@@ -1,0 +1,639 @@
+"""Typed, defaulted views over parsed prototxt `Message` trees.
+
+Field names / defaults mirror the reference schema
+(reference: caffe/src/caffe/proto/caffe.proto) so that the bundled model and
+solver prototxts (cifar10_quick/full, LeNet, AlexNet, CaffeNet, GoogLeNet)
+parse with identical semantics.  Only the subset actually consumed by the
+framework is given a typed view; everything else stays reachable through the
+raw `Message`.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional, Sequence
+
+from .textformat import Enum, Message, parse, parse_file, serialize
+
+
+class View:
+    """Base: wraps a raw Message; subclasses define DEFAULTS for scalar fields."""
+
+    DEFAULTS: dict[str, Any] = {}
+
+    def __init__(self, msg: Optional[Message] = None) -> None:
+        self.msg = msg if msg is not None else Message()
+
+    def __getattr__(self, name: str):
+        # Only called when normal lookup fails -> field access on the message.
+        if name.startswith("_") or name == "msg":
+            raise AttributeError(name)
+        defaults = type(self).DEFAULTS
+        if name in defaults:
+            v = self.msg.get(name, defaults[name])
+            d = defaults[name]
+            if isinstance(d, float) and v is not None and not isinstance(v, bool):
+                return float(v)
+            if isinstance(d, int) and not isinstance(d, bool) and v is not None \
+                    and not isinstance(v, bool) and not isinstance(v, str):
+                return int(v)
+            return v
+        return self.msg.get(name)
+
+    def has(self, name: str) -> bool:
+        return self.msg.has(name)
+
+    def getlist(self, name: str) -> List[Any]:
+        return self.msg.getlist(name)
+
+    def __repr__(self) -> str:
+        return f"{type(self).__name__}({self.msg!r})"
+
+
+# ---------------------------------------------------------------------------
+# Fillers (caffe.proto:43-62)
+# ---------------------------------------------------------------------------
+
+class FillerParameter(View):
+    DEFAULTS = dict(type="constant", value=0.0, min=0.0, max=1.0, mean=0.0,
+                    std=1.0, sparse=-1, variance_norm="FAN_IN")
+
+
+# ---------------------------------------------------------------------------
+# Per-layer parameter messages
+# ---------------------------------------------------------------------------
+
+def _resolve_hw(msg: Message, name: str, default: int) -> tuple:
+    """Resolve a spatial size from repeated `name` and/or `<stem>_h`/`<stem>_w`
+    (the 2-D alternatives; note `kernel_size` pairs with `kernel_h`/`kernel_w`,
+    reference: caffe.proto:499-512, 781-795)."""
+    stem = name[:-5] if name.endswith("_size") else name
+    h = msg.get(stem + "_h")
+    w = msg.get(stem + "_w")
+    if h is not None or w is not None:
+        return (int(h) if h is not None else default,
+                int(w) if w is not None else default)
+    vals = msg.getlist(name)
+    if not vals:
+        return (default, default)
+    if len(vals) == 1:
+        return (int(vals[0]), int(vals[0]))
+    return tuple(int(v) for v in vals)
+
+
+class ConvolutionParameter(View):
+    # caffe.proto:495-541: pad/kernel_size/stride are *repeated* (nd conv),
+    # with _h/_w 2-D alternatives.
+    DEFAULTS = dict(num_output=0, bias_term=True, group=1, axis=1,
+                    force_nd_im2col=False)
+
+    def _dims(self, name: str, default: int) -> tuple:
+        return _resolve_hw(self.msg, name, default)
+
+    @property
+    def kernel(self) -> tuple:
+        return self._dims("kernel_size", 0)
+
+    @property
+    def pad(self) -> tuple:
+        return self._dims("pad", 0)
+
+    @property
+    def stride(self) -> tuple:
+        return self._dims("stride", 1)
+
+    @property
+    def dilation(self) -> tuple:
+        return self._dims("dilation", 1)
+
+    @property
+    def weight_filler(self) -> FillerParameter:
+        return FillerParameter(self.msg.get("weight_filler"))
+
+    @property
+    def bias_filler(self) -> FillerParameter:
+        return FillerParameter(self.msg.get("bias_filler"))
+
+
+class PoolingParameter(View):
+    # caffe.proto:777-801
+    DEFAULTS = dict(pool="MAX", global_pooling=False)
+
+    @property
+    def kernel(self) -> tuple:
+        return _resolve_hw(self.msg, "kernel_size", 0)
+
+    @property
+    def pads(self) -> tuple:
+        return _resolve_hw(self.msg, "pad", 0)
+
+    @property
+    def strides(self) -> tuple:
+        return _resolve_hw(self.msg, "stride", 1)
+
+
+class InnerProductParameter(View):
+    DEFAULTS = dict(num_output=0, bias_term=True, axis=1)
+
+    @property
+    def weight_filler(self) -> FillerParameter:
+        return FillerParameter(self.msg.get("weight_filler"))
+
+    @property
+    def bias_filler(self) -> FillerParameter:
+        return FillerParameter(self.msg.get("bias_filler"))
+
+
+class LRNParameter(View):
+    DEFAULTS = dict(local_size=5, alpha=1.0, beta=0.75,
+                    norm_region="ACROSS_CHANNELS", k=1.0)
+
+
+class ReLUParameter(View):
+    DEFAULTS = dict(negative_slope=0.0)
+
+
+class PReLUParameter(View):
+    DEFAULTS = dict(channel_shared=False)
+
+    @property
+    def filler(self) -> FillerParameter:
+        f = FillerParameter(self.msg.get("filler"))
+        if not f.msg.has("type"):  # PReLU default init is 0.25 (prelu_layer.cpp)
+            f.msg.set("type", "constant")
+            f.msg.set("value", 0.25)
+        return f
+
+
+class DropoutParameter(View):
+    DEFAULTS = dict(dropout_ratio=0.5)
+
+
+class PowerParameter(View):
+    DEFAULTS = dict(power=1.0, scale=1.0, shift=0.0)
+
+
+class ExpParameter(View):
+    DEFAULTS = dict(base=-1.0, scale=1.0, shift=0.0)
+
+
+class LogParameter(View):
+    DEFAULTS = dict(base=-1.0, scale=1.0, shift=0.0)
+
+
+class ConcatParameter(View):
+    DEFAULTS = dict(axis=1, concat_dim=1)
+
+
+class SliceParameter(View):
+    DEFAULTS = dict(axis=1, slice_dim=1)
+
+    @property
+    def slice_points(self) -> List[int]:
+        return [int(v) for v in self.msg.getlist("slice_point")]
+
+
+class EltwiseParameter(View):
+    DEFAULTS = dict(operation="SUM", stable_prod_grad=True)
+
+    @property
+    def coeffs(self) -> List[float]:
+        return [float(v) for v in self.msg.getlist("coeff")]
+
+
+class SoftmaxParameter(View):
+    DEFAULTS = dict(axis=1)
+
+
+class AccuracyParameter(View):
+    DEFAULTS = dict(top_k=1, axis=1)
+
+    @property
+    def ignore_label(self) -> Optional[int]:
+        v = self.msg.get("ignore_label")
+        return None if v is None else int(v)
+
+
+class LossParameter(View):
+    DEFAULTS = dict(normalize=True)
+
+    @property
+    def ignore_label(self) -> Optional[int]:
+        v = self.msg.get("ignore_label")
+        return None if v is None else int(v)
+
+
+class HingeLossParameter(View):
+    DEFAULTS = dict(norm="L1")
+
+
+class ContrastiveLossParameter(View):
+    DEFAULTS = dict(margin=1.0, legacy_version=False)
+
+
+class InfogainLossParameter(View):
+    DEFAULTS = dict(source="")
+
+
+class FlattenParameter(View):
+    DEFAULTS = dict(axis=1, end_axis=-1)
+
+
+class ReshapeParameter(View):
+    DEFAULTS = dict(axis=0, num_axes=-1)
+
+    @property
+    def shape_dims(self) -> List[int]:
+        sh = self.msg.get("shape")
+        if sh is None:
+            return []
+        return [int(d) for d in sh.getlist("dim")]
+
+
+class TileParameter(View):
+    DEFAULTS = dict(axis=1, tiles=1)
+
+
+class EmbedParameter(View):
+    DEFAULTS = dict(num_output=0, input_dim=0, bias_term=True)
+
+    @property
+    def weight_filler(self) -> FillerParameter:
+        return FillerParameter(self.msg.get("weight_filler"))
+
+    @property
+    def bias_filler(self) -> FillerParameter:
+        return FillerParameter(self.msg.get("bias_filler"))
+
+
+class ReductionParameter(View):
+    DEFAULTS = dict(operation="SUM", axis=0, coeff=1.0)
+
+
+class ArgMaxParameter(View):
+    DEFAULTS = dict(out_max_val=False, top_k=1)
+
+    @property
+    def axis(self) -> Optional[int]:
+        v = self.msg.get("axis")
+        return None if v is None else int(v)
+
+
+class ThresholdParameter(View):
+    DEFAULTS = dict(threshold=0.0)
+
+
+class BatchNormParameter(View):
+    DEFAULTS = dict(moving_average_fraction=0.999, eps=1e-5)
+
+    @property
+    def use_global_stats(self) -> Optional[bool]:
+        v = self.msg.get("use_global_stats")
+        return None if v is None else bool(v)
+
+
+class MVNParameter(View):
+    DEFAULTS = dict(normalize_variance=True, across_channels=False, eps=1e-9)
+
+
+class SPPParameter(View):
+    DEFAULTS = dict(pyramid_height=0, pool="MAX")
+
+
+class BatchReindexParameter(View):
+    DEFAULTS: dict[str, Any] = {}
+
+
+class TransformationParameter(View):
+    # caffe.proto:401-421
+    DEFAULTS = dict(scale=1.0, mirror=False, crop_size=0, mean_file="",
+                    force_color=False, force_gray=False)
+
+    @property
+    def mean_values(self) -> List[float]:
+        return [float(v) for v in self.msg.getlist("mean_value")]
+
+
+class DataParameter(View):
+    DEFAULTS = dict(source="", batch_size=0, backend="LEVELDB", rand_skip=0,
+                    scale=1.0, mirror=False, crop_size=0, mean_file="", prefetch=4)
+
+
+class MemoryDataParameter(View):
+    DEFAULTS = dict(batch_size=0, channels=0, height=0, width=0)
+
+
+class ImageDataParameter(View):
+    DEFAULTS = dict(source="", batch_size=1, rand_skip=0, shuffle=False,
+                    new_height=0, new_width=0, is_color=True, scale=1.0,
+                    mirror=False, crop_size=0, mean_file="", root_folder="")
+
+
+class HDF5DataParameter(View):
+    DEFAULTS = dict(source="", batch_size=0, shuffle=False)
+
+
+class HDF5OutputParameter(View):
+    DEFAULTS = dict(file_name="")
+
+
+class WindowDataParameter(View):
+    DEFAULTS = dict(source="", scale=1.0, mean_file="", batch_size=0,
+                    crop_size=0, mirror=False, fg_threshold=0.5,
+                    bg_threshold=0.5, fg_fraction=0.25, context_pad=0,
+                    crop_mode="warp", cache_images=False, root_folder="")
+
+
+class DummyDataParameter(View):
+    @property
+    def shapes(self) -> List[List[int]]:
+        return [[int(d) for d in s.getlist("dim")] for s in self.msg.getlist("shape")]
+
+    @property
+    def data_fillers(self) -> List[FillerParameter]:
+        return [FillerParameter(m) for m in self.msg.getlist("data_filler")]
+
+
+class JavaDataParameter(View):
+    """SparkNet's own layer param (reference: caffe.proto:991-993)."""
+
+    @property
+    def shape_dims(self) -> List[int]:
+        sh = self.msg.get("shape")
+        if sh is None:
+            return []
+        return [int(d) for d in sh.getlist("dim")]
+
+
+class ParamSpec(View):
+    # caffe.proto:286-304
+    DEFAULTS = dict(name="", lr_mult=1.0, decay_mult=1.0, share_mode="STRICT")
+
+
+class BlobShape(View):
+    @property
+    def dims(self) -> List[int]:
+        return [int(d) for d in self.msg.getlist("dim")]
+
+
+class NetStateRule(View):
+    # caffe.proto:262-284
+    @property
+    def phase(self) -> Optional[str]:
+        v = self.msg.get("phase")
+        return None if v is None else str(v)
+
+    @property
+    def min_level(self) -> Optional[int]:
+        v = self.msg.get("min_level")
+        return None if v is None else int(v)
+
+    @property
+    def max_level(self) -> Optional[int]:
+        v = self.msg.get("max_level")
+        return None if v is None else int(v)
+
+    @property
+    def stages(self) -> List[str]:
+        return [str(s) for s in self.msg.getlist("stage")]
+
+    @property
+    def not_stages(self) -> List[str]:
+        return [str(s) for s in self.msg.getlist("not_stage")]
+
+
+class NetState(View):
+    DEFAULTS = dict(phase="TEST", level=0)
+
+    @property
+    def stages(self) -> List[str]:
+        return [str(s) for s in self.msg.getlist("stage")]
+
+
+_PARAM_VIEWS = {
+    "convolution_param": ConvolutionParameter,
+    "pooling_param": PoolingParameter,
+    "inner_product_param": InnerProductParameter,
+    "lrn_param": LRNParameter,
+    "relu_param": ReLUParameter,
+    "prelu_param": PReLUParameter,
+    "dropout_param": DropoutParameter,
+    "power_param": PowerParameter,
+    "exp_param": ExpParameter,
+    "log_param": LogParameter,
+    "concat_param": ConcatParameter,
+    "slice_param": SliceParameter,
+    "eltwise_param": EltwiseParameter,
+    "softmax_param": SoftmaxParameter,
+    "accuracy_param": AccuracyParameter,
+    "loss_param": LossParameter,
+    "hinge_loss_param": HingeLossParameter,
+    "contrastive_loss_param": ContrastiveLossParameter,
+    "infogain_loss_param": InfogainLossParameter,
+    "flatten_param": FlattenParameter,
+    "reshape_param": ReshapeParameter,
+    "tile_param": TileParameter,
+    "embed_param": EmbedParameter,
+    "reduction_param": ReductionParameter,
+    "argmax_param": ArgMaxParameter,
+    "threshold_param": ThresholdParameter,
+    "batch_norm_param": BatchNormParameter,
+    "mvn_param": MVNParameter,
+    "spp_param": SPPParameter,
+    "transform_param": TransformationParameter,
+    "data_param": DataParameter,
+    "memory_data_param": MemoryDataParameter,
+    "image_data_param": ImageDataParameter,
+    "hdf5_data_param": HDF5DataParameter,
+    "hdf5_output_param": HDF5OutputParameter,
+    "window_data_param": WindowDataParameter,
+    "dummy_data_param": DummyDataParameter,
+    "java_data_param": JavaDataParameter,
+}
+
+
+class LayerParameter(View):
+    # caffe.proto:310-399
+    DEFAULTS = dict(name="", type="")
+
+    @property
+    def bottoms(self) -> List[str]:
+        return [str(b) for b in self.msg.getlist("bottom")]
+
+    @property
+    def tops(self) -> List[str]:
+        return [str(t) for t in self.msg.getlist("top")]
+
+    @property
+    def params(self) -> List[ParamSpec]:
+        return [ParamSpec(m) for m in self.msg.getlist("param")]
+
+    @property
+    def include_rules(self) -> List[NetStateRule]:
+        return [NetStateRule(m) for m in self.msg.getlist("include")]
+
+    @property
+    def exclude_rules(self) -> List[NetStateRule]:
+        return [NetStateRule(m) for m in self.msg.getlist("exclude")]
+
+    @property
+    def loss_weights(self) -> List[float]:
+        return [float(v) for v in self.msg.getlist("loss_weight")]
+
+    @property
+    def phase(self) -> Optional[str]:
+        v = self.msg.get("phase")
+        return None if v is None else str(v)
+
+    def param_view(self, which: str) -> Any:
+        cls = _PARAM_VIEWS[which]
+        return cls(self.msg.get(which))
+
+    def __getattr__(self, name: str):
+        if name in _PARAM_VIEWS:
+            return _PARAM_VIEWS[name](self.msg.get(name))
+        return super().__getattr__(name)
+
+
+class NetParameter(View):
+    # caffe.proto:64-100
+    DEFAULTS = dict(name="", force_backward=False, debug_info=False)
+
+    @property
+    def layers(self) -> List[LayerParameter]:
+        # modern field `layer`; legacy `layers` (V1) not supported — the bundled
+        # prototxts all use `layer`.
+        return [LayerParameter(m) for m in self.msg.getlist("layer")]
+
+    @property
+    def input_blobs(self) -> List[str]:
+        return [str(s) for s in self.msg.getlist("input")]
+
+    @property
+    def input_shapes(self) -> List[List[int]]:
+        shapes = [[int(d) for d in s.getlist("dim")]
+                  for s in self.msg.getlist("input_shape")]
+        if not shapes and self.msg.has("input_dim"):
+            dims = [int(d) for d in self.msg.getlist("input_dim")]
+            shapes = [dims[i:i + 4] for i in range(0, len(dims), 4)]
+        return shapes
+
+    @property
+    def state(self) -> NetState:
+        return NetState(self.msg.get("state"))
+
+    def add_layer(self, layer_msg: Message, index: Optional[int] = None) -> None:
+        if index is None:
+            self.msg.add("layer", layer_msg)
+        else:
+            lst = self.msg._fields.setdefault("layer", [])
+            lst.insert(index, layer_msg)
+
+
+class SolverParameter(View):
+    # caffe.proto:102-244
+    DEFAULTS = dict(
+        net="", train_net="", test_interval=0, test_compute_loss=False,
+        test_initialization=True, base_lr=0.01, display=0, average_loss=1,
+        max_iter=0, iter_size=1, lr_policy="fixed", gamma=0.1, power=1.0,
+        momentum=0.0, weight_decay=0.0, regularization_type="L2", stepsize=0,
+        clip_gradients=-1.0, snapshot=0, snapshot_prefix="",
+        snapshot_diff=False, snapshot_format="BINARYPROTO", solver_mode="GPU",
+        device_id=0, random_seed=-1, type="SGD", delta=1e-8, momentum2=0.999,
+        rms_decay=0.99, debug_info=False, snapshot_after_train=True,
+    )
+
+    @property
+    def net_param(self) -> Optional[NetParameter]:
+        m = self.msg.get("net_param")
+        return None if m is None else NetParameter(m)
+
+    @property
+    def train_net_param(self) -> Optional[NetParameter]:
+        m = self.msg.get("train_net_param")
+        return None if m is None else NetParameter(m)
+
+    @property
+    def test_iters(self) -> List[int]:
+        return [int(v) for v in self.msg.getlist("test_iter")]
+
+    @property
+    def stepvalues(self) -> List[int]:
+        return [int(v) for v in self.msg.getlist("stepvalue")]
+
+    @property
+    def legacy_solver_type(self) -> Optional[str]:
+        """Old enum field `solver_type` (caffe.proto:232-241); maps to `type`."""
+        v = self.msg.get("solver_type")
+        return None if v is None else str(v)
+
+    def resolved_type(self) -> str:
+        if self.msg.has("type"):
+            return str(self.msg.get("type"))
+        legacy = self.legacy_solver_type
+        if legacy is not None:
+            # enum names or numeric values (caffe.proto:232-241)
+            table = {"SGD": "SGD", "NESTEROV": "Nesterov", "ADAGRAD": "AdaGrad",
+                     "RMSPROP": "RMSProp", "ADADELTA": "AdaDelta", "ADAM": "Adam",
+                     "0": "SGD", "1": "Nesterov", "2": "AdaGrad", "3": "RMSProp",
+                     "4": "AdaDelta", "5": "Adam"}
+            key = str(legacy)
+            if key not in table:
+                raise ValueError(f"unknown solver_type {legacy!r}")
+            return table[key]
+        return "SGD"
+
+
+def load_net_prototxt(path: str) -> NetParameter:
+    """Parse a net prototxt (reference: ProtoLoader.scala:9-29, via C++ there)."""
+    return NetParameter(parse_file(path))
+
+
+def load_solver_prototxt(path: str) -> SolverParameter:
+    return SolverParameter(parse_file(path))
+
+
+def load_solver_prototxt_with_net(solver_path: str, net: NetParameter,
+                                  ) -> SolverParameter:
+    """Inline a net into a solver param, clearing file-based net refs and
+    engine-side snapshotting (reference: ProtoLoader.scala:31-43)."""
+    sp = SolverParameter(parse_file(solver_path))
+    for f in ("net", "train_net", "test_net"):
+        sp.msg.clear(f)
+    sp.msg.set("net_param", net.msg.copy())
+    # SparkNet drives snapshots from the driver, not the engine
+    sp.msg.clear("snapshot")
+    sp.msg.set("snapshot_after_train", False)
+    sp.msg.set("snapshot_prefix", "/tmp/sparknet_tpu")
+    return sp
+
+
+def replace_data_layers(net: NetParameter, train_batch_size: int,
+                        test_batch_size: int, channels: int, height: int,
+                        width: int) -> NetParameter:
+    """Swap the first two (data) layers for train+test in-memory feed layers
+    with the given batch/shape (reference: ProtoLoader.scala:50-57,
+    Layers.scala:18-40 `RDDLayer`)."""
+    out = NetParameter(net.msg.copy())
+    layers = out.msg.getlist("layer")
+    # Drop every leading data-source layer (the reference drops exactly the
+    # first two; we generalize to any number of leading data layers).
+    data_types = {"Data", "ImageData", "MemoryData", "HDF5Data", "WindowData",
+                  "DummyData", "JavaData"}
+    n_data = 0
+    while n_data < len(layers) and str(
+            LayerParameter(layers[n_data]).type) in data_types:
+        n_data += 1
+    rest = layers[max(n_data, 1):]
+
+    def make(phase: str, batch: int) -> Message:
+        m = parse(
+            'name: "data" type: "MemoryData" top: "data" top: "label"\n'
+            f'include {{ phase: {phase} }}\n'
+            f'memory_data_param {{ batch_size: {batch} channels: {channels} '
+            f'height: {height} width: {width} }}\n'
+        )
+        return m
+
+    out.msg._fields["layer"] = [make("TRAIN", train_batch_size),
+                                make("TEST", test_batch_size)] + rest
+    return out

@@ -1,0 +1,254 @@
+"""Pure-Python protobuf *text format* parser / serializer.
+
+The reference parses prototxt through the C++ protobuf runtime reached over JNA
+(reference: libccaffe/ccaffe.cpp:275-304, src/main/scala/libs/ProtoLoader.scala:9-29).
+We need no generated bindings: prototxt is a simple self-describing text tree, so a
+schema-less recursive-descent parser suffices.  Typed, defaulted access on top of the
+raw tree lives in `caffe_pb.py`.
+
+Grammar (informal):
+
+    message  := field*
+    field    := IDENT ':' scalar | IDENT '{' message '}' | IDENT '<' message '>'
+    scalar   := STRING | NUMBER | BOOL | ENUM_IDENT
+
+Repeated fields appear as repeated keys.  Comments run '#' to end of line.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Iterator, List, Optional, Union
+
+
+class Message:
+    """Dynamic protobuf message: ordered multimap of field name -> values.
+
+    Values are str/int/float/bool scalars, `Enum` tokens, or nested `Message`s.
+    Field order is preserved for faithful re-serialization.
+    """
+
+    __slots__ = ("_fields",)
+
+    def __init__(self) -> None:
+        # name -> list of values (singular fields hold a 1-element list)
+        self._fields: dict[str, list[Any]] = {}
+
+    # -- construction -------------------------------------------------------
+    def add(self, name: str, value: Any) -> None:
+        self._fields.setdefault(name, []).append(value)
+
+    def set(self, name: str, value: Any) -> None:
+        self._fields[name] = [value]
+
+    def clear(self, name: str) -> None:
+        self._fields.pop(name, None)
+
+    # -- access -------------------------------------------------------------
+    def get(self, name: str, default: Any = None) -> Any:
+        vals = self._fields.get(name)
+        if not vals:
+            return default
+        return vals[-1]  # proto3/proto2 semantics: last singular value wins
+
+    def getlist(self, name: str) -> List[Any]:
+        return list(self._fields.get(name, []))
+
+    def has(self, name: str) -> bool:
+        return bool(self._fields.get(name))
+
+    def keys(self):
+        return self._fields.keys()
+
+    def items(self) -> Iterator[tuple]:
+        for k, vals in self._fields.items():
+            for v in vals:
+                yield k, v
+
+    def copy(self) -> "Message":
+        m = Message()
+        for k, vals in self._fields.items():
+            m._fields[k] = [v.copy() if isinstance(v, Message) else v for v in vals]
+        return m
+
+    def __contains__(self, name: str) -> bool:
+        return self.has(name)
+
+    def __repr__(self) -> str:
+        return f"Message({dict(self._fields)!r})"
+
+    def __eq__(self, other: object) -> bool:
+        return isinstance(other, Message) and self._fields == other._fields
+
+
+class Enum(str):
+    """A bare-identifier scalar (enum value) — a str subtype so comparisons with
+    string literals work, but serialized without quotes."""
+
+    __slots__ = ()
+
+
+_TOKEN_RE = re.compile(
+    r"""
+    (?P<space>\s+|\#[^\n]*)
+  | (?P<brace>[{}<>])
+  | (?P<punct>[\[\],;])
+  | (?P<colon>:)
+  | (?P<string>"(?:[^"\\]|\\.)*"|'(?:[^'\\]|\\.)*')
+  | (?P<number>[-+]?(?:\.\d+|\d+\.?\d*)(?:[eE][-+]?\d+)?|[-+]?(?:inf(?:inity)?|nan)\b)
+  | (?P<ident>[A-Za-z_][A-Za-z0-9_.]*)
+    """,
+    re.VERBOSE,
+)
+
+_ESCAPES = {"n": "\n", "t": "\t", "r": "\r", '"': '"', "'": "'", "\\": "\\", "0": "\0"}
+
+
+def _unquote(tok: str) -> str:
+    body = tok[1:-1]
+    out, i = [], 0
+    while i < len(body):
+        c = body[i]
+        if c == "\\" and i + 1 < len(body):
+            out.append(_ESCAPES.get(body[i + 1], body[i + 1]))
+            i += 2
+        else:
+            out.append(c)
+            i += 1
+    return "".join(out)
+
+
+def _tokenize(text: str) -> Iterator[tuple[str, str]]:
+    pos = 0
+    while pos < len(text):
+        m = _TOKEN_RE.match(text, pos)
+        if m is None:
+            raise ValueError(
+                f"prototxt tokenize error at offset {pos}: {text[pos:pos+40]!r}"
+            )
+        pos = m.end()
+        kind = m.lastgroup
+        if kind != "space":
+            yield kind, m.group()
+    yield "eof", ""
+
+
+class _Parser:
+    def __init__(self, text: str) -> None:
+        self._toks = list(_tokenize(text))
+        self._i = 0
+
+    def _peek(self) -> tuple[str, str]:
+        return self._toks[self._i]
+
+    def _next(self) -> tuple[str, str]:
+        t = self._toks[self._i]
+        self._i += 1
+        return t
+
+    def parse_message(self, terminator: Optional[str] = None) -> Message:
+        msg = Message()
+        while True:
+            kind, tok = self._peek()
+            if kind == "eof":
+                if terminator is not None:
+                    raise ValueError("unexpected EOF inside message")
+                return msg
+            if kind == "brace" and tok in ("}", ">"):
+                if terminator is None or tok != terminator:
+                    raise ValueError(f"unexpected {tok!r}")
+                self._next()
+                return msg
+            if kind != "ident":
+                raise ValueError(f"expected field name, got {tok!r}")
+            name = self._next()[1]
+            kind, tok = self._peek()
+            if kind == "colon":
+                self._next()
+                if self._peek() == ("punct", "["):
+                    for v in self._parse_bracket_list():
+                        msg.add(name, v)
+                else:
+                    msg.add(name, self._parse_scalar())
+            elif kind == "brace" and tok in ("{", "<"):
+                self._next()
+                msg.add(name, self.parse_message("}" if tok == "{" else ">"))
+            else:
+                raise ValueError(f"expected ':' or '{{' after {name!r}, got {tok!r}")
+            # optional field separators (legal text format)
+            while self._peek() == ("punct", ";") or self._peek() == ("punct", ","):
+                self._next()
+
+    def _parse_bracket_list(self) -> list:
+        """`field: [v, v, ...]` — short repeated-field syntax."""
+        self._next()  # consume '['
+        vals: list = []
+        if self._peek() == ("punct", "]"):
+            self._next()
+            return vals
+        while True:
+            vals.append(self._parse_scalar())
+            kind, tok = self._next()
+            if (kind, tok) == ("punct", "]"):
+                return vals
+            if (kind, tok) != ("punct", ","):
+                raise ValueError(f"expected ',' or ']' in list, got {tok!r}")
+
+    def _parse_scalar(self) -> Any:
+        kind, tok = self._next()
+        if kind == "string":
+            # adjacent string literals concatenate (proto text format)
+            parts = [_unquote(tok)]
+            while self._peek()[0] == "string":
+                parts.append(_unquote(self._next()[1]))
+            return "".join(parts)
+        if kind == "number":
+            if re.fullmatch(r"[-+]?\d+", tok):
+                return int(tok)
+            return float(tok)
+        if kind == "ident":
+            if tok == "true":
+                return True
+            if tok == "false":
+                return False
+            return Enum(tok)
+        if kind == "brace" and tok in ("{", "<"):
+            # `field: { ... }` — colon before a message is legal text format
+            return self.parse_message("}" if tok == "{" else ">")
+        raise ValueError(f"bad scalar token {tok!r}")
+
+
+def parse(text: str) -> Message:
+    """Parse prototxt text into a `Message` tree."""
+    return _Parser(text).parse_message()
+
+
+def parse_file(path: str) -> Message:
+    with open(path, "r") as f:
+        return parse(f.read())
+
+
+def _fmt_scalar(v: Any) -> str:
+    if isinstance(v, bool):
+        return "true" if v else "false"
+    if isinstance(v, Enum):
+        return str(v)
+    if isinstance(v, str):
+        body = v.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+        return f'"{body}"'
+    if isinstance(v, float):
+        s = repr(v)
+        return s
+    return str(v)
+
+
+def serialize(msg: Message, indent: int = 0) -> str:
+    """Serialize a `Message` back to prototxt text (round-trips `parse`)."""
+    pad = "  " * indent
+    out: list[str] = []
+    for name, value in msg.items():
+        if isinstance(value, Message):
+            out.append(f"{pad}{name} {{\n{serialize(value, indent + 1)}{pad}}}\n")
+        else:
+            out.append(f"{pad}{name}: {_fmt_scalar(value)}\n")
+    return "".join(out)

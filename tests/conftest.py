@@ -1,0 +1,34 @@
+"""Test harness: force an 8-device virtual CPU platform BEFORE jax imports.
+
+Mirrors the survey's test strategy (SURVEY.md §4.1): multi-device behavior is
+exercised on host-platform fake devices so the τ-averaging collectives are
+tested without TPU hardware.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture
+def rng():
+    return np.random.RandomState(1234)
+
+
+REFERENCE = "/root/reference"
+
+
+def reference_path(rel: str) -> str:
+    return os.path.join(REFERENCE, rel)

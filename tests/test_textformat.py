@@ -1,0 +1,134 @@
+"""Parser tests: round-trip and real bundled prototxts from the reference."""
+
+import glob
+import os
+
+import pytest
+
+from sparknet_tpu.proto import caffe_pb, textformat
+from tests.conftest import reference_path
+
+
+def test_scalars_and_nesting():
+    m = textformat.parse(
+        '''
+        name: "net"  # a comment
+        num: 3
+        frac: -1.5e-2
+        flag: true
+        mode: LMDB
+        inner { a: 1 inner2 { b: "x\\ny" } }
+        rep: 1 rep: 2 rep: 3
+        '''
+    )
+    assert m.get("name") == "net"
+    assert m.get("num") == 3
+    assert m.get("frac") == -0.015
+    assert m.get("flag") is True
+    assert m.get("mode") == "LMDB"
+    assert isinstance(m.get("mode"), textformat.Enum)
+    assert m.get("inner").get("inner2").get("b") == "x\ny"
+    assert m.getlist("rep") == [1, 2, 3]
+
+
+def test_roundtrip():
+    src = 'name: "n"\nlayer {\n  type: "Convolution"\n  pad: 2\n}\n'
+    m = textformat.parse(src)
+    again = textformat.parse(textformat.serialize(m))
+    assert m == again
+
+
+def test_angle_brackets_and_colon_message():
+    m = textformat.parse('a < b: 1 > c: { d: 2 }')
+    assert m.get("a").get("b") == 1
+    assert m.get("c").get("d") == 2
+
+
+BUNDLED = [
+    "caffe/examples/cifar10/cifar10_quick_train_test.prototxt",
+    "caffe/examples/cifar10/cifar10_full_train_test.prototxt",
+    "caffe/examples/mnist/lenet_train_test.prototxt",
+    "caffe/models/bvlc_alexnet/train_val.prototxt",
+    "caffe/models/bvlc_reference_caffenet/train_val.prototxt",
+    "caffe/models/bvlc_googlenet/train_val.prototxt",
+    "caffe/examples/mnist/mnist_autoencoder.prototxt",
+]
+
+
+@pytest.mark.parametrize("rel", BUNDLED)
+def test_parse_bundled_net(rel):
+    path = reference_path(rel)
+    if not os.path.exists(path):
+        pytest.skip(f"missing {rel}")
+    net = caffe_pb.load_net_prototxt(path)
+    assert len(net.layers) > 3
+    for layer in net.layers:
+        assert layer.type
+    # round trip parses to the same tree
+    again = textformat.parse(textformat.serialize(net.msg))
+    assert again == net.msg
+
+
+def test_parse_all_reference_prototxts():
+    """Every prototxt in the reference tree must tokenize+parse."""
+    paths = glob.glob(reference_path("caffe/**/*.prototxt"), recursive=True)
+    assert len(paths) > 30
+    for p in paths:
+        textformat.parse_file(p)
+
+
+def test_solver_defaults_and_fields():
+    sp = caffe_pb.load_solver_prototxt(
+        reference_path("caffe/examples/cifar10/cifar10_quick_solver.prototxt"))
+    assert sp.base_lr == pytest.approx(0.001)
+    assert sp.lr_policy == "fixed"
+    assert sp.max_iter == 4000
+    assert sp.momentum == pytest.approx(0.9)
+    assert sp.weight_decay == pytest.approx(0.004)
+    assert sp.test_iters == [100]
+    assert sp.resolved_type() == "SGD"
+    # defaults for unset fields
+    assert sp.iter_size == 1
+    assert sp.clip_gradients == -1.0
+    assert sp.regularization_type == "L2"
+
+
+def test_solver_with_net_inline():
+    net = caffe_pb.load_net_prototxt(
+        reference_path("caffe/examples/cifar10/cifar10_quick_train_test.prototxt"))
+    sp = caffe_pb.load_solver_prototxt_with_net(
+        reference_path("caffe/examples/cifar10/cifar10_quick_solver.prototxt"), net)
+    assert sp.net_param is not None
+    assert not sp.msg.has("net")
+    assert sp.msg.get("snapshot_after_train") is False
+    assert len(sp.net_param.layers) == len(net.layers)
+
+
+def test_replace_data_layers():
+    net = caffe_pb.load_net_prototxt(
+        reference_path("caffe/examples/cifar10/cifar10_quick_train_test.prototxt"))
+    out = caffe_pb.replace_data_layers(net, 100, 100, 3, 32, 32)
+    layers = out.layers
+    assert layers[0].type == "MemoryData"
+    assert layers[1].type == "MemoryData"
+    assert layers[0].include_rules[0].phase == "TRAIN"
+    assert layers[1].include_rules[0].phase == "TEST"
+    assert layers[0].memory_data_param.batch_size == 100
+    assert layers[2].name == "conv1"
+    # original untouched
+    assert net.layers[0].type == "Data"
+
+
+def test_alexnet_conv_params():
+    net = caffe_pb.load_net_prototxt(
+        reference_path("caffe/models/bvlc_alexnet/train_val.prototxt"))
+    conv1 = [l for l in net.layers if l.name == "conv1"][0]
+    cp = conv1.convolution_param
+    assert cp.num_output == 96
+    assert cp.kernel == (11, 11)
+    assert cp.stride == (4, 4)
+    assert conv1.params[0].lr_mult == 1.0
+    assert conv1.params[1].lr_mult == 2.0
+    conv2 = [l for l in net.layers if l.name == "conv2"][0]
+    assert conv2.convolution_param.group == 2
+    assert conv2.convolution_param.pad == (2, 2)
