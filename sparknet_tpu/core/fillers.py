@@ -1,0 +1,88 @@
+"""Weight fillers (reference: caffe/include/caffe/filler.hpp).
+
+Distributions and fan computations match the reference exactly — initial
+weights drive epochs-to-accuracy, the north-star metric (SURVEY.md §7).
+Fillers run host-side on numpy with a seeded RNG; results become device
+arrays at first use.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence, Tuple
+
+import numpy as np
+
+from ..proto.caffe_pb import FillerParameter
+
+
+def _fans(shape: Sequence[int]) -> Tuple[int, int]:
+    """fan_in = count/num, fan_out = count/channels
+    (reference: filler.hpp:136-160 XavierFiller/MSRAFiller)."""
+    count = 1
+    for s in shape:
+        count *= int(s)
+    num = int(shape[0]) if len(shape) > 0 else 1
+    channels = int(shape[1]) if len(shape) > 1 else 1
+    return count // max(num, 1), count // max(channels, 1)
+
+
+def fill(filler: FillerParameter, shape: Sequence[int],
+         rng: np.random.RandomState) -> np.ndarray:
+    """Materialize one blob according to its FillerParameter."""
+    shape = tuple(int(s) for s in shape)
+    ftype = str(filler.type)
+    if ftype == "constant":
+        return np.full(shape, float(filler.value), dtype=np.float32)
+    if ftype == "uniform":
+        return rng.uniform(float(filler.min), float(filler.max),
+                           size=shape).astype(np.float32)
+    if ftype == "gaussian":
+        out = (rng.randn(*shape) * float(filler.std) + float(filler.mean)
+               ).astype(np.float32)
+        sparse = int(filler.sparse)
+        if sparse >= 0:
+            # reference: filler.hpp:60-77 — bernoulli mask with
+            # p = sparse / fan_in (num_outputs = shape[0])
+            fan_in = 1
+            for s in shape[1:]:
+                fan_in *= s
+            p = sparse / max(fan_in, 1)
+            out *= (rng.rand(*shape) < p)
+        return out
+    if ftype == "positive_unitball":
+        # rows sum to 1 (reference: filler.hpp:88-111)
+        out = rng.rand(*shape).astype(np.float32)
+        flat = out.reshape(shape[0], -1)
+        flat /= flat.sum(axis=1, keepdims=True)
+        return flat.reshape(shape)
+    if ftype == "xavier":
+        fan_in, fan_out = _fans(shape)
+        n = _norm_fan(filler, fan_in, fan_out)
+        scale = float(np.sqrt(3.0 / n))
+        return rng.uniform(-scale, scale, size=shape).astype(np.float32)
+    if ftype == "msra":
+        fan_in, fan_out = _fans(shape)
+        n = _norm_fan(filler, fan_in, fan_out)
+        std = float(np.sqrt(2.0 / n))
+        return (rng.randn(*shape) * std).astype(np.float32)
+    if ftype == "bilinear":
+        # upsampling kernel for deconv (reference: filler.hpp:187-213)
+        assert len(shape) == 4 and shape[2] == shape[3]
+        k = shape[3]
+        f = int(np.ceil(k / 2.0))
+        c = (2 * f - 1 - f % 2) / (2.0 * f)
+        out = np.zeros(shape, dtype=np.float32)
+        for i in range(k):
+            for j in range(k):
+                out[:, :, i, j] = (1 - abs(i / f - c)) * (1 - abs(j / f - c))
+        return out
+    raise ValueError(f"unknown filler type {ftype!r}")
+
+
+def _norm_fan(filler: FillerParameter, fan_in: int, fan_out: int) -> float:
+    vn = str(filler.variance_norm)
+    if vn == "FAN_OUT":
+        return float(fan_out)
+    if vn == "AVERAGE":
+        return (fan_in + fan_out) / 2.0
+    return float(fan_in)

@@ -1,0 +1,941 @@
+"""Functional net builder: NetParameter -> pure jittable forward.
+
+This replaces the reference's graph engine (reference: caffe/src/caffe/net.cpp
+— Init :40-563, ForwardFromTo :565, BackwardFromTo :635) the TPU-native way:
+the "graph" is traced once into a single XLA program; there is no per-layer
+dispatch at runtime, no Blob/SyncedMemory (device-resident jax Arrays), and no
+explicit backward pass (jax.grad of the built forward).  Phase filtering
+(FilterNet, net.cpp:297-357) happens at build time; split insertion
+(InsertSplits) is unnecessary because values are freely reused in functional
+form.
+
+Params are a flat dict {param_key: array} where param_key is
+"<layer_name>/<blob_index>" or a shared ParamSpec name (param sharing,
+net.cpp:445-505).  Per-key lr_mult/decay_mult live in Net.param_specs —
+the solver consumes them (reference: AlexNet per-blob lr_mult semantics).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .. import ops
+from ..proto import caffe_pb
+from ..proto.caffe_pb import (FillerParameter, LayerParameter, NetParameter,
+                              NetState)
+from ..proto.textformat import Message, parse
+from .fillers import fill
+
+LOSS_TYPES = {
+    "SoftmaxWithLoss", "EuclideanLoss", "SigmoidCrossEntropyLoss",
+    "HingeLoss", "ContrastiveLoss", "InfogainLoss",
+    "MultinomialLogisticLoss",
+}
+
+DATA_TYPES = {"Data", "ImageData", "MemoryData", "HDF5Data", "WindowData",
+              "JavaData"}
+
+
+@dataclasses.dataclass
+class ParamInit:
+    key: str               # params-dict key
+    shape: Tuple[int, ...]
+    filler: FillerParameter
+    lr_mult: float = 1.0
+    decay_mult: float = 1.0
+    is_stat: bool = False  # updated by forward (BatchNorm), not by gradients
+
+
+@dataclasses.dataclass
+class BuiltLayer:
+    name: str
+    type: str
+    bottoms: List[str]
+    tops: List[str]
+    param_keys: List[str]
+    # fn(param_arrays, bottom_arrays, rng_key_or_None, train)
+    #   -> (top_arrays, stat_updates: dict key->array)
+    fn: Callable
+    needs_rng: bool = False
+
+
+def _default_filler(**kw) -> FillerParameter:
+    f = FillerParameter(Message())
+    for k, v in kw.items():
+        f.msg.set(k, v)
+    return f
+
+
+def phase_matches(layer: LayerParameter, state: NetState) -> bool:
+    """NetStateRule evaluation (reference: net.cpp:297-357 FilterNet +
+    StateMeetsRule)."""
+
+    def rule_met(rule) -> bool:
+        if rule.phase is not None and rule.phase != str(state.phase):
+            return False
+        if rule.min_level is not None and state.level < rule.min_level:
+            return False
+        if rule.max_level is not None and state.level > rule.max_level:
+            return False
+        stages = set(state.stages)
+        for s in rule.stages:
+            if s not in stages:
+                return False
+        for s in rule.not_stages:
+            if s in stages:
+                return False
+        return True
+
+    includes = layer.include_rules
+    excludes = layer.exclude_rules
+    if includes:
+        return any(rule_met(r) for r in includes)
+    return not any(rule_met(r) for r in excludes)
+
+
+def _prod(shape) -> int:
+    n = 1
+    for s in shape:
+        n *= int(s)
+    return n
+
+
+class Net:
+    """A phase-filtered, shape-inferred, executable network.
+
+    Mirrors the introspection surface of the reference bridge
+    (reference: libccaffe/ccaffe.cpp:142-195 — num_layers/layer_name/
+    num_layer_weights, blob readback) so WeightCollection-style interchange
+    works identically.
+    """
+
+    def __init__(self, net_param: NetParameter, phase: str = "TRAIN", *,
+                 data_shapes: Optional[Dict[str, Sequence[int]]] = None,
+                 level: int = 0, stages: Sequence[str] = (),
+                 batch_override: Optional[int] = None) -> None:
+        self.net_param = net_param
+        self.phase = phase
+        state = NetState(Message())
+        state.msg.set("phase", phase)
+        state.msg.set("level", level)
+        for s in stages:
+            state.msg.add("stage", s)
+        self.name = str(net_param.name)
+        self._data_shapes = {k: tuple(v) for k, v in (data_shapes or {}).items()}
+        self._batch_override = batch_override
+
+        self.layers: List[BuiltLayer] = []
+        self.param_inits: Dict[str, ParamInit] = {}
+        self.blob_shapes: Dict[str, Tuple[int, ...]] = {}
+        self.input_blobs: List[str] = []   # blobs the caller must feed
+        self.loss_terms: List[Tuple[str, float]] = []  # (blob, weight)
+        self._build(net_param, state)
+
+    # ------------------------------------------------------------------ build
+    def _build(self, net_param: NetParameter, state: NetState) -> None:
+        # net-level deploy inputs (reference: net.cpp:70-103 legacy input fields)
+        for name, shape in zip(net_param.input_blobs, net_param.input_shapes):
+            self.blob_shapes[name] = tuple(shape)
+            self.input_blobs.append(name)
+
+        for layer in net_param.layers:
+            if not phase_matches(layer, state):
+                continue
+            ltype = str(layer.type)
+            builder = _BUILDERS.get(ltype)
+            if builder is None:
+                raise NotImplementedError(
+                    f"layer type {ltype!r} (layer {layer.name!r})")
+            bshapes = []
+            for b in layer.bottoms:
+                if b not in self.blob_shapes:
+                    raise ValueError(
+                        f"layer {layer.name!r} bottom {b!r} is undefined")
+                bshapes.append(self.blob_shapes[b])
+            built, top_shapes, pinits = builder(self, layer, bshapes)
+            for t, ts in zip(built.tops, top_shapes):
+                self.blob_shapes[t] = tuple(int(x) for x in ts)
+            for pi in pinits:
+                if pi.key in self.param_inits:
+                    prev = self.param_inits[pi.key]
+                    if prev.shape != pi.shape:
+                        raise ValueError(
+                            f"shared param {pi.key!r} shape mismatch "
+                            f"{prev.shape} vs {pi.shape}")
+                else:
+                    self.param_inits[pi.key] = pi
+            self.layers.append(built)
+            # loss bookkeeping (reference: layer.hpp SetLossWeights — loss
+            # layers default to weight 1 on top[0])
+            weights = layer.loss_weights
+            if not weights and ltype in LOSS_TYPES:
+                weights = [1.0]
+            for t, w in zip(built.tops, weights):
+                if w != 0.0:
+                    self.loss_terms.append((t, float(w)))
+
+    def _layer_params(self, layer: LayerParameter,
+                      specs: List[Tuple[Tuple[int, ...], FillerParameter]],
+                      default_lr: Sequence[float] = (),
+                      is_stat: bool = False) -> List[ParamInit]:
+        """Build ParamInits honoring ParamSpec lr_mult/decay_mult/name."""
+        pspecs = layer.params
+        out = []
+        for i, (shape, filler) in enumerate(specs):
+            ps = pspecs[i] if i < len(pspecs) else None
+            key = (str(ps.name) if ps is not None and ps.name
+                   else f"{layer.name}/{i}")
+            lr = (float(ps.lr_mult) if ps is not None and ps.msg.has("lr_mult")
+                  else (default_lr[i] if i < len(default_lr) else 1.0))
+            dm = (float(ps.decay_mult)
+                  if ps is not None and ps.msg.has("decay_mult") else 1.0)
+            out.append(ParamInit(key=key, shape=tuple(int(s) for s in shape),
+                                 filler=filler, lr_mult=lr, decay_mult=dm,
+                                 is_stat=is_stat))
+        return out
+
+    # ------------------------------------------------------------- params api
+    def init_params(self, seed: int = 0) -> Dict[str, jnp.ndarray]:
+        rng = np.random.RandomState(seed if seed >= 0 else None)
+        out = {}
+        for key, pi in self.param_inits.items():
+            out[key] = jnp.asarray(fill(pi.filler, pi.shape, rng))
+        return out
+
+    @property
+    def param_keys(self) -> List[str]:
+        return list(self.param_inits.keys())
+
+    def lr_multipliers(self) -> Dict[str, float]:
+        return {k: (0.0 if pi.is_stat else pi.lr_mult)
+                for k, pi in self.param_inits.items()}
+
+    def decay_multipliers(self) -> Dict[str, float]:
+        return {k: (0.0 if pi.is_stat else pi.decay_mult)
+                for k, pi in self.param_inits.items()}
+
+    def stat_keys(self) -> List[str]:
+        return [k for k, pi in self.param_inits.items() if pi.is_stat]
+
+    # -- WeightCollection-style interchange (reference: Net.scala:122-172) --
+    def get_weights(self, params: Dict[str, jnp.ndarray],
+                    ) -> Dict[str, List[np.ndarray]]:
+        out: Dict[str, List[np.ndarray]] = {}
+        for bl in self.layers:
+            if bl.param_keys:
+                out[bl.name] = [np.asarray(params[k]) for k in bl.param_keys]
+        return out
+
+    def set_weights(self, params: Dict[str, jnp.ndarray],
+                    weights: Dict[str, List[np.ndarray]],
+                    ) -> Dict[str, jnp.ndarray]:
+        new = dict(params)
+        for bl in self.layers:
+            if bl.name in weights:
+                for k, w in zip(bl.param_keys, weights[bl.name]):
+                    assert tuple(new[k].shape) == tuple(w.shape), \
+                        f"shape mismatch for {k}"
+                    new[k] = jnp.asarray(w)
+        return new
+
+    # --------------------------------------------------------------- forward
+    def apply(self, params: Dict[str, jnp.ndarray],
+              inputs: Dict[str, jnp.ndarray],
+              rng: Optional[jax.Array] = None, *,
+              train: Optional[bool] = None,
+              ) -> Tuple[Dict[str, jnp.ndarray], Dict[str, jnp.ndarray]]:
+        """Pure forward pass.
+
+        Returns (blobs, stat_updates).  blobs contains every named blob plus
+        reserved "loss" (weighted sum over loss terms, reference:
+        net.cpp:520-563 loss accumulation).
+        """
+        if train is None:
+            train = self.phase == "TRAIN"
+        blobs: Dict[str, jnp.ndarray] = {}
+        for b in self.input_blobs:
+            if b not in inputs:
+                raise ValueError(f"missing input blob {b!r}")
+        blobs.update(inputs)
+        stat_updates: Dict[str, jnp.ndarray] = {}
+        for i, bl in enumerate(self.layers):
+            layer_rng = (jax.random.fold_in(rng, i)
+                         if (bl.needs_rng and rng is not None) else None)
+            pvals = [params[k] for k in bl.param_keys]
+            bvals = [blobs[b] for b in bl.bottoms]
+            tops, updates = bl.fn(pvals, bvals, layer_rng, train)
+            for t, v in zip(bl.tops, tops):
+                blobs[t] = v
+            stat_updates.update(updates)
+        loss = jnp.asarray(0.0, dtype=jnp.float32)
+        for blob_name, w in self.loss_terms:
+            loss = loss + w * jnp.sum(blobs[blob_name])
+        blobs["loss"] = loss
+        return blobs, stat_updates
+
+    def forward(self, params, inputs, rng=None):
+        """Convenience eager forward returning blobs only
+        (reference bridge: ccaffe.cpp:218-222 forward)."""
+        blobs, _ = self.apply(params, inputs, rng)
+        return blobs
+
+    # ---------------------------------------------------------- introspection
+    @property
+    def num_layers(self) -> int:
+        return len(self.layers)
+
+    def layer_names(self) -> List[str]:
+        return [bl.name for bl in self.layers]
+
+    def blob_names(self) -> List[str]:
+        return list(self.blob_shapes.keys())
+
+
+# ===========================================================================
+# Layer builders.  Each: (net, layer, bottom_shapes)
+#   -> (BuiltLayer, top_shapes, [ParamInit])
+# ===========================================================================
+
+_BUILDERS: Dict[str, Callable] = {}
+
+
+def register(type_name: str):
+    def deco(f):
+        _BUILDERS[type_name] = f
+        return f
+    return deco
+
+
+def _simple(net: Net, layer: LayerParameter, tops_fn,
+            top_shapes, pinits=None, needs_rng=False,
+            param_keys=None) -> Tuple[BuiltLayer, list, list]:
+    pinits = pinits or []
+    bl = BuiltLayer(
+        name=str(layer.name), type=str(layer.type),
+        bottoms=layer.bottoms, tops=layer.tops,
+        param_keys=param_keys if param_keys is not None
+        else [pi.key for pi in pinits],
+        fn=tops_fn, needs_rng=needs_rng)
+    return bl, top_shapes, pinits
+
+
+# ----------------------------------------------------------------- data layers
+
+def _data_layer_shapes(net: Net, layer: LayerParameter,
+                       ) -> List[Tuple[int, ...]]:
+    """Resolve data-layer top shapes: explicit overrides > layer params."""
+    ltype = str(layer.type)
+    tops = layer.tops
+    shapes: List[Optional[Tuple[int, ...]]] = []
+    for t in tops:
+        if t in net._data_shapes:
+            shapes.append(net._data_shapes[t])
+        else:
+            shapes.append(None)
+    if all(s is not None for s in shapes):
+        return shapes  # type: ignore[return-value]
+
+    batch = None
+    chw: Optional[Tuple[int, int, int]] = None
+    if ltype == "MemoryData":
+        mp = layer.memory_data_param
+        batch = int(mp.batch_size)
+        chw = (int(mp.channels), int(mp.height), int(mp.width))
+    elif ltype == "JavaData":
+        dims = layer.java_data_param.shape_dims
+        if dims:
+            batch, chw = dims[0], tuple(dims[1:])  # type: ignore[assignment]
+    elif ltype == "Data":
+        dp = layer.data_param
+        batch = int(dp.batch_size)
+        crop = int(layer.transform_param.crop_size)
+        if crop:
+            chw = (3, crop, crop)
+    elif ltype == "ImageData":
+        ip = layer.image_data_param
+        batch = int(ip.batch_size)
+        crop = int(layer.transform_param.crop_size)
+        h = crop or int(ip.new_height)
+        w = crop or int(ip.new_width)
+        if h and w:
+            chw = (3 if ip.is_color else 1, h, w)
+    elif ltype == "HDF5Data":
+        batch = int(layer.hdf5_data_param.batch_size)
+    elif ltype == "WindowData":
+        wp = layer.window_data_param
+        batch = int(wp.batch_size)
+        crop = int(wp.crop_size)
+        if crop:
+            chw = (3, crop, crop)
+    if net._batch_override:
+        batch = net._batch_override
+    out = []
+    for t, s in zip(tops, shapes):
+        if s is not None:
+            out.append(s)
+        elif t == tops[0] and batch and chw:
+            out.append((batch,) + tuple(chw))
+        elif batch:
+            out.append((batch,))  # label
+        else:
+            raise ValueError(
+                f"cannot infer shape for data blob {t!r} of layer "
+                f"{layer.name!r}; pass data_shapes={{{t!r}: (...)}}")
+    return out
+
+
+def _register_feed(type_name: str):
+    @register(type_name)
+    def build(net: Net, layer: LayerParameter, bshapes):
+        shapes = _data_layer_shapes(net, layer)
+        for t in layer.tops:
+            if t not in net.input_blobs:
+                net.input_blobs.append(t)
+
+        # The tops are fed externally (the host data pipeline replaces the
+        # reference's JavaDataLayer JNA upcall, java_data_layer.cpp:37-45);
+        # fn produces nothing and apply() keeps the fed values.
+        def fn(pvals, bvals, rng, train):
+            return [], {}
+
+        return _simple(net, layer, fn, shapes)
+    return build
+
+
+for _t in DATA_TYPES:
+    _register_feed(_t)
+
+
+@register("DummyData")
+def build_dummy_data(net: Net, layer: LayerParameter, bshapes):
+    dp = layer.dummy_data_param
+    shapes = dp.shapes
+    fillers = dp.data_fillers
+    if len(shapes) > 1 and len(fillers) == 1:
+        fillers = fillers * len(shapes)
+    if not fillers:
+        fillers = [_default_filler()] * len(shapes)
+    consts = [jnp.asarray(fill(f, s, np.random.RandomState(0)))
+              for f, s in zip(fillers, shapes)]
+
+    def fn(pvals, bvals, rng, train):
+        return list(consts), {}
+
+    return _simple(net, layer, fn, shapes)
+
+
+# ------------------------------------------------------------ learnable layers
+
+@register("Convolution")
+def build_conv(net: Net, layer: LayerParameter, bshapes):
+    cp = layer.convolution_param
+    n, c, h, w = bshapes[0]
+    kh, kw = cp.kernel
+    ph, pw = cp.pad
+    sh, sw = cp.stride
+    dh, dw = cp.dilation
+    groups = int(cp.group)
+    co = int(cp.num_output)
+    oh = ops.conv_out_dim(h, kh, ph, sh, dh)
+    ow = ops.conv_out_dim(w, kw, pw, sw, dw)
+    specs = [((co, c // groups, kh, kw), cp.weight_filler)]
+    if cp.bias_term:
+        specs.append(((co,), cp.bias_filler))
+    pinits = net._layer_params(layer, specs)
+
+    def fn(pvals, bvals, rng, train):
+        wgt = pvals[0]
+        b = pvals[1] if len(pvals) > 1 else None
+        y = ops.conv2d(bvals[0], wgt, b, stride=(sh, sw), pad=(ph, pw),
+                       dilation=(dh, dw), groups=groups)
+        return [y], {}
+
+    return _simple(net, layer, fn, [(n, co, oh, ow)], pinits)
+
+
+@register("Deconvolution")
+def build_deconv(net: Net, layer: LayerParameter, bshapes):
+    cp = layer.convolution_param
+    n, c, h, w = bshapes[0]
+    kh, kw = cp.kernel
+    ph, pw = cp.pad
+    sh, sw = cp.stride
+    dh, dw = cp.dilation
+    groups = int(cp.group)
+    co = int(cp.num_output)
+    oh = ops.deconv_out_dim(h, kh, ph, sh, dh)
+    ow = ops.deconv_out_dim(w, kw, pw, sw, dw)
+    specs = [((c, co // groups, kh, kw), cp.weight_filler)]
+    if cp.bias_term:
+        specs.append(((co,), cp.bias_filler))
+    pinits = net._layer_params(layer, specs)
+
+    def fn(pvals, bvals, rng, train):
+        wgt = pvals[0]
+        b = pvals[1] if len(pvals) > 1 else None
+        y = ops.deconv2d(bvals[0], wgt, b, stride=(sh, sw), pad=(ph, pw),
+                         dilation=(dh, dw), groups=groups)
+        return [y], {}
+
+    return _simple(net, layer, fn, [(n, co, oh, ow)], pinits)
+
+
+@register("InnerProduct")
+def build_inner_product(net: Net, layer: LayerParameter, bshapes):
+    ip = layer.inner_product_param
+    axis = int(ip.axis)
+    co = int(ip.num_output)
+    bshape = bshapes[0]
+    fan_in = _prod(bshape[axis:])
+    lead = tuple(bshape[:axis])
+    specs = [((co, fan_in), ip.weight_filler)]
+    if ip.bias_term:
+        specs.append(((co,), ip.bias_filler))
+    pinits = net._layer_params(layer, specs)
+
+    def fn(pvals, bvals, rng, train):
+        wgt = pvals[0]
+        b = pvals[1] if len(pvals) > 1 else None
+        return [ops.inner_product(bvals[0], wgt, b, axis=axis)], {}
+
+    return _simple(net, layer, fn, [lead + (co,)], pinits)
+
+
+@register("Embed")
+def build_embed(net: Net, layer: LayerParameter, bshapes):
+    ep = layer.embed_param
+    co, vocab = int(ep.num_output), int(ep.input_dim)
+    specs = [((vocab, co), ep.weight_filler)]
+    if ep.bias_term:
+        specs.append(((co,), ep.bias_filler))
+    pinits = net._layer_params(layer, specs)
+
+    def fn(pvals, bvals, rng, train):
+        b = pvals[1] if len(pvals) > 1 else None
+        return [ops.embed(bvals[0], pvals[0], b)], {}
+
+    return _simple(net, layer, fn, [tuple(bshapes[0]) + (co,)], pinits)
+
+
+@register("PReLU")
+def build_prelu(net: Net, layer: LayerParameter, bshapes):
+    pp = layer.prelu_param
+    shared = bool(pp.channel_shared)
+    c = 1 if shared else int(bshapes[0][1])
+    pinits = net._layer_params(layer, [((c,), pp.filler)])
+
+    def fn(pvals, bvals, rng, train):
+        return [ops.prelu(bvals[0], pvals[0], channel_shared=shared)], {}
+
+    return _simple(net, layer, fn, [bshapes[0]], pinits)
+
+
+@register("BatchNorm")
+def build_batch_norm(net: Net, layer: LayerParameter, bshapes):
+    bp = layer.batch_norm_param
+    c = int(bshapes[0][1])
+    ugs = bp.use_global_stats
+    if ugs is None:
+        ugs = net.phase == "TEST"
+    eps = float(bp.eps)
+    maf = float(bp.moving_average_fraction)
+    zero = _default_filler()
+    specs = [((c,), zero), ((c,), zero), ((), zero)]
+    pinits = net._layer_params(layer, specs, default_lr=(0.0, 0.0, 0.0),
+                               is_stat=True)
+    keys = [pi.key for pi in pinits]
+
+    def fn(pvals, bvals, rng, train):
+        y, (m, v, s) = ops.batch_norm(
+            bvals[0], pvals[0], pvals[1], pvals[2],
+            use_global_stats=bool(ugs), eps=eps,
+            moving_average_fraction=maf)
+        updates = {} if ugs else {keys[0]: m, keys[1]: v, keys[2]: s}
+        return [y], updates
+
+    return _simple(net, layer, fn, [bshapes[0]], pinits)
+
+
+# --------------------------------------------------------------- simple layers
+
+def _register_elementwise(type_name: str, make_fn):
+    @register(type_name)
+    def build(net: Net, layer: LayerParameter, bshapes):
+        f = make_fn(layer)
+        needs_rng = type_name == "Dropout"
+
+        def fn(pvals, bvals, rng, train):
+            if needs_rng:
+                return [f(bvals[0], rng, train)], {}
+            return [f(bvals[0])], {}
+
+        return _simple(net, layer, fn, [bshapes[0]], needs_rng=needs_rng)
+    return build
+
+
+_register_elementwise("ReLU", lambda l: (
+    lambda x: ops.relu(x, float(l.relu_param.negative_slope))))
+_register_elementwise("Sigmoid", lambda l: ops.sigmoid)
+_register_elementwise("TanH", lambda l: ops.tanh)
+_register_elementwise("BNLL", lambda l: ops.bnll)
+_register_elementwise("AbsVal", lambda l: ops.absval)
+_register_elementwise("Power", lambda l: (
+    lambda x: ops.power(x, float(l.power_param.power),
+                        float(l.power_param.scale),
+                        float(l.power_param.shift))))
+_register_elementwise("Exp", lambda l: (
+    lambda x: ops.exp(x, float(l.exp_param.base), float(l.exp_param.scale),
+                      float(l.exp_param.shift))))
+_register_elementwise("Log", lambda l: (
+    lambda x: ops.log(x, float(l.log_param.base), float(l.log_param.scale),
+                      float(l.log_param.shift))))
+_register_elementwise("Threshold", lambda l: (
+    lambda x: ops.threshold(x, float(l.threshold_param.threshold))))
+_register_elementwise("Dropout", lambda l: (
+    lambda x, rng, train: ops.dropout(
+        x, float(l.dropout_param.dropout_ratio), rng, train)))
+_register_elementwise("MVN", lambda l: (
+    lambda x: ops.mvn(x, normalize_variance=bool(l.mvn_param.normalize_variance),
+                      across_channels=bool(l.mvn_param.across_channels),
+                      eps=float(l.mvn_param.eps))))
+
+
+@register("Pooling")
+def build_pooling(net: Net, layer: LayerParameter, bshapes):
+    pp = layer.pooling_param
+    n, c, h, w = bshapes[0]
+    mode = str(pp.pool)
+    if pp.global_pooling:
+        def fn(pvals, bvals, rng, train):
+            return [ops.global_pool(bvals[0],
+                                    "MAX" if mode == "MAX" else "AVE")], {}
+        return _simple(net, layer, fn, [(n, c, 1, 1)])
+    kh, kw = pp.kernel
+    ph, pw = pp.pads
+    sh, sw = pp.strides
+    oh = ops.pool_out_dim(h, kh, ph, sh)
+    ow = ops.pool_out_dim(w, kw, pw, sw)
+    needs_rng = mode == "STOCHASTIC"
+
+    def fn(pvals, bvals, rng, train):
+        if mode == "MAX":
+            y = ops.max_pool(bvals[0], (kh, kw), stride=(sh, sw), pad=(ph, pw))
+        elif mode == "AVE":
+            y = ops.avg_pool(bvals[0], (kh, kw), stride=(sh, sw), pad=(ph, pw))
+        else:
+            y = ops.stochastic_pool(bvals[0], (kh, kw), stride=(sh, sw),
+                                    pad=(ph, pw), rng=rng, train=train)
+        return [y], {}
+
+    return _simple(net, layer, fn, [(n, c, oh, ow)], needs_rng=needs_rng)
+
+
+@register("LRN")
+def build_lrn(net: Net, layer: LayerParameter, bshapes):
+    lp = layer.lrn_param
+    size, alpha = int(lp.local_size), float(lp.alpha)
+    beta, k = float(lp.beta), float(lp.k)
+    region = str(lp.norm_region)
+
+    def fn(pvals, bvals, rng, train):
+        return [ops.lrn(bvals[0], size, alpha, beta, k, region)], {}
+
+    return _simple(net, layer, fn, [bshapes[0]])
+
+
+@register("SPP")
+def build_spp(net: Net, layer: LayerParameter, bshapes):
+    sp = layer.spp_param
+    height = int(sp.pyramid_height)
+    mode = str(sp.pool)
+    n, c = bshapes[0][0], bshapes[0][1]
+    bins = sum(4 ** l for l in range(height))
+
+    def fn(pvals, bvals, rng, train):
+        return [ops.spp(bvals[0], height, mode)], {}
+
+    return _simple(net, layer, fn, [(n, c * bins)])
+
+
+@register("Im2col")
+def build_im2col(net: Net, layer: LayerParameter, bshapes):
+    cp = layer.convolution_param
+    n, c, h, w = bshapes[0]
+    kh, kw = cp.kernel
+    ph, pw = cp.pad
+    sh, sw = cp.stride
+    oh = ops.conv_out_dim(h, kh, ph, sh)
+    ow = ops.conv_out_dim(w, kw, pw, sw)
+
+    def fn(pvals, bvals, rng, train):
+        return [ops.im2col(bvals[0], (kh, kw), stride=(sh, sw),
+                           pad=(ph, pw))], {}
+
+    return _simple(net, layer, fn, [(n, c * kh * kw, oh, ow)])
+
+
+# ------------------------------------------------------------ structural
+
+@register("Concat")
+def build_concat(net: Net, layer: LayerParameter, bshapes):
+    axis = int(layer.concat_param.axis)
+    if layer.concat_param.msg.has("concat_dim"):
+        axis = int(layer.concat_param.concat_dim)
+    out = list(bshapes[0])
+    out[axis] = sum(int(s[axis]) for s in bshapes)
+
+    def fn(pvals, bvals, rng, train):
+        return [ops.concat(bvals, axis=axis)], {}
+
+    return _simple(net, layer, fn, [tuple(out)])
+
+
+@register("Slice")
+def build_slice(net: Net, layer: LayerParameter, bshapes):
+    sp = layer.slice_param
+    axis = int(sp.axis)
+    if sp.msg.has("slice_dim"):
+        axis = int(sp.slice_dim)
+    points = sp.slice_points
+    n_out = len(layer.tops)
+    size = int(bshapes[0][axis])
+    bounds = ([0] + points + [size] if points
+              else [size // n_out * i for i in range(n_out)] + [size])
+    shapes = []
+    for i in range(len(bounds) - 1):
+        s = list(bshapes[0])
+        s[axis] = bounds[i + 1] - bounds[i]
+        shapes.append(tuple(s))
+
+    def fn(pvals, bvals, rng, train):
+        return ops.slice_op(bvals[0], axis=axis,
+                            slice_points=points or None,
+                            num_slices=None if points else n_out), {}
+
+    return _simple(net, layer, fn, shapes)
+
+
+@register("Split")
+def build_split(net: Net, layer: LayerParameter, bshapes):
+    n_out = len(layer.tops)
+
+    def fn(pvals, bvals, rng, train):
+        return [bvals[0]] * n_out, {}
+
+    return _simple(net, layer, fn, [bshapes[0]] * n_out)
+
+
+@register("Flatten")
+def build_flatten(net: Net, layer: LayerParameter, bshapes):
+    fp = layer.flatten_param
+    axis, end_axis = int(fp.axis), int(fp.end_axis)
+    nd = len(bshapes[0])
+    a, e = axis % nd, end_axis % nd
+    mid = _prod(bshapes[0][a:e + 1])
+    out = tuple(bshapes[0][:a]) + (mid,) + tuple(bshapes[0][e + 1:])
+
+    def fn(pvals, bvals, rng, train):
+        return [ops.flatten(bvals[0], axis=axis, end_axis=end_axis)], {}
+
+    return _simple(net, layer, fn, [out])
+
+
+@register("Reshape")
+def build_reshape(net: Net, layer: LayerParameter, bshapes):
+    rp = layer.reshape_param
+    dims, axis, num_axes = rp.shape_dims, int(rp.axis), int(rp.num_axes)
+
+    def fn(pvals, bvals, rng, train):
+        return [ops.reshape(bvals[0], dims, axis=axis, num_axes=num_axes)], {}
+
+    probe = jax.eval_shape(
+        lambda x: ops.reshape(x, dims, axis=axis, num_axes=num_axes),
+        jax.ShapeDtypeStruct(tuple(bshapes[0]), jnp.float32))
+    return _simple(net, layer, fn, [probe.shape])
+
+
+@register("Eltwise")
+def build_eltwise(net: Net, layer: LayerParameter, bshapes):
+    ep = layer.eltwise_param
+    op = str(ep.operation)
+    coeffs = ep.coeffs or None
+
+    def fn(pvals, bvals, rng, train):
+        return [ops.eltwise(bvals, operation=op, coeffs=coeffs)], {}
+
+    return _simple(net, layer, fn, [bshapes[0]])
+
+
+@register("Tile")
+def build_tile(net: Net, layer: LayerParameter, bshapes):
+    tp = layer.tile_param
+    axis, tiles = int(tp.axis), int(tp.tiles)
+    out = list(bshapes[0])
+    out[axis] *= tiles
+
+    def fn(pvals, bvals, rng, train):
+        return [ops.tile(bvals[0], axis=axis, tiles=tiles)], {}
+
+    return _simple(net, layer, fn, [tuple(out)])
+
+
+@register("Reduction")
+def build_reduction(net: Net, layer: LayerParameter, bshapes):
+    rp = layer.reduction_param
+    op, axis, coeff = str(rp.operation), int(rp.axis), float(rp.coeff)
+    out = tuple(bshapes[0][:axis % len(bshapes[0])]) if axis != 0 else ()
+
+    def fn(pvals, bvals, rng, train):
+        return [ops.reduction(bvals[0], operation=op, axis=axis,
+                              coeff=coeff)], {}
+
+    return _simple(net, layer, fn, [out])
+
+
+@register("ArgMax")
+def build_argmax(net: Net, layer: LayerParameter, bshapes):
+    ap = layer.argmax_param
+    top_k, omv, axis = int(ap.top_k), bool(ap.out_max_val), ap.axis
+
+    def fn(pvals, bvals, rng, train):
+        return [ops.argmax(bvals[0], top_k=top_k, out_max_val=omv,
+                           axis=axis)], {}
+
+    probe = jax.eval_shape(
+        lambda x: ops.argmax(x, top_k=top_k, out_max_val=omv, axis=axis),
+        jax.ShapeDtypeStruct(tuple(bshapes[0]), jnp.float32))
+    return _simple(net, layer, fn, [probe.shape])
+
+
+@register("BatchReindex")
+def build_batch_reindex(net: Net, layer: LayerParameter, bshapes):
+    out = (int(bshapes[1][0]),) + tuple(bshapes[0][1:])
+
+    def fn(pvals, bvals, rng, train):
+        return [ops.batch_reindex(bvals[0], bvals[1])], {}
+
+    return _simple(net, layer, fn, [out])
+
+
+@register("Filter")
+def build_filter(net: Net, layer: LayerParameter, bshapes):
+    raise NotImplementedError(
+        "Filter produces data-dependent shapes, which cannot be compiled for "
+        "TPU; use ops.filter_op host-side instead "
+        "(reference: caffe/src/caffe/layers/filter_layer.cpp)")
+
+
+@register("Silence")
+def build_silence(net: Net, layer: LayerParameter, bshapes):
+    def fn(pvals, bvals, rng, train):
+        return [], {}
+
+    return _simple(net, layer, fn, [])
+
+
+# ------------------------------------------------------------------- heads
+
+@register("Softmax")
+def build_softmax(net: Net, layer: LayerParameter, bshapes):
+    axis = int(layer.softmax_param.axis)
+
+    def fn(pvals, bvals, rng, train):
+        return [ops.softmax(bvals[0], axis=axis)], {}
+
+    return _simple(net, layer, fn, [bshapes[0]])
+
+
+@register("SoftmaxWithLoss")
+def build_softmax_with_loss(net: Net, layer: LayerParameter, bshapes):
+    lp = layer.loss_param
+    axis = int(layer.softmax_param.axis)
+    ignore = lp.ignore_label
+    normalize = bool(lp.normalize)
+
+    def fn(pvals, bvals, rng, train):
+        return [ops.softmax_with_loss(bvals[0], bvals[1], axis=axis,
+                                      ignore_label=ignore,
+                                      normalize=normalize)], {}
+
+    return _simple(net, layer, fn, [()])
+
+
+@register("EuclideanLoss")
+def build_euclidean_loss(net: Net, layer: LayerParameter, bshapes):
+    def fn(pvals, bvals, rng, train):
+        return [ops.euclidean_loss(bvals[0], bvals[1])], {}
+
+    return _simple(net, layer, fn, [()])
+
+
+@register("SigmoidCrossEntropyLoss")
+def build_bce_loss(net: Net, layer: LayerParameter, bshapes):
+    def fn(pvals, bvals, rng, train):
+        return [ops.sigmoid_cross_entropy_loss(bvals[0], bvals[1])], {}
+
+    return _simple(net, layer, fn, [()])
+
+
+@register("HingeLoss")
+def build_hinge_loss(net: Net, layer: LayerParameter, bshapes):
+    norm = str(layer.hinge_loss_param.norm)
+
+    def fn(pvals, bvals, rng, train):
+        return [ops.hinge_loss(bvals[0], bvals[1], norm=norm)], {}
+
+    return _simple(net, layer, fn, [()])
+
+
+@register("ContrastiveLoss")
+def build_contrastive_loss(net: Net, layer: LayerParameter, bshapes):
+    cp = layer.contrastive_loss_param
+    margin, legacy = float(cp.margin), bool(cp.legacy_version)
+
+    def fn(pvals, bvals, rng, train):
+        return [ops.contrastive_loss(bvals[0], bvals[1], bvals[2],
+                                     margin=margin, legacy_version=legacy)], {}
+
+    return _simple(net, layer, fn, [()])
+
+
+@register("InfogainLoss")
+def build_infogain_loss(net: Net, layer: LayerParameter, bshapes):
+    src = str(layer.infogain_loss_param.source)
+    H = None
+    if len(bshapes) < 3 and src:
+        H = jnp.asarray(np.load(src)) if src.endswith(".npy") else None
+        if H is None:
+            raise NotImplementedError(
+                "InfogainLoss matrix must come from a 3rd bottom or a .npy "
+                "source file")
+
+    def fn(pvals, bvals, rng, train):
+        mat = bvals[2] if len(bvals) > 2 else H
+        return [ops.infogain_loss(bvals[0], bvals[1], mat)], {}
+
+    return _simple(net, layer, fn, [()])
+
+
+@register("MultinomialLogisticLoss")
+def build_mll(net: Net, layer: LayerParameter, bshapes):
+    def fn(pvals, bvals, rng, train):
+        return [ops.multinomial_logistic_loss(bvals[0], bvals[1])], {}
+
+    return _simple(net, layer, fn, [()])
+
+
+@register("Accuracy")
+def build_accuracy(net: Net, layer: LayerParameter, bshapes):
+    ap = layer.accuracy_param
+    top_k, axis, ignore = int(ap.top_k), int(ap.axis), ap.ignore_label
+
+    def fn(pvals, bvals, rng, train):
+        return [ops.accuracy(bvals[0], bvals[1], top_k=top_k, axis=axis,
+                             ignore_label=ignore)], {}
+
+    return _simple(net, layer, fn, [()])

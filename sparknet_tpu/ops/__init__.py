@@ -1,0 +1,18 @@
+"""TPU-native layer zoo: pure JAX functions replacing the reference's C++/CUDA
+layer implementations (reference: caffe/src/caffe/layers/ — 58 .cpp + 44 .cu).
+XLA:TPU codegen replaces the hand-written kernels; there is deliberately no
+Layer class hierarchy — composition happens in core.net."""
+
+from .activations import (absval, bnll, dropout, exp, log, power, prelu, relu,
+                          sigmoid, tanh, threshold)
+from .conv import conv2d, conv_out_dim, deconv2d, deconv_out_dim, im2col
+from .dense import embed, inner_product
+from .lrn import lrn, lrn_across_channels, lrn_within_channel
+from .losses import (accuracy, argmax, contrastive_loss, euclidean_loss,
+                     hinge_loss, infogain_loss, multinomial_logistic_loss,
+                     sigmoid_cross_entropy_loss, softmax, softmax_with_loss)
+from .norm import batch_norm, mvn, scale_shift
+from .pooling import (avg_pool, global_pool, max_pool, pool_out_dim, spp,
+                      stochastic_pool)
+from .shape_ops import (batch_reindex, concat, eltwise, filter_op, flatten,
+                        reduction, reshape, silence, slice_op, split, tile)

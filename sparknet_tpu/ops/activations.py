@@ -1,0 +1,93 @@
+"""Neuron (elementwise) ops — reference: caffe/src/caffe/layers/*_layer.cpp.
+
+All are pure jnp functions; XLA fuses them into adjacent matmul/conv HLOs on
+TPU, so there is no analogue of the reference's per-layer CUDA kernels.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def relu(x: jax.Array, negative_slope: float = 0.0) -> jax.Array:
+    """reference: relu_layer.cpp:9-20 (leaky when negative_slope != 0)."""
+    if negative_slope == 0.0:
+        return jnp.maximum(x, 0)
+    return jnp.where(x > 0, x, negative_slope * x)
+
+
+def prelu(x: jax.Array, slope: jax.Array, channel_shared: bool = False,
+          ) -> jax.Array:
+    """reference: prelu_layer.cpp; slope is a learnable per-channel (or
+    scalar) blob; x is (N, C, ...)."""
+    if channel_shared:
+        a = slope.reshape(())
+    else:
+        a = slope.reshape((1, -1) + (1,) * (x.ndim - 2))
+    return jnp.where(x > 0, x, a * x)
+
+
+def sigmoid(x: jax.Array) -> jax.Array:
+    return jax.nn.sigmoid(x)
+
+
+def tanh(x: jax.Array) -> jax.Array:
+    return jnp.tanh(x)
+
+
+def bnll(x: jax.Array) -> jax.Array:
+    """y = log(1 + exp(x)), overflow-safe (reference: bnll_layer.cpp:9-20)."""
+    return jnp.logaddexp(0.0, x)
+
+
+def absval(x: jax.Array) -> jax.Array:
+    return jnp.abs(x)
+
+
+def power(x: jax.Array, power: float = 1.0, scale: float = 1.0,
+          shift: float = 0.0) -> jax.Array:
+    """y = (shift + scale*x)^power (reference: power_layer.cpp:10-60)."""
+    inner = shift + scale * x
+    if power == 1.0:
+        return inner
+    return jnp.power(inner, power)
+
+
+def exp(x: jax.Array, base: float = -1.0, scale: float = 1.0,
+        shift: float = 0.0) -> jax.Array:
+    """y = base^(shift + scale*x); base=-1 means e
+    (reference: exp_layer.cpp:10-35)."""
+    inner = shift + scale * x
+    if base == -1.0:
+        return jnp.exp(inner)
+    return jnp.exp(inner * jnp.log(base))
+
+
+def log(x: jax.Array, base: float = -1.0, scale: float = 1.0,
+        shift: float = 0.0) -> jax.Array:
+    """y = log_base(shift + scale*x) (reference: log_layer.cpp:10-45)."""
+    inner = shift + scale * x
+    y = jnp.log(inner)
+    if base != -1.0:
+        y = y / jnp.log(base)
+    return y
+
+
+def threshold(x: jax.Array, threshold: float = 0.0) -> jax.Array:
+    """y = 1[x > t] (reference: threshold_layer.cpp:9-20). Not differentiable;
+    the reference has no Backward either."""
+    return (x > threshold).astype(x.dtype)
+
+
+def dropout(x: jax.Array, ratio: float, rng: Optional[jax.Array],
+            train: bool) -> jax.Array:
+    """Inverted dropout: train scales kept units by 1/(1-ratio), test is
+    identity (reference: dropout_layer.cpp:29-46)."""
+    if not train or ratio == 0.0:
+        return x
+    keep = 1.0 - ratio
+    mask = jax.random.bernoulli(rng, keep, x.shape)
+    return jnp.where(mask, x / keep, 0.0).astype(x.dtype)

@@ -1,0 +1,46 @@
+"""Fully-connected and embedding ops.
+
+Weight layouts match the reference blobs so weight interchange and per-blob
+lr_mult carry over: InnerProduct weight is (num_output, fan_in)
+(reference: caffe/src/caffe/layers/inner_product_layer.cpp:28-45), Embed
+weight is (input_dim, num_output) (embed_layer.cpp:20-35).  The matmuls are
+the MXU hot path — keep them batched and let XLA tile them.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def _prod(shape) -> int:
+    out = 1
+    for s in shape:
+        out *= int(s)
+    return out
+
+
+def inner_product(x: jax.Array, w: jax.Array, b: Optional[jax.Array] = None,
+                  *, axis: int = 1) -> jax.Array:
+    """y = flatten(x, from=axis) @ w.T + b.
+
+    Axes before `axis` are batch dims; trailing axes fold into the fan-in
+    (reference: inner_product_layer.cpp:46-60)."""
+    lead = x.shape[:axis]
+    xf = x.reshape((_prod(lead), -1))
+    y = xf @ w.T
+    if b is not None:
+        y = y + b
+    return y.reshape(lead + (w.shape[0],))
+
+
+def embed(indices: jax.Array, w: jax.Array, b: Optional[jax.Array] = None,
+          ) -> jax.Array:
+    """Lookup rows of w by integer index (reference: embed_layer.cpp:40-55)."""
+    idx = indices.astype(jnp.int32)
+    y = w[idx]
+    if b is not None:
+        y = y + b
+    return y

@@ -1,0 +1,169 @@
+"""Loss and metric ops (reference: caffe/src/caffe/layers/*loss*.cpp,
+accuracy_layer.cpp).  All return scalars with the reference's exact
+normalization so loss curves and epochs-to-accuracy are comparable.
+
+Label blobs are integer class ids shaped (N,) or (N, 1, H, W) — spatial
+(inner) label dims are supported the way the reference's outer/inner split is
+(softmax_loss_layer.cpp:40-60).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def softmax(x: jax.Array, axis: int = 1) -> jax.Array:
+    return jax.nn.softmax(x, axis=axis)
+
+
+def _flatten_outer_inner(scores: jax.Array, labels: jax.Array, axis: int):
+    """(outer, C, inner) view of scores + (outer, inner) labels."""
+    c = scores.shape[axis]
+    outer = 1
+    for s in scores.shape[:axis]:
+        outer *= s
+    inner = 1
+    for s in scores.shape[axis + 1:]:
+        inner *= s
+    s3 = scores.reshape(outer, c, inner)
+    l2 = labels.reshape(outer, inner).astype(jnp.int32)
+    return s3, l2, outer, inner, c
+
+
+def softmax_with_loss(scores: jax.Array, labels: jax.Array, *, axis: int = 1,
+                      ignore_label: Optional[int] = None,
+                      normalize: bool = True) -> jax.Array:
+    """reference: softmax_loss_layer.cpp:55-83 (forward), :85-118 (normalizer:
+    non-ignored count when normalize else outer_num)."""
+    s3, l2, outer, inner, c = _flatten_outer_inner(scores, labels, axis)
+    logp = jax.nn.log_softmax(s3, axis=1)
+    picked = jnp.take_along_axis(logp, l2[:, None, :], axis=1)[:, 0, :]
+    if ignore_label is not None:
+        valid = (l2 != ignore_label)
+        picked = jnp.where(valid, picked, 0.0)
+        count = jnp.sum(valid)
+    else:
+        count = outer * inner
+    total = -jnp.sum(picked)
+    if normalize:
+        return total / jnp.maximum(count, 1)
+    return total / outer
+
+
+def multinomial_logistic_loss(prob: jax.Array, labels: jax.Array,
+                              ) -> jax.Array:
+    """Input is already a probability distribution
+    (reference: multinomial_logistic_loss_layer.cpp:27-41)."""
+    n = prob.shape[0]
+    l = labels.reshape(n).astype(jnp.int32)
+    p = prob.reshape(n, -1)
+    picked = jnp.take_along_axis(p, l[:, None], axis=1)[:, 0]
+    return -jnp.sum(jnp.log(jnp.maximum(picked, 1e-20))) / n
+
+
+def infogain_loss(prob: jax.Array, labels: jax.Array, H: jax.Array,
+                  ) -> jax.Array:
+    """loss = -sum_j H[label, j] log(p_j) / num
+    (reference: infogain_loss_layer.cpp:59-76)."""
+    n = prob.shape[0]
+    l = labels.reshape(n).astype(jnp.int32)
+    p = prob.reshape(n, -1)
+    rows = H[l]  # (n, dim)
+    return -jnp.sum(rows * jnp.log(jnp.maximum(p, 1e-20))) / n
+
+
+def euclidean_loss(a: jax.Array, b: jax.Array) -> jax.Array:
+    """loss = ||a-b||^2 / (2N) (reference: euclidean_loss_layer.cpp:21-32)."""
+    n = a.shape[0]
+    d = (a - b).reshape(n, -1)
+    return jnp.sum(d * d) / (2.0 * n)
+
+
+def sigmoid_cross_entropy_loss(logits: jax.Array, targets: jax.Array,
+                               ) -> jax.Array:
+    """Stable BCE-with-logits, normalized by batch num
+    (reference: sigmoid_cross_entropy_loss_layer.cpp:34-52)."""
+    n = logits.shape[0]
+    x = logits
+    z = targets
+    per = jnp.maximum(x, 0) - x * z + jnp.log1p(jnp.exp(-jnp.abs(x)))
+    return jnp.sum(per) / n
+
+
+def hinge_loss(scores: jax.Array, labels: jax.Array, *, norm: str = "L1",
+               ) -> jax.Array:
+    """reference: hinge_loss_layer.cpp:10-41 — margins include the label
+    column (contributing max(0, 1 - s_label))."""
+    n = scores.shape[0]
+    s = scores.reshape(n, -1)
+    l = labels.reshape(n).astype(jnp.int32)
+    signs = jnp.ones_like(s).at[jnp.arange(n), l].set(-1.0)
+    margins = jnp.maximum(0.0, 1.0 + signs * s)
+    if norm == "L2":
+        return jnp.sum(margins * margins) / n
+    return jnp.sum(margins) / n
+
+
+def contrastive_loss(a: jax.Array, b: jax.Array, y: jax.Array, *,
+                     margin: float = 1.0, legacy_version: bool = False,
+                     ) -> jax.Array:
+    """reference: contrastive_loss_layer.cpp:28-59 — y=1 similar pairs pull
+    (d^2), y=0 dissimilar push (max(margin - d, 0)^2, or legacy margin - d^2)."""
+    n = a.shape[0]
+    diff = (a - b).reshape(n, -1)
+    d2 = jnp.sum(diff * diff, axis=1)
+    ysim = y.reshape(n).astype(a.dtype)
+    if legacy_version:
+        push = jnp.maximum(margin - d2, 0.0)
+    else:
+        d = jnp.sqrt(jnp.maximum(d2, 1e-12))
+        push = jnp.square(jnp.maximum(margin - d, 0.0))
+    per = ysim * d2 + (1.0 - ysim) * push
+    return jnp.sum(per) / (2.0 * n)
+
+
+def accuracy(scores: jax.Array, labels: jax.Array, *, top_k: int = 1,
+             axis: int = 1, ignore_label: Optional[int] = None) -> jax.Array:
+    """Fraction of (non-ignored) positions whose label is in the top-k
+    (reference: accuracy_layer.cpp:37-74)."""
+    s3, l2, outer, inner, c = _flatten_outer_inner(scores, labels, axis)
+    # rank of the true-label score; ties break toward the larger class id,
+    # matching the reference's partial_sort over (score, id) pairs
+    # (accuracy_layer.cpp:57-66)
+    true_scores = jnp.take_along_axis(s3, l2[:, None, :], axis=1)
+    cls = jnp.arange(c).reshape(1, c, 1)
+    higher = jnp.sum(s3 > true_scores, axis=1) + jnp.sum(
+        (s3 == true_scores) & (cls > l2[:, None, :]), axis=1)
+    hit = (higher < top_k)
+    if ignore_label is not None:
+        valid = (l2 != ignore_label)
+        correct = jnp.sum(jnp.where(valid, hit, False))
+        count = jnp.maximum(jnp.sum(valid), 1)
+    else:
+        correct = jnp.sum(hit)
+        count = outer * inner
+    return correct.astype(jnp.float32) / count
+
+
+def argmax(x: jax.Array, *, top_k: int = 1, out_max_val: bool = False,
+           axis: Optional[int] = None) -> jax.Array:
+    """reference: argmax_layer.cpp:28-74."""
+    if axis is not None:
+        if top_k == 1:
+            idx = jnp.argmax(x, axis=axis, keepdims=True)
+            if out_max_val:
+                return jnp.max(x, axis=axis, keepdims=True)
+            return idx.astype(x.dtype)
+        vals, idx = jax.lax.top_k(jnp.moveaxis(x, axis, -1), top_k)
+        out = vals if out_max_val else idx.astype(x.dtype)
+        return jnp.moveaxis(out, -1, axis)
+    n = x.shape[0]
+    flat = x.reshape(n, -1)
+    vals, idx = jax.lax.top_k(flat, top_k)
+    if out_max_val:
+        # (N, 2, top_k): indices then values (argmax_layer.cpp:58-66)
+        return jnp.stack([idx.astype(x.dtype), vals], axis=1)
+    return idx.astype(x.dtype).reshape(n, 1, top_k)

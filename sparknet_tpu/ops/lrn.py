@@ -1,0 +1,52 @@
+"""Local Response Normalization (reference: caffe/src/caffe/layers/lrn_layer.cpp).
+
+AlexNet/CaffeNet/cifar10_full all use ACROSS_CHANNELS LRN; GoogLeNet uses it
+twice.  y = x / (k + alpha/n * sum_window x^2)^beta, where the window is
+`local_size` wide over channels (ACROSS_CHANNELS) or over space
+(WITHIN_CHANNEL, which the reference computes via average pooling of x^2 —
+lrn_layer.cpp:121-135 — so alpha is NOT divided by the window size again).
+
+Expressed with `lax.reduce_window` over the channel axis so XLA keeps it
+fused; no custom kernel needed.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .pooling import avg_pool
+
+
+def lrn_across_channels(x: jax.Array, local_size: int = 5, alpha: float = 1.0,
+                        beta: float = 0.75, k: float = 1.0) -> jax.Array:
+    pad = (local_size - 1) // 2
+    sq_sum = lax.reduce_window(
+        x * x, 0.0, lax.add,
+        window_dimensions=(1, local_size, 1, 1),
+        window_strides=(1, 1, 1, 1),
+        padding=((0, 0), (pad, local_size - 1 - pad), (0, 0), (0, 0)))
+    scale = k + (alpha / local_size) * sq_sum
+    return x * jnp.power(scale, -beta)
+
+
+def lrn_within_channel(x: jax.Array, local_size: int = 5, alpha: float = 1.0,
+                       beta: float = 0.75, k: float = 1.0) -> jax.Array:
+    pad = (local_size - 1) // 2
+    # reference uses AVE pooling of x^2 (divisor = window size incl. padding)
+    mean_sq = avg_pool(x * x, (local_size, local_size), stride=(1, 1),
+                       pad=(pad, pad))
+    # pooling with ceil-mode may add a trailing output; within-channel LRN is
+    # stride-1 same-size, so shapes already match.
+    mean_sq = mean_sq[:, :, :x.shape[2], :x.shape[3]]
+    scale = k + alpha * mean_sq
+    return x * jnp.power(scale, -beta)
+
+
+def lrn(x: jax.Array, local_size: int = 5, alpha: float = 1.0,
+        beta: float = 0.75, k: float = 1.0,
+        norm_region: str = "ACROSS_CHANNELS") -> jax.Array:
+    if norm_region == "ACROSS_CHANNELS":
+        return lrn_across_channels(x, local_size, alpha, beta, k)
+    return lrn_within_channel(x, local_size, alpha, beta, k)

@@ -1,0 +1,82 @@
+"""Normalization layers: BatchNorm and MVN.
+
+This Caffe vintage's BatchNorm has NO learnable scale/shift — its three blobs
+are (running_mean, running_var, moving_average_scale) and affine transforms
+are done by a separate layer (reference: caffe/src/caffe/layers/
+batch_norm_layer.cpp:7-48; blob layout :27-36).  We keep that contract: the
+learnable-params list carries the same three blobs, updated functionally.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def batch_norm(x: jax.Array, mean_blob: jax.Array, var_blob: jax.Array,
+               scale_blob: jax.Array, *, use_global_stats: bool,
+               eps: float = 1e-5, moving_average_fraction: float = 0.999,
+               ) -> Tuple[jax.Array, Tuple[jax.Array, jax.Array, jax.Array]]:
+    """Returns (y, updated_stat_blobs).
+
+    Training (use_global_stats=False): normalize by batch statistics over
+    (N, H, W) and fold them into the running blobs the way the reference does
+    (stored blobs are *unscaled* accumulations; divide by scale_blob on use,
+    batch_norm_layer.cpp:59-78).  Inference: use stored stats.
+    """
+    c = x.shape[1]
+    axes = (0,) + tuple(range(2, x.ndim))
+    if use_global_stats:
+        scale = jnp.where(scale_blob == 0, 1.0, scale_blob)
+        mean = mean_blob / scale
+        var = var_blob / scale
+        new_blobs = (mean_blob, var_blob, scale_blob)
+    else:
+        mean = jnp.mean(x, axis=axes)
+        var = jnp.mean(jnp.square(x), axis=axes) - jnp.square(mean)
+        m = 1
+        for a in axes:
+            m *= x.shape[a]
+        bias_corr = m / max(m - 1, 1)
+        new_scale = scale_blob * moving_average_fraction + 1.0
+        new_mean = mean_blob * moving_average_fraction + mean
+        new_var = var_blob * moving_average_fraction + bias_corr * var
+        new_blobs = (new_mean, new_var, new_scale)
+    shape = (1, c) + (1,) * (x.ndim - 2)
+    y = (x - mean.reshape(shape)) * jax.lax.rsqrt(var.reshape(shape) + eps)
+    return y, new_blobs
+
+
+def mvn(x: jax.Array, *, normalize_variance: bool = True,
+        across_channels: bool = False, eps: float = 1e-9) -> jax.Array:
+    """Mean-variance normalization per sample
+    (reference: caffe/src/caffe/layers/mvn_layer.cpp:37-78)."""
+    if across_channels:
+        axes = tuple(range(1, x.ndim))
+    else:
+        axes = tuple(range(2, x.ndim))
+    mean = jnp.mean(x, axis=axes, keepdims=True)
+    y = x - mean
+    if normalize_variance:
+        # reference computes E[x^2] - E[x]^2 then uses std + eps in the divisor
+        var = jnp.mean(jnp.square(x), axis=axes, keepdims=True) - jnp.square(mean)
+        y = y / (jnp.sqrt(var) + eps)
+    return y
+
+
+def scale_shift(x: jax.Array, scale: jax.Array,
+                bias: Optional[jax.Array] = None, *, axis: int = 1,
+                ) -> jax.Array:
+    """Channelwise affine (the companion `Scale` layer pattern; this vintage
+    pairs BatchNorm with it in BN prototxts like cifar10_full_sigmoid_bn —
+    reference: caffe/examples/cifar10/cifar10_full_sigmoid_train_test_bn.prototxt)."""
+    nd = x.ndim
+    shape = [1] * nd
+    for i, s in enumerate(scale.shape):
+        shape[axis + i] = s
+    y = x * scale.reshape(shape)
+    if bias is not None:
+        y = y + bias.reshape(shape)
+    return y

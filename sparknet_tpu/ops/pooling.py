@@ -1,0 +1,160 @@
+"""Pooling ops with exact reference output-size and divisor semantics
+(reference: caffe/src/caffe/layers/pooling_layer.cpp:90-106 ceil-mode shape,
+:193-213 AVE divisor counts padding up to H+pad but not window overhang).
+
+Implemented on `lax.reduce_window` so XLA fuses and vectorizes on TPU; the
+position-dependent AVE divisor is a host-precomputed static array (shapes are
+static under jit, so this costs nothing at runtime).
+"""
+
+from __future__ import annotations
+
+import math
+from functools import partial
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+
+def pool_out_dim(size: int, kernel: int, pad: int, stride: int) -> int:
+    """Ceil-mode output size with boundary trim
+    (reference: pooling_layer.cpp:90-105)."""
+    out = int(math.ceil((size + 2 * pad - kernel) / float(stride))) + 1
+    if pad > 0 and (out - 1) * stride >= size + pad:
+        out -= 1
+    return out
+
+
+def _window_geometry(size: Tuple[int, int], kernel: Tuple[int, int],
+                     pad: Tuple[int, int], stride: Tuple[int, int]):
+    h, w = size
+    oh = pool_out_dim(h, kernel[0], pad[0], stride[0])
+    ow = pool_out_dim(w, kernel[1], pad[1], stride[1])
+    # reduce_window needs enough (low, high) padding that every ceil-mode
+    # window fits: high pad covers the last window's reach beyond the input.
+    hi_h = max((oh - 1) * stride[0] + kernel[0] - h - pad[0], 0)
+    hi_w = max((ow - 1) * stride[1] + kernel[1] - w - pad[1], 0)
+    return oh, ow, (pad[0], hi_h), (pad[1], hi_w)
+
+
+def max_pool(x: jax.Array, kernel: Tuple[int, int], *,
+             stride: Tuple[int, int] = (1, 1),
+             pad: Tuple[int, int] = (0, 0)) -> jax.Array:
+    """MAX pooling; padding never wins (reference clips the window to the
+    valid region, pooling_layer.cpp:155-169 — identical to -inf padding)."""
+    _, _, ph, pw = x.shape[0], x.shape[1], x.shape[2], x.shape[3]
+    oh, ow, pad_h, pad_w = _window_geometry((ph, pw), kernel, pad, stride)
+    y = lax.reduce_window(
+        x, -jnp.inf, lax.max,
+        window_dimensions=(1, 1, kernel[0], kernel[1]),
+        window_strides=(1, 1, stride[0], stride[1]),
+        padding=((0, 0), (0, 0), pad_h, pad_w))
+    return y
+
+
+def _ave_divisor(size: Tuple[int, int], kernel: Tuple[int, int],
+                 pad: Tuple[int, int], stride: Tuple[int, int]) -> np.ndarray:
+    """Static (oh, ow) divisor: window extent clipped to [0-pad, size+pad)
+    (reference: pooling_layer.cpp:195-201)."""
+    h, w = size
+    oh = pool_out_dim(h, kernel[0], pad[0], stride[0])
+    ow = pool_out_dim(w, kernel[1], pad[1], stride[1])
+    div = np.zeros((oh, ow), dtype=np.float32)
+    for i in range(oh):
+        hstart = i * stride[0] - pad[0]
+        hend = min(hstart + kernel[0], h + pad[0])
+        for j in range(ow):
+            wstart = j * stride[1] - pad[1]
+            wend = min(wstart + kernel[1], w + pad[1])
+            div[i, j] = (hend - hstart) * (wend - wstart)
+    return div
+
+
+def avg_pool(x: jax.Array, kernel: Tuple[int, int], *,
+             stride: Tuple[int, int] = (1, 1),
+             pad: Tuple[int, int] = (0, 0)) -> jax.Array:
+    """AVE pooling with the reference's padded-divisor semantics."""
+    ph, pw = x.shape[2], x.shape[3]
+    oh, ow, pad_h, pad_w = _window_geometry((ph, pw), kernel, pad, stride)
+    s = lax.reduce_window(
+        x, 0.0, lax.add,
+        window_dimensions=(1, 1, kernel[0], kernel[1]),
+        window_strides=(1, 1, stride[0], stride[1]),
+        padding=((0, 0), (0, 0), pad_h, pad_w))
+    div = jnp.asarray(_ave_divisor((ph, pw), kernel, pad, stride),
+                      dtype=x.dtype)
+    return s / div[None, None, :, :]
+
+
+def stochastic_pool(x: jax.Array, kernel: Tuple[int, int], *,
+                    stride: Tuple[int, int] = (1, 1),
+                    pad: Tuple[int, int] = (0, 0),
+                    rng: Optional[jax.Array] = None,
+                    train: bool = True) -> jax.Array:
+    """STOCHASTIC pooling (reference: pooling_layer.cu:60-126; train samples a
+    window element with probability proportional to its value, test computes
+    the activation-weighted average).  Defined for non-negative inputs, as in
+    the reference (used after ReLU)."""
+    ph, pw = x.shape[2], x.shape[3]
+    oh, ow, pad_h, pad_w = _window_geometry((ph, pw), kernel, pad, stride)
+    window = dict(window_dimensions=(1, 1, kernel[0], kernel[1]),
+                  window_strides=(1, 1, stride[0], stride[1]),
+                  padding=((0, 0), (0, 0), pad_h, pad_w))
+    s = lax.reduce_window(x, 0.0, lax.add, **window)
+    if not train:
+        sq = lax.reduce_window(x * x, 0.0, lax.add, **window)
+        return jnp.where(s > 0, sq / jnp.where(s > 0, s, 1.0), 0.0)
+    if rng is None:
+        raise ValueError("stochastic_pool(train=True) needs an rng key")
+    # Sample threshold t ~ U(0, sum); pick the first element whose cumulative
+    # value crosses t.  Realized as: for threshold t, count elements whose
+    # prefix-sum <= t — equivalent to inverse-CDF sampling within the window.
+    # We express it with kernel*kernel shifted comparisons (static unroll).
+    n, c = x.shape[0], x.shape[1]
+    t = jax.random.uniform(rng, (n, c, oh, ow), dtype=x.dtype) * s
+    xp = jnp.pad(x, ((0, 0), (0, 0), pad_h, pad_w))
+    picked = jnp.zeros((n, c, oh, ow), dtype=x.dtype)
+    cum = jnp.zeros((n, c, oh, ow), dtype=x.dtype)
+    done = jnp.zeros((n, c, oh, ow), dtype=bool)
+    for i in range(kernel[0]):
+        for j in range(kernel[1]):
+            patch = lax.slice(
+                xp, (0, 0, i, j),
+                (n, c, i + (oh - 1) * stride[0] + 1,
+                 j + (ow - 1) * stride[1] + 1),
+                (1, 1, stride[0], stride[1]))
+            cum = cum + patch
+            hit = (cum >= t) & ~done
+            picked = jnp.where(hit, patch, picked)
+            done = done | hit
+    return picked
+
+
+def global_pool(x: jax.Array, mode: str = "AVE") -> jax.Array:
+    """global_pooling=true: kernel = full spatial extent
+    (reference: pooling_layer.cpp:38-42)."""
+    if mode == "MAX":
+        return jnp.max(x, axis=(2, 3), keepdims=True)
+    return jnp.mean(x, axis=(2, 3), keepdims=True)
+
+
+def spp(x: jax.Array, pyramid_height: int, mode: str = "MAX") -> jax.Array:
+    """Spatial pyramid pooling (reference: caffe/src/caffe/layers/spp_layer.cpp):
+    for level l, pool into a 2^l × 2^l grid; concat flattened results."""
+    outs = []
+    h, w = x.shape[2], x.shape[3]
+    for l in range(pyramid_height):
+        bins = 2 ** l
+        kh, kw = int(math.ceil(h / bins)), int(math.ceil(w / bins))
+        sh, sw = int(math.floor(h / bins)), int(math.floor(w / bins))
+        if bins == 1:
+            y = global_pool(x, mode)
+        elif mode == "MAX":
+            y = max_pool(x, (kh, kw), stride=(sh, sw), pad=(0, 0))
+        else:
+            y = avg_pool(x, (kh, kw), stride=(sh, sw), pad=(0, 0))
+        outs.append(y.reshape(x.shape[0], -1))
+    return jnp.concatenate(outs, axis=1)

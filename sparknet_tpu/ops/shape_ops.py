@@ -1,0 +1,146 @@
+"""Structural / utility ops: concat, slice, split, flatten, reshape, eltwise,
+tile, reduction, batch_reindex, filter, silence
+(reference: caffe/src/caffe/layers/{concat,slice,split,flatten,reshape,
+eltwise,tile,reduction,batch_reindex,filter,silence}_layer.cpp).
+
+These are shape plumbing — XLA folds them into the surrounding computation.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+
+def concat(xs: Sequence[jax.Array], axis: int = 1) -> jax.Array:
+    return jnp.concatenate(list(xs), axis=axis)
+
+
+def slice_op(x: jax.Array, *, axis: int = 1,
+             slice_points: Optional[Sequence[int]] = None,
+             num_slices: Optional[int] = None) -> List[jax.Array]:
+    """reference: slice_layer.cpp:40-60 — explicit slice_points or equal split."""
+    size = x.shape[axis]
+    if slice_points:
+        points = list(slice_points)
+    else:
+        assert num_slices is not None and size % num_slices == 0
+        step = size // num_slices
+        points = [step * i for i in range(1, num_slices)]
+    bounds = [0] + points + [size]
+    return [jax.lax.slice_in_dim(x, bounds[i], bounds[i + 1], axis=axis)
+            for i in range(len(bounds) - 1)]
+
+
+def split(x: jax.Array, n: int) -> List[jax.Array]:
+    """Fan-out: the reference's Split layer shares data to n tops
+    (split_layer.cpp); functionally it's just reuse of the same value."""
+    return [x] * n
+
+
+def flatten(x: jax.Array, *, axis: int = 1, end_axis: int = -1) -> jax.Array:
+    nd = x.ndim
+    a = axis % nd
+    e = end_axis % nd
+    mid = 1
+    for s in x.shape[a:e + 1]:
+        mid *= s
+    return x.reshape(x.shape[:a] + (mid,) + x.shape[e + 1:])
+
+
+def reshape(x: jax.Array, dims: Sequence[int], *, axis: int = 0,
+            num_axes: int = -1) -> jax.Array:
+    """reference: reshape_layer.cpp — dim 0 copies the input dim, -1 infers."""
+    nd = x.ndim
+    a = axis % (nd + 1) if axis >= 0 else nd + 1 + axis
+    end = nd if num_axes == -1 else a + num_axes
+    spanned = x.shape[a:end]
+    out_mid: List[int] = []
+    infer = -1
+    for i, d in enumerate(dims):
+        if d == 0:
+            out_mid.append(spanned[i])
+        elif d == -1:
+            infer = len(out_mid)
+            out_mid.append(1)
+        else:
+            out_mid.append(int(d))
+    new_shape = list(x.shape[:a]) + out_mid + list(x.shape[end:])
+    if infer >= 0:
+        known = 1
+        for s in new_shape:
+            known *= s
+        total = 1
+        for s in x.shape:
+            total *= s
+        new_shape[a + infer] = total // known
+    return x.reshape(tuple(new_shape))
+
+
+def eltwise(xs: Sequence[jax.Array], *, operation: str = "SUM",
+            coeffs: Optional[Sequence[float]] = None) -> jax.Array:
+    """reference: eltwise_layer.cpp:28-70 (PROD, SUM with coeffs, MAX)."""
+    if operation == "PROD":
+        out = xs[0]
+        for x in xs[1:]:
+            out = out * x
+        return out
+    if operation == "MAX":
+        out = xs[0]
+        for x in xs[1:]:
+            out = jnp.maximum(out, x)
+        return out
+    cs = list(coeffs) if coeffs else [1.0] * len(xs)
+    out = xs[0] * cs[0]
+    for x, c in zip(xs[1:], cs[1:]):
+        out = out + x * c
+    return out
+
+
+def tile(x: jax.Array, *, axis: int = 1, tiles: int = 1) -> jax.Array:
+    reps = [1] * x.ndim
+    reps[axis % x.ndim] = tiles
+    return jnp.tile(x, reps)
+
+
+def reduction(x: jax.Array, *, operation: str = "SUM", axis: int = 0,
+              coeff: float = 1.0) -> jax.Array:
+    """Reduce trailing axes from `axis` on (reference: reduction_layer.cpp)."""
+    n = x.ndim
+    a = axis % n
+    lead = x.shape[:a]
+    flat = x.reshape(lead + (-1,)) if a < n else x.reshape(lead)
+    if operation == "SUM":
+        out = jnp.sum(flat, axis=-1)
+    elif operation == "ASUM":
+        out = jnp.sum(jnp.abs(flat), axis=-1)
+    elif operation == "SUMSQ":
+        out = jnp.sum(flat * flat, axis=-1)
+    elif operation == "MEAN":
+        out = jnp.mean(flat, axis=-1)
+    else:
+        raise ValueError(f"unknown reduction {operation}")
+    return out * coeff
+
+
+def batch_reindex(x: jax.Array, idx: jax.Array) -> jax.Array:
+    """Gather along the batch axis (reference: batch_reindex_layer.cpp)."""
+    return x[idx.astype(jnp.int32)]
+
+
+def filter_op(xs: Sequence[jax.Array], selector: jax.Array,
+              ) -> List[jax.Array]:
+    """reference: filter_layer.cpp — keep items whose selector is nonzero.
+
+    Data-dependent output shape cannot be jitted on TPU; this op is provided
+    for host-side/eager use (the reference uses it only in deploy-side nets).
+    """
+    keep = jnp.nonzero(selector.reshape(-1))[0]
+    return [x[keep] for x in xs]
+
+
+def silence(*xs: jax.Array) -> None:
+    """Consume inputs, produce nothing (reference: silence_layer.cpp)."""
+    return None

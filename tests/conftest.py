@@ -7,12 +7,19 @@ tested without TPU hardware.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8").strip()
 os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+# The machine's sitecustomize pre-imports jax and registers the TPU platform
+# before conftest runs, so the env vars alone are too late — override through
+# the live config as well (safe: the CPU backend is not yet initialized).
+import jax
+
+jax.config.update("jax_platforms", "cpu")
 
 import sys
 

@@ -1,0 +1,194 @@
+"""Net builder integration tests against the real bundled prototxts —
+the analogue of the reference's LayerSpec/CifarFeaturizationSpec
+(src/test/scala/libs/LayerSpec.scala, CifarFeaturizationSpec.scala)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from sparknet_tpu.core.net import Net
+from sparknet_tpu.proto import caffe_pb
+from tests.conftest import reference_path
+
+
+def load_cifar_quick(phase="TRAIN"):
+    net_param = caffe_pb.load_net_prototxt(
+        reference_path("caffe/examples/cifar10/cifar10_quick_train_test.prototxt"))
+    net_param = caffe_pb.replace_data_layers(net_param, 100, 100, 3, 32, 32)
+    return Net(net_param, phase)
+
+
+def test_cifar_quick_build_shapes():
+    net = load_cifar_quick("TRAIN")
+    # blob inventory of the reference featurization test
+    # (CifarFeaturizationSpec.scala:87-103): conv1 is 100x32x32x32
+    assert net.blob_shapes["conv1"] == (100, 32, 32, 32)
+    assert net.blob_shapes["pool1"] == (100, 32, 16, 16)
+    assert net.blob_shapes["conv2"] == (100, 32, 16, 16)
+    assert net.blob_shapes["pool2"] == (100, 32, 8, 8)
+    assert net.blob_shapes["conv3"] == (100, 64, 8, 8)
+    assert net.blob_shapes["pool3"] == (100, 64, 4, 4)
+    assert net.blob_shapes["ip1"] == (100, 64)
+    assert net.blob_shapes["ip2"] == (100, 10)
+    # TRAIN phase excludes the accuracy layer
+    assert "accuracy" not in net.blob_shapes
+
+
+def test_cifar_quick_phase_filtering():
+    test_net = load_cifar_quick("TEST")
+    assert "accuracy" in [bl.name for bl in test_net.layers]
+    train_net = load_cifar_quick("TRAIN")
+    assert "accuracy" not in [bl.name for bl in train_net.layers]
+
+
+def test_cifar_quick_forward_and_loss():
+    net = load_cifar_quick("TRAIN")
+    params = net.init_params(seed=42)
+    # gaussian filler std from prototxt: conv1 std=0.0001
+    w = np.asarray(params["conv1/0"])
+    assert w.shape == (32, 3, 5, 5)
+    assert 0 < w.std() < 3e-4
+    rng = np.random.RandomState(0)
+    data = jnp.asarray(rng.rand(100, 3, 32, 32).astype(np.float32))
+    label = jnp.asarray(rng.randint(0, 10, size=(100,)))
+    blobs, stats = net.apply(params, {"data": data, "label": label})
+    assert blobs["loss"].shape == ()
+    # random init -> loss ~ log(10)
+    assert abs(float(blobs["loss"]) - np.log(10)) < 0.3
+    assert stats == {}
+
+
+def test_cifar_quick_test_accuracy_chance():
+    """Statistical smoke test, as the reference does
+    (CifarSpec.scala:92: random-init accuracy ~ 10% +/- 3%)."""
+    net = load_cifar_quick("TEST")
+    params = net.init_params(seed=7)
+    rng = np.random.RandomState(0)
+    accs = []
+    for _ in range(5):
+        data = jnp.asarray(rng.rand(100, 3, 32, 32).astype(np.float32))
+        label = jnp.asarray(rng.randint(0, 10, size=(100,)))
+        blobs = net.forward(params, {"data": data, "label": label})
+        accs.append(float(blobs["accuracy"]))
+    assert 0.02 <= np.mean(accs) <= 0.25
+
+
+def test_lr_mult_extraction():
+    net = load_cifar_quick("TRAIN")
+    lrs = net.lr_multipliers()
+    assert lrs["conv1/0"] == 1.0
+    assert lrs["conv1/1"] == 2.0  # bias lr_mult: 2 in the prototxt
+
+
+def test_weight_interchange_roundtrip():
+    net = load_cifar_quick("TRAIN")
+    params = net.init_params(seed=1)
+    wc = net.get_weights(params)
+    assert set(wc.keys()) == {"conv1", "conv2", "conv3", "ip1", "ip2"}
+    assert len(wc["conv1"]) == 2
+    params2 = net.init_params(seed=2)
+    params2 = net.set_weights(params2, wc)
+    for k in params:
+        np.testing.assert_array_equal(np.asarray(params[k]),
+                                      np.asarray(params2[k]))
+
+
+def test_jit_forward():
+    net = load_cifar_quick("TRAIN")
+    params = net.init_params(seed=0)
+    rng = np.random.RandomState(0)
+    data = jnp.asarray(rng.rand(100, 3, 32, 32).astype(np.float32))
+    label = jnp.asarray(rng.randint(0, 10, size=(100,)))
+
+    @jax.jit
+    def loss_fn(p, d, l):
+        blobs, _ = net.apply(p, {"data": d, "label": l})
+        return blobs["loss"]
+
+    l1 = float(loss_fn(params, data, label))
+    l2 = float(loss_fn(params, data, label))
+    assert l1 == l2
+    g = jax.grad(loss_fn)(params, data, label)
+    assert set(g.keys()) == set(params.keys())
+    assert float(jnp.abs(g["ip2/0"]).sum()) > 0
+
+
+def test_alexnet_build():
+    net_param = caffe_pb.load_net_prototxt(
+        reference_path("caffe/models/bvlc_alexnet/train_val.prototxt"))
+    net = Net(net_param, "TRAIN", batch_override=4)
+    # canonical AlexNet shapes (train crop 227)
+    assert net.blob_shapes["conv1"] == (4, 96, 55, 55)
+    assert net.blob_shapes["pool1"] == (4, 96, 27, 27)
+    assert net.blob_shapes["conv2"] == (4, 256, 27, 27)
+    assert net.blob_shapes["pool5"] == (4, 256, 6, 6)
+    assert net.blob_shapes["fc6"] == (4, 4096)
+    assert net.blob_shapes["fc8"] == (4, 1000)
+    params = net.init_params(seed=0)
+    # grouped conv2: (256, 48, 5, 5)
+    assert params["conv2/0"].shape == (256, 48, 5, 5)
+    rng = np.random.RandomState(0)
+    data = jnp.asarray(rng.rand(4, 3, 227, 227).astype(np.float32))
+    label = jnp.asarray(rng.randint(0, 1000, size=(4,)))
+    blobs, _ = net.apply(params, {"data": data, "label": label},
+                         rng=jax.random.PRNGKey(0))
+    assert abs(float(blobs["loss"]) - np.log(1000)) < 1.0
+
+
+def test_googlenet_build():
+    net_param = caffe_pb.load_net_prototxt(
+        reference_path("caffe/models/bvlc_googlenet/train_val.prototxt"))
+    net = Net(net_param, "TRAIN", batch_override=2)
+    assert net.blob_shapes["inception_3a/output"] == (2, 256, 28, 28)
+    assert net.blob_shapes["pool5/7x7_s1"] == (2, 1024, 1, 1)
+    # three loss heads with weights 0.3/0.3/1.0
+    weights = dict(net.loss_terms)
+    assert weights["loss1/loss1"] == pytest.approx(0.3)
+    assert weights["loss2/loss1"] == pytest.approx(0.3)
+    assert weights["loss3/loss3"] == pytest.approx(1.0)
+    params = net.init_params(seed=0)
+    rng = np.random.RandomState(0)
+    data = jnp.asarray(rng.rand(2, 3, 224, 224).astype(np.float32))
+    label = jnp.asarray(rng.randint(0, 1000, size=(2,)))
+    blobs, _ = net.apply(params, {"data": data, "label": label},
+                         rng=jax.random.PRNGKey(0))
+    # 1.6 * log(1000) give or take init noise
+    assert 5.0 < float(blobs["loss"]) < 18.0
+
+
+def test_lenet_build():
+    net_param = caffe_pb.load_net_prototxt(
+        reference_path("caffe/examples/mnist/lenet_train_test.prototxt"))
+    net = Net(net_param, "TRAIN", data_shapes={"data": (64, 1, 28, 28),
+                                               "label": (64,)})
+    assert net.blob_shapes["conv1"] == (64, 20, 24, 24)
+    assert net.blob_shapes["ip2"] == (64, 10)
+    params = net.init_params(seed=0)
+    # xavier filler on conv1: bounded uniform
+    w = np.asarray(params["conv1/0"])
+    bound = np.sqrt(3.0 / 25)
+    assert np.abs(w).max() <= bound + 1e-6
+
+
+def test_autoencoder_build():
+    """mnist_autoencoder: sigmoid, euclidean + BCE losses, stages/phase rules."""
+    net_param = caffe_pb.load_net_prototxt(
+        reference_path("caffe/examples/mnist/mnist_autoencoder.prototxt"))
+    net = Net(net_param, "TRAIN", data_shapes={"data": (100, 1, 28, 28)})
+    names = [bl.name for bl in net.layers]
+    assert "encode1" in names and "decode1" in names
+    params = net.init_params(seed=0)
+    rng = np.random.RandomState(0)
+    data = jnp.asarray(rng.rand(100, 1, 28, 28).astype(np.float32))
+    blobs, _ = net.apply(params, {"data": data})
+    assert np.isfinite(float(blobs["loss"]))
+
+
+def test_deploy_net_with_input_fields():
+    net_param = caffe_pb.load_net_prototxt(
+        reference_path("caffe/models/bvlc_alexnet/deploy.prototxt"))
+    net = Net(net_param, "TEST")
+    assert net.input_blobs == ["data"]
+    assert net.blob_shapes["data"] == (10, 3, 227, 227)
+    assert net.blob_shapes["prob"] == (10, 1000)
