@@ -1,0 +1,167 @@
+"""Programmatic model DSL — the analogue of the reference's Scala builder
+(reference: src/main/scala/libs/Layers.scala:18-137) emitting LayerParameter
+messages, plus the NetParam aggregator (:130-137).
+
+Example (LeNet, as in LayerSpec.scala:20-35):
+
+    net = net_param(
+        "LeNet",
+        memory_data_layer("data", ["data", "label"], batch=64, channels=1,
+                          height=28, width=28),
+        convolution_layer("conv1", "data", num_output=20, kernel_size=5),
+        pooling_layer("pool1", "conv1", pool="MAX", kernel_size=2, stride=2),
+        inner_product_layer("ip1", "pool1", num_output=500),
+        relu_layer("relu1", "ip1"),
+        inner_product_layer("ip2", "ip1", num_output=10),  # relu1 is in-place,
+        softmax_with_loss_layer("loss", ["ip2", "label"]),
+    )
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence, Union
+
+from ..proto.caffe_pb import NetParameter
+from ..proto.textformat import Enum, Message
+
+
+def _msg(**fields) -> Message:
+    m = Message()
+    for k, v in fields.items():
+        if v is None:
+            continue
+        if isinstance(v, (list, tuple)):
+            for item in v:
+                m.add(k, item)
+        else:
+            m.set(k, v)
+    return m
+
+
+def _layer(name: str, type_: str, bottoms, tops, phase: Optional[str] = None,
+           **params) -> Message:
+    if isinstance(bottoms, str):
+        bottoms = [bottoms]
+    if isinstance(tops, str):
+        tops = [tops]
+    m = _msg(name=name, type=type_)
+    for b in bottoms or []:
+        m.add("bottom", b)
+    for t in tops or []:
+        m.add("top", t)
+    if phase:
+        # NetStateRule include (reference: Layers.scala:27-35 RDDLayer)
+        m.add("include", _msg(phase=Enum(phase)))
+    for k, v in params.items():
+        if v is not None:
+            m.add(k, v)
+    return m
+
+
+def _filler(spec: Union[None, str, Dict[str, Any]]) -> Optional[Message]:
+    if spec is None:
+        return None
+    if isinstance(spec, str):
+        return _msg(type=spec)
+    return _msg(**spec)
+
+
+def memory_data_layer(name: str, tops: Sequence[str], *, batch: int,
+                      channels: int, height: int, width: int,
+                      phase: Optional[str] = None) -> Message:
+    """In-memory feed layer — the RDDLayer analogue (Layers.scala:18-40)."""
+    return _layer(name, "MemoryData", [], list(tops), phase,
+                  memory_data_param=_msg(batch_size=batch, channels=channels,
+                                         height=height, width=width))
+
+
+def convolution_layer(name: str, bottom: str, *, num_output: int,
+                      kernel_size: int, stride: int = 1, pad: int = 0,
+                      group: int = 1,
+                      weight_filler: Union[None, str, Dict] = "xavier",
+                      bias_filler: Union[None, str, Dict] = None,
+                      top: Optional[str] = None) -> Message:
+    """(reference: Layers.scala:42-56 ConvolutionLayer)"""
+    return _layer(name, "Convolution", bottom, top or name,
+                  convolution_param=_msg(
+                      num_output=num_output, kernel_size=kernel_size,
+                      stride=stride, pad=pad or None, group=group if group > 1
+                      else None, weight_filler=_filler(weight_filler),
+                      bias_filler=_filler(bias_filler)))
+
+
+def pooling_layer(name: str, bottom: str, *, pool: str = "MAX",
+                  kernel_size: int, stride: int = 1, pad: int = 0,
+                  top: Optional[str] = None) -> Message:
+    """(reference: Layers.scala:58-86 PoolingLayer, Max/Ave)"""
+    return _layer(name, "Pooling", bottom, top or name,
+                  pooling_param=_msg(pool=Enum(pool), kernel_size=kernel_size,
+                                     stride=stride, pad=pad or None))
+
+
+def inner_product_layer(name: str, bottom: str, *, num_output: int,
+                        weight_filler: Union[None, str, Dict] = "xavier",
+                        bias_filler: Union[None, str, Dict] = None,
+                        top: Optional[str] = None) -> Message:
+    """(reference: Layers.scala:88-100 InnerProductLayer)"""
+    return _layer(name, "InnerProduct", bottom, top or name,
+                  inner_product_param=_msg(
+                      num_output=num_output,
+                      weight_filler=_filler(weight_filler),
+                      bias_filler=_filler(bias_filler)))
+
+
+def relu_layer(name: str, bottom: str, top: Optional[str] = None) -> Message:
+    """(reference: Layers.scala:102-113; defaults to in-place like prototxts)"""
+    return _layer(name, "ReLU", bottom, top or bottom)
+
+
+def dropout_layer(name: str, bottom: str, *, ratio: float = 0.5,
+                  top: Optional[str] = None) -> Message:
+    return _layer(name, "Dropout", bottom, top or bottom,
+                  dropout_param=_msg(dropout_ratio=ratio))
+
+
+def lrn_layer(name: str, bottom: str, *, local_size: int = 5,
+              alpha: float = 1.0, beta: float = 0.75,
+              top: Optional[str] = None) -> Message:
+    return _layer(name, "LRN", bottom, top or name,
+                  lrn_param=_msg(local_size=local_size, alpha=alpha, beta=beta))
+
+
+def concat_layer(name: str, bottoms: Sequence[str], *, axis: int = 1,
+                 top: Optional[str] = None) -> Message:
+    return _layer(name, "Concat", list(bottoms), top or name,
+                  concat_param=_msg(axis=axis))
+
+
+def softmax_with_loss_layer(name: str, bottoms: Sequence[str],
+                            top: Optional[str] = None) -> Message:
+    """(reference: Layers.scala:115-128 SoftmaxWithLoss)"""
+    return _layer(name, "SoftmaxWithLoss", list(bottoms), top or name)
+
+
+def accuracy_layer(name: str, bottoms: Sequence[str], *, top_k: int = 1,
+                   phase: Optional[str] = "TEST",
+                   top: Optional[str] = None) -> Message:
+    return _layer(name, "Accuracy", list(bottoms), top or name, phase,
+                  accuracy_param=_msg(top_k=top_k if top_k > 1 else None))
+
+
+def net_param(name: str, *layers: Message) -> NetParameter:
+    """(reference: Layers.scala:130-137 NetParam)"""
+    m = _msg(name=name)
+    for l in layers:
+        m.add("layer", l)
+    return NetParameter(m)
+
+
+def solver_param(*, base_lr: float = 0.01, lr_policy: str = "fixed",
+                 momentum: float = 0.0, weight_decay: float = 0.0,
+                 max_iter: int = 100, solver_type: str = "SGD",
+                 random_seed: int = 1, **extra) -> "caffe_pb.SolverParameter":
+    from ..proto import caffe_pb
+    m = _msg(base_lr=base_lr, lr_policy=lr_policy, momentum=momentum or None,
+             weight_decay=weight_decay or None, max_iter=max_iter,
+             type=solver_type, random_seed=random_seed, **extra)
+    return caffe_pb.SolverParameter(m)

@@ -286,6 +286,22 @@ class Net:
 
     # ---------------------------------------------------------- introspection
     @property
+    def output_blobs(self) -> List[str]:
+        """Blobs produced but never consumed — the net's outputs, which the
+        test loop accumulates (reference: net.cpp:270-285 available_blobs,
+        solver.cpp:414-444 TestAndStoreResult)."""
+        consumed = set()
+        for bl in self.layers:
+            for b in bl.bottoms:
+                consumed.add(b)
+        out = []
+        for bl in self.layers:
+            for t in bl.tops:
+                if t not in consumed and t not in out:
+                    out.append(t)
+        return out
+
+    @property
     def num_layers(self) -> int:
         return len(self.layers)
 

@@ -1,0 +1,41 @@
+"""Learning-rate policies (reference: caffe/src/caffe/solvers/sgd_solver.cpp:27-64
+GetLearningRate).  Jit-friendly: `it` may be a traced int32 scalar, so the
+whole train step — including the LR schedule — compiles into one XLA program.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax.numpy as jnp
+
+from ..proto.caffe_pb import SolverParameter
+
+
+def learning_rate(sp: SolverParameter, it) -> jnp.ndarray:
+    """Current LR for iteration `it` under sp.lr_policy."""
+    policy = str(sp.lr_policy)
+    base = jnp.float32(sp.base_lr)
+    it = jnp.asarray(it, dtype=jnp.float32)
+    if policy == "fixed":
+        return base
+    if policy == "step":
+        cur = jnp.floor(it / float(sp.stepsize))
+        return base * jnp.power(jnp.float32(sp.gamma), cur)
+    if policy == "exp":
+        return base * jnp.power(jnp.float32(sp.gamma), it)
+    if policy == "inv":
+        return base * jnp.power(1.0 + jnp.float32(sp.gamma) * it,
+                                -jnp.float32(sp.power))
+    if policy == "multistep":
+        steps = jnp.asarray(list(sp.stepvalues) or [0], dtype=jnp.float32)
+        cur = jnp.sum(it >= steps) if sp.stepvalues else jnp.float32(0)
+        return base * jnp.power(jnp.float32(sp.gamma),
+                                cur.astype(jnp.float32))
+    if policy == "poly":
+        return base * jnp.power(1.0 - it / float(sp.max_iter),
+                                jnp.float32(sp.power))
+    if policy == "sigmoid":
+        return base / (1.0 + jnp.exp(-jnp.float32(sp.gamma) *
+                                     (it - float(sp.stepsize))))
+    raise ValueError(f"unknown lr_policy {policy!r}")

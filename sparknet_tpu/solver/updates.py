@@ -1,0 +1,117 @@
+"""Functional, jittable parameter updates with the reference's exact math
+(reference: caffe/src/caffe/solvers/{sgd,nesterov,adagrad,rmsprop,adadelta,
+adam}_solver.cpp).  The whole ApplyUpdate pipeline — clip, normalize,
+regularize, per-solver update — compiles into the train step; there is no
+per-blob dispatch at runtime.
+
+State layout: dict param_key -> tuple of history arrays (solver-dependent
+arity), mirroring the reference's `history_` blobs (sgd_solver.cpp:66-79) so
+snapshot/restore carries the same information.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+Params = Dict[str, jax.Array]
+Grads = Dict[str, jax.Array]
+State = Dict[str, Tuple[jax.Array, ...]]
+
+
+def init_state(params: Params, solver_type: str) -> State:
+    n_slots = {"SGD": 1, "Nesterov": 1, "AdaGrad": 1, "RMSProp": 1,
+               "AdaDelta": 2, "Adam": 2}[solver_type]
+    return {k: tuple(jnp.zeros_like(v) for _ in range(n_slots))
+            for k, v in params.items()}
+
+
+def clip_gradients(grads: Grads, clip: float) -> Grads:
+    """Global-L2-norm clipping (reference: sgd_solver.cpp:81-100)."""
+    if clip <= 0:
+        return grads
+    sumsq = jnp.asarray(0.0, jnp.float32)
+    for g in grads.values():
+        sumsq = sumsq + jnp.sum(jnp.square(g))
+    l2 = jnp.sqrt(sumsq)
+    scale = jnp.where(l2 > clip, clip / jnp.maximum(l2, 1e-12), 1.0)
+    return {k: g * scale for k, g in grads.items()}
+
+
+def regularize(params: Params, grads: Grads, weight_decay: float,
+               decay_mults: Dict[str, float], reg_type: str) -> Grads:
+    """diff += λ·decay_mult·w (L2) or λ·decay_mult·sign(w) (L1)
+    (reference: sgd_solver.cpp:119-160)."""
+    if weight_decay == 0:
+        return grads
+    out = {}
+    for k, g in grads.items():
+        local = weight_decay * decay_mults.get(k, 1.0)
+        if local == 0:
+            out[k] = g
+        elif reg_type == "L1":
+            out[k] = g + local * jnp.sign(params[k])
+        else:
+            out[k] = g + local * params[k]
+    return out
+
+
+def apply_update(solver_type: str, params: Params, grads: Grads, state: State,
+                 rate, it, *, lr_mults: Dict[str, float],
+                 momentum: float = 0.0, delta: float = 1e-8,
+                 momentum2: float = 0.999, rms_decay: float = 0.99,
+                 ) -> Tuple[Params, State]:
+    """ComputeUpdateValue + net.Update() for every param
+    (reference: sgd_solver.cpp:207-240 and solvers/*.cpp)."""
+    new_p: Params = {}
+    new_s: State = {}
+    for k, w in params.items():
+        g = grads[k]
+        lr = rate * lr_mults.get(k, 1.0)
+        h = state[k]
+        if solver_type == "SGD":
+            # v = μv + lr·g ; w -= v   (sgd_solver.cpp:226-240)
+            v = momentum * h[0] + lr * g
+            new_p[k] = w - v
+            new_s[k] = (v,)
+        elif solver_type == "Nesterov":
+            # (nesterov_solver.cpp:30-45)
+            v = momentum * h[0] + lr * g
+            upd = (1.0 + momentum) * v - momentum * h[0]
+            new_p[k] = w - upd
+            new_s[k] = (v,)
+        elif solver_type == "AdaGrad":
+            # (adagrad_solver.cpp:22-42)
+            hist = h[0] + jnp.square(g)
+            upd = lr * g / (jnp.sqrt(hist) + delta)
+            new_p[k] = w - upd
+            new_s[k] = (hist,)
+        elif solver_type == "RMSProp":
+            # (rmsprop_solver.cpp:20-45)
+            hist = rms_decay * h[0] + (1.0 - rms_decay) * jnp.square(g)
+            upd = lr * g / (jnp.sqrt(hist) + delta)
+            new_p[k] = w - upd
+            new_s[k] = (hist,)
+        elif solver_type == "AdaDelta":
+            # μ plays the averaging-decay role (adadelta_solver.cpp:18-85);
+            # h[0]=grad² history, h[1]=update² history (pre-update this step)
+            g2h = momentum * h[0] + (1.0 - momentum) * jnp.square(g)
+            upd = g * jnp.sqrt((delta + h[1]) / (delta + g2h))
+            u2h = momentum * h[1] + (1.0 - momentum) * jnp.square(upd)
+            new_p[k] = w - lr * upd
+            new_s[k] = (g2h, u2h)
+        elif solver_type == "Adam":
+            # (adam_solver.cpp:20-50); t = iter+1
+            t = jnp.asarray(it, jnp.float32) + 1.0
+            m = momentum * h[0] + (1.0 - momentum) * g
+            v = momentum2 * h[1] + (1.0 - momentum2) * jnp.square(g)
+            corr = jnp.sqrt(1.0 - jnp.power(momentum2, t)) / \
+                (1.0 - jnp.power(momentum, t))
+            upd = lr * corr * m / (jnp.sqrt(v) + delta)
+            new_p[k] = w - upd
+            new_s[k] = (m, v)
+        else:
+            raise ValueError(f"unknown solver type {solver_type!r}")
+    return new_p, new_s
