@@ -1,0 +1,54 @@
+"""ByteImage: planar-CHW uint8 image container
+(reference: src/main/java/libs/ByteImage.java:35-104).
+
+Vectorized over whole batches (numpy) instead of the reference's per-image
+Java loops — the host-side preprocessing must keep up with a TPU, not a K40.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+
+
+class ByteImage:
+    """One planar-RGB (or grayscale) image, uint8, shape (C, H, W)."""
+
+    __slots__ = ("data",)
+
+    def __init__(self, data: np.ndarray) -> None:
+        assert data.ndim == 3, "ByteImage is CHW"
+        self.data = np.ascontiguousarray(data, dtype=np.uint8)
+
+    @classmethod
+    def from_hwc(cls, arr: np.ndarray) -> "ByteImage":
+        """From an interleaved (H, W, C) decode (reference: ByteImage.java:35-60
+        converts BufferedImage to planar)."""
+        return cls(np.transpose(arr, (2, 0, 1)))
+
+    @property
+    def shape(self) -> Tuple[int, int, int]:
+        return self.data.shape  # type: ignore[return-value]
+
+    def to_float(self) -> np.ndarray:
+        return self.data.astype(np.float32)
+
+    def crop_into(self, lower: Sequence[int], upper: Sequence[int],
+                  ) -> np.ndarray:
+        """Crop [lower, upper) per axis and cast to float
+        (reference: ByteImage.java:86-104 cropInto)."""
+        sl = tuple(slice(int(l), int(u)) for l, u in zip(lower, upper))
+        return self.data[sl].astype(np.float32)
+
+
+def batch_crop(images: np.ndarray, offsets_hw: np.ndarray, crop: int,
+               ) -> np.ndarray:
+    """Crop a (N, C, H, W) uint8/float batch at per-image (row, col) offsets
+    into (N, C, crop, crop) — the vectorized cropInto."""
+    n = images.shape[0]
+    out = np.empty(images.shape[:2] + (crop, crop), dtype=np.float32)
+    for i in range(n):
+        r, c = int(offsets_hw[i, 0]), int(offsets_hw[i, 1])
+        out[i] = images[i, :, r:r + crop, c:c + crop]
+    return out

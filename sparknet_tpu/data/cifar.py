@@ -1,0 +1,69 @@
+"""CIFAR-10 binary-format loader
+(reference: src/main/scala/loaders/CifarLoader.scala).
+
+Record format (:65-85): 1 label byte + 3072 image bytes (3x32x32 planar RGB),
+files data_batch_{1..5}.bin (train) and test_batch.bin (test).  Train records
+are shuffled with a seeded permutation (:31-35) and the channel-mean image is
+computed over the train set (:57-63).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+RECORD_BYTES = 1 + 3 * 32 * 32
+
+
+def read_batch_file(path: str) -> Tuple[np.ndarray, np.ndarray]:
+    raw = np.fromfile(path, dtype=np.uint8)
+    if raw.size % RECORD_BYTES:
+        raise ValueError(f"{path}: size {raw.size} not a multiple of "
+                         f"{RECORD_BYTES}")
+    recs = raw.reshape(-1, RECORD_BYTES)
+    labels = recs[:, 0].astype(np.int32)
+    images = recs[:, 1:].reshape(-1, 3, 32, 32)
+    return images, labels
+
+
+class CifarLoader:
+    def __init__(self, path: str, *, shuffle_seed: int = 42,
+                 train_files: Optional[List[str]] = None) -> None:
+        files = train_files or [f"data_batch_{i}.bin" for i in range(1, 6)]
+        xs, ys = [], []
+        for f in files:
+            p = os.path.join(path, f)
+            if os.path.exists(p):
+                x, y = read_batch_file(p)
+                xs.append(x)
+                ys.append(y)
+        if not xs:
+            raise FileNotFoundError(f"no CIFAR batch files under {path}")
+        self.train_images = np.concatenate(xs)
+        self.train_labels = np.concatenate(ys)
+        # seeded shuffle of the train set (CifarLoader.scala:31-35)
+        perm = np.random.RandomState(shuffle_seed).permutation(
+            len(self.train_labels))
+        self.train_images = self.train_images[perm]
+        self.train_labels = self.train_labels[perm]
+        test_path = os.path.join(path, "test_batch.bin")
+        if os.path.exists(test_path):
+            self.test_images, self.test_labels = read_batch_file(test_path)
+        else:
+            self.test_images = np.zeros((0, 3, 32, 32), np.uint8)
+            self.test_labels = np.zeros((0,), np.int32)
+        # per-pixel mean image over train (CifarLoader.scala:57-63)
+        self.mean_image = self.train_images.astype(np.float64).mean(axis=0) \
+            .astype(np.float32)
+
+
+def write_batch_file(path: str, images: np.ndarray, labels: np.ndarray,
+                     ) -> None:
+    """Inverse of read_batch_file — used by tests and the DB-analogue tools."""
+    n = len(labels)
+    recs = np.empty((n, RECORD_BYTES), dtype=np.uint8)
+    recs[:, 0] = labels.astype(np.uint8)
+    recs[:, 1:] = images.reshape(n, -1)
+    recs.tofile(path)

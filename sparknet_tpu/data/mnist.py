@@ -1,0 +1,44 @@
+"""MNIST idx-format loader (for the bundled LeNet/autoencoder models;
+reference fetch script: caffe/data/mnist/get_mnist.sh, consumed through
+LMDB by examples/mnist).  Supports the standard idx1/idx3 byte layout.
+"""
+
+from __future__ import annotations
+
+import gzip
+import os
+import struct
+from typing import Tuple
+
+import numpy as np
+
+
+def _open(path: str):
+    return gzip.open(path, "rb") if path.endswith(".gz") else open(path, "rb")
+
+
+def read_idx(path: str) -> np.ndarray:
+    with _open(path) as f:
+        magic = struct.unpack(">I", f.read(4))[0]
+        ndim = magic & 0xFF
+        dtype_code = (magic >> 8) & 0xFF
+        assert dtype_code == 0x08, "only ubyte idx supported"
+        dims = struct.unpack(">" + "I" * ndim, f.read(4 * ndim))
+        data = np.frombuffer(f.read(), dtype=np.uint8)
+    return data.reshape(dims)
+
+
+def load_mnist(path: str, kind: str = "train",
+               ) -> Tuple[np.ndarray, np.ndarray]:
+    """Returns ((N, 1, 28, 28) uint8, (N,) int32)."""
+    prefix = "train" if kind == "train" else "t10k"
+    imgs = labels = None
+    for suffix in ("", ".gz"):
+        ip = os.path.join(path, f"{prefix}-images-idx3-ubyte{suffix}")
+        lp = os.path.join(path, f"{prefix}-labels-idx1-ubyte{suffix}")
+        if os.path.exists(ip) and os.path.exists(lp):
+            imgs, labels = read_idx(ip), read_idx(lp)
+            break
+    if imgs is None:
+        raise FileNotFoundError(f"no MNIST idx files under {path}")
+    return imgs[:, None, :, :], labels.astype(np.int32)

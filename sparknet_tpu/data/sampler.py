@@ -1,0 +1,63 @@
+"""MinibatchSampler: paired image/label pull streams over one partition
+(reference: src/main/scala/libs/MinibatchSampler.scala).
+
+Faithful semantics:
+- a random *contiguous* window of `num_sampled_batches` minibatch indices out
+  of `total_num_batches` is chosen per sampler (:16-21) — this windowed
+  subsample is part of the reference's periodic-averaging training recipe and
+  affects epochs-to-accuracy;
+- images and labels are pulled through two separate callbacks that must stay
+  aligned whichever is called first (:3-12), because the engine requests them
+  independently (JavaDataLayer per-blob callbacks, ccaffe.cpp:197-216).
+"""
+
+from __future__ import annotations
+
+import random
+from typing import Any, Iterator, Optional, Tuple
+
+
+class MinibatchSampler:
+    def __init__(self, minibatch_it: Iterator[Tuple[Any, Any]],
+                 total_num_batches: int, num_sampled_batches: int,
+                 seed: Optional[int] = None) -> None:
+        self._it = iter(minibatch_it)
+        rng = random.Random(seed)
+        start = rng.randint(0, total_num_batches - num_sampled_batches)
+        self.indices = list(range(start, start + num_sampled_batches))
+        self._indices_index = 0
+        self._position = -1
+        self._images: Optional[Any] = None
+        self._labels: Optional[Any] = None
+
+    def _next_minibatch(self) -> None:
+        target = self.indices[self._indices_index]
+        for _ in range(target - self._position - 1):
+            next(self._it)
+        self._position = target
+        self._indices_index += 1
+        images, labels = next(self._it)
+        self._images, self._labels = images, labels
+
+    def next_image_minibatch(self):
+        if self._images is None:
+            self._next_minibatch()
+            return self._images
+        images = self._images
+        self._images = None
+        self._labels = None
+        return images
+
+    def next_label_minibatch(self):
+        if self._labels is None:
+            self._next_minibatch()
+            return self._labels
+        labels = self._labels
+        self._images = None
+        self._labels = None
+        return labels
+
+    def next_batch(self) -> dict:
+        """Convenience pull for the Solver data-source contract."""
+        return {"data": self.next_image_minibatch(),
+                "label": self.next_label_minibatch()}

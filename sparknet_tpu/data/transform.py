@@ -1,0 +1,82 @@
+"""Data augmentation: the DataTransformer
+(reference: caffe/src/caffe/data_transformer.cpp — Transform(Datum):
+value = (pixel - mean) * scale, with train-phase random crop + random mirror
+and test-phase center crop; mean from a mean image or per-channel values)
+and the app-level preprocessing closures
+(reference: src/main/scala/apps/ImageNetApp.scala:124-138).
+
+Vectorized over batches; runs host-side, feeding device arrays per step.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as np
+
+
+class DataTransformer:
+    def __init__(self, *, scale: float = 1.0, crop_size: int = 0,
+                 mirror: bool = False,
+                 mean_image: Optional[np.ndarray] = None,
+                 mean_values: Sequence[float] = (),
+                 phase: str = "TRAIN", seed: Optional[int] = None) -> None:
+        self.scale = float(scale)
+        self.crop = int(crop_size)
+        self.mirror = bool(mirror)
+        self.mean_image = (np.asarray(mean_image, dtype=np.float32)
+                           if mean_image is not None else None)
+        self.mean_values = np.asarray(mean_values, dtype=np.float32) \
+            if mean_values else None
+        self.phase = phase
+        self.rng = np.random.RandomState(seed)
+
+    def __call__(self, batch: np.ndarray) -> np.ndarray:
+        """(N, C, H, W) uint8/float -> transformed float32."""
+        x = batch.astype(np.float32)
+        n, c, h, w = x.shape
+        mean = self.mean_image
+        if self.crop and (h > self.crop or w > self.crop):
+            cs = self.crop
+            if self.phase == "TRAIN":
+                # per-image random offsets (data_transformer.cpp random crop)
+                offs = np.stack([self.rng.randint(0, h - cs + 1, size=n),
+                                 self.rng.randint(0, w - cs + 1, size=n)],
+                                axis=1)
+                out = np.empty((n, c, cs, cs), dtype=np.float32)
+                for i in range(n):
+                    r, col = offs[i]
+                    out[i] = x[i, :, r:r + cs, col:col + cs]
+                    if mean is not None:
+                        out[i] -= mean[:, r:r + cs, col:col + cs]
+                x = out
+                mean = None  # already subtracted (crop-aligned, as reference)
+            else:
+                r, col = (h - cs) // 2, (w - cs) // 2
+                x = x[:, :, r:r + cs, col:col + cs]
+                if mean is not None:
+                    mean = mean[:, r:r + cs, col:col + cs]
+        if mean is not None:
+            x = x - mean[None]
+        if self.mean_values is not None:
+            x = x - self.mean_values.reshape(1, -1, 1, 1)
+        if self.mirror and self.phase == "TRAIN":
+            flip = self.rng.rand(n) < 0.5
+            x[flip] = x[flip][:, :, :, ::-1]
+        if self.scale != 1.0:
+            x = x * self.scale
+        return x
+
+
+def compute_mean_image(batches) -> np.ndarray:
+    """Distributed-style per-pixel mean: accumulate int64 sums per batch then
+    combine (reference: src/main/scala/preprocessing/ComputeMean.scala:8-76)."""
+    total = None
+    count = 0
+    for batch in batches:
+        b = np.asarray(batch)
+        s = b.astype(np.int64).sum(axis=0)
+        total = s if total is None else total + s
+        count += b.shape[0]
+    assert total is not None and count > 0
+    return (total.astype(np.float64) / count).astype(np.float32)

@@ -1,0 +1,43 @@
+"""Device-mesh helpers.
+
+The reference's distributed substrate is Spark executors + a driver in the
+weight path (reference: CifarApp.scala:95-136 broadcast/collect) and a CUDA
+P2P tree within a node (parallel.cpp:271-437).  Here the substrate is a
+`jax.sharding.Mesh` over TPU chips: collectives ride ICI within a slice and
+DCN across slices, and no host ever holds the weights during training.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+WORKER_AXIS = "workers"
+MODEL_AXIS = "model"
+
+
+def make_mesh(n_workers: Optional[int] = None,
+              model_parallel: int = 1,
+              devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+    """A (workers, model) mesh; model axis defaults to 1 (pure DP, matching
+    the reference's parallelism inventory, SURVEY.md §2.3)."""
+    devs = list(devices if devices is not None else jax.devices())
+    if n_workers is None:
+        n_workers = len(devs) // model_parallel
+    need = n_workers * model_parallel
+    if need > len(devs):
+        raise ValueError(f"need {need} devices, have {len(devs)}")
+    grid = np.asarray(devs[:need]).reshape(n_workers, model_parallel)
+    return Mesh(grid, (WORKER_AXIS, MODEL_AXIS))
+
+
+def worker_sharding(mesh: Mesh) -> NamedSharding:
+    """Leading-axis sharding over workers (per-replica stacked data/params)."""
+    return NamedSharding(mesh, P(WORKER_AXIS))
+
+
+def replicated_sharding(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())

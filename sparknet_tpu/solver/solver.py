@@ -35,6 +35,44 @@ from .lr_policies import learning_rate
 DataSource = Callable[[], Dict[str, Any]]
 
 
+def make_single_step(net: Net, sp: SolverParameter):
+    """One training iteration as a pure function
+    (params, state, it, inputs, rng) -> (params, state, loss).
+
+    The per-iteration core of Solver::Step + SGDSolver::ApplyUpdate
+    (solver.cpp:193-288, sgd_solver.cpp:102-240) with iter_size folded out;
+    shared by the single-chip Solver and the distributed trainer, which scans
+    it over τ local steps inside one compiled round (SURVEY.md §2.3)."""
+    clip = float(sp.clip_gradients)
+    weight_decay = float(sp.weight_decay)
+    reg_type = str(sp.regularization_type)
+    hyper = dict(momentum=float(sp.momentum), delta=float(sp.delta),
+                 momentum2=float(sp.momentum2), rms_decay=float(sp.rms_decay))
+    solver_type = sp.resolved_type()
+    lr_mults = net.lr_multipliers()
+    decay_mults = net.decay_multipliers()
+
+    def loss_fn(params, inputs, rng):
+        blobs, stats = net.apply(params, inputs, rng, train=True)
+        return blobs["loss"], stats
+
+    def single_step(params, state, it, inputs, rng):
+        (loss, stats), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(params, inputs, rng)
+        grads = updates.clip_gradients(grads, clip)
+        grads = updates.regularize(params, grads, weight_decay, decay_mults,
+                                   reg_type)
+        rate = learning_rate(sp, it)
+        new_p, new_s = updates.apply_update(
+            solver_type, params, grads, state, rate, it,
+            lr_mults=lr_mults, **hyper)
+        for k, v in stats.items():
+            new_p[k] = v
+        return new_p, new_s, loss
+
+    return single_step
+
+
 class Solver:
     def __init__(self, solver_param: SolverParameter, *,
                  net_param: Optional[NetParameter] = None,
