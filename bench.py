@@ -1,0 +1,82 @@
+"""Benchmark: AlexNet training throughput (img/s) on one chip.
+
+Baseline (BASELINE.md): the reference's headline number is CaffeNet/AlexNet
+training at ~267 img/s on a K40 with cuDNN (caffe/docs/performance_hardware.md:
+19-24, 26.5s / 20 iters x 256 imgs without cuDNN, 19.2s with).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import numpy as np
+
+BASELINE_IMGS_PER_SEC = 267.0  # K40 + cuDNN
+BATCH = 256
+WARMUP_STEPS = 3
+MEASURE_STEPS = 20  # the reference's own protocol: 20 iters of 256 imgs
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+
+    from sparknet_tpu.core.net import Net
+    from sparknet_tpu.proto import caffe_pb
+    from sparknet_tpu.solver.solver import make_single_step
+    from sparknet_tpu.solver import updates
+
+    net_param = caffe_pb.load_net_prototxt(
+        "/root/reference/caffe/models/bvlc_alexnet/train_val.prototxt")
+    net = Net(net_param, "TRAIN", batch_override=BATCH)
+    sp = caffe_pb.load_solver_prototxt(
+        "/root/reference/caffe/models/bvlc_alexnet/solver.prototxt")
+
+    params = net.init_params(seed=0)
+    state = updates.init_state(params, sp.resolved_type())
+    step = jax.jit(make_single_step(net, sp), donate_argnums=(0, 1))
+
+    rng = np.random.RandomState(0)
+    data = jnp.asarray(rng.rand(BATCH, 3, 227, 227).astype(np.float32))
+    label = jnp.asarray(rng.randint(0, 1000, size=(BATCH,)).astype(np.int32))
+    key = jax.random.PRNGKey(0)
+
+    it = [0]
+
+    def run_chain(n: int) -> float:
+        """Run n dependent steps and force materialization by fetching the
+        loss scalar.  Returns wall time including one fixed host<->device
+        fetch; the caller differences two chain lengths to cancel it
+        (block_until_ready alone is unreliable on tunneled platforms)."""
+        nonlocal params, state
+        t0 = time.perf_counter()
+        loss = None
+        for _ in range(n):
+            params, state, loss = step(params, state, jnp.int32(it[0]),
+                                       {"data": data, "label": label},
+                                       jax.random.fold_in(key, it[0]))
+            it[0] += 1
+        float(loss)
+        return time.perf_counter() - t0
+
+    run_chain(WARMUP_STEPS)  # compile + warm caches
+    short = run_chain(2)
+    long = run_chain(2 + MEASURE_STEPS)
+    dt = long - short  # fixed fetch latency cancels
+
+    imgs_per_sec = MEASURE_STEPS * BATCH / dt
+    print(json.dumps({
+        "metric": "alexnet_train_imgs_per_sec",
+        "value": round(imgs_per_sec, 1),
+        "unit": "img/s",
+        "vs_baseline": round(imgs_per_sec / BASELINE_IMGS_PER_SEC, 2),
+    }))
+
+
+if __name__ == "__main__":
+    main()

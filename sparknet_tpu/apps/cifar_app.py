@@ -1,0 +1,181 @@
+"""CifarApp: distributed CIFAR-10 training — the canonical entry point
+(reference: src/main/scala/apps/CifarApp.scala).
+
+Flow parity (CifarApp.scala:25-136): load CIFAR binaries -> partition across
+N workers -> per-round windowed minibatch sampling (τ=10) -> τ local SGD
+steps per worker -> weight average -> test every 10 rounds, logging accuracy
+with elapsed seconds.  The Spark broadcast/collect machinery is replaced by
+the one-program mesh round (parallel/dist.py).
+
+Usage:
+    python -m sparknet_tpu.apps.cifar_app NUM_WORKERS [--data DIR]
+        [--model quick|full] [--rounds N] [--synthetic]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import time
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from ..data import partition as part
+from ..data.cifar import CifarLoader
+from ..data.sampler import MinibatchSampler
+from ..parallel.dist import DistributedSolver
+from ..parallel.mesh import make_mesh
+from ..proto import caffe_pb
+from ..utils.logging import PhaseLogger
+
+# (reference: CifarApp.scala:15-22)
+TRAIN_BATCH_SIZE = 100
+TEST_BATCH_SIZE = 100
+CHANNELS, HEIGHT, WIDTH = 3, 32, 32
+SYNC_INTERVAL = 10          # τ (CifarApp.scala:119)
+TEST_EVERY_ROUNDS = 10      # (CifarApp.scala:101)
+
+REFERENCE_PROTO_DIR = "/root/reference/caffe/examples/cifar10"
+
+
+def synthetic_cifar(n_train=5000, n_test=1000, seed=0):
+    """Learnable stand-in when the real dataset is unavailable (zero-egress
+    environments): class = dominant color channel pattern + noise."""
+    rng = np.random.RandomState(seed)
+
+    def gen(n):
+        labels = rng.randint(0, 10, size=n).astype(np.int32)
+        base = rng.randint(0, 120, size=(n, 3, 32, 32))
+        # class-dependent signal: bright block whose position/channel encodes
+        # the label
+        for i in range(n):
+            c, r = labels[i] % 3, labels[i] // 3
+            base[i, c, 8 * r:8 * r + 8, :] += 120
+        return np.clip(base, 0, 255).astype(np.uint8), labels
+
+    tr = gen(n_train)
+    te = gen(n_test)
+    return tr[0], tr[1], te[0], te[1]
+
+
+def load_data(args) -> Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray,
+                             np.ndarray]:
+    if args.synthetic or not os.path.isdir(args.data):
+        xtr, ytr, xte, yte = synthetic_cifar()
+    else:
+        loader = CifarLoader(args.data)
+        xtr, ytr = loader.train_images, loader.train_labels
+        xte, yte = loader.test_images, loader.test_labels
+    mean = xtr.astype(np.float64).mean(axis=0).astype(np.float32)
+    return xtr, ytr, xte, yte, mean
+
+
+def build_solver(model: str, n_workers: int, tau: int, mesh=None,
+                 proto_dir: str = REFERENCE_PROTO_DIR,
+                 batch_size: int = TRAIN_BATCH_SIZE) -> DistributedSolver:
+    """ProtoLoader flow (CifarApp.scala:81-89): net prototxt ->
+    replaceDataLayers -> solver-with-inline-net -> instantiate."""
+    net = caffe_pb.load_net_prototxt(
+        os.path.join(proto_dir, f"cifar10_{model}_train_test.prototxt"))
+    net = caffe_pb.replace_data_layers(net, batch_size, batch_size,
+                                       CHANNELS, HEIGHT, WIDTH)
+    sp = caffe_pb.load_solver_prototxt_with_net(
+        os.path.join(proto_dir, f"cifar10_{model}_solver.prototxt"), net)
+    return DistributedSolver(sp, n_workers=n_workers, tau=tau, mesh=mesh)
+
+
+class WorkerFeed:
+    """Per-round windowed sampling over this worker's shard
+    (CifarApp.scala:120-130: a fresh MinibatchSampler per round)."""
+
+    def __init__(self, images, labels, mean, batch_size, tau, seed):
+        self.batches = part.make_minibatches(images, labels, batch_size)
+        self.mean = mean
+        self.tau = tau
+        self.rng = np.random.RandomState(seed)
+        self.sampler: Optional[MinibatchSampler] = None
+        self._served = 0
+
+    def new_round(self):
+        self.sampler = MinibatchSampler(
+            iter(self.batches), len(self.batches), self.tau,
+            seed=int(self.rng.randint(0, 2 ** 31)))
+        self._served = 0
+
+    def __call__(self):
+        if self.sampler is None or self._served >= self.tau:
+            self.new_round()
+        self._served += 1
+        b = self.sampler.next_batch()
+        return {"data": b["data"].astype(np.float32) - self.mean,
+                "label": b["label"]}
+
+
+def run(num_workers: int, *, model: str = "quick", rounds: int = 100,
+        data_dir: str = "", synthetic: bool = False,
+        log_path: Optional[str] = None, mesh=None,
+        target_accuracy: Optional[float] = None,
+        batch_size: int = TRAIN_BATCH_SIZE, tau: int = SYNC_INTERVAL,
+        ) -> float:
+    args = argparse.Namespace(data=data_dir, synthetic=synthetic)
+    log = PhaseLogger(log_path or
+                      f"/tmp/training_log_{int(time.time())}.txt")
+    log(f"rounds = {rounds}, workers = {num_workers}, model = {model}")
+
+    xtr, ytr, xte, yte, mean = load_data(args)
+    log("loaded data")
+    shards = part.partition(xtr, ytr, num_workers)
+    solver = build_solver(model, num_workers, tau, mesh=mesh,
+                          batch_size=batch_size)
+    log("built solver")
+
+    feeds = [WorkerFeed(x, y, mean, batch_size, tau, seed=w)
+             for w, (x, y) in enumerate(shards)]
+    solver.set_train_data(feeds)
+
+    test_batches = part.make_minibatches(xte, yte, batch_size)
+    num_test = len(test_batches)
+
+    def test_source():
+        test_source.i = (getattr(test_source, "i", -1) + 1) % num_test
+        x, y = test_batches[test_source.i]
+        return {"data": x.astype(np.float32) - mean, "label": y}
+
+    solver.set_test_data(test_source, num_test)
+
+    accuracy = 0.0
+    for r in range(rounds):
+        for f in feeds:
+            f.new_round()
+        if r % TEST_EVERY_ROUNDS == 0:
+            log("starting testing", i=r)
+            scores = solver.test()
+            accuracy = scores.get("accuracy", scores.get("acc", 0.0))
+            log(f"%-age of test set correct: {accuracy}", i=r)
+            if target_accuracy and accuracy >= target_accuracy:
+                log(f"target accuracy {target_accuracy} reached", i=r)
+                return accuracy
+        log("starting training", i=r)
+        loss = solver.run_round()
+        log(f"round loss = {loss}", i=r)
+    scores = solver.test()
+    accuracy = scores.get("accuracy", scores.get("acc", 0.0))
+    log(f"final %-age of test set correct: {accuracy}")
+    return accuracy
+
+
+def main() -> None:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("num_workers", type=int)
+    p.add_argument("--data", default="/root/data/cifar10")
+    p.add_argument("--model", default="quick", choices=["quick", "full"])
+    p.add_argument("--rounds", type=int, default=100)
+    p.add_argument("--synthetic", action="store_true")
+    a = p.parse_args()
+    run(a.num_workers, model=a.model, rounds=a.rounds, data_dir=a.data,
+        synthetic=a.synthetic)
+
+
+if __name__ == "__main__":
+    main()

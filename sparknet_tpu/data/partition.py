@@ -1,0 +1,30 @@
+"""Partitioning and minibatch grouping — the RDD-pipeline analogue
+(reference: src/main/scala/preprocessing/ScaleAndConvert.scala:45-91
+makeMinibatchRDD* groups partition elements into fixed-size minibatch arrays
+and DROPS the remainder; apps repartition/coalesce across workers,
+CifarApp.scala:50-68).
+"""
+
+from __future__ import annotations
+
+from typing import List, Sequence, Tuple
+
+import numpy as np
+
+
+def make_minibatches(images: np.ndarray, labels: np.ndarray, batch_size: int,
+                     ) -> List[Tuple[np.ndarray, np.ndarray]]:
+    """Group into full minibatches, dropping the remainder."""
+    n = (len(labels) // batch_size) * batch_size
+    out = []
+    for i in range(0, n, batch_size):
+        out.append((images[i:i + batch_size], labels[i:i + batch_size]))
+    return out
+
+
+def partition(images: np.ndarray, labels: np.ndarray, n_workers: int,
+              ) -> List[Tuple[np.ndarray, np.ndarray]]:
+    """Split a dataset into n contiguous worker shards (repartition analogue)."""
+    per = len(labels) // n_workers
+    return [(images[w * per:(w + 1) * per], labels[w * per:(w + 1) * per])
+            for w in range(n_workers)]

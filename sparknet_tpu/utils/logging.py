@@ -1,0 +1,32 @@
+"""Phase logging with elapsed seconds — same format as the reference's
+driver log (reference: CifarApp.scala:36-46 `log()` writing
+training_log_<start>.txt lines "<elapsed>: <message>"), kept identical for
+run-to-run comparability (SURVEY.md §5.1).
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+from typing import Optional, TextIO
+
+
+class PhaseLogger:
+    def __init__(self, path: Optional[str] = None, echo: bool = True) -> None:
+        self.start = time.time()
+        self.echo = echo
+        self._f: Optional[TextIO] = open(path, "a") if path else None
+
+    def __call__(self, message: str, i: int = -1) -> None:
+        elapsed = time.time() - self.start
+        prefix = f"iteration {i}: " if i >= 0 else ""
+        line = f"{elapsed:.2f}: {prefix}{message}"
+        if self._f:
+            self._f.write(line + "\n")
+            self._f.flush()
+        if self.echo:
+            print(line, file=sys.stderr)
+
+    def close(self) -> None:
+        if self._f:
+            self._f.close()

@@ -1,0 +1,46 @@
+"""Timers for the benchmark/profiling verb (reference:
+caffe/src/caffe/util/benchmark.cpp Timer/CPUTimer; `caffe time`
+tools/caffe.cpp:290-376).  Device work is asynchronous, so the device timer
+block-synchronizes on exit — the cudaEvent analogue."""
+
+from __future__ import annotations
+
+import time
+from typing import List, Optional
+
+import jax
+
+
+class CPUTimer:
+    def __init__(self) -> None:
+        self._t0: Optional[float] = None
+        self.millis = 0.0
+
+    def start(self) -> "CPUTimer":
+        self._t0 = time.perf_counter()
+        return self
+
+    def stop(self) -> float:
+        assert self._t0 is not None
+        self.millis = (time.perf_counter() - self._t0) * 1e3
+        self._t0 = None
+        return self.millis
+
+
+class DeviceTimer(CPUTimer):
+    """Wraps a computation returning jax arrays; stop() blocks until the
+    device work is done so wall-clock covers execution, not dispatch."""
+
+    def __init__(self) -> None:
+        super().__init__()
+        self._outputs: List[jax.Array] = []
+
+    def track(self, *outputs) -> None:
+        self._outputs.extend(o for o in jax.tree.leaves(outputs)
+                             if hasattr(o, "block_until_ready"))
+
+    def stop(self) -> float:
+        for o in self._outputs:
+            o.block_until_ready()
+        self._outputs = []
+        return super().stop()
