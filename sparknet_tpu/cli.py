@@ -1,0 +1,239 @@
+"""Command-line interface with the reference CLI's four verbs
+(reference: caffe/tools/caffe.cpp — train :153-217, test :219-288,
+time :290-376, device_query :139-151; brew-verb registry :55-70).
+
+    python -m sparknet_tpu.cli train --solver S.prototxt [--snapshot F.npz]
+        [--weights W.npz] [--data D] [--workers N] [--tau T]
+    python -m sparknet_tpu.cli test --model M.prototxt --weights W.npz
+        --data D [--iterations N]
+    python -m sparknet_tpu.cli time --model M.prototxt [--iterations N]
+    python -m sparknet_tpu.cli device_query
+
+Data sources (`--data`): a directory of CIFAR-10 binary batches, or an .npz
+with `data`/`label` arrays.  Nets with in-graph data layers are fed through
+the replace-data-layers path, as the reference apps do.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+from typing import Dict, Optional
+
+import numpy as np
+
+
+def _load_arrays(path: str, batch: int):
+    """Yield {data,label} batches forever from a CIFAR dir or an .npz."""
+    import os
+
+    from .data import partition as part
+    from .data.cifar import CifarLoader
+
+    if os.path.isdir(path):
+        loader = CifarLoader(path)
+        data, label = loader.train_images.astype(np.float32) - \
+            loader.mean_image, loader.train_labels
+    else:
+        z = np.load(path)
+        data, label = z["data"].astype(np.float32), z["label"]
+    batches = part.make_minibatches(data, label, batch)
+    i = [0]
+
+    def source():
+        b = batches[i[0] % len(batches)]
+        i[0] += 1
+        return {"data": b[0], "label": b[1]}
+
+    return source, len(batches)
+
+
+def cmd_train(args) -> int:
+    from .proto import caffe_pb
+    from .solver.solver import Solver
+    from .utils.signals import SignalHandler, parse_effect
+
+    sp = caffe_pb.load_solver_prototxt(args.solver)
+    net_path = str(sp.net or sp.train_net)
+    net = caffe_pb.load_net_prototxt(net_path) if net_path else None
+    if net is not None and args.data:
+        first = net.layers[0]
+        bs = args.batch or 100
+        c, h, w = (3, 32, 32)
+        net = caffe_pb.replace_data_layers(net, bs, bs, c, h, w)
+        sp = caffe_pb.load_solver_prototxt_with_net(args.solver, net)
+    solver = Solver(sp, net_param=net)
+    if args.weights:
+        solver.load_weights(args.weights)  # warm start (tools/caffe.cpp:169)
+    if args.snapshot:
+        solver.restore(args.snapshot)      # resume (tools/caffe.cpp:164)
+    handler = SignalHandler(parse_effect(args.sigint_effect),
+                            parse_effect(args.sighup_effect)).install()
+    solver.action_source = handler
+    source, _ = _load_arrays(args.data, args.batch or 100)
+    solver.set_train_data(source)
+    n = args.iterations or int(sp.max_iter) or 100
+    display = int(sp.display) or 50
+    done = 0
+    while done < n:
+        chunk = min(display, n - done)
+        loss = solver.step(chunk)
+        done = solver.iter
+        print(f"Iteration {solver.iter}, loss = {loss:.6f}")
+        if handler.get_requested_action().name == "STOP":
+            break
+    out = args.out or "trained.npz"
+    solver.save_weights(out)  # the .caffemodel analogue
+    print(f"Optimization Done. Snapshot written to {out}")
+    return 0
+
+
+def cmd_test(args) -> int:
+    from .proto import caffe_pb
+    from .solver.solver import Solver
+
+    net = caffe_pb.load_net_prototxt(args.model)
+    bs = args.batch or 100
+    net = caffe_pb.replace_data_layers(net, bs, bs, 3, 32, 32)
+    sp = caffe_pb.SolverParameter()
+    sp.msg.set("net_param", net.msg)
+    solver = Solver(sp)
+    if args.weights:
+        solver.load_weights(args.weights)
+    source, n_avail = _load_arrays(args.data, bs)
+    n = args.iterations or n_avail
+    solver.set_test_data(source, n)
+    scores = solver.test()
+    for k, v in scores.items():
+        print(f"{k} = {v:.6f}")
+    return 0
+
+
+def cmd_time(args) -> int:
+    """Per-layer forward timing + total forward/backward
+    (reference: tools/caffe.cpp:290-376 prints per-layer averages)."""
+    import jax
+    import jax.numpy as jnp
+
+    from .core.net import Net
+    from .proto import caffe_pb
+    from .utils.timers import CPUTimer
+
+    net_param = caffe_pb.load_net_prototxt(args.model)
+    has_inputs = bool(net_param.input_blobs)
+    if not has_inputs:
+        bs = args.batch or 16
+        net_param = caffe_pb.replace_data_layers(net_param, bs, bs, 3,
+                                                 args.size, args.size)
+    net = Net(net_param, "TRAIN")
+    params = net.init_params(0)
+    rng = np.random.RandomState(0)
+    inputs: Dict[str, jnp.ndarray] = {}
+    for b in net.input_blobs:
+        shape = net.blob_shapes[b]
+        if len(shape) == 1:
+            inputs[b] = jnp.asarray(rng.randint(0, 2, size=shape)
+                                    .astype(np.int32))
+        else:
+            inputs[b] = jnp.asarray(rng.rand(*shape).astype(np.float32))
+    key = jax.random.PRNGKey(0)
+    n = args.iterations or 10
+
+    # per-layer eager forward timing
+    print(f"Average time per layer ({n} iterations):")
+    blobs = dict(inputs)
+    for i, bl in enumerate(net.layers):
+        pvals = [params[k] for k in bl.param_keys]
+        bvals = [blobs[b] for b in bl.bottoms]
+        layer_rng = jax.random.fold_in(key, i) if bl.needs_rng else None
+        t = CPUTimer().start()
+        for _ in range(n):
+            tops, _ = bl.fn(pvals, bvals, layer_rng, True)
+            for tv in tops:
+                if hasattr(tv, "block_until_ready"):
+                    tv.block_until_ready()
+        ms = t.stop() / n
+        for tname, tv in zip(bl.tops, tops):
+            blobs[tname] = tv
+        print(f"  {bl.name:24s} forward: {ms:8.3f} ms")
+
+    # jitted end-to-end forward and forward+backward
+    def fwd(p, x, k):
+        bl, _ = net.apply(p, x, k, train=True)
+        return bl["loss"]
+
+    jf = jax.jit(fwd)
+    jg = jax.jit(jax.grad(fwd))
+    jf(params, inputs, key).block_until_ready()
+    t = CPUTimer().start()
+    for _ in range(n):
+        jf(params, inputs, key).block_until_ready()
+    print(f"Total forward (jit):          {t.stop() / n:8.3f} ms")
+    g = jg(params, inputs, key)
+    jax.tree.leaves(g)[0].block_until_ready()
+    t = CPUTimer().start()
+    for _ in range(n):
+        g = jg(params, inputs, key)
+        jax.tree.leaves(g)[0].block_until_ready()
+    print(f"Total forward-backward (jit): {t.stop() / n:8.3f} ms")
+    return 0
+
+
+def cmd_device_query(args) -> int:
+    """(reference: tools/caffe.cpp:139-151 prints per-GPU properties)"""
+    import jax
+
+    for d in jax.devices():
+        print(json.dumps({
+            "id": d.id, "platform": d.platform,
+            "device_kind": d.device_kind,
+            "process_index": d.process_index,
+            "memory_stats": getattr(d, "memory_stats", lambda: None)() or {},
+        }))
+    return 0
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="sparknet_tpu", description=__doc__)
+    sub = p.add_subparsers(dest="verb", required=True)
+
+    t = sub.add_parser("train")
+    t.add_argument("--solver", required=True)
+    t.add_argument("--data", required=True)
+    t.add_argument("--weights")
+    t.add_argument("--snapshot")
+    t.add_argument("--iterations", type=int)
+    t.add_argument("--batch", type=int)
+    t.add_argument("--out")
+    t.add_argument("--sigint_effect", default="stop",
+                   choices=["stop", "snapshot", "none"])
+    t.add_argument("--sighup_effect", default="snapshot",
+                   choices=["stop", "snapshot", "none"])
+    t.set_defaults(fn=cmd_train)
+
+    te = sub.add_parser("test")
+    te.add_argument("--model", required=True)
+    te.add_argument("--weights")
+    te.add_argument("--data", required=True)
+    te.add_argument("--iterations", type=int)
+    te.add_argument("--batch", type=int)
+    te.set_defaults(fn=cmd_test)
+
+    ti = sub.add_parser("time")
+    ti.add_argument("--model", required=True)
+    ti.add_argument("--iterations", type=int)
+    ti.add_argument("--batch", type=int)
+    ti.add_argument("--size", type=int, default=32)
+    ti.set_defaults(fn=cmd_time)
+
+    d = sub.add_parser("device_query")
+    d.set_defaults(fn=cmd_device_query)
+
+    args = p.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

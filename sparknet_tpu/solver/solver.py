@@ -101,6 +101,7 @@ class Solver:
         self.train_source: Optional[DataSource] = None
         self.test_source: Optional[DataSource] = None
         self._num_test_batches = 0
+        self.action_source = None  # optional utils.signals.SignalHandler
 
         self._lr_mults = self.net.lr_multipliers()
         self._decay_mults = self.net.decay_multipliers()
@@ -219,12 +220,23 @@ class Solver:
 
     def step(self, n: int) -> float:
         """Run n iterations (reference: Solver::Step, solver.cpp:193-288;
-        bridge: ccaffe.cpp:230-233 solver_step).  Returns last smoothed loss."""
+        bridge: ccaffe.cpp:230-233 solver_step).  Returns last smoothed loss.
+
+        Honors a registered SignalHandler once per iteration the way the
+        reference polls GetRequestedAction (solver.cpp:268-287)."""
         if self.train_source is None:
             raise RuntimeError("set_train_data first")
         iter_size = int(self.param.iter_size)
         smoothed = 0.0
         for _ in range(n):
+            if self.action_source is not None:
+                from ..utils.signals import SolverAction
+                action = self.action_source.get_requested_action()
+                if action is SolverAction.STOP:
+                    break
+                if action is SolverAction.SNAPSHOT:
+                    prefix = str(self.param.snapshot_prefix) or "/tmp/snapshot"
+                    self.snapshot(f"{prefix}_iter_{self.iter}.npz")
             pulls = [self._pull(self.train_source) for _ in range(iter_size)]
             stacked = {k: jnp.stack([p[k] for p in pulls])
                        for k in pulls[0]}
@@ -233,6 +245,10 @@ class Solver:
                 self.params, self.state, jnp.int32(self.iter), stacked, rng)
             smoothed = self._smooth_loss(float(loss))
             self.iter += 1
+            if (self.param.snapshot and self.iter % int(self.param.snapshot)
+                    == 0 and self.param.snapshot_prefix):
+                self.snapshot(f"{self.param.snapshot_prefix}"
+                              f"_iter_{self.iter}.npz")
         return smoothed
 
     def _smooth_loss(self, loss: float) -> float:

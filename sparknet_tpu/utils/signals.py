@@ -1,0 +1,54 @@
+"""Signal-driven solver actions (reference: caffe/src/caffe/util/
+signal_handler.cpp + Solver action polling, solver.cpp:268-287):
+SIGINT -> stop (default), SIGHUP -> snapshot-and-continue, both remappable
+the way the caffe CLI's --sigint_effect/--sighup_effect flags do
+(tools/caffe.cpp:130-151).
+"""
+
+from __future__ import annotations
+
+import enum
+import signal
+from typing import Callable, Optional
+
+
+class SolverAction(enum.Enum):
+    NONE = 0
+    STOP = 1
+    SNAPSHOT = 2
+
+
+class SignalHandler:
+    """Installs handlers and exposes a poll the training loop checks once per
+    iteration (the reference's GetRequestedAction contract)."""
+
+    def __init__(self, sigint_effect: SolverAction = SolverAction.STOP,
+                 sighup_effect: SolverAction = SolverAction.SNAPSHOT) -> None:
+        self._effects = {signal.SIGINT: sigint_effect,
+                         signal.SIGHUP: sighup_effect}
+        self._pending: Optional[SolverAction] = None
+        self._prev = {}
+
+    def install(self) -> "SignalHandler":
+        for sig, effect in self._effects.items():
+            if effect is SolverAction.NONE:
+                continue
+            self._prev[sig] = signal.signal(sig, self._on_signal)
+        return self
+
+    def uninstall(self) -> None:
+        for sig, prev in self._prev.items():
+            signal.signal(sig, prev)
+        self._prev = {}
+
+    def _on_signal(self, signum, frame) -> None:
+        self._pending = self._effects.get(signum, SolverAction.NONE)
+
+    def get_requested_action(self) -> SolverAction:
+        action, self._pending = self._pending or SolverAction.NONE, None
+        return action
+
+
+def parse_effect(name: str) -> SolverAction:
+    return {"stop": SolverAction.STOP, "snapshot": SolverAction.SNAPSHOT,
+            "none": SolverAction.NONE}[name]

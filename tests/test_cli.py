@@ -1,0 +1,82 @@
+"""CLI verb tests (reference: the `caffe` tool's brew verbs,
+tools/caffe.cpp:55-376) plus signal-handler behavior."""
+
+import os
+import signal
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from sparknet_tpu import cli
+from sparknet_tpu.utils.signals import SignalHandler, SolverAction
+from tests.conftest import reference_path
+
+
+@pytest.fixture
+def toy_npz(tmp_path):
+    rng = np.random.RandomState(0)
+    n = 64
+    data = rng.randn(n, 3, 32, 32).astype(np.float32)
+    label = rng.randint(0, 10, size=(n,)).astype(np.int32)
+    p = str(tmp_path / "toy.npz")
+    np.savez(p, data=data, label=label)
+    return p
+
+
+def test_device_query(capsys):
+    assert cli.main(["device_query"]) == 0
+    out = capsys.readouterr().out
+    assert '"platform"' in out
+
+
+def test_train_and_test_verbs(tmp_path, toy_npz, capsys):
+    solver = reference_path(
+        "caffe/examples/cifar10/cifar10_quick_solver.prototxt")
+    # the solver's net path points into the reference tree; patch a copy
+    text = open(solver).read().replace(
+        "examples/cifar10/cifar10_quick_train_test.prototxt",
+        reference_path(
+            "caffe/examples/cifar10/cifar10_quick_train_test.prototxt"))
+    sp = tmp_path / "solver.prototxt"
+    sp.write_text(text)
+    out = str(tmp_path / "weights.npz")
+    rc = cli.main(["train", "--solver", str(sp), "--data", toy_npz,
+                   "--iterations", "3", "--batch", "16", "--out", out])
+    assert rc == 0
+    assert os.path.exists(out)
+    assert "Optimization Done" in capsys.readouterr().out
+
+    rc = cli.main(["test", "--model",
+                   reference_path("caffe/examples/cifar10/"
+                                  "cifar10_quick_train_test.prototxt"),
+                   "--weights", out, "--data", toy_npz,
+                   "--iterations", "2", "--batch", "16"])
+    assert rc == 0
+    out_text = capsys.readouterr().out
+    assert "accuracy" in out_text and "loss" in out_text
+
+
+def test_time_verb(capsys):
+    rc = cli.main(["time", "--model",
+                   reference_path("caffe/examples/cifar10/"
+                                  "cifar10_quick_train_test.prototxt"),
+                   "--iterations", "2", "--batch", "4"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "conv1" in out
+    assert "Total forward-backward" in out
+
+
+def test_signal_handler_polling():
+    h = SignalHandler().install()
+    try:
+        assert h.get_requested_action() is SolverAction.NONE
+        os.kill(os.getpid(), signal.SIGHUP)
+        assert h.get_requested_action() is SolverAction.SNAPSHOT
+        assert h.get_requested_action() is SolverAction.NONE
+        os.kill(os.getpid(), signal.SIGINT)
+        assert h.get_requested_action() is SolverAction.STOP
+    finally:
+        h.uninstall()
