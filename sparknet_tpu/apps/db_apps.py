@@ -1,0 +1,125 @@
+"""DB-fed app variants (reference: src/main/scala/apps/CifarDBApp.scala,
+ImageNetCreateDBApp.scala, ImageNetRunDBApp.scala): one app materializes the
+preprocessed dataset into a store, the other trains from it — decoupling
+ingest from training exactly like the reference's LevelDB path.
+
+    python -m sparknet_tpu.apps.db_apps create --cifar DIR --out STORE
+    python -m sparknet_tpu.apps.db_apps create --shards DIR --labels F --out STORE
+    python -m sparknet_tpu.apps.db_apps run N --store STORE [--model quick]
+        [--warm-start W.npz]
+"""
+
+from __future__ import annotations
+
+import argparse
+from typing import Optional
+
+import numpy as np
+
+from ..data.cifar import CifarLoader
+from ..data.store import ArrayStoreCursor, ArrayStoreWriter
+from ..utils.logging import PhaseLogger
+from . import cifar_app
+
+
+def create_from_cifar(cifar_dir: str, out: str, txn_size: int = 1000) -> int:
+    """(reference: CifarDBApp's CreateDB pass / preprocessing/CreateDB.scala)"""
+    loader = CifarLoader(cifar_dir)
+    w = ArrayStoreWriter(out, txn_size=txn_size)
+    for img, label in zip(loader.train_images, loader.train_labels):
+        w.put(img, int(label))
+    w.close()
+    return len(loader.train_labels)
+
+
+def create_from_tars(shards_dir: str, label_file: str, out: str,
+                     height: int = 256, width: int = 256,
+                     txn_size: int = 1000) -> int:
+    """(reference: ImageNetCreateDBApp.scala — tar shards -> resize -> DB)"""
+    from ..data.imagenet import ImageNetLoader
+    from ..data.scale_convert import convert_stream
+
+    loader = ImageNetLoader(shards_dir)
+    labels = loader.load_label_map(label_file)
+    w = ArrayStoreWriter(out, txn_size=txn_size)
+    count = 0
+    for path in loader.get_file_paths():
+        for arr, label in convert_stream(loader.read_tar(path, labels),
+                                         height, width):
+            w.put(arr, label)
+            count += 1
+    w.close()
+    return count
+
+
+def run_from_store(num_workers: int, store: str, *, model: str = "quick",
+                   rounds: int = 50, batch_size: int = 100, tau: int = 10,
+                   warm_start: Optional[str] = None, mesh=None,
+                   log_path: Optional[str] = None) -> float:
+    """Train from a store (reference: ImageNetRunDBApp.scala — DB-fed
+    training with optional .caffemodel warm start at :75)."""
+    log = PhaseLogger(log_path)
+    solver = cifar_app.build_solver(model, num_workers, tau,
+                                    batch_size=batch_size, mesh=mesh)
+    if warm_start:
+        z = np.load(warm_start)
+        params0 = {k: z[k] for k in z.files}
+        weights = {}
+        import jax
+
+        flat = {k: jax.numpy.asarray(v) for k, v in params0.items()}
+        weights = solver.net.get_weights(flat)
+        solver.set_weights(weights)
+        log("warm-started from " + warm_start)
+    cursors = [ArrayStoreCursor(store) for _ in range(num_workers)]
+    # stagger cursors so workers see different data (partition analogue)
+    for w, c in enumerate(cursors):
+        skip = (len(c) // num_workers) * w
+        for _ in range(skip):
+            c.next()
+    feeds = []
+    for c in cursors:
+        it = c.batches(batch_size)
+
+        def feed(it=it):
+            b = next(it)
+            return {"data": b["data"].astype(np.float32), "label": b["label"]}
+
+        feeds.append(feed)
+    solver.set_train_data(feeds)
+    loss = 0.0
+    for r in range(rounds):
+        loss = solver.run_round()
+        log(f"round loss = {loss}", i=r)
+    return loss
+
+
+def main() -> None:
+    p = argparse.ArgumentParser(description=__doc__)
+    sub = p.add_subparsers(dest="verb", required=True)
+    c = sub.add_parser("create")
+    c.add_argument("--cifar")
+    c.add_argument("--shards")
+    c.add_argument("--labels")
+    c.add_argument("--out", required=True)
+    r = sub.add_parser("run")
+    r.add_argument("num_workers", type=int)
+    r.add_argument("--store", required=True)
+    r.add_argument("--model", default="quick")
+    r.add_argument("--rounds", type=int, default=50)
+    r.add_argument("--warm-start")
+    a = p.parse_args()
+    if a.verb == "create":
+        if a.cifar:
+            n = create_from_cifar(a.cifar, a.out)
+        else:
+            n = create_from_tars(a.shards, a.labels, a.out)
+        print(f"wrote {n} records to {a.out}")
+    else:
+        loss = run_from_store(a.num_workers, a.store, model=a.model,
+                              rounds=a.rounds, warm_start=a.warm_start)
+        print(f"final loss {loss}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,77 @@
+"""FeaturizerApp: forward-only feature extraction reading an intermediate
+blob (reference: src/main/scala/apps/FeaturizerApp.scala:88-103 — forwards
+minibatches through the net and reads blob `ip1` via getData).
+
+    python -m sparknet_tpu.apps.featurizer_app --model NET.prototxt
+        [--weights W.npz] --data D.npz --blob ip1 --out features.npz
+"""
+
+from __future__ import annotations
+
+import argparse
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ..core.net import Net
+from ..proto import caffe_pb
+
+
+def featurize(net_prototxt: str, data: np.ndarray, blob: str = "ip1", *,
+              weights_path: Optional[str] = None, batch_size: int = 100,
+              labels: Optional[np.ndarray] = None,
+              extra_shapes: Optional[Dict] = None) -> np.ndarray:
+    """Forward batches, collect `blob` activations
+    (reference: FeaturizerApp.scala:88-103; blob readback = the bridge's
+    getData path, Net.scala:174-192)."""
+    import jax
+    import jax.numpy as jnp
+
+    net_param = caffe_pb.load_net_prototxt(net_prototxt)
+    net_param = caffe_pb.replace_data_layers(
+        net_param, batch_size, batch_size, *data.shape[1:])
+    net = Net(net_param, "TEST", data_shapes=extra_shapes)
+    params = net.init_params(0)
+    if weights_path:
+        z = np.load(weights_path)
+        params = {k: jnp.asarray(z[k]) for k in z.files}
+    if blob not in net.blob_shapes:
+        raise ValueError(f"blob {blob!r} not in net; have "
+                         f"{sorted(net.blob_shapes)}")
+
+    @jax.jit
+    def fwd(p, x, y):
+        blobs, _ = net.apply(p, {"data": x, "label": y}, train=False)
+        return blobs[blob]
+
+    out: List[np.ndarray] = []
+    n = (len(data) // batch_size) * batch_size
+    if labels is None:
+        labels = np.zeros(len(data), dtype=np.int32)
+    for i in range(0, n, batch_size):
+        out.append(np.asarray(fwd(params,
+                                  jnp.asarray(data[i:i + batch_size],
+                                              dtype=jnp.float32),
+                                  jnp.asarray(labels[i:i + batch_size]))))
+    return np.concatenate(out) if out else np.zeros((0,))
+
+
+def main() -> None:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--model", required=True)
+    p.add_argument("--weights")
+    p.add_argument("--data", required=True)
+    p.add_argument("--blob", default="ip1")
+    p.add_argument("--batch", type=int, default=100)
+    p.add_argument("--out", default="features.npz")
+    a = p.parse_args()
+    z = np.load(a.data)
+    feats = featurize(a.model, z["data"], a.blob, weights_path=a.weights,
+                      batch_size=a.batch,
+                      labels=z["label"] if "label" in z.files else None)
+    np.savez(a.out, features=feats)
+    print(f"wrote {feats.shape} features to {a.out}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,170 @@
+"""ImageNetApp: distributed AlexNet/CaffeNet training from tar shards
+(reference: src/main/scala/apps/ImageNetApp.scala).
+
+Flow parity (:25-189): list shards -> per-worker shard assignment -> decode/
+resize to 256x256 -> mean image -> per-round sampling with train-time random
+227-crop + mean subtraction and test-time center crop (:124-138) -> τ=50
+local steps + weight averaging (:151) -> top-1 scoring.
+
+    python -m sparknet_tpu.apps.imagenet_app N --shards DIR --labels FILE
+        [--model alexnet|caffenet] [--synthetic]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import time
+from typing import List, Optional
+
+import numpy as np
+
+from ..data import partition as part
+from ..data.imagenet import ImageNetLoader, shard_paths_for_worker
+from ..data.transform import DataTransformer
+from ..parallel.dist import DistributedSolver
+from ..proto import caffe_pb
+from ..utils.logging import PhaseLogger
+
+# (reference: ImageNetApp.scala:20-26)
+TRAIN_BATCH_SIZE = 256
+TEST_BATCH_SIZE = 50
+FULL_HEIGHT, FULL_WIDTH = 256, 256
+CROPPED = 227
+SYNC_INTERVAL = 50  # τ (ImageNetApp.scala:151)
+
+MODEL_PROTO = {
+    "alexnet": "/root/reference/caffe/models/bvlc_alexnet",
+    "caffenet": "/root/reference/caffe/models/bvlc_reference_caffenet",
+    "googlenet": "/root/reference/caffe/models/bvlc_googlenet",
+}
+
+
+def build_solver(model: str, n_workers: int, tau: int, batch_size: int,
+                 test_batch: int, mesh=None, crop: int = CROPPED,
+                 ) -> DistributedSolver:
+    d = MODEL_PROTO[model]
+    net = caffe_pb.load_net_prototxt(os.path.join(d, "train_val.prototxt"))
+    net = caffe_pb.replace_data_layers(net, batch_size, test_batch, 3, crop,
+                                       crop)
+    sp = caffe_pb.load_solver_prototxt_with_net(
+        os.path.join(d, "solver.prototxt"), net)
+    return DistributedSolver(sp, n_workers=n_workers, tau=tau, mesh=mesh)
+
+
+class ShardFeed:
+    """Streams this worker's tar shards through decode -> transform; loops
+    forever (the reference re-runs partitions each round)."""
+
+    def __init__(self, loader: ImageNetLoader, shards: List[str],
+                 label_file: str, batch_size: int,
+                 transformer: DataTransformer) -> None:
+        self.loader = loader
+        self.shards = shards
+        self.label_file = label_file
+        self.batch_size = batch_size
+        self.transformer = transformer
+        self._it = None
+
+    def _fresh(self):
+        return self.loader.batches(self.label_file,
+                                   batch_size=self.batch_size,
+                                   height=FULL_HEIGHT, width=FULL_WIDTH,
+                                   shards=self.shards)
+
+    def __call__(self):
+        if self._it is None:
+            self._it = self._fresh()
+        try:
+            imgs, labels = next(self._it)
+        except StopIteration:
+            self._it = self._fresh()
+            imgs, labels = next(self._it)
+        return {"data": self.transformer(imgs), "label": labels}
+
+
+def synthetic_feed(batch_size: int, crop: int, n_classes: int = 1000,
+                   seed: int = 0):
+    rng = np.random.RandomState(seed)
+
+    def source():
+        return {"data": rng.rand(batch_size, 3, crop, crop)
+                .astype(np.float32),
+                "label": rng.randint(0, n_classes, size=(batch_size,))
+                .astype(np.int32)}
+
+    return source
+
+
+def run(num_workers: int, *, shards_dir: str = "", label_file: str = "",
+        model: str = "alexnet", rounds: int = 100, synthetic: bool = False,
+        batch_size: int = TRAIN_BATCH_SIZE, tau: int = SYNC_INTERVAL,
+        test_batch: int = TEST_BATCH_SIZE, mesh=None,
+        log_path: Optional[str] = None, crop: int = CROPPED,
+        test_every: int = 10) -> float:
+    log = PhaseLogger(log_path or
+                      f"/tmp/training_log_{int(time.time())}.txt")
+    log(f"workers = {num_workers}, model = {model}, tau = {tau}")
+    solver = build_solver(model, num_workers, tau, batch_size, test_batch,
+                          mesh=mesh, crop=crop)
+    log("built solver")
+
+    if synthetic or not shards_dir:
+        feeds = [synthetic_feed(batch_size, crop, seed=w)
+                 for w in range(num_workers)]
+        test_source = synthetic_feed(test_batch, crop, seed=999)
+        num_test = 2
+    else:
+        loader = ImageNetLoader(shards_dir)
+        paths = loader.get_file_paths()
+        # mean image over a sample (reference computes the full distributed
+        # mean, ImageNetApp.scala:95-105 / ComputeMean.scala)
+        from ..data.transform import compute_mean_image
+        sample = loader.batches(label_file, batch_size=batch_size,
+                                shards=paths[:1])
+        mean = compute_mean_image(b for b, _ in [next(sample)])
+        log("computed mean image")
+        train_tf = DataTransformer(crop_size=crop, mirror=True,
+                                   mean_image=mean, phase="TRAIN")
+        test_tf = DataTransformer(crop_size=crop, mean_image=mean,
+                                  phase="TEST")
+        feeds = [ShardFeed(loader, shard_paths_for_worker(paths, w,
+                                                          num_workers),
+                           label_file, batch_size, train_tf)
+                 for w in range(num_workers)]
+        test_source = ShardFeed(loader, paths, label_file, test_batch,
+                                test_tf)
+        num_test = 10
+    solver.set_train_data(feeds)
+    solver.set_test_data(test_source, num_test)
+
+    accuracy = 0.0
+    for r in range(rounds):
+        if r % test_every == 0:
+            scores = solver.test()
+            accuracy = scores.get("accuracy", 0.0)
+            log(f"%-age of test set correct: {accuracy}", i=r)
+        log("starting training", i=r)
+        loss = solver.run_round()
+        log(f"round loss = {loss}", i=r)
+    scores = solver.test()
+    accuracy = scores.get("accuracy", 0.0)
+    log(f"final %-age of test set correct: {accuracy}")
+    return accuracy
+
+
+def main() -> None:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("num_workers", type=int)
+    p.add_argument("--shards", default="")
+    p.add_argument("--labels", default="")
+    p.add_argument("--model", default="alexnet", choices=list(MODEL_PROTO))
+    p.add_argument("--rounds", type=int, default=100)
+    p.add_argument("--synthetic", action="store_true")
+    a = p.parse_args()
+    run(a.num_workers, shards_dir=a.shards, label_file=a.labels,
+        model=a.model, rounds=a.rounds, synthetic=a.synthetic)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,80 @@
+"""ImageNet tar-shard loader
+(reference: src/main/scala/loaders/ImageNetLoader.scala — S3 bucket listing
+:25-38, label-map file :41-54, tar un-archiving with label join :56-86;
+label files built by ec2/create_labelfile.py).
+
+The storage backend here is a local/NFS/GCS-fuse directory of .tar shards
+instead of S3; the shard-listing/label-join/decode pipeline is the same.
+Sharding across workers replaces Spark partitioning.
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+import tarfile
+from typing import Dict, Iterator, List, Optional, Tuple
+
+import numpy as np
+
+from .scale_convert import convert_stream, make_minibatch_stream
+
+
+class ImageNetLoader:
+    def __init__(self, shard_dir: str) -> None:
+        self.shard_dir = shard_dir
+
+    def get_file_paths(self, pattern: str = "*.tar") -> List[str]:
+        """(reference: getFilePathsRDD, ImageNetLoader.scala:25-38)"""
+        return sorted(glob.glob(os.path.join(self.shard_dir, pattern)))
+
+    @staticmethod
+    def load_label_map(path: str) -> Dict[str, int]:
+        """filename -> class index (reference: getLabels,
+        ImageNetLoader.scala:41-54; file format '<name> <label>')."""
+        out: Dict[str, int] = {}
+        with open(path) as f:
+            for line in f:
+                parts = line.split()
+                if len(parts) >= 2:
+                    out[parts[0]] = int(parts[1])
+        return out
+
+    @staticmethod
+    def read_tar(path: str, labels: Dict[str, int],
+                 ) -> Iterator[Tuple[bytes, int]]:
+        """Un-tar JPEGs, joining labels by entry basename
+        (reference: loadImagesFromTarFile, ImageNetLoader.scala:56-79)."""
+        with tarfile.open(path) as tf:
+            for member in tf:
+                if not member.isfile():
+                    continue
+                name = os.path.basename(member.name)
+                if name not in labels:
+                    continue
+                f = tf.extractfile(member)
+                if f is None:
+                    continue
+                yield f.read(), labels[name]
+
+    def batches(self, label_file: str, *, batch_size: int, height: int = 256,
+                width: int = 256, shards: Optional[List[str]] = None,
+                ) -> Iterator[Tuple[np.ndarray, np.ndarray]]:
+        """Full pipeline: shards -> decode/resize -> minibatches
+        (reference: apps/ImageNetApp.scala:55-79)."""
+        labels = self.load_label_map(label_file)
+        paths = shards if shards is not None else self.get_file_paths()
+
+        def stream():
+            for p in paths:
+                yield from self.read_tar(p, labels)
+
+        yield from make_minibatch_stream(
+            convert_stream(stream(), height, width), batch_size)
+
+
+def shard_paths_for_worker(paths: List[str], worker: int, n_workers: int,
+                           ) -> List[str]:
+    """Round-robin shard assignment (the coalesce-partitioning analogue,
+    ImageNetApp.scala:82)."""
+    return [p for i, p in enumerate(paths) if i % n_workers == worker]

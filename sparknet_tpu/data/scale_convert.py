@@ -1,0 +1,51 @@
+"""JPEG decode + resize + minibatch grouping
+(reference: src/main/scala/preprocessing/ScaleAndConvert.scala — ImageIO/
+twelvemonkeys decode + Thumbnails.forceSize resize at :16-27, corrupt images
+dropped; fixed-size minibatch grouping with remainder dropping at :45-91).
+"""
+
+from __future__ import annotations
+
+import io
+from typing import Iterable, Iterator, List, Optional, Tuple
+
+import numpy as np
+
+from .byte_image import ByteImage
+
+
+def decode_and_resize(jpeg_bytes: bytes, height: int, width: int,
+                      ) -> Optional[np.ndarray]:
+    """JPEG/PNG bytes -> (3, H, W) uint8, or None for corrupt images
+    (the reference drops them, ScaleAndConvert.scala:17-26)."""
+    try:
+        from PIL import Image
+
+        img = Image.open(io.BytesIO(jpeg_bytes))
+        img = img.convert("RGB").resize((width, height))
+        return np.transpose(np.asarray(img, dtype=np.uint8), (2, 0, 1))
+    except Exception:
+        return None
+
+
+def convert_stream(pairs: Iterable[Tuple[bytes, int]], height: int,
+                   width: int) -> Iterator[Tuple[np.ndarray, int]]:
+    for raw, label in pairs:
+        arr = decode_and_resize(raw, height, width)
+        if arr is not None:
+            yield arr, label
+
+
+def make_minibatch_stream(pairs: Iterable[Tuple[np.ndarray, int]],
+                          batch_size: int,
+                          ) -> Iterator[Tuple[np.ndarray, np.ndarray]]:
+    """Group into (images, labels) arrays of exactly batch_size, dropping the
+    remainder (ScaleAndConvert.scala:52-66)."""
+    imgs: List[np.ndarray] = []
+    labels: List[int] = []
+    for arr, label in pairs:
+        imgs.append(arr)
+        labels.append(label)
+        if len(imgs) == batch_size:
+            yield np.stack(imgs), np.asarray(labels, dtype=np.int32)
+            imgs, labels = [], []

@@ -1,0 +1,102 @@
+"""ArrayStore: the DB-path analogue (LevelDB/LMDB in the reference).
+
+Mirrors the bridge's DB API surface — create_db / write_to_db /
+commit_db_txn / close_db (reference: libccaffe/ccaffe.cpp:51-81, driven by
+src/main/scala/preprocessing/CreateDB.scala with 1000-row transactions) and
+the engine's cursor-style sequential reader (reference:
+caffe/src/caffe/util/db_lmdb.cpp, data_reader.cpp).
+
+Storage: a directory of .npz transaction shards plus an index file — dumb,
+portable, and fast enough to saturate a host feed thread; records are
+(image uint8 CHW, label) like Datum.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Iterator, List, Optional, Tuple
+
+import numpy as np
+
+
+class ArrayStoreWriter:
+    def __init__(self, path: str, txn_size: int = 1000) -> None:
+        """(reference: create_db + start txn, ccaffe.cpp:51-63)"""
+        os.makedirs(path, exist_ok=True)
+        self.path = path
+        self.txn_size = txn_size
+        self._images: List[np.ndarray] = []
+        self._labels: List[int] = []
+        self._n_txn = 0
+        self._count = 0
+
+    def put(self, image: np.ndarray, label: int) -> None:
+        """(reference: write_to_db, ccaffe.cpp:65-73; auto-commits full
+        transactions like CreateDB.scala's 1000-row batches)"""
+        self._images.append(np.asarray(image, dtype=np.uint8))
+        self._labels.append(int(label))
+        self._count += 1
+        if len(self._labels) >= self.txn_size:
+            self.commit()
+
+    def commit(self) -> None:
+        """(reference: commit_db_txn, ccaffe.cpp:75-77)"""
+        if not self._labels:
+            return
+        np.savez(os.path.join(self.path, f"txn_{self._n_txn:06d}.npz"),
+                 images=np.stack(self._images),
+                 labels=np.asarray(self._labels, dtype=np.int32))
+        self._n_txn += 1
+        self._images, self._labels = [], []
+
+    def close(self) -> None:
+        """(reference: close_db, ccaffe.cpp:79-81)"""
+        self.commit()
+        with open(os.path.join(self.path, "index.json"), "w") as f:
+            json.dump({"num_txns": self._n_txn, "count": self._count}, f)
+
+
+class ArrayStoreCursor:
+    """Sequential wrapping cursor (reference: db::Cursor used by DataLayer;
+    wraps to the first record at the end like data_layer.cpp)."""
+
+    def __init__(self, path: str) -> None:
+        self.path = path
+        with open(os.path.join(path, "index.json")) as f:
+            self.meta = json.load(f)
+        self._txn_files = sorted(
+            f for f in os.listdir(path) if f.startswith("txn_"))
+        self._txn_idx = 0
+        self._rec_idx = 0
+        self._cur: Optional[dict] = None
+
+    def __len__(self) -> int:
+        return int(self.meta["count"])
+
+    def _load(self) -> dict:
+        if self._cur is None:
+            z = np.load(os.path.join(self.path, self._txn_files[self._txn_idx]))
+            self._cur = {"images": z["images"], "labels": z["labels"]}
+        return self._cur
+
+    def next(self) -> Tuple[np.ndarray, int]:
+        cur = self._load()
+        img = cur["images"][self._rec_idx]
+        label = int(cur["labels"][self._rec_idx])
+        self._rec_idx += 1
+        if self._rec_idx >= len(cur["labels"]):
+            self._rec_idx = 0
+            self._txn_idx = (self._txn_idx + 1) % len(self._txn_files)
+            self._cur = None
+        return img, label
+
+    def batches(self, batch_size: int) -> Iterator[dict]:
+        while True:
+            imgs, labels = [], []
+            for _ in range(batch_size):
+                i, l = self.next()
+                imgs.append(i)
+                labels.append(l)
+            yield {"data": np.stack(imgs),
+                   "label": np.asarray(labels, dtype=np.int32)}

@@ -1,0 +1,73 @@
+"""Featurizer, ImageNet app, and DB-app tests (tiny shapes; 1-core box)."""
+
+import numpy as np
+import pytest
+
+from sparknet_tpu.apps import db_apps, featurizer_app, imagenet_app
+from sparknet_tpu.data.cifar import write_batch_file
+from sparknet_tpu.parallel.mesh import make_mesh
+from tests.conftest import reference_path
+
+
+def test_featurizer_reads_intermediate_blob():
+    """(reference: FeaturizerApp.scala:88-103 reads blob ip1; blob inventory
+    checked by CifarFeaturizationSpec.scala:87-103)"""
+    rng = np.random.RandomState(0)
+    data = rng.rand(8, 3, 32, 32).astype(np.float32)
+    feats = featurizer_app.featurize(
+        reference_path(
+            "caffe/examples/cifar10/cifar10_quick_train_test.prototxt"),
+        data, "ip1", batch_size=4)
+    assert feats.shape == (8, 64)
+    conv1 = featurizer_app.featurize(
+        reference_path(
+            "caffe/examples/cifar10/cifar10_quick_train_test.prototxt"),
+        data, "conv1", batch_size=4)
+    assert conv1.shape == (8, 32, 32, 32)
+
+
+def test_imagenet_app_synthetic_round():
+    """One τ-round of AlexNet on the mesh with tiny synthetic batches."""
+    acc = imagenet_app.run(2, synthetic=True, rounds=1, batch_size=2,
+                           tau=1, test_batch=2, mesh=make_mesh(2),
+                           test_every=100)
+    assert 0.0 <= acc <= 1.0
+
+
+def test_db_create_and_run(tmp_path):
+    rng = np.random.RandomState(0)
+    imgs = rng.randint(0, 256, size=(64, 3, 32, 32)).astype(np.uint8)
+    labels = rng.randint(0, 10, size=(64,))
+    cifar_dir = tmp_path / "cifar"
+    cifar_dir.mkdir()
+    write_batch_file(str(cifar_dir / "data_batch_1.bin"), imgs, labels)
+    store = str(tmp_path / "store")
+    n = db_apps.create_from_cifar(str(cifar_dir), store, txn_size=10)
+    assert n == 64
+    loss = db_apps.run_from_store(2, store, model="quick", rounds=2,
+                                  batch_size=8, tau=2, mesh=make_mesh(2),
+                                  log_path=str(tmp_path / "log.txt"))
+    assert np.isfinite(loss)
+
+
+def test_db_create_from_tars(tmp_path):
+    import io
+    import tarfile
+
+    from PIL import Image
+
+    rng = np.random.RandomState(0)
+    with tarfile.open(tmp_path / "s.tar", "w") as tf:
+        for i in range(4):
+            buf = io.BytesIO()
+            Image.fromarray(rng.randint(0, 255, (20, 20, 3))
+                            .astype(np.uint8)).save(buf, format="JPEG")
+            data = buf.getvalue()
+            info = tarfile.TarInfo(f"i{i}.jpg")
+            info.size = len(data)
+            tf.addfile(info, io.BytesIO(data))
+    (tmp_path / "labels.txt").write_text(
+        "\n".join(f"i{i}.jpg {i}" for i in range(4)))
+    n = db_apps.create_from_tars(str(tmp_path), str(tmp_path / "labels.txt"),
+                                 str(tmp_path / "db"), height=16, width=16)
+    assert n == 4

@@ -1,0 +1,202 @@
+"""Data-pipeline tests: loaders, sampler, transformer, store, tar shards —
+the analogue of NDArraySpec/MinibatchSamplerSpec/ImageNetLoaderSpec
+(src/test/scala/libs/, src/test/scala/loaders/)."""
+
+import io
+import os
+import tarfile
+
+import numpy as np
+import pytest
+
+from sparknet_tpu.data import partition as part
+from sparknet_tpu.data.byte_image import ByteImage, batch_crop
+from sparknet_tpu.data.cifar import (CifarLoader, read_batch_file,
+                                     write_batch_file)
+from sparknet_tpu.data.imagenet import ImageNetLoader, shard_paths_for_worker
+from sparknet_tpu.data.sampler import MinibatchSampler
+from sparknet_tpu.data.scale_convert import decode_and_resize
+from sparknet_tpu.data.store import ArrayStoreCursor, ArrayStoreWriter
+from sparknet_tpu.data.transform import DataTransformer, compute_mean_image
+
+
+# ------------------------------------------------------------------ sampler
+
+def make_batches(n):
+    return [(np.full((2, 1), i, dtype=np.uint8), np.array([i, i])) for i in
+            range(n)]
+
+
+def test_sampler_paired_alignment_either_order():
+    """(reference: MinibatchSamplerSpec.scala:12-27)"""
+    s = MinibatchSampler(iter(make_batches(10)), 10, 5, seed=0)
+    for _ in range(3):
+        imgs = s.next_image_minibatch()
+        labels = s.next_label_minibatch()
+        assert imgs[0, 0] == labels[0]
+    s2 = MinibatchSampler(iter(make_batches(10)), 10, 5, seed=0)
+    for _ in range(2):
+        labels = s2.next_label_minibatch()
+        imgs = s2.next_image_minibatch()
+        assert imgs[0, 0] == labels[0]
+
+
+def test_sampler_contiguous_window():
+    for seed in range(5):
+        s = MinibatchSampler(iter(make_batches(20)), 20, 5, seed=seed)
+        idx = s.indices
+        assert len(idx) == 5
+        assert idx == list(range(idx[0], idx[0] + 5))
+        assert 0 <= idx[0] <= 15
+        seen = [int(s.next_batch()["label"][0]) for _ in range(5)]
+        assert seen == idx
+
+
+# ------------------------------------------------------------------- cifar
+
+def test_cifar_roundtrip(tmp_path):
+    rng = np.random.RandomState(0)
+    imgs = rng.randint(0, 256, size=(30, 3, 32, 32)).astype(np.uint8)
+    labels = rng.randint(0, 10, size=(30,))
+    write_batch_file(str(tmp_path / "data_batch_1.bin"), imgs, labels)
+    write_batch_file(str(tmp_path / "test_batch.bin"), imgs[:10], labels[:10])
+    loader = CifarLoader(str(tmp_path))
+    assert loader.train_images.shape == (30, 3, 32, 32)
+    assert loader.test_images.shape == (10, 3, 32, 32)
+    # shuffled but same multiset
+    assert sorted(loader.train_labels) == sorted(labels)
+    assert loader.mean_image.shape == (3, 32, 32)
+    r_imgs, r_labels = read_batch_file(str(tmp_path / "test_batch.bin"))
+    np.testing.assert_array_equal(r_imgs, imgs[:10])
+
+
+# ---------------------------------------------------------------- transform
+
+def test_byte_image_crop():
+    rng = np.random.RandomState(0)
+    raw = rng.randint(0, 256, size=(3, 8, 8)).astype(np.uint8)
+    img = ByteImage(raw)
+    crop = img.crop_into((0, 2, 3), (3, 6, 7))
+    assert crop.shape == (3, 4, 4)
+    np.testing.assert_array_equal(crop, raw[:, 2:6, 3:7].astype(np.float32))
+    hwc = np.transpose(raw, (1, 2, 0))
+    img2 = ByteImage.from_hwc(hwc)
+    np.testing.assert_array_equal(img2.data, raw)
+
+
+def test_transformer_center_and_random_crop():
+    x = np.arange(2 * 3 * 8 * 8, dtype=np.uint8).reshape(2, 3, 8, 8)
+    t = DataTransformer(crop_size=4, phase="TEST")
+    y = t(x)
+    np.testing.assert_allclose(y, x[:, :, 2:6, 2:6].astype(np.float32))
+    tr = DataTransformer(crop_size=4, phase="TRAIN", mirror=True, seed=0)
+    y2 = tr(x)
+    assert y2.shape == (2, 3, 4, 4)
+    mean = np.ones((3, 8, 8), dtype=np.float32)
+    tm = DataTransformer(crop_size=4, phase="TEST", mean_image=mean,
+                         scale=0.5)
+    y3 = tm(x)
+    np.testing.assert_allclose(
+        y3, (x[:, :, 2:6, 2:6].astype(np.float32) - 1.0) * 0.5)
+    tv = DataTransformer(mean_values=[1.0, 2.0, 3.0])
+    y4 = tv(x)
+    np.testing.assert_allclose(
+        y4, x.astype(np.float32) -
+        np.array([1, 2, 3], np.float32).reshape(1, 3, 1, 1))
+
+
+def test_compute_mean_image():
+    batches = [np.full((4, 3, 2, 2), 10, np.uint8),
+               np.full((4, 3, 2, 2), 20, np.uint8)]
+    mean = compute_mean_image(batches)
+    np.testing.assert_allclose(mean, np.full((3, 2, 2), 15.0))
+
+
+def test_partition_and_minibatches():
+    imgs = np.arange(10)[:, None]
+    labels = np.arange(10)
+    mbs = part.make_minibatches(imgs, labels, 3)
+    assert len(mbs) == 3  # remainder dropped (ScaleAndConvert semantics)
+    shards = part.partition(imgs, labels, 3)
+    assert len(shards) == 3
+    assert all(len(s[1]) == 3 for s in shards)
+
+
+# ------------------------------------------------------------------- store
+
+def test_array_store_roundtrip(tmp_path):
+    w = ArrayStoreWriter(str(tmp_path / "db"), txn_size=7)
+    rng = np.random.RandomState(0)
+    imgs = rng.randint(0, 256, size=(20, 3, 4, 4)).astype(np.uint8)
+    for i in range(20):
+        w.put(imgs[i], i % 10)
+    w.close()
+    c = ArrayStoreCursor(str(tmp_path / "db"))
+    assert len(c) == 20
+    for i in range(20):
+        img, label = c.next()
+        np.testing.assert_array_equal(img, imgs[i])
+        assert label == i % 10
+    # wraps around
+    img, label = c.next()
+    np.testing.assert_array_equal(img, imgs[0])
+    b = next(ArrayStoreCursor(str(tmp_path / "db")).batches(6))
+    assert b["data"].shape == (6, 3, 4, 4)
+
+
+# ----------------------------------------------------------------- imagenet
+
+@pytest.fixture
+def tar_fixture(tmp_path):
+    """Two tar shards of synthetic JPEGs + a label file
+    (the ImageNetLoaderSpec scenario, minus S3)."""
+    from PIL import Image
+
+    rng = np.random.RandomState(0)
+    labels = {}
+    for shard in range(2):
+        tar_path = tmp_path / f"shard_{shard}.tar"
+        with tarfile.open(tar_path, "w") as tf:
+            for i in range(6):
+                name = f"img_{shard}_{i}.jpg"
+                arr = rng.randint(0, 256, size=(40, 50, 3)).astype(np.uint8)
+                buf = io.BytesIO()
+                Image.fromarray(arr).save(buf, format="JPEG")
+                data = buf.getvalue()
+                info = tarfile.TarInfo(name)
+                info.size = len(data)
+                tf.addfile(info, io.BytesIO(data))
+                labels[name] = (shard * 6 + i) % 5
+    label_file = tmp_path / "labels.txt"
+    label_file.write_text(
+        "\n".join(f"{k} {v}" for k, v in labels.items()))
+    return str(tmp_path), str(label_file), labels
+
+
+def test_imagenet_loader(tar_fixture):
+    shard_dir, label_file, labels = tar_fixture
+    loader = ImageNetLoader(shard_dir)
+    paths = loader.get_file_paths()
+    assert len(paths) == 2
+    batches = list(loader.batches(label_file, batch_size=4, height=32,
+                                  width=32))
+    # 12 images -> 3 full batches of 4
+    assert len(batches) == 3
+    imgs, lbls = batches[0]
+    assert imgs.shape == (4, 3, 32, 32)
+    assert imgs.dtype == np.uint8
+    assert set(lbls) <= set(range(5))
+    # worker sharding covers all shards exactly once
+    w0 = shard_paths_for_worker(paths, 0, 2)
+    w1 = shard_paths_for_worker(paths, 1, 2)
+    assert sorted(w0 + w1) == paths
+
+
+def test_decode_and_resize_corrupt():
+    assert decode_and_resize(b"not a jpeg", 8, 8) is None
+    from PIL import Image
+
+    buf = io.BytesIO()
+    Image.fromarray(np.zeros((5, 7, 3), np.uint8)).save(buf, format="PNG")
+    out = decode_and_resize(buf.getvalue(), 8, 9)
+    assert out.shape == (3, 8, 9)
