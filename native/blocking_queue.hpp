@@ -1,0 +1,73 @@
+// Bounded blocking queue — the native hand-off primitive of the data
+// pipeline (reference: caffe/src/caffe/util/blocking_queue.cpp; used as a
+// free/full buffer pair by BasePrefetchingDataLayer,
+// caffe/src/caffe/layers/base_data_layer.cpp:70-98).
+//
+// std::mutex/condition_variable replace the reference's boost::thread
+// machinery; semantics are identical (blocking push when bounded, blocking
+// pop, peek-free).
+#pragma once
+
+#include <condition_variable>
+#include <deque>
+#include <mutex>
+
+namespace sparknet {
+
+template <typename T>
+class BlockingQueue {
+ public:
+  explicit BlockingQueue(size_t capacity = 0) : capacity_(capacity) {}
+
+  void push(T value) {
+    std::unique_lock<std::mutex> lock(mutex_);
+    if (capacity_ > 0) {
+      not_full_.wait(lock, [&] { return queue_.size() < capacity_ || closed_; });
+    }
+    if (closed_) return;
+    queue_.push_back(std::move(value));
+    not_empty_.notify_one();
+  }
+
+  // Blocking pop; returns false if the queue was closed and drained.
+  bool pop(T* out) {
+    std::unique_lock<std::mutex> lock(mutex_);
+    not_empty_.wait(lock, [&] { return !queue_.empty() || closed_; });
+    if (queue_.empty()) return false;
+    *out = std::move(queue_.front());
+    queue_.pop_front();
+    not_full_.notify_one();
+    return true;
+  }
+
+  bool try_pop(T* out) {
+    std::lock_guard<std::mutex> lock(mutex_);
+    if (queue_.empty()) return false;
+    *out = std::move(queue_.front());
+    queue_.pop_front();
+    not_full_.notify_one();
+    return true;
+  }
+
+  void close() {
+    std::lock_guard<std::mutex> lock(mutex_);
+    closed_ = true;
+    not_empty_.notify_all();
+    not_full_.notify_all();
+  }
+
+  size_t size() const {
+    std::lock_guard<std::mutex> lock(mutex_);
+    return queue_.size();
+  }
+
+ private:
+  mutable std::mutex mutex_;
+  std::condition_variable not_empty_;
+  std::condition_variable not_full_;
+  std::deque<T> queue_;
+  size_t capacity_;
+  bool closed_ = false;
+};
+
+}  // namespace sparknet

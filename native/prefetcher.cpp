@@ -1,0 +1,212 @@
+// Native prefetching data loader: the TPU-host equivalent of the reference's
+// C++ data tier — single reading thread per source deduped like DataReader
+// (reference: caffe/src/caffe/data_reader.cpp:15-31), transform worker
+// threads (reference: caffe/src/caffe/data_transformer.cpp — crop, mirror,
+// mean subtract, scale), triple-buffered batch hand-off (reference:
+// caffe/src/caffe/layers/base_data_layer.cpp:70-98, PREFETCH_COUNT=3), and
+// context propagated at spawn (reference:
+// caffe/src/caffe/internal_thread.cpp:21-50).
+//
+// Record format: fixed-size [1 label byte][C*H*W image bytes] — the CIFAR-10
+// binary layout (reference: loaders/CifarLoader.scala:65-85), which the
+// ArrayStore/db tools can also emit for arbitrary shapes.
+//
+// Exposed as a flat C API for ctypes binding (the libccaffe role,
+// reference: libccaffe/ccaffe.h) — no Python objects cross the boundary,
+// only raw pointers, exactly like the JNA bridge.
+
+#include <atomic>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <random>
+#include <string>
+#include <thread>
+#include <vector>
+
+#include "blocking_queue.hpp"
+
+namespace sparknet {
+
+struct Record {
+  int label;
+  std::vector<uint8_t> pixels;  // C*H*W
+};
+
+struct Batch {
+  std::vector<float> images;  // batch*C*crop*crop
+  std::vector<int> labels;    // batch
+};
+
+struct LoaderConfig {
+  int channels, height, width;
+  int batch, crop;  // crop==0 -> no crop
+  bool mirror, train;
+  float scale;
+  std::vector<float> mean;  // full-size C*H*W mean image, may be empty
+  int num_threads, queue_depth;
+  uint64_t seed;
+};
+
+class Loader {
+ public:
+  Loader(std::vector<std::string> files, LoaderConfig cfg)
+      : files_(std::move(files)),
+        cfg_(cfg),
+        raw_queue_(static_cast<size_t>(cfg.queue_depth) * cfg.batch),
+        full_queue_(static_cast<size_t>(cfg.queue_depth)) {
+    reader_ = std::thread(&Loader::ReadLoop, this);
+    for (int i = 0; i < cfg_.num_threads; ++i) {
+      workers_.emplace_back(&Loader::TransformLoop, this, i);
+    }
+  }
+
+  ~Loader() {
+    stop_.store(true);
+    raw_queue_.close();
+    full_queue_.close();
+    if (reader_.joinable()) reader_.join();
+    for (auto& w : workers_) {
+      if (w.joinable()) w.join();
+    }
+  }
+
+  // Blocks until a batch is ready. Returns 0 on success, -1 if closed.
+  int Next(float* out_images, int* out_labels) {
+    Batch* b = nullptr;
+    if (!full_queue_.pop(&b)) return -1;
+    std::memcpy(out_images, b->images.data(),
+                b->images.size() * sizeof(float));
+    std::memcpy(out_labels, b->labels.data(), b->labels.size() * sizeof(int));
+    delete b;
+    return 0;
+  }
+
+ private:
+  // One reading thread per source, like DataReader's deduped single-reader
+  // bodies; loops over files forever (DB cursor wrap-around semantics).
+  void ReadLoop() {
+    const size_t rec_bytes =
+        1 + static_cast<size_t>(cfg_.channels) * cfg_.height * cfg_.width;
+    std::vector<uint8_t> buf(rec_bytes);
+    while (!stop_.load()) {
+      for (const auto& path : files_) {
+        FILE* f = std::fopen(path.c_str(), "rb");
+        if (!f) continue;
+        while (!stop_.load() &&
+               std::fread(buf.data(), 1, rec_bytes, f) == rec_bytes) {
+          Record* r = new Record;
+          r->label = buf[0];
+          r->pixels.assign(buf.begin() + 1, buf.end());
+          raw_queue_.push(r);
+          if (stop_.load()) { delete r; break; }
+        }
+        std::fclose(f);
+        if (stop_.load()) break;
+      }
+    }
+  }
+
+  // Transform workers: assemble batches; each worker owns its RNG seeded
+  // from (seed, worker index) — the InternalThread context-propagation idea.
+  void TransformLoop(int worker_id) {
+    std::mt19937_64 rng(cfg_.seed + 0x9e3779b9u * (worker_id + 1));
+    const int c = cfg_.channels, h = cfg_.height, w = cfg_.width;
+    const int crop = cfg_.crop > 0 ? cfg_.crop : 0;
+    const int oh = crop ? crop : h, ow = crop ? crop : w;
+    while (!stop_.load()) {
+      Batch* b = new Batch;
+      b->images.resize(static_cast<size_t>(cfg_.batch) * c * oh * ow);
+      b->labels.resize(cfg_.batch);
+      bool ok = true;
+      for (int i = 0; i < cfg_.batch; ++i) {
+        Record* r = nullptr;
+        if (!raw_queue_.pop(&r)) { ok = false; break; }
+        b->labels[i] = r->label;
+        int off_h = 0, off_w = 0;
+        if (crop) {
+          if (cfg_.train) {
+            off_h = static_cast<int>(rng() % (h - crop + 1));
+            off_w = static_cast<int>(rng() % (w - crop + 1));
+          } else {  // center crop (data_transformer.cpp test phase)
+            off_h = (h - crop) / 2;
+            off_w = (w - crop) / 2;
+          }
+        }
+        bool mirror = cfg_.mirror && cfg_.train && (rng() & 1);
+        float* dst = b->images.data() +
+                     static_cast<size_t>(i) * c * oh * ow;
+        const uint8_t* src = r->pixels.data();
+        const float* mean =
+            cfg_.mean.empty() ? nullptr : cfg_.mean.data();
+        for (int ch = 0; ch < c; ++ch) {
+          for (int y = 0; y < oh; ++y) {
+            for (int x = 0; x < ow; ++x) {
+              int sy = y + off_h;
+              int sx = mirror ? (w - 1 - (x + off_w)) : (x + off_w);
+              size_t sidx =
+                  (static_cast<size_t>(ch) * h + sy) * w + sx;
+              float v = static_cast<float>(src[sidx]);
+              if (mean) v -= mean[sidx];
+              dst[(static_cast<size_t>(ch) * oh + y) * ow + x] =
+                  v * cfg_.scale;
+            }
+          }
+        }
+        delete r;
+      }
+      if (!ok) { delete b; return; }
+      full_queue_.push(b);
+      if (stop_.load()) return;
+    }
+  }
+
+  std::vector<std::string> files_;
+  LoaderConfig cfg_;
+  BlockingQueue<Record*> raw_queue_;
+  BlockingQueue<Batch*> full_queue_;
+  std::thread reader_;
+  std::vector<std::thread> workers_;
+  std::atomic<bool> stop_{false};
+};
+
+}  // namespace sparknet
+
+extern "C" {
+
+// Flat C API (the libccaffe pattern: opaque state pointer + plain types,
+// reference: libccaffe/ccaffe.h:5-77).
+void* snt_loader_create(const char** files, int nfiles, int channels,
+                        int height, int width, int batch, int crop,
+                        int mirror, int train, const float* mean,
+                        float scale, int num_threads, int queue_depth,
+                        uint64_t seed) {
+  std::vector<std::string> fs(files, files + nfiles);
+  sparknet::LoaderConfig cfg;
+  cfg.channels = channels;
+  cfg.height = height;
+  cfg.width = width;
+  cfg.batch = batch;
+  cfg.crop = crop;
+  cfg.mirror = mirror != 0;
+  cfg.train = train != 0;
+  cfg.scale = scale;
+  if (mean) {
+    cfg.mean.assign(mean,
+                    mean + static_cast<size_t>(channels) * height * width);
+  }
+  cfg.num_threads = num_threads > 0 ? num_threads : 1;
+  cfg.queue_depth = queue_depth > 0 ? queue_depth : 3;  // PREFETCH_COUNT
+  cfg.seed = seed;
+  return new sparknet::Loader(std::move(fs), cfg);
+}
+
+int snt_loader_next(void* handle, float* out_images, int* out_labels) {
+  return static_cast<sparknet::Loader*>(handle)->Next(out_images, out_labels);
+}
+
+void snt_loader_destroy(void* handle) {
+  delete static_cast<sparknet::Loader*>(handle);
+}
+
+}  // extern "C"

@@ -61,9 +61,12 @@ class CifarLoader:
 
 def write_batch_file(path: str, images: np.ndarray, labels: np.ndarray,
                      ) -> None:
-    """Inverse of read_batch_file — used by tests and the DB-analogue tools."""
+    """Inverse of read_batch_file, generalized to any CHW record size
+    (1 label byte + C*H*W image bytes) — used by tests, the DB-analogue
+    tools, and the native prefetcher's record files."""
     n = len(labels)
-    recs = np.empty((n, RECORD_BYTES), dtype=np.uint8)
+    rec_bytes = 1 + int(np.prod(images.shape[1:]))
+    recs = np.empty((n, rec_bytes), dtype=np.uint8)
     recs[:, 0] = labels.astype(np.uint8)
     recs[:, 1:] = images.reshape(n, -1)
     recs.tofile(path)

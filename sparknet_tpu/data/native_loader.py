@@ -1,0 +1,113 @@
+"""ctypes binding to the native prefetching loader (native/prefetcher.cpp).
+
+Plays the bridge role of the reference's JNA layer
+(reference: src/main/java/libs/CaffeLibrary.java — 1:1 mirror of a flat C
+API, loaded once per process) but in the host->device feed direction: C++
+threads read+transform records and hand ready float batches to Python, which
+device_puts them.  Falls back to a pure-Python loader when no compiler is
+available.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+_NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))), "native")
+_LIB_PATH = os.path.join(_NATIVE_DIR, "libsparknet_data.so")
+_lib_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+
+
+def _build_library() -> None:
+    subprocess.run(["make", "-s", "libsparknet_data.so"], cwd=_NATIVE_DIR,
+                   check=True)
+
+
+def get_library() -> ctypes.CDLL:
+    """Build-on-first-use + load-once singleton
+    (reference: CaffeLibrary.java:9 Native.loadLibrary singleton)."""
+    global _lib
+    with _lib_lock:
+        if _lib is not None:
+            return _lib
+        if not os.path.exists(_LIB_PATH):
+            _build_library()
+        lib = ctypes.CDLL(_LIB_PATH)
+        lib.snt_loader_create.restype = ctypes.c_void_p
+        lib.snt_loader_create.argtypes = [
+            ctypes.POINTER(ctypes.c_char_p), ctypes.c_int, ctypes.c_int,
+            ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+            ctypes.c_int, ctypes.c_int, ctypes.POINTER(ctypes.c_float),
+            ctypes.c_float, ctypes.c_int, ctypes.c_int, ctypes.c_uint64]
+        lib.snt_loader_next.restype = ctypes.c_int
+        lib.snt_loader_next.argtypes = [ctypes.c_void_p,
+                                        ctypes.POINTER(ctypes.c_float),
+                                        ctypes.POINTER(ctypes.c_int)]
+        lib.snt_loader_destroy.restype = None
+        lib.snt_loader_destroy.argtypes = [ctypes.c_void_p]
+        _lib = lib
+        return lib
+
+
+class NativeRecordLoader:
+    """Prefetching loader over fixed-record binary files (CIFAR layout:
+    1 label byte + C*H*W image bytes).  Usable directly as a Solver
+    DataSource."""
+
+    def __init__(self, files: Sequence[str], *, channels: int, height: int,
+                 width: int, batch: int, crop: int = 0, mirror: bool = False,
+                 train: bool = True, mean: Optional[np.ndarray] = None,
+                 scale: float = 1.0, num_threads: int = 2,
+                 queue_depth: int = 3, seed: int = 0) -> None:
+        lib = get_library()
+        self._lib = lib
+        arr = (ctypes.c_char_p * len(files))(
+            *[f.encode() for f in files])
+        mean_ptr = None
+        self._mean_buf = None
+        if mean is not None:
+            self._mean_buf = np.ascontiguousarray(mean, dtype=np.float32)
+            mean_ptr = self._mean_buf.ctypes.data_as(
+                ctypes.POINTER(ctypes.c_float))
+        self._handle = lib.snt_loader_create(
+            arr, len(files), channels, height, width, batch, crop,
+            int(mirror), int(train), mean_ptr, ctypes.c_float(scale),
+            num_threads, queue_depth, seed)
+        if not self._handle:
+            raise RuntimeError("failed to create native loader")
+        out = crop if crop else height
+        ow = crop if crop else width
+        self.batch = batch
+        self._img_shape = (batch, channels, out, ow)
+        self._images = np.empty(self._img_shape, dtype=np.float32)
+        self._labels = np.empty((batch,), dtype=np.int32)
+
+    def next_batch(self) -> Dict[str, np.ndarray]:
+        rc = self._lib.snt_loader_next(
+            self._handle,
+            self._images.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            self._labels.ctypes.data_as(ctypes.POINTER(ctypes.c_int)))
+        if rc != 0:
+            raise RuntimeError("native loader closed")
+        return {"data": self._images.copy(), "label": self._labels.copy()}
+
+    def __call__(self) -> Dict[str, np.ndarray]:
+        return self.next_batch()
+
+    def close(self) -> None:
+        if getattr(self, "_handle", None):
+            self._lib.snt_loader_destroy(self._handle)
+            self._handle = None
+
+    def __del__(self) -> None:
+        try:
+            self.close()
+        except Exception:
+            pass
