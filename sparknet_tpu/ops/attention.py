@@ -1,0 +1,86 @@
+"""Attention ops: standard, and blockwise-streaming (online softmax).
+
+The reference has no attention anywhere (SURVEY.md §5.7: image CNNs only;
+RNNs were future work) — this module exists because long-context support is
+first-class in the TPU build.  The blockwise form is the building block of
+ring attention (parallel/ring_attention.py): it never materializes the full
+(S, S) score matrix, trading HBM for recompute exactly the way flash
+attention does, and XLA fuses each block's matmul chain onto the MXU.
+
+Shapes: (batch, heads, seq, head_dim) throughout.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e30
+
+
+def attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
+              causal: bool = False, scale: Optional[float] = None,
+              q_offset: int = 0, k_offset: int = 0) -> jax.Array:
+    """Reference (dense) softmax attention; offsets give global positions for
+    causal masking of sequence shards."""
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    scores = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
+    if causal:
+        qpos = jnp.arange(q.shape[2]) + q_offset
+        kpos = jnp.arange(k.shape[2]) + k_offset
+        mask = qpos[:, None] >= kpos[None, :]
+        scores = jnp.where(mask[None, None], scores, NEG_INF)
+    p = jax.nn.softmax(scores, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", p, v)
+
+
+def _block_update(carry, q, k, v, scale, mask):
+    """One online-softmax accumulation step (the flash-attention recurrence)."""
+    o, m, l = carry
+    scores = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
+    if mask is not None:
+        scores = jnp.where(mask, scores, NEG_INF)
+    m_new = jnp.maximum(m, scores.max(axis=-1))
+    corr = jnp.exp(m - m_new)
+    p = jnp.exp(scores - m_new[..., None])
+    l_new = l * corr + p.sum(axis=-1)
+    o_new = o * corr[..., None] + jnp.einsum("bhqk,bhkd->bhqd", p, v)
+    return (o_new, m_new, l_new)
+
+
+def blockwise_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
+                        block_size: int, causal: bool = False,
+                        scale: Optional[float] = None) -> jax.Array:
+    """Streaming attention over KV blocks; O(S·block) memory instead of O(S²)."""
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    b, h, s, d = q.shape
+    assert k.shape[2] % block_size == 0
+    n_blocks = k.shape[2] // block_size
+    kb = k.reshape(b, h, n_blocks, block_size, d)
+    vb = v.reshape(b, h, n_blocks, block_size, d)
+
+    o = jnp.zeros_like(q)
+    m = jnp.full((b, h, s), NEG_INF, dtype=q.dtype)
+    l = jnp.zeros((b, h, s), dtype=q.dtype)
+
+    qpos = jnp.arange(s)
+
+    def body(carry, xs):
+        kblk, vblk, blk_idx = xs
+        if causal:
+            kpos = blk_idx * block_size + jnp.arange(block_size)
+            mask = (qpos[:, None] >= kpos[None, :])[None, None]
+        else:
+            mask = None
+        return _block_update(carry, q, kblk, vblk, scale, mask), None
+
+    (o, m, l), _ = jax.lax.scan(
+        body, (o, m, l),
+        (jnp.moveaxis(kb, 2, 0), jnp.moveaxis(vb, 2, 0),
+         jnp.arange(n_blocks)))
+    return o / l[..., None]

@@ -1,0 +1,120 @@
+"""Sequence/context parallelism: ring attention and Ulysses-style
+all-to-all attention over a mesh axis.
+
+Absent from the reference by construction (SURVEY.md §5.7 — no attention, no
+sequence axis), but first-class here: these are the two standard ways to
+scale attention past one chip's HBM, and they shape the communication design
+(ICI neighbor exchange vs all-to-all).
+
+- `ring_attention`: each device owns a sequence shard of Q/K/V.  K/V blocks
+  rotate around the ring via `ppermute` while each device streams them into
+  an online-softmax accumulator (ops/attention.py).  n_devices steps, each
+  overlapping a neighbor ICI transfer with a block of MXU work; the full
+  (S, S) score matrix never exists anywhere.
+- `ulysses_attention`: `all_to_all` re-shards from sequence-sharded to
+  head-sharded, runs dense local attention per head group, and re-shards
+  back.  Cheaper collectives for moderate S, requires heads % devices == 0.
+
+Both run inside shard_map; `sequence_parallel_attention` is the user-facing
+wrapper that builds the mesh plumbing.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..ops.attention import NEG_INF, _block_update
+
+SEQ_AXIS = "seq"
+
+
+def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
+                   axis_name: str, causal: bool = False,
+                   scale: Optional[float] = None) -> jax.Array:
+    """Call INSIDE shard_map.  q/k/v: this device's sequence shard
+    (B, H, S_local, D); returns the local shard of the attention output."""
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    n = jax.lax.axis_size(axis_name)
+    idx = jax.lax.axis_index(axis_name)
+    b, h, s_local, d = q.shape
+
+    o = jnp.zeros_like(q)
+    m = jnp.full((b, h, s_local), NEG_INF, dtype=q.dtype)
+    l = jnp.zeros((b, h, s_local), dtype=q.dtype)
+
+    qpos = idx * s_local + jnp.arange(s_local)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def body(r, state):
+        o, m, l, k_cur, v_cur = state
+        # the block now on this device originated on device (idx - r) mod n
+        src = (idx - r) % n
+        kpos = src * s_local + jnp.arange(s_local)
+        if causal:
+            mask = (qpos[:, None] >= kpos[None, :])[None, None]
+        else:
+            mask = None
+        o, m, l = _block_update((o, m, l), q, k_cur, v_cur, scale, mask)
+        k_nxt = jax.lax.ppermute(k_cur, axis_name, perm)
+        v_nxt = jax.lax.ppermute(v_cur, axis_name, perm)
+        return (o, m, l, k_nxt, v_nxt)
+
+    state = (o, m, l, k, v)
+    state = jax.lax.fori_loop(0, n, body, state)
+    o, m, l = state[0], state[1], state[2]
+    # fully-masked rows (can't happen with causal self-attention over aligned
+    # shards, but guard anyway): l == 0 -> output 0
+    safe_l = jnp.where(l == 0, 1.0, l)
+    return o / safe_l[..., None]
+
+
+def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
+                      axis_name: str, causal: bool = False,
+                      scale: Optional[float] = None) -> jax.Array:
+    """Call INSIDE shard_map.  all_to_all: (B, H, S/n, D) -> (B, H/n, S, D),
+    dense attention on full sequences for this device's head group, inverse
+    all_to_all back to sequence sharding."""
+    from ..ops.attention import attention
+
+    def to_heads(x):
+        # split heads across devices, gather sequence
+        return jax.lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2,
+                                  tiled=True)
+
+    def to_seq(x):
+        return jax.lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1,
+                                  tiled=True)
+
+    qh, kh, vh = to_heads(q), to_heads(k), to_heads(v)
+    oh = attention(qh, kh, vh, causal=causal, scale=scale)
+    return to_seq(oh)
+
+
+def sequence_parallel_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
+                                mesh: Optional[Mesh] = None,
+                                n_devices: Optional[int] = None,
+                                causal: bool = False,
+                                method: str = "ring") -> jax.Array:
+    """User-facing wrapper: shards (B, H, S, D) inputs over a sequence mesh
+    axis and runs ring or ulysses attention as one compiled program."""
+    if mesh is None:
+        devs = jax.devices()
+        n = n_devices or len(devs)
+        mesh = Mesh(devs[:n], (SEQ_AXIS,))
+    fn = ring_attention if method == "ring" else ulysses_attention
+    spec = P(None, None, SEQ_AXIS, None)
+
+    @functools.partial(
+        shard_map, mesh=mesh, in_specs=(spec, spec, spec),
+        out_specs=spec, check_vma=False)
+    def run(q, k, v):
+        return fn(q, k, v, axis_name=SEQ_AXIS, causal=causal)
+
+    return run(q, k, v)
