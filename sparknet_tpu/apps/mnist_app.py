@@ -1,0 +1,119 @@
+"""LeNet on MNIST built through the programmatic DSL — the reference's
+"Scala NetParam DSL" config (reference: src/test/scala/libs/LayerSpec.scala:
+20-35 builds LeNet via the DSL; examples/mnist/lenet_solver.prototxt drives
+training).
+
+    python -m sparknet_tpu.apps.mnist_app [--data DIR] [--iterations N]
+        [--synthetic]
+"""
+
+from __future__ import annotations
+
+import argparse
+from typing import Optional
+
+import numpy as np
+
+from ..core import layers_dsl as dsl
+from ..data.mnist import load_mnist
+from ..data import partition as part
+from ..proto import caffe_pb
+from ..solver.solver import Solver
+from ..utils.logging import PhaseLogger
+
+BATCH = 64
+
+
+def lenet(batch: int = BATCH) -> "caffe_pb.NetParameter":
+    """LeNet via the DSL (mirrors examples/mnist/lenet_train_test.prototxt)."""
+    return dsl.net_param(
+        "LeNet",
+        dsl.memory_data_layer("mnist", ["data", "label"], batch=batch,
+                              channels=1, height=28, width=28),
+        dsl.convolution_layer("conv1", "data", num_output=20, kernel_size=5,
+                              weight_filler="xavier"),
+        dsl.pooling_layer("pool1", "conv1", pool="MAX", kernel_size=2,
+                          stride=2),
+        dsl.convolution_layer("conv2", "pool1", num_output=50, kernel_size=5,
+                              weight_filler="xavier"),
+        dsl.pooling_layer("pool2", "conv2", pool="MAX", kernel_size=2,
+                          stride=2),
+        dsl.inner_product_layer("ip1", "pool2", num_output=500,
+                                weight_filler="xavier"),
+        dsl.relu_layer("relu1", "ip1"),
+        dsl.inner_product_layer("ip2", "ip1", num_output=10,
+                                weight_filler="xavier"),
+        dsl.softmax_with_loss_layer("loss", ["ip2", "label"]),
+        dsl.accuracy_layer("accuracy", ["ip2", "label"], phase="TEST"),
+    )
+
+
+def lenet_solver() -> "caffe_pb.SolverParameter":
+    """(mirrors examples/mnist/lenet_solver.prototxt)"""
+    return dsl.solver_param(base_lr=0.01, lr_policy="inv", momentum=0.9,
+                            weight_decay=0.0005, max_iter=10000,
+                            solver_type="SGD", random_seed=1,
+                            gamma=0.0001, power=0.75)
+
+
+def synthetic_mnist(n=2000, seed=0):
+    rng = np.random.RandomState(seed)
+    labels = rng.randint(0, 10, size=n).astype(np.int32)
+    imgs = rng.randint(0, 50, size=(n, 1, 28, 28))
+    for i in range(n):
+        r = labels[i]
+        imgs[i, 0, 2 * r:2 * r + 3, :] += 180
+    return np.clip(imgs, 0, 255).astype(np.uint8), labels
+
+
+def run(*, data_dir: str = "", iterations: int = 1000, batch: int = BATCH,
+        synthetic: bool = False, log_path: Optional[str] = None) -> float:
+    log = PhaseLogger(log_path)
+    if synthetic or not data_dir:
+        xtr, ytr = synthetic_mnist()
+        xte, yte = synthetic_mnist(500, seed=9)
+    else:
+        xtr, ytr = load_mnist(data_dir, "train")
+        xte, yte = load_mnist(data_dir, "test")
+    solver = Solver(lenet_solver(), net_param=lenet(batch))
+    train = part.make_minibatches(xtr.astype(np.float32) / 256.0, ytr, batch)
+    test = part.make_minibatches(xte.astype(np.float32) / 256.0, yte, batch)
+    i = [0]
+
+    def train_src():
+        b = train[i[0] % len(train)]
+        i[0] += 1
+        return {"data": b[0], "label": b[1]}
+
+    j = [0]
+
+    def test_src():
+        b = test[j[0] % len(test)]
+        j[0] += 1
+        return {"data": b[0], "label": b[1]}
+
+    solver.set_train_data(train_src)
+    solver.set_test_data(test_src, len(test))
+    done = 0
+    while done < iterations:
+        chunk = min(100, iterations - done)
+        loss = solver.step(chunk)
+        done = solver.iter
+        log(f"loss = {loss}", i=done)
+    scores = solver.test()
+    log(f"test accuracy = {scores.get('accuracy')}")
+    return float(scores.get("accuracy", 0.0))
+
+
+def main() -> None:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--data", default="")
+    p.add_argument("--iterations", type=int, default=1000)
+    p.add_argument("--synthetic", action="store_true")
+    a = p.parse_args()
+    acc = run(data_dir=a.data, iterations=a.iterations, synthetic=a.synthetic)
+    print(f"final accuracy: {acc}")
+
+
+if __name__ == "__main__":
+    main()

@@ -12,8 +12,7 @@ Shapes: (batch, heads, seq, head_dim) throughout.
 
 from __future__ import annotations
 
-import functools
-from typing import Optional, Tuple
+from typing import Optional
 
 import jax
 import jax.numpy as jnp
@@ -25,7 +24,9 @@ def attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
               causal: bool = False, scale: Optional[float] = None,
               q_offset: int = 0, k_offset: int = 0) -> jax.Array:
     """Reference (dense) softmax attention; offsets give global positions for
-    causal masking of sequence shards."""
+    causal masking of sequence shards.  Fully-masked query rows (possible
+    when a key shard lies entirely in a query shard's future) produce zeros,
+    not a uniform average."""
     if scale is None:
         scale = q.shape[-1] ** -0.5
     scores = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
@@ -34,19 +35,30 @@ def attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
         kpos = jnp.arange(k.shape[2]) + k_offset
         mask = qpos[:, None] >= kpos[None, :]
         scores = jnp.where(mask[None, None], scores, NEG_INF)
-    p = jax.nn.softmax(scores, axis=-1)
+    m = scores.max(axis=-1, keepdims=True)
+    m_safe = jnp.where(m <= NEG_INF / 2, 0.0, m)
+    p = jnp.exp(scores - m_safe)  # masked entries underflow to exactly 0
+    denom = p.sum(axis=-1, keepdims=True)
+    p = p / jnp.maximum(denom, 1e-30)
     return jnp.einsum("bhqk,bhkd->bhqd", p, v)
 
 
 def _block_update(carry, q, k, v, scale, mask):
-    """One online-softmax accumulation step (the flash-attention recurrence)."""
+    """One online-softmax accumulation step (the flash-attention recurrence).
+
+    Robust to fully-masked blocks: while a row has seen no valid key, m stays
+    at NEG_INF and (corr, p) are arranged so l remains exactly 0 — the caller
+    can then map l == 0 rows to zero output."""
     o, m, l = carry
     scores = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
     if mask is not None:
         scores = jnp.where(mask, scores, NEG_INF)
     m_new = jnp.maximum(m, scores.max(axis=-1))
+    # exp(-1e30 - -1e30) would be 1 and pollute l; subtract a zeroed max for
+    # still-all-masked rows so every masked p underflows to 0 instead
+    m_safe = jnp.where(m_new <= NEG_INF / 2, 0.0, m_new)
     corr = jnp.exp(m - m_new)
-    p = jnp.exp(scores - m_new[..., None])
+    p = jnp.exp(scores - m_safe[..., None])
     l_new = l * corr + p.sum(axis=-1)
     o_new = o * corr[..., None] + jnp.einsum("bhqk,bhkd->bhqd", p, v)
     return (o_new, m_new, l_new)
@@ -83,4 +95,5 @@ def blockwise_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
         body, (o, m, l),
         (jnp.moveaxis(kb, 2, 0), jnp.moveaxis(vb, 2, 0),
          jnp.arange(n_blocks)))
-    return o / l[..., None]
+    # l == 0 <=> the row never saw a valid key (see _block_update) -> zeros
+    return o / jnp.where(l == 0, 1.0, l)[..., None]

@@ -27,7 +27,7 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 from jax import shard_map
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh, PartitionSpec as P
 
 from ..ops.attention import NEG_INF, _block_update
 
@@ -69,10 +69,9 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
     state = (o, m, l, k, v)
     state = jax.lax.fori_loop(0, n, body, state)
     o, m, l = state[0], state[1], state[2]
-    # fully-masked rows (can't happen with causal self-attention over aligned
-    # shards, but guard anyway): l == 0 -> output 0
-    safe_l = jnp.where(l == 0, 1.0, l)
-    return o / safe_l[..., None]
+    # l == 0 <=> the row never saw a valid key (guaranteed by _block_update's
+    # masked-block handling) -> zero output, never an average of masked keys
+    return o / jnp.where(l == 0, 1.0, l)[..., None]
 
 
 def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
@@ -101,6 +100,7 @@ def sequence_parallel_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
                                 mesh: Optional[Mesh] = None,
                                 n_devices: Optional[int] = None,
                                 causal: bool = False,
+                                scale: Optional[float] = None,
                                 method: str = "ring") -> jax.Array:
     """User-facing wrapper: shards (B, H, S, D) inputs over a sequence mesh
     axis and runs ring or ulysses attention as one compiled program."""
@@ -108,6 +108,13 @@ def sequence_parallel_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
         devs = jax.devices()
         n = n_devices or len(devs)
         mesh = Mesh(devs[:n], (SEQ_AXIS,))
+    n = mesh.shape[SEQ_AXIS]
+    if q.shape[2] % n:
+        raise ValueError(f"sequence length {q.shape[2]} not divisible by "
+                         f"{n} devices")
+    if method == "ulysses" and q.shape[1] % n:
+        raise ValueError(f"ulysses needs heads ({q.shape[1]}) divisible by "
+                         f"devices ({n}); use method='ring'")
     fn = ring_attention if method == "ring" else ulysses_attention
     spec = P(None, None, SEQ_AXIS, None)
 
@@ -115,6 +122,6 @@ def sequence_parallel_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
         shard_map, mesh=mesh, in_specs=(spec, spec, spec),
         out_specs=spec, check_vma=False)
     def run(q, k, v):
-        return fn(q, k, v, axis_name=SEQ_AXIS, causal=causal)
+        return fn(q, k, v, axis_name=SEQ_AXIS, causal=causal, scale=scale)
 
     return run(q, k, v)

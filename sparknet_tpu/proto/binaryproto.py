@@ -1,0 +1,223 @@
+"""Protobuf *binary wire format* for weight interchange with the reference.
+
+Covers the subset needed for (a) `.caffemodel` import/export — warm-starting
+from nets trained by the reference and exporting back (reference:
+Net::CopyTrainedLayersFromBinaryProto caffe/src/caffe/net.cpp:805-830,
+bridge load/save ccaffe.cpp:261-269) — and (b) mean-image `.binaryproto`
+files (reference: preprocessing/ComputeMean.scala:78-85 writing through
+ccaffe, DataTransformer reading them).
+
+Field numbers (reference: caffe/src/caffe/proto/caffe.proto):
+  NetParameter: name=1, layers(V1)=2, layer=100
+  LayerParameter: name=1, type=2, blobs=7
+  V1LayerParameter: bottom=2, top=3, name=4, type(enum)=5, blobs=6
+  BlobProto: num=1, channels=2, height=3, width=4, data=5 (packed float),
+             diff=6, shape=7
+  BlobShape: dim=1 (packed int64)
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import Dict, Iterator, List, Optional, Tuple
+
+import numpy as np
+
+# ----------------------------------------------------------------- wire I/O
+
+
+def _read_varint(buf: bytes, pos: int) -> Tuple[int, int]:
+    result = 0
+    shift = 0
+    while True:
+        b = buf[pos]
+        pos += 1
+        result |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return result, pos
+        shift += 7
+
+
+def _write_varint(out: bytearray, value: int) -> None:
+    while True:
+        b = value & 0x7F
+        value >>= 7
+        if value:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return
+
+
+def iter_fields(buf: bytes) -> Iterator[Tuple[int, int, object]]:
+    """Yield (field_number, wire_type, value) over a message's bytes."""
+    pos = 0
+    n = len(buf)
+    while pos < n:
+        tag, pos = _read_varint(buf, pos)
+        field, wt = tag >> 3, tag & 7
+        if wt == 0:
+            val, pos = _read_varint(buf, pos)
+        elif wt == 1:
+            val = buf[pos:pos + 8]
+            pos += 8
+        elif wt == 2:
+            ln, pos = _read_varint(buf, pos)
+            val = buf[pos:pos + ln]
+            pos += ln
+        elif wt == 5:
+            val = buf[pos:pos + 4]
+            pos += 4
+        else:
+            raise ValueError(f"unsupported wire type {wt}")
+        yield field, wt, val
+
+
+def _packed_floats(chunks: List[object], unpacked: List[object]) -> np.ndarray:
+    parts = []
+    for c in chunks:
+        parts.append(np.frombuffer(c, dtype="<f4"))
+    for u in unpacked:
+        parts.append(np.asarray([struct.unpack("<f", u)[0]], dtype=np.float32))
+    if not parts:
+        return np.zeros((0,), dtype=np.float32)
+    return np.concatenate(parts)
+
+
+# ---------------------------------------------------------------- BlobProto
+
+
+def parse_blob(buf: bytes) -> np.ndarray:
+    """BlobProto -> float32 array with its recorded shape (modern `shape` or
+    legacy 4-d num/channels/height/width, blob.cpp:450-480 semantics)."""
+    data_chunks: List[object] = []
+    data_single: List[object] = []
+    legacy = {}
+    shape: Optional[List[int]] = None
+    for field, wt, val in iter_fields(buf):
+        if field == 5:
+            (data_chunks if wt == 2 else data_single).append(val)
+        elif field == 7 and wt == 2:
+            dims = []
+            for f2, wt2, v2 in iter_fields(val):  # BlobShape
+                if f2 == 1:
+                    if wt2 == 2:
+                        pos = 0
+                        while pos < len(v2):
+                            d, pos = _read_varint(v2, pos)
+                            dims.append(d)
+                    else:
+                        dims.append(int(v2))
+            shape = dims
+        elif field in (1, 2, 3, 4) and wt == 0:
+            legacy[field] = int(val)
+    data = _packed_floats(data_chunks, data_single)
+    if shape is None and legacy:
+        shape = [legacy.get(1, 1), legacy.get(2, 1), legacy.get(3, 1),
+                 legacy.get(4, 1)]
+    if shape:
+        data = data.reshape(shape)
+    return data
+
+
+def write_blob(arr: np.ndarray) -> bytes:
+    """float32 array -> BlobProto bytes (modern shape + packed data)."""
+    arr = np.asarray(arr, dtype=np.float32)
+    out = bytearray()
+    # shape (field 7): BlobShape with packed dims (field 1)
+    dims = bytearray()
+    packed = bytearray()
+    for d in arr.shape:
+        _write_varint(packed, int(d))
+    _write_varint(dims, (1 << 3) | 2)
+    _write_varint(dims, len(packed))
+    dims += packed
+    _write_varint(out, (7 << 3) | 2)
+    _write_varint(out, len(dims))
+    out += dims
+    # data (field 5, packed floats)
+    raw = arr.astype("<f4").tobytes()
+    _write_varint(out, (5 << 3) | 2)
+    _write_varint(out, len(raw))
+    out += raw
+    return bytes(out)
+
+
+def read_mean_binaryproto(path: str) -> np.ndarray:
+    """mean.binaryproto -> (C, H, W) float32 (squeezes the legacy num dim)."""
+    with open(path, "rb") as f:
+        arr = parse_blob(f.read())
+    if arr.ndim == 4 and arr.shape[0] == 1:
+        arr = arr[0]
+    return arr
+
+
+def write_mean_binaryproto(path: str, mean: np.ndarray) -> None:
+    """(reference: ccaffe.cpp:83-97 write_mean_image — legacy 4-d blob)"""
+    mean = np.asarray(mean, dtype=np.float32)
+    if mean.ndim == 3:
+        mean = mean[None]
+    with open(path, "wb") as f:
+        f.write(write_blob(mean))
+
+
+# -------------------------------------------------------------- .caffemodel
+
+
+def _layer_name_and_blobs(buf: bytes, name_field: int, blobs_field: int,
+                          ) -> Tuple[str, List[np.ndarray]]:
+    name = ""
+    blobs: List[np.ndarray] = []
+    for field, wt, val in iter_fields(buf):
+        if field == name_field and wt == 2:
+            name = val.decode("utf-8", "replace")
+        elif field == blobs_field and wt == 2:
+            blobs.append(parse_blob(val))
+    return name, blobs
+
+
+def read_caffemodel(path: str) -> Dict[str, List[np.ndarray]]:
+    """Binary NetParameter -> {layer_name: [blob arrays]} — directly
+    compatible with Net.set_weights / Solver.set_weights (the
+    WeightCollection layout)."""
+    with open(path, "rb") as f:
+        buf = f.read()
+    out: Dict[str, List[np.ndarray]] = {}
+    for field, wt, val in iter_fields(buf):
+        if field == 100 and wt == 2:          # modern LayerParameter
+            name, blobs = _layer_name_and_blobs(val, 1, 7)
+        elif field == 2 and wt == 2:          # V1LayerParameter
+            name, blobs = _layer_name_and_blobs(val, 4, 6)
+        else:
+            continue
+        if name and blobs:
+            out[name] = blobs
+    return out
+
+
+def write_caffemodel(path: str, weights: Dict[str, List[np.ndarray]],
+                     net_name: str = "sparknet_tpu") -> None:
+    """{layer: [blobs]} -> binary NetParameter loadable by the reference's
+    CopyTrainedLayersFromBinaryProto (layer name + blobs only, which is all
+    that weight copying reads, net.cpp:805-830)."""
+    out = bytearray()
+    nb = net_name.encode()
+    _write_varint(out, (1 << 3) | 2)
+    _write_varint(out, len(nb))
+    out += nb
+    for name, blobs in weights.items():
+        layer = bytearray()
+        enc = name.encode()
+        _write_varint(layer, (1 << 3) | 2)
+        _write_varint(layer, len(enc))
+        layer += enc
+        for blob in blobs:
+            bb = write_blob(blob)
+            _write_varint(layer, (7 << 3) | 2)
+            _write_varint(layer, len(bb))
+            layer += bb
+        _write_varint(out, (100 << 3) | 2)
+        _write_varint(out, len(layer))
+        out += layer
+    with open(path, "wb") as f:
+        f.write(bytes(out))

@@ -327,3 +327,17 @@ class Solver:
         """(reference: ccaffe.h:69 load_weights_from_file)"""
         data = np.load(path if path.endswith(".npz") else path + ".npz")
         self.params = {k: jnp.asarray(data[k]) for k in data.files}
+
+    def load_caffemodel(self, path: str) -> None:
+        """Warm start from a reference-trained binary NetParameter
+        (reference: Net::CopyTrainedLayersFromBinaryProto, net.cpp:805-830;
+        app usage ImageNetRunDBApp.scala:75)."""
+        from ..proto.binaryproto import read_caffemodel
+
+        self.set_weights(read_caffemodel(path))
+
+    def save_caffemodel(self, path: str) -> None:
+        """Export weights in the reference's .caffemodel format."""
+        from ..proto.binaryproto import write_caffemodel
+
+        write_caffemodel(path, self.get_weights())

@@ -1,0 +1,86 @@
+"""NDArray: strided float tensor with views — API parity with the
+reference's JVM tensor (reference: src/main/scala/libs/NDArray.scala +
+src/main/java/libs/JavaNDArray.java: slice/subarray views :30-40, get/set,
+flatten :59-75, elementwise math :84-126).
+
+numpy is the actual representation (views map to numpy views), so this is a
+thin veneer — it exists so code and tests written against the reference API
+surface port over mechanically, and its semantics (views alias the parent's
+storage) match JavaNDArray's offset/stride views.
+"""
+
+from __future__ import annotations
+
+from typing import List, Sequence
+
+import numpy as np
+
+
+class NDArray:
+    def __init__(self, data, shape: Sequence[int] | None = None) -> None:
+        arr = np.asarray(data, dtype=np.float32)
+        if shape is not None:
+            arr = arr.reshape(tuple(shape))
+        self._arr = arr
+
+    @classmethod
+    def zeros(cls, shape: Sequence[int]) -> "NDArray":
+        return cls(np.zeros(tuple(shape), dtype=np.float32))
+
+    @property
+    def shape(self) -> tuple:
+        return self._arr.shape
+
+    def get(self, *indices: int) -> float:
+        return float(self._arr[tuple(indices)])
+
+    def set(self, *indices_and_value) -> None:
+        *idx, value = indices_and_value
+        self._arr[tuple(int(i) for i in idx)] = value
+
+    def slice(self, axis: int, index: int) -> "NDArray":
+        """Aliasing view dropping `axis` (JavaNDArray.java:30-35)."""
+        out = NDArray.__new__(NDArray)
+        out._arr = np.squeeze(
+            self._arr[(slice(None),) * axis + (slice(index, index + 1),)],
+            axis=axis)
+        return out
+
+    def subarray(self, lower: Sequence[int], upper: Sequence[int],
+                 ) -> "NDArray":
+        """Aliasing rectangular view (JavaNDArray.java:36-40)."""
+        out = NDArray.__new__(NDArray)
+        out._arr = self._arr[tuple(slice(int(l), int(u))
+                                   for l, u in zip(lower, upper))]
+        return out
+
+    def flatten(self) -> np.ndarray:
+        """Copy-out in row-major order (JavaNDArray.java:59-75)."""
+        return np.ascontiguousarray(self._arr).reshape(-1).copy()
+
+    def to_flat(self, out: np.ndarray) -> None:
+        out[:] = self._arr.reshape(-1)
+
+    def add(self, other: "NDArray") -> None:
+        """In-place += (JavaNDArray.java:84-98)."""
+        assert self.shape == other.shape
+        self._arr += other._arr
+
+    def subtract(self, other: "NDArray") -> None:
+        assert self.shape == other.shape
+        self._arr -= other._arr
+
+    def scalar_divide(self, v: float) -> None:
+        self._arr /= v
+
+    def copy(self) -> "NDArray":
+        return NDArray(self._arr.copy())
+
+    def __eq__(self, other: object) -> bool:
+        if not isinstance(other, NDArray):
+            return NotImplemented
+        return self.shape == other.shape and bool(
+            np.array_equal(self._arr, other._arr))
+
+    def numpy(self) -> np.ndarray:
+        return self._arr

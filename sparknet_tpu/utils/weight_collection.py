@@ -1,0 +1,88 @@
+"""WeightCollection + WorkerStore: API-parity containers.
+
+WeightCollection (reference: src/main/scala/libs/Net.scala:14-47) is the
+entire "optimizer" of the reference's distributed level: a serializable map
+layer-name -> list of weight arrays with `add` (shape-checked elementwise
+sum) and `scalar_divide` — driver-side averaging.  In the TPU build the
+averaging normally happens on-device as a pmean (parallel/dist.py), but the
+host-side container remains useful for checkpoint surgery, interchange, and
+reproducing the reference's driver loop literally.
+
+WorkerStore (reference: src/main/scala/libs/WorkerStore.scala:5-25) is the
+per-executor singleton keeping nets/state alive across tasks.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List
+
+import numpy as np
+
+
+class WeightCollection:
+    def __init__(self, weights: Dict[str, List[np.ndarray]]) -> None:
+        self.weights = {k: [np.asarray(a, dtype=np.float32) for a in v]
+                        for k, v in weights.items()}
+
+    def scalar_divide(self, v: float) -> "WeightCollection":
+        """In-place, like the reference (Net.scala:17-23)."""
+        for blobs in self.weights.values():
+            for b in blobs:
+                b /= v
+        return self
+
+    @staticmethod
+    def add(a: "WeightCollection", b: "WeightCollection",
+            ) -> "WeightCollection":
+        """Shape-checked elementwise sum (Net.scala:27-46)."""
+        assert set(a.weights) == set(b.weights), "layer sets differ"
+        out: Dict[str, List[np.ndarray]] = {}
+        for name in a.weights:
+            xa, xb = a.weights[name], b.weights[name]
+            assert len(xa) == len(xb), f"blob counts differ for {name}"
+            blobs = []
+            for u, w in zip(xa, xb):
+                assert u.shape == w.shape, \
+                    f"shape mismatch for {name}: {u.shape} vs {w.shape}"
+                blobs.append(u + w)
+            out[name] = blobs
+        return WeightCollection(out)
+
+    @staticmethod
+    def mean(collections: List["WeightCollection"]) -> "WeightCollection":
+        """The driver-side average (CifarApp.scala:133-134)."""
+        acc = collections[0]
+        for c in collections[1:]:
+            acc = WeightCollection.add(acc, c)
+        return acc.scalar_divide(len(collections))
+
+    def __eq__(self, other: object) -> bool:
+        if not isinstance(other, WeightCollection):
+            return NotImplemented
+        if set(self.weights) != set(other.weights):
+            return False
+        return all(
+            len(a) == len(b) and all(np.array_equal(x, y)
+                                     for x, y in zip(a, b))
+            for a, b in ((self.weights[k], other.weights[k])
+                         for k in self.weights))
+
+
+class WorkerStore:
+    """Name -> object map living for the process (reference:
+    WorkerStore.scala — setNet/getNet/setLib/getLib generalized)."""
+
+    def __init__(self) -> None:
+        self._store: Dict[str, Any] = {}
+
+    def set(self, name: str, value: Any) -> None:
+        self._store[name] = value
+
+    def get(self, name: str) -> Any:
+        return self._store[name]
+
+    def __contains__(self, name: str) -> bool:
+        return name in self._store
+
+
+worker_store = WorkerStore()  # process-level singleton, as in the reference

@@ -71,3 +71,10 @@ def test_db_create_from_tars(tmp_path):
     n = db_apps.create_from_tars(str(tmp_path), str(tmp_path / "labels.txt"),
                                  str(tmp_path / "db"), height=16, width=16)
     assert n == 4
+
+
+def test_mnist_dsl_app():
+    from sparknet_tpu.apps import mnist_app
+
+    acc = mnist_app.run(synthetic=True, iterations=60, batch=16)
+    assert acc > 0.5  # synthetic rule is easy; chance is 0.10

@@ -1,0 +1,81 @@
+"""Binary wire-format tests: blob/mean/caffemodel round-trips and warm start."""
+
+import numpy as np
+import pytest
+
+from sparknet_tpu.core import layers_dsl as dsl
+from sparknet_tpu.proto import binaryproto as bp
+from sparknet_tpu.proto import caffe_pb
+from sparknet_tpu.proto.textformat import parse
+from sparknet_tpu.solver.solver import Solver
+
+
+def test_blob_roundtrip(rng):
+    arr = rng.randn(4, 3, 5, 5).astype(np.float32)
+    back = bp.parse_blob(bp.write_blob(arr))
+    np.testing.assert_array_equal(back, arr)
+    scalar = np.float32([1.5, -2.5])
+    np.testing.assert_array_equal(bp.parse_blob(bp.write_blob(scalar)),
+                                  scalar)
+
+
+def test_mean_binaryproto_roundtrip(tmp_path, rng):
+    mean = rng.rand(3, 32, 32).astype(np.float32)
+    p = str(tmp_path / "mean.binaryproto")
+    bp.write_mean_binaryproto(p, mean)
+    back = bp.read_mean_binaryproto(p)
+    np.testing.assert_allclose(back, mean)
+
+
+def test_caffemodel_roundtrip(tmp_path, rng):
+    weights = {
+        "conv1": [rng.randn(32, 3, 5, 5).astype(np.float32),
+                  rng.randn(32).astype(np.float32)],
+        "ip1": [rng.randn(10, 64).astype(np.float32),
+                rng.randn(10).astype(np.float32)],
+    }
+    p = str(tmp_path / "model.caffemodel")
+    bp.write_caffemodel(p, weights)
+    back = bp.read_caffemodel(p)
+    assert set(back) == set(weights)
+    for k in weights:
+        for a, b in zip(weights[k], back[k]):
+            np.testing.assert_array_equal(a, b)
+
+
+def test_legacy_4d_blob(rng):
+    """A blob written with legacy num/channels/height/width fields parses."""
+    import struct
+
+    arr = rng.randn(2, 3, 4, 5).astype(np.float32)
+    out = bytearray()
+    for field, v in ((1, 2), (2, 3), (3, 4), (4, 5)):
+        bp._write_varint(out, (field << 3) | 0)
+        bp._write_varint(out, v)
+    raw = arr.astype("<f4").tobytes()
+    bp._write_varint(out, (5 << 3) | 2)
+    bp._write_varint(out, len(raw))
+    out += raw
+    back = bp.parse_blob(bytes(out))
+    np.testing.assert_array_equal(back, arr)
+
+
+def test_solver_warm_start_from_caffemodel(tmp_path):
+    net = dsl.net_param(
+        "toy",
+        dsl.memory_data_layer("data", ["data", "label"], batch=4, channels=1,
+                              height=4, width=4),
+        dsl.inner_product_layer("ip1", "data", num_output=3),
+        dsl.softmax_with_loss_layer("loss", ["ip1", "label"]),
+    )
+    sp = caffe_pb.SolverParameter(parse(
+        "base_lr: 0.1 lr_policy: 'fixed' random_seed: 1"))
+    a = Solver(sp, net_param=net)
+    p = str(tmp_path / "w.caffemodel")
+    a.save_caffemodel(p)
+    b = Solver(caffe_pb.SolverParameter(parse(
+        "base_lr: 0.1 lr_policy: 'fixed' random_seed: 2")), net_param=net)
+    b.load_caffemodel(p)
+    for k in a.params:
+        np.testing.assert_array_equal(np.asarray(a.params[k]),
+                                      np.asarray(b.params[k]))

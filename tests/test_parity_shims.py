@@ -1,0 +1,66 @@
+"""Parity-shim tests mirroring the reference's NDArraySpec and
+WeightCollectionSpec (src/test/scala/libs/)."""
+
+import numpy as np
+import pytest
+
+from sparknet_tpu.utils.ndarray import NDArray
+from sparknet_tpu.utils.weight_collection import (WeightCollection,
+                                                  WorkerStore)
+
+
+def test_ndarray_get_set_flatten():
+    a = NDArray.zeros((2, 3, 4))
+    a.set(1, 2, 3, 5.0)
+    assert a.get(1, 2, 3) == 5.0
+    flat = a.flatten()
+    assert flat.shape == (24,)
+    assert flat[23] == 5.0
+
+
+def test_ndarray_views_alias():
+    """(reference: NDArraySpec — slice/subarray are views)"""
+    a = NDArray(np.arange(24).reshape(2, 3, 4))
+    s = a.slice(0, 1)
+    assert s.shape == (3, 4)
+    assert s.get(0, 0) == 12.0
+    s.set(0, 0, -1.0)
+    assert a.get(1, 0, 0) == -1.0  # view aliases parent
+    sub = a.subarray((0, 1, 1), (2, 3, 3))
+    assert sub.shape == (2, 2, 2)
+    assert sub.get(0, 0, 0) == a.get(0, 1, 1)
+
+
+def test_ndarray_math():
+    a = NDArray(np.ones((2, 2)))
+    b = NDArray(np.full((2, 2), 3.0))
+    a.add(b)
+    np.testing.assert_allclose(a.numpy(), 4.0)
+    a.subtract(b)
+    np.testing.assert_allclose(a.numpy(), 1.0)
+    a.scalar_divide(2.0)
+    np.testing.assert_allclose(a.numpy(), 0.5)
+
+
+def test_weight_collection_add_and_mean():
+    w1 = WeightCollection({"l": [np.ones((2, 2)), np.zeros(3)]})
+    w2 = WeightCollection({"l": [np.full((2, 2), 3.0), np.ones(3)]})
+    s = WeightCollection.add(w1, w2)
+    np.testing.assert_allclose(s.weights["l"][0], 4.0)
+    m = WeightCollection.mean([w1, w2])
+    np.testing.assert_allclose(m.weights["l"][0], 2.0)
+    np.testing.assert_allclose(m.weights["l"][1], 0.5)
+
+
+def test_weight_collection_shape_check():
+    w1 = WeightCollection({"l": [np.ones((2, 2))]})
+    w2 = WeightCollection({"l": [np.ones((3, 2))]})
+    with pytest.raises(AssertionError):
+        WeightCollection.add(w1, w2)
+
+
+def test_worker_store():
+    ws = WorkerStore()
+    ws.set("net", object())
+    assert "net" in ws
+    assert ws.get("net") is not None
