@@ -134,6 +134,7 @@ class Net:
         self.blob_shapes: Dict[str, Tuple[int, ...]] = {}
         self.input_blobs: List[str] = []   # blobs the caller must feed
         self.loss_terms: List[Tuple[str, float]] = []  # (blob, weight)
+        self.hdf5_outputs: List[Tuple[str, List[str]]] = []  # (file, bottoms)
         self._build(net_param, state)
 
     # ------------------------------------------------------------------ build
@@ -848,6 +849,21 @@ def build_filter(net: Net, layer: LayerParameter, bshapes):
 
 @register("Silence")
 def build_silence(net: Net, layer: LayerParameter, bshapes):
+    def fn(pvals, bvals, rng, train):
+        return [], {}
+
+    return _simple(net, layer, fn, [])
+
+
+@register("HDF5Output")
+def build_hdf5_output(net: Net, layer: LayerParameter, bshapes):
+    """Graph-side no-op that records (file_name, bottoms) so the host loop
+    can sink the blobs with data.hdf5_data.HDF5OutputWriter — file I/O can't
+    live inside a compiled step (reference: hdf5_output_layer.cpp writes
+    during Forward; here the seam moves host-side like the data layers)."""
+    file_name = str(layer.hdf5_output_param.file_name)
+    net.hdf5_outputs.append((file_name, list(layer.bottoms)))
+
     def fn(pvals, bvals, rng, train):
         return [], {}
 

@@ -1,0 +1,137 @@
+"""Host-side HDF5 data source and output sink.
+
+The reference's HDF5DataLayer (caffe/src/caffe/layers/hdf5_data_layer.cpp)
+reads a `source` listing file of .h5 paths; each file holds one dataset per
+top blob, named after the blob, all sharing the leading (row) axis.  Files
+are cycled in order, rows batched sequentially; `shuffle` permutes both the
+file order and the rows within each file (HDF5DataParameter,
+caffe.proto:652-664).  Here that becomes a pull-style DataSource feeding the
+compiled step — the graph-side HDF5Data layer in core/net.py is a pure feed,
+mirroring how JavaDataLayer's upcall seam became the host pipeline.
+
+HDF5OutputLayer (hdf5_output_layer.cpp) writes its bottoms to a file; the
+`HDF5OutputWriter` here is the host-side sink apps use with forward results.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+try:
+    import h5py
+
+    HAVE_H5PY = True
+except ImportError:  # pragma: no cover
+    HAVE_H5PY = False
+
+
+class HDF5DataSource:
+    """Cycling batch puller over a listing of HDF5 files.
+
+    `source` is either a listing file (one .h5 path per line, the
+    reference's format) or a list of paths.  `keys` are the dataset/blob
+    names to read (the layer's tops).
+    """
+
+    def __init__(self, source, keys: Sequence[str], batch_size: int, *,
+                 shuffle: bool = False, seed: int = 0) -> None:
+        if not HAVE_H5PY:
+            raise RuntimeError("h5py is required for HDF5Data")
+        if isinstance(source, str):
+            base = os.path.dirname(os.path.abspath(source))
+            with open(source) as f:
+                self.files = [
+                    ln.strip() if os.path.isabs(ln.strip())
+                    else os.path.join(base, ln.strip())
+                    for ln in f if ln.strip()]
+        else:
+            self.files = list(source)
+        if not self.files:
+            raise ValueError("HDF5Data source lists no files")
+        self.keys = list(keys)
+        self.batch_size = int(batch_size)
+        self.shuffle = shuffle
+        self._rng = np.random.RandomState(seed)
+        self._file_order = list(range(len(self.files)))
+        self._file_idx = 0
+        self._row = 0
+        self._current: Optional[Dict[str, np.ndarray]] = None
+        if shuffle:
+            self._rng.shuffle(self._file_order)
+        self._load(0)
+
+    def _load(self, order_idx: int) -> None:
+        path = self.files[self._file_order[order_idx]]
+        with h5py.File(path, "r") as f:
+            data = {k: np.asarray(f[k], dtype=np.float32) for k in self.keys}
+        n = data[self.keys[0]].shape[0]
+        if n == 0:   # the reference CHECKs num > 0; without this, __call__
+            raise ValueError(f"HDF5 file {path} has zero rows")  # spins forever
+        for k in self.keys[1:]:
+            if data[k].shape[0] != n:
+                raise ValueError(f"row-count mismatch in {path}")
+        if self.shuffle:
+            perm = self._rng.permutation(n)
+            data = {k: v[perm] for k, v in data.items()}
+        self._current = data
+        self._row = 0
+
+    def num_rows(self) -> int:
+        total = 0
+        for path in self.files:
+            with h5py.File(path, "r") as f:
+                total += f[self.keys[0]].shape[0]
+        return total
+
+    def __call__(self) -> Dict[str, np.ndarray]:
+        """Pull one batch, spanning file boundaries and wrapping at the end
+        of the epoch (the reference's Forward_cpu row loop,
+        hdf5_data_layer.cpp:121-160)."""
+        assert self._current is not None
+        out = {k: [] for k in self.keys}
+        need = self.batch_size
+        while need > 0:
+            n = self._current[self.keys[0]].shape[0]
+            take = min(need, n - self._row)
+            if take > 0:
+                for k in self.keys:
+                    out[k].append(self._current[k][self._row:self._row + take])
+                self._row += take
+                need -= take
+            if self._row >= n:
+                self._file_idx = (self._file_idx + 1) % len(self._file_order)
+                if self._file_idx == 0 and self.shuffle:
+                    self._rng.shuffle(self._file_order)
+                self._load(self._file_idx)
+        return {k: np.concatenate(v) if len(v) > 1 else v[0]
+                for k, v in out.items()}
+
+
+class HDF5OutputWriter:
+    """Accumulate forward-pass blobs and write them as one HDF5 file with a
+    dataset per blob (reference: hdf5_output_layer.cpp — datasets "data" /
+    "label"; generalized here to any blob names)."""
+
+    def __init__(self, file_name: str) -> None:
+        if not HAVE_H5PY:
+            raise RuntimeError("h5py is required for HDF5Output")
+        self.file_name = file_name
+        self._chunks: Dict[str, List[np.ndarray]] = {}
+
+    def write(self, blobs: Dict[str, np.ndarray]) -> None:
+        for k, v in blobs.items():
+            self._chunks.setdefault(k, []).append(np.asarray(v))
+
+    def close(self) -> None:
+        with h5py.File(self.file_name, "w") as f:
+            for k, chunks in self._chunks.items():
+                f.create_dataset(k, data=np.concatenate(chunks))
+
+    def __enter__(self) -> "HDF5OutputWriter":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

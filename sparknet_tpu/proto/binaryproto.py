@@ -115,7 +115,7 @@ def parse_blob(buf: bytes) -> np.ndarray:
     if shape is None and legacy:
         shape = [legacy.get(1, 1), legacy.get(2, 1), legacy.get(3, 1),
                  legacy.get(4, 1)]
-    if shape:
+    if shape is not None:   # [] is a valid 0-d (scalar) shape
         data = data.reshape(shape)
     return data
 
@@ -193,6 +193,52 @@ def read_caffemodel(path: str) -> Dict[str, List[np.ndarray]]:
         if name and blobs:
             out[name] = blobs
     return out
+
+
+def read_solverstate(path: str) -> Dict[str, object]:
+    """Binary SolverState (.solverstate) -> {iter, learned_net, history,
+    current_step} (reference: SGDSolver::RestoreSolverStateFromBinaryProto,
+    sgd_solver.cpp:301-318; caffe.proto:245-250)."""
+    with open(path, "rb") as f:
+        buf = f.read()
+    out: Dict[str, object] = {"iter": 0, "learned_net": "", "history": [],
+                              "current_step": 0}
+    history: List[np.ndarray] = []
+    for field, wt, val in iter_fields(buf):
+        if field == 1 and wt == 0:
+            out["iter"] = int(val)
+        elif field == 2 and wt == 2:
+            out["learned_net"] = val.decode("utf-8", "replace")
+        elif field == 3 and wt == 2:
+            history.append(parse_blob(val))
+        elif field == 4 and wt == 0:
+            out["current_step"] = int(val)
+    out["history"] = history
+    return out
+
+
+def write_solverstate(path: str, *, iteration: int, learned_net: str = "",
+                      history: List[np.ndarray] = [],
+                      current_step: int = 0) -> None:
+    """(reference: SGDSolver::SnapshotSolverStateToBinaryProto,
+    sgd_solver.cpp:242-258)"""
+    out = bytearray()
+    _write_varint(out, (1 << 3) | 0)
+    _write_varint(out, int(iteration))
+    if learned_net:
+        enc = learned_net.encode()
+        _write_varint(out, (2 << 3) | 2)
+        _write_varint(out, len(enc))
+        out += enc
+    for h in history:
+        bb = write_blob(h)
+        _write_varint(out, (3 << 3) | 2)
+        _write_varint(out, len(bb))
+        out += bb
+    _write_varint(out, (4 << 3) | 0)
+    _write_varint(out, int(current_step))
+    with open(path, "wb") as f:
+        f.write(bytes(out))
 
 
 def write_caffemodel(path: str, weights: Dict[str, List[np.ndarray]],

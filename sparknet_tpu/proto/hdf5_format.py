@@ -1,0 +1,143 @@
+"""Caffe-compatible HDF5 weight and solver-state files.
+
+The reference supports two snapshot wire formats (SolverParameter
+snapshot_format, caffe.proto:222-226): BINARYPROTO (.caffemodel — see
+binaryproto.py) and HDF5.  This module mirrors the HDF5 layouts exactly:
+
+- Weights file (reference: Net::ToHDF5, net.cpp:920+ and
+  Net::CopyTrainedLayersFromHDF5, net.cpp:860-908): root group "data"
+  containing one subgroup per layer, each with float datasets named
+  "0", "1", ... — one per param blob.
+- Solver state file (reference: SGDSolver::SnapshotSolverStateToHDF5 /
+  RestoreSolverStateFromHDF5, sgd_solver.cpp:278-330): scalar int datasets
+  "iter" and "current_step", string dataset "learned_net", and a group
+  "history" with datasets "0".."n-1".  Multi-slot solvers (Adam et al.)
+  append extra slots after the first n entries, matching the reference's
+  history_ layout (adam_solver.cpp grows history_ to 2n).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Sequence, Tuple
+
+import numpy as np
+
+try:
+    import h5py
+
+    HAVE_H5PY = True
+except ImportError:  # pragma: no cover - h5py is in the base image
+    HAVE_H5PY = False
+
+
+def _require_h5py() -> None:
+    if not HAVE_H5PY:
+        raise RuntimeError("h5py is required for HDF5 snapshot support")
+
+
+# ------------------------------------------------------------------- weights
+
+def write_weights_hdf5(path: str,
+                       weights: Dict[str, List[np.ndarray]]) -> None:
+    """weights = {layer_name: [blob0, blob1, ...]} → Caffe .caffemodel.h5."""
+    _require_h5py()
+    with h5py.File(path, "w") as f:
+        data = f.create_group("data")
+        for layer_name, blobs in weights.items():
+            g = data.create_group(layer_name)
+            for j, blob in enumerate(blobs):
+                g.create_dataset(str(j),
+                                 data=np.asarray(blob, dtype=np.float32))
+
+
+def read_weights_hdf5(path: str) -> Dict[str, List[np.ndarray]]:
+    """Walks nested groups so slash-named layers (GoogLeNet's
+    "inception_3a/1x1" etc.) round-trip: h5 treats '/' as group nesting, so
+    such a layer's blobs live two levels deep."""
+    _require_h5py()
+    out: Dict[str, List[np.ndarray]] = {}
+
+    def walk(group, prefix: str) -> None:
+        blobs: Dict[int, np.ndarray] = {}
+        for name in group:
+            item = group[name]
+            if isinstance(item, h5py.Group):
+                walk(item, f"{prefix}/{name}" if prefix else name)
+            else:
+                blobs[int(name)] = np.asarray(item, dtype=np.float32)
+        if blobs:
+            out[prefix] = [blobs[i] for i in sorted(blobs)]
+
+    with h5py.File(path, "r") as f:
+        walk(f["data"], "")
+    return out
+
+
+# --------------------------------------------------------------- solver state
+
+def write_solver_state_hdf5(path: str, *, iteration: int,
+                            current_step: int = 0,
+                            learned_net: str = "",
+                            history: Sequence[np.ndarray] = ()) -> None:
+    _require_h5py()
+    with h5py.File(path, "w") as f:
+        f.create_dataset("iter", data=np.int64(iteration))
+        f.create_dataset("current_step", data=np.int64(current_step))
+        if learned_net:
+            f.create_dataset("learned_net", data=learned_net)
+        g = f.create_group("history")
+        for i, h in enumerate(history):
+            g.create_dataset(str(i), data=np.asarray(h, dtype=np.float32))
+
+
+def read_solver_state_hdf5(path: str) -> Dict[str, object]:
+    _require_h5py()
+    with h5py.File(path, "r") as f:
+        out: Dict[str, object] = {
+            "iter": int(np.asarray(f["iter"])),
+            "current_step": int(np.asarray(f["current_step"]))
+            if "current_step" in f else 0,
+            "learned_net": "",
+        }
+        if "learned_net" in f:
+            raw = f["learned_net"][()]
+            out["learned_net"] = (raw.decode() if isinstance(raw, bytes)
+                                  else str(raw))
+        g = f["history"]
+        hist = [None] * len(g)
+        for ds_name in g:
+            hist[int(ds_name)] = np.asarray(g[ds_name], dtype=np.float32)
+        out["history"] = hist
+    return out
+
+
+# ------------------------------------------------- state dict <-> flat history
+
+def flatten_state(state: Dict[str, Tuple[np.ndarray, ...]],
+                  param_order: Sequence[str],
+                  ) -> List[np.ndarray]:
+    """Our solver state {param_key: (slot0, slot1, ...)} → the reference's
+    flat history_ vector: slot-major, params in net order within a slot
+    (matching adam_solver.cpp history_[i] / history_[i + n])."""
+    n_slots = max((len(v) for v in state.values()), default=0)
+    flat: List[np.ndarray] = []
+    for slot in range(n_slots):
+        for k in param_order:
+            slots = state.get(k, ())
+            if slot < len(slots):
+                flat.append(np.asarray(slots[slot]))
+    return flat
+
+
+def unflatten_state(history: Sequence[np.ndarray],
+                    param_order: Sequence[str], n_slots: int,
+                    ) -> Dict[str, Tuple[np.ndarray, ...]]:
+    n = len(param_order)
+    if n_slots and len(history) != n * n_slots:
+        raise ValueError(
+            f"history length {len(history)} != {n} params x {n_slots} slots")
+    out: Dict[str, List[np.ndarray]] = {k: [] for k in param_order}
+    for slot in range(n_slots):
+        for i, k in enumerate(param_order):
+            out[k].append(np.asarray(history[slot * n + i]))
+    return {k: tuple(v) for k, v in out.items()}

@@ -17,6 +17,7 @@ Differences from the reference by design (TPU-first):
 from __future__ import annotations
 
 import functools
+import os
 from typing import Any, Callable, Dict, Iterable, List, Optional, Tuple
 
 import jax
@@ -235,8 +236,7 @@ class Solver:
                 if action is SolverAction.STOP:
                     break
                 if action is SolverAction.SNAPSHOT:
-                    prefix = str(self.param.snapshot_prefix) or "/tmp/snapshot"
-                    self.snapshot(f"{prefix}_iter_{self.iter}.npz")
+                    self.snapshot_caffe_style()
             pulls = [self._pull(self.train_source) for _ in range(iter_size)]
             stacked = {k: jnp.stack([p[k] for p in pulls])
                        for k in pulls[0]}
@@ -247,8 +247,7 @@ class Solver:
             self.iter += 1
             if (self.param.snapshot and self.iter % int(self.param.snapshot)
                     == 0 and self.param.snapshot_prefix):
-                self.snapshot(f"{self.param.snapshot_prefix}"
-                              f"_iter_{self.iter}.npz")
+                self.snapshot_caffe_style()
         return smoothed
 
     def _smooth_loss(self, loss: float) -> float:
@@ -288,10 +287,18 @@ class Solver:
         self.params = self.net.set_weights(self.params, weights)
 
     # --------------------------------------------------------------- snapshot
-    def snapshot(self, path: str) -> None:
+    def snapshot(self, path: str) -> str:
         """Weights + solver state + iter (reference: Solver::Snapshot,
         solver.cpp:446-466; SGDSolver::SnapshotSolverState,
-        sgd_solver.cpp:242-330)."""
+        sgd_solver.cpp:242-330).  `.h5` paths write the reference's HDF5
+        snapshot *pair* at the path's stem; anything else is the native npz
+        format.  Returns the path restore() should be given."""
+        if path.endswith(".h5"):
+            for suffix in (".solverstate.h5", ".caffemodel.h5", ".h5"):
+                if path.endswith(suffix):
+                    stem = path[:-len(suffix)]
+                    break
+            return self._snapshot_caffe_pair(stem, "HDF5")
         arrays: Dict[str, np.ndarray] = {"__iter__": np.asarray(self.iter)}
         for k, v in self.params.items():
             arrays[f"param:{k}"] = np.asarray(v)
@@ -299,9 +306,51 @@ class Solver:
             for i, h in enumerate(hs):
                 arrays[f"state:{i}:{k}"] = np.asarray(h)
         np.savez(path, **arrays)
+        return path if path.endswith(".npz") else path + ".npz"
+
+    def snapshot_caffe_style(self, prefix: Optional[str] = None) -> str:
+        """Write the reference's snapshot *pair* — model + solver state —
+        under `snapshot_prefix`, honoring SolverParameter.snapshot_format
+        (reference: Solver::Snapshot solver.cpp:446-466; filenames
+        Solver::SnapshotFilename `<prefix>_iter_<N>.caffemodel[.h5]` /
+        `.solverstate[.h5]`).  Returns the state-file path."""
+        prefix = prefix or str(self.param.snapshot_prefix) or "/tmp/snapshot"
+        fmt = str(getattr(self.param, "snapshot_format", "BINARYPROTO"))
+        return self._snapshot_caffe_pair(f"{prefix}_iter_{self.iter}", fmt)
+
+    def _snapshot_caffe_pair(self, stem: str, fmt: str) -> str:
+        from ..proto import binaryproto, hdf5_format
+
+        weights = self.get_weights()
+        param_order = list(self.params.keys())
+        history = hdf5_format.flatten_state(self.state, param_order)
+        if fmt == "HDF5":
+            model = stem + ".caffemodel.h5"
+            state_path = stem + ".solverstate.h5"
+            hdf5_format.write_weights_hdf5(model, weights)
+            hdf5_format.write_solver_state_hdf5(
+                state_path, iteration=self.iter, learned_net=model,
+                history=history)
+        else:
+            model = stem + ".caffemodel"
+            state_path = stem + ".solverstate"
+            binaryproto.write_caffemodel(model, weights)
+            binaryproto.write_solverstate(state_path, iteration=self.iter,
+                                          learned_net=model, history=history)
+        return state_path
 
     def restore(self, path: str) -> None:
-        """(reference: Solver::Restore; bridge ccaffe.cpp:271-273)"""
+        """(reference: Solver::Restore; bridge ccaffe.cpp:271-273).
+        Accepts the native .npz or either reference .solverstate format; a
+        bare `x.h5` resolves to `x.solverstate.h5` if that exists (the pair
+        snapshot(x.h5) wrote)."""
+        if path.endswith(".h5") and not os.path.exists(path):
+            stem_state = path[:-3] + ".solverstate.h5"
+            if os.path.exists(stem_state):
+                path = stem_state
+        if path.endswith(".solverstate") or path.endswith(".h5"):
+            self._restore_caffe_state(path)
+            return
         data = np.load(path if path.endswith(".npz") else path + ".npz")
         self.iter = int(data["__iter__"])
         params = {}
@@ -319,22 +368,87 @@ class Solver:
         self.params = params
         self.state = {k: tuple(v) for k, v in state.items()}
 
+    def _restore_caffe_state(self, path: str) -> None:
+        from ..proto import binaryproto, hdf5_format
+
+        if path.endswith(".h5"):
+            st = hdf5_format.read_solver_state_hdf5(path)
+        else:
+            st = binaryproto.read_solverstate(path)
+        # Resolve learned_net and load its weights BEFORE mutating any
+        # solver state, so a missing model file can't leave the solver
+        # half-restored.  Relative learned_net paths (snapshot_prefix was
+        # relative) resolve against the state file's directory.
+        learned = str(st.get("learned_net", ""))
+        new_weights = None
+        if learned:
+            if not os.path.isabs(learned) and not os.path.exists(learned):
+                candidate = os.path.join(os.path.dirname(os.path.abspath(path)),
+                                         os.path.basename(learned))
+                if os.path.exists(candidate):
+                    learned = candidate
+            if learned.endswith(".h5"):
+                new_weights = hdf5_format.read_weights_hdf5(learned)
+            else:
+                new_weights = binaryproto.read_caffemodel(learned)
+        param_order = list(self.params.keys())
+        n_slots = updates.N_SLOTS[self.solver_type]
+        history = st["history"]  # type: ignore[assignment]
+        restored = None
+        if history:
+            restored = hdf5_format.unflatten_state(
+                history, param_order, n_slots)  # type: ignore[arg-type]
+        # All parsing/validation that can fail has now run; apply weights
+        # (set_weights shape-checks) before touching state/iter so a failure
+        # cannot leave the solver half-restored.
+        if new_weights is not None:
+            self.set_weights(new_weights)
+        if restored is not None:
+            self.state = {k: tuple(jnp.asarray(h) for h in v)
+                          for k, v in restored.items()}
+        self.iter = int(st["iter"])  # type: ignore[arg-type]
+
     def save_weights(self, path: str) -> None:
-        """(reference: ccaffe.h:68 save_weights_to_file)"""
-        np.savez(path, **{k: np.asarray(v) for k, v in self.params.items()})
+        """(reference: ccaffe.h:68 save_weights_to_file).  Dispatches on
+        extension: .caffemodel (binaryproto), .h5 (HDF5), else npz."""
+        if path.endswith(".caffemodel"):
+            self.save_caffemodel(path)
+        elif path.endswith(".h5"):
+            from ..proto.hdf5_format import write_weights_hdf5
+
+            write_weights_hdf5(path, self.get_weights())
+        else:
+            np.savez(path,
+                     **{k: np.asarray(v) for k, v in self.params.items()})
 
     def load_weights(self, path: str) -> None:
         """(reference: ccaffe.h:69 load_weights_from_file)"""
+        if path.endswith(".caffemodel") or path.endswith(".h5"):
+            self.copy_trained_layers_from(path)
+            return
         data = np.load(path if path.endswith(".npz") else path + ".npz")
         self.params = {k: jnp.asarray(data[k]) for k in data.files}
+
+    def copy_trained_layers_from(self, path: str) -> None:
+        """Name-matched weight copy for warm starts and fine-tuning: source
+        layers absent from this net are ignored; net layers absent from the
+        source keep their initialization (reference:
+        Net::CopyTrainedLayersFrom, net.cpp:843-850 extension dispatch,
+        :805-830 binaryproto, :860-908 HDF5 — the mechanism behind
+        examples/finetune_flickr_style)."""
+        from ..proto import binaryproto, hdf5_format
+
+        if path.endswith(".h5"):
+            weights = hdf5_format.read_weights_hdf5(path)
+        else:
+            weights = binaryproto.read_caffemodel(path)
+        self.set_weights(weights)
 
     def load_caffemodel(self, path: str) -> None:
         """Warm start from a reference-trained binary NetParameter
         (reference: Net::CopyTrainedLayersFromBinaryProto, net.cpp:805-830;
         app usage ImageNetRunDBApp.scala:75)."""
-        from ..proto.binaryproto import read_caffemodel
-
-        self.set_weights(read_caffemodel(path))
+        self.copy_trained_layers_from(path)
 
     def save_caffemodel(self, path: str) -> None:
         """Export weights in the reference's .caffemodel format."""

@@ -21,9 +21,12 @@ Grads = Dict[str, jax.Array]
 State = Dict[str, Tuple[jax.Array, ...]]
 
 
+N_SLOTS = {"SGD": 1, "Nesterov": 1, "AdaGrad": 1, "RMSProp": 1,
+           "AdaDelta": 2, "Adam": 2}
+
+
 def init_state(params: Params, solver_type: str) -> State:
-    n_slots = {"SGD": 1, "Nesterov": 1, "AdaGrad": 1, "RMSProp": 1,
-               "AdaDelta": 2, "Adam": 2}[solver_type]
+    n_slots = N_SLOTS[solver_type]
     return {k: tuple(jnp.zeros_like(v) for _ in range(n_slots))
             for k, v in params.items()}
 

@@ -1,0 +1,307 @@
+"""HDF5 tier: Caffe-layout weight files, solver-state snapshots in both
+reference wire formats, the HDF5Data source, and the HDF5Output sink
+(reference: net.cpp:860-908 CopyTrainedLayersFromHDF5, sgd_solver.cpp:242-330
+snapshot/restore x {binaryproto, HDF5}, hdf5_data_layer.cpp,
+hdf5_output_layer.cpp; example: caffe/examples/hdf5_classification)."""
+
+import numpy as np
+import pytest
+
+h5py = pytest.importorskip("h5py")
+
+from sparknet_tpu.core import layers_dsl as dsl
+from sparknet_tpu.core.net import Net
+from sparknet_tpu.data.hdf5_data import HDF5DataSource, HDF5OutputWriter
+from sparknet_tpu.proto import caffe_pb, hdf5_format
+from sparknet_tpu.proto.textformat import parse
+from sparknet_tpu.solver.solver import Solver
+
+
+def make_solver_param(text: str) -> caffe_pb.SolverParameter:
+    return caffe_pb.SolverParameter(parse(text))
+
+
+def _toy_net(batch=32):
+    return dsl.net_param(
+        "toy",
+        dsl.memory_data_layer("data", ["data", "label"], batch=batch,
+                              channels=1, height=4, width=4),
+        dsl.inner_product_layer("ip1", "data", num_output=16),
+        dsl.relu_layer("relu1", "ip1"),
+        dsl.inner_product_layer("ip2", "ip1", num_output=2),
+        dsl.softmax_with_loss_layer("loss", ["ip2", "label"]),
+    )
+
+
+def _toy_source(batch=32, seed=0):
+    rng = np.random.RandomState(seed)
+
+    def source():
+        x = rng.randn(batch, 1, 4, 4).astype(np.float32)
+        y = (x.mean(axis=(1, 2, 3)) > 0).astype(np.int32)
+        return {"data": x, "label": y}
+
+    return source
+
+
+# ------------------------------------------------------------- weight files
+
+def test_weights_hdf5_slash_layer_names(tmp_path):
+    """GoogLeNet layer names contain '/' (e.g. "inception_3a/1x1"), which
+    HDF5 treats as group nesting — the reader must walk it back."""
+    w = {"inception_3a/1x1": [np.ones((2, 2), np.float32)],
+         "inception_3a/3x3": [np.full((3,), 2.0, np.float32),
+                              np.zeros((3,), np.float32)],
+         "conv1": [np.arange(4, dtype=np.float32)]}
+    path = str(tmp_path / "g.caffemodel.h5")
+    hdf5_format.write_weights_hdf5(path, w)
+    back = hdf5_format.read_weights_hdf5(path)
+    assert set(back) == set(w)
+    for name in w:
+        for a, b in zip(w[name], back[name]):
+            np.testing.assert_array_equal(a, b)
+
+
+def test_snapshot_h5_path_symmetry(tmp_path, monkeypatch):
+    """snapshot('x.h5') and restore('x.h5') are symmetric, and a snapshot
+    taken with a *relative* prefix restores from a different cwd."""
+    sp_text = ("base_lr: 0.05 lr_policy: 'fixed' momentum: 0.9 "
+               "random_seed: 4")
+    a = Solver(make_solver_param(sp_text), net_param=_toy_net())
+    a.set_train_data(_toy_source(seed=1))
+    a.step(3)
+    returned = a.snapshot(str(tmp_path / "ck.h5"))
+    assert returned.endswith(".solverstate.h5")
+    b = Solver(make_solver_param(sp_text), net_param=_toy_net())
+    b.restore(str(tmp_path / "ck.h5"))
+    assert b.iter == 3
+    for k in a.params:
+        np.testing.assert_array_equal(np.asarray(a.params[k]),
+                                      np.asarray(b.params[k]))
+
+    # relative snapshot_prefix, restored from a different cwd
+    monkeypatch.chdir(tmp_path)
+    sp_rel = make_solver_param(sp_text + " snapshot_prefix: 'rel'")
+    c = Solver(sp_rel, net_param=_toy_net())
+    c.set_train_data(_toy_source(seed=1))
+    c.step(2)
+    state_path = c.snapshot_caffe_style()
+    monkeypatch.chdir("/")
+    d = Solver(sp_rel, net_param=_toy_net())
+    d.restore(str(tmp_path / state_path))
+    assert d.iter == 2
+
+
+def test_weights_hdf5_roundtrip(tmp_path):
+    w = {"conv1": [np.random.RandomState(0).randn(4, 1, 3, 3).astype(
+        np.float32), np.zeros((4,), np.float32)],
+         "ip1": [np.ones((10, 8), np.float32)]}
+    path = str(tmp_path / "w.caffemodel.h5")
+    hdf5_format.write_weights_hdf5(path, w)
+    back = hdf5_format.read_weights_hdf5(path)
+    assert set(back) == {"conv1", "ip1"}
+    for name in w:
+        assert len(back[name]) == len(w[name])
+        for a, b in zip(w[name], back[name]):
+            np.testing.assert_array_equal(a, b)
+    # the file layout is the reference's: /data/<layer>/<blob_idx>
+    with h5py.File(path, "r") as f:
+        assert "data" in f
+        assert set(f["data"]["conv1"]) == {"0", "1"}
+
+
+@pytest.mark.parametrize("fmt,ext", [("BINARYPROTO", ""), ("HDF5", ".h5")])
+def test_caffe_style_snapshot_resume(tmp_path, fmt, ext):
+    """Training N == train k, caffe-pair snapshot, restore, train N-k — for
+    both snapshot_format values (the reference asserts this equivalence in
+    test_gradient_based_solver.cpp TestSnapshot)."""
+    sp_text = ("base_lr: 0.05 lr_policy: 'inv' gamma: 0.01 power: 0.75 "
+               "momentum: 0.9 weight_decay: 0.004 random_seed: 11 "
+               f"snapshot_prefix: '{tmp_path}/snap' "
+               f"snapshot_format: {fmt}")
+    a = Solver(make_solver_param(sp_text), net_param=_toy_net())
+    a.set_train_data(_toy_source(seed=5))
+    a.step(16)
+
+    b = Solver(make_solver_param(sp_text), net_param=_toy_net())
+    b.set_train_data(_toy_source(seed=5))
+    b.step(8)
+    state_path = b.snapshot_caffe_style()
+    assert state_path.endswith(f".solverstate{ext}")
+
+    c = Solver(make_solver_param(sp_text), net_param=_toy_net())
+    c.restore(state_path)
+    assert c.iter == 8
+    src = _toy_source(seed=5)
+    for _ in range(8):
+        src()
+    c.set_train_data(src)
+    c.step(8)
+    for k in a.params:
+        np.testing.assert_allclose(np.asarray(a.params[k]),
+                                   np.asarray(c.params[k]), rtol=1e-5,
+                                   atol=1e-6)
+
+
+def test_adam_state_roundtrip_hdf5(tmp_path):
+    """Multi-slot (Adam: m, v) history flattens slot-major and restores."""
+    sp_text = ("base_lr: 0.001 lr_policy: 'fixed' type: 'Adam' "
+               "momentum: 0.9 momentum2: 0.999 random_seed: 3 "
+               f"snapshot_prefix: '{tmp_path}/adam' snapshot_format: HDF5")
+    a = Solver(make_solver_param(sp_text), net_param=_toy_net())
+    a.set_train_data(_toy_source(seed=2))
+    a.step(5)
+    state_path = a.snapshot_caffe_style()
+
+    b = Solver(make_solver_param(sp_text), net_param=_toy_net())
+    b.restore(state_path)
+    for k in a.state:
+        assert len(b.state[k]) == len(a.state[k]) == 2
+        for ha, hb in zip(a.state[k], b.state[k]):
+            np.testing.assert_allclose(np.asarray(ha), np.asarray(hb),
+                                       rtol=1e-6)
+
+
+def test_finetune_name_matched_copy(tmp_path):
+    """CopyTrainedLayersFrom semantics: matching names copied, renamed head
+    keeps its fresh init, extra source layers ignored (reference:
+    net.cpp:771-830; the examples/finetune_flickr_style workflow renames
+    fc8 -> fc8_flickr_style to relearn it)."""
+    donor = Solver(make_solver_param("base_lr: 0.01 lr_policy: 'fixed' "
+                                     "random_seed: 1"),
+                   net_param=_toy_net())
+    path = str(tmp_path / "donor.caffemodel.h5")
+    donor.save_weights(path)
+
+    # same body, renamed head
+    head_renamed = dsl.net_param(
+        "toy_ft",
+        dsl.memory_data_layer("data", ["data", "label"], batch=32,
+                              channels=1, height=4, width=4),
+        dsl.inner_product_layer("ip1", "data", num_output=16),
+        dsl.relu_layer("relu1", "ip1"),
+        dsl.inner_product_layer("ip2_ft", "ip1", num_output=2),
+        dsl.softmax_with_loss_layer("loss", ["ip2_ft", "label"]),
+    )
+    ft = Solver(make_solver_param("base_lr: 0.01 lr_policy: 'fixed' "
+                                  "random_seed: 99"),
+                net_param=head_renamed)
+    before_head = {k: np.asarray(v) for k, v in ft.params.items()
+                   if "ip2_ft" in k}
+    ft.copy_trained_layers_from(path)
+    donor_w = donor.get_weights()
+    ft_w = ft.get_weights()
+    for a, b in zip(donor_w["ip1"], ft_w["ip1"]):
+        np.testing.assert_array_equal(a, b)     # body copied
+    for k, v in before_head.items():
+        np.testing.assert_array_equal(v, np.asarray(ft.params[k]))  # head kept
+
+
+def test_scalar_blob_binaryproto_roundtrip():
+    """BatchNorm's third blob is scalar shape (); binaryproto must
+    round-trip it (parse_blob: [] is a valid 0-d shape, not 'no shape')."""
+    from sparknet_tpu.proto.binaryproto import parse_blob, write_blob
+
+    scalar = np.asarray(3.5, dtype=np.float32)
+    back = parse_blob(write_blob(scalar))
+    assert back.shape == ()
+    assert float(back) == pytest.approx(3.5)
+
+
+# --------------------------------------------------------------- data source
+
+def _write_h5(path, n, seed):
+    rng = np.random.RandomState(seed)
+    with h5py.File(path, "w") as f:
+        f.create_dataset("data", data=rng.randn(n, 3).astype(np.float32))
+        f.create_dataset("label", data=np.arange(n, dtype=np.float32))
+
+
+def test_hdf5_source_batches_across_files(tmp_path):
+    _write_h5(tmp_path / "a.h5", 5, 0)
+    _write_h5(tmp_path / "b.h5", 4, 1)
+    listing = tmp_path / "train.txt"
+    listing.write_text("a.h5\nb.h5\n")   # relative paths, reference-style
+    src = HDF5DataSource(str(listing), ["data", "label"], batch_size=4)
+    assert src.num_rows() == 9
+    b1 = src()
+    b2 = src()
+    b3 = src()
+    assert b1["data"].shape == (4, 3)
+    np.testing.assert_array_equal(b1["label"], [0, 1, 2, 3])
+    # second batch spans the a.h5 -> b.h5 boundary
+    np.testing.assert_array_equal(b2["label"], [4, 0, 1, 2])
+    # third wraps the epoch
+    np.testing.assert_array_equal(b3["label"], [3, 0, 1, 2])
+
+
+def test_hdf5_source_shuffle_covers_all_rows(tmp_path):
+    _write_h5(tmp_path / "a.h5", 8, 0)
+    src = HDF5DataSource([str(tmp_path / "a.h5")], ["data", "label"],
+                         batch_size=4, shuffle=True, seed=7)
+    seen = np.concatenate([src()["label"], src()["label"]])
+    assert sorted(seen.tolist()) == list(range(8))
+
+
+def test_hdf5_source_trains_logreg(tmp_path):
+    """The hdf5_classification example shape: flat features + HDF5Data
+    (reference: caffe/examples/hdf5_classification — logreg over h5 files)."""
+    rng = np.random.RandomState(0)
+    n = 256
+    x = rng.randn(n, 4).astype(np.float32)
+    y = (x[:, 0] + x[:, 1] > 0).astype(np.float32)
+    with h5py.File(tmp_path / "train.h5", "w") as f:
+        f.create_dataset("data", data=x)
+        f.create_dataset("label", data=y)
+
+    net = dsl.net_param(
+        "logreg",
+        dsl.memory_data_layer("data", ["data", "label"], batch=32,
+                              channels=4, height=1, width=1),
+        dsl.inner_product_layer("fc1", "data", num_output=2),
+        dsl.softmax_with_loss_layer("loss", ["fc1", "label"]),
+    )
+    solver = Solver(make_solver_param(
+        "base_lr: 0.1 lr_policy: 'fixed' momentum: 0.9 random_seed: 0"),
+        net_param=net,
+        data_shapes={"data": (32, 4), "label": (32,)})
+    src = HDF5DataSource([str(tmp_path / "train.h5")], ["data", "label"],
+                         batch_size=32, shuffle=True, seed=1)
+
+    def pull():
+        b = src()
+        return {"data": b["data"], "label": b["label"].astype(np.int32)}
+
+    solver.set_train_data(pull)
+    first = solver.step(2)
+    last = solver.step(40)
+    assert last < first
+
+
+# --------------------------------------------------------------- output sink
+
+def test_hdf5_output_layer_and_writer(tmp_path):
+    out_file = str(tmp_path / "out.h5")
+    text = f"""
+    name: "sink"
+    layer {{ name: "data" type: "MemoryData" top: "data" top: "label"
+             memory_data_param {{ batch_size: 2 channels: 1 height: 2 width: 2 }} }}
+    layer {{ name: "out" type: "HDF5Output" bottom: "data" bottom: "label"
+             hdf5_output_param {{ file_name: "{out_file}" }} }}
+    """
+    net = Net(caffe_pb.NetParameter(parse(text)), "TRAIN")
+    assert net.hdf5_outputs == [(out_file, ["data", "label"])]
+
+    params = net.init_params(0)
+    writer = HDF5OutputWriter(out_file)
+    for i in range(3):
+        batch = {"data": np.full((2, 1, 2, 2), float(i), np.float32),
+                 "label": np.asarray([i, i], np.float32)}
+        blobs = net.forward(params, batch)
+        writer.write({k: np.asarray(blobs[k])
+                      for _, bots in net.hdf5_outputs for k in bots})
+    writer.close()
+    with h5py.File(out_file, "r") as f:
+        assert f["data"].shape == (6, 1, 2, 2)
+        np.testing.assert_array_equal(np.asarray(f["label"]),
+                                      [0, 0, 1, 1, 2, 2])
