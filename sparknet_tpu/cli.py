@@ -231,6 +231,9 @@ def main(argv=None) -> int:
     d = sub.add_parser("device_query")
     d.set_defaults(fn=cmd_device_query)
 
+    from . import tools
+    tools.register(sub)
+
     args = p.parse_args(argv)
     return args.fn(args)
 

@@ -14,15 +14,17 @@ import numpy as np
 from .byte_image import ByteImage
 
 
-def decode_and_resize(jpeg_bytes: bytes, height: int, width: int,
-                      ) -> Optional[np.ndarray]:
+def decode_and_resize(jpeg_bytes: bytes, height: Optional[int] = None,
+                      width: Optional[int] = None) -> Optional[np.ndarray]:
     """JPEG/PNG bytes -> (3, H, W) uint8, or None for corrupt images
-    (the reference drops them, ScaleAndConvert.scala:17-26)."""
+    (the reference drops them, ScaleAndConvert.scala:17-26).  height/width
+    None keeps the native size (convert_imageset's no-resize default)."""
     try:
         from PIL import Image
 
-        img = Image.open(io.BytesIO(jpeg_bytes))
-        img = img.convert("RGB").resize((width, height))
+        img = Image.open(io.BytesIO(jpeg_bytes)).convert("RGB")
+        if height and width:
+            img = img.resize((width, height))
         return np.transpose(np.asarray(img, dtype=np.uint8), (2, 0, 1))
     except Exception:
         return None

@@ -500,8 +500,8 @@ class NetParameter(View):
 
     @property
     def layers(self) -> List[LayerParameter]:
-        # modern field `layer`; legacy `layers` (V1) not supported — the bundled
-        # prototxts all use `layer`.
+        # modern field `layer`; legacy `layers` (V0/V1) trees are upgraded on
+        # load by proto/upgrade.py.
         return [LayerParameter(m) for m in self.msg.getlist("layer")]
 
     @property
@@ -584,19 +584,27 @@ class SolverParameter(View):
 
 
 def load_net_prototxt(path: str) -> NetParameter:
-    """Parse a net prototxt (reference: ProtoLoader.scala:9-29, via C++ there)."""
-    return NetParameter(parse_file(path))
+    """Parse a net prototxt, transparently upgrading legacy V0/V1 formats
+    (reference: ProtoLoader.scala:9-29 via C++;
+    upgrade_proto.cpp ReadNetParamsFromTextFileOrDie)."""
+    return parse_net_text(open(path).read())
+
+
+def parse_net_text(text: str) -> NetParameter:
+    from . import upgrade
+    return NetParameter(upgrade.upgrade_net_as_needed(parse(text)))
 
 
 def load_solver_prototxt(path: str) -> SolverParameter:
-    return SolverParameter(parse_file(path))
+    from . import upgrade
+    return SolverParameter(upgrade.upgrade_solver_as_needed(parse_file(path)))
 
 
 def load_solver_prototxt_with_net(solver_path: str, net: NetParameter,
                                   ) -> SolverParameter:
     """Inline a net into a solver param, clearing file-based net refs and
     engine-side snapshotting (reference: ProtoLoader.scala:31-43)."""
-    sp = SolverParameter(parse_file(solver_path))
+    sp = load_solver_prototxt(solver_path)
     for f in ("net", "train_net", "test_net"):
         sp.msg.clear(f)
     sp.msg.set("net_param", net.msg.copy())

@@ -41,6 +41,9 @@ class Message:
     def set(self, name: str, value: Any) -> None:
         self._fields[name] = [value]
 
+    def set_list(self, name: str, values: List[Any]) -> None:
+        self._fields[name] = list(values)
+
     def clear(self, name: str) -> None:
         self._fields.pop(name, None)
 

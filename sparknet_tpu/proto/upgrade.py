@@ -1,0 +1,347 @@
+"""Legacy prototxt upgrades: V0/V1 NetParameter and old SolverParameter.
+
+The reference engine transparently upgrades old model definitions on load
+(reference: caffe/src/caffe/util/upgrade_proto.cpp, API at
+caffe/include/caffe/util/upgrade_proto.hpp:11-68) and ships standalone
+upgrade tools (caffe/tools/upgrade_net_proto_text.cpp,
+upgrade_solver_proto_text.cpp).  Three generations exist:
+
+* **V0** — `layers { layer { name type("conv"...) num_output ... } }`:
+  a repeated `layers` *connection* holding a nested flat `layer` message
+  (caffe.proto:1134,1139-1230); padding was a separate layer type folded
+  into the following conv on upgrade (upgrade_proto.cpp UpgradeV0PaddingLayers).
+* **V1** — `layers { name type: CONVOLUTION ... }`: repeated `layers` with an
+  enum type and `blobs_lr`/`weight_decay` float lists instead of `param`
+  specs (caffe.proto:1045-1135).
+* **V2 (modern)** — `layer { name type: "Convolution" param {...} }`.
+
+This module upgrades the dynamic `Message` tree in place-free style and is
+invoked automatically by `caffe_pb.load_net_prototxt` /
+`load_solver_prototxt`, mirroring `UpgradeNetAsNeeded` being called from
+`ReadNetParamsFromTextFileOrDie` (upgrade_proto.cpp:937-960).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+from .textformat import Enum, Message
+
+# V1LayerParameter.LayerType enum name -> modern type string
+# (caffe.proto:1051-1095 enum; string names from upgrade_proto.cpp
+# UpgradeV1LayerType).
+V1_TYPE_TO_NAME = {
+    "NONE": "",
+    "ABSVAL": "AbsVal",
+    "ACCURACY": "Accuracy",
+    "ARGMAX": "ArgMax",
+    "BNLL": "BNLL",
+    "CONCAT": "Concat",
+    "CONTRASTIVE_LOSS": "ContrastiveLoss",
+    "CONVOLUTION": "Convolution",
+    "DATA": "Data",
+    "DECONVOLUTION": "Deconvolution",
+    "DROPOUT": "Dropout",
+    "DUMMY_DATA": "DummyData",
+    "EUCLIDEAN_LOSS": "EuclideanLoss",
+    "ELTWISE": "Eltwise",
+    "EXP": "Exp",
+    "FLATTEN": "Flatten",
+    "HDF5_DATA": "HDF5Data",
+    "HDF5_OUTPUT": "HDF5Output",
+    "HINGE_LOSS": "HingeLoss",
+    "IM2COL": "Im2col",
+    "IMAGE_DATA": "ImageData",
+    "INFOGAIN_LOSS": "InfogainLoss",
+    "INNER_PRODUCT": "InnerProduct",
+    "LRN": "LRN",
+    "MEMORY_DATA": "MemoryData",
+    "MULTINOMIAL_LOGISTIC_LOSS": "MultinomialLogisticLoss",
+    "MVN": "MVN",
+    "POOLING": "Pooling",
+    "POWER": "Power",
+    "RELU": "ReLU",
+    "SIGMOID": "Sigmoid",
+    "SIGMOID_CROSS_ENTROPY_LOSS": "SigmoidCrossEntropyLoss",
+    "SILENCE": "Silence",
+    "SOFTMAX": "Softmax",
+    "SOFTMAX_LOSS": "SoftmaxWithLoss",
+    "SPLIT": "Split",
+    "SLICE": "Slice",
+    "TANH": "TanH",
+    "WINDOW_DATA": "WindowData",
+    "THRESHOLD": "Threshold",
+}
+
+# V0 lowercase type string -> modern type string (upgrade_proto.cpp
+# UpgradeV0LayerType, composed with the V1 table above).
+V0_TYPE_TO_NAME = {
+    "accuracy": "Accuracy",
+    "bnll": "BNLL",
+    "concat": "Concat",
+    "conv": "Convolution",
+    "data": "Data",
+    "dropout": "Dropout",
+    "euclidean_loss": "EuclideanLoss",
+    "flatten": "Flatten",
+    "hdf5_data": "HDF5Data",
+    "hdf5_output": "HDF5Output",
+    "im2col": "Im2col",
+    "images": "ImageData",
+    "infogain_loss": "InfogainLoss",
+    "innerproduct": "InnerProduct",
+    "lrn": "LRN",
+    "multinomial_logistic_loss": "MultinomialLogisticLoss",
+    "pool": "Pooling",
+    "relu": "ReLU",
+    "sigmoid": "Sigmoid",
+    "softmax": "Softmax",
+    "softmax_loss": "SoftmaxWithLoss",
+    "split": "Split",
+    "tanh": "TanH",
+    "window_data": "WindowData",
+}
+
+# Fields that migrated out of DataParameter-family messages into
+# TransformationParameter (upgrade_proto.cpp UpgradeNetDataTransformation).
+_TRANSFORM_FIELDS = ("scale", "mean_file", "crop_size", "mirror")
+_DATA_PARAM_MSGS = ("data_param", "image_data_param", "window_data_param")
+
+
+def _is_v0(net: Message) -> bool:
+    return any(isinstance(m, Message) and m.has("layer")
+               for m in net.getlist("layers"))
+
+
+def net_needs_upgrade(net: Message) -> bool:
+    """Mirror of NetNeedsUpgrade (upgrade_proto.cpp:14-17): any legacy
+    `layers` field, or transformation fields still inside data params."""
+    if net.has("layers"):
+        return True
+    for layer in net.getlist("layer"):
+        for pm in _DATA_PARAM_MSGS:
+            sub = layer.get(pm)
+            if isinstance(sub, Message) and any(
+                    sub.has(f) for f in _TRANSFORM_FIELDS):
+                return True
+    return False
+
+
+def solver_needs_upgrade(solver: Message) -> bool:
+    return solver.has("solver_type")
+
+
+def _move_fields(src: Message, dst: Message, mapping: dict) -> None:
+    for old, new in mapping.items():
+        for v in src.getlist(old):
+            dst.add(new, v)
+        src.clear(old)
+
+
+def _upgrade_v0_layer(conn: Message, pad: Optional[int]) -> Message:
+    """One V0 connection {layer{...} bottom top} -> modern layer message.
+    `pad` is carried in from a preceding V0 "padding" layer, if any
+    (upgrade_proto.cpp UpgradeV0PaddingLayers)."""
+    v0 = conn.get("layer")
+    out = Message()
+    if v0.has("name"):
+        out.set("name", v0.get("name"))
+    old_type = str(v0.get("type", ""))
+    if old_type not in V0_TYPE_TO_NAME:
+        raise ValueError(f"unknown V0 layer type {old_type!r}")
+    new_type = V0_TYPE_TO_NAME[old_type]
+    out.set("type", new_type)
+    for b in conn.getlist("bottom"):
+        out.add("bottom", b)
+    for t in conn.getlist("top"):
+        out.add("top", t)
+
+    if new_type in ("Convolution", "InnerProduct"):
+        pm = Message()
+        _move_fields(v0, pm, {
+            "num_output": "num_output", "biasterm": "bias_term",
+            "weight_filler": "weight_filler", "bias_filler": "bias_filler"})
+        if new_type == "Convolution":
+            _move_fields(v0, pm, {"pad": "pad", "kernelsize": "kernel_size",
+                                  "group": "group", "stride": "stride"})
+            if pad is not None:
+                pm.set("pad", pad)
+        out.set("convolution_param" if new_type == "Convolution"
+                else "inner_product_param", pm)
+    elif new_type == "Pooling":
+        pm = Message()
+        if v0.has("pool"):
+            pm.set("pool", Enum(str(v0.get("pool"))))
+        _move_fields(v0, pm, {"kernelsize": "kernel_size", "stride": "stride",
+                              "pad": "pad"})
+        out.set("pooling_param", pm)
+    elif new_type == "Dropout":
+        pm = Message()
+        _move_fields(v0, pm, {"dropout_ratio": "dropout_ratio"})
+        out.set("dropout_param", pm)
+    elif new_type == "LRN":
+        pm = Message()
+        _move_fields(v0, pm, {"local_size": "local_size", "alpha": "alpha",
+                              "beta": "beta", "k": "k"})
+        out.set("lrn_param", pm)
+    elif new_type == "Concat":
+        pm = Message()
+        _move_fields(v0, pm, {"concat_dim": "concat_dim"})
+        out.set("concat_param", pm)
+    elif new_type in ("Data", "ImageData", "HDF5Data", "WindowData"):
+        pm = Message()
+        _move_fields(v0, pm, {"source": "source", "batchsize": "batch_size",
+                              "rand_skip": "rand_skip"})
+        out.set({"Data": "data_param", "ImageData": "image_data_param",
+                 "HDF5Data": "hdf5_data_param",
+                 "WindowData": "window_data_param"}[new_type], pm)
+        tp = Message()
+        _move_fields(v0, tp, {"scale": "scale", "meanfile": "mean_file",
+                              "cropsize": "crop_size", "mirror": "mirror"})
+        if list(tp.keys()):
+            out.set("transform_param", tp)
+
+    for b in v0.getlist("blobs"):
+        out.add("blobs", b)
+    _v1_param_specs(v0, out)
+    return out
+
+
+def _v1_param_specs(src: Message, out: Message) -> None:
+    """blobs_lr / weight_decay / param-name lists -> modern `param` specs
+    (upgrade_proto.cpp UpgradeV1LayerParameter param handling)."""
+    names = [str(v) for v in src.getlist("param")]
+    lrs = [float(v) for v in src.getlist("blobs_lr")]
+    decays = [float(v) for v in src.getlist("weight_decay")]
+    n = max(len(names), len(lrs), len(decays))
+    for i in range(n):
+        spec = Message()
+        if i < len(names) and names[i]:
+            spec.set("name", names[i])
+        if i < len(lrs):
+            spec.set("lr_mult", lrs[i])
+        if i < len(decays):
+            spec.set("decay_mult", decays[i])
+        out.add("param", spec)
+
+
+def upgrade_v0_net(net: Message) -> Message:
+    """V0 -> modern, including padding-layer folding: a V0 "padding" layer's
+    pad value moves into the consuming conv and the padding layer vanishes,
+    with blob names rewired (upgrade_proto.cpp UpgradeV0PaddingLayers)."""
+    out = Message()
+    for k, v in net.items():
+        if k != "layers":
+            out.add(k, v)
+    # blob produced by a padding layer -> (source blob, pad value)
+    pad_tops: dict = {}
+    for conn in net.getlist("layers"):
+        v0 = conn.get("layer")
+        if v0 is not None and str(v0.get("type", "")) == "padding":
+            src = str(conn.getlist("bottom")[0])
+            top = str(conn.getlist("top")[0])
+            pad_tops[top] = (src, int(v0.get("pad", 0)))
+            continue
+        pad = None
+        bottoms = [str(b) for b in conn.getlist("bottom")]
+        if any(b in pad_tops for b in bottoms):
+            v0t = str(conn.get("layer").get("type", ""))
+            if v0t != "conv":
+                # the reference CHECKs padding feeds only convs
+                # (upgrade_proto.cpp UpgradeV0PaddingLayers)
+                raise ValueError(
+                    f"V0 padding layer output consumed by non-conv layer "
+                    f"type {v0t!r}")
+            conn = conn.copy()
+            rewired = []
+            for b in bottoms:
+                if b in pad_tops:
+                    src, pad = pad_tops[b]
+                    rewired.append(src)
+                else:
+                    rewired.append(b)
+            conn.set_list("bottom", rewired)
+        out.add("layer", _upgrade_v0_layer(conn, pad))
+    return out
+
+
+def upgrade_v1_layer(v1: Message) -> Message:
+    out = Message()
+    enum_name = str(v1.get("type", "NONE"))
+    if enum_name not in V1_TYPE_TO_NAME:
+        raise ValueError(f"unknown V1 layer type {enum_name!r}")
+    passthrough_skip = {"type", "blobs_lr", "weight_decay", "param",
+                        "blob_share_mode", "layer"}
+    if v1.has("name"):
+        out.set("name", v1.get("name"))
+        passthrough_skip.add("name")
+    out.set("type", V1_TYPE_TO_NAME[enum_name])
+    for k, v in v1.items():
+        if k not in passthrough_skip:
+            out.add(k, v)
+    _v1_param_specs(v1, out)
+    shares = [str(v) for v in v1.getlist("blob_share_mode")]
+    specs = out.getlist("param")
+    for i, mode in enumerate(shares):
+        if i < len(specs):
+            specs[i].set("share_mode", Enum(mode))
+    return out
+
+
+def upgrade_v1_net(net: Message) -> Message:
+    out = Message()
+    for k, v in net.items():
+        if k != "layers":
+            out.add(k, v)
+    for v1 in net.getlist("layers"):
+        out.add("layer", upgrade_v1_layer(v1))
+    return out
+
+
+def upgrade_net_data_transformation(net: Message) -> None:
+    """Move scale/mean_file/crop_size/mirror out of data params into
+    transform_param, in place (upgrade_proto.cpp
+    UpgradeNetDataTransformation)."""
+    for layer in net.getlist("layer"):
+        for pm_name in _DATA_PARAM_MSGS:
+            pm = layer.get(pm_name)
+            if not isinstance(pm, Message):
+                continue
+            moved = {f: pm.get(f) for f in _TRANSFORM_FIELDS if pm.has(f)}
+            if not moved:
+                continue
+            tp = layer.get("transform_param")
+            if not isinstance(tp, Message):
+                tp = Message()
+                layer.set("transform_param", tp)
+            for f, v in moved.items():
+                if not tp.has(f):
+                    tp.set(f, v)
+                pm.clear(f)
+
+
+def upgrade_net_as_needed(net: Message) -> Message:
+    """Full upgrade chain (upgrade_proto.cpp UpgradeNetAsNeeded:
+    V0 -> V1 -> data-transformation -> V2)."""
+    if net.has("layers"):
+        net = upgrade_v0_net(net) if _is_v0(net) else upgrade_v1_net(net)
+    upgrade_net_data_transformation(net)
+    return net
+
+
+def upgrade_solver_as_needed(solver: Message) -> Message:
+    """Old enum `solver_type` -> string `type` (upgrade_proto.cpp
+    UpgradeSolverType)."""
+    if not solver.has("solver_type"):
+        return solver
+    table = {"SGD": "SGD", "NESTEROV": "Nesterov", "ADAGRAD": "AdaGrad",
+             "RMSPROP": "RMSProp", "ADADELTA": "AdaDelta", "ADAM": "Adam",
+             "0": "SGD", "1": "Nesterov", "2": "AdaGrad", "3": "RMSProp",
+             "4": "AdaDelta", "5": "Adam"}
+    key = str(solver.get("solver_type"))
+    if key not in table:
+        raise ValueError(f"unknown solver_type {key!r}")
+    if not solver.has("type"):
+        solver.set("type", table[key])
+    solver.clear("solver_type")
+    return solver

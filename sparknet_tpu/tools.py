@@ -1,0 +1,178 @@
+"""Standalone converter / maintenance tools, exposed as CLI verbs.
+
+Mirrors the reference's tool binaries (reference: caffe/tools/):
+`upgrade_net_proto_text.cpp`, `upgrade_solver_proto_text.cpp`,
+`compute_image_mean.cpp`, `convert_imageset.cpp`, `extract_features.cpp`.
+Each `cmd_*` takes parsed argparse args and returns an exit code;
+`register(sub)` wires them into the main CLI's subparser registry.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+from typing import List
+
+import numpy as np
+
+
+def cmd_upgrade_net_proto_text(args) -> int:
+    """Upgrade a V0/V1 net prototxt to the modern schema
+    (reference: tools/upgrade_net_proto_text.cpp)."""
+    from .proto import caffe_pb, textformat
+
+    net = caffe_pb.load_net_prototxt(args.input)
+    with open(args.output, "w") as f:
+        f.write(textformat.serialize(net.msg))
+    print(f"Wrote upgraded NetParameter text proto to {args.output}")
+    return 0
+
+
+def cmd_upgrade_solver_proto_text(args) -> int:
+    """(reference: tools/upgrade_solver_proto_text.cpp)"""
+    from .proto import caffe_pb, textformat
+
+    sp = caffe_pb.load_solver_prototxt(args.input)
+    with open(args.output, "w") as f:
+        f.write(textformat.serialize(sp.msg))
+    print(f"Wrote upgraded SolverParameter text proto to {args.output}")
+    return 0
+
+
+def cmd_compute_image_mean(args) -> int:
+    """Per-pixel mean of every image in an ArrayStore, written as
+    mean.binaryproto (reference: tools/compute_image_mean.cpp; the
+    distributed analogue is preprocessing/ComputeMean.scala)."""
+    from .data.store import ArrayStoreCursor
+    from .proto.binaryproto import write_mean_binaryproto
+
+    cursor = ArrayStoreCursor(args.db)
+    total = None
+    n = 0
+    for _ in range(len(cursor)):
+        data, _label = cursor.next()
+        x = data.astype(np.float64)
+        total = x if total is None else total + x
+        n += 1
+    if n == 0:
+        print("empty store", file=sys.stderr)
+        return 1
+    mean = (total / n).astype(np.float32)
+    write_mean_binaryproto(args.output, mean)
+    print(f"Wrote mean of {n} images {mean.shape} to {args.output}")
+    return 0
+
+
+def cmd_convert_imageset(args) -> int:
+    """Build an ArrayStore from a root dir + listfile of
+    `relative/path.jpg label` lines (reference: tools/convert_imageset.cpp;
+    shuffle and resize flags mirror its gflags)."""
+    from .data.scale_convert import decode_and_resize
+    from .data.store import ArrayStoreWriter
+
+    entries: List[tuple] = []
+    with open(args.listfile) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            path, label = line.rsplit(None, 1)
+            entries.append((path, int(label)))
+    if args.shuffle:
+        rng = np.random.RandomState(args.seed)
+        rng.shuffle(entries)
+    store = ArrayStoreWriter(args.db)
+    n_ok, n_bad = 0, 0
+    for path, label in entries:
+        with open(os.path.join(args.root, path), "rb") as f:
+            raw = f.read()
+        img = decode_and_resize(raw, args.resize_height or None,
+                                args.resize_width or None)
+        if img is None:
+            n_bad += 1  # corrupt images dropped, as ScaleAndConvert.scala:16-27
+            continue
+        store.put(img, label)
+        n_ok += 1
+    store.close()
+    print(f"Processed {n_ok} images ({n_bad} skipped) into {args.db}")
+    return 0
+
+
+def cmd_extract_features(args) -> int:
+    """Forward a trained net over a data source and dump named blob
+    activations (reference: tools/extract_features.cpp; the distributed
+    analogue is FeaturizerApp.scala:88-103 reading blob `ip1`)."""
+    import jax
+
+    from .core.net import Net
+    from .proto import caffe_pb
+    from .solver.solver import Solver
+
+    net_param = caffe_pb.load_net_prototxt(args.model)
+    bs = args.batch or 100
+    net_param = caffe_pb.replace_data_layers(net_param, bs, bs, 3, args.size,
+                                             args.size)
+    sp = caffe_pb.SolverParameter()
+    sp.msg.set("net_param", net_param.msg)
+    solver = Solver(sp)
+    if args.weights:
+        solver.load_weights(args.weights)
+    z = np.load(args.data)
+    data, label = z["data"].astype(np.float32), z["label"]
+    names = args.blobs.split(",")
+    feats = {n: [] for n in names}
+    key = jax.random.PRNGKey(0)
+    want = args.iterations if args.iterations is not None else 10
+    n_batches = min(want, len(data) // bs)
+    if n_batches <= 0:
+        print(f"no full batches: {len(data)} rows < batch size {bs} "
+              f"(or --iterations 0)", file=sys.stderr)
+        return 1
+    for i in range(n_batches):
+        batch = {"data": data[i * bs:(i + 1) * bs],
+                 "label": label[i * bs:(i + 1) * bs]}
+        blobs, _ = solver.test_net.apply(solver.params, batch, key,
+                                         train=False)
+        for n in names:
+            feats[n].append(np.asarray(blobs[n]))
+    np.savez(args.output, **{n: np.concatenate(v) for n, v in feats.items()})
+    print(f"Extracted {names} over {n_batches} batches to {args.output}")
+    return 0
+
+
+def register(sub) -> None:
+    u = sub.add_parser("upgrade_net_proto_text")
+    u.add_argument("input")
+    u.add_argument("output")
+    u.set_defaults(fn=cmd_upgrade_net_proto_text)
+
+    us = sub.add_parser("upgrade_solver_proto_text")
+    us.add_argument("input")
+    us.add_argument("output")
+    us.set_defaults(fn=cmd_upgrade_solver_proto_text)
+
+    cm = sub.add_parser("compute_image_mean")
+    cm.add_argument("db")
+    cm.add_argument("output")
+    cm.set_defaults(fn=cmd_compute_image_mean)
+
+    ci = sub.add_parser("convert_imageset")
+    ci.add_argument("root")
+    ci.add_argument("listfile")
+    ci.add_argument("db")
+    ci.add_argument("--shuffle", action="store_true")
+    ci.add_argument("--seed", type=int, default=0)
+    ci.add_argument("--resize_height", type=int, default=0)
+    ci.add_argument("--resize_width", type=int, default=0)
+    ci.set_defaults(fn=cmd_convert_imageset)
+
+    ef = sub.add_parser("extract_features")
+    ef.add_argument("--model", required=True)
+    ef.add_argument("--weights")
+    ef.add_argument("--data", required=True)
+    ef.add_argument("--blobs", required=True)
+    ef.add_argument("--output", required=True)
+    ef.add_argument("--batch", type=int)
+    ef.add_argument("--size", type=int, default=32)
+    ef.add_argument("--iterations", type=int)
+    ef.set_defaults(fn=cmd_extract_features)

@@ -1,0 +1,97 @@
+"""Converter-tool tests (reference: caffe/tools/compute_image_mean.cpp,
+convert_imageset.cpp, extract_features.cpp)."""
+
+import io
+import os
+
+import numpy as np
+import pytest
+
+from sparknet_tpu.cli import main
+from sparknet_tpu.data.store import ArrayStoreCursor, ArrayStoreWriter
+from sparknet_tpu.proto.binaryproto import read_mean_binaryproto
+
+
+def _write_png(path, arr_hwc):
+    from PIL import Image
+
+    Image.fromarray(arr_hwc).save(path)
+
+
+@pytest.fixture
+def image_dir(tmp_path):
+    rng = np.random.RandomState(0)
+    root = tmp_path / "imgs"
+    root.mkdir()
+    lines = []
+    for i in range(6):
+        arr = rng.randint(0, 255, size=(16, 16, 3), dtype=np.uint8)
+        _write_png(root / f"im{i}.png", arr)
+        lines.append(f"im{i}.png {i % 3}")
+    # one corrupt file, dropped like ScaleAndConvert.scala:17-26
+    (root / "bad.png").write_bytes(b"not an image")
+    lines.append("bad.png 0")
+    listfile = tmp_path / "list.txt"
+    listfile.write_text("\n".join(lines) + "\n")
+    return root, listfile
+
+
+def test_convert_imageset_and_mean(tmp_path, image_dir):
+    root, listfile = image_dir
+    db = tmp_path / "db"
+    assert main(["convert_imageset", str(root), str(listfile), str(db)]) == 0
+    cur = ArrayStoreCursor(str(db))
+    assert len(cur) == 6  # corrupt image skipped
+    imgs, labels = [], []
+    for _ in range(6):
+        d, l = cur.next()
+        imgs.append(d)
+        labels.append(l)
+    assert sorted(labels) == [0, 0, 1, 1, 2, 2]
+    assert imgs[0].shape == (3, 16, 16)
+
+    mean_path = tmp_path / "mean.binaryproto"
+    assert main(["compute_image_mean", str(db), str(mean_path)]) == 0
+    mean = read_mean_binaryproto(str(mean_path))
+    expected = np.stack(imgs).astype(np.float64).mean(axis=0)
+    np.testing.assert_allclose(mean, expected, rtol=1e-5)
+
+
+def test_convert_imageset_resize_and_shuffle(tmp_path, image_dir):
+    root, listfile = image_dir
+    db = tmp_path / "db_r"
+    assert main(["convert_imageset", str(root), str(listfile), str(db),
+                 "--shuffle", "--resize_height", "8",
+                 "--resize_width", "10"]) == 0
+    cur = ArrayStoreCursor(str(db))
+    d, _ = cur.next()
+    assert d.shape == (3, 8, 10)
+
+
+def test_extract_features(tmp_path):
+    rng = np.random.RandomState(0)
+    data = rng.rand(40, 3, 12, 12).astype(np.float32)
+    label = rng.randint(0, 5, size=(40,)).astype(np.int32)
+    npz = tmp_path / "d.npz"
+    np.savez(npz, data=data, label=label)
+    model = tmp_path / "m.prototxt"
+    model.write_text("""
+name: "feat"
+layer { name: "data" type: "MemoryData" top: "data" top: "label"
+  memory_data_param { batch_size: 20 channels: 3 height: 12 width: 12 } }
+layer { name: "ip1" type: "InnerProduct" bottom: "data" top: "ip1"
+  inner_product_param { num_output: 7 weight_filler { type: "xavier" } } }
+layer { name: "loss" type: "SoftmaxWithLoss" bottom: "ip1" bottom: "label"
+  top: "loss" }
+""")
+    out = tmp_path / "feats.npz"
+    assert main(["extract_features", "--model", str(model), "--data",
+                 str(npz), "--blobs", "ip1", "--output", str(out),
+                 "--batch", "20", "--size", "12", "--iterations", "2"]) == 0
+    z = np.load(out)
+    assert z["ip1"].shape == (40, 7)
+
+    # fewer rows than one batch -> clear failure, not a crash
+    assert main(["extract_features", "--model", str(model), "--data",
+                 str(npz), "--blobs", "ip1", "--output", str(out),
+                 "--batch", "100", "--size", "12"]) == 1

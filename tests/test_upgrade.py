@@ -1,0 +1,194 @@
+"""Legacy V0/V1 prototxt upgrade tests
+(reference intent: caffe/src/caffe/test/test_upgrade_proto.cpp)."""
+
+import numpy as np
+import pytest
+
+from sparknet_tpu.proto import caffe_pb, upgrade
+from sparknet_tpu.proto.textformat import parse
+
+V1_LENET = """
+name: "v1net"
+layers {
+  name: "data" type: DUMMY_DATA top: "data" top: "label"
+  dummy_data_param {
+    shape { dim: 4 dim: 1 dim: 12 dim: 12 }
+    shape { dim: 4 }
+  }
+}
+layers {
+  name: "conv1" type: CONVOLUTION bottom: "data" top: "conv1"
+  blobs_lr: 1 blobs_lr: 2
+  weight_decay: 1 weight_decay: 0
+  convolution_param {
+    num_output: 4 kernel_size: 5 stride: 1
+    weight_filler { type: "xavier" }
+  }
+}
+layers { name: "relu1" type: RELU bottom: "conv1" top: "conv1" }
+layers {
+  name: "pool1" type: POOLING bottom: "conv1" top: "pool1"
+  pooling_param { pool: MAX kernel_size: 2 stride: 2 }
+}
+layers {
+  name: "ip1" type: INNER_PRODUCT bottom: "pool1" top: "ip1"
+  inner_product_param { num_output: 10 weight_filler { type: "xavier" } }
+}
+layers {
+  name: "loss" type: SOFTMAX_LOSS bottom: "ip1" bottom: "label" top: "loss"
+}
+"""
+
+V0_NET = """
+name: "v0net"
+layers {
+  layer {
+    name: "conv1" type: "conv" num_output: 4 kernelsize: 3 stride: 1
+    weight_filler { type: "gaussian" std: 0.01 }
+    blobs_lr: 1 blobs_lr: 2
+  }
+  bottom: "data" top: "conv1"
+}
+layers {
+  layer { name: "pad1" type: "padding" pad: 2 }
+  bottom: "conv1" top: "pad1_out"
+}
+layers {
+  layer {
+    name: "conv2" type: "conv" num_output: 4 kernelsize: 5
+    weight_filler { type: "xavier" }
+  }
+  bottom: "pad1_out" top: "conv2"
+}
+layers {
+  layer { name: "relu2" type: "relu" }
+  bottom: "conv2" top: "conv2"
+}
+layers {
+  layer { name: "pool2" type: "pool" pool: AVE kernelsize: 2 stride: 2 }
+  bottom: "conv2" top: "pool2"
+}
+layers {
+  layer { name: "drop" type: "dropout" dropout_ratio: 0.3 }
+  bottom: "pool2" top: "pool2"
+}
+"""
+
+
+def test_v1_detect_and_upgrade():
+    msg = parse(V1_LENET)
+    assert upgrade.net_needs_upgrade(msg)
+    net = caffe_pb.NetParameter(upgrade.upgrade_net_as_needed(msg))
+    types = [str(l.type) for l in net.layers]
+    assert types == ["DummyData", "Convolution", "ReLU", "Pooling",
+                     "InnerProduct", "SoftmaxWithLoss"]
+    conv = net.layers[1]
+    specs = conv.params
+    assert [float(s.lr_mult) for s in specs] == [1.0, 2.0]
+    assert [float(s.decay_mult) for s in specs] == [1.0, 0.0]
+    assert int(conv.convolution_param.msg.get("num_output")) == 4
+
+
+def test_v1_net_builds_and_runs():
+    import jax
+
+    net_msg = upgrade.upgrade_net_as_needed(parse(V1_LENET))
+    from sparknet_tpu.core.net import Net
+
+    net = Net(caffe_pb.NetParameter(net_msg), "TRAIN")
+    params = net.init_params(0)
+    blobs, _ = net.apply(params, {}, jax.random.PRNGKey(0), train=True)
+    assert np.isfinite(float(blobs["loss"]))
+
+
+def test_v0_upgrade_with_padding_fold():
+    msg = parse(V0_NET)
+    assert upgrade.net_needs_upgrade(msg)
+    net = caffe_pb.NetParameter(upgrade.upgrade_net_as_needed(msg))
+    types = [str(l.type) for l in net.layers]
+    # padding layer folded away
+    assert types == ["Convolution", "Convolution", "ReLU", "Pooling",
+                     "Dropout"]
+    conv2 = net.layers[1]
+    assert int(conv2.convolution_param.msg.get("pad")) == 2
+    assert conv2.bottoms == ["conv1"]  # rewired past the padding layer
+    assert tuple(conv2.convolution_param.kernel) == (5, 5)
+    pool = net.layers[3]
+    assert str(pool.pooling_param.msg.get("pool")) == "AVE"
+    drop = net.layers[4]
+    assert float(drop.dropout_param.msg.get("dropout_ratio")) == \
+        pytest.approx(0.3)
+
+
+def test_v0_padding_preserves_other_bottoms():
+    msg = parse("""
+layers { layer { name: "p" type: "padding" pad: 1 } bottom: "data" top: "pd" }
+layers {
+  layer { name: "c" type: "conv" num_output: 2 kernelsize: 3 }
+  bottom: "pd" bottom: "extra" top: "c"
+}
+""")
+    net = caffe_pb.NetParameter(upgrade.upgrade_net_as_needed(msg))
+    assert net.layers[0].bottoms == ["data", "extra"]
+    assert int(net.layers[0].convolution_param.msg.get("pad")) == 1
+
+
+def test_v0_padding_into_non_conv_rejected():
+    msg = parse("""
+layers { layer { name: "p" type: "padding" pad: 1 } bottom: "d" top: "pd" }
+layers { layer { name: "q" type: "pool" kernelsize: 2 } bottom: "pd" top: "o" }
+""")
+    with pytest.raises(ValueError, match="non-conv"):
+        upgrade.upgrade_net_as_needed(msg)
+
+
+def test_data_transformation_upgrade():
+    msg = parse("""
+layer {
+  name: "d" type: "Data" top: "data" top: "label"
+  data_param { source: "db" batch_size: 8 scale: 0.00390625
+               mean_file: "m.binaryproto" crop_size: 27 mirror: true }
+}
+""")
+    assert upgrade.net_needs_upgrade(msg)
+    net = caffe_pb.NetParameter(upgrade.upgrade_net_as_needed(msg))
+    layer = net.layers[0]
+    tp = layer.msg.get("transform_param")
+    assert float(tp.get("scale")) == pytest.approx(0.00390625)
+    assert str(tp.get("mean_file")) == "m.binaryproto"
+    assert int(tp.get("crop_size")) == 27
+    assert tp.get("mirror") is True
+    dp = layer.msg.get("data_param")
+    assert not dp.has("scale") and not dp.has("crop_size")
+    assert int(dp.get("batch_size")) == 8
+
+
+def test_modern_net_untouched():
+    path = "/root/reference/caffe/examples/mnist/lenet_train_test.prototxt"
+    msg = parse(open(path).read())
+    assert not upgrade.net_needs_upgrade(msg)
+
+
+def test_solver_type_upgrade():
+    msg = parse('base_lr: 0.01\nsolver_type: ADAGRAD\n')
+    assert upgrade.solver_needs_upgrade(msg)
+    sp = caffe_pb.SolverParameter(upgrade.upgrade_solver_as_needed(msg))
+    assert sp.resolved_type() == "AdaGrad"
+    assert not sp.msg.has("solver_type")
+
+
+def test_upgrade_cli_roundtrip(tmp_path):
+    from sparknet_tpu.cli import main
+
+    src = tmp_path / "v1.prototxt"
+    src.write_text(V1_LENET)
+    dst = tmp_path / "v2.prototxt"
+    assert main(["upgrade_net_proto_text", str(src), str(dst)]) == 0
+    net = caffe_pb.load_net_prototxt(str(dst))
+    assert [str(l.type) for l in net.layers][1] == "Convolution"
+    ssrc = tmp_path / "s.prototxt"
+    ssrc.write_text("base_lr: 0.1\nsolver_type: NESTEROV\n")
+    sdst = tmp_path / "s2.prototxt"
+    assert main(["upgrade_solver_proto_text", str(ssrc), str(sdst)]) == 0
+    assert caffe_pb.load_solver_prototxt(str(sdst)).resolved_type() == \
+        "Nesterov"
