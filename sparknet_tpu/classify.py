@@ -1,0 +1,222 @@
+"""Image-classification convenience API: the pycaffe `Classifier`/`Detector`
+analogue (reference: caffe/python/caffe/classifier.py,
+caffe/python/caffe/detector.py, CLIs caffe/python/classify.py + detect.py,
+crop helpers caffe/python/caffe/io.py:305-361).
+
+`Classifier.predict` reproduces the reference behavior: resize inputs to
+`image_dims`, then either a center crop or 10-crop oversampling (4 corners +
+center, plus mirrors), forward through a TEST-phase net, average the
+per-crop class probabilities.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+
+def resize_image(img_hwc: np.ndarray, new_dims: Sequence[int]) -> np.ndarray:
+    """Bilinear resize of an HWC float image (reference: io.py:305-338)."""
+    from PIL import Image
+
+    h, w = int(new_dims[0]), int(new_dims[1])
+    if img_hwc.shape[:2] == (h, w):
+        return img_hwc.astype(np.float32)
+    lo, hi = float(img_hwc.min()), float(img_hwc.max())
+    scale = 255.0 / (hi - lo) if hi > lo else 1.0
+    u8 = ((img_hwc - lo) * scale).astype(np.uint8)
+    out = np.stack([
+        np.asarray(Image.fromarray(u8[..., c]).resize((w, h),
+                                                      Image.BILINEAR),
+                   dtype=np.float32)
+        for c in range(u8.shape[2])], axis=2)
+    return out / scale + lo
+
+
+def oversample(images_hwc: Sequence[np.ndarray],
+               crop_dims: Sequence[int]) -> np.ndarray:
+    """10-crop: 4 corners + center, each mirrored
+    (reference: io.py:340-361)."""
+    ch, cw = int(crop_dims[0]), int(crop_dims[1])
+    out: List[np.ndarray] = []
+    for im in images_hwc:
+        h, w = im.shape[:2]
+        ys = [0, h - ch]
+        xs = [0, w - cw]
+        crops = [im[y:y + ch, x:x + cw] for y in ys for x in xs]
+        crops.append(im[(h - ch) // 2:(h - ch) // 2 + ch,
+                        (w - cw) // 2:(w - cw) // 2 + cw])
+        for c in list(crops):
+            crops.append(c[:, ::-1])
+        out.extend(crops)
+    return np.asarray(out, dtype=np.float32)
+
+
+def center_crop(images_hwc: Sequence[np.ndarray],
+                crop_dims: Sequence[int]) -> np.ndarray:
+    ch, cw = int(crop_dims[0]), int(crop_dims[1])
+    out = []
+    for im in images_hwc:
+        h, w = im.shape[:2]
+        out.append(im[(h - ch) // 2:(h - ch) // 2 + ch,
+                      (w - cw) // 2:(w - cw) // 2 + cw])
+    return np.asarray(out, dtype=np.float32)
+
+
+def load_image(path: str, color: bool = True) -> np.ndarray:
+    """Image file -> HWC float32 in [0, 1] RGB (reference: io.py
+    load_image)."""
+    from PIL import Image
+
+    img = Image.open(path).convert("RGB" if color else "L")
+    arr = np.asarray(img, dtype=np.float32) / 255.0
+    if not color:
+        arr = arr[..., None]
+    return arr
+
+
+class Classifier:
+    """TEST-phase forward classification with reference-compatible
+    preprocessing (reference: classifier.py:11-98).
+
+    Preprocessing order per the reference Transformer (io.py:123-153):
+    resize -> raw_scale -> channel_swap -> mean subtract -> input_scale,
+    with data in CHW for the net.
+    """
+
+    def __init__(self, model_file: str, pretrained_file: Optional[str] = None,
+                 *, image_dims: Optional[Sequence[int]] = None,
+                 mean: Optional[np.ndarray] = None,
+                 input_scale: Optional[float] = None,
+                 raw_scale: Optional[float] = None,
+                 channel_swap: Optional[Sequence[int]] = None,
+                 batch_override: Optional[int] = None) -> None:
+        from .core.net import Net
+        from .proto import caffe_pb
+
+        net_param = caffe_pb.load_net_prototxt(model_file)
+        self.net = Net(net_param, "TEST", batch_override=batch_override)
+        self.params = self.net.init_params(0)
+        if pretrained_file:
+            self._load_pretrained(pretrained_file)
+        in_blob = self.net.input_blobs[0]
+        self.input_name = in_blob
+        shape = self.net.blob_shapes[in_blob]
+        self.crop_dims = np.array(shape[2:])
+        self.image_dims = np.array(image_dims if image_dims is not None
+                                   else self.crop_dims)
+        self.mean = mean
+        self.input_scale = input_scale
+        self.raw_scale = raw_scale
+        self.channel_swap = channel_swap
+
+    def _load_pretrained(self, path: str) -> None:
+        """Accepts .npz weight files or .caffemodel binaryprotos
+        (reference: Net::CopyTrainedLayersFrom, net.cpp:805-860)."""
+        import jax.numpy as jnp
+
+        if path.endswith(".caffemodel"):
+            from .proto.binaryproto import read_caffemodel
+
+            weights = read_caffemodel(path)
+        elif path.endswith(".h5"):
+            from .proto.hdf5_format import read_weights_hdf5
+
+            weights = read_weights_hdf5(path)
+        else:
+            z = np.load(path)
+            self.params = {k: jnp.asarray(z[k]) if k in z.files else v
+                           for k, v in self.params.items()}
+            return
+        names = {bl.name for bl in self.net.layers}
+        self.params = self.net.set_weights(
+            self.params, {k: v for k, v in weights.items() if k in names})
+
+    def _preprocess(self, crops: np.ndarray) -> np.ndarray:
+        """HWC crop batch -> net-ready NCHW (reference: io.py
+        Transformer.preprocess:123-153)."""
+        x = crops
+        if self.raw_scale is not None:
+            x = x * self.raw_scale
+        if self.channel_swap is not None:
+            x = x[..., list(self.channel_swap)]
+        x = np.transpose(x, (0, 3, 1, 2)).astype(np.float32)
+        if self.mean is not None:
+            m = self.mean
+            if m.ndim == 1:
+                m = m[:, None, None]
+            x = x - m
+        if self.input_scale is not None:
+            x = x * self.input_scale
+        return x
+
+    def predict(self, inputs: Sequence[np.ndarray],
+                oversample_crops: bool = True) -> np.ndarray:
+        """(N_images, n_classes) probabilities; 10-crop averaged when
+        `oversample_crops` (reference: classifier.py:47-98)."""
+        imgs = [resize_image(im, self.image_dims) for im in inputs]
+        if oversample_crops:
+            crops = oversample(imgs, self.crop_dims)
+            n_per = 10
+        else:
+            crops = center_crop(imgs, self.crop_dims)
+            n_per = 1
+        x = self._preprocess(crops)
+        probs = self._forward_probs(x)
+        probs = probs.reshape(len(inputs), n_per, -1).mean(axis=1)
+        return probs
+
+    def _forward_probs(self, x: np.ndarray) -> np.ndarray:
+        import jax.numpy as jnp
+
+        batch = self.net.blob_shapes[self.input_name][0]
+        outs = []
+        prob_blob = self._prob_blob()
+        for i in range(0, len(x), batch):
+            chunk = x[i:i + batch]
+            pad = batch - len(chunk)
+            if pad:
+                chunk = np.concatenate(
+                    [chunk, np.zeros((pad,) + chunk.shape[1:],
+                                     np.float32)])
+            feed = {self.input_name: jnp.asarray(chunk)}
+            for b in self.net.input_blobs[1:]:
+                shape = self.net.blob_shapes[b]
+                feed[b] = jnp.zeros(shape, jnp.int32 if len(shape) == 1
+                                    else jnp.float32)
+            blobs = self.net.forward(self.params, feed)
+            out = np.asarray(blobs[prob_blob])
+            outs.append(out[:len(x[i:i + batch])] if pad else out)
+        return np.concatenate(outs)
+
+    def _prob_blob(self) -> str:
+        """Last softmax-ish output, else the last top blob."""
+        for layer in reversed(self.net.layers):
+            if layer.type in ("Softmax",):
+                return layer.tops[0]
+        return self.net.output_blobs[-1]
+
+
+class Detector(Classifier):
+    """Windowed detection-by-classification
+    (reference: caffe/python/caffe/detector.py — crops each window, adds
+    context padding, classifies every crop)."""
+
+    def detect_windows(self, images_windows: Sequence[Tuple[np.ndarray,
+                                                            Sequence]],
+                       ) -> List[dict]:
+        dets: List[dict] = []
+        crops, meta = [], []
+        for image, windows in images_windows:
+            for ymin, xmin, ymax, xmax in windows:
+                crop = image[int(ymin):int(ymax), int(xmin):int(xmax)]
+                crops.append(resize_image(crop, self.crop_dims))
+                meta.append((ymin, xmin, ymax, xmax))
+        if not crops:
+            return dets
+        x = self._preprocess(np.asarray(crops, dtype=np.float32))
+        probs = self._forward_probs(x)
+        for (window, p) in zip(meta, probs):
+            dets.append({"window": window, "prediction": p})
+        return dets

@@ -1,0 +1,112 @@
+"""Net visualization: NetParameter -> Graphviz DOT text
+(reference: caffe/python/caffe/draw.py + caffe/python/draw_net.py, which
+render via pydot; here we emit the .dot source so no graphviz binary is
+required — `dot -Tpng out.dot` renders it).
+
+Layer nodes are octagons labelled with type and key hyperparameters
+(the reference annotates conv kernel/stride/pad and pooling type); blob
+nodes are ovals; in-place layers (top == bottom, e.g. ReLU) are collapsed
+onto their blob like the reference's display.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+from .proto.caffe_pb import LayerParameter, NetParameter
+
+LAYER_STYLE = 'shape=octagon, fillcolor="#6495ED", style=filled'
+BLOB_STYLE = 'shape=oval, fillcolor="#E0E0E0", style=filled'
+
+
+def _layer_label(layer: LayerParameter) -> str:
+    ltype = str(layer.type)
+    bits = [f"{layer.name}", f"({ltype})"]
+    if ltype in ("Convolution", "Deconvolution"):
+        cp = layer.convolution_param
+        k = cp.kernel
+        s = cp.stride
+        p = cp.pad
+        bits.append(f"kernel {k[0]}x{k[1]}, stride {s[0]}, pad {p[0]}")
+        bits.append(f"out {int(cp.msg.get('num_output', 0))}")
+    elif ltype == "Pooling":
+        pp = layer.pooling_param
+        k = pp.kernel
+        bits.append(f"{str(pp.msg.get('pool', 'MAX'))} {k[0]}x{k[1]} "
+                    f"stride {pp.strides[0]}")
+    elif ltype == "InnerProduct":
+        bits.append(f"out {int(layer.inner_product_param.msg.get('num_output', 0))}")
+    elif ltype == "LRN":
+        bits.append(f"local_size {int(layer.lrn_param.msg.get('local_size', 5))}")
+    return "\\n".join(bits)
+
+
+def _quote(s: str) -> str:
+    return '"' + s.replace('"', '\\"') + '"'
+
+
+def net_to_dot(net: NetParameter, *, phase: Optional[str] = None,
+               rankdir: str = "TB") -> str:
+    """DOT source for the net graph, optionally filtered to one phase
+    (reference: draw.py get_pydot_graph; phase filtering matches
+    net.cpp:290-306 FilterNet)."""
+    from .core.net import phase_matches
+    from .proto.caffe_pb import NetState
+
+    lines: List[str] = [
+        f'digraph {_quote(str(net.name) or "net")} {{',
+        f"  rankdir={rankdir};",
+    ]
+    state = None
+    if phase is not None:
+        from .proto.textformat import Enum, Message
+
+        m = Message()
+        m.set("phase", Enum(phase))
+        state = NetState(m)
+    seen_blobs = set()
+    edges: List[str] = []
+    for i, layer in enumerate(net.layers):
+        if state is not None and not phase_matches(layer, state):
+            continue
+        bottoms, tops = layer.bottoms, layer.tops
+        in_place = bottoms and tops == bottoms
+        lid = f"layer_{i}"
+        lines.append(f"  {lid} [label={_quote(_layer_label(layer))}, "
+                     f"{LAYER_STYLE}];")
+        for b in bottoms:
+            if b not in seen_blobs:
+                lines.append(f"  blob_{b} [label={_quote(b)}, {BLOB_STYLE}];")
+                seen_blobs.add(b)
+            edges.append(f"  blob_{b} -> {lid};")
+        if not in_place:
+            for t in tops:
+                if t not in seen_blobs:
+                    lines.append(f"  blob_{t} [label={_quote(t)}, "
+                                 f"{BLOB_STYLE}];")
+                    seen_blobs.add(t)
+                edges.append(f"  {lid} -> blob_{t};")
+    lines.extend(edges)
+    lines.append("}")
+    return "\n".join(lines) + "\n"
+
+
+def cmd_draw_net(args) -> int:
+    """CLI verb (reference: python/draw_net.py main)."""
+    from .proto import caffe_pb
+
+    net = caffe_pb.load_net_prototxt(args.model)
+    dot = net_to_dot(net, phase=args.phase, rankdir=args.rankdir)
+    with open(args.output, "w") as f:
+        f.write(dot)
+    print(f"Wrote DOT graph ({len(net.layers)} layers) to {args.output}")
+    return 0
+
+
+def register(sub) -> None:
+    d = sub.add_parser("draw_net")
+    d.add_argument("model")
+    d.add_argument("output")
+    d.add_argument("--phase", choices=["TRAIN", "TEST"])
+    d.add_argument("--rankdir", default="TB", choices=["TB", "LR", "BT", "RL"])
+    d.set_defaults(fn=cmd_draw_net)

@@ -587,7 +587,8 @@ def load_net_prototxt(path: str) -> NetParameter:
     """Parse a net prototxt, transparently upgrading legacy V0/V1 formats
     (reference: ProtoLoader.scala:9-29 via C++;
     upgrade_proto.cpp ReadNetParamsFromTextFileOrDie)."""
-    return parse_net_text(open(path).read())
+    from . import upgrade
+    return NetParameter(upgrade.upgrade_net_as_needed(parse_file(path)))
 
 
 def parse_net_text(text: str) -> NetParameter:

@@ -143,6 +143,10 @@ def _upgrade_v0_layer(conn: Message, pad: Optional[int]) -> Message:
     `pad` is carried in from a preceding V0 "padding" layer, if any
     (upgrade_proto.cpp UpgradeV0PaddingLayers)."""
     v0 = conn.get("layer")
+    if not isinstance(v0, Message):
+        raise ValueError(
+            "V0 net mixes connection styles: `layers` entry without a "
+            "nested `layer` message")
     out = Message()
     if v0.has("name"):
         out.set("name", v0.get("name"))

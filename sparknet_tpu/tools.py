@@ -84,8 +84,12 @@ def cmd_convert_imageset(args) -> int:
     store = ArrayStoreWriter(args.db)
     n_ok, n_bad = 0, 0
     for path, label in entries:
-        with open(os.path.join(args.root, path), "rb") as f:
-            raw = f.read()
+        try:
+            with open(os.path.join(args.root, path), "rb") as f:
+                raw = f.read()
+        except OSError:
+            n_bad += 1  # missing files skipped like corrupt ones
+            continue
         img = decode_and_resize(raw, args.resize_height or None,
                                 args.resize_width or None)
         if img is None:
@@ -140,6 +144,38 @@ def cmd_extract_features(args) -> int:
     return 0
 
 
+def cmd_classify(args) -> int:
+    """Classify image files, writing an (N, n_classes) probability array
+    (reference: caffe/python/classify.py main)."""
+    from .classify import Classifier, load_image
+
+    mean = None
+    if args.mean:
+        if args.mean.endswith(".binaryproto"):
+            from .proto.binaryproto import read_mean_binaryproto
+
+            mean = read_mean_binaryproto(args.mean).mean(axis=(1, 2))
+        else:
+            mean = np.array([float(v) for v in args.mean.split(",")],
+                            dtype=np.float32)
+    clf = Classifier(
+        args.model, args.weights,
+        image_dims=[int(v) for v in args.images_dim.split(",")]
+        if args.images_dim else None,
+        mean=mean,
+        raw_scale=args.raw_scale,
+        input_scale=args.input_scale,
+        channel_swap=[int(v) for v in args.channel_swap.split(",")]
+        if args.channel_swap else None)
+    imgs = [load_image(p) for p in args.inputs]
+    probs = clf.predict(imgs, oversample_crops=not args.center_only)
+    np.save(args.output, probs)
+    for path, p in zip(args.inputs, probs):
+        top = int(np.argmax(p))
+        print(f"{path}: class {top} p={float(p[top]):.4f}")
+    return 0
+
+
 def register(sub) -> None:
     u = sub.add_parser("upgrade_net_proto_text")
     u.add_argument("input")
@@ -176,3 +212,21 @@ def register(sub) -> None:
     ef.add_argument("--size", type=int, default=32)
     ef.add_argument("--iterations", type=int)
     ef.set_defaults(fn=cmd_extract_features)
+
+    cl = sub.add_parser("classify")
+    cl.add_argument("inputs", nargs="+")
+    cl.add_argument("--model", required=True)
+    cl.add_argument("--weights")
+    cl.add_argument("--output", required=True)
+    cl.add_argument("--mean")
+    cl.add_argument("--images_dim")
+    # 255.0 matches load_image's [0,1] output against 0-255 means
+    # (reference: python/classify.py --raw_scale default)
+    cl.add_argument("--raw_scale", type=float, default=255.0)
+    cl.add_argument("--input_scale", type=float)
+    cl.add_argument("--channel_swap")
+    cl.add_argument("--center_only", action="store_true")
+    cl.set_defaults(fn=cmd_classify)
+
+    from . import draw_net
+    draw_net.register(sub)

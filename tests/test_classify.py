@@ -1,0 +1,164 @@
+"""Classifier / Detector / draw_net tests (reference:
+caffe/python/caffe/classifier.py, detector.py, draw_net.py)."""
+
+import numpy as np
+import pytest
+
+from sparknet_tpu.classify import (Classifier, Detector, center_crop,
+                                   load_image, oversample, resize_image)
+from sparknet_tpu.draw_net import net_to_dot
+from sparknet_tpu.proto import caffe_pb
+
+DEPLOY = """
+name: "tiny_deploy"
+input: "data"
+input_shape { dim: 4 dim: 3 dim: 12 dim: 12 }
+layer { name: "conv1" type: "Convolution" bottom: "data" top: "conv1"
+  convolution_param { num_output: 4 kernel_size: 3 pad: 1
+    weight_filler { type: "xavier" } } }
+layer { name: "relu1" type: "ReLU" bottom: "conv1" top: "conv1" }
+layer { name: "pool1" type: "Pooling" bottom: "conv1" top: "pool1"
+  pooling_param { pool: MAX kernel_size: 2 stride: 2 } }
+layer { name: "ip1" type: "InnerProduct" bottom: "pool1" top: "ip1"
+  inner_product_param { num_output: 5 weight_filler { type: "xavier" } } }
+layer { name: "prob" type: "Softmax" bottom: "ip1" top: "prob" }
+"""
+
+
+@pytest.fixture
+def deploy_file(tmp_path):
+    p = tmp_path / "deploy.prototxt"
+    p.write_text(DEPLOY)
+    return str(p)
+
+
+def test_oversample_is_ten_crops():
+    im = np.arange(20 * 24 * 3, dtype=np.float32).reshape(20, 24, 3)
+    crops = oversample([im], (12, 12))
+    assert crops.shape == (10, 12, 12, 3)
+    # center crop present, all crops distinct windows of the image
+    c = center_crop([im], (12, 12))[0]
+    assert any(np.array_equal(c, crop) for crop in crops)
+    # mirrors are the second half
+    np.testing.assert_array_equal(crops[5], crops[0][:, ::-1])
+
+
+def test_resize_image_roundtrip():
+    im = np.random.RandomState(0).rand(8, 8, 3).astype(np.float32)
+    out = resize_image(im, (8, 8))
+    np.testing.assert_array_equal(out, im)
+    up = resize_image(im, (16, 20))
+    assert up.shape == (16, 20, 3)
+    assert up.min() >= im.min() - 1e-3 and up.max() <= im.max() + 1e-3
+
+
+def test_classifier_predict_shapes(deploy_file):
+    clf = Classifier(deploy_file)
+    rng = np.random.RandomState(0)
+    imgs = [rng.rand(16, 16, 3).astype(np.float32) for _ in range(3)]
+    probs = clf.predict(imgs)  # oversampled: 30 crops over batch 4
+    assert probs.shape == (3, 5)
+    np.testing.assert_allclose(probs.sum(axis=1), 1.0, rtol=1e-4)
+    probs_c = clf.predict(imgs, oversample_crops=False)
+    assert probs_c.shape == (3, 5)
+
+
+def test_classifier_preprocessing_order(deploy_file):
+    mean = np.array([10.0, 20.0, 30.0], dtype=np.float32)
+    clf = Classifier(deploy_file, mean=mean, raw_scale=255.0,
+                     channel_swap=(2, 1, 0), input_scale=0.5)
+    x = clf._preprocess(np.ones((1, 12, 12, 3), np.float32))
+    # 1*255 -> swap (no-op for constant) -> minus mean -> *0.5
+    np.testing.assert_allclose(x[0, 0], (255.0 - 10.0) * 0.5)
+    np.testing.assert_allclose(x[0, 2], (255.0 - 30.0) * 0.5)
+    assert x.shape == (1, 3, 12, 12)
+
+
+def test_classifier_caffemodel_warm_start(tmp_path, deploy_file):
+    from sparknet_tpu.proto.binaryproto import write_caffemodel
+
+    clf = Classifier(deploy_file)
+    weights = clf.net.get_weights(clf.params)
+    # perturb and save; a fresh classifier must pick the weights up
+    weights["conv1"][0] = weights["conv1"][0] + 1.5
+    path = str(tmp_path / "w.caffemodel")
+    write_caffemodel(path, weights)
+    clf2 = Classifier(deploy_file, path)
+    got = clf2.net.get_weights(clf2.params)
+    np.testing.assert_allclose(got["conv1"][0], weights["conv1"][0],
+                               rtol=1e-6)
+
+
+def test_detector_windows(deploy_file):
+    det = Detector(deploy_file)
+    rng = np.random.RandomState(0)
+    image = rng.rand(40, 40, 3).astype(np.float32)
+    dets = det.detect_windows([(image, [(0, 0, 20, 20), (10, 10, 40, 40)])])
+    assert len(dets) == 2
+    assert dets[0]["prediction"].shape == (5,)
+    assert det.detect_windows([]) == []
+
+
+def test_load_image(tmp_path):
+    from PIL import Image
+
+    arr = np.random.RandomState(0).randint(0, 255, (10, 12, 3),
+                                           dtype=np.uint8)
+    p = tmp_path / "x.png"
+    Image.fromarray(arr).save(p)
+    im = load_image(str(p))
+    assert im.shape == (10, 12, 3)
+    assert 0.0 <= im.min() and im.max() <= 1.0
+    np.testing.assert_allclose(im, arr / 255.0, atol=1e-6)
+
+
+def test_draw_net_dot(deploy_file):
+    net = caffe_pb.load_net_prototxt(deploy_file)
+    dot = net_to_dot(net)
+    assert dot.startswith('digraph "tiny_deploy"')
+    assert '(Convolution)' in dot and 'kernel 3x3' in dot
+    assert 'blob_data -> layer_0' in dot
+    # in-place relu collapsed: no edge layer->conv1 blob from relu
+    assert dot.count("blob_conv1 [") == 1
+    assert dot.strip().endswith("}")
+
+
+def test_draw_net_phase_filter(tmp_path):
+    src = """
+name: "p"
+layer { name: "train_data" type: "DummyData" top: "data"
+  include { phase: TRAIN }
+  dummy_data_param { shape { dim: 1 dim: 1 dim: 4 dim: 4 } } }
+layer { name: "test_data" type: "DummyData" top: "data"
+  include { phase: TEST }
+  dummy_data_param { shape { dim: 1 dim: 1 dim: 4 dim: 4 } } }
+layer { name: "ip" type: "InnerProduct" bottom: "data" top: "ip"
+  inner_product_param { num_output: 2 } }
+"""
+    p = tmp_path / "n.prototxt"
+    p.write_text(src)
+    net = caffe_pb.load_net_prototxt(str(p))
+    dot = net_to_dot(net, phase="TRAIN")
+    assert "train_data" in dot and "test_data" not in dot
+
+
+def test_classify_and_draw_cli(tmp_path, deploy_file):
+    from PIL import Image
+
+    from sparknet_tpu.cli import main
+
+    rng = np.random.RandomState(0)
+    paths = []
+    for i in range(2):
+        p = tmp_path / f"im{i}.png"
+        Image.fromarray(rng.randint(0, 255, (16, 16, 3), np.uint8)).save(p)
+        paths.append(str(p))
+    out = tmp_path / "probs.npy"
+    assert main(["classify", *paths, "--model", deploy_file, "--output",
+                 str(out), "--center_only"]) == 0
+    probs = np.load(out)
+    assert probs.shape == (2, 5)
+
+    dot_out = tmp_path / "net.dot"
+    assert main(["draw_net", deploy_file, str(dot_out)]) == 0
+    assert dot_out.read_text().startswith("digraph")

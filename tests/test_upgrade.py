@@ -164,9 +164,26 @@ layer {
 
 
 def test_modern_net_untouched():
-    path = "/root/reference/caffe/examples/mnist/lenet_train_test.prototxt"
-    msg = parse(open(path).read())
+    msg = parse("""
+name: "modern"
+layer { name: "data" type: "DummyData" top: "data"
+  dummy_data_param { shape { dim: 1 dim: 1 dim: 4 dim: 4 } } }
+layer { name: "ip" type: "InnerProduct" bottom: "data" top: "ip"
+  inner_product_param { num_output: 2 } }
+""")
     assert not upgrade.net_needs_upgrade(msg)
+    out = upgrade.upgrade_net_as_needed(msg)
+    assert out is msg  # no-op for modern nets
+
+
+def test_mixed_v0_v1_rejected():
+    msg = parse("""
+layers { layer { name: "c" type: "conv" num_output: 1 kernelsize: 1 }
+  bottom: "d" top: "c" }
+layers { name: "r" type: RELU bottom: "c" top: "c" }
+""")
+    with pytest.raises(ValueError, match="connection styles"):
+        upgrade.upgrade_net_as_needed(msg)
 
 
 def test_solver_type_upgrade():
