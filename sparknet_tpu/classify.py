@@ -17,21 +17,27 @@ import numpy as np
 
 
 def resize_image(img_hwc: np.ndarray, new_dims: Sequence[int]) -> np.ndarray:
-    """Bilinear resize of an HWC float image (reference: io.py:305-338)."""
-    from PIL import Image
-
+    """Float bilinear resize of an HWC image, no quantization
+    (reference: io.py:305-338 resizes in float as well)."""
     h, w = int(new_dims[0]), int(new_dims[1])
-    if img_hwc.shape[:2] == (h, w):
-        return img_hwc.astype(np.float32)
-    lo, hi = float(img_hwc.min()), float(img_hwc.max())
-    scale = 255.0 / (hi - lo) if hi > lo else 1.0
-    u8 = ((img_hwc - lo) * scale).astype(np.uint8)
-    out = np.stack([
-        np.asarray(Image.fromarray(u8[..., c]).resize((w, h),
-                                                      Image.BILINEAR),
-                   dtype=np.float32)
-        for c in range(u8.shape[2])], axis=2)
-    return out / scale + lo
+    img = np.asarray(img_hwc, dtype=np.float32)
+    ih, iw = img.shape[:2]
+    if (ih, iw) == (h, w):
+        return img
+    if ih == 0 or iw == 0:
+        raise ValueError(f"cannot resize zero-size image {img.shape}")
+    # align-corners-free sample grid (matches PIL/skimage convention)
+    ys = (np.arange(h, dtype=np.float32) + 0.5) * ih / h - 0.5
+    xs = (np.arange(w, dtype=np.float32) + 0.5) * iw / w - 0.5
+    y0 = np.clip(np.floor(ys).astype(np.int32), 0, ih - 1)
+    x0 = np.clip(np.floor(xs).astype(np.int32), 0, iw - 1)
+    y1 = np.minimum(y0 + 1, ih - 1)
+    x1 = np.minimum(x0 + 1, iw - 1)
+    wy = np.clip(ys - y0, 0.0, 1.0)[:, None, None]
+    wx = np.clip(xs - x0, 0.0, 1.0)[None, :, None]
+    top = img[y0][:, x0] * (1 - wx) + img[y0][:, x1] * wx
+    bot = img[y1][:, x0] * (1 - wx) + img[y1][:, x1] * wx
+    return (top * (1 - wy) + bot * wy).astype(np.float32)
 
 
 def oversample(images_hwc: Sequence[np.ndarray],
@@ -200,8 +206,39 @@ class Classifier:
 
 class Detector(Classifier):
     """Windowed detection-by-classification
-    (reference: caffe/python/caffe/detector.py — crops each window, adds
-    context padding, classifies every crop)."""
+    (reference: caffe/python/caffe/detector.py — crops each window with
+    `context_pad` pixels of surrounding context, mean-filling where the
+    padded window leaves the image, then classifies every crop).
+
+    Zero-area or fully out-of-bounds windows are skipped (their entry is
+    returned with `prediction: None`) instead of aborting the batch.
+    """
+
+    def __init__(self, *a, context_pad: int = 0, **kw) -> None:
+        super().__init__(*a, **kw)
+        self.context_pad = int(context_pad)
+
+    def _crop_with_context(self, image: np.ndarray,
+                           window) -> Optional[np.ndarray]:
+        ymin, xmin, ymax, xmax = (int(v) for v in window)
+        p = self.context_pad
+        ih, iw = image.shape[:2]
+        cy0, cx0 = max(ymin - p, 0), max(xmin - p, 0)
+        cy1, cx1 = min(ymax + p, ih), min(xmax + p, iw)
+        if cy1 <= cy0 or cx1 <= cx0:
+            return None
+        crop = image[cy0:cy1, cx0:cx1]
+        if p and (cy0 > ymin - p or cx0 > xmin - p or cy1 < ymax + p
+                  or cx1 < xmax + p):
+            # padded window runs off the image: mean-fill the canvas
+            # (reference: detector.py detect_windows context handling)
+            canvas = np.full((ymax - ymin + 2 * p, xmax - xmin + 2 * p,
+                              image.shape[2]),
+                             float(image.mean()), np.float32)
+            oy, ox = cy0 - (ymin - p), cx0 - (xmin - p)
+            canvas[oy:oy + crop.shape[0], ox:ox + crop.shape[1]] = crop
+            crop = canvas
+        return resize_image(crop, self.crop_dims)
 
     def detect_windows(self, images_windows: Sequence[Tuple[np.ndarray,
                                                             Sequence]],
@@ -209,10 +246,13 @@ class Detector(Classifier):
         dets: List[dict] = []
         crops, meta = [], []
         for image, windows in images_windows:
-            for ymin, xmin, ymax, xmax in windows:
-                crop = image[int(ymin):int(ymax), int(xmin):int(xmax)]
-                crops.append(resize_image(crop, self.crop_dims))
-                meta.append((ymin, xmin, ymax, xmax))
+            for window in windows:
+                crop = self._crop_with_context(image, window)
+                if crop is None:
+                    dets.append({"window": tuple(window), "prediction": None})
+                    continue
+                crops.append(crop)
+                meta.append(tuple(window))
         if not crops:
             return dets
         x = self._preprocess(np.asarray(crops, dtype=np.float32))

@@ -64,28 +64,44 @@ def net_to_dot(net: NetParameter, *, phase: Optional[str] = None,
         m = Message()
         m.set("phase", Enum(phase))
         state = NetState(m)
+    visible = [l for l in net.layers
+               if state is None or phase_matches(l, state)]
+    # in-place layers (top == bottom, e.g. ReLU/Dropout) annotate their blob
+    # instead of appearing as nodes (reference: draw.py collapses them too)
+    blob_notes: dict = {}
+    for layer in visible:
+        if layer.bottoms and layer.tops == layer.bottoms:
+            blob_notes.setdefault(layer.tops[0], []).append(
+                f"{layer.name} ({layer.type})")
+
+    def blob_id(b: str) -> str:
+        return _quote(f"blob_{b}")
+
     seen_blobs = set()
     edges: List[str] = []
-    for i, layer in enumerate(net.layers):
-        if state is not None and not phase_matches(layer, state):
-            continue
+
+    def emit_blob(b: str) -> None:
+        if b in seen_blobs:
+            return
+        label = b
+        for note in blob_notes.get(b, []):
+            label += f"\\n+ {note}"
+        lines.append(f"  {blob_id(b)} [label={_quote(label)}, {BLOB_STYLE}];")
+        seen_blobs.add(b)
+
+    for i, layer in enumerate(visible):
         bottoms, tops = layer.bottoms, layer.tops
-        in_place = bottoms and tops == bottoms
-        lid = f"layer_{i}"
+        if bottoms and tops == bottoms:
+            continue  # collapsed onto the blob node
+        lid = _quote(f"layer_{i}")
         lines.append(f"  {lid} [label={_quote(_layer_label(layer))}, "
                      f"{LAYER_STYLE}];")
         for b in bottoms:
-            if b not in seen_blobs:
-                lines.append(f"  blob_{b} [label={_quote(b)}, {BLOB_STYLE}];")
-                seen_blobs.add(b)
-            edges.append(f"  blob_{b} -> {lid};")
-        if not in_place:
-            for t in tops:
-                if t not in seen_blobs:
-                    lines.append(f"  blob_{t} [label={_quote(t)}, "
-                                 f"{BLOB_STYLE}];")
-                    seen_blobs.add(t)
-                edges.append(f"  {lid} -> blob_{t};")
+            emit_blob(b)
+            edges.append(f"  {blob_id(b)} -> {lid};")
+        for t in tops:
+            emit_blob(t)
+            edges.append(f"  {lid} -> {blob_id(t)};")
     lines.extend(edges)
     lines.append("}")
     return "\n".join(lines) + "\n"

@@ -1,0 +1,2 @@
+"""Cluster infrastructure tier (reference: ec2/ — spark_ec2.py launcher,
+pull.py / create_labelfile.py ImageNet tooling), re-targeted at GCP TPU VMs."""

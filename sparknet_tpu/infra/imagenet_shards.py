@@ -1,0 +1,116 @@
+"""ImageNet shard tooling: the `ec2/pull.py` + `ec2/create_labelfile.py`
+analogues.
+
+The reference pulls `files-shuf-%03d.tar` shards from S3 and un-tars JPEGs
+into a per-range directory (reference: ec2/pull.py — range [start, stop)
+into `<dir>/<start>-<stop>/`), then rebuilds a train.txt restricted to the
+files actually present, matching names case-insensitively
+(reference: ec2/create_labelfile.py).  Here the shard source is a local
+directory or a `gs://` prefix (fetched via gsutil), since TPU-VM data
+normally lives in GCS.
+"""
+
+from __future__ import annotations
+
+import argparse
+import io
+import os
+import subprocess
+import sys
+import tarfile
+from typing import Optional
+
+SHARD_PATTERN = "files-shuf-%03d.tar"
+
+
+def _read_shard(source: str, idx: int) -> bytes:
+    path = f"{source.rstrip('/')}/{SHARD_PATTERN % idx}"
+    if source.startswith("gs://"):
+        out = subprocess.run(["gsutil", "cat", path], check=True,
+                             stdout=subprocess.PIPE)
+        return out.stdout
+    with open(path, "rb") as f:
+        return f.read()
+
+
+def pull_shards(start_idx: int, stop_idx: int, directory: str,
+                source: str) -> int:
+    """Extract shards [start_idx, stop_idx) into
+    `<directory>/<start>-<stop>/`, returning the JPEG count
+    (reference: ec2/pull.py:23-49)."""
+    out_dir = os.path.join(directory, "%03d-%03d" % (start_idx, stop_idx))
+    os.makedirs(out_dir, exist_ok=True)
+    n = 0
+    for idx in range(start_idx, stop_idx):
+        raw = _read_shard(source, idx)
+        with tarfile.open(mode="r", fileobj=io.BytesIO(raw)) as tar:
+            for member in tar.getmembers():
+                if not member.isfile():
+                    continue
+                f = tar.extractfile(member)
+                if f is None:
+                    continue
+                name = os.path.basename(member.name)
+                with open(os.path.join(out_dir, name), "wb") as out:
+                    out.write(f.read())
+                n += 1
+    return n
+
+
+def create_labelfile(directory: str, trainfile: str, outfile: str,
+                     *, strict: bool = False) -> int:
+    """Walk `directory` and write `<fname> <label>` lines for every file
+    found in the master trainfile, matching names case-insensitively
+    (reference: ec2/create_labelfile.py).  Unknown files are skipped unless
+    `strict` (the reference KeyErrors on them)."""
+    labelmap = {}
+    with open(trainfile) as f:
+        for line in f:
+            parts = line.split()
+            if len(parts) == 2:
+                labelmap[parts[0].upper()] = parts[1]
+    n = 0
+    with open(outfile, "w") as out:
+        for root, _dirs, files in os.walk(directory):
+            for fname in sorted(files):
+                key = fname.upper()
+                if key not in labelmap:
+                    if strict:
+                        raise KeyError(f"{fname} not in {trainfile}")
+                    continue
+                out.write(f"{fname} {labelmap[key]}\n")
+                n += 1
+    return n
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="imagenet_shards")
+    sub = p.add_subparsers(dest="verb", required=True)
+
+    pl = sub.add_parser("pull")
+    pl.add_argument("start_idx", type=int)
+    pl.add_argument("stop_idx", type=int)
+    pl.add_argument("directory")
+    pl.add_argument("--source", required=True,
+                    help="local dir or gs:// prefix holding the tar shards")
+
+    lf = sub.add_parser("labelfile")
+    lf.add_argument("directory")
+    lf.add_argument("trainfile")
+    lf.add_argument("outfile")
+    lf.add_argument("--strict", action="store_true")
+
+    args = p.parse_args(argv)
+    if args.verb == "pull":
+        n = pull_shards(args.start_idx, args.stop_idx, args.directory,
+                        args.source)
+        print(f"Extracted {n} files")
+    else:
+        n = create_labelfile(args.directory, args.trainfile, args.outfile,
+                             strict=args.strict)
+        print(f"Wrote {n} labelled entries to {args.outfile}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,166 @@
+"""TPU-VM cluster launcher: the `ec2/spark_ec2.py` analogue.
+
+The reference launches/destroys/logs-into EC2 Spark clusters with boto
+(reference: ec2/spark_ec2.py:1342-1518 — actions launch, destroy, login,
+get-master, stop, start).  The TPU equivalent drives `gcloud compute tpus
+tpu-vm` over a named TPU slice: one pod slice IS the cluster (workers =
+hosts of the slice; there is no separate master — JAX's multi-host runtime
+discovers peers through the TPU metadata service, so the reference's
+master/slave split and cluster-state polling collapse away).
+
+Every action builds an argv list; `--dry-run` prints instead of executing,
+which is also how tests validate command construction without gcloud.
+
+    python -m sparknet_tpu.infra.launch_tpu launch  -n my-pod -z us-central2-b \
+        --accelerator-type v5e-16
+    python -m sparknet_tpu.infra.launch_tpu login   -n my-pod -z ... [--worker 0]
+    python -m sparknet_tpu.infra.launch_tpu destroy -n my-pod -z ...
+"""
+
+from __future__ import annotations
+
+import argparse
+import shlex
+import subprocess
+import sys
+from typing import List, Optional
+
+# Commands run on every host after creation (the analogue of the AMI
+# setup + deploy rsync in spark_ec2.py setup_cluster).
+DEFAULT_SETUP = (
+    "pip install -q 'jax[tpu]' flax optax orbax-checkpoint einops && "
+    "mkdir -p ~/sparknet_tpu"
+)
+
+
+class TpuCluster:
+    """Builds `gcloud compute tpus tpu-vm ...` argv lists for one slice."""
+
+    def __init__(self, name: str, zone: str, *,
+                 accelerator_type: str = "v5litepod-16",
+                 runtime_version: str = "tpu-ubuntu2204-base",
+                 project: Optional[str] = None,
+                 spot: bool = False) -> None:
+        self.name = name
+        self.zone = zone
+        self.accelerator_type = accelerator_type
+        self.runtime_version = runtime_version
+        self.project = project
+        self.spot = spot
+
+    def _base(self, verb: str) -> List[str]:
+        cmd = ["gcloud", "compute", "tpus", "tpu-vm", verb, self.name,
+               f"--zone={self.zone}"]
+        if self.project:
+            cmd.append(f"--project={self.project}")
+        return cmd
+
+    def launch(self) -> List[List[str]]:
+        cmd = self._base("create") + [
+            f"--accelerator-type={self.accelerator_type}",
+            f"--version={self.runtime_version}",
+        ]
+        if self.spot:
+            cmd.append("--spot")
+        return [cmd, self.setup()]
+
+    def setup(self) -> List[str]:
+        return self._base("ssh") + ["--worker=all",
+                                    f"--command={DEFAULT_SETUP}"]
+
+    def deploy(self, local_dir: str, remote_dir: str = "~/sparknet_tpu",
+               ) -> List[str]:
+        """rsync the framework to every host (the reference rsyncs
+        SparkNet to master, spark_ec2.py deploy_files)."""
+        # gcloud scp syntax puts SRC NAME:DST last, unlike the other verbs
+        cmd = ["gcloud", "compute", "tpus", "tpu-vm", "scp", "--recurse",
+               "--worker=all", f"--zone={self.zone}"]
+        if self.project:
+            cmd.append(f"--project={self.project}")
+        cmd += [local_dir, f"{self.name}:{remote_dir}"]
+        return cmd
+
+    def destroy(self) -> List[List[str]]:
+        return [self._base("delete") + ["--quiet"]]
+
+    def login(self, worker: int = 0) -> List[List[str]]:
+        return [self._base("ssh") + [f"--worker={worker}"]]
+
+    def run(self, command: str, worker: str = "all") -> List[List[str]]:
+        """Run a shell command on workers (how training jobs start —
+        replaces spark-submit)."""
+        return [self._base("ssh") + [f"--worker={worker}",
+                                     f"--command={command}"]]
+
+    def get_master(self) -> List[List[str]]:
+        """`describe` — endpoints of all hosts (reference get-master prints
+        the master DNS name, spark_ec2.py:1454-1459)."""
+        return [self._base("describe") +
+                ["--format=value(networkEndpoints[].ipAddress)"]]
+
+    def stop(self) -> List[List[str]]:
+        return [self._base("stop")]
+
+    def start(self) -> List[List[str]]:
+        return [self._base("start") + [], self.setup()]
+
+
+def _execute(cmds: List[List[str]], dry_run: bool) -> int:
+    for cmd in cmds:
+        line = " ".join(shlex.quote(c) for c in cmd)
+        print(line)
+        if not dry_run:
+            rc = subprocess.call(cmd)
+            if rc != 0:
+                return rc
+    return 0
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="launch_tpu", description="TPU slice lifecycle "
+        "(reference: ec2/spark_ec2.py actions)")
+    p.add_argument("action", choices=["launch", "destroy", "login", "run",
+                                      "get-master", "stop", "start",
+                                      "deploy"])
+    p.add_argument("-n", "--name", required=True)
+    p.add_argument("-z", "--zone", required=True)
+    p.add_argument("--accelerator-type", default="v5litepod-16")
+    p.add_argument("--runtime-version", default="tpu-ubuntu2204-base")
+    p.add_argument("--project")
+    p.add_argument("--spot", action="store_true")
+    p.add_argument("--worker", default=None,
+                   help="worker index; default 0 for login, all for run")
+    p.add_argument("--command", help="shell command for `run`")
+    p.add_argument("--local-dir", default=".", help="source dir for `deploy`")
+    p.add_argument("--dry-run", action="store_true")
+    args = p.parse_args(argv)
+
+    cluster = TpuCluster(args.name, args.zone,
+                         accelerator_type=args.accelerator_type,
+                         runtime_version=args.runtime_version,
+                         project=args.project, spot=args.spot)
+    if args.action == "launch":
+        cmds = cluster.launch()
+    elif args.action == "destroy":
+        cmds = cluster.destroy()
+    elif args.action == "login":
+        cmds = cluster.login(int(args.worker or 0))
+    elif args.action == "run":
+        if not args.command:
+            p.error("`run` requires --command")
+        # training must start on every host of the slice
+        cmds = cluster.run(args.command, args.worker or "all")
+    elif args.action == "get-master":
+        cmds = cluster.get_master()
+    elif args.action == "stop":
+        cmds = cluster.stop()
+    elif args.action == "start":
+        cmds = cluster.start()
+    else:  # deploy
+        cmds = [cluster.deploy(args.local_dir)]
+    return _execute(cmds, args.dry_run)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -52,6 +52,20 @@ def test_resize_image_roundtrip():
     assert up.min() >= im.min() - 1e-3 and up.max() <= im.max() + 1e-3
 
 
+def test_resize_image_float_precision_with_outlier():
+    # an outlier pixel must not quantize away the rest of the image
+    im = np.random.RandomState(0).rand(8, 8, 3).astype(np.float32)
+    im[0, 0, 0] = 100.0
+    down = resize_image(im, (4, 4))
+    rest = down[2:, 2:]  # far from the outlier
+    assert rest.std() > 0.01  # structure survives
+    # constant image stays exactly constant
+    const = np.full((6, 6, 3), 0.25, np.float32)
+    np.testing.assert_allclose(resize_image(const, (9, 13)), 0.25, rtol=1e-6)
+    with pytest.raises(ValueError):
+        resize_image(np.zeros((0, 5, 3), np.float32), (4, 4))
+
+
 def test_classifier_predict_shapes(deploy_file):
     clf = Classifier(deploy_file)
     rng = np.random.RandomState(0)
@@ -97,6 +111,22 @@ def test_detector_windows(deploy_file):
     assert len(dets) == 2
     assert dets[0]["prediction"].shape == (5,)
     assert det.detect_windows([]) == []
+    # degenerate windows are flagged, not fatal
+    dets = det.detect_windows([(image, [(5, 5, 5, 20), (50, 50, 60, 60),
+                                        (0, 0, 10, 10)])])
+    assert dets[0]["prediction"] is None
+    assert dets[1]["prediction"] is None
+    assert dets[2]["prediction"] is not None
+
+
+def test_detector_context_pad(deploy_file):
+    det = Detector(deploy_file, context_pad=4)
+    rng = np.random.RandomState(0)
+    image = rng.rand(30, 30, 3).astype(np.float32)
+    # corner window: padded region runs off the image -> mean fill
+    dets = det.detect_windows([(image, [(0, 0, 10, 10), (10, 10, 20, 20)])])
+    assert len(dets) == 2
+    assert all(d["prediction"] is not None for d in dets)
 
 
 def test_load_image(tmp_path):
@@ -117,10 +147,31 @@ def test_draw_net_dot(deploy_file):
     dot = net_to_dot(net)
     assert dot.startswith('digraph "tiny_deploy"')
     assert '(Convolution)' in dot and 'kernel 3x3' in dot
-    assert 'blob_data -> layer_0' in dot
-    # in-place relu collapsed: no edge layer->conv1 blob from relu
-    assert dot.count("blob_conv1 [") == 1
+    assert '"blob_data" -> "layer_0"' in dot
+    # in-place relu collapsed onto its blob annotation, no dangling node
+    assert '"blob_conv1" [' in dot and "+ relu1 (ReLU)" in dot
+    assert "(ReLU)\", shape=octagon" not in dot  # no separate relu node
     assert dot.strip().endswith("}")
+
+
+def test_draw_net_slash_names_quoted(tmp_path):
+    # GoogLeNet-style names with '/' must yield valid (quoted) DOT ids
+    src = """
+name: "g"
+layer { name: "d" type: "DummyData" top: "x/1"
+  dummy_data_param { shape { dim: 1 dim: 1 dim: 4 dim: 4 } } }
+layer { name: "inception_3a/1x1" type: "InnerProduct" bottom: "x/1"
+  top: "inception_3a/out" inner_product_param { num_output: 2 } }
+"""
+    p = tmp_path / "g.prototxt"
+    p.write_text(src)
+    dot = net_to_dot(caffe_pb.load_net_prototxt(str(p)))
+    for line in dot.splitlines():
+        stripped = line.strip()
+        if "->" in stripped or stripped.endswith("];"):
+            # every id with special chars is quoted
+            assert "blob_x/1" not in stripped.replace('"blob_x/1"', "")
+    assert '"blob_x/1" -> "layer_1"' in dot
 
 
 def test_draw_net_phase_filter(tmp_path):

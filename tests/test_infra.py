@@ -1,0 +1,93 @@
+"""Infra-tier tests (reference: ec2/spark_ec2.py, pull.py,
+create_labelfile.py)."""
+
+import io
+import os
+import tarfile
+
+import numpy as np
+import pytest
+
+from sparknet_tpu.infra.imagenet_shards import (SHARD_PATTERN,
+                                                create_labelfile,
+                                                pull_shards)
+from sparknet_tpu.infra.launch_tpu import TpuCluster
+from sparknet_tpu.infra.launch_tpu import main as launch_main
+
+
+def test_launch_commands():
+    c = TpuCluster("pod1", "us-central2-b", accelerator_type="v5litepod-16",
+                   project="proj")
+    create, setup = c.launch()
+    assert create[:6] == ["gcloud", "compute", "tpus", "tpu-vm", "create",
+                          "pod1"]
+    assert "--zone=us-central2-b" in create
+    assert "--project=proj" in create
+    assert "--accelerator-type=v5litepod-16" in create
+    assert any(a.startswith("--version=") for a in create)
+    assert "--worker=all" in setup  # setup touches every host
+
+    (delete,) = c.destroy()
+    assert delete[4] == "delete" and "--quiet" in delete
+    (ssh,) = c.login(worker=2)
+    assert ssh[4] == "ssh" and "--worker=2" in ssh
+    (run,) = c.run("python -m sparknet_tpu.apps.cifar_app 16")
+    assert any(a.startswith("--command=python") for a in run)
+    (desc,) = c.get_master()
+    assert desc[4] == "describe"
+    scp = c.deploy("/src/repo")
+    assert scp[4] == "scp" and scp[-1] == "pod1:~/sparknet_tpu"
+    assert "--project=proj" in scp
+
+
+def test_launch_spot_flag_and_main_dry_run(capsys):
+    rc = launch_main(["launch", "-n", "p", "-z", "z1", "--spot", "--dry-run"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "create p" in out and "--spot" in out
+    rc = launch_main(["get-master", "-n", "p", "-z", "z1", "--dry-run"])
+    assert rc == 0
+    assert "describe" in capsys.readouterr().out
+
+
+def _make_shard(path, names):
+    buf = io.BytesIO()
+    with tarfile.open(mode="w", fileobj=buf) as tar:
+        for name in names:
+            data = name.encode() * 3
+            info = tarfile.TarInfo(name)
+            info.size = len(data)
+            tar.addfile(info, io.BytesIO(data))
+    with open(path, "wb") as f:
+        f.write(buf.getvalue())
+
+
+def test_pull_shards_local(tmp_path):
+    src = tmp_path / "shards"
+    src.mkdir()
+    _make_shard(src / (SHARD_PATTERN % 0),
+                ["n01_1.JPEG", "n01_2.JPEG"])
+    _make_shard(src / (SHARD_PATTERN % 1), ["n02_1.JPEG"])
+    dest = tmp_path / "train"
+    n = pull_shards(0, 2, str(dest), str(src))
+    assert n == 3
+    out_dir = dest / "000-002"  # range-named dir, as ec2/pull.py:45
+    assert sorted(os.listdir(out_dir)) == ["n01_1.JPEG", "n01_2.JPEG",
+                                           "n02_1.JPEG"]
+
+
+def test_create_labelfile(tmp_path):
+    d = tmp_path / "imgs"
+    d.mkdir()
+    for f in ["a_1.jpeg", "b_2.JPEG", "orphan.JPEG"]:
+        (d / f).write_bytes(b"x")
+    master = tmp_path / "train.txt"
+    # master uses different case + extra entries, like the reference's
+    # "poor man's normalization" (create_labelfile.py:17)
+    master.write_text("A_1.JPEG 3\nB_2.jpeg 7\nmissing.JPEG 9\n")
+    out = tmp_path / "out.txt"
+    n = create_labelfile(str(d), str(master), str(out))
+    assert n == 2
+    assert out.read_text() == "a_1.jpeg 3\nb_2.JPEG 7\n"
+    with pytest.raises(KeyError):
+        create_labelfile(str(d), str(master), str(out), strict=True)
