@@ -218,8 +218,8 @@ class Detector(Classifier):
         super().__init__(*a, **kw)
         self.context_pad = int(context_pad)
 
-    def _crop_with_context(self, image: np.ndarray,
-                           window) -> Optional[np.ndarray]:
+    def _crop_with_context(self, image: np.ndarray, window,
+                           fill_value: float) -> Optional[np.ndarray]:
         ymin, xmin, ymax, xmax = (int(v) for v in window)
         p = self.context_pad
         ih, iw = image.shape[:2]
@@ -233,8 +233,7 @@ class Detector(Classifier):
             # padded window runs off the image: mean-fill the canvas
             # (reference: detector.py detect_windows context handling)
             canvas = np.full((ymax - ymin + 2 * p, xmax - xmin + 2 * p,
-                              image.shape[2]),
-                             float(image.mean()), np.float32)
+                              image.shape[2]), fill_value, np.float32)
             oy, ox = cy0 - (ymin - p), cx0 - (xmin - p)
             canvas[oy:oy + crop.shape[0], ox:ox + crop.shape[1]] = crop
             crop = canvas
@@ -243,20 +242,22 @@ class Detector(Classifier):
     def detect_windows(self, images_windows: Sequence[Tuple[np.ndarray,
                                                             Sequence]],
                        ) -> List[dict]:
+        # dets stays in input-window order; degenerate windows keep their
+        # slot with prediction None
         dets: List[dict] = []
-        crops, meta = [], []
+        crops, slots = [], []
         for image, windows in images_windows:
+            fill = float(image.mean()) if self.context_pad else 0.0
             for window in windows:
-                crop = self._crop_with_context(image, window)
-                if crop is None:
-                    dets.append({"window": tuple(window), "prediction": None})
-                    continue
-                crops.append(crop)
-                meta.append(tuple(window))
+                crop = self._crop_with_context(image, window, fill)
+                dets.append({"window": tuple(window), "prediction": None})
+                if crop is not None:
+                    crops.append(crop)
+                    slots.append(len(dets) - 1)
         if not crops:
             return dets
         x = self._preprocess(np.asarray(crops, dtype=np.float32))
         probs = self._forward_probs(x)
-        for (window, p) in zip(meta, probs):
-            dets.append({"window": window, "prediction": p})
+        for slot, p in zip(slots, probs):
+            dets[slot]["prediction"] = p
         return dets

@@ -83,7 +83,8 @@ class TpuCluster:
     def destroy(self) -> List[List[str]]:
         return [self._base("delete") + ["--quiet"]]
 
-    def login(self, worker: int = 0) -> List[List[str]]:
+    def login(self, worker: str = "0") -> List[List[str]]:
+        # gcloud accepts numeric indices or "all"
         return [self._base("ssh") + [f"--worker={worker}"]]
 
     def run(self, command: str, worker: str = "all") -> List[List[str]]:
@@ -102,7 +103,7 @@ class TpuCluster:
         return [self._base("stop")]
 
     def start(self) -> List[List[str]]:
-        return [self._base("start") + [], self.setup()]
+        return [self._base("start"), self.setup()]
 
 
 def _execute(cmds: List[List[str]], dry_run: bool) -> int:
@@ -145,7 +146,7 @@ def main(argv=None) -> int:
     elif args.action == "destroy":
         cmds = cluster.destroy()
     elif args.action == "login":
-        cmds = cluster.login(int(args.worker or 0))
+        cmds = cluster.login(args.worker or "0")
     elif args.action == "run":
         if not args.command:
             p.error("`run` requires --command")

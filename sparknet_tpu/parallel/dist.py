@@ -32,7 +32,8 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from ..core.net import Net
 from ..proto.caffe_pb import NetParameter, SolverParameter
 from ..solver import updates
-from ..solver.solver import DataSource, make_single_step
+from ..solver.solver import (DataSource, make_loss_fn, make_single_step,
+                             resolve_precision)
 from .mesh import WORKER_AXIS, make_mesh
 
 
@@ -55,9 +56,10 @@ class DistributedSolver:
                  mode: str = "average",
                  data_shapes: Optional[Dict[str, Any]] = None,
                  batch_override: Optional[int] = None,
-                 mesh=None) -> None:
+                 mesh=None, precision: Optional[str] = None) -> None:
         assert mode in ("average", "sync")
         self.param = solver_param
+        self.precision = resolve_precision(solver_param, precision)
         self.mode = mode
         self.tau = int(tau) if mode == "average" else 1
         if net_param is None:
@@ -90,7 +92,8 @@ class DistributedSolver:
 
     # ----------------------------------------------------------------- build
     def _build_round_fn(self):
-        single_step = make_single_step(self.net, self.param)
+        single_step = make_single_step(self.net, self.param,
+                                       precision=self.precision)
         tau = self.tau
         mode = self.mode
         axis = WORKER_AXIS
@@ -103,13 +106,13 @@ class DistributedSolver:
             rng = rng[0]
 
             if mode == "sync":
+                base_loss = make_loss_fn(self.net, self.precision)
+
                 def sync_step(params, state, it, inputs, step_rng):
                     # pmean of grads inside the step: wrap the loss so its
                     # gradient is already averaged over workers
                     def loss_fn(p):
-                        blobs, stats = self.net.apply(p, inputs, step_rng,
-                                                      train=True)
-                        return blobs["loss"], stats
+                        return base_loss(p, inputs, step_rng)
                     (loss, stats), grads = jax.value_and_grad(
                         loss_fn, has_aux=True)(params)
                     grads = jax.lax.pmean(grads, axis)

@@ -36,7 +36,55 @@ from .lr_policies import learning_rate
 DataSource = Callable[[], Dict[str, Any]]
 
 
-def make_single_step(net: Net, sp: SolverParameter):
+def _cast_tree(tree, dtype):
+    return jax.tree.map(
+        lambda a: a.astype(dtype)
+        if jnp.issubdtype(a.dtype, jnp.floating) else a, tree)
+
+
+def resolve_precision(sp: SolverParameter,
+                      precision: Optional[str]) -> str:
+    """Explicit arg wins; else the (framework-extension) `precision` solver
+    field; else float32.  "bfloat16" = mixed precision: bf16 forward/
+    backward on the MXU, float32 master weights and update math — there is
+    no reference analogue (Caffe is float-typed end to end), this is the
+    TPU-native fast path."""
+    if precision is None:
+        precision = str(sp.msg.get("precision", "float32"))
+    if precision not in ("float32", "bfloat16"):
+        raise ValueError(f"unknown precision {precision!r}")
+    return precision
+
+
+def make_loss_fn(net: Net, precision: str):
+    """Training loss closure; under "bfloat16" the fp32 master params and
+    float inputs are cast to bf16 for forward/backward (the cast is
+    differentiable, so grads land on the fp32 leaves) while BatchNorm stats
+    and the loss scalar stay fp32.  Stat blobs are kept fp32 going INTO the
+    net too: Caffe-style BN accumulates unscaled sums (norm.py) whose
+    increments would round away in a bf16 accumulator after a few hundred
+    iterations."""
+    half = precision == "bfloat16"
+    stat_keys = set(net.stat_keys())
+
+    def loss_fn(params, inputs, rng):
+        if half:
+            params = {k: (v if k in stat_keys else v.astype(jnp.bfloat16)
+                          if jnp.issubdtype(v.dtype, jnp.floating) else v)
+                      for k, v in params.items()}
+            inputs = {k: v.astype(jnp.bfloat16)
+                      if jnp.issubdtype(v.dtype, jnp.floating) else v
+                      for k, v in inputs.items()}
+        blobs, stats = net.apply(params, inputs, rng, train=True)
+        if half:
+            stats = _cast_tree(stats, jnp.float32)
+        return blobs["loss"].astype(jnp.float32), stats
+
+    return loss_fn
+
+
+def make_single_step(net: Net, sp: SolverParameter,
+                     precision: Optional[str] = None):
     """One training iteration as a pure function
     (params, state, it, inputs, rng) -> (params, state, loss).
 
@@ -52,10 +100,8 @@ def make_single_step(net: Net, sp: SolverParameter):
     solver_type = sp.resolved_type()
     lr_mults = net.lr_multipliers()
     decay_mults = net.decay_multipliers()
-
-    def loss_fn(params, inputs, rng):
-        blobs, stats = net.apply(params, inputs, rng, train=True)
-        return blobs["loss"], stats
+    precision = resolve_precision(sp, precision)
+    loss_fn = make_loss_fn(net, precision)
 
     def single_step(params, state, it, inputs, rng):
         (loss, stats), grads = jax.value_and_grad(
@@ -78,8 +124,10 @@ class Solver:
     def __init__(self, solver_param: SolverParameter, *,
                  net_param: Optional[NetParameter] = None,
                  data_shapes: Optional[Dict[str, Any]] = None,
-                 batch_override: Optional[int] = None) -> None:
+                 batch_override: Optional[int] = None,
+                 precision: Optional[str] = None) -> None:
         self.param = solver_param
+        self.precision = resolve_precision(solver_param, precision)
         if net_param is None:
             net_param = solver_param.net_param or solver_param.train_net_param
         if net_param is None and solver_param.net:
@@ -136,10 +184,7 @@ class Solver:
         lr_mults = self._lr_mults
         decay_mults = self._decay_mults
         stat_keys = self._stat_keys
-
-        def loss_fn(params, inputs, rng):
-            blobs, stats = net.apply(params, inputs, rng, train=True)
-            return blobs["loss"], stats
+        loss_fn = make_loss_fn(net, self.precision)
 
         def step(params, state, it, stacked_inputs, rng):
             # iter_size gradient accumulation (solver.cpp:221-229 + Normalize

@@ -111,12 +111,16 @@ def test_detector_windows(deploy_file):
     assert len(dets) == 2
     assert dets[0]["prediction"].shape == (5,)
     assert det.detect_windows([]) == []
-    # degenerate windows are flagged, not fatal
-    dets = det.detect_windows([(image, [(5, 5, 5, 20), (50, 50, 60, 60),
-                                        (0, 0, 10, 10)])])
-    assert dets[0]["prediction"] is None
+    # degenerate windows are flagged, not fatal, and input order is kept
+    # even when valid windows surround the degenerate ones
+    dets = det.detect_windows([(image, [(0, 0, 10, 10), (5, 5, 5, 20),
+                                        (50, 50, 60, 60), (0, 0, 12, 12)])])
+    assert [d["window"] for d in dets] == [(0, 0, 10, 10), (5, 5, 5, 20),
+                                           (50, 50, 60, 60), (0, 0, 12, 12)]
+    assert dets[0]["prediction"] is not None
     assert dets[1]["prediction"] is None
-    assert dets[2]["prediction"] is not None
+    assert dets[2]["prediction"] is None
+    assert dets[3]["prediction"] is not None
 
 
 def test_detector_context_pad(deploy_file):

@@ -1,0 +1,167 @@
+"""Mixed-precision (bf16 compute / fp32 master weights) tests.
+
+No reference analogue — Caffe is float-typed end to end; this is the
+TPU-native fast path (MXU prefers bf16, SURVEY.md design notes)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from sparknet_tpu.core.net import Net
+from sparknet_tpu.proto import caffe_pb
+from sparknet_tpu.proto.textformat import parse
+from sparknet_tpu.solver import updates
+from sparknet_tpu.solver.solver import (Solver, make_single_step,
+                                        resolve_precision)
+
+TINY = """
+name: "tiny"
+layer { name: "data" type: "MemoryData" top: "data" top: "label"
+  memory_data_param { batch_size: 8 channels: 3 height: 10 width: 10 } }
+layer { name: "conv1" type: "Convolution" bottom: "data" top: "conv1"
+  convolution_param { num_output: 4 kernel_size: 3
+    weight_filler { type: "xavier" } } }
+layer { name: "relu1" type: "ReLU" bottom: "conv1" top: "conv1" }
+layer { name: "ip1" type: "InnerProduct" bottom: "conv1" top: "ip1"
+  inner_product_param { num_output: 5 weight_filler { type: "xavier" } } }
+layer { name: "loss" type: "SoftmaxWithLoss" bottom: "ip1" bottom: "label"
+  top: "loss" }
+"""
+
+
+def _solver_param(**extra):
+    sp = caffe_pb.SolverParameter(parse(
+        "base_lr: 0.1\nmomentum: 0.9\nlr_policy: \"fixed\"\n"))
+    sp.msg.set("net_param", caffe_pb.parse_net_text(TINY).msg)
+    for k, v in extra.items():
+        sp.msg.set(k, v)
+    return sp
+
+
+def _batch(seed=0):
+    rng = np.random.RandomState(seed)
+    return {"data": jnp.asarray(rng.rand(8, 3, 10, 10).astype(np.float32)),
+            "label": jnp.asarray(rng.randint(0, 5, (8,)).astype(np.int32))}
+
+
+def test_resolve_precision():
+    sp = _solver_param()
+    assert resolve_precision(sp, None) == "float32"
+    assert resolve_precision(sp, "bfloat16") == "bfloat16"
+    sp.msg.set("precision", "bfloat16")
+    assert resolve_precision(sp, None) == "bfloat16"
+    assert resolve_precision(sp, "float32") == "float32"
+    with pytest.raises(ValueError):
+        resolve_precision(sp, "float16")
+
+
+def test_bf16_step_keeps_fp32_masters():
+    sp = _solver_param()
+    net = Net(sp.net_param, "TRAIN")
+    params = net.init_params(0)
+    state = updates.init_state(params, "SGD")
+    step = jax.jit(make_single_step(net, sp, precision="bfloat16"))
+    new_p, new_s, loss = step(params, state, jnp.int32(0), _batch(),
+                              jax.random.PRNGKey(0))
+    assert np.isfinite(float(loss))
+    for k, v in new_p.items():
+        assert v.dtype == jnp.float32, k
+    for k, slots in new_s.items():
+        for s in slots:
+            assert s.dtype == jnp.float32
+    # params actually moved
+    assert any(not np.allclose(np.asarray(new_p[k]), np.asarray(params[k]))
+               for k in params)
+
+
+def test_bf16_tracks_fp32_losses():
+    sp = _solver_param()
+    net = Net(sp.net_param, "TRAIN")
+    params = net.init_params(0)
+
+    def run(precision, n=5):
+        state = updates.init_state(params, "SGD")
+        step = jax.jit(make_single_step(net, sp, precision=precision))
+        p = params
+        losses = []
+        for i in range(n):
+            p, state, loss = step(p, state, jnp.int32(i), _batch(i),
+                                  jax.random.PRNGKey(i))
+            losses.append(float(loss))
+        return losses
+
+    lf = run("float32")
+    lh = run("bfloat16")
+    # same trajectory within bf16 resolution (~3 decimal digits)
+    np.testing.assert_allclose(lh, lf, rtol=0.05)
+
+
+def test_bf16_batchnorm_stats_accumulate_fp32():
+    """Caffe BN accumulates unscaled sums; a bf16 accumulator would stop
+    advancing after a few hundred increments.  Stats must enter and leave
+    the net in fp32 under mixed precision."""
+    bn_net = """
+name: "bn"
+layer { name: "data" type: "MemoryData" top: "data" top: "label"
+  memory_data_param { batch_size: 4 channels: 2 height: 4 width: 4 } }
+layer { name: "bn1" type: "BatchNorm" bottom: "data" top: "bn1" }
+layer { name: "ip1" type: "InnerProduct" bottom: "bn1" top: "ip1"
+  inner_product_param { num_output: 3 weight_filler { type: "xavier" } } }
+layer { name: "loss" type: "SoftmaxWithLoss" bottom: "ip1" bottom: "label"
+  top: "loss" }
+"""
+    sp = caffe_pb.SolverParameter(parse('base_lr: 0.01\nlr_policy: "fixed"'))
+    sp.msg.set("net_param", caffe_pb.parse_net_text(bn_net).msg)
+    net = Net(sp.net_param, "TRAIN")
+    params = net.init_params(0)
+    state = updates.init_state(params, "SGD")
+    step = jax.jit(make_single_step(net, sp, precision="bfloat16"))
+    stat_keys = net.stat_keys()
+    assert stat_keys, "BN net must expose stat blobs"
+    rng = np.random.RandomState(0)
+    p = params
+    # drive the scale accumulator high enough that a bf16 accumulator
+    # (8-bit mantissa) could no longer represent +1 increments
+    for i in range(30):
+        batch = {"data": jnp.asarray(rng.rand(4, 2, 4, 4).astype(np.float32)),
+                 "label": jnp.asarray(rng.randint(0, 3, (4,)).astype(np.int32))}
+        prev = {k: np.asarray(p[k]) for k in stat_keys}
+        p, state, _ = step(p, state, jnp.int32(i), batch,
+                           jax.random.PRNGKey(i))
+        for k in stat_keys:
+            assert p[k].dtype == jnp.float32
+    # the scale/mean stats moved on the very last step (no saturation)
+    changed = any(not np.allclose(np.asarray(p[k]), prev[k])
+                  for k in stat_keys)
+    assert changed
+
+
+def test_solver_precision_field_and_kwarg():
+    sp = _solver_param(precision="bfloat16")
+    s = Solver(sp)
+    assert s.precision == "bfloat16"
+    src = lambda: _batch()
+    s.set_train_data(src)
+    loss = s.step(3)
+    assert np.isfinite(loss)
+    assert all(v.dtype == jnp.float32 for v in s.params.values())
+
+    s32 = Solver(_solver_param(), precision="float32")
+    assert s32.precision == "float32"
+
+
+def test_distributed_bf16_round():
+    from sparknet_tpu.parallel.dist import DistributedSolver
+
+    n = min(len(jax.devices()), 4)
+    if n < 2:
+        pytest.skip("needs multi-device mesh")
+    for mode in ("average", "sync"):
+        ds = DistributedSolver(_solver_param(), n_workers=n, tau=2,
+                               mode=mode, precision="bfloat16")
+        batches = [[_batch(w * 10 + t) for t in range(ds.tau)]
+                   for w in range(n)]
+        ds.set_train_data([lambda w=w: batches[w].pop(0) for w in range(n)])
+        loss = ds.run_round()
+        assert np.isfinite(loss), mode
