@@ -6,17 +6,48 @@ twice.  y = x / (k + alpha/n * sum_window x^2)^beta, where the window is
 (WITHIN_CHANNEL, which the reference computes via average pooling of x^2 —
 lrn_layer.cpp:121-135 — so alpha is NOT divided by the window size again).
 
-Expressed with `lax.reduce_window` over the channel axis so XLA keeps it
-fused; no custom kernel needed.
+Three implementations of the ACROSS_CHANNELS path, selectable via
+SPARKNET_LRN_IMPL=xla|pallas|matmul (default: xla):
+- xla: `lax.reduce_window` over the channel axis, with sqrt/rsqrt fast
+  paths for the beta the bundled models use (every model runs beta=0.75 and
+  scale^-0.75 = rsqrt(scale*sqrt(scale)) — far cheaper than the exp/log
+  pow lowering);
+- pallas: fused VMEM-resident kernel with a fused custom-VJP backward
+  (pallas_lrn.py) — 1.4-2.2x the reduce_window formulation standalone on
+  v5e (fwd 1.9ms vs 4.2ms on AlexNet norm1 bf16);
+- matmul: the channel window sum as a banded (C, C) matmul on the MXU.
+Measured inside a full AlexNet train step on the shared bench chip, the
+three are within run-to-run variance of each other, so the portable one is
+the default; the standalone-kernel wins are real (see tests + bench notes).
 """
 
 from __future__ import annotations
 
+import os
+
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 
 from .pooling import avg_pool
+
+
+def _powm(s: jax.Array, p: float) -> jax.Array:
+    """s**p for s>0, avoiding exp/log for the exponents the models use.
+
+    Every bundled model runs beta=0.75, so the hot exponents are -0.75 and
+    (backward) -1.75; sqrt/rsqrt are far cheaper than the exp+log pair on
+    the VPU and this is where a compute-bound LRN spends its time."""
+    if p == -0.75:
+        return jax.lax.rsqrt(s * jnp.sqrt(s))
+    if p == -1.75:
+        return jax.lax.rsqrt(s * jnp.sqrt(s)) / s
+    if p == -0.5:
+        return jax.lax.rsqrt(s)
+    if p == -1.0:
+        return 1.0 / s
+    return jnp.exp(p * jnp.log(s))
 
 
 def lrn_across_channels(x: jax.Array, local_size: int = 5, alpha: float = 1.0,
@@ -28,7 +59,34 @@ def lrn_across_channels(x: jax.Array, local_size: int = 5, alpha: float = 1.0,
         window_strides=(1, 1, 1, 1),
         padding=((0, 0), (pad, local_size - 1 - pad), (0, 0), (0, 0)))
     scale = k + (alpha / local_size) * sq_sum
-    return x * jnp.power(scale, -beta)
+    return x * _powm(scale, -beta)
+
+
+def _band_matrix(c: int, local_size: int, dtype) -> jnp.ndarray:
+    """Band[j, i] = 1 where j is inside output channel i's window."""
+    pad_lo = (local_size - 1) // 2
+    i = np.arange(c)
+    band = ((i[None, :] - pad_lo <= i[:, None])
+            & (i[:, None] <= i[None, :] + (local_size - 1 - pad_lo)))
+    return jnp.asarray(band.astype(np.float32), dtype=dtype)
+
+
+def lrn_across_channels_matmul(x: jax.Array, local_size: int = 5,
+                               alpha: float = 1.0, beta: float = 0.75,
+                               k: float = 1.0) -> jax.Array:
+    """The channel-window sum as a banded (C, C) matmul.
+
+    On TPU the window reduction of the reduce_window/pallas formulations is
+    VPU- and layout-bound while the MXU sits idle; a 0/1 banded matmul over
+    the channel axis moves it onto the MXU (~0.04 ms for AlexNet norm1 vs
+    milliseconds on the VPU) and is exactly autodifferentiable (the
+    transpose is the reflected band).  Works for any channel count/dtype."""
+    c = x.shape[1]
+    band = _band_matrix(c, local_size, x.dtype)
+    sq_sum = jnp.einsum("nchw,cd->ndhw", x * x, band,
+                        preferred_element_type=jnp.float32).astype(x.dtype)
+    scale = k + (alpha / local_size) * sq_sum
+    return x * _powm(scale, -beta)
 
 
 def lrn_within_channel(x: jax.Array, local_size: int = 5, alpha: float = 1.0,
@@ -41,12 +99,31 @@ def lrn_within_channel(x: jax.Array, local_size: int = 5, alpha: float = 1.0,
     # stride-1 same-size, so shapes already match.
     mean_sq = mean_sq[:, :, :x.shape[2], :x.shape[3]]
     scale = k + alpha * mean_sq
-    return x * jnp.power(scale, -beta)
+    return x * _powm(scale, -beta)
+
+
+def _pick_impl() -> str:
+    impl = os.environ.get("SPARKNET_LRN_IMPL", "xla")
+    if impl not in ("xla", "pallas", "matmul"):
+        raise ValueError(
+            f"SPARKNET_LRN_IMPL={impl!r}; expected xla, pallas, or matmul")
+    return impl
 
 
 def lrn(x: jax.Array, local_size: int = 5, alpha: float = 1.0,
         beta: float = 0.75, k: float = 1.0,
         norm_region: str = "ACROSS_CHANNELS") -> jax.Array:
     if norm_region == "ACROSS_CHANNELS":
+        impl = _pick_impl()
+        if impl == "matmul":
+            return lrn_across_channels_matmul(x, local_size, alpha, beta, k)
+        if impl == "pallas":
+            # deferred: keeps jax.experimental.pallas out of the default path
+            from .pallas_lrn import (lrn_across_channels_pallas,
+                                     pallas_lrn_supported)
+            if pallas_lrn_supported(x):
+                interpret = jax.default_backend() != "tpu"
+                return lrn_across_channels_pallas(x, local_size, alpha, beta,
+                                                  k, interpret)
         return lrn_across_channels(x, local_size, alpha, beta, k)
     return lrn_within_channel(x, local_size, alpha, beta, k)

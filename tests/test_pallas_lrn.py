@@ -1,0 +1,100 @@
+"""Pallas LRN kernel vs the XLA reference implementation.
+
+Runs the kernel in interpret mode on the CPU test platform; the math must
+match ops.lrn.lrn_across_channels (itself validated against the reference
+formula, lrn_layer.cpp:88-119) in both forward and backward.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from sparknet_tpu.ops.lrn import lrn_across_channels
+from sparknet_tpu.ops.pallas_lrn import (lrn_across_channels_pallas,
+                                         pallas_lrn_supported)
+
+
+@pytest.mark.parametrize("local_size", [5, 3, 4])
+@pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16])
+def test_forward_matches_xla(rng, local_size, dtype):
+    x = jnp.asarray(rng.randn(2, 16, 5, 7).astype(np.float32), dtype=dtype)
+    want = lrn_across_channels(x.astype(jnp.float32), local_size,
+                               alpha=1e-4, beta=0.75, k=1.0)
+    got = lrn_across_channels_pallas(x, local_size, 1e-4, 0.75, 1.0, True)
+    assert got.dtype == dtype
+    tol = 1e-6 if dtype == jnp.float32 else 1e-2
+    np.testing.assert_allclose(np.asarray(got, np.float32),
+                               np.asarray(want, np.float32),
+                               rtol=tol, atol=tol)
+
+
+@pytest.mark.parametrize("local_size", [5, 4])
+def test_backward_matches_xla(rng, local_size):
+    x = jnp.asarray(rng.randn(2, 16, 3, 5).astype(np.float32))
+    g = jnp.asarray(rng.randn(2, 16, 3, 5).astype(np.float32))
+
+    def via_pallas(x):
+        return jnp.sum(
+            lrn_across_channels_pallas(x, local_size, 2e-4, 0.75, 2.0, True)
+            * g)
+
+    def via_xla(x):
+        return jnp.sum(
+            lrn_across_channels(x, local_size, alpha=2e-4, beta=0.75, k=2.0)
+            * g)
+
+    np.testing.assert_allclose(np.asarray(jax.grad(via_pallas)(x)),
+                               np.asarray(jax.grad(via_xla)(x)),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_spatial_not_multiple_of_lane_block(rng):
+    # 55x55 = 3025 lanes (AlexNet norm1) exercises the masked partial block
+    x = jnp.asarray(rng.randn(1, 8, 55, 55).astype(np.float32))
+    want = lrn_across_channels(x, 5, alpha=1e-4, beta=0.75, k=1.0)
+    got = lrn_across_channels_pallas(x, 5, 1e-4, 0.75, 1.0, True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-6, atol=1e-6)
+
+
+@pytest.mark.parametrize("local_size", [5, 4])
+def test_matmul_impl_matches_xla(rng, local_size):
+    from sparknet_tpu.ops.lrn import lrn_across_channels_matmul
+
+    x = jnp.asarray(rng.randn(2, 13, 3, 5).astype(np.float32))  # odd C ok
+    g = jnp.asarray(rng.randn(2, 13, 3, 5).astype(np.float32))
+    want = lrn_across_channels(x, local_size, alpha=1e-4, beta=0.75, k=1.0)
+    got = lrn_across_channels_matmul(x, local_size, 1e-4, 0.75, 1.0)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-6)
+    dw = jax.grad(lambda x: jnp.sum(
+        lrn_across_channels(x, local_size, alpha=1e-4, beta=0.75, k=1.0) * g))
+    dg = jax.grad(lambda x: jnp.sum(
+        lrn_across_channels_matmul(x, local_size, 1e-4, 0.75, 1.0) * g))
+    np.testing.assert_allclose(np.asarray(dg(x)), np.asarray(dw(x)),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_supported_predicate(rng):
+    f32 = jnp.zeros((1, 96, 4, 4), jnp.float32)
+    bf16 = jnp.zeros((1, 96, 4, 4), jnp.bfloat16)
+    assert pallas_lrn_supported(f32)
+    assert pallas_lrn_supported(bf16)
+    assert not pallas_lrn_supported(jnp.zeros((1, 12, 4, 4), jnp.bfloat16))
+    assert not pallas_lrn_supported(jnp.zeros((1, 7, 4, 4), jnp.float32))
+    assert not pallas_lrn_supported(jnp.zeros((96, 4, 4), jnp.float32))
+
+
+def test_dispatch_env(rng, monkeypatch):
+    import importlib
+
+    lrn_mod = importlib.import_module("sparknet_tpu.ops.lrn")
+
+    x = jnp.asarray(rng.randn(1, 8, 4, 4).astype(np.float32))
+    monkeypatch.setenv("SPARKNET_LRN_IMPL", "pallas")
+    got = lrn_mod.lrn(x, 5, 1e-4, 0.75, 1.0)
+    monkeypatch.setenv("SPARKNET_LRN_IMPL", "xla")
+    want = lrn_mod.lrn(x, 5, 1e-4, 0.75, 1.0)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-6, atol=1e-6)

@@ -121,17 +121,22 @@ layer { name: "loss" type: "SoftmaxWithLoss" bottom: "ip1" bottom: "label"
     assert stat_keys, "BN net must expose stat blobs"
     rng = np.random.RandomState(0)
     p = params
-    # drive the scale accumulator high enough that a bf16 accumulator
-    # (8-bit mantissa) could no longer represent +1 increments
-    for i in range(30):
+    # drive the scale accumulator past 256, where bf16 (8-bit mantissa) has
+    # spacing > 1 and a bf16 accumulator would stop advancing on +1 steps
+    n_steps = 300
+    prev = None
+    for i in range(n_steps):
         batch = {"data": jnp.asarray(rng.rand(4, 2, 4, 4).astype(np.float32)),
                  "label": jnp.asarray(rng.randint(0, 3, (4,)).astype(np.int32))}
-        prev = {k: np.asarray(p[k]) for k in stat_keys}
+        if i == n_steps - 1:
+            prev = {k: np.asarray(p[k]) for k in stat_keys}
         p, state, _ = step(p, state, jnp.int32(i), batch,
                            jax.random.PRNGKey(i))
-        for k in stat_keys:
-            assert p[k].dtype == jnp.float32
-    # the scale/mean stats moved on the very last step (no saturation)
+    for k in stat_keys:
+        assert p[k].dtype == jnp.float32
+    # the accumulator actually reached the bf16 dead zone...
+    assert max(float(np.max(np.asarray(p[k]))) for k in stat_keys) > 256
+    # ...and the stats still moved on the very last step (no saturation)
     changed = any(not np.allclose(np.asarray(p[k]), prev[k])
                   for k in stat_keys)
     assert changed
