@@ -141,7 +141,8 @@ def cmd_time(args) -> int:
     key = jax.random.PRNGKey(0)
     n = args.iterations or 10
 
-    # per-layer eager forward timing
+    # per-layer eager forward + backward timing (reference: caffe.cpp
+    # :331-356 prints "<layer> forward:"/"backward:" averages)
     print(f"Average time per layer ({n} iterations):")
     blobs = dict(inputs)
     for i, bl in enumerate(net.layers):
@@ -157,7 +158,22 @@ def cmd_time(args) -> int:
         ms = t.stop() / n
         for tname, tv in zip(bl.tops, tops):
             blobs[tname] = tv
-        print(f"  {bl.name:24s} forward: {ms:8.3f} ms")
+        print(f"  {bl.name:24s} forward:  {ms:8.3f} ms")
+        if not tops:
+            continue  # data/sink layers have no backward
+        try:
+            primals, vjp = jax.vjp(
+                lambda p, b: bl.fn(p, b, layer_rng, True)[0], pvals, bvals)
+            cots = [jnp.ones_like(tv) for tv in primals]
+            t = CPUTimer().start()
+            for _ in range(n):
+                grads = vjp(cots)
+                for g in jax.tree.leaves(grads):
+                    if hasattr(g, "block_until_ready"):
+                        g.block_until_ready()
+            print(f"  {bl.name:24s} backward: {t.stop() / n:8.3f} ms")
+        except TypeError:
+            pass  # non-differentiable outputs (e.g. ArgMax int tops)
 
     # jitted end-to-end forward and forward+backward
     def fwd(p, x, k):

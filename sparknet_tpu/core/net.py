@@ -872,6 +872,28 @@ def build_hdf5_output(net: Net, layer: LayerParameter, bshapes):
 
 # ------------------------------------------------------------------- heads
 
+@register("Python")
+def build_python(net: Net, layer: LayerParameter, bshapes):
+    """User-defined layer (reference: python_layer.hpp; see
+    core/python_layer.py for the TPU-native contract)."""
+    from .python_layer import resolve_python_layer
+
+    pp = layer.python_param
+    cls = resolve_python_layer(str(pp.module), str(pp.layer))
+    inst = cls()
+    inst.param_str = str(pp.param_str)
+    inst.setup(layer, bshapes)
+    tshapes = inst.top_shapes(bshapes)
+
+    def fn(pvals, bvals, rng, train):
+        tops = inst.forward(*bvals)
+        if not isinstance(tops, (list, tuple)):
+            tops = [tops]
+        return list(tops), {}
+
+    return _simple(net, layer, fn, tshapes)
+
+
 @register("Softmax")
 def build_softmax(net: Net, layer: LayerParameter, bshapes):
     axis = int(layer.softmax_param.axis)

@@ -1,0 +1,78 @@
+"""User-defined layers written in Python (`type: "Python"`).
+
+Reference: caffe/include/caffe/python_layer.hpp + the pycaffe layer
+machinery (`layer_factory.cpp` CreatorRegistry special-cases Python) — a
+prototxt layer names a Python class via `python_param { module: "m"
+layer: "L" param_str: "..." }`, and the class supplies setup/reshape/
+forward/backward.
+
+TPU-native shape: the user class supplies `setup` (once, at graph build),
+`top_shapes` (static shape inference — the analogue of Caffe's `reshape`,
+which must be build-time here because XLA requires static shapes), and a
+*pure, traceable* `forward` over jax arrays.  `backward` does not exist:
+the layer is differentiated through by `jax.grad` like every built-in
+layer (a custom gradient can still be attached with `jax.custom_vjp`
+inside `forward`).
+
+Resolution order mirrors pycaffe: an explicit in-process registry
+(`register_python_layer`, handy for tests and closures) first, then
+`importlib.import_module(python_param.module)` attribute lookup.
+"""
+
+from __future__ import annotations
+
+import importlib
+from typing import Dict, List, Sequence, Tuple, Type
+
+_REGISTRY: Dict[str, type] = {}
+
+
+class PythonLayer:
+    """Base class for user layers; subclass and override.
+
+    Attributes set before `setup`: `param_str` (the prototxt's free-form
+    config string, reference: caffe.proto:813-817).
+    """
+
+    param_str: str = ""
+
+    def setup(self, layer_param, bottom_shapes: Sequence[Tuple[int, ...]]
+              ) -> None:
+        """One-time init at graph build (reference: python_layer.hpp
+        LayerSetUp -> self.setup upcall)."""
+
+    def top_shapes(self, bottom_shapes: Sequence[Tuple[int, ...]]
+                   ) -> List[Tuple[int, ...]]:
+        """Static shape inference; default: elementwise (shapes pass
+        through, one top per bottom)."""
+        return [tuple(s) for s in bottom_shapes]
+
+    def forward(self, *bottoms):
+        """Pure function of the bottom arrays; returns the top arrays
+        (a sequence, or a single array for one top).  Traced under jit —
+        jnp/lax only, no side effects."""
+        raise NotImplementedError
+
+
+def register_python_layer(name: str):
+    """Decorator: make a PythonLayer class resolvable as
+    `python_param { layer: "<name>" }` without an importable module."""
+
+    def deco(cls: Type[PythonLayer]):
+        _REGISTRY[name] = cls
+        return cls
+
+    return deco
+
+
+def resolve_python_layer(module: str, layer: str) -> Type[PythonLayer]:
+    if layer in _REGISTRY:
+        return _REGISTRY[layer]
+    if module:
+        mod = importlib.import_module(module)
+        cls = getattr(mod, layer, None)
+        if cls is not None:
+            return cls
+    raise KeyError(
+        f"Python layer {layer!r} not found (module {module!r}, registry "
+        f"{sorted(_REGISTRY)})")

@@ -34,7 +34,7 @@ from ..proto.caffe_pb import NetParameter, SolverParameter
 from ..solver import updates
 from ..solver.solver import (DataSource, make_loss_fn, make_single_step,
                              resolve_precision)
-from .mesh import WORKER_AXIS, make_mesh
+from .mesh import DCN_AXIS, WORKER_AXIS, make_mesh
 
 
 def _stack_tree(tree, n: int):
@@ -48,7 +48,15 @@ class DistributedSolver:
 
     mode="average": τ-step local SGD + weight pmean per round (the SparkNet
     algorithm).  mode="sync": per-step gradient pmean (classic sync DP,
-    subsuming the reference's P2PSync tree)."""
+    subsuming the reference's P2PSync tree).
+
+    On a hierarchical (dcn, workers) mesh (mesh.make_hierarchical_mesh),
+    `dcn_interval` makes the averaging two-level: every round averages over
+    the ICI worker axis, and only every dcn_interval-th round also averages
+    across slices over DCN — the bandwidth hierarchy analogue of the
+    reference's two sync tiers (per-step P2PSync within a node, τ-step
+    Spark averaging between nodes).  dcn_interval=1 is plain global
+    averaging; sync mode always syncs gradients globally."""
 
     def __init__(self, solver_param: SolverParameter, *,
                  net_param: Optional[NetParameter] = None,
@@ -56,7 +64,8 @@ class DistributedSolver:
                  mode: str = "average",
                  data_shapes: Optional[Dict[str, Any]] = None,
                  batch_override: Optional[int] = None,
-                 mesh=None, precision: Optional[str] = None) -> None:
+                 mesh=None, precision: Optional[str] = None,
+                 dcn_interval: int = 1) -> None:
         assert mode in ("average", "sync")
         self.param = solver_param
         self.precision = resolve_precision(solver_param, precision)
@@ -66,7 +75,13 @@ class DistributedSolver:
             net_param = solver_param.net_param or solver_param.train_net_param
         assert net_param is not None, "solver needs an inline net"
         self.mesh = mesh if mesh is not None else make_mesh(n_workers)
-        self.n_workers = self.mesh.shape[WORKER_AXIS]
+        self.has_dcn = DCN_AXIS in self.mesh.shape
+        self.dcn_interval = int(dcn_interval)
+        assert self.dcn_interval >= 1
+        assert self.has_dcn or self.dcn_interval == 1, \
+            "dcn_interval needs a (dcn, workers) mesh"
+        self.n_workers = self.mesh.shape[WORKER_AXIS] * (
+            self.mesh.shape[DCN_AXIS] if self.has_dcn else 1)
         self.net = Net(net_param, "TRAIN", data_shapes=data_shapes,
                        batch_override=batch_override)
         self.test_net = Net(net_param, "TEST", data_shapes=data_shapes,
@@ -76,27 +91,40 @@ class DistributedSolver:
         state0 = updates.init_state(params0, solver_param.resolved_type())
         # replicate-at-init == the reference's initial broadcast
         # (CifarApp.scala:92-99)
+        self._dataspec = (P((DCN_AXIS, WORKER_AXIS)) if self.has_dcn
+                          else P(WORKER_AXIS))
+        self._wsh = NamedSharding(self.mesh, self._dataspec)
         self.params_w = _stack_tree(params0, self.n_workers)
         self.state_w = _stack_tree(state0, self.n_workers)
-        wsh = NamedSharding(self.mesh, P(WORKER_AXIS))
-        self.params_w = jax.device_put(self.params_w, wsh)
-        self.state_w = jax.device_put(self.state_w, wsh)
+        self.params_w = jax.device_put(self.params_w, self._wsh)
+        self.state_w = jax.device_put(self.state_w, self._wsh)
         self.iter = 0
         self.round = 0
         self._rng = jax.random.PRNGKey(seed if seed >= 0 else 0)
         self.train_sources: Optional[List[DataSource]] = None
         self.test_source: Optional[DataSource] = None
         self._num_test_batches = 0
-        self._round_fn = self._build_round_fn()
+        self._round_fns: Dict[bool, Any] = {}
         self._test_step = jax.jit(self._build_test_step())
 
     # ----------------------------------------------------------------- build
-    def _build_round_fn(self):
+    def _round_fn(self, avg_dcn: bool = True):
+        if self.mode == "sync":
+            avg_dcn = True  # flag unused in sync mode; avoid a 2nd compile
+        if avg_dcn not in self._round_fns:
+            self._round_fns[avg_dcn] = self._build_round_fn(avg_dcn)
+        return self._round_fns[avg_dcn]
+
+    def _build_round_fn(self, avg_dcn: bool = True):
         single_step = make_single_step(self.net, self.param,
                                        precision=self.precision)
         tau = self.tau
         mode = self.mode
         axis = WORKER_AXIS
+        has_dcn = self.has_dcn
+        # sync mode always syncs globally; average mode crosses DCN only on
+        # avg_dcn rounds (the dcn_interval hierarchy)
+        sync_axes = (DCN_AXIS, WORKER_AXIS) if has_dcn else WORKER_AXIS
 
         def round_shard(params, state, it0, batches, rng):
             # shard_map hands us the leading worker-block of size 1: strip it.
@@ -115,8 +143,8 @@ class DistributedSolver:
                         return base_loss(p, inputs, step_rng)
                     (loss, stats), grads = jax.value_and_grad(
                         loss_fn, has_aux=True)(params)
-                    grads = jax.lax.pmean(grads, axis)
-                    loss = jax.lax.pmean(loss, axis)
+                    grads = jax.lax.pmean(grads, sync_axes)
+                    loss = jax.lax.pmean(loss, sync_axes)
                     grads_dict = grads
                     # reuse the shared update pipeline via single_step's
                     # components is cleaner, but clip/regularize order must
@@ -153,13 +181,17 @@ class DistributedSolver:
                 body, (params, state, it0), (batches, step_rngs))
             if mode == "average":
                 # the τ-interval weight average (WeightCollection mean,
-                # Net.scala:14-47) as one ICI collective
+                # Net.scala:14-47) as one ICI collective...
                 params = jax.lax.pmean(params, axis)
+                if has_dcn and avg_dcn:
+                    # ...plus the cross-slice average over DCN on
+                    # dcn_interval rounds
+                    params = jax.lax.pmean(params, DCN_AXIS)
             return (jax.tree.map(lambda a: a[None], params),
                     jax.tree.map(lambda a: a[None], state),
                     jnp.mean(losses))
 
-        wspec = P(WORKER_AXIS)
+        wspec = self._dataspec
         mapped = shard_map(
             round_shard, mesh=self.mesh,
             in_specs=(wspec, wspec, P(), wspec, wspec),
@@ -202,13 +234,14 @@ class DistributedSolver:
                                for k in pulls[0]})
         stacked = {k: np.stack([w[k] for w in per_worker])
                    for k in per_worker[0]}
-        wsh = NamedSharding(self.mesh, P(WORKER_AXIS))
-        batches = {k: jax.device_put(jnp.asarray(v), wsh)
+        batches = {k: jax.device_put(jnp.asarray(v), self._wsh)
                    for k, v in stacked.items()}
         rngs = jax.device_put(
             jax.random.split(jax.random.fold_in(self._rng, self.round),
-                             self.n_workers), wsh)
-        self.params_w, self.state_w, loss = self._round_fn(
+                             self.n_workers), self._wsh)
+        avg_dcn = (not self.has_dcn
+                   or self.round % self.dcn_interval == self.dcn_interval - 1)
+        self.params_w, self.state_w, loss = self._round_fn(avg_dcn)(
             self.params_w, self.state_w, jnp.int32(self.iter), batches, rngs)
         self.iter += self.tau
         self.round += 1
@@ -236,6 +269,5 @@ class DistributedSolver:
         params = jax.tree.map(lambda a: jnp.asarray(np.asarray(a[0])),
                               self.params_w)
         params = self.net.set_weights(params, weights)
-        wsh = NamedSharding(self.mesh, P(WORKER_AXIS))
         self.params_w = jax.device_put(_stack_tree(params, self.n_workers),
-                                       wsh)
+                                       self._wsh)

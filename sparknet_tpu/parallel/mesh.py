@@ -17,6 +17,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 WORKER_AXIS = "workers"
 MODEL_AXIS = "model"
+DCN_AXIS = "dcn"  # slice/host axis: collectives over it cross DCN, not ICI
 
 
 def make_mesh(n_workers: Optional[int] = None,
@@ -32,6 +33,62 @@ def make_mesh(n_workers: Optional[int] = None,
         raise ValueError(f"need {need} devices, have {len(devs)}")
     grid = np.asarray(devs[:need]).reshape(n_workers, model_parallel)
     return Mesh(grid, (WORKER_AXIS, MODEL_AXIS))
+
+
+def make_hierarchical_mesh(n_slices: int,
+                           workers_per_slice: Optional[int] = None,
+                           devices: Optional[Sequence[jax.Device]] = None,
+                           ) -> Mesh:
+    """A (dcn, workers) mesh for multi-slice / multi-host training.
+
+    Within a slice the worker axis rides ICI; the leading `dcn` axis
+    crosses slices over DCN.  On a real multi-host pod (after
+    `init_distributed`) the device grid is built host-contiguously so each
+    dcn row is one process's chips; single-process (and the CPU test
+    platform) just reshapes the flat device list the same way — the axis
+    semantics are identical either way, which is what the τ-interval
+    hierarchy in DistributedSolver keys on (SURVEY.md §2.4: collectives
+    ride ICI intra-slice, DCN across slices)."""
+    devs = list(devices if devices is not None else jax.devices())
+    if workers_per_slice is None:
+        workers_per_slice = len(devs) // n_slices
+    need = n_slices * workers_per_slice
+    if need > len(devs):
+        raise ValueError(f"need {need} devices, have {len(devs)}")
+    if jax.process_count() > 1:
+        # keep each dcn row on one process so the workers axis is ICI-only
+        by_process: dict = {}
+        for d in devs:
+            by_process.setdefault(d.process_index, []).append(d)
+        if len(by_process) != n_slices:
+            raise ValueError(
+                f"n_slices={n_slices} must equal the process count "
+                f"({len(by_process)}) in multi-host mode")
+        sizes = {p: len(row) for p, row in by_process.items()}
+        if any(s != workers_per_slice for s in sizes.values()):
+            raise ValueError(
+                f"workers_per_slice={workers_per_slice} does not match the "
+                f"per-process device counts {sizes}")
+        grid = np.asarray([row for _, row in sorted(by_process.items())])
+    else:
+        grid = np.asarray(devs[:need]).reshape(n_slices, workers_per_slice)
+    assert grid.shape == (n_slices, workers_per_slice)
+    return Mesh(grid, (DCN_AXIS, WORKER_AXIS))
+
+
+def init_distributed(coordinator_address: Optional[str] = None,
+                     num_processes: Optional[int] = None,
+                     process_id: Optional[int] = None) -> None:
+    """Multi-host bring-up: one call per host before any jax use
+    (the launcher invokes this on every TPU-VM worker; on Cloud TPU all
+    arguments are auto-detected from the metadata server).  Replaces the
+    reference's Spark executor registration (reference: CifarApp.scala:78
+    `sc.parallelize(0 until numWorkers)` + WorkerStore) — afterwards
+    `jax.devices()` spans every host's chips and meshes/collectives work
+    across DCN."""
+    jax.distributed.initialize(coordinator_address=coordinator_address,
+                               num_processes=num_processes,
+                               process_id=process_id)
 
 
 def worker_sharding(mesh: Mesh) -> NamedSharding:

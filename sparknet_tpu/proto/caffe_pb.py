@@ -353,6 +353,12 @@ class DummyDataParameter(View):
         return [FillerParameter(m) for m in self.msg.getlist("data_filler")]
 
 
+class PythonParameter(View):
+    # caffe.proto:810-817 — module/layer name a user PythonLayer class,
+    # param_str is free-form config handed to the instance before setup()
+    DEFAULTS = dict(module="", layer="", param_str="")
+
+
 class JavaDataParameter(View):
     """SparkNet's own layer param (reference: caffe.proto:991-993)."""
 
@@ -448,6 +454,7 @@ _PARAM_VIEWS = {
     "window_data_param": WindowDataParameter,
     "dummy_data_param": DummyDataParameter,
     "java_data_param": JavaDataParameter,
+    "python_param": PythonParameter,
 }
 
 

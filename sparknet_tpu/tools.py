@@ -144,20 +144,24 @@ def cmd_extract_features(args) -> int:
     return 0
 
 
+def _parse_mean(arg):
+    """--mean accepts a mean.binaryproto path or comma-separated
+    per-channel values (reference: python/classify.py --mean_file)."""
+    if not arg:
+        return None
+    if arg.endswith(".binaryproto"):
+        from .proto.binaryproto import read_mean_binaryproto
+
+        return read_mean_binaryproto(arg).mean(axis=(1, 2))
+    return np.array([float(v) for v in arg.split(",")], dtype=np.float32)
+
+
 def cmd_classify(args) -> int:
     """Classify image files, writing an (N, n_classes) probability array
     (reference: caffe/python/classify.py main)."""
     from .classify import Classifier, load_image
 
-    mean = None
-    if args.mean:
-        if args.mean.endswith(".binaryproto"):
-            from .proto.binaryproto import read_mean_binaryproto
-
-            mean = read_mean_binaryproto(args.mean).mean(axis=(1, 2))
-        else:
-            mean = np.array([float(v) for v in args.mean.split(",")],
-                            dtype=np.float32)
+    mean = _parse_mean(args.mean)
     clf = Classifier(
         args.model, args.weights,
         image_dims=[int(v) for v in args.images_dim.split(",")]
@@ -173,6 +177,54 @@ def cmd_classify(args) -> int:
     for path, p in zip(args.inputs, probs):
         top = int(np.argmax(p))
         print(f"{path}: class {top} p={float(p[top]):.4f}")
+    return 0
+
+
+def cmd_detect(args) -> int:
+    """Windowed detection-by-classification over a window listfile
+    (reference: caffe/python/detect.py — CSV of filename + ymin,xmin,
+    ymax,xmax rows, or whole-image windows when none given)."""
+    from .classify import Detector, load_image
+
+    det = Detector(args.model, args.weights, mean=_parse_mean(args.mean),
+                   raw_scale=args.raw_scale,
+                   context_pad=args.context_pad)
+    # one (image, [window]) entry per input line, so output row i is input
+    # line i and the npz carries the filename (the reference keys its
+    # output frame by filename; interleaved listfiles must not reorder)
+    entries = []  # (path, window)
+    if args.windows:
+        with open(args.windows) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                path, *coords = line.replace(",", " ").split()
+                entries.append((path, [int(float(v)) for v in coords[:4]]))
+    else:
+        for path in args.inputs:
+            entries.append((path, None))
+    image_cache: dict = {}
+    images_windows = []
+    for path, window in entries:
+        if path not in image_cache:
+            image_cache[path] = load_image(path)
+        img = image_cache[path]
+        if window is None:
+            window = [0, 0, img.shape[0], img.shape[1]]
+        images_windows.append((img, [window]))
+    dets = det.detect_windows(images_windows)
+    n_classes = next((len(d["prediction"]) for d in dets
+                      if d["prediction"] is not None), 0)
+    preds = np.full((len(dets), n_classes), np.nan, np.float32)
+    for i, d in enumerate(dets):
+        if d["prediction"] is not None:
+            preds[i] = d["prediction"]
+    np.savez(args.output,
+             filenames=np.asarray([p for p, _ in entries]),
+             windows=np.asarray([d["window"] for d in dets], np.int64),
+             predictions=preds)
+    print(f"Processed {len(dets)} windows into {args.output}")
     return 0
 
 
@@ -227,6 +279,17 @@ def register(sub) -> None:
     cl.add_argument("--channel_swap")
     cl.add_argument("--center_only", action="store_true")
     cl.set_defaults(fn=cmd_classify)
+
+    de = sub.add_parser("detect")
+    de.add_argument("inputs", nargs="*")
+    de.add_argument("--model", required=True)
+    de.add_argument("--weights")
+    de.add_argument("--output", required=True)
+    de.add_argument("--windows", help="listfile: path ymin xmin ymax xmax")
+    de.add_argument("--mean")
+    de.add_argument("--raw_scale", type=float, default=255.0)
+    de.add_argument("--context_pad", type=int, default=0)
+    de.set_defaults(fn=cmd_detect)
 
     from . import draw_net
     draw_net.register(sub)

@@ -1,0 +1,100 @@
+"""`detect` CLI verb (reference: caffe/python/detect.py) and the per-layer
+backward timing added to the `time` verb (reference: tools/caffe.cpp:331-356
+prints both forward and backward per-layer averages)."""
+
+import numpy as np
+import pytest
+
+from sparknet_tpu.cli import main
+from tests.conftest import reference_path
+
+DEPLOY = """
+name: "tiny_deploy"
+input: "data"
+input_shape { dim: 4 dim: 3 dim: 12 dim: 12 }
+layer { name: "conv1" type: "Convolution" bottom: "data" top: "conv1"
+  convolution_param { num_output: 4 kernel_size: 3 pad: 1
+    weight_filler { type: "xavier" } } }
+layer { name: "ip1" type: "InnerProduct" bottom: "conv1" top: "ip1"
+  inner_product_param { num_output: 5 weight_filler { type: "xavier" } } }
+layer { name: "prob" type: "Softmax" bottom: "ip1" top: "prob" }
+"""
+
+
+@pytest.fixture
+def deploy_file(tmp_path):
+    p = tmp_path / "deploy.prototxt"
+    p.write_text(DEPLOY)
+    return str(p)
+
+
+@pytest.fixture
+def image_files(tmp_path):
+    from PIL import Image
+
+    rng = np.random.RandomState(0)
+    paths = []
+    for i in range(2):
+        arr = rng.randint(0, 255, size=(20, 24, 3), dtype=np.uint8)
+        p = tmp_path / f"im{i}.png"
+        Image.fromarray(arr).save(p)
+        paths.append(str(p))
+    return paths
+
+
+def test_detect_whole_image(tmp_path, deploy_file, image_files, capsys):
+    out = str(tmp_path / "dets.npz")
+    rc = main(["detect", *image_files, "--model", deploy_file,
+               "--output", out])
+    assert rc == 0
+    z = np.load(out)
+    assert z["windows"].shape == (2, 4)
+    assert z["predictions"].shape == (2, 5)
+    assert np.isfinite(z["predictions"]).all()
+    np.testing.assert_allclose(z["predictions"].sum(axis=1), 1.0, rtol=1e-4)
+
+
+def test_detect_window_listfile(tmp_path, deploy_file, image_files):
+    wins = tmp_path / "windows.txt"
+    # interleaved images, one degenerate window; rows must stay line-ordered
+    wins.write_text(
+        f"{image_files[0]} 0 0 10 10\n"
+        f"{image_files[1]} 2 2 18 20\n"
+        f"{image_files[0]} 5,5,5,9\n"          # zero-height -> skipped
+        f"{image_files[1]} 0 0 20 24\n")
+    out = str(tmp_path / "dets.npz")
+    rc = main(["detect", "--model", deploy_file, "--windows", str(wins),
+               "--output", out])
+    assert rc == 0
+    z = np.load(out)
+    assert z["windows"].shape == (4, 4)
+    assert list(z["filenames"]) == [image_files[0], image_files[1],
+                                    image_files[0], image_files[1]]
+    np.testing.assert_array_equal(z["windows"][1], [2, 2, 18, 20])
+    assert np.isfinite(z["predictions"][0]).all()
+    assert np.isfinite(z["predictions"][1]).all()
+    assert np.isnan(z["predictions"][2]).all()   # degenerate slot kept
+    assert np.isfinite(z["predictions"][3]).all()
+
+
+def test_detect_context_pad(tmp_path, deploy_file, image_files):
+    wins = tmp_path / "windows.txt"
+    wins.write_text(f"{image_files[0]} 0 0 8 8\n")
+    out = str(tmp_path / "dets.npz")
+    rc = main(["detect", "--model", deploy_file, "--windows", str(wins),
+               "--context_pad", "4", "--output", out])
+    assert rc == 0
+    z = np.load(out)
+    assert np.isfinite(z["predictions"]).all()
+
+
+def test_time_verb_prints_backward(capsys):
+    rc = main(["time", "--model",
+               reference_path("caffe/examples/cifar10/"
+                              "cifar10_quick_train_test.prototxt"),
+               "--iterations", "2", "--batch", "4"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "conv1" in out
+    # every learnable layer reports a backward line
+    assert out.count("backward:") >= out.count("forward:") - 2
