@@ -25,8 +25,8 @@ from typing import Dict, Optional
 import numpy as np
 
 
-def _load_arrays(path: str, batch: int):
-    """Yield {data,label} batches forever from a CIFAR dir or an .npz."""
+def _load_batch_list(path: str, batch: int):
+    """Materialize the minibatch list once from a CIFAR dir or an .npz."""
     import os
 
     from .data import partition as part
@@ -39,15 +39,25 @@ def _load_arrays(path: str, batch: int):
     else:
         z = np.load(path)
         data, label = z["data"].astype(np.float32), z["label"]
-    batches = part.make_minibatches(data, label, batch)
-    i = [0]
+    return part.make_minibatches(data, label, batch)
+
+
+def _batch_source(batches, start: int = 0):
+    """Endless pull-source cycling the shared batch list from `start`."""
+    i = [start]
 
     def source():
         b = batches[i[0] % len(batches)]
         i[0] += 1
         return {"data": b[0], "label": b[1]}
 
-    return source, len(batches)
+    return source
+
+
+def _load_arrays(path: str, batch: int):
+    """Yield {data,label} batches forever from a CIFAR dir or an .npz."""
+    batches = _load_batch_list(path, batch)
+    return _batch_source(batches), len(batches)
 
 
 def cmd_train(args) -> int:
@@ -64,6 +74,8 @@ def cmd_train(args) -> int:
         c, h, w = (3, 32, 32)
         net = caffe_pb.replace_data_layers(net, bs, bs, c, h, w)
         sp = caffe_pb.load_solver_prototxt_with_net(args.solver, net)
+    if args.workers and args.workers > 1:
+        return _train_distributed(args, sp, net)
     solver = Solver(sp, net_param=net)
     if args.weights:
         solver.load_weights(args.weights)  # warm start (tools/caffe.cpp:169)
@@ -77,15 +89,73 @@ def cmd_train(args) -> int:
     n = args.iterations or int(sp.max_iter) or 100
     display = int(sp.display) or 50
     done = 0
-    while done < n:
-        chunk = min(display, n - done)
-        loss = solver.step(chunk)
-        done = solver.iter
-        print(f"Iteration {solver.iter}, loss = {loss:.6f}")
-        if handler.get_requested_action().name == "STOP":
-            break
+    with _maybe_profile(args):
+        while done < n:
+            chunk = min(display, n - done)
+            loss = solver.step(chunk)
+            done = solver.iter
+            print(f"Iteration {solver.iter}, loss = {loss:.6f}")
+            if handler.get_requested_action().name == "STOP":
+                break
     out = args.out or "trained.npz"
     solver.save_weights(out)  # the .caffemodel analogue
+    print(f"Optimization Done. Snapshot written to {out}")
+    return 0
+
+
+def _maybe_profile(args):
+    """--profile DIR captures a jax profiler trace of the run (SURVEY.md
+    §5.1 — the `caffe time`/Spark-event-log analogue; open in tensorboard
+    or xprof)."""
+    import contextlib
+
+    if getattr(args, "profile", None):
+        import jax
+
+        return jax.profiler.trace(args.profile)
+    return contextlib.nullcontext()
+
+
+def _train_distributed(args, sp, net) -> int:
+    """Multi-worker dispatch (the analogue of `caffe train --gpu=0,1,..`,
+    reference: tools/caffe.cpp:209-215 spawning P2PSync, and of the apps'
+    driver loops): τ local steps per worker per round + weight averaging
+    over the device mesh; each worker pulls from its own shard of the
+    data (CifarApp.scala:120-130 zipPartitions)."""
+    from .parallel.dist import DistributedSolver
+    from .parallel.mesh import make_mesh
+    from .utils.signals import SignalHandler, parse_effect
+
+    n = args.workers
+    tau = args.tau or 10
+    solver = DistributedSolver(sp, net_param=net, mesh=make_mesh(n),
+                               tau=tau, mode=args.mode)
+    if args.weights:
+        solver.load_weights(args.weights)
+    if args.snapshot:
+        solver.restore(args.snapshot)
+    handler = SignalHandler(parse_effect(args.sigint_effect),
+                            parse_effect(args.sighup_effect)).install()
+    # one shared batch list; worker w starts count/n batches into the cycle
+    # (the RDD-partition analogue, without n copies of the dataset in RAM)
+    batches = _load_batch_list(args.data, args.batch or 100)
+    solver.set_train_data([_batch_source(batches, w * len(batches) // n)
+                           for w in range(n)])
+    n_iters = args.iterations or int(sp.max_iter) or 100
+    with _maybe_profile(args):
+        while solver.iter < n_iters:
+            loss = solver.run_round()
+            print(f"Iteration {solver.iter}, loss = {loss:.6f} "
+                  f"(round {solver.round}, {n} workers, tau={solver.tau})")
+            action = handler.get_requested_action()
+            if action.name == "STOP":
+                break
+            if action.name == "SNAPSHOT":
+                state_path = solver.snapshot(
+                    (args.out or "trained.npz") + ".solverstate")
+                print(f"Snapshotted state to {state_path}")
+    out = args.out or "trained.npz"
+    solver.save_weights(out)
     print(f"Optimization Done. Snapshot written to {out}")
     return 0
 
@@ -227,6 +297,15 @@ def main(argv=None) -> int:
                    choices=["stop", "snapshot", "none"])
     t.add_argument("--sighup_effect", default="snapshot",
                    choices=["stop", "snapshot", "none"])
+    t.add_argument("--workers", type=int, default=1,
+                   help="device-parallel workers (caffe train --gpu=.. "
+                        "analogue); >1 uses the distributed solver")
+    t.add_argument("--tau", type=int,
+                   help="local SGD steps between weight averages")
+    t.add_argument("--mode", default="average",
+                   choices=["average", "sync"])
+    t.add_argument("--profile",
+                   help="write a jax profiler trace to this directory")
     t.set_defaults(fn=cmd_train)
 
     te = sub.add_parser("test")

@@ -21,6 +21,7 @@ broadcast/collect machinery of the reference collapses into one collective.
 from __future__ import annotations
 
 import functools
+import os
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import jax
@@ -32,8 +33,10 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from ..core.net import Net
 from ..proto.caffe_pb import NetParameter, SolverParameter
 from ..solver import updates
-from ..solver.solver import (DataSource, make_loss_fn, make_single_step,
-                             resolve_precision)
+from ..solver.solver import (DataSource, load_params_file, make_loss_fn,
+                             make_single_step, parse_caffe_snapshot,
+                             parse_native_snapshot, resolve_precision,
+                             save_params_file, write_native_snapshot)
 from .mesh import DCN_AXIS, WORKER_AXIS, make_mesh
 
 
@@ -260,6 +263,84 @@ class DistributedSolver:
         return {k: v / n for k, v in totals.items()}
 
     # ------------------------------------------------------------- weights
+    def _params0(self) -> Dict[str, jnp.ndarray]:
+        """Worker-0 replica as an ordinary params dict."""
+        return {k: jnp.asarray(np.asarray(v[0]))
+                for k, v in self.params_w.items()}
+
+    def _broadcast_params(self, params: Dict[str, jnp.ndarray]) -> None:
+        self.params_w = jax.device_put(_stack_tree(params, self.n_workers),
+                                       self._wsh)
+
+    def save_weights(self, path: str) -> None:
+        """Same format dispatch as Solver.save_weights (.caffemodel/.h5/npz),
+        writing the worker-0 replica (all equal after an averaging round)."""
+        save_params_file(path, self._params0(), self.net)
+
+    def load_weights(self, path: str) -> None:
+        """Warm start every replica (the reference's initial broadcast)."""
+        self._broadcast_params(load_params_file(path, self._params0(),
+                                                self.net))
+
+    def snapshot(self, path: str) -> str:
+        """Native npz snapshot: iter + worker-0 params (all replicas equal
+        after an averaging round) + the FULL per-worker solver history —
+        momentum states are worker-local between averages (the reference
+        keeps them in each executor's WorkerStore too), so exact resume
+        needs all of them.  Worker-0 `state:` views are also written, which
+        is what the single-chip Solver's restore reads."""
+        state0 = jax.tree.map(lambda a: np.asarray(a[0]), self.state_w)
+        extra = {f"wstate:{i}:{k}": np.asarray(h)
+                 for k, hs in self.state_w.items()
+                 for i, h in enumerate(hs)}
+        return write_native_snapshot(path, self.iter, self._params0(),
+                                     state0, extra=extra)
+
+    def restore(self, path: str) -> None:
+        if path.endswith(".solverstate") or path.endswith(".h5"):
+            # reference-format pair written by snapshot_caffe_style: weights
+            # are name-matched, history is broadcast (it has no worker dim)
+            if path.endswith(".h5") and not os.path.exists(path):
+                cand = path[:-3] + ".solverstate.h5"
+                if os.path.exists(cand):
+                    path = cand
+            it, weights, state = parse_caffe_snapshot(
+                path, list(self.params_w.keys()),
+                self.param.resolved_type())
+            params = self._params0()
+            if weights is not None:
+                params = self.net.set_weights(params, weights)
+            self.iter = it
+            self.round = it // self.tau
+            self._broadcast_params(params)
+            if state is not None:
+                self.state_w = jax.device_put(
+                    _stack_tree(state, self.n_workers), self._wsh)
+            return
+        data = np.load(path if path.endswith(".npz") else path + ".npz")
+        it, params, state = parse_native_snapshot(data)
+        self.iter = it
+        self.round = it // self.tau
+        self._broadcast_params(params)
+        wstate: Dict[str, List[np.ndarray]] = {}
+        for name in data.files:
+            if name.startswith("wstate:"):
+                _, idx, key = name.split(":", 2)
+                slots = wstate.setdefault(key, [])
+                while len(slots) <= int(idx):
+                    slots.append(None)  # type: ignore[arg-type]
+                slots[int(idx)] = data[name]
+        if wstate and all(v[0].shape[0] == self.n_workers
+                          for v in wstate.values()):
+            # exact per-worker history resume
+            self.state_w = jax.device_put(
+                {k: tuple(jnp.asarray(h) for h in v)
+                 for k, v in wstate.items()}, self._wsh)
+        else:
+            # single-chip snapshot (or worker count changed): broadcast
+            self.state_w = jax.device_put(
+                _stack_tree(state, self.n_workers), self._wsh)
+
     def get_weights(self) -> Dict[str, List[np.ndarray]]:
         """Worker-0 weights (all equal right after an averaging round)."""
         params = jax.tree.map(lambda a: np.asarray(a[0]), self.params_w)

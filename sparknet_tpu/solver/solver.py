@@ -344,14 +344,7 @@ class Solver:
                     stem = path[:-len(suffix)]
                     break
             return self._snapshot_caffe_pair(stem, "HDF5")
-        arrays: Dict[str, np.ndarray] = {"__iter__": np.asarray(self.iter)}
-        for k, v in self.params.items():
-            arrays[f"param:{k}"] = np.asarray(v)
-        for k, hs in self.state.items():
-            for i, h in enumerate(hs):
-                arrays[f"state:{i}:{k}"] = np.asarray(h)
-        np.savez(path, **arrays)
-        return path if path.endswith(".npz") else path + ".npz"
+        return write_native_snapshot(path, self.iter, self.params, self.state)
 
     def snapshot_caffe_style(self, prefix: Optional[str] = None) -> str:
         """Write the reference's snapshot *pair* — model + solver state —
@@ -396,83 +389,28 @@ class Solver:
         if path.endswith(".solverstate") or path.endswith(".h5"):
             self._restore_caffe_state(path)
             return
-        data = np.load(path if path.endswith(".npz") else path + ".npz")
-        self.iter = int(data["__iter__"])
-        params = {}
-        state: Dict[str, List[np.ndarray]] = {}
-        for name in data.files:
-            if name.startswith("param:"):
-                params[name[len("param:"):]] = jnp.asarray(data[name])
-            elif name.startswith("state:"):
-                _, idx, key = name.split(":", 2)
-                state.setdefault(key, [])
-                slots = state[key]
-                while len(slots) <= int(idx):
-                    slots.append(None)  # type: ignore[arg-type]
-                slots[int(idx)] = jnp.asarray(data[name])
-        self.params = params
-        self.state = {k: tuple(v) for k, v in state.items()}
+        self.iter, self.params, self.state = parse_native_snapshot(path)
 
     def _restore_caffe_state(self, path: str) -> None:
-        from ..proto import binaryproto, hdf5_format
-
-        if path.endswith(".h5"):
-            st = hdf5_format.read_solver_state_hdf5(path)
-        else:
-            st = binaryproto.read_solverstate(path)
-        # Resolve learned_net and load its weights BEFORE mutating any
-        # solver state, so a missing model file can't leave the solver
-        # half-restored.  Relative learned_net paths (snapshot_prefix was
-        # relative) resolve against the state file's directory.
-        learned = str(st.get("learned_net", ""))
-        new_weights = None
-        if learned:
-            if not os.path.isabs(learned) and not os.path.exists(learned):
-                candidate = os.path.join(os.path.dirname(os.path.abspath(path)),
-                                         os.path.basename(learned))
-                if os.path.exists(candidate):
-                    learned = candidate
-            if learned.endswith(".h5"):
-                new_weights = hdf5_format.read_weights_hdf5(learned)
-            else:
-                new_weights = binaryproto.read_caffemodel(learned)
-        param_order = list(self.params.keys())
-        n_slots = updates.N_SLOTS[self.solver_type]
-        history = st["history"]  # type: ignore[assignment]
-        restored = None
-        if history:
-            restored = hdf5_format.unflatten_state(
-                history, param_order, n_slots)  # type: ignore[arg-type]
+        it, new_weights, restored = parse_caffe_snapshot(
+            path, list(self.params.keys()), self.solver_type)
         # All parsing/validation that can fail has now run; apply weights
         # (set_weights shape-checks) before touching state/iter so a failure
         # cannot leave the solver half-restored.
         if new_weights is not None:
             self.set_weights(new_weights)
         if restored is not None:
-            self.state = {k: tuple(jnp.asarray(h) for h in v)
-                          for k, v in restored.items()}
-        self.iter = int(st["iter"])  # type: ignore[arg-type]
+            self.state = restored
+        self.iter = it
 
     def save_weights(self, path: str) -> None:
         """(reference: ccaffe.h:68 save_weights_to_file).  Dispatches on
         extension: .caffemodel (binaryproto), .h5 (HDF5), else npz."""
-        if path.endswith(".caffemodel"):
-            self.save_caffemodel(path)
-        elif path.endswith(".h5"):
-            from ..proto.hdf5_format import write_weights_hdf5
-
-            write_weights_hdf5(path, self.get_weights())
-        else:
-            np.savez(path,
-                     **{k: np.asarray(v) for k, v in self.params.items()})
+        save_params_file(path, self.params, self.net)
 
     def load_weights(self, path: str) -> None:
         """(reference: ccaffe.h:69 load_weights_from_file)"""
-        if path.endswith(".caffemodel") or path.endswith(".h5"):
-            self.copy_trained_layers_from(path)
-            return
-        data = np.load(path if path.endswith(".npz") else path + ".npz")
-        self.params = {k: jnp.asarray(data[k]) for k in data.files}
+        self.params = load_params_file(path, self.params, self.net)
 
     def copy_trained_layers_from(self, path: str) -> None:
         """Name-matched weight copy for warm starts and fine-tuning: source
@@ -500,3 +438,113 @@ class Solver:
         from ..proto.binaryproto import write_caffemodel
 
         write_caffemodel(path, self.get_weights())
+
+
+# -------------------------------------------------------------- weight files
+# Shared by Solver and the distributed solver/CLI so every surface speaks the
+# same formats (reference: ccaffe.h:68-70 save/load/restore file API).
+
+def save_params_file(path: str, params: Dict[str, jnp.ndarray], net) -> None:
+    """Format-dispatched weight write: .caffemodel (binaryproto), .h5
+    (Caffe HDF5 layout), else a param-key npz."""
+    if path.endswith(".caffemodel"):
+        from ..proto.binaryproto import write_caffemodel
+
+        write_caffemodel(path, net.get_weights(params))
+    elif path.endswith(".h5"):
+        from ..proto.hdf5_format import write_weights_hdf5
+
+        write_weights_hdf5(path, net.get_weights(params))
+    else:
+        np.savez(path, **{k: np.asarray(v) for k, v in params.items()})
+
+
+def load_params_file(path: str, params: Dict[str, jnp.ndarray], net
+                     ) -> Dict[str, jnp.ndarray]:
+    """Inverse of save_params_file.  npz replaces params wholesale by key;
+    .caffemodel/.h5 do the reference's name-matched layer copy
+    (Net::CopyTrainedLayersFrom semantics — unmatched layers keep their
+    current values)."""
+    if path.endswith(".caffemodel") or path.endswith(".h5"):
+        from ..proto import binaryproto, hdf5_format
+
+        weights = (hdf5_format.read_weights_hdf5(path) if path.endswith(".h5")
+                   else binaryproto.read_caffemodel(path))
+        return net.set_weights(params, weights)
+    data = np.load(path if path.endswith(".npz") else path + ".npz")
+    return {k: jnp.asarray(data[k]) for k in data.files}
+
+
+def write_native_snapshot(path: str, it: int, params, state,
+                          extra: Optional[Dict[str, np.ndarray]] = None
+                          ) -> str:
+    """The native npz snapshot triple: iteration + params + solver history
+    (reference: Solver::Snapshot + SnapshotSolverState).  `extra` lets
+    callers append arrays (e.g. per-worker history) in the same write."""
+    arrays: Dict[str, np.ndarray] = {"__iter__": np.asarray(it)}
+    for k, v in params.items():
+        arrays[f"param:{k}"] = np.asarray(v)
+    for k, hs in state.items():
+        for i, h in enumerate(hs):
+            arrays[f"state:{i}:{k}"] = np.asarray(h)
+    if extra:
+        arrays.update(extra)
+    np.savez(path, **arrays)
+    return path if path.endswith(".npz") else path + ".npz"
+
+
+def parse_caffe_snapshot(path: str, param_order: List[str], solver_type: str):
+    """Parse a reference-format .solverstate / .solverstate.h5 pair
+    (reference: Solver::Restore) -> (iter, weights_or_None, state_or_None).
+    weights is a layer-name -> blob-list dict (name-matched copy semantics);
+    relative learned_net paths resolve against the state file's directory."""
+    from ..proto import binaryproto, hdf5_format
+
+    if path.endswith(".h5"):
+        st = hdf5_format.read_solver_state_hdf5(path)
+    else:
+        st = binaryproto.read_solverstate(path)
+    learned = str(st.get("learned_net", ""))
+    new_weights = None
+    if learned:
+        if not os.path.isabs(learned) and not os.path.exists(learned):
+            candidate = os.path.join(os.path.dirname(os.path.abspath(path)),
+                                     os.path.basename(learned))
+            if os.path.exists(candidate):
+                learned = candidate
+        if learned.endswith(".h5"):
+            new_weights = hdf5_format.read_weights_hdf5(learned)
+        else:
+            new_weights = binaryproto.read_caffemodel(learned)
+    n_slots = updates.N_SLOTS[solver_type]
+    history = st["history"]  # type: ignore[assignment]
+    restored = None
+    if history:
+        unflat = hdf5_format.unflatten_state(
+            history, param_order, n_slots)  # type: ignore[arg-type]
+        restored = {k: tuple(jnp.asarray(h) for h in v)
+                    for k, v in unflat.items()}
+    return int(st["iter"]), new_weights, restored  # type: ignore[arg-type]
+
+
+def parse_native_snapshot(path_or_data):
+    """Inverse of write_native_snapshot -> (iter, params, state).  Accepts a
+    path or an already-opened npz mapping (so callers reading extra keys
+    load the file once)."""
+    data = (path_or_data if not isinstance(path_or_data, str)
+            else np.load(path_or_data if path_or_data.endswith(".npz")
+                         else path_or_data + ".npz"))
+    it = int(data["__iter__"])
+    params = {}
+    state: Dict[str, List[np.ndarray]] = {}
+    for name in data.files:
+        if name.startswith("param:"):
+            params[name[len("param:"):]] = jnp.asarray(data[name])
+        elif name.startswith("state:"):
+            _, idx, key = name.split(":", 2)
+            state.setdefault(key, [])
+            slots = state[key]
+            while len(slots) <= int(idx):
+                slots.append(None)  # type: ignore[arg-type]
+            slots[int(idx)] = jnp.asarray(data[name])
+    return it, params, {k: tuple(v) for k, v in state.items()}

@@ -195,11 +195,16 @@ def cmd_detect(args) -> int:
     entries = []  # (path, window)
     if args.windows:
         with open(args.windows) as f:
-            for line in f:
+            for lineno, line in enumerate(f, 1):
                 line = line.strip()
                 if not line:
                     continue
                 path, *coords = line.replace(",", " ").split()
+                if len(coords) < 4:
+                    print(f"{args.windows}:{lineno}: expected "
+                          f"'path ymin xmin ymax xmax', got {line!r}",
+                          file=sys.stderr)
+                    return 1
                 entries.append((path, [int(float(v)) for v in coords[:4]]))
     else:
         for path in args.inputs:

@@ -58,6 +58,64 @@ def test_train_and_test_verbs(tmp_path, toy_npz, capsys):
     assert "accuracy" in out_text and "loss" in out_text
 
 
+def test_train_distributed_verb(tmp_path, toy_npz, capsys):
+    """--workers N dispatches to the mesh solver (the `caffe train
+    --gpu=0,1,..` analogue, tools/caffe.cpp:209-215) and writes weights
+    the test verb can load."""
+    solver = reference_path(
+        "caffe/examples/cifar10/cifar10_quick_solver.prototxt")
+    text = open(solver).read().replace(
+        "examples/cifar10/cifar10_quick_train_test.prototxt",
+        reference_path(
+            "caffe/examples/cifar10/cifar10_quick_train_test.prototxt"))
+    sp = tmp_path / "solver.prototxt"
+    sp.write_text(text)
+    out = str(tmp_path / "weights_dist.npz")
+    rc = cli.main(["train", "--solver", str(sp), "--data", toy_npz,
+                   "--iterations", "4", "--batch", "8", "--workers", "4",
+                   "--tau", "2", "--out", out,
+                   "--profile", str(tmp_path / "trace")])
+    assert rc == 0
+    assert os.path.exists(out)
+    txt = capsys.readouterr().out
+    assert "4 workers, tau=2" in txt
+    assert os.path.isdir(tmp_path / "trace")  # profiler trace captured
+
+    rc = cli.main(["test", "--model",
+                   reference_path("caffe/examples/cifar10/"
+                                  "cifar10_quick_train_test.prototxt"),
+                   "--weights", out, "--data", toy_npz,
+                   "--iterations", "2", "--batch", "16"])
+    assert rc == 0
+    assert "accuracy" in capsys.readouterr().out
+
+
+def test_train_distributed_caffemodel_out_and_warm_start(tmp_path, toy_npz,
+                                                         capsys):
+    """--out dispatches on extension in the distributed path too, and the
+    produced .caffemodel warm-starts a follow-up distributed run."""
+    solver = reference_path(
+        "caffe/examples/cifar10/cifar10_quick_solver.prototxt")
+    text = open(solver).read().replace(
+        "examples/cifar10/cifar10_quick_train_test.prototxt",
+        reference_path(
+            "caffe/examples/cifar10/cifar10_quick_train_test.prototxt"))
+    sp = tmp_path / "solver.prototxt"
+    sp.write_text(text)
+    out = str(tmp_path / "weights.caffemodel")
+    rc = cli.main(["train", "--solver", str(sp), "--data", toy_npz,
+                   "--iterations", "2", "--batch", "8", "--workers", "2",
+                   "--tau", "2", "--out", out])
+    assert rc == 0
+    assert os.path.exists(out)  # no stray .npz suffix
+    rc = cli.main(["train", "--solver", str(sp), "--data", toy_npz,
+                   "--iterations", "2", "--batch", "8", "--workers", "2",
+                   "--tau", "2", "--weights", out,
+                   "--out", str(tmp_path / "w2.npz")])
+    assert rc == 0
+    capsys.readouterr()
+
+
 def test_time_verb(capsys):
     rc = cli.main(["time", "--model",
                    reference_path("caffe/examples/cifar10/"

@@ -77,6 +77,16 @@ def test_detect_window_listfile(tmp_path, deploy_file, image_files):
     assert np.isfinite(z["predictions"][3]).all()
 
 
+def test_detect_malformed_listfile_line(tmp_path, deploy_file, image_files,
+                                        capsys):
+    wins = tmp_path / "windows.txt"
+    wins.write_text(f"{image_files[0]} 0 0 10 10\n{image_files[0]} 3 4\n")
+    rc = main(["detect", "--model", deploy_file, "--windows", str(wins),
+               "--output", str(tmp_path / "d.npz")])
+    assert rc == 1
+    assert "windows.txt:2" in capsys.readouterr().err
+
+
 def test_detect_context_pad(tmp_path, deploy_file, image_files):
     wins = tmp_path / "windows.txt"
     wins.write_text(f"{image_files[0]} 0 0 8 8\n")

@@ -1,0 +1,57 @@
+"""bvlc_googlenet end-to-end build/train coverage (reference:
+caffe/models/bvlc_googlenet/train_val.prototxt — the deepest bundled model:
+9 inception blocks, 2 auxiliary loss heads at weight 0.3, LRN, concat,
+dropout, global-average pool)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from sparknet_tpu.core.net import Net
+from sparknet_tpu.proto import caffe_pb
+from sparknet_tpu.solver import updates
+from sparknet_tpu.solver.solver import make_single_step
+from tests.conftest import reference_path
+
+PROTO = reference_path("caffe/models/bvlc_googlenet/train_val.prototxt")
+
+
+@pytest.fixture(scope="module")
+def train_net():
+    return Net(caffe_pb.load_net_prototxt(PROTO), "TRAIN", batch_override=2)
+
+
+def test_build_and_aux_heads(train_net):
+    # the three softmax losses with the reference's weights
+    assert sorted(train_net.loss_terms) == [
+        ("loss1/loss1", 0.3), ("loss2/loss1", 0.3), ("loss3/loss3", 1.0)]
+    # inception concat axes inferred: first block outputs 256 channels
+    assert train_net.blob_shapes["inception_3a/output"][1] == 256
+    assert train_net.blob_shapes["pool5/7x7_s1"][2:] == (1, 1)
+
+
+def test_test_phase_has_accuracy():
+    net = Net(caffe_pb.load_net_prototxt(PROTO), "TEST", batch_override=2)
+    tops = set()
+    for bl in net.layers:
+        tops.update(bl.tops)
+    assert "loss3/top-1" in tops and "loss3/top-5" in tops
+
+
+def test_one_train_step(train_net):
+    sp = caffe_pb.load_solver_prototxt(
+        reference_path("caffe/models/bvlc_googlenet/solver.prototxt"))
+    params = train_net.init_params(0)
+    state = updates.init_state(params, sp.resolved_type())
+    step = jax.jit(make_single_step(train_net, sp))
+    rng = np.random.RandomState(0)
+    batch = {"data": jnp.asarray(rng.rand(2, 3, 224, 224).astype(np.float32)),
+             "label": jnp.asarray(rng.randint(0, 1000, (2,)).astype(np.int32))}
+    p1, s1, loss = step(params, state, jnp.int32(0), batch,
+                        jax.random.PRNGKey(0))
+    # random-init loss ~= (1 + 0.3 + 0.3) * ln(1000)
+    assert 7.0 < float(loss) < 14.0
+    moved = sum(int(not np.allclose(np.asarray(p1[k]), np.asarray(params[k])))
+                for k in params)
+    assert moved > 100  # every learnable blob stepped
