@@ -69,11 +69,14 @@ def main() -> None:
         return time.perf_counter() - t0
 
     run_chain(WARMUP_STEPS)  # compile + warm caches
-    short = run_chain(2)
-    long = run_chain(2 + MEASURE_STEPS)
-    dt = long - short  # fixed fetch latency cancels
-
-    imgs_per_sec = MEASURE_STEPS * BATCH / dt
+    # the shared chip's throughput drifts run to run; take the median of
+    # three differenced windows so one slow window doesn't define the number
+    rates = []
+    for _ in range(3):
+        short = run_chain(2)
+        long = run_chain(2 + MEASURE_STEPS)
+        rates.append(MEASURE_STEPS * BATCH / (long - short))
+    imgs_per_sec = float(np.median(rates))
     print(json.dumps({
         "metric": "alexnet_train_imgs_per_sec",
         "value": round(imgs_per_sec, 1),

@@ -373,6 +373,22 @@ def _data_layer_shapes(net: Net, layer: LayerParameter,
         crop = int(layer.transform_param.crop_size)
         if crop:
             chw = (3, crop, crop)
+        else:
+            # the reference reshapes from the first DB datum
+            # (data_layer.cpp DataLayerSetUp); peek the store if it exists,
+            # else the caller must pass data_shapes
+            import os as _os
+
+            src = str(dp.source)
+            if src and _os.path.exists(src):
+                from ..data.store import ArrayStoreCursor
+
+                try:
+                    first, _ = ArrayStoreCursor(src).next()
+                    chw = tuple(first.shape)  # type: ignore[assignment]
+                except Exception:
+                    pass  # not an ArrayStore (e.g. a Caffe LMDB dir) or
+                    # empty — fall through to the data_shapes error below
     elif ltype == "ImageData":
         ip = layer.image_data_param
         batch = int(ip.batch_size)
@@ -397,12 +413,13 @@ def _data_layer_shapes(net: Net, layer: LayerParameter,
             out.append(s)
         elif t == tops[0] and batch and chw:
             out.append((batch,) + tuple(chw))
-        elif batch:
+        elif t != tops[0] and batch:
             out.append((batch,))  # label
         else:
             raise ValueError(
                 f"cannot infer shape for data blob {t!r} of layer "
-                f"{layer.name!r}; pass data_shapes={{{t!r}: (...)}}")
+                f"{layer.name!r} (no crop_size, no readable source store); "
+                f"pass data_shapes={{{t!r}: (...)}}")
     return out
 
 

@@ -35,7 +35,8 @@ from ..proto.caffe_pb import NetParameter, SolverParameter
 from ..solver import updates
 from ..solver.solver import (DataSource, load_params_file, make_loss_fn,
                              make_single_step, parse_caffe_snapshot,
-                             parse_native_snapshot, resolve_precision,
+                             parse_native_snapshot, parse_slot_arrays,
+                             resolve_precision, resolve_solverstate_path,
                              save_params_file, write_native_snapshot)
 from .mesh import DCN_AXIS, WORKER_AXIS, make_mesh
 
@@ -264,9 +265,9 @@ class DistributedSolver:
 
     # ------------------------------------------------------------- weights
     def _params0(self) -> Dict[str, jnp.ndarray]:
-        """Worker-0 replica as an ordinary params dict."""
-        return {k: jnp.asarray(np.asarray(v[0]))
-                for k, v in self.params_w.items()}
+        """Worker-0 replica as an ordinary params dict (device views — no
+        host round trip; savers np.asarray on their own)."""
+        return {k: v[0] for k, v in self.params_w.items()}
 
     def _broadcast_params(self, params: Dict[str, jnp.ndarray]) -> None:
         self.params_w = jax.device_put(_stack_tree(params, self.n_workers),
@@ -297,16 +298,15 @@ class DistributedSolver:
                                      state0, extra=extra)
 
     def restore(self, path: str) -> None:
+        path = resolve_solverstate_path(path)
         if path.endswith(".solverstate") or path.endswith(".h5"):
             # reference-format pair written by snapshot_caffe_style: weights
-            # are name-matched, history is broadcast (it has no worker dim)
-            if path.endswith(".h5") and not os.path.exists(path):
-                cand = path[:-3] + ".solverstate.h5"
-                if os.path.exists(cand):
-                    path = cand
+            # are name-matched, history is broadcast (it has no worker dim).
+            # History is positional in NET order (flatten_state follows
+            # init_params insertion order) — params_w keys are tree-sorted,
+            # so they must NOT be used here.
             it, weights, state = parse_caffe_snapshot(
-                path, list(self.params_w.keys()),
-                self.param.resolved_type())
+                path, self.net.param_keys, self.param.resolved_type())
             params = self._params0()
             if weights is not None:
                 params = self.net.set_weights(params, weights)
@@ -322,20 +322,11 @@ class DistributedSolver:
         self.iter = it
         self.round = it // self.tau
         self._broadcast_params(params)
-        wstate: Dict[str, List[np.ndarray]] = {}
-        for name in data.files:
-            if name.startswith("wstate:"):
-                _, idx, key = name.split(":", 2)
-                slots = wstate.setdefault(key, [])
-                while len(slots) <= int(idx):
-                    slots.append(None)  # type: ignore[arg-type]
-                slots[int(idx)] = data[name]
+        wstate = parse_slot_arrays(data, "wstate")
         if wstate and all(v[0].shape[0] == self.n_workers
                           for v in wstate.values()):
             # exact per-worker history resume
-            self.state_w = jax.device_put(
-                {k: tuple(jnp.asarray(h) for h in v)
-                 for k, v in wstate.items()}, self._wsh)
+            self.state_w = jax.device_put(wstate, self._wsh)
         else:
             # single-chip snapshot (or worker count changed): broadcast
             self.state_w = jax.device_put(

@@ -360,7 +360,8 @@ class Solver:
         from ..proto import binaryproto, hdf5_format
 
         weights = self.get_weights()
-        param_order = list(self.params.keys())
+        # positional history follows NET param order on both write and read
+        param_order = self.net.param_keys
         history = hdf5_format.flatten_state(self.state, param_order)
         if fmt == "HDF5":
             model = stem + ".caffemodel.h5"
@@ -382,18 +383,18 @@ class Solver:
         Accepts the native .npz or either reference .solverstate format; a
         bare `x.h5` resolves to `x.solverstate.h5` if that exists (the pair
         snapshot(x.h5) wrote)."""
-        if path.endswith(".h5") and not os.path.exists(path):
-            stem_state = path[:-3] + ".solverstate.h5"
-            if os.path.exists(stem_state):
-                path = stem_state
+        path = resolve_solverstate_path(path)
         if path.endswith(".solverstate") or path.endswith(".h5"):
             self._restore_caffe_state(path)
             return
         self.iter, self.params, self.state = parse_native_snapshot(path)
 
     def _restore_caffe_state(self, path: str) -> None:
+        # history is positional in NET order (flatten_state follows
+        # init_params insertion order); self.params order can drift after a
+        # load_weights, so take the order from the net itself
         it, new_weights, restored = parse_caffe_snapshot(
-            path, list(self.params.keys()), self.solver_type)
+            path, self.net.param_keys, self.solver_type)
         # All parsing/validation that can fail has now run; apply weights
         # (set_weights shape-checks) before touching state/iter so a failure
         # cannot leave the solver half-restored.
@@ -527,6 +528,30 @@ def parse_caffe_snapshot(path: str, param_order: List[str], solver_type: str):
     return int(st["iter"]), new_weights, restored  # type: ignore[arg-type]
 
 
+def parse_slot_arrays(data, prefix: str) -> Dict[str, Tuple[jnp.ndarray, ...]]:
+    """Rebuild `{prefix}:{slot}:{key}` npz entries into key -> slot tuple."""
+    state: Dict[str, List[jnp.ndarray]] = {}
+    head = prefix + ":"
+    for name in data.files:
+        if name.startswith(head):
+            _, idx, key = name.split(":", 2)
+            slots = state.setdefault(key, [])
+            while len(slots) <= int(idx):
+                slots.append(None)  # type: ignore[arg-type]
+            slots[int(idx)] = jnp.asarray(data[name])
+    return {k: tuple(v) for k, v in state.items()}
+
+
+def resolve_solverstate_path(path: str) -> str:
+    """A bare `x.h5` resolves to `x.solverstate.h5` if that exists (the
+    pair snapshot(x.h5) wrote)."""
+    if path.endswith(".h5") and not os.path.exists(path):
+        cand = path[:-3] + ".solverstate.h5"
+        if os.path.exists(cand):
+            return cand
+    return path
+
+
 def parse_native_snapshot(path_or_data):
     """Inverse of write_native_snapshot -> (iter, params, state).  Accepts a
     path or an already-opened npz mapping (so callers reading extra keys
@@ -536,15 +561,7 @@ def parse_native_snapshot(path_or_data):
                          else path_or_data + ".npz"))
     it = int(data["__iter__"])
     params = {}
-    state: Dict[str, List[np.ndarray]] = {}
     for name in data.files:
         if name.startswith("param:"):
             params[name[len("param:"):]] = jnp.asarray(data[name])
-        elif name.startswith("state:"):
-            _, idx, key = name.split(":", 2)
-            state.setdefault(key, [])
-            slots = state[key]
-            while len(slots) <= int(idx):
-                slots.append(None)  # type: ignore[arg-type]
-            slots[int(idx)] = jnp.asarray(data[name])
-    return it, params, {k: tuple(v) for k, v in state.items()}
+    return it, params, parse_slot_arrays(data, "state")

@@ -157,21 +157,44 @@ def test_distributed_save_load_weights_formats(tmp_path):
 
 def test_distributed_restore_from_caffe_solverstate(tmp_path):
     """A single-chip snapshot_caffe_style pair resumes a distributed run
-    (weights name-matched, history broadcast)."""
+    (weights name-matched, history broadcast).  The net's layer names sort
+    DIFFERENTLY than net order (zz_ip before an alphabetically-earlier
+    loss bottom), catching positional-history mapping against tree-sorted
+    param dicts — the solverstate history is written in net order."""
     from sparknet_tpu.solver.solver import Solver
 
-    single = Solver(_solver())
+    net_txt = NET.replace('name: "ip1"', 'name: "zz_ip"').replace(
+        'bottom: "ip1"', 'bottom: "zz_ip"').replace(
+        'top: "ip1"', 'top: "zz_ip"') .replace(
+        'layer { name: "loss"',
+        '''layer { name: "aa_extra" type: "InnerProduct" bottom: "zz_ip"
+  top: "aa_extra" inner_product_param { num_output: 3
+    weight_filler { type: "gaussian" std: 0.1 } } }
+layer { name: "loss"''').replace(
+        'bottom: "zz_ip"\n  bottom: "label"', 'bottom: "aa_extra"\n  bottom: "label"')
+    sp = caffe_pb.SolverParameter(parse(
+        'base_lr: 0.05\nlr_policy: "fixed"\nmomentum: 0.9\nrandom_seed: 7'))
+    sp.msg.set("net_param", caffe_pb.parse_net_text(net_txt).msg)
+    single = Solver(sp)
+    # param order is net order (zz_ip before aa_extra), sorted order differs
+    assert single.net.param_keys != sorted(single.net.param_keys)
     src = _sources(1)[0]
     single.set_train_data(src)
     single.step(3)
     state_path = single.snapshot_caffe_style(str(tmp_path / "snap"))
 
-    d = DistributedSolver(_solver(), mesh=make_mesh(4), tau=2)
+    d = DistributedSolver(sp, mesh=make_mesh(4), tau=2)
     d.restore(state_path)
     assert d.iter == 3
     pd = _p0(d)
     for k, v in single.params.items():
         np.testing.assert_allclose(pd[k], np.asarray(v), rtol=1e-6)
+    # momentum history landed on the RIGHT params (net order, not sorted)
+    for k, hs in single.state.items():
+        for i, h in enumerate(hs):
+            np.testing.assert_allclose(
+                np.asarray(d.state_w[k][i][0]), np.asarray(h), rtol=1e-6,
+                err_msg=f"history mismatch for {k}[{i}]")
     # and it keeps training
     d.set_train_data(_sources(4))
     assert np.isfinite(d.run_round())
