@@ -129,6 +129,22 @@ def lrn_layer(name: str, bottom: str, *, local_size: int = 5,
                   lrn_param=_msg(local_size=local_size, alpha=alpha, beta=beta))
 
 
+def attention_layer(name: str, bottom: str, *, num_heads: int = 1,
+                    causal: bool = False, method: str = "dense",
+                    block_size: int = 128, bias_term: bool = True,
+                    weight_filler: Union[None, str, Dict] = "xavier",
+                    bias_filler: Union[None, str, Dict] = None,
+                    top: Optional[str] = None) -> Message:
+    """Multi-head self-attention (framework extension; see
+    core/net.py build_attention)."""
+    return _layer(name, "Attention", bottom, top or name,
+                  attention_param=_msg(
+                      num_heads=num_heads, causal=causal, method=method,
+                      block_size=block_size, bias_term=bias_term,
+                      weight_filler=_filler(weight_filler),
+                      bias_filler=_filler(bias_filler)))
+
+
 def concat_layer(name: str, bottoms: Sequence[str], *, axis: int = 1,
                  top: Optional[str] = None) -> Message:
     return _layer(name, "Concat", list(bottoms), top or name,

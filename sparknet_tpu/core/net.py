@@ -384,11 +384,10 @@ def _data_layer_shapes(net: Net, layer: LayerParameter,
                 from ..data.store import ArrayStoreCursor
 
                 try:
-                    first, _ = ArrayStoreCursor(src).next()
-                    chw = tuple(first.shape)  # type: ignore[assignment]
+                    chw = ArrayStoreCursor(src).datum_shape  # type: ignore
                 except Exception:
-                    pass  # not an ArrayStore (e.g. a Caffe LMDB dir) or
-                    # empty — fall through to the data_shapes error below
+                    pass  # not an ArrayStore (e.g. a Caffe LMDB dir) —
+                    # fall through to the data_shapes error below
     elif ltype == "ImageData":
         ip = layer.image_data_param
         batch = int(ip.batch_size)
@@ -888,6 +887,71 @@ def build_hdf5_output(net: Net, layer: LayerParameter, bshapes):
 
 
 # ------------------------------------------------------------------- heads
+
+@register("Attention")
+def build_attention(net: Net, layer: LayerParameter, bshapes):
+    """Multi-head self-attention over a (N, S, E) bottom — this framework's
+    own extension layer (attention_param; see proto/caffe_pb.py
+    AttentionParameter).  Blobs, Caffe-style: fused QKV projection weight
+    (3E, E) [+ bias], output projection (E, E) [+ bias].  method
+    "blockwise" uses the O(S·block)-memory streaming core for long
+    sequences (ops/attention.py); sequence-parallel execution over a mesh
+    lives one level up in parallel/ring_attention.py."""
+    ap = layer.attention_param
+    n, s, e = bshapes[0]
+    heads = int(ap.num_heads)
+    if e % heads:
+        raise ValueError(f"embed dim {e} not divisible by num_heads {heads}")
+    causal = bool(ap.causal)
+    method = str(ap.method)
+    if method not in ("dense", "blockwise"):
+        raise ValueError(f"attention method {method!r}; expected "
+                         f"'dense' or 'blockwise'")
+    block = int(ap.block_size)
+    if method == "blockwise" and s % block:
+        raise ValueError(
+            f"sequence length {s} not divisible by block_size {block}")
+    bias = bool(ap.bias_term)
+    wf = ap.weight_filler
+    if not wf.msg.has("type"):
+        wf = _default_filler(type="xavier")
+    specs = [((3 * e, e), wf)]
+    if bias:
+        specs.append(((3 * e,), ap.bias_filler))
+    specs.append(((e, e), wf))
+    if bias:
+        specs.append(((e,), ap.bias_filler))
+    pinits = net._layer_params(layer, specs)
+
+    def fn(pvals, bvals, rng, train):
+        x = bvals[0]
+        if bias:
+            w_qkv, b_qkv, w_out, b_out = pvals
+        else:
+            w_qkv, w_out = pvals
+            b_qkv = b_out = None
+        qkv = jnp.einsum("nse,fe->nsf", x, w_qkv)
+        if b_qkv is not None:
+            qkv = qkv + b_qkv
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+
+        def to_heads(t):
+            return t.reshape(n, s, heads, e // heads).transpose(0, 2, 1, 3)
+
+        q, k, v = to_heads(q), to_heads(k), to_heads(v)
+        if method == "blockwise":
+            o = ops.blockwise_attention(q, k, v, block_size=block,
+                                        causal=causal)
+        else:
+            o = ops.attention(q, k, v, causal=causal)
+        o = o.transpose(0, 2, 1, 3).reshape(n, s, e)
+        y = jnp.einsum("nse,fe->nsf", o, w_out)
+        if b_out is not None:
+            y = y + b_out
+        return [y], {}
+
+    return _simple(net, layer, fn, [(n, s, e)], pinits)
+
 
 @register("Python")
 def build_python(net: Net, layer: LayerParameter, bshapes):

@@ -34,7 +34,10 @@ class ArrayStoreWriter:
     def put(self, image: np.ndarray, label: int) -> None:
         """(reference: write_to_db, ccaffe.cpp:65-73; auto-commits full
         transactions like CreateDB.scala's 1000-row batches)"""
-        self._images.append(np.asarray(image, dtype=np.uint8))
+        image = np.asarray(image, dtype=np.uint8)
+        if self._count == 0:
+            self._shape = list(image.shape)
+        self._images.append(image)
         self._labels.append(int(label))
         self._count += 1
         if len(self._labels) >= self.txn_size:
@@ -51,10 +54,15 @@ class ArrayStoreWriter:
         self._images, self._labels = [], []
 
     def close(self) -> None:
-        """(reference: close_db, ccaffe.cpp:79-81)"""
+        """(reference: close_db, ccaffe.cpp:79-81).  The first datum's
+        shape goes into the index so readers can learn it without
+        decompressing a shard (data_layer.cpp reshape-from-first-datum)."""
         self.commit()
+        meta = {"num_txns": self._n_txn, "count": self._count}
+        if getattr(self, "_shape", None) is not None:
+            meta["shape"] = self._shape
         with open(os.path.join(self.path, "index.json"), "w") as f:
-            json.dump({"num_txns": self._n_txn, "count": self._count}, f)
+            json.dump(meta, f)
 
 
 class ArrayStoreCursor:
@@ -73,6 +81,17 @@ class ArrayStoreCursor:
 
     def __len__(self) -> int:
         return int(self.meta["count"])
+
+    @property
+    def datum_shape(self) -> Optional[Tuple[int, ...]]:
+        """First record's shape, from the index when available (cheap) or
+        by reading one record (older stores without the index field)."""
+        if "shape" in self.meta:
+            return tuple(int(d) for d in self.meta["shape"])
+        if len(self) == 0:
+            return None
+        first, _ = ArrayStoreCursor(self.path).next()
+        return tuple(first.shape)
 
     def _load(self) -> dict:
         if self._cur is None:

@@ -353,6 +353,24 @@ class DummyDataParameter(View):
         return [FillerParameter(m) for m in self.msg.getlist("data_filler")]
 
 
+class AttentionParameter(View):
+    """Framework-extension layer param (this framework's own addition, the
+    way JavaDataParameter was SparkNet's — caffe.proto:991 precedent):
+    multi-head self-attention for sequence models.  method: "dense" or
+    "blockwise" (ops/attention.py); blockwise is the memory-linear path
+    long sequences need."""
+    DEFAULTS = dict(num_heads=1, causal=False, method="dense",
+                    block_size=128, bias_term=True)
+
+    @property
+    def weight_filler(self):
+        return FillerParameter(self.msg.get("weight_filler"))
+
+    @property
+    def bias_filler(self):
+        return FillerParameter(self.msg.get("bias_filler"))
+
+
 class PythonParameter(View):
     # caffe.proto:810-817 — module/layer name a user PythonLayer class,
     # param_str is free-form config handed to the instance before setup()
@@ -455,6 +473,7 @@ _PARAM_VIEWS = {
     "dummy_data_param": DummyDataParameter,
     "java_data_param": JavaDataParameter,
     "python_param": PythonParameter,
+    "attention_param": AttentionParameter,
 }
 
 

@@ -1,0 +1,103 @@
+"""The Attention layer type (framework extension; attention_param) — the
+sequence-model entry point of the layer zoo, wired to ops/attention.py."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from sparknet_tpu.core.net import Net
+from sparknet_tpu.proto import caffe_pb
+
+NET = """
+name: "attn"
+input: "data"
+input_shape { dim: 2 dim: 8 dim: 16 }
+layer { name: "attn1" type: "Attention" bottom: "data" top: "attn1"
+  attention_param { num_heads: 4 causal: true
+    weight_filler { type: "gaussian" std: 0.05 } } }
+"""
+
+
+def _build(extra=""):
+    txt = NET
+    if extra:
+        txt = txt.replace("causal: true", f"causal: true {extra}")
+    return Net(caffe_pb.parse_net_text(txt), "TRAIN")
+
+
+def test_build_and_shapes(rng):
+    net = _build()
+    assert net.blob_shapes["attn1"] == (2, 8, 16)
+    # fused QKV (3E,E)+bias, out (E,E)+bias
+    shapes = [net.param_inits[k].shape for k in net.param_keys]
+    assert shapes == [(48, 16), (48,), (16, 16), (16,)]
+    x = jnp.asarray(rng.randn(2, 8, 16).astype(np.float32))
+    y = net.forward(net.init_params(0), {"data": x})["attn1"]
+    assert y.shape == (2, 8, 16)
+    assert np.isfinite(np.asarray(y)).all()
+
+
+def test_causal_masking(rng):
+    """Output at position t must not change when future inputs change."""
+    net = _build()
+    params = net.init_params(0)
+    x = rng.randn(2, 8, 16).astype(np.float32)
+    x2 = x.copy()
+    x2[:, 5:] += 10.0  # perturb the future
+    y1 = np.asarray(net.forward(params, {"data": jnp.asarray(x)})["attn1"])
+    y2 = np.asarray(net.forward(params, {"data": jnp.asarray(x2)})["attn1"])
+    np.testing.assert_allclose(y1[:, :5], y2[:, :5], rtol=1e-5, atol=1e-6)
+    assert not np.allclose(y1[:, 5:], y2[:, 5:])
+
+
+def test_blockwise_matches_dense(rng):
+    dense = _build()
+    blockwise = _build('method: "blockwise" block_size: 4')
+    params = dense.init_params(0)
+    x = jnp.asarray(rng.randn(2, 8, 16).astype(np.float32))
+    yd = dense.forward(params, {"data": x})["attn1"]
+    yb = blockwise.forward(params, {"data": x})["attn1"]
+    np.testing.assert_allclose(np.asarray(yb), np.asarray(yd), rtol=1e-4,
+                               atol=1e-5)
+
+
+def test_grad_and_jit(rng):
+    net = _build()
+    params = net.init_params(0)
+    x = jnp.asarray(rng.randn(2, 8, 16).astype(np.float32))
+
+    def loss(p):
+        return jnp.sum(net.forward(p, {"data": x})["attn1"] ** 2)
+
+    g = jax.jit(jax.grad(loss))(params)
+    assert all(np.isfinite(np.asarray(v)).all() for v in g.values())
+    assert all(float(jnp.abs(v).sum()) > 0 for v in g.values())
+
+
+def test_head_divisibility_error():
+    txt = NET.replace("num_heads: 4", "num_heads: 3")
+    with pytest.raises(ValueError):
+        Net(caffe_pb.parse_net_text(txt), "TRAIN")
+
+
+def test_dsl_constructor(rng):
+    from sparknet_tpu.core.layers_dsl import attention_layer
+    from sparknet_tpu.proto.caffe_pb import LayerParameter
+
+    msg = attention_layer("a1", "data", num_heads=2, causal=True,
+                          method="blockwise", block_size=4)
+    lp = LayerParameter(msg)
+    assert str(lp.type) == "Attention"
+    assert int(lp.attention_param.num_heads) == 2
+    assert bool(lp.attention_param.causal)
+    assert str(lp.attention_param.method) == "blockwise"
+
+
+def test_no_bias_variant(rng):
+    net = _build("bias_term: false")
+    assert [net.param_inits[k].shape for k in net.param_keys] == [
+        (48, 16), (16, 16)]
+    x = jnp.asarray(rng.randn(2, 8, 16).astype(np.float32))
+    y = net.forward(net.init_params(0), {"data": x})["attn1"]
+    assert np.isfinite(np.asarray(y)).all()

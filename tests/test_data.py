@@ -200,3 +200,25 @@ def test_decode_and_resize_corrupt():
     Image.fromarray(np.zeros((5, 7, 3), np.uint8)).save(buf, format="PNG")
     out = decode_and_resize(buf.getvalue(), 8, 9)
     assert out.shape == (3, 8, 9)
+
+
+def test_store_datum_shape_index_and_legacy(tmp_path):
+    """datum_shape comes from index.json when present (no shard
+    decompression) and falls back to reading a record for older stores."""
+    import json
+    import os
+
+    from sparknet_tpu.data.store import ArrayStoreCursor, ArrayStoreWriter
+
+    path = str(tmp_path / "store")
+    w = ArrayStoreWriter(path)
+    for i in range(3):
+        w.put(np.zeros((3, 9, 7), np.uint8), i)
+    w.close()
+    assert ArrayStoreCursor(path).datum_shape == (3, 9, 7)
+    # legacy index without the shape field
+    idx = os.path.join(path, "index.json")
+    meta = json.load(open(idx))
+    del meta["shape"]
+    json.dump(meta, open(idx, "w"))
+    assert ArrayStoreCursor(path).datum_shape == (3, 9, 7)
