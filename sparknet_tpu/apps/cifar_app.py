@@ -73,7 +73,8 @@ def load_data(args) -> Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray,
 
 def build_solver(model: str, n_workers: int, tau: int, mesh=None,
                  proto_dir: str = REFERENCE_PROTO_DIR,
-                 batch_size: int = TRAIN_BATCH_SIZE) -> DistributedSolver:
+                 batch_size: int = TRAIN_BATCH_SIZE,
+                 dcn_interval: int = 1) -> DistributedSolver:
     """ProtoLoader flow (CifarApp.scala:81-89): net prototxt ->
     replaceDataLayers -> solver-with-inline-net -> instantiate."""
     net = caffe_pb.load_net_prototxt(
@@ -82,7 +83,8 @@ def build_solver(model: str, n_workers: int, tau: int, mesh=None,
                                        CHANNELS, HEIGHT, WIDTH)
     sp = caffe_pb.load_solver_prototxt_with_net(
         os.path.join(proto_dir, f"cifar10_{model}_solver.prototxt"), net)
-    return DistributedSolver(sp, n_workers=n_workers, tau=tau, mesh=mesh)
+    return DistributedSolver(sp, n_workers=n_workers, tau=tau, mesh=mesh,
+                             dcn_interval=dcn_interval)
 
 
 class WorkerFeed:
@@ -91,20 +93,29 @@ class WorkerFeed:
 
     def __init__(self, images, labels, mean, batch_size, tau, seed):
         self.batches = part.make_minibatches(images, labels, batch_size)
+        if not self.batches:
+            raise ValueError(
+                f"worker shard of {len(labels)} examples yields no full "
+                f"batch of {batch_size}; decrease batch_size or workers")
         self.mean = mean
         self.tau = tau
         self.rng = np.random.RandomState(seed)
         self.sampler: Optional[MinibatchSampler] = None
         self._served = 0
+        self._window = 0
 
     def new_round(self):
+        # a shard can hold fewer batches than τ (tiny/synthetic datasets on
+        # many workers): the window clamps to the shard and __call__ opens a
+        # fresh window when it runs dry mid-round
+        self._window = min(self.tau, len(self.batches))
         self.sampler = MinibatchSampler(
-            iter(self.batches), len(self.batches), self.tau,
+            iter(self.batches), len(self.batches), self._window,
             seed=int(self.rng.randint(0, 2 ** 31)))
         self._served = 0
 
     def __call__(self):
-        if self.sampler is None or self._served >= self.tau:
+        if self.sampler is None or self._served >= self._window:
             self.new_round()
         self._served += 1
         b = self.sampler.next_batch()
@@ -117,7 +128,7 @@ def run(num_workers: int, *, model: str = "quick", rounds: int = 100,
         log_path: Optional[str] = None, mesh=None,
         target_accuracy: Optional[float] = None,
         batch_size: int = TRAIN_BATCH_SIZE, tau: int = SYNC_INTERVAL,
-        ) -> float:
+        dcn_interval: int = 1) -> float:
     args = argparse.Namespace(data=data_dir, synthetic=synthetic)
     log = PhaseLogger(log_path or
                       f"/tmp/training_log_{int(time.time())}.txt")
@@ -127,7 +138,7 @@ def run(num_workers: int, *, model: str = "quick", rounds: int = 100,
     log("loaded data")
     shards = part.partition(xtr, ytr, num_workers)
     solver = build_solver(model, num_workers, tau, mesh=mesh,
-                          batch_size=batch_size)
+                          batch_size=batch_size, dcn_interval=dcn_interval)
     log("built solver")
 
     feeds = [WorkerFeed(x, y, mean, batch_size, tau, seed=w)
@@ -172,9 +183,16 @@ def main() -> None:
     p.add_argument("--model", default="quick", choices=["quick", "full"])
     p.add_argument("--rounds", type=int, default=100)
     p.add_argument("--synthetic", action="store_true")
+    from .common import add_distributed_args, mesh_from_args
+
+    add_distributed_args(p)
+    p.add_argument("--batch", type=int, default=TRAIN_BATCH_SIZE)
+    p.add_argument("--tau", type=int, default=SYNC_INTERVAL)
     a = p.parse_args()
+    mesh = mesh_from_args(a)
     run(a.num_workers, model=a.model, rounds=a.rounds, data_dir=a.data,
-        synthetic=a.synthetic)
+        synthetic=a.synthetic, mesh=mesh, dcn_interval=a.dcn_interval,
+        batch_size=a.batch, tau=a.tau)
 
 
 if __name__ == "__main__":

@@ -1,0 +1,40 @@
+"""Shared distributed-flags plumbing for the app entry points.
+
+One place for the --multihost/--slices/--dcn-interval surface so every app
+validates the same way (the reference apps share their driver loop shape the
+same way, CifarApp.scala vs ImageNetApp.scala).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+
+def add_distributed_args(p) -> None:
+    p.add_argument("--multihost", action="store_true",
+                   help="jax.distributed bring-up (call on every TPU-VM "
+                        "worker; auto-detects on Cloud TPU)")
+    p.add_argument("--slices", type=int, default=1,
+                   help=">1 uses a (dcn, workers) hierarchical mesh")
+    p.add_argument("--dcn-interval", type=int, default=1,
+                   help="cross-slice average every k-th round")
+
+
+def mesh_from_args(a) -> Optional[object]:
+    """Validate the flag combination and build the mesh (None = flat
+    default).  Fail fast at parse time, not deep inside the solver."""
+    if a.dcn_interval != 1 and a.slices <= 1:
+        raise SystemExit("--dcn-interval needs --slices > 1")
+    if a.multihost:
+        from ..parallel.mesh import init_distributed
+
+        init_distributed()
+    if a.slices > 1:
+        if a.num_workers % a.slices:
+            raise SystemExit(
+                f"num_workers ({a.num_workers}) must be divisible by "
+                f"--slices ({a.slices})")
+        from ..parallel.mesh import make_hierarchical_mesh
+
+        return make_hierarchical_mesh(a.slices, a.num_workers // a.slices)
+    return None

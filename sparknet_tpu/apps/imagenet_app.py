@@ -42,14 +42,15 @@ MODEL_PROTO = {
 
 def build_solver(model: str, n_workers: int, tau: int, batch_size: int,
                  test_batch: int, mesh=None, crop: int = CROPPED,
-                 ) -> DistributedSolver:
+                 dcn_interval: int = 1) -> DistributedSolver:
     d = MODEL_PROTO[model]
     net = caffe_pb.load_net_prototxt(os.path.join(d, "train_val.prototxt"))
     net = caffe_pb.replace_data_layers(net, batch_size, test_batch, 3, crop,
                                        crop)
     sp = caffe_pb.load_solver_prototxt_with_net(
         os.path.join(d, "solver.prototxt"), net)
-    return DistributedSolver(sp, n_workers=n_workers, tau=tau, mesh=mesh)
+    return DistributedSolver(sp, n_workers=n_workers, tau=tau, mesh=mesh,
+                             dcn_interval=dcn_interval)
 
 
 class ShardFeed:
@@ -101,12 +102,12 @@ def run(num_workers: int, *, shards_dir: str = "", label_file: str = "",
         batch_size: int = TRAIN_BATCH_SIZE, tau: int = SYNC_INTERVAL,
         test_batch: int = TEST_BATCH_SIZE, mesh=None,
         log_path: Optional[str] = None, crop: int = CROPPED,
-        test_every: int = 10) -> float:
+        test_every: int = 10, dcn_interval: int = 1) -> float:
     log = PhaseLogger(log_path or
                       f"/tmp/training_log_{int(time.time())}.txt")
     log(f"workers = {num_workers}, model = {model}, tau = {tau}")
     solver = build_solver(model, num_workers, tau, batch_size, test_batch,
-                          mesh=mesh, crop=crop)
+                          mesh=mesh, crop=crop, dcn_interval=dcn_interval)
     log("built solver")
 
     if synthetic or not shards_dir:
@@ -161,9 +162,16 @@ def main() -> None:
     p.add_argument("--model", default="alexnet", choices=list(MODEL_PROTO))
     p.add_argument("--rounds", type=int, default=100)
     p.add_argument("--synthetic", action="store_true")
+    from .common import add_distributed_args, mesh_from_args
+
+    add_distributed_args(p)
+    p.add_argument("--batch", type=int, default=TRAIN_BATCH_SIZE)
+    p.add_argument("--tau", type=int, default=SYNC_INTERVAL)
     a = p.parse_args()
+    mesh = mesh_from_args(a)
     run(a.num_workers, shards_dir=a.shards, label_file=a.labels,
-        model=a.model, rounds=a.rounds, synthetic=a.synthetic)
+        model=a.model, rounds=a.rounds, synthetic=a.synthetic, mesh=mesh,
+        dcn_interval=a.dcn_interval, batch_size=a.batch, tau=a.tau)
 
 
 if __name__ == "__main__":

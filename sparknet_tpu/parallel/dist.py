@@ -226,23 +226,44 @@ class DistributedSolver:
         self._num_test_batches = num_batches
 
     # ------------------------------------------------------------------- run
+    def local_worker_ids(self) -> List[int]:
+        """Worker rows whose device belongs to this process.  Single
+        process: all of them.  Multi-host: only this host's slice — each
+        process feeds (and decodes) its own workers' data, not the whole
+        fleet's (the reference's per-executor zipPartitions locality,
+        CifarApp.scala:120-130)."""
+        if jax.process_count() == 1:
+            return list(range(self.n_workers))
+        flat = list(np.asarray(self.mesh.devices).reshape(-1))
+        pid = jax.process_index()
+        return [w for w in range(self.n_workers)
+                if flat[w].process_index == pid]
+
+    def _put_worker_major(self, arr: np.ndarray):
+        """Shard a worker-major host array onto the mesh.  Multi-host: the
+        caller provides only the local workers' rows."""
+        if jax.process_count() == 1:
+            return jax.device_put(jnp.asarray(arr), self._wsh)
+        return jax.make_array_from_process_local_data(self._wsh, arr)
+
     def run_round(self) -> float:
         """One outer round: τ local steps per worker + weight average
         (reference: one iteration of the while(true) driver loop,
         CifarApp.scala:95-136).  Returns mean loss over the round."""
         assert self.train_sources is not None, "set_train_data first"
+        local = self.local_worker_ids()
         per_worker = []
-        for src in self.train_sources:
+        for w in local:
+            src = self.train_sources[w]
             pulls = [src() for _ in range(self.tau)]
             per_worker.append({k: np.stack([p[k] for p in pulls])
                                for k in pulls[0]})
-        stacked = {k: np.stack([w[k] for w in per_worker])
+        stacked = {k: np.stack([pw[k] for pw in per_worker])
                    for k in per_worker[0]}
-        batches = {k: jax.device_put(jnp.asarray(v), self._wsh)
-                   for k, v in stacked.items()}
-        rngs = jax.device_put(
-            jax.random.split(jax.random.fold_in(self._rng, self.round),
-                             self.n_workers), self._wsh)
+        batches = {k: self._put_worker_major(v) for k, v in stacked.items()}
+        all_rngs = np.asarray(jax.random.split(
+            jax.random.fold_in(self._rng, self.round), self.n_workers))
+        rngs = self._put_worker_major(all_rngs[np.asarray(local)])
         avg_dcn = (not self.has_dcn
                    or self.round % self.dcn_interval == self.dcn_interval - 1)
         self.params_w, self.state_w, loss = self._round_fn(avg_dcn)(

@@ -37,3 +37,18 @@ def test_graft_dryrun_multichip():
     import __graft_entry__ as g
 
     g.dryrun_multichip(8)
+
+
+def test_worker_feed_shard_shorter_than_tau():
+    """A shard with fewer batches than τ clamps the window and reopens it
+    mid-round instead of crashing (tiny/synthetic data on many workers)."""
+    from sparknet_tpu.apps.cifar_app import WorkerFeed
+
+    rng = np.random.RandomState(0)
+    imgs = rng.randint(0, 255, (12, 3, 32, 32)).astype(np.uint8)
+    labels = rng.randint(0, 10, (12,)).astype(np.int32)
+    mean = np.zeros((3, 32, 32), np.float32)
+    feed = WorkerFeed(imgs, labels, mean, batch_size=4, tau=10, seed=0)
+    feed.new_round()
+    pulls = [feed() for _ in range(10)]  # 3 batches available, 10 pulls
+    assert all(p["data"].shape == (4, 3, 32, 32) for p in pulls)

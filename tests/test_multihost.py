@@ -203,3 +203,14 @@ layer { name: "loss"''').replace(
 def test_dcn_interval_requires_dcn_mesh():
     with pytest.raises(AssertionError):
         DistributedSolver(_solver(), mesh=make_mesh(8), dcn_interval=2)
+
+
+def test_cifar_app_hierarchical_mesh(tmp_path):
+    """The app drives a (dcn, workers) mesh + dcn_interval end to end."""
+    from sparknet_tpu.apps import cifar_app
+
+    acc = cifar_app.run(8, model="quick", rounds=2, synthetic=True,
+                        mesh=make_hierarchical_mesh(2, 4), dcn_interval=2,
+                        batch_size=16, tau=2,
+                        log_path=str(tmp_path / "log.txt"))
+    assert 0.0 <= acc <= 1.0
