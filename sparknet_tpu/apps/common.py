@@ -10,7 +10,8 @@ from __future__ import annotations
 from typing import Optional
 
 
-def add_distributed_args(p) -> None:
+def add_distributed_args(p, *, batch_default: int,
+                         tau_default: int) -> None:
     p.add_argument("--multihost", action="store_true",
                    help="jax.distributed bring-up (call on every TPU-VM "
                         "worker; auto-detects on Cloud TPU)")
@@ -18,6 +19,9 @@ def add_distributed_args(p) -> None:
                    help=">1 uses a (dcn, workers) hierarchical mesh")
     p.add_argument("--dcn-interval", type=int, default=1,
                    help="cross-slice average every k-th round")
+    p.add_argument("--batch", type=int, default=batch_default)
+    p.add_argument("--tau", type=int, default=tau_default,
+                   help="local SGD steps between weight averages")
 
 
 def mesh_from_args(a) -> Optional[object]:
@@ -26,9 +30,15 @@ def mesh_from_args(a) -> Optional[object]:
     if a.dcn_interval != 1 and a.slices <= 1:
         raise SystemExit("--dcn-interval needs --slices > 1")
     if a.multihost:
+        import jax
+
         from ..parallel.mesh import init_distributed
 
         init_distributed()
+        if a.num_workers < jax.process_count():
+            raise SystemExit(
+                f"num_workers ({a.num_workers}) must cover every host "
+                f"({jax.process_count()} processes need >= 1 worker each)")
     if a.slices > 1:
         if a.num_workers % a.slices:
             raise SystemExit(

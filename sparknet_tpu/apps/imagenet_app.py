@@ -164,9 +164,8 @@ def main() -> None:
     p.add_argument("--synthetic", action="store_true")
     from .common import add_distributed_args, mesh_from_args
 
-    add_distributed_args(p)
-    p.add_argument("--batch", type=int, default=TRAIN_BATCH_SIZE)
-    p.add_argument("--tau", type=int, default=SYNC_INTERVAL)
+    add_distributed_args(p, batch_default=TRAIN_BATCH_SIZE,
+                         tau_default=SYNC_INTERVAL)
     a = p.parse_args()
     mesh = mesh_from_args(a)
     run(a.num_workers, shards_dir=a.shards, label_file=a.labels,

@@ -234,10 +234,12 @@ class DistributedSolver:
         CifarApp.scala:120-130)."""
         if jax.process_count() == 1:
             return list(range(self.n_workers))
-        flat = list(np.asarray(self.mesh.devices).reshape(-1))
+        # leading-dim shard w owns the w-th row of the device grid (the
+        # trailing model axis, if any, replicates within the row)
+        rows = np.asarray(self.mesh.devices).reshape(self.n_workers, -1)
         pid = jax.process_index()
         return [w for w in range(self.n_workers)
-                if flat[w].process_index == pid]
+                if any(d.process_index == pid for d in rows[w])]
 
     def _put_worker_major(self, arr: np.ndarray):
         """Shard a worker-major host array onto the mesh.  Multi-host: the
@@ -252,6 +254,12 @@ class DistributedSolver:
         CifarApp.scala:95-136).  Returns mean loss over the round."""
         assert self.train_sources is not None, "set_train_data first"
         local = self.local_worker_ids()
+        if not local:
+            raise RuntimeError(
+                f"process {jax.process_index()} owns no worker rows: "
+                f"n_workers={self.n_workers} does not cover every host — "
+                f"use at least one worker per host "
+                f"({jax.process_count()} processes)")
         per_worker = []
         for w in local:
             src = self.train_sources[w]

@@ -52,3 +52,21 @@ def test_worker_feed_shard_shorter_than_tau():
     feed.new_round()
     pulls = [feed() for _ in range(10)]  # 3 batches available, 10 pulls
     assert all(p["data"].shape == (4, 3, 32, 32) for p in pulls)
+
+
+def test_random_init_accuracy_is_chance():
+    """Statistical smoke test at random init: accuracy within 0.7x-1.3x of
+    chance (the reference's CifarSpec band, CifarSpec.scala:92 asserts
+    70 <= score*1000 <= 130 for 10 classes)."""
+    from sparknet_tpu.apps.cifar_app import build_solver
+
+    solver = build_solver("quick", n_workers=1, tau=1, batch_size=50)
+    rng = np.random.RandomState(0)
+
+    def src():
+        return {"data": rng.rand(50, 3, 32, 32).astype(np.float32),
+                "label": rng.randint(0, 10, (50,)).astype(np.int32)}
+
+    solver.set_test_data(src, 20)
+    acc = solver.test()["accuracy"]
+    assert 0.07 <= acc <= 0.13, acc

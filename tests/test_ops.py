@@ -360,3 +360,36 @@ def test_spp(rng):
     y = ops.spp(jnp.asarray(x), 3)
     # 3*(1 + 4 + 16) = 63
     assert y.shape == (2, 63)
+
+
+# --- systematic elementwise gradient sweep ---------------------------------
+# (the GradientChecker-everywhere discipline of the reference test suite,
+# test_gradient_check_util.hpp — every smooth op checked against numerical
+# differentiation; kinked ops checked away from their kinks)
+
+ELEMENTWISE_GRAD_CASES = [
+    ("sigmoid", lambda x: ops.sigmoid(x), None),
+    ("tanh", lambda x: ops.tanh(x), None),
+    ("bnll", lambda x: ops.bnll(x), None),
+    ("power", lambda x: ops.power(x, 2.0, 0.5, 2.0), None),
+    ("exp", lambda x: ops.exp(x, -1.0, 0.5, 0.1), None),
+    ("log", lambda x: ops.log(x, -1.0, 1.0, 3.0), "positive"),
+    ("absval", lambda x: ops.absval(x), "away_from_zero"),
+    ("relu_kink", lambda x: ops.relu(x), "away_from_zero"),
+    ("leaky_relu", lambda x: ops.relu(x, 0.1), "away_from_zero"),
+    ("mvn", lambda x: ops.mvn(x), None),
+    ("mvn_across", lambda x: ops.mvn(x, across_channels=True), None),
+    ("softmax", lambda x: ops.softmax(x), None),
+]
+
+
+@pytest.mark.parametrize("name,f,domain",
+                         ELEMENTWISE_GRAD_CASES,
+                         ids=[c[0] for c in ELEMENTWISE_GRAD_CASES])
+def test_elementwise_grad_sweep(rng, name, f, domain):
+    x = rng.randn(2, 3, 4, 5).astype(np.float32)
+    if domain == "positive":
+        x = np.abs(x) + 0.5
+    elif domain == "away_from_zero":
+        x = np.where(np.abs(x) < 0.1, x + 0.3, x)  # keep off the kink
+    check_grad(f, x)
