@@ -26,6 +26,10 @@ def main() -> None:
     import jax
     import jax.numpy as jnp
 
+    from sparknet_tpu.utils.compile_cache import maybe_enable_compile_cache
+
+    maybe_enable_compile_cache()
+
     from sparknet_tpu.core.net import Net
     from sparknet_tpu.proto import caffe_pb
     from sparknet_tpu.solver.solver import make_single_step

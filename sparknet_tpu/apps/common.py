@@ -35,10 +35,15 @@ def mesh_from_args(a) -> Optional[object]:
         from ..parallel.mesh import init_distributed
 
         init_distributed()
-        if a.num_workers < jax.process_count():
+        # a flat mesh consumes devices in order, so the last host owns a
+        # worker only if the count reaches into its device block
+        min_workers = ((jax.process_count() - 1)
+                       * jax.local_device_count() + 1)
+        if a.slices <= 1 and a.num_workers < min_workers:
             raise SystemExit(
-                f"num_workers ({a.num_workers}) must cover every host "
-                f"({jax.process_count()} processes need >= 1 worker each)")
+                f"num_workers ({a.num_workers}) leaves some of the "
+                f"{jax.process_count()} hosts with no worker; need >= "
+                f"{min_workers} (or use --slices for a hierarchical mesh)")
     if a.slices > 1:
         if a.num_workers % a.slices:
             raise SystemExit(

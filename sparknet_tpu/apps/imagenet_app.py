@@ -162,8 +162,10 @@ def main() -> None:
     p.add_argument("--model", default="alexnet", choices=list(MODEL_PROTO))
     p.add_argument("--rounds", type=int, default=100)
     p.add_argument("--synthetic", action="store_true")
+    from ..utils.compile_cache import maybe_enable_compile_cache
     from .common import add_distributed_args, mesh_from_args
 
+    maybe_enable_compile_cache()
     add_distributed_args(p, batch_default=TRAIN_BATCH_SIZE,
                          tau_default=SYNC_INTERVAL)
     a = p.parse_args()

@@ -282,6 +282,9 @@ def cmd_device_query(args) -> int:
 
 
 def main(argv=None) -> int:
+    from .utils.compile_cache import maybe_enable_compile_cache
+
+    maybe_enable_compile_cache()
     p = argparse.ArgumentParser(prog="sparknet_tpu", description=__doc__)
     sub = p.add_subparsers(dest="verb", required=True)
 

@@ -1,0 +1,26 @@
+"""Persistent XLA compilation cache (opt-in via SPARKNET_COMPILE_CACHE).
+
+First compiles on TPU run 20-40s per program; the reference has no
+analogue (Caffe doesn't compile), but for a jit-compiled framework warm
+starts matter: with the cache directory set, repeat CLI invocations and
+restarted training jobs reuse compiled executables across processes.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def maybe_enable_compile_cache() -> bool:
+    """Enable jax's persistent compilation cache if SPARKNET_COMPILE_CACHE
+    names a directory.  Returns whether it was enabled.  Safe to call
+    multiple times and before/after backend init."""
+    cache_dir = os.environ.get("SPARKNET_COMPILE_CACHE")
+    if not cache_dir:
+        return False
+    import jax
+
+    jax.config.update("jax_compilation_cache_dir", cache_dir)
+    # threshold 0: CLI verbs build many small programs, cache all of them
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    return True

@@ -91,3 +91,24 @@ def test_create_labelfile(tmp_path):
     assert out.read_text() == "a_1.jpeg 3\nb_2.JPEG 7\n"
     with pytest.raises(KeyError):
         create_labelfile(str(d), str(master), str(out), strict=True)
+
+
+def test_compile_cache_env(tmp_path, monkeypatch):
+    """SPARKNET_COMPILE_CACHE wires the persistent jax compilation cache."""
+    import jax
+
+    from sparknet_tpu.utils.compile_cache import maybe_enable_compile_cache
+
+    prev_dir = jax.config.jax_compilation_cache_dir
+    prev_min = jax.config.jax_persistent_cache_min_compile_time_secs
+    try:
+        monkeypatch.delenv("SPARKNET_COMPILE_CACHE", raising=False)
+        assert maybe_enable_compile_cache() is False
+        d = str(tmp_path / "cache")
+        monkeypatch.setenv("SPARKNET_COMPILE_CACHE", d)
+        assert maybe_enable_compile_cache() is True
+        assert jax.config.jax_compilation_cache_dir == d
+    finally:
+        jax.config.update("jax_compilation_cache_dir", prev_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                          prev_min)
