@@ -25,7 +25,6 @@ from ..data import partition as part
 from ..data.cifar import CifarLoader
 from ..data.sampler import MinibatchSampler
 from ..parallel.dist import DistributedSolver
-from ..parallel.mesh import make_mesh
 from ..proto import caffe_pb
 from ..utils.logging import PhaseLogger
 

@@ -33,7 +33,6 @@ from typing import Tuple
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
-from jax.experimental.pallas import tpu as pltpu
 
 from .lrn import _powm  # sqrt/rsqrt fast paths for the models' beta values
 

@@ -20,9 +20,8 @@ broadcast/collect machinery of the reference collapses into one collective.
 
 from __future__ import annotations
 
-import functools
 import os
-from typing import Any, Callable, Dict, List, Optional, Tuple
+from typing import Any, Dict, List, Optional
 
 import jax
 import jax.numpy as jnp

@@ -2,16 +2,24 @@
 
 Mirrors the survey's test strategy (SURVEY.md §4.1): multi-device behavior is
 exercised on host-platform fake devices so the τ-averaging collectives are
-tested without TPU hardware.
+tested without TPU hardware.  Set SPARKNET_TEST_PLATFORM=tpu to run the
+suite on real hardware instead (multi-device tests then need enough chips —
+on a single chip run the single-device modules, e.g.
+`SPARKNET_TEST_PLATFORM=tpu pytest tests/test_ops.py tests/test_net.py`).
+Impractical over a remote-compile tunnel (each jit pays seconds of
+round-trip); intended for real TPU-VM hosts with local compilation.
 """
 
 import os
 
-os.environ["JAX_PLATFORMS"] = "cpu"
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8").strip()
+_PLATFORM = os.environ.get("SPARKNET_TEST_PLATFORM", "cpu")
+
+if _PLATFORM == "cpu":
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8").strip()
 os.environ.setdefault("JAX_ENABLE_X64", "0")
 
 # The machine's sitecustomize pre-imports jax and registers the TPU platform
@@ -19,7 +27,12 @@ os.environ.setdefault("JAX_ENABLE_X64", "0")
 # the live config as well (safe: the CPU backend is not yet initialized).
 import jax
 
-jax.config.update("jax_platforms", "cpu")
+if _PLATFORM == "cpu":
+    jax.config.update("jax_platforms", "cpu")
+else:
+    # the MXU computes f32 matmuls/convs in bf16 by default; the suite
+    # checks math (incl. numerical gradients), so pin full precision
+    jax.config.update("jax_default_matmul_precision", "highest")
 
 import sys
 
