@@ -127,7 +127,8 @@ def run(num_workers: int, *, model: str = "quick", rounds: int = 100,
         log_path: Optional[str] = None, mesh=None,
         target_accuracy: Optional[float] = None,
         batch_size: int = TRAIN_BATCH_SIZE, tau: int = SYNC_INTERVAL,
-        dcn_interval: int = 1) -> float:
+        dcn_interval: int = 1, snapshot_every_rounds: int = 0,
+        snapshot_prefix: str = "", resume: str = "") -> float:
     args = argparse.Namespace(data=data_dir, synthetic=synthetic)
     log = PhaseLogger(log_path or
                       f"/tmp/training_log_{int(time.time())}.txt")
@@ -154,8 +155,16 @@ def run(num_workers: int, *, model: str = "quick", rounds: int = 100,
 
     solver.set_test_data(test_source, num_test)
 
+    from .common import (check_snapshot_args, maybe_snapshot_round,
+                         resume_and_replay)
+    check_snapshot_args(snapshot_every_rounds, snapshot_prefix)
+    start_round = 0
+    if resume:
+        start_round = resume_and_replay(solver, resume, feeds, log,
+                                        per_round=lambda f: f.new_round())
+
     accuracy = 0.0
-    for r in range(rounds):
+    for r in range(start_round, rounds):
         for f in feeds:
             f.new_round()
         if r % TEST_EVERY_ROUNDS == 0:
@@ -169,6 +178,8 @@ def run(num_workers: int, *, model: str = "quick", rounds: int = 100,
         log("starting training", i=r)
         loss = solver.run_round()
         log(f"round loss = {loss}", i=r)
+        maybe_snapshot_round(solver, log, r, snapshot_every_rounds,
+                             snapshot_prefix)
     scores = solver.test()
     accuracy = scores.get("accuracy", scores.get("acc", 0.0))
     log(f"final %-age of test set correct: {accuracy}")
@@ -182,17 +193,23 @@ def main() -> None:
     p.add_argument("--model", default="quick", choices=["quick", "full"])
     p.add_argument("--rounds", type=int, default=100)
     p.add_argument("--synthetic", action="store_true")
-    from ..utils.compile_cache import maybe_enable_compile_cache
-    from .common import add_distributed_args, mesh_from_args
+    from ..utils.compile_cache import (apply_platform_env,
+                                      maybe_enable_compile_cache)
+    from .common import (add_distributed_args, add_snapshot_args,
+                         mesh_from_args)
 
+    apply_platform_env()
     maybe_enable_compile_cache()
     add_distributed_args(p, batch_default=TRAIN_BATCH_SIZE,
                          tau_default=SYNC_INTERVAL)
+    add_snapshot_args(p)
     a = p.parse_args()
     mesh = mesh_from_args(a)
     run(a.num_workers, model=a.model, rounds=a.rounds, data_dir=a.data,
         synthetic=a.synthetic, mesh=mesh, dcn_interval=a.dcn_interval,
-        batch_size=a.batch, tau=a.tau)
+        batch_size=a.batch, tau=a.tau,
+        snapshot_every_rounds=a.snapshot_every_rounds,
+        snapshot_prefix=a.snapshot_prefix, resume=a.resume)
 
 
 if __name__ == "__main__":

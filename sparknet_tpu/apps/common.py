@@ -24,6 +24,62 @@ def add_distributed_args(p, *, batch_default: int,
                    help="local SGD steps between weight averages")
 
 
+def add_snapshot_args(p) -> None:
+    """App-level periodic checkpointing of the averaged weights + per-worker
+    solver state (SURVEY.md §5.4 — realizing the reference's dead
+    driver-checkpoint code, CifarDBApp.scala:144-149)."""
+    p.add_argument("--snapshot-every-rounds", type=int, default=0,
+                   help="write a snapshot every N averaging rounds")
+    p.add_argument("--snapshot-prefix", default="",
+                   help="snapshot path prefix (files: "
+                        "<prefix>_iter_<N>.npz)")
+    p.add_argument("--resume", default="",
+                   help="snapshot file to resume from")
+
+
+def check_snapshot_args(every: int, prefix: str) -> None:
+    """Fail fast on a half-configured snapshot request instead of silently
+    writing nothing for the whole run."""
+    if every and not prefix:
+        raise SystemExit(
+            "--snapshot-every-rounds needs --snapshot-prefix")
+
+
+def maybe_snapshot_round(solver, log, r: int, every: int,
+                         prefix: str) -> Optional[str]:
+    """Post-round hook: snapshot after rounds every, 2*every, ...  Returns
+    the written path (averaged weights + full per-worker momentum, so a
+    kill-and-resume run reproduces the uninterrupted one exactly)."""
+    if every and prefix and (r + 1) % every == 0:
+        path = solver.snapshot(f"{prefix}_iter_{solver.iter}")
+        log(f"snapshot -> {path}", i=r)
+        return path
+    return None
+
+
+def resume_and_replay(solver, resume_path: str, feeds, log,
+                      per_round=None) -> int:
+    """Restore the solver, then replay each feed's data stream through the
+    already-consumed rounds so RNG/iterator state matches the uninterrupted
+    run (the reference relies on Spark re-running partitions
+    deterministically for the same effect).  `per_round(feed)` runs any
+    per-round feed reset the app's loop would have done (e.g.
+    WorkerFeed.new_round).  Returns the round to continue from."""
+    solver.restore(resume_path)
+    start = solver.round
+    # round-major, matching run_round's consumption order exactly — feeds
+    # may share host state (e.g. the ImageNet apps share one stateful
+    # DataTransformer RNG across workers), so replay order matters
+    for _ in range(start):
+        for f in feeds:
+            if per_round is not None:
+                per_round(f)
+            for _ in range(solver.tau):
+                f()
+    log(f"resumed from {resume_path} at round {start} (iter {solver.iter})")
+    return start
+
+
 def mesh_from_args(a) -> Optional[object]:
     """Validate the flag combination and build the mesh (None = flat
     default).  Fail fast at parse time, not deep inside the solver."""

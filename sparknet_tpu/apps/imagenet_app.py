@@ -102,7 +102,9 @@ def run(num_workers: int, *, shards_dir: str = "", label_file: str = "",
         batch_size: int = TRAIN_BATCH_SIZE, tau: int = SYNC_INTERVAL,
         test_batch: int = TEST_BATCH_SIZE, mesh=None,
         log_path: Optional[str] = None, crop: int = CROPPED,
-        test_every: int = 10, dcn_interval: int = 1) -> float:
+        test_every: int = 10, dcn_interval: int = 1,
+        snapshot_every_rounds: int = 0, snapshot_prefix: str = "",
+        resume: str = "") -> float:
     log = PhaseLogger(log_path or
                       f"/tmp/training_log_{int(time.time())}.txt")
     log(f"workers = {num_workers}, model = {model}, tau = {tau}")
@@ -139,8 +141,15 @@ def run(num_workers: int, *, shards_dir: str = "", label_file: str = "",
     solver.set_train_data(feeds)
     solver.set_test_data(test_source, num_test)
 
+    from .common import (check_snapshot_args, maybe_snapshot_round,
+                         resume_and_replay)
+    check_snapshot_args(snapshot_every_rounds, snapshot_prefix)
+    start_round = 0
+    if resume:
+        start_round = resume_and_replay(solver, resume, feeds, log)
+
     accuracy = 0.0
-    for r in range(rounds):
+    for r in range(start_round, rounds):
         if r % test_every == 0:
             scores = solver.test()
             accuracy = scores.get("accuracy", 0.0)
@@ -148,6 +157,8 @@ def run(num_workers: int, *, shards_dir: str = "", label_file: str = "",
         log("starting training", i=r)
         loss = solver.run_round()
         log(f"round loss = {loss}", i=r)
+        maybe_snapshot_round(solver, log, r, snapshot_every_rounds,
+                             snapshot_prefix)
     scores = solver.test()
     accuracy = scores.get("accuracy", 0.0)
     log(f"final %-age of test set correct: {accuracy}")
@@ -162,17 +173,23 @@ def main() -> None:
     p.add_argument("--model", default="alexnet", choices=list(MODEL_PROTO))
     p.add_argument("--rounds", type=int, default=100)
     p.add_argument("--synthetic", action="store_true")
-    from ..utils.compile_cache import maybe_enable_compile_cache
-    from .common import add_distributed_args, mesh_from_args
+    from ..utils.compile_cache import (apply_platform_env,
+                                      maybe_enable_compile_cache)
+    from .common import (add_distributed_args, add_snapshot_args,
+                         mesh_from_args)
 
+    apply_platform_env()
     maybe_enable_compile_cache()
     add_distributed_args(p, batch_default=TRAIN_BATCH_SIZE,
                          tau_default=SYNC_INTERVAL)
+    add_snapshot_args(p)
     a = p.parse_args()
     mesh = mesh_from_args(a)
     run(a.num_workers, shards_dir=a.shards, label_file=a.labels,
         model=a.model, rounds=a.rounds, synthetic=a.synthetic, mesh=mesh,
-        dcn_interval=a.dcn_interval, batch_size=a.batch, tau=a.tau)
+        dcn_interval=a.dcn_interval, batch_size=a.batch, tau=a.tau,
+        snapshot_every_rounds=a.snapshot_every_rounds,
+        snapshot_prefix=a.snapshot_prefix, resume=a.resume)
 
 
 if __name__ == "__main__":

@@ -282,8 +282,10 @@ def cmd_device_query(args) -> int:
 
 
 def main(argv=None) -> int:
-    from .utils.compile_cache import maybe_enable_compile_cache
+    from .utils.compile_cache import (apply_platform_env,
+                                     maybe_enable_compile_cache)
 
+    apply_platform_env()
     maybe_enable_compile_cache()
     p = argparse.ArgumentParser(prog="sparknet_tpu", description=__doc__)
     sub = p.add_subparsers(dest="verb", required=True)

@@ -401,7 +401,10 @@ def _data_layer_shapes(net: Net, layer: LayerParameter,
     elif ltype == "WindowData":
         wp = layer.window_data_param
         batch = int(wp.batch_size)
-        crop = int(wp.crop_size)
+        # crop lives in transform_param in the modern layout (the reference
+        # reads transform_param_.crop_size(), window_data_layer.cpp:168);
+        # the in-layer field is the legacy V1 fallback
+        crop = int(layer.transform_param.crop_size) or int(wp.crop_size)
         if crop:
             chw = (3, crop, crop)
     if net._batch_override:

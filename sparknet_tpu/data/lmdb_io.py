@@ -1,0 +1,451 @@
+"""Reference-DB compatibility: pure-Python LMDB read (and bulk write) plus
+the Caffe Datum codec.
+
+The reference's data path reads LMDB/LevelDB databases of serialized Datum
+records (reference: caffe/src/caffe/util/db_lmdb.cpp:20-86 cursor API;
+caffe/src/caffe/layers/data_layer.cpp reads Datum values;
+caffe/tools/convert_imageset.cpp writes them).  This module implements the
+LMDB on-disk page format directly — no liblmdb — so a database produced by
+the reference's `convert_imageset` / CreateDB path can be ingested here, and
+`LMDBWriter` emits databases the reference can open.
+
+Format notes (LMDB 0.9.x, 64-bit build, the layout mdb.c documents):
+
+- file = psize-aligned pages; pages 0 and 1 are meta pages, readers use the
+  one with the larger txnid.  psize is recorded in mm_dbs[0].md_pad.
+- page header (16 bytes): pgno u64 | mp_pad u16 | mp_flags u16 |
+  pb_lower u16, pb_upper u16 (or pb_pages u32 for overflow pages).
+- flags: P_BRANCH=0x01 P_LEAF=0x02 P_OVERFLOW=0x04 P_META=0x08.
+- node pointer array (u16 page offsets) starts at byte 16; node count =
+  (pb_lower - 16) / 2; nodes pack downward from pb_upper.
+- node: mn_lo u16, mn_hi u16, mn_flags u16, mn_ksize u16, key bytes, then
+  (leaf) data bytes.  Leaf data size = lo | hi<<16; branch child pgno =
+  lo | hi<<16 | flags<<32.  Branch ptr[0] has an empty key.
+- F_BIGDATA=0x01: the node's 8 data bytes are an overflow pgno; the value
+  occupies pb_pages contiguous pages starting there, data from byte 16 of
+  the first page (no headers on the continuation pages).
+- meta (at byte 16 of a meta page): mm_magic u32 = 0xBEEFC0DE,
+  mm_version u32 = 1, mm_address u64, mm_mapsize u64, mm_dbs[2] (each:
+  md_pad u32, md_flags u16, md_depth u16, md_branch_pages u64,
+  md_leaf_pages u64, md_overflow_pages u64, md_entries u64, md_root u64),
+  mm_last_pg u64, mm_txnid u64.  Main DB is mm_dbs[1]; empty root =
+  0xFFFFFFFFFFFFFFFF.
+
+LevelDB (SSTable log/manifest) compatibility is NOT implemented; the
+reference's default backend is lmdb (caffe.proto DataParameter.DB) and its
+LevelDB databases must be converted with the reference's own tools first.
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+from typing import Dict, Iterator, List, Optional, Tuple
+
+import numpy as np
+
+from ..proto.binaryproto import _read_varint, _write_varint, iter_fields
+
+PAGEHDRSZ = 16
+P_BRANCH, P_LEAF, P_OVERFLOW, P_META = 0x01, 0x02, 0x04, 0x08
+F_BIGDATA = 0x01
+MDB_MAGIC = 0xBEEFC0DE
+MDB_VERSION = 1
+P_INVALID = 0xFFFFFFFFFFFFFFFF
+DEFAULT_PSIZE = 4096
+
+
+def _even(n: int) -> int:
+    return (n + 1) & ~1
+
+
+# ------------------------------------------------------------------- reader
+
+class LMDBReader:
+    """Read-only cursor over an LMDB environment (directory with data.mdb,
+    or the data file itself) — the role of db_lmdb.cpp's LMDBCursor."""
+
+    def __init__(self, path: str) -> None:
+        import mmap
+
+        if os.path.isdir(path):
+            path = os.path.join(path, "data.mdb")
+        # mmap, not read(): reference ImageNet LMDBs run to hundreds of GB
+        # (all access below is struct.unpack_from / slicing, both mmap-safe)
+        self._f = open(path, "rb")
+        self.buf = mmap.mmap(self._f.fileno(), 0, access=mmap.ACCESS_READ)
+        meta0 = self._parse_meta(0, DEFAULT_PSIZE)
+        psize = meta0["psize"]
+        meta1 = self._parse_meta(psize, psize)
+        self.meta = meta0 if meta0["txnid"] >= meta1["txnid"] else meta1
+        self.psize = self.meta["psize"]
+        self.entries = self.meta["entries"]
+
+    def _parse_meta(self, off: int, psize_hint: int) -> Dict[str, int]:
+        flags = struct.unpack_from("<H", self.buf, off + 10)[0]
+        if not flags & P_META:
+            raise ValueError(f"page at {off} is not a meta page")
+        m = off + PAGEHDRSZ
+        magic, version = struct.unpack_from("<II", self.buf, m)
+        if magic != MDB_MAGIC:
+            raise ValueError(f"bad LMDB magic {magic:#x}")
+        if version != MDB_VERSION:
+            raise ValueError(f"unsupported LMDB data version {version}")
+        # mm_dbs[0] at m+24; md_pad of the free DB records the page size
+        psize = struct.unpack_from("<I", self.buf, m + 24)[0]
+        main = m + 24 + 48
+        depth = struct.unpack_from("<H", self.buf, main + 6)[0]
+        entries, root = struct.unpack_from("<QQ", self.buf, main + 32)
+        txnid = struct.unpack_from("<Q", self.buf, m + 24 + 96 + 8)[0]
+        return dict(psize=psize, depth=depth, entries=entries, root=root,
+                    txnid=txnid)
+
+    # ---- page walk
+    def _page(self, pgno: int) -> int:
+        off = pgno * self.psize
+        if off + PAGEHDRSZ > len(self.buf):
+            raise ValueError(f"page {pgno} beyond end of file")
+        return off
+
+    def _numkeys(self, off: int) -> int:
+        lower = struct.unpack_from("<H", self.buf, off + 12)[0]
+        return (lower - PAGEHDRSZ) >> 1
+
+    def _node(self, off: int, i: int) -> int:
+        ptr = struct.unpack_from("<H", self.buf, off + PAGEHDRSZ + 2 * i)[0]
+        return off + ptr
+
+    def _walk(self, pgno: int) -> Iterator[Tuple[bytes, bytes]]:
+        off = self._page(pgno)
+        flags = struct.unpack_from("<H", self.buf, off + 10)[0]
+        n = self._numkeys(off)
+        if flags & P_BRANCH:
+            for i in range(n):
+                nd = self._node(off, i)
+                lo, hi, nflags, _ks = struct.unpack_from("<HHHH", self.buf,
+                                                         nd)
+                child = lo | (hi << 16) | (nflags << 32)
+                yield from self._walk(child)
+        elif flags & P_LEAF:
+            for i in range(n):
+                nd = self._node(off, i)
+                lo, hi, nflags, ksize = struct.unpack_from("<HHHH", self.buf,
+                                                           nd)
+                key = self.buf[nd + 8:nd + 8 + ksize]
+                dsize = lo | (hi << 16)
+                dpos = nd + 8 + ksize
+                if nflags & F_BIGDATA:
+                    ovpg = struct.unpack_from("<Q", self.buf, dpos)[0]
+                    ovoff = self._page(ovpg)
+                    oflags = struct.unpack_from("<H", self.buf, ovoff + 10)[0]
+                    if not oflags & P_OVERFLOW:
+                        raise ValueError(f"page {ovpg} is not overflow")
+                    start = ovoff + PAGEHDRSZ
+                    value = self.buf[start:start + dsize]
+                else:
+                    value = self.buf[dpos:dpos + dsize]
+                yield key, value
+        else:
+            raise ValueError(f"page {pgno} has unexpected flags {flags:#x}")
+
+    def items(self) -> Iterator[Tuple[bytes, bytes]]:
+        """(key, value) pairs in key order (LMDBCursor SeekToFirst/Next)."""
+        if self.meta["root"] == P_INVALID:
+            return
+        yield from self._walk(self.meta["root"])
+
+    def __len__(self) -> int:
+        return self.entries
+
+
+# ------------------------------------------------------------------- writer
+
+class LMDBWriter:
+    """Bulk-load a fresh LMDB environment from sorted or unsorted (key,
+    value) pairs — the role of db_lmdb.cpp's LMDBTransaction::Put/Commit as
+    used by convert_imageset (single bulk transaction, then close)."""
+
+    def __init__(self, path: str, psize: int = DEFAULT_PSIZE) -> None:
+        os.makedirs(path, exist_ok=True)
+        self.path = os.path.join(path, "data.mdb")
+        self.psize = psize
+        self.items: List[Tuple[bytes, bytes]] = []
+        # nodemax mirrors liblmdb: half an even page minus the header,
+        # so any page holds >= 2 nodes (MDB_MINKEYS)
+        self.nodemax = ((psize - PAGEHDRSZ) // 2) & ~1
+
+    def put(self, key: bytes, value: bytes) -> None:
+        self.items.append((bytes(key), bytes(value)))
+
+    def commit(self) -> None:
+        items = sorted(self.items, key=lambda kv: kv[0])
+        psize = self.psize
+        pages: Dict[int, bytes] = {}
+        next_pg = 2
+        n_overflow = 0
+
+        def page_hdr(pgno: int, flags: int, lower: int, upper: int) -> bytes:
+            return struct.pack("<QHHHH", pgno, 0, flags, lower, upper)
+
+        def pack_page(pgno: int, flags: int,
+                      nodes: List[bytes]) -> None:
+            ptrs: List[int] = []
+            body = bytearray(psize)
+            upper = psize
+            for nd in nodes:
+                upper -= _even(len(nd))
+                body[upper:upper + len(nd)] = nd
+                ptrs.append(upper)
+            lower = PAGEHDRSZ + 2 * len(nodes)
+            assert lower <= upper, "page overflow"
+            body[:PAGEHDRSZ] = page_hdr(pgno, flags, lower, upper)
+            struct.pack_into(f"<{len(ptrs)}H", body, PAGEHDRSZ, *ptrs)
+            pages[pgno] = bytes(body)
+
+        # ---- leaves (with overflow spills)
+        def leaf_node(key: bytes, value: bytes) -> bytes:
+            nonlocal next_pg, n_overflow
+            if 8 + len(key) + len(value) <= self.nodemax:
+                return struct.pack("<HHHH", len(value) & 0xFFFF,
+                                   len(value) >> 16, 0,
+                                   len(key)) + key + value
+            ovpages = (len(value) + PAGEHDRSZ + psize - 1) // psize
+            ovpg = next_pg
+            next_pg += ovpages
+            n_overflow += ovpages
+            blob = bytearray(ovpages * psize)
+            blob[:PAGEHDRSZ] = struct.pack("<QHHI", ovpg, 0, P_OVERFLOW,
+                                           ovpages)
+            blob[PAGEHDRSZ:PAGEHDRSZ + len(value)] = value
+            for i in range(ovpages):
+                pages[ovpg + i] = bytes(blob[i * psize:(i + 1) * psize])
+            return struct.pack("<HHHH", len(value) & 0xFFFF,
+                               len(value) >> 16, F_BIGDATA,
+                               len(key)) + key + struct.pack("<Q", ovpg)
+
+        level: List[Tuple[bytes, int]] = []  # (first_key, pgno)
+        cur_nodes: List[bytes] = []
+        cur_first: Optional[bytes] = None
+        cur_used = PAGEHDRSZ
+
+        def flush_leaf() -> None:
+            nonlocal cur_nodes, cur_first, cur_used, next_pg
+            if not cur_nodes:
+                return
+            pgno = next_pg
+            next_pg += 1
+            pack_page(pgno, P_LEAF, cur_nodes)
+            level.append((cur_first, pgno))
+            cur_nodes, cur_first, cur_used = [], None, PAGEHDRSZ
+
+        for key, value in items:
+            nd = leaf_node(key, value)
+            need = _even(len(nd)) + 2
+            if cur_used + need > psize:
+                flush_leaf()
+            if cur_first is None:
+                cur_first = key
+            cur_nodes.append(nd)
+            cur_used += need
+        flush_leaf()
+        n_leaves = len(level)
+
+        # ---- branch levels up to a single root
+        depth = 1
+        n_branch = 0
+        while len(level) > 1:
+            depth += 1
+            parent: List[Tuple[bytes, int]] = []
+            nodes: List[bytes] = []
+            first: Optional[bytes] = None
+            used = PAGEHDRSZ
+
+            def branch_node(key: bytes, child: int) -> bytes:
+                return struct.pack("<HHHH", child & 0xFFFF,
+                                   (child >> 16) & 0xFFFF, child >> 32,
+                                   len(key)) + key
+
+            def flush_branch() -> None:
+                nonlocal nodes, first, used, next_pg, n_branch
+                if not nodes:
+                    return
+                pgno = next_pg
+                next_pg += 1
+                n_branch += 1
+                pack_page(pgno, P_BRANCH, nodes)
+                parent.append((first, pgno))
+                nodes, first, used = [], None, PAGEHDRSZ
+
+            for i, (key, child) in enumerate(level):
+                nd = branch_node(b"" if not nodes else key, child)
+                need = _even(len(nd)) + 2
+                if used + need > psize:
+                    flush_branch()
+                    nd = branch_node(b"", child)
+                    need = _even(len(nd)) + 2
+                if first is None:
+                    first = key
+                nodes.append(nd)
+                used += need
+            flush_branch()
+            level = parent
+
+        root = level[0][1] if level else P_INVALID
+        last_pg = next_pg - 1 if next_pg > 2 else 1
+
+        # ---- meta pages (txnid 1 on page 0, txnid 0 on page 1)
+        def meta_page(pgno: int, txnid: int) -> bytes:
+            body = bytearray(psize)
+            body[:PAGEHDRSZ] = page_hdr(pgno, P_META, 0, 0)
+            m = PAGEHDRSZ
+            struct.pack_into("<II", body, m, MDB_MAGIC, MDB_VERSION)
+            struct.pack_into("<QQ", body, m + 8, 0, max(
+                (last_pg + 1) * psize, 1 << 20))
+            # mm_dbs[0] (free DB): md_pad records psize, empty tree
+            struct.pack_into("<IHH", body, m + 24, psize, 0, 0)
+            struct.pack_into("<QQQQQ", body, m + 32, 0, 0, 0, 0, P_INVALID)
+            # mm_dbs[1] (main DB)
+            struct.pack_into("<IHH", body, m + 72, 0, 0,
+                             depth if items else 0)
+            struct.pack_into("<QQQQQ", body, m + 80, n_branch, n_leaves,
+                             n_overflow, len(items), root)
+            struct.pack_into("<QQ", body, m + 120, last_pg, txnid)
+            return bytes(body)
+
+        with open(self.path, "wb") as f:
+            f.write(meta_page(0, 1))
+            f.write(meta_page(1, 0))
+            for pgno in range(2, next_pg):
+                f.write(pages[pgno])
+        # lock file for liblmdb open-compat (contents are runtime state)
+        open(os.path.join(os.path.dirname(self.path), "lock.mdb"),
+             "wb").close()
+
+    def close(self) -> None:
+        self.commit()
+
+
+# -------------------------------------------------------------- Datum codec
+
+def parse_datum(buf: bytes) -> Dict[str, object]:
+    """Caffe Datum (caffe.proto:30-41: channels=1 height=2 width=3 data=4
+    label=5 float_data=6 encoded=7) -> dict with an (C, H, W) array under
+    "image" (uint8 from `data`, float32 from `float_data`) unless
+    `encoded`, in which case "encoded_bytes" carries the compressed image."""
+    channels = height = width = label = 0
+    data = b""
+    floats: List[np.ndarray] = []
+    encoded = False
+    for field, wt, val in iter_fields(buf):
+        if field == 1:
+            channels = int(val)
+        elif field == 2:
+            height = int(val)
+        elif field == 3:
+            width = int(val)
+        elif field == 4:
+            data = val
+        elif field == 5:
+            label = int(val)
+        elif field == 6:
+            if wt == 2:
+                floats.append(np.frombuffer(val, dtype="<f4"))
+            else:
+                floats.append(np.frombuffer(bytes(val), dtype="<f4"))
+        elif field == 7:
+            encoded = bool(val)
+    out: Dict[str, object] = dict(channels=channels, height=height,
+                                  width=width, label=label, encoded=encoded)
+    if encoded:
+        out["encoded_bytes"] = data
+    elif data:
+        out["image"] = np.frombuffer(data, dtype=np.uint8).reshape(
+            channels, height, width)
+    elif floats:
+        out["image"] = np.concatenate(floats).astype(np.float32).reshape(
+            channels, height, width)
+    return out
+
+
+def serialize_datum(image: np.ndarray, label: int) -> bytes:
+    """(C, H, W) uint8 -> Datum bytes (what convert_imageset stores)."""
+    c, h, w = image.shape
+    out = bytearray()
+    for field, val in ((1, c), (2, h), (3, w)):
+        _write_varint(out, field << 3)
+        _write_varint(out, val)
+    raw = np.ascontiguousarray(image, dtype=np.uint8).tobytes()
+    _write_varint(out, (4 << 3) | 2)
+    _write_varint(out, len(raw))
+    out += raw
+    _write_varint(out, 5 << 3)
+    _write_varint(out, int(label))
+    return bytes(out)
+
+
+# ------------------------------------------------------------ integrations
+
+def read_datum_db(path: str, height: Optional[int] = None,
+                  width: Optional[int] = None
+                  ) -> Iterator[Tuple[np.ndarray, int]]:
+    """Stream (image CHW, label) from a reference-made LMDB of Datum
+    records, decoding `encoded` datums (compressed JPEG/PNG) on the fly;
+    height/width resize encoded images (convert_imageset --resize_*
+    semantics — without them encoded datums keep their native sizes)."""
+    from .scale_convert import decode_and_resize
+
+    for _key, value in LMDBReader(path).items():
+        d = parse_datum(value)
+        if d.get("encoded"):
+            img = decode_and_resize(d["encoded_bytes"],  # type: ignore
+                                    height, width)
+            if img is None:
+                continue
+            yield img, int(d["label"])  # type: ignore[arg-type]
+        elif "image" in d:
+            yield d["image"], int(d["label"])  # type: ignore
+
+
+def convert_lmdb_to_store(lmdb_path: str, store_path: str,
+                          height: Optional[int] = None,
+                          width: Optional[int] = None) -> int:
+    """Migrate a reference LMDB into this framework's ArrayStore (the
+    ingestion path ImageNetRunDBApp parity needs).  Returns the record
+    count.  Pass height/width for encoded DBs with per-image native sizes
+    (ArrayStore batches need one shape); float_data datums are rejected
+    rather than silently truncated to uint8."""
+    from .store import ArrayStoreWriter
+
+    w = ArrayStoreWriter(store_path)
+    n = 0
+    shape = None
+    for img, label in read_datum_db(lmdb_path, height, width):
+        if np.issubdtype(img.dtype, np.floating):
+            raise ValueError(
+                "LMDB record holds float_data; ArrayStore stores uint8 "
+                "images — convert feature DBs with your own scaling instead "
+                "of this verb")
+        if shape is None:
+            shape = img.shape
+        elif img.shape != shape:
+            raise ValueError(
+                f"LMDB images have mixed shapes ({shape} vs {img.shape}); "
+                f"pass height/width (convert_imageset --resize_* analogue) "
+                f"to normalize encoded records")
+        w.put(img, label)
+        n += 1
+    w.close()
+    return n
+
+
+def write_datum_lmdb(path: str, pairs: Iterator[Tuple[np.ndarray, int]],
+                     key_format: str = "{:08d}") -> int:
+    """Write (image, label) pairs as a Datum LMDB the reference can read
+    (convert_imageset's DB layout, keys zero-padded in insertion order)."""
+    w = LMDBWriter(path)
+    n = 0
+    for img, label in pairs:
+        w.put(key_format.format(n).encode(), serialize_datum(img, label))
+        n += 1
+    w.commit()
+    return n

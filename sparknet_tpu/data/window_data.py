@@ -1,0 +1,335 @@
+"""WindowData host pipeline: R-CNN-style ROI minibatch sampling from a
+window file (reference: caffe/src/caffe/layers/window_data_layer.cpp:30-470).
+
+The reference's WindowDataLayer parses a window file into foreground /
+background window lists (fg if overlap >= fg_threshold, bg if overlap <
+bg_threshold — bg windows get label and overlap forced to 0,
+window_data_layer.cpp:128-141), then each batch samples N*fg_fraction
+foreground and the rest background windows, crops each ROI with optional
+context padding / square mode, warps it to crop_size x crop_size, randomly
+mirrors, subtracts the mean, and scales (load_batch,
+window_data_layer.cpp:225-470).
+
+Here that whole per-batch loop is a host-side feed producing {"data",
+"label"} for the graph's WindowData feed layer (core/net.py) — the pull
+contract every data layer uses in this framework.  One deliberate deviation:
+images decode to RGB channel order (consistent with the rest of this
+framework's pipeline) where OpenCV's imread gives BGR; mean_values are
+interpreted in the same order as the decoded channels, so semantics are
+preserved end to end.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+# window record columns (window_data_layer.cpp enum: IMAGE_INDEX, LABEL,
+# OVERLAP, X1, Y1, X2, Y2)
+IMAGE_INDEX, LABEL, OVERLAP, X1, Y1, X2, Y2 = range(7)
+
+
+def _c_round(v: float) -> int:
+    """C round(): half away from zero (the reference's static_cast<int>(
+    round(...)) — Python's round() is banker's and would drift)."""
+    return int(np.floor(v + 0.5)) if v >= 0 else int(np.ceil(v - 0.5))
+
+
+class WindowDataset:
+    """Parsed window file (window_data_layer.cpp:77-155).
+
+    Format, repeated per image::
+
+        # image_index
+        img_path (abs or root_folder-relative)
+        channels
+        height
+        width
+        num_windows
+        class_index overlap x1 y1 x2 y2     (num_windows lines)
+    """
+
+    def __init__(self, source: str, *, fg_threshold: float = 0.5,
+                 bg_threshold: float = 0.5, root_folder: str = "") -> None:
+        self.image_database: List[Tuple[str, Tuple[int, int, int]]] = []
+        self.fg_windows: List[List[float]] = []
+        self.bg_windows: List[List[float]] = []
+        self.label_hist: Dict[int, int] = {0: 0}
+        with open(source) as f:
+            tokens = f.read().split()
+        pos = 0
+
+        def take() -> str:
+            nonlocal pos
+            t = tokens[pos]
+            pos += 1
+            return t
+
+        if not tokens:
+            raise ValueError("Window file is empty")
+        while pos < len(tokens):
+            hashtag = take()
+            if hashtag != "#":
+                raise ValueError(f"expected '#', got {hashtag!r}")
+            image_index = int(take())
+            image_path = root_folder + take()
+            c, h, w = int(take()), int(take()), int(take())
+            self.image_database.append((image_path, (c, h, w)))
+            num_windows = int(take())
+            for _ in range(num_windows):
+                label = int(take())
+                overlap = float(take())
+                x1, y1, x2, y2 = (int(take()) for _ in range(4))
+                window = [float(image_index), float(label), overlap,
+                          float(x1), float(y1), float(x2), float(y2)]
+                if overlap >= fg_threshold:
+                    if label <= 0:
+                        raise ValueError(
+                            f"foreground window must have label > 0, got "
+                            f"{label} (image {image_path})")
+                    self.fg_windows.append(window)
+                    self.label_hist[label] = self.label_hist.get(label, 0) + 1
+                elif overlap < bg_threshold:
+                    # background: force label and overlap to 0
+                    window[LABEL] = 0.0
+                    window[OVERLAP] = 0.0
+                    self.bg_windows.append(window)
+                    self.label_hist[0] += 1
+
+    @property
+    def channels(self) -> int:
+        return self.image_database[0][1][0] if self.image_database else 3
+
+
+def load_image_chw(path: str) -> np.ndarray:
+    """Decode an image file to (C, H, W) uint8, RGB order."""
+    from PIL import Image
+
+    img = Image.open(path).convert("RGB")
+    return np.transpose(np.asarray(img, dtype=np.uint8), (2, 0, 1))
+
+
+def expand_window(x1: int, y1: int, x2: int, y2: int, img_h: int, img_w: int,
+                  crop_size: int, context_pad: int, use_square: bool,
+                  do_mirror: bool
+                  ) -> Tuple[int, int, int, int, int, int, int, int]:
+    """The reference's context-padding geometry (window_data_layer.cpp:
+    305-383): expand the ROI so that after warping to crop_size there is
+    exactly context_pad padding each side; clip to the image; compute the
+    warp target size and the canvas offsets for the clipped region.
+
+    Returns (x1, y1, x2, y2, target_w, target_h, pad_w, pad_h) — the
+    clipped ROI, the size to warp it to, and where it lands on the
+    crop_size x crop_size canvas."""
+    target_w = target_h = crop_size
+    pad_w = pad_h = 0
+    if context_pad > 0 or use_square:
+        context_scale = crop_size / float(crop_size - 2 * context_pad)
+        half_height = (y2 - y1 + 1) / 2.0
+        half_width = (x2 - x1 + 1) / 2.0
+        center_x = x1 + half_width
+        center_y = y1 + half_height
+        if use_square:
+            half_width = half_height = max(half_height, half_width)
+        x1 = _c_round(center_x - half_width * context_scale)
+        x2 = _c_round(center_x + half_width * context_scale)
+        y1 = _c_round(center_y - half_height * context_scale)
+        y2 = _c_round(center_y + half_height * context_scale)
+
+        unclipped_height = y2 - y1 + 1
+        unclipped_width = x2 - x1 + 1
+        pad_x1 = max(0, -x1)
+        pad_y1 = max(0, -y1)
+        pad_x2 = max(0, x2 - img_w + 1)
+        pad_y2 = max(0, y2 - img_h + 1)
+        x1, x2 = x1 + pad_x1, x2 - pad_x2
+        y1, y2 = y1 + pad_y1, y2 - pad_y2
+        assert x1 >= 0 and y1 >= 0 and x2 < img_w and y2 < img_h
+
+        clipped_height = y2 - y1 + 1
+        clipped_width = x2 - x1 + 1
+        scale_x = crop_size / float(unclipped_width)
+        scale_y = crop_size / float(unclipped_height)
+        target_w = _c_round(clipped_width * scale_x)
+        target_h = _c_round(clipped_height * scale_y)
+        pad_x1 = _c_round(pad_x1 * scale_x)
+        pad_x2 = _c_round(pad_x2 * scale_x)
+        pad_y1 = _c_round(pad_y1 * scale_y)
+        pad_y2 = _c_round(pad_y2 * scale_y)
+        pad_h = pad_y1
+        # mirroring mirrors the padding too (window_data_layer.cpp:370-375)
+        pad_w = pad_x2 if do_mirror else pad_x1
+        # rounding may overflow the canvas; shrink the warp target
+        if pad_h + target_h > crop_size:
+            target_h = crop_size - pad_h
+        if pad_w + target_w > crop_size:
+            target_w = crop_size - pad_w
+    return x1, y1, x2, y2, target_w, target_h, pad_w, pad_h
+
+
+def _warp(img_chw: np.ndarray, h: int, w: int) -> np.ndarray:
+    """Bilinear warp of a (C, H, W) crop to (C, h, w) (the reference's
+    cv::resize INTER_LINEAR, window_data_layer.cpp:386-389)."""
+    from ..classify import resize_image
+
+    hwc = np.transpose(img_chw, (1, 2, 0)).astype(np.float32)
+    out = resize_image(hwc, (h, w))
+    return np.transpose(out, (2, 0, 1))
+
+
+class WindowDataFeed:
+    """Per-batch fg/bg ROI sampler — the load_batch loop
+    (window_data_layer.cpp:225-470) as a pull-style data source.
+
+    Samples num_fg = int(batch_size * fg_fraction) foreground windows and
+    batch_size - num_fg background windows (background first, matching the
+    reference's is_fg 0-then-1 order), crops + context-pads + warps each,
+    mirrors at random, subtracts the mean and scales.  Pixels outside the
+    warped region stay zero (the reference zeroes the batch buffer)."""
+
+    def __init__(self, dataset: WindowDataset, *, batch_size: int,
+                 crop_size: int, fg_fraction: float = 0.25,
+                 context_pad: int = 0, crop_mode: str = "warp",
+                 mirror: bool = False, scale: float = 1.0,
+                 mean_image: Optional[np.ndarray] = None,
+                 mean_values: Sequence[float] = (),
+                 seed: Optional[int] = None,
+                 cache_images: bool = False) -> None:
+        if crop_size <= 0:
+            raise ValueError("WindowData needs crop_size > 0")
+        if mean_image is not None and len(mean_values):
+            raise ValueError(
+                "Cannot specify mean_file and mean_value at the same time")
+        self.ds = dataset
+        self.batch_size = int(batch_size)
+        self.crop_size = int(crop_size)
+        self.fg_fraction = float(fg_fraction)
+        self.context_pad = int(context_pad)
+        self.use_square = crop_mode == "square"
+        self.mirror = bool(mirror)
+        self.scale = float(scale)
+        self.mean_image = (np.asarray(mean_image, dtype=np.float32)
+                           if mean_image is not None else None)
+        c = dataset.channels
+        mv = list(mean_values)
+        if len(mv) == 1 and c > 1:
+            mv = mv * c  # replicate single mean_value across channels
+        if mv and len(mv) != c:
+            raise ValueError(
+                f"specify 1 mean_value or {c} (one per channel), got "
+                f"{len(mv)}")
+        self.mean_values = np.asarray(mv, dtype=np.float32) if mv else None
+        self.rng = np.random.RandomState(seed)
+        self._cache: Dict[int, np.ndarray] = {}
+        self.cache_images = bool(cache_images)
+
+    @classmethod
+    def from_layer_param(cls, layer, *, seed: Optional[int] = None
+                         ) -> "WindowDataFeed":
+        """Build from a prototxt WindowData LayerParameter.  crop/mirror/
+        mean/scale come from transform_param when present (modern layout)
+        with the legacy in-layer fields as fallback (the V0/V1 upgrade
+        path's merged view, upgrade_proto.cpp semantics)."""
+        wp = layer.window_data_param
+        tp = layer.transform_param
+        crop = int(tp.crop_size) or int(wp.crop_size)
+        mirror = bool(tp.mirror) or bool(wp.mirror)
+        scale = (float(tp.scale) if float(tp.scale) != 1.0
+                 else float(wp.scale))
+        mean_file = str(tp.mean_file) or str(wp.mean_file)
+        mean_values = tp.mean_values
+        mean_image = None
+        if mean_file:
+            from ..proto.binaryproto import read_mean_binaryproto
+
+            mean_image = read_mean_binaryproto(mean_file)
+        ds = WindowDataset(str(wp.source),
+                           fg_threshold=float(wp.fg_threshold),
+                           bg_threshold=float(wp.bg_threshold),
+                           root_folder=str(wp.root_folder))
+        return cls(ds, batch_size=int(wp.batch_size), crop_size=crop,
+                   fg_fraction=float(wp.fg_fraction),
+                   context_pad=int(wp.context_pad),
+                   crop_mode=str(wp.crop_mode), mirror=mirror, scale=scale,
+                   mean_image=mean_image, mean_values=mean_values,
+                   seed=seed, cache_images=bool(wp.cache_images))
+
+    # ------------------------------------------------------------------ io
+    def _image(self, index: int) -> np.ndarray:
+        if index in self._cache:
+            return self._cache[index]
+        img = load_image_chw(self.ds.image_database[index][0])
+        if self.cache_images:
+            self._cache[index] = img
+        return img
+
+    def _rand(self) -> int:
+        return int(self.rng.randint(0, 2 ** 31))
+
+    # ---------------------------------------------------------------- batch
+    def _one(self, window: List[float], do_mirror: bool) -> np.ndarray:
+        img = self._image(int(window[IMAGE_INDEX]))
+        c, img_h, img_w = img.shape
+        cs = self.crop_size
+        x1, y1, x2, y2, tw, th, pad_w, pad_h = expand_window(
+            int(window[X1]), int(window[Y1]), int(window[X2]),
+            int(window[Y2]), img_h, img_w, cs, self.context_pad,
+            self.use_square, do_mirror)
+        roi = img[:, y1:y2 + 1, x1:x2 + 1]
+        warped = _warp(roi, th, tw)
+        if do_mirror:
+            warped = warped[:, :, ::-1]
+        out = np.zeros((c, cs, cs), dtype=np.float32)
+        region = warped
+        if self.mean_image is not None:
+            # mean is indexed at the canvas position, offset to its center
+            # crop (window_data_layer.cpp:404-409)
+            mh, mw = self.mean_image.shape[-2:]
+            mean_off = (mw - cs) // 2
+            mean = self.mean_image.reshape(c, mh, mw)
+            region = region - mean[:, mean_off + pad_h:mean_off + pad_h + th,
+                                   mean_off + pad_w:mean_off + pad_w + tw]
+        elif self.mean_values is not None:
+            region = region - self.mean_values[:, None, None]
+        out[:, pad_h:pad_h + th, pad_w:pad_w + tw] = region * self.scale
+        return out
+
+    def __call__(self) -> Dict[str, np.ndarray]:
+        bs = self.batch_size
+        num_fg = int(bs * self.fg_fraction)
+        num_samples = (bs - num_fg, num_fg)  # bg first, then fg
+        data = np.zeros((bs, self.ds.channels, self.crop_size,
+                         self.crop_size), dtype=np.float32)
+        label = np.zeros((bs,), dtype=np.int32)
+        item = 0
+        for is_fg in (0, 1):
+            pool = self.ds.fg_windows if is_fg else self.ds.bg_windows
+            if num_samples[is_fg] and not pool:
+                raise ValueError(
+                    f"window file has no "
+                    f"{'foreground' if is_fg else 'background'} windows but "
+                    f"the batch needs {num_samples[is_fg]}")
+            for _ in range(num_samples[is_fg]):
+                window = pool[self._rand() % len(pool)]
+                do_mirror = self.mirror and self._rand() % 2 == 1
+                data[item] = self._one(window, do_mirror)
+                label[item] = int(window[LABEL])
+                item += 1
+        return {"data": data, "label": label}
+
+
+def write_window_file(path: str, entries: List[Tuple[str, Tuple[int, int, int],
+                                                     List[Tuple[int, float,
+                                                                int, int, int,
+                                                                int]]]]
+                      ) -> None:
+    """Write a window file (the format parsed above) — fixture/tooling
+    helper; entries = [(img_path, (c, h, w), [(label, overlap, x1, y1, x2,
+    y2), ...]), ...]."""
+    with open(path, "w") as f:
+        for idx, (img_path, (c, h, w), windows) in enumerate(entries):
+            f.write(f"# {idx}\n{img_path}\n{c}\n{h}\n{w}\n{len(windows)}\n")
+            for label, overlap, x1, y1, x2, y2 in windows:
+                f.write(f"{label} {overlap} {x1} {y1} {x2} {y2}\n")

@@ -32,7 +32,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from ..core.net import Net
 from ..proto.caffe_pb import NetParameter, SolverParameter
 from ..solver import updates
-from ..solver.solver import (DataSource, load_params_file, make_loss_fn,
+from ..solver.solver import (DataSource, load_params_file,
                              make_single_step, parse_caffe_snapshot,
                              parse_native_snapshot, parse_slot_arrays,
                              resolve_precision, resolve_solverstate_path,
@@ -109,6 +109,12 @@ class DistributedSolver:
         self._num_test_batches = 0
         self._round_fns: Dict[bool, Any] = {}
         self._test_step = jax.jit(self._build_test_step())
+        # the model under test is the replica MEAN — identical to worker 0
+        # right after a global averaging round, and the reference's
+        # average-then-test semantics (CifarApp.scala:97-116) when slices
+        # have diverged mid-schedule under dcn_interval > 1
+        self._avg_params_fn = jax.jit(
+            lambda pw: jax.tree.map(lambda a: jnp.mean(a, axis=0), pw))
 
     # ----------------------------------------------------------------- build
     def _round_fn(self, avg_dcn: bool = True):
@@ -119,8 +125,6 @@ class DistributedSolver:
         return self._round_fns[avg_dcn]
 
     def _build_round_fn(self, avg_dcn: bool = True):
-        single_step = make_single_step(self.net, self.param,
-                                       precision=self.precision)
         tau = self.tau
         mode = self.mode
         axis = WORKER_AXIS
@@ -128,6 +132,18 @@ class DistributedSolver:
         # sync mode always syncs globally; average mode crosses DCN only on
         # avg_dcn rounds (the dcn_interval hierarchy)
         sync_axes = (DCN_AXIS, WORKER_AXIS) if has_dcn else WORKER_AXIS
+        if mode == "sync":
+            # per-step gradient pmean (the P2PSync on_gradients_ready
+            # analogue, parallel.cpp:325-381) plugged into the ONE shared
+            # clip/regularize/LR/update pipeline
+            def grad_sync(grads, loss):
+                return (jax.lax.pmean(grads, sync_axes),
+                        jax.lax.pmean(loss, sync_axes))
+        else:
+            grad_sync = None
+        stepper = make_single_step(self.net, self.param,
+                                   precision=self.precision,
+                                   grad_sync=grad_sync)
 
         def round_shard(params, state, it0, batches, rng):
             # shard_map hands us the leading worker-block of size 1: strip it.
@@ -135,43 +151,6 @@ class DistributedSolver:
             state = jax.tree.map(lambda a: a[0], state)
             batches = jax.tree.map(lambda a: a[0], batches)
             rng = rng[0]
-
-            if mode == "sync":
-                base_loss = make_loss_fn(self.net, self.precision)
-
-                def sync_step(params, state, it, inputs, step_rng):
-                    # pmean of grads inside the step: wrap the loss so its
-                    # gradient is already averaged over workers
-                    def loss_fn(p):
-                        return base_loss(p, inputs, step_rng)
-                    (loss, stats), grads = jax.value_and_grad(
-                        loss_fn, has_aux=True)(params)
-                    grads = jax.lax.pmean(grads, sync_axes)
-                    loss = jax.lax.pmean(loss, sync_axes)
-                    grads_dict = grads
-                    # reuse the shared update pipeline via single_step's
-                    # components is cleaner, but clip/regularize order must
-                    # match: delegate to updates.* directly
-                    from ..solver.lr_policies import learning_rate
-                    sp = self.param
-                    g = updates.clip_gradients(grads_dict,
-                                               float(sp.clip_gradients))
-                    g = updates.regularize(params, g, float(sp.weight_decay),
-                                           self.net.decay_multipliers(),
-                                           str(sp.regularization_type))
-                    rate = learning_rate(sp, it)
-                    new_p, new_s = updates.apply_update(
-                        sp.resolved_type(), params, g, state, rate, it,
-                        lr_mults=self.net.lr_multipliers(),
-                        momentum=float(sp.momentum), delta=float(sp.delta),
-                        momentum2=float(sp.momentum2),
-                        rms_decay=float(sp.rms_decay))
-                    for k, v in stats.items():
-                        new_p[k] = v
-                    return new_p, new_s, loss
-                stepper = sync_step
-            else:
-                stepper = single_step
 
             def body(carry, xs):
                 p, s, it = carry
@@ -206,8 +185,7 @@ class DistributedSolver:
         net = self.test_net
         outputs = net.output_blobs
 
-        def test_step(params_w, inputs):
-            params = jax.tree.map(lambda a: a[0], params_w)
+        def test_step(params, inputs):
             blobs, _ = net.apply(params, inputs, train=False)
             return {k: blobs[k] for k in outputs}
 
@@ -280,13 +258,18 @@ class DistributedSolver:
         return float(loss)
 
     def test(self, num_batches: Optional[int] = None) -> Dict[str, float]:
-        """Evaluate the (averaged) model (reference: CifarApp.scala:101-116)."""
+        """Evaluate the averaged model (reference: CifarApp.scala:101-116).
+
+        Uses the mean over every replica, not worker 0's — so a test call
+        between DCN rounds (dcn_interval > 1, slices diverged) still
+        evaluates what the reference's driver would have averaged."""
         assert self.test_source is not None
         n = num_batches or self._num_test_batches
+        avg = self._avg_params_fn(self.params_w)
         totals: Dict[str, float] = {}
         for _ in range(n):
             batch = {k: jnp.asarray(v) for k, v in self.test_source().items()}
-            outs = self._test_step(self.params_w, batch)
+            outs = self._test_step(avg, batch)
             for k, v in outs.items():
                 totals[k] = totals.get(k, 0.0) + float(v)
         return {k: v / n for k, v in totals.items()}
@@ -317,11 +300,22 @@ class DistributedSolver:
         momentum states are worker-local between averages (the reference
         keeps them in each executor's WorkerStore too), so exact resume
         needs all of them.  Worker-0 `state:` views are also written, which
-        is what the single-chip Solver's restore reads."""
+        is what the single-chip Solver's restore reads.
+
+        Under dcn_interval > 1 the slices' PARAMS also diverge between DCN
+        rounds, so the full per-worker params are written too — otherwise a
+        snapshot taken on a non-DCN round would resume slice-1 momentum
+        against slice-0 weights and silently break the exact kill-and-resume
+        contract."""
         state0 = jax.tree.map(lambda a: np.asarray(a[0]), self.state_w)
         extra = {f"wstate:{i}:{k}": np.asarray(h)
                  for k, hs in self.state_w.items()
                  for i, h in enumerate(hs)}
+        if self.dcn_interval > 1 and self.round % self.dcn_interval != 0:
+            # slices are diverged right now (last round was ICI-only);
+            # DCN-aligned snapshots skip this — replicas are all equal
+            extra.update({f"wparam:0:{k}": np.asarray(v)
+                          for k, v in self.params_w.items()})
         return write_native_snapshot(path, self.iter, self._params0(),
                                      state0, extra=extra)
 
@@ -349,7 +343,14 @@ class DistributedSolver:
         it, params, state = parse_native_snapshot(data)
         self.iter = it
         self.round = it // self.tau
-        self._broadcast_params(params)
+        wparam = parse_slot_arrays(data, "wparam")
+        if wparam and all(v[0].shape[0] == self.n_workers
+                          for v in wparam.values()):
+            # exact per-worker (diverged-slice) params resume
+            self.params_w = jax.device_put(
+                {k: v[0] for k, v in wparam.items()}, self._wsh)
+        else:
+            self._broadcast_params(params)
         wstate = parse_slot_arrays(data, "wstate")
         if wstate and all(v[0].shape[0] == self.n_workers
                           for v in wstate.values()):

@@ -84,14 +84,20 @@ def make_loss_fn(net: Net, precision: str):
 
 
 def make_single_step(net: Net, sp: SolverParameter,
-                     precision: Optional[str] = None):
+                     precision: Optional[str] = None,
+                     grad_sync: Optional[Callable] = None):
     """One training iteration as a pure function
     (params, state, it, inputs, rng) -> (params, state, loss).
 
     The per-iteration core of Solver::Step + SGDSolver::ApplyUpdate
     (solver.cpp:193-288, sgd_solver.cpp:102-240) with iter_size folded out;
     shared by the single-chip Solver and the distributed trainer, which scans
-    it over τ local steps inside one compiled round (SURVEY.md §2.3)."""
+    it over τ local steps inside one compiled round (SURVEY.md §2.3).
+
+    `grad_sync(grads, loss) -> (grads, loss)` runs between backward and the
+    clip/regularize/update pipeline — the distributed trainer's per-step
+    gradient `pmean` (the P2PSync on_gradients_ready analogue,
+    parallel.cpp:325-381) plugs in here so the update math exists once."""
     clip = float(sp.clip_gradients)
     weight_decay = float(sp.weight_decay)
     reg_type = str(sp.regularization_type)
@@ -106,6 +112,8 @@ def make_single_step(net: Net, sp: SolverParameter,
     def single_step(params, state, it, inputs, rng):
         (loss, stats), grads = jax.value_and_grad(
             loss_fn, has_aux=True)(params, inputs, rng)
+        if grad_sync is not None:
+            grads, loss = grad_sync(grads, loss)
         grads = updates.clip_gradients(grads, clip)
         grads = updates.regularize(params, grads, weight_decay, decay_mults,
                                    reg_type)

@@ -102,6 +102,27 @@ def cmd_convert_imageset(args) -> int:
     return 0
 
 
+def cmd_convert_db(args) -> int:
+    """Migrate between DB formats: a reference-made LMDB of Datum records
+    ingests into this framework's ArrayStore, and an ArrayStore exports to
+    an LMDB the reference can open (reference: db_lmdb.cpp:20-86 cursor,
+    convert_imageset.cpp layout)."""
+    from .data import lmdb_io
+    from .data.store import ArrayStoreCursor
+
+    if args.direction == "lmdb-to-store":
+        n = lmdb_io.convert_lmdb_to_store(
+            args.input, args.output, args.resize_height or None,
+            args.resize_width or None)
+    else:
+        cur = ArrayStoreCursor(args.input)
+        n = lmdb_io.write_datum_lmdb(
+            args.output, (cur.next() for _ in range(len(cur))))
+    print(f"Converted {n} records {args.direction}: "
+          f"{args.input} -> {args.output}")
+    return 0
+
+
 def cmd_extract_features(args) -> int:
     """Forward a trained net over a data source and dump named blob
     activations (reference: tools/extract_features.cpp; the distributed
@@ -258,6 +279,15 @@ def register(sub) -> None:
     ci.add_argument("--resize_height", type=int, default=0)
     ci.add_argument("--resize_width", type=int, default=0)
     ci.set_defaults(fn=cmd_convert_imageset)
+
+    cd = sub.add_parser("convert_db")
+    cd.add_argument("direction",
+                    choices=["lmdb-to-store", "store-to-lmdb"])
+    cd.add_argument("input")
+    cd.add_argument("output")
+    cd.add_argument("--resize_height", type=int, default=0)
+    cd.add_argument("--resize_width", type=int, default=0)
+    cd.set_defaults(fn=cmd_convert_db)
 
     ef = sub.add_parser("extract_features")
     ef.add_argument("--model", required=True)

@@ -11,6 +11,27 @@ from __future__ import annotations
 import os
 
 
+def apply_platform_env() -> None:
+    """Honor JAX_PLATFORMS even when a sitecustomize pre-imports jax.
+
+    Env-var platform selection is consumed at jax import; hosts whose
+    sitecustomize imports jax before user code (this box does, to register
+    the TPU tunnel) silently ignore it, so CLI runs like
+    `JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
+    python -m sparknet_tpu.apps.cifar_app 8 ...` would demand 8 real chips.
+    Re-applying through the live config is safe as long as no backend has
+    been initialized yet — call this first in every entry point."""
+    platforms = os.environ.get("JAX_PLATFORMS")
+    if not platforms:
+        return
+    import jax
+
+    try:
+        jax.config.update("jax_platforms", platforms)
+    except RuntimeError:
+        pass  # backend already initialized; env took effect or it's too late
+
+
 def maybe_enable_compile_cache() -> bool:
     """Enable jax's persistent compilation cache if SPARKNET_COMPILE_CACHE
     names a directory.  Returns whether it was enabled.  Safe to call

@@ -78,3 +78,54 @@ def test_mnist_dsl_app():
 
     acc = mnist_app.run(synthetic=True, iterations=60, batch=16)
     assert acc > 0.5  # synthetic rule is easy; chance is 0.10
+
+
+def test_cifar_app_snapshot_resume(tmp_path):
+    """Kill-and-resume reproduces the uninterrupted run exactly (SURVEY.md
+    §5.4; the reference's dead driver-checkpoint code,
+    CifarDBApp.scala:144-149, made real): run A snapshots at rounds 2 and 4;
+    run B resumes from A's round-2 snapshot and snapshots at round 4; the
+    round-4 snapshots must be bit-comparable (params AND per-worker
+    momentum)."""
+    from sparknet_tpu.apps import cifar_app
+
+    a_prefix = str(tmp_path / "a")
+    b_prefix = str(tmp_path / "b")
+    common = dict(model="quick", synthetic=True, batch_size=8, tau=2,
+                  mesh=make_mesh(4))
+    cifar_app.run(4, rounds=4, snapshot_every_rounds=2,
+                  snapshot_prefix=a_prefix,
+                  log_path=str(tmp_path / "a.log"), **common)
+    mid = a_prefix + "_iter_4.npz"      # after round 2 (tau=2)
+    final_a = a_prefix + "_iter_8.npz"  # after round 4
+    assert np.load(mid) is not None
+
+    cifar_app.run(4, rounds=4, snapshot_every_rounds=2,
+                  snapshot_prefix=b_prefix, resume=mid,
+                  log_path=str(tmp_path / "b.log"), **common)
+    final_b = b_prefix + "_iter_8.npz"
+
+    da, db = np.load(final_a), np.load(final_b)
+    assert set(da.files) == set(db.files)
+    for k in da.files:
+        np.testing.assert_allclose(da[k], db[k], rtol=1e-6, atol=1e-7,
+                                   err_msg=k)
+
+
+def test_imagenet_app_snapshot_resume(tmp_path):
+    """Same kill-and-resume contract on the ImageNet app (synthetic feed)."""
+    a_prefix = str(tmp_path / "a")
+    b_prefix = str(tmp_path / "b")
+    common = dict(model="alexnet", synthetic=True, batch_size=2, tau=1,
+                  test_batch=2, test_every=100, mesh=make_mesh(2))
+    imagenet_app.run(2, rounds=2, snapshot_every_rounds=1,
+                     snapshot_prefix=a_prefix,
+                     log_path=str(tmp_path / "a.log"), **common)
+    imagenet_app.run(2, rounds=2, snapshot_every_rounds=1,
+                     snapshot_prefix=b_prefix, resume=a_prefix + "_iter_1.npz",
+                     log_path=str(tmp_path / "b.log"), **common)
+    da = np.load(a_prefix + "_iter_2.npz")
+    db = np.load(b_prefix + "_iter_2.npz")
+    for k in da.files:
+        np.testing.assert_allclose(da[k], db[k], rtol=1e-6, atol=1e-7,
+                                   err_msg=k)

@@ -1,0 +1,213 @@
+"""LMDB on-disk format + Datum codec tests
+(reference: caffe/src/caffe/util/db_lmdb.cpp:20-86, caffe.proto Datum)."""
+
+import os
+import struct
+
+import numpy as np
+import pytest
+
+from sparknet_tpu.data.lmdb_io import (DEFAULT_PSIZE, LMDBReader, LMDBWriter,
+                                       MDB_MAGIC, P_BRANCH, P_LEAF, P_META,
+                                       P_OVERFLOW, PAGEHDRSZ,
+                                       convert_lmdb_to_store, parse_datum,
+                                       read_datum_db, serialize_datum,
+                                       write_datum_lmdb)
+
+
+def _write(tmp_path, items, name="db"):
+    p = str(tmp_path / name)
+    w = LMDBWriter(p)
+    for k, v in items:
+        w.put(k, v)
+    w.commit()
+    return p
+
+
+def test_roundtrip_small_values(tmp_path):
+    items = [(f"k{i:03d}".encode(), f"value-{i}".encode())
+             for i in range(10)]
+    p = _write(tmp_path, items)
+    got = list(LMDBReader(p).items())
+    assert got == sorted(items)
+    assert len(LMDBReader(p)) == 10
+
+
+def test_unsorted_input_is_sorted_by_key(tmp_path):
+    items = [(b"zz", b"1"), (b"aa", b"2"), (b"mm", b"3")]
+    p = _write(tmp_path, items)
+    assert [k for k, _ in LMDBReader(p).items()] == [b"aa", b"mm", b"zz"]
+
+
+def test_overflow_values(tmp_path):
+    """Values larger than half a page spill to overflow pages (F_BIGDATA),
+    the layout Caffe image datums (3x32x32 = 3073+ bytes) always hit."""
+    rng = np.random.RandomState(0)
+    big = rng.randint(0, 256, size=20000).astype(np.uint8).tobytes()
+    small = b"tiny"
+    p = _write(tmp_path, [(b"big", big), (b"small", small)])
+    got = dict(LMDBReader(p).items())
+    assert got[b"big"] == big
+    assert got[b"small"] == small
+
+
+def test_multipage_tree(tmp_path):
+    """Enough entries to force leaf splits and a branch level."""
+    items = [(f"{i:08d}".encode(), (f"payload-{i}-" * 20).encode())
+             for i in range(500)]
+    p = _write(tmp_path, items)
+    r = LMDBReader(p)
+    got = list(r.items())
+    assert len(got) == 500
+    assert got == items  # already sorted by the zero-padded keys
+    assert r.meta["depth"] >= 2
+
+
+def test_on_disk_layout_invariants(tmp_path):
+    """Structural checks at fixed offsets, independent of the reader's
+    traversal logic: meta magic/version, page flags, psize recording —
+    the format contract a liblmdb build would check on open (mdb.c
+    mdb_env_read_header)."""
+    items = [(f"{i:04d}".encode(), b"x" * 100) for i in range(50)]
+    p = _write(tmp_path, items)
+    buf = open(os.path.join(p, "data.mdb"), "rb").read()
+    assert len(buf) % DEFAULT_PSIZE == 0
+    for off in (0, DEFAULT_PSIZE):
+        assert struct.unpack_from("<H", buf, off + 10)[0] & P_META
+        magic, version = struct.unpack_from("<II", buf, off + PAGEHDRSZ)
+        assert magic == MDB_MAGIC and version == 1
+        # mm_dbs[0].md_pad records the page size
+        assert struct.unpack_from("<I", buf, off + PAGEHDRSZ + 24)[0] \
+            == DEFAULT_PSIZE
+    # txnid of meta 0 newer than meta 1
+    t0 = struct.unpack_from("<Q", buf, PAGEHDRSZ + 128)[0]
+    t1 = struct.unpack_from("<Q", buf, DEFAULT_PSIZE + PAGEHDRSZ + 128)[0]
+    assert t0 > t1
+    # every non-meta page carries a known flag and its own page number
+    for pgno in range(2, len(buf) // DEFAULT_PSIZE):
+        off = pgno * DEFAULT_PSIZE
+        flags = struct.unpack_from("<H", buf, off + 10)[0]
+        if flags == 0:
+            continue  # overflow continuation (raw data)
+        assert flags & (P_LEAF | P_BRANCH | P_OVERFLOW)
+        if flags & (P_LEAF | P_BRANCH | P_OVERFLOW):
+            assert struct.unpack_from("<Q", buf, off)[0] == pgno
+
+
+def test_empty_db(tmp_path):
+    p = _write(tmp_path, [])
+    assert list(LMDBReader(p).items()) == []
+    assert len(LMDBReader(p)) == 0
+
+
+def test_datum_codec_roundtrip():
+    rng = np.random.RandomState(1)
+    img = rng.randint(0, 256, size=(3, 32, 32)).astype(np.uint8)
+    buf = serialize_datum(img, 7)
+    d = parse_datum(buf)
+    assert d["label"] == 7
+    assert (d["channels"], d["height"], d["width"]) == (3, 32, 32)
+    np.testing.assert_array_equal(d["image"], img)
+
+
+def test_datum_float_data():
+    """float_data datums (extract_features output layout)."""
+    from sparknet_tpu.proto.binaryproto import _write_varint
+
+    vals = np.arange(12, dtype=np.float32)
+    out = bytearray()
+    for field, v in ((1, 3), (2, 2), (3, 2), (5, 4)):
+        _write_varint(out, field << 3)
+        _write_varint(out, v)
+    packed = vals.tobytes()
+    _write_varint(out, (6 << 3) | 2)
+    _write_varint(out, len(packed))
+    out += packed
+    d = parse_datum(bytes(out))
+    assert d["label"] == 4
+    np.testing.assert_allclose(d["image"],
+                               vals.reshape(3, 2, 2))
+
+
+def test_datum_db_to_store_migration(tmp_path):
+    """A reference-layout Datum LMDB ingests into ArrayStore and feeds the
+    DB apps (VERDICT r1 item 6's done-bar)."""
+    from sparknet_tpu.data.store import ArrayStoreCursor
+
+    rng = np.random.RandomState(2)
+    imgs = rng.randint(0, 256, size=(30, 3, 32, 32)).astype(np.uint8)
+    labels = rng.randint(0, 10, size=30)
+    db = str(tmp_path / "cifar_lmdb")
+    n = write_datum_lmdb(db, ((imgs[i], int(labels[i])) for i in range(30)))
+    assert n == 30
+
+    back = list(read_datum_db(db))
+    assert len(back) == 30
+    np.testing.assert_array_equal(back[0][0], imgs[0])
+    assert [l for _, l in back] == [int(x) for x in labels]
+
+    store = str(tmp_path / "store")
+    assert convert_lmdb_to_store(db, store) == 30
+    cur = ArrayStoreCursor(store)
+    assert len(cur) == 30
+    img0, l0 = cur.next()
+    np.testing.assert_array_equal(img0, imgs[0])
+    assert l0 == int(labels[0])
+
+
+def test_convert_db_cli_verbs(tmp_path):
+    """The convert_db tool round-trips store <-> lmdb both directions."""
+    from sparknet_tpu.cli import main as cli_main
+    from sparknet_tpu.data.store import ArrayStoreWriter
+
+    rng = np.random.RandomState(3)
+    imgs = rng.randint(0, 256, size=(12, 3, 8, 8)).astype(np.uint8)
+    store = str(tmp_path / "store")
+    w = ArrayStoreWriter(store)
+    for i in range(12):
+        w.put(imgs[i], i % 5)
+    w.close()
+
+    db = str(tmp_path / "as_lmdb")
+    assert cli_main(["convert_db", "store-to-lmdb", store, db]) == 0
+    store2 = str(tmp_path / "store2")
+    assert cli_main(["convert_db", "lmdb-to-store", db, store2]) == 0
+    from sparknet_tpu.data.store import ArrayStoreCursor
+
+    cur = ArrayStoreCursor(store2)
+    assert len(cur) == 12
+    img0, l0 = cur.next()
+    np.testing.assert_array_equal(img0, imgs[0])
+
+
+def test_convert_rejects_mixed_shapes_and_floats(tmp_path):
+    """Mixed-size and float_data DBs fail loudly instead of corrupting the
+    store (uint8 truncation) or crashing deep in a batch stack."""
+    from sparknet_tpu.proto.binaryproto import _write_varint
+
+    rng = np.random.RandomState(5)
+    w = LMDBWriter(str(tmp_path / "mixed"))
+    w.put(b"00", serialize_datum(
+        rng.randint(0, 256, size=(3, 8, 8)).astype(np.uint8), 0))
+    w.put(b"01", serialize_datum(
+        rng.randint(0, 256, size=(3, 16, 16)).astype(np.uint8), 1))
+    w.commit()
+    with pytest.raises(ValueError, match="mixed shapes"):
+        convert_lmdb_to_store(str(tmp_path / "mixed"),
+                              str(tmp_path / "out"))
+
+    vals = np.linspace(0, 1, 12, dtype=np.float32)
+    out = bytearray()
+    for field, v in ((1, 3), (2, 2), (3, 2), (5, 1)):
+        _write_varint(out, field << 3)
+        _write_varint(out, v)
+    packed = vals.tobytes()
+    _write_varint(out, (6 << 3) | 2)
+    _write_varint(out, len(packed))
+    out += packed
+    w2 = LMDBWriter(str(tmp_path / "floats"))
+    w2.put(b"00", bytes(out))
+    w2.commit()
+    with pytest.raises(ValueError, match="float_data"):
+        convert_lmdb_to_store(str(tmp_path / "floats"),
+                              str(tmp_path / "out2"))

@@ -200,6 +200,38 @@ layer { name: "loss"''').replace(
     assert np.isfinite(d.run_round())
 
 
+def test_mid_schedule_eval_uses_replica_mean():
+    """test() between DCN rounds must evaluate the replica MEAN (the
+    reference's average-then-test, CifarApp.scala:97-116), not worker 0 —
+    under dcn_interval=2 the slices have diverged after round 0."""
+    hier = DistributedSolver(_solver(), mesh=make_hierarchical_mesh(2),
+                             tau=2, dcn_interval=2)
+    hier.set_train_data(_sources(8))
+    hier.run_round()  # ICI-only average: slices diverged
+    a, b = _row_worker(hier, 0, 0), _row_worker(hier, 1, 0)
+    assert any(not np.allclose(a[k], b[k]) for k in a)
+
+    rng = np.random.RandomState(99)
+    fixed = {"data": rng.rand(4, 1, 5, 5).astype(np.float32),
+             "label": rng.randint(0, 3, (4,)).astype(np.int32)}
+    hier.set_test_data(lambda: fixed, 1)
+    got = hier.test()["loss"]
+
+    mean_params = {k: jnp.asarray(np.mean(np.asarray(v), axis=0))
+                   for k, v in hier.params_w.items()}
+    blobs, _ = hier.test_net.apply(
+        mean_params, {k: jnp.asarray(v) for k, v in fixed.items()},
+        train=False)
+    expect = float(blobs["loss"])
+    np.testing.assert_allclose(got, expect, rtol=1e-5)
+
+    # worker-0-only eval would be WRONG here: prove it differs
+    blobs0, _ = hier.test_net.apply(
+        {k: jnp.asarray(v) for k, v in a.items()},
+        {k: jnp.asarray(v) for k, v in fixed.items()}, train=False)
+    assert abs(float(blobs0["loss"]) - expect) > 1e-9
+
+
 def test_dcn_interval_requires_dcn_mesh():
     with pytest.raises(AssertionError):
         DistributedSolver(_solver(), mesh=make_mesh(8), dcn_interval=2)
@@ -214,3 +246,40 @@ def test_cifar_app_hierarchical_mesh(tmp_path):
                         batch_size=16, tau=2,
                         log_path=str(tmp_path / "log.txt"))
     assert 0.0 <= acc <= 1.0
+
+
+def test_hierarchical_snapshot_on_non_dcn_round_resumes_exactly():
+    """A snapshot taken between DCN rounds (slices diverged) must capture
+    the per-worker params so resume reproduces the uninterrupted run —
+    not slice-0 weights broadcast everywhere."""
+    import os
+    import tempfile
+
+    def fresh():
+        s = DistributedSolver(_solver(), mesh=make_hierarchical_mesh(2),
+                              tau=2, dcn_interval=2)
+        s.set_train_data(_sources(8))
+        return s
+
+    a = fresh()
+    a.run_round()  # round 0: ICI-only average — slices diverged
+    with tempfile.TemporaryDirectory() as d:
+        snap = a.snapshot(os.path.join(d, "mid.npz"))
+        pa1_mid = _row_worker(a, 1, 0)  # slice-1 replica AT snapshot time
+        a.run_round()  # round 1 crosses DCN
+
+        b = fresh()
+        b.run_round()  # align the data stream
+        b.restore(snap)
+        assert b.round == 1
+        # diverged params restored per worker, not broadcast: slice-1's
+        # replica in b matches a's at snapshot time (differs from slice-0's)
+        pb1 = _row_worker(b, 1, 0)
+        for k in pa1_mid:
+            np.testing.assert_allclose(pa1_mid[k], pb1[k], rtol=1e-6,
+                                       atol=1e-7, err_msg=k)
+        b.run_round()
+        pa, pb = _p0(a), _p0(b)
+        for k in pa:
+            np.testing.assert_allclose(pa[k], pb[k], rtol=1e-6, atol=1e-7,
+                                       err_msg=k)
