@@ -128,7 +128,17 @@ def run(num_workers: int, *, model: str = "quick", rounds: int = 100,
         target_accuracy: Optional[float] = None,
         batch_size: int = TRAIN_BATCH_SIZE, tau: int = SYNC_INTERVAL,
         dcn_interval: int = 1, snapshot_every_rounds: int = 0,
-        snapshot_prefix: str = "", resume: str = "") -> float:
+        snapshot_prefix: str = "", resume: str = "",
+        native_feed: Optional[bool] = None) -> float:
+    """native_feed: stream worker shards through the C++ prefetcher
+    (reader+transform threads + one-round-ahead staging) instead of the
+    Python windowed sampler.  Default (None): on for real CIFAR data — the
+    hot path the reference runs through its prefetching data layer
+    (base_data_layer.cpp:70-98) — off for synthetic, which keeps the
+    MinibatchSampler flow-parity semantics AND the exact kill-and-resume
+    replay (the native reader threads make batch order scheduling-
+    dependent, so resume with native_feed continues the stream but is not
+    bit-exact)."""
     args = argparse.Namespace(data=data_dir, synthetic=synthetic)
     log = PhaseLogger(log_path or
                       f"/tmp/training_log_{int(time.time())}.txt")
@@ -141,9 +151,25 @@ def run(num_workers: int, *, model: str = "quick", rounds: int = 100,
                           batch_size=batch_size, dcn_interval=dcn_interval)
     log("built solver")
 
-    feeds = [WorkerFeed(x, y, mean, batch_size, tau, seed=w)
-             for w, (x, y) in enumerate(shards)]
-    solver.set_train_data(feeds)
+    if native_feed is None:
+        native_feed = not (synthetic or not os.path.isdir(data_dir))
+    shard_dir = None
+    if native_feed:
+        import tempfile
+
+        from ..data.native_loader import native_feeds_from_arrays
+
+        shard_dir = tempfile.mkdtemp(prefix="sparknet_shards_")
+        feeds = native_feeds_from_arrays(shards, mean=mean,
+                                         batch=batch_size, seed0=1,
+                                         out_dir=shard_dir)
+        solver.set_train_data(feeds)
+        solver.set_prefetch(True)  # stream feeds: stage N+1 during N
+        log("native prefetcher feeds enabled")
+    else:
+        feeds = [WorkerFeed(x, y, mean, batch_size, tau, seed=w)
+                 for w, (x, y) in enumerate(shards)]
+        solver.set_train_data(feeds)
 
     test_batches = part.make_minibatches(xte, yte, batch_size)
     num_test = len(test_batches)
@@ -160,30 +186,43 @@ def run(num_workers: int, *, model: str = "quick", rounds: int = 100,
     check_snapshot_args(snapshot_every_rounds, snapshot_prefix)
     start_round = 0
     if resume:
-        start_round = resume_and_replay(solver, resume, feeds, log,
-                                        per_round=lambda f: f.new_round())
+        start_round = resume_and_replay(
+            solver, resume, feeds, log,
+            per_round=(None if native_feed
+                       else (lambda f: f.new_round())))
 
     accuracy = 0.0
-    for r in range(start_round, rounds):
-        for f in feeds:
-            f.new_round()
-        if r % TEST_EVERY_ROUNDS == 0:
-            log("starting testing", i=r)
-            scores = solver.test()
-            accuracy = scores.get("accuracy", scores.get("acc", 0.0))
-            log(f"%-age of test set correct: {accuracy}", i=r)
-            if target_accuracy and accuracy >= target_accuracy:
-                log(f"target accuracy {target_accuracy} reached", i=r)
-                return accuracy
-        log("starting training", i=r)
-        loss = solver.run_round()
-        log(f"round loss = {loss}", i=r)
-        maybe_snapshot_round(solver, log, r, snapshot_every_rounds,
-                             snapshot_prefix)
-    scores = solver.test()
-    accuracy = scores.get("accuracy", scores.get("acc", 0.0))
-    log(f"final %-age of test set correct: {accuracy}")
-    return accuracy
+    try:
+        for r in range(start_round, rounds):
+            if not native_feed:
+                for f in feeds:
+                    f.new_round()
+            if r % TEST_EVERY_ROUNDS == 0:
+                log("starting testing", i=r)
+                scores = solver.test()
+                accuracy = scores.get("accuracy", scores.get("acc", 0.0))
+                log(f"%-age of test set correct: {accuracy}", i=r)
+                if target_accuracy and accuracy >= target_accuracy:
+                    log(f"target accuracy {target_accuracy} reached", i=r)
+                    return accuracy
+            log("starting training", i=r)
+            loss = solver.run_round(prefetch_next=r < rounds - 1)
+            log(f"round loss = {loss}", i=r)
+            maybe_snapshot_round(solver, log, r, snapshot_every_rounds,
+                                 snapshot_prefix)
+        scores = solver.test()
+        accuracy = scores.get("accuracy", scores.get("acc", 0.0))
+        log(f"final %-age of test set correct: {accuracy}")
+        return accuracy
+    finally:
+        if native_feed:
+            for f in feeds:
+                if hasattr(f, "close"):
+                    f.close()
+            if shard_dir:
+                import shutil
+
+                shutil.rmtree(shard_dir, ignore_errors=True)
 
 
 def main() -> None:
@@ -193,6 +232,12 @@ def main() -> None:
     p.add_argument("--model", default="quick", choices=["quick", "full"])
     p.add_argument("--rounds", type=int, default=100)
     p.add_argument("--synthetic", action="store_true")
+    p.add_argument("--native-feed", dest="native_feed", action="store_true",
+                   default=None,
+                   help="stream shards through the C++ prefetcher "
+                        "(default: on for real data)")
+    p.add_argument("--no-native-feed", dest="native_feed",
+                   action="store_false")
     from ..utils.compile_cache import (apply_platform_env,
                                       maybe_enable_compile_cache)
     from .common import (add_distributed_args, add_snapshot_args,
@@ -209,7 +254,8 @@ def main() -> None:
         synthetic=a.synthetic, mesh=mesh, dcn_interval=a.dcn_interval,
         batch_size=a.batch, tau=a.tau,
         snapshot_every_rounds=a.snapshot_every_rounds,
-        snapshot_prefix=a.snapshot_prefix, resume=a.resume)
+        snapshot_prefix=a.snapshot_prefix, resume=a.resume,
+        native_feed=a.native_feed)
 
 
 if __name__ == "__main__":

@@ -55,9 +55,13 @@ def create_from_tars(shards_dir: str, label_file: str, out: str,
 def run_from_store(num_workers: int, store: str, *, model: str = "quick",
                    rounds: int = 50, batch_size: int = 100, tau: int = 10,
                    warm_start: Optional[str] = None, mesh=None,
-                   log_path: Optional[str] = None) -> float:
+                   log_path: Optional[str] = None,
+                   native_feed: bool = False) -> float:
     """Train from a store (reference: ImageNetRunDBApp.scala — DB-fed
-    training with optional .caffemodel warm start at :75)."""
+    training with optional .caffemodel warm start at :75).  native_feed
+    streams each worker's partition through the C++ prefetcher (labels
+    must fit one byte); either way round N+1 is staged while round N
+    computes (set_prefetch)."""
     log = PhaseLogger(log_path)
     solver = cifar_app.build_solver(model, num_workers, tau,
                                     batch_size=batch_size, mesh=mesh)
@@ -71,26 +75,55 @@ def run_from_store(num_workers: int, store: str, *, model: str = "quick",
         weights = solver.net.get_weights(flat)
         solver.set_weights(weights)
         log("warm-started from " + warm_start)
-    cursors = [ArrayStoreCursor(store) for _ in range(num_workers)]
-    # stagger cursors so workers see different data (partition analogue)
-    for w, c in enumerate(cursors):
-        skip = (len(c) // num_workers) * w
-        for _ in range(skip):
-            c.next()
-    feeds = []
-    for c in cursors:
-        it = c.batches(batch_size)
+    tmp_dir = None
+    if native_feed:
+        import tempfile
 
-        def feed(it=it):
-            b = next(it)
-            return {"data": b["data"].astype(np.float32), "label": b["label"]}
+        from ..data.native_loader import (NativeRecordLoader,
+                                          export_shard_record_files)
 
-        feeds.append(feed)
+        cur = ArrayStoreCursor(store)
+        c, h, wd = cur.datum_shape
+        tmp_dir = tempfile.mkdtemp(prefix="sparknet_dbshards_")
+        # O(one record) streaming export — the store may be ImageNet-scale
+        paths = export_shard_record_files(
+            (cur.next() for _ in range(len(cur))), num_workers, tmp_dir)
+        feeds = [NativeRecordLoader([p], channels=c, height=h, width=wd,
+                                    batch=batch_size, seed=1 + w)
+                 for w, p in enumerate(paths)]
+        log("native prefetcher feeds enabled")
+    else:
+        cursors = [ArrayStoreCursor(store) for _ in range(num_workers)]
+        # stagger cursors so workers see different data (partition analogue)
+        for w, c in enumerate(cursors):
+            skip = (len(c) // num_workers) * w
+            for _ in range(skip):
+                c.next()
+        feeds = []
+        for c in cursors:
+            it = c.batches(batch_size)
+
+            def feed(it=it):
+                b = next(it)
+                return {"data": b["data"].astype(np.float32),
+                        "label": b["label"]}
+
+            feeds.append(feed)
     solver.set_train_data(feeds)
+    solver.set_prefetch(True)  # stream feeds: stage round N+1 during N
     loss = 0.0
-    for r in range(rounds):
-        loss = solver.run_round()
-        log(f"round loss = {loss}", i=r)
+    try:
+        for r in range(rounds):
+            loss = solver.run_round(prefetch_next=r < rounds - 1)
+            log(f"round loss = {loss}", i=r)
+    finally:
+        for f in feeds:
+            if hasattr(f, "close"):
+                f.close()
+        if tmp_dir:
+            import shutil
+
+            shutil.rmtree(tmp_dir, ignore_errors=True)
     return loss
 
 
@@ -108,6 +141,8 @@ def main() -> None:
     r.add_argument("--model", default="quick")
     r.add_argument("--rounds", type=int, default=50)
     r.add_argument("--warm-start")
+    r.add_argument("--native-feed", action="store_true",
+                   help="stream partitions through the C++ prefetcher")
     a = p.parse_args()
     if a.verb == "create":
         if a.cifar:
@@ -117,7 +152,8 @@ def main() -> None:
         print(f"wrote {n} records to {a.out}")
     else:
         loss = run_from_store(a.num_workers, a.store, model=a.model,
-                              rounds=a.rounds, warm_start=a.warm_start)
+                              rounds=a.rounds, warm_start=a.warm_start,
+                              native_feed=a.native_feed)
         print(f"final loss {loss}")
 
 

@@ -56,6 +56,60 @@ def get_library() -> ctypes.CDLL:
         return lib
 
 
+def export_shard_record_files(records, n_workers: int, out_dir: str,
+                              ) -> List[str]:
+    """Round-robin a (image CHW uint8, label) stream into n_workers
+    fixed-record files with O(one record) memory — the streaming export a
+    store-to-prefetcher handoff needs at ImageNet scale.  Labels must fit
+    the 1-byte record field."""
+    paths = [os.path.join(out_dir, f"shard_{w:03d}.bin")
+             for w in range(n_workers)]
+    handles = [open(p, "wb") for p in paths]
+    try:
+        for i, (img, label) in enumerate(records):
+            if not 0 <= int(label) <= 255:
+                raise ValueError("record labels are 1 byte; use the Python "
+                                 "feed for >256-class data")
+            h = handles[i % n_workers]
+            h.write(bytes([int(label)]))
+            h.write(np.ascontiguousarray(img, dtype=np.uint8).tobytes())
+    finally:
+        for h in handles:
+            h.close()
+    return paths
+
+
+def native_feeds_from_arrays(shards, *, mean=None, batch: int,
+                             out_dir: Optional[str] = None,
+                             crop: int = 0, mirror: bool = False,
+                             train: bool = True, scale: float = 1.0,
+                             num_threads: int = 2, seed0: int = 0
+                             ) -> List["NativeRecordLoader"]:
+    """Materialize per-worker (images, labels) shards as fixed-record files
+    and stream them back through the native prefetcher — putting the C++
+    reader+transform threads in the training hot path (the integration the
+    reference has at base_data_layer.cpp:70-98, where prefetch feeds the
+    solver loop directly).  Labels must fit the 1-byte record field."""
+    import tempfile
+
+    from .cifar import write_batch_file
+
+    out_dir = out_dir or tempfile.mkdtemp(prefix="sparknet_shards_")
+    feeds = []
+    for w, (x, y) in enumerate(shards):
+        if int(np.max(y)) > 255:
+            raise ValueError("record labels are 1 byte; use the Python "
+                             "feed for >256-class data")
+        path = os.path.join(out_dir, f"shard_{w:03d}.bin")
+        write_batch_file(path, x, y)
+        feeds.append(NativeRecordLoader(
+            [path], channels=int(x.shape[1]), height=int(x.shape[2]),
+            width=int(x.shape[3]), batch=batch, crop=crop, mirror=mirror,
+            train=train, mean=mean, scale=scale, num_threads=num_threads,
+            seed=seed0 + w))
+    return feeds
+
+
 class NativeRecordLoader:
     """Prefetching loader over fixed-record binary files (CIFAR layout:
     1 label byte + C*H*W image bytes).  Usable directly as a Solver

@@ -39,9 +39,12 @@ def softmax_with_loss(scores: jax.Array, labels: jax.Array, *, axis: int = 1,
     """reference: softmax_loss_layer.cpp:55-83 (forward), :85-118 (normalizer:
     non-ignored count when normalize else outer_num)."""
     s3, l2, outer, inner, c = _flatten_outer_inner(scores, labels, axis)
-    # loss math in fp32 even under bf16 mixed precision (log_softmax over
-    # 1000 classes loses too much in bf16)
-    logp = jax.nn.log_softmax(s3.astype(jnp.float32), axis=1)
+    # loss math in >= fp32: under bf16 mixed precision log_softmax over 1000
+    # classes loses too much, so upcast — but never DOWNcast (the float64
+    # validation harness runs the whole step at f64)
+    if s3.dtype not in (jnp.float32, jnp.float64):
+        s3 = s3.astype(jnp.float32)
+    logp = jax.nn.log_softmax(s3, axis=1)
     picked = jnp.take_along_axis(logp, l2[:, None, :], axis=1)[:, 0, :]
     if ignore_label is not None:
         valid = (l2 != ignore_label)

@@ -7,35 +7,39 @@ from __future__ import annotations
 
 from typing import Sequence
 
+import jax
 import jax.numpy as jnp
 
 from ..proto.caffe_pb import SolverParameter
 
 
+def _f(x):
+    """Canonical float scalar: float32 normally, float64 under
+    jax_enable_x64 (the float64 validation harness, validation.py)."""
+    return jnp.asarray(x, dtype=jax.dtypes.canonicalize_dtype(jnp.float64))
+
+
 def learning_rate(sp: SolverParameter, it) -> jnp.ndarray:
     """Current LR for iteration `it` under sp.lr_policy."""
     policy = str(sp.lr_policy)
-    base = jnp.float32(sp.base_lr)
-    it = jnp.asarray(it, dtype=jnp.float32)
+    base = _f(sp.base_lr)
+    it = _f(it)
     if policy == "fixed":
         return base
     if policy == "step":
         cur = jnp.floor(it / float(sp.stepsize))
-        return base * jnp.power(jnp.float32(sp.gamma), cur)
+        return base * jnp.power(_f(sp.gamma), cur)
     if policy == "exp":
-        return base * jnp.power(jnp.float32(sp.gamma), it)
+        return base * jnp.power(_f(sp.gamma), it)
     if policy == "inv":
-        return base * jnp.power(1.0 + jnp.float32(sp.gamma) * it,
-                                -jnp.float32(sp.power))
+        return base * jnp.power(1.0 + _f(sp.gamma) * it, -_f(sp.power))
     if policy == "multistep":
-        steps = jnp.asarray(list(sp.stepvalues) or [0], dtype=jnp.float32)
-        cur = jnp.sum(it >= steps) if sp.stepvalues else jnp.float32(0)
-        return base * jnp.power(jnp.float32(sp.gamma),
-                                cur.astype(jnp.float32))
+        steps = _f(list(sp.stepvalues) or [0])
+        cur = jnp.sum(it >= steps) if sp.stepvalues else _f(0)
+        return base * jnp.power(_f(sp.gamma), _f(cur))
     if policy == "poly":
-        return base * jnp.power(1.0 - it / float(sp.max_iter),
-                                jnp.float32(sp.power))
+        return base * jnp.power(1.0 - it / float(sp.max_iter), _f(sp.power))
     if policy == "sigmoid":
-        return base / (1.0 + jnp.exp(-jnp.float32(sp.gamma) *
+        return base / (1.0 + jnp.exp(-_f(sp.gamma) *
                                      (it - float(sp.stepsize))))
     raise ValueError(f"unknown lr_policy {policy!r}")

@@ -78,7 +78,8 @@ def make_loss_fn(net: Net, precision: str):
         blobs, stats = net.apply(params, inputs, rng, train=True)
         if half:
             stats = _cast_tree(stats, jnp.float32)
-        return blobs["loss"].astype(jnp.float32), stats
+            return blobs["loss"].astype(jnp.float32), stats
+        return blobs["loss"], stats
 
     return loss_fn
 
@@ -208,7 +209,7 @@ class Solver:
                 return (acc, stats, i + 1), None
 
             zero = ({k: jnp.zeros_like(v) for k, v in params.items()},
-                    jnp.float32(0.0))
+                    jnp.zeros((), jax.dtypes.canonicalize_dtype(jnp.float64)))
             (acc, stats, _), _ = jax.lax.scan(
                 sub, (zero, {}, 0), stacked_inputs)
             if not isinstance(stats, dict):

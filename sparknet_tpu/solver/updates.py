@@ -106,8 +106,10 @@ def apply_update(solver_type: str, params: Params, grads: Grads, state: State,
             new_p[k] = w - lr * upd
             new_s[k] = (g2h, u2h)
         elif solver_type == "Adam":
-            # (adam_solver.cpp:20-50); t = iter+1
-            t = jnp.asarray(it, jnp.float32) + 1.0
+            # (adam_solver.cpp:20-50); t = iter+1.  Canonical float dtype:
+            # f32 normally, f64 under the x64 validation harness
+            t = jnp.asarray(
+                it, jax.dtypes.canonicalize_dtype(jnp.float64)) + 1.0
             m = momentum * h[0] + (1.0 - momentum) * g
             v = momentum2 * h[1] + (1.0 - momentum2) * jnp.square(g)
             corr = jnp.sqrt(1.0 - jnp.power(momentum2, t)) / \

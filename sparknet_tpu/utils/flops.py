@@ -1,0 +1,83 @@
+"""Analytic FLOPs accounting for MFU reporting.
+
+The reference reports throughput only (img/s, performance_hardware.md);
+on TPU the honest companion number is model FLOPs utilization — achieved
+FLOPs/s over the chip's peak — which exposes whether "fast" is the hardware
+or the software.  Counts multiply-accumulates in the compute-bearing layers
+(convolution im2col-GEMM and the fully-connected GEMMs carry essentially
+all FLOPs in the bundled model zoo) from the net's inferred blob shapes.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+# bf16 peak FLOPs/s per chip by device kind (public spec sheets)
+PEAK_FLOPS = {
+    "TPU v5 lite": 197e12,   # v5e: 197 bf16 TFLOPs/chip
+    "TPU v5e": 197e12,
+    "TPU v5": 459e12,        # v5p
+    "TPU v4": 275e12,
+    "TPU v3": 123e12,
+    "TPU v2": 45e12,
+    "cpu": 1e11,             # nominal, for smoke runs only
+}
+
+
+def peak_flops(device) -> float:
+    kind = getattr(device, "device_kind", "cpu")
+    for key, val in PEAK_FLOPS.items():
+        if key.lower() in str(kind).lower():
+            return val
+    return PEAK_FLOPS["cpu"]
+
+
+def forward_macs(net) -> Dict[str, int]:
+    """Per-layer forward multiply-accumulates from inferred shapes."""
+    by_name = {l.name: l for l in net.net_param.layers}
+    out: Dict[str, int] = {}
+    for bl in net.layers:
+        lp = by_name.get(bl.name)
+        if lp is None:
+            continue
+        ltype = bl.type
+        macs = 0
+        if ltype in ("Convolution", "Deconvolution"):
+            cp = lp.convolution_param
+            group = int(cp.group)
+            if ltype == "Convolution":
+                # N*K*OH*OW output points x (C/g)*R*S MACs each
+                n, k, oh, ow = net.blob_shapes[bl.tops[0]]
+                c = net.blob_shapes[bl.bottoms[0]][1]
+            else:
+                n, c, oh, ow = net.blob_shapes[bl.bottoms[0]]
+                k = net.blob_shapes[bl.tops[0]][1]
+                # deconv: same GEMM transposed; count on the input grid
+                oh, ow = net.blob_shapes[bl.bottoms[0]][2:]
+            kern = cp.kernel
+            r = int(kern[0])
+            s = int(kern[1] if len(kern) > 1 else kern[0])
+            macs = n * k * oh * ow * (c // group) * r * s
+        elif ltype == "InnerProduct":
+            top = net.blob_shapes[bl.tops[0]]
+            bottom = net.blob_shapes[bl.bottoms[0]]
+            n = bottom[0]
+            fan_in = 1
+            for d in bottom[1:]:
+                fan_in *= int(d)
+            macs = n * fan_in * int(top[-1])
+        elif ltype == "Attention":
+            n, t = net.blob_shapes[bl.bottoms[0]][:2]
+            d = net.blob_shapes[bl.bottoms[0]][-1]
+            # qkv+out projections + 2 attention matmuls
+            macs = n * (4 * t * d * d + 2 * t * t * d)
+        if macs:
+            out[bl.name] = int(macs)
+    return out
+
+
+def training_flops_per_iter(net) -> float:
+    """FLOPs for one forward+backward+update iteration: 2 FLOPs/MAC, and
+    backward recomputes both the input- and weight-gradient GEMMs (the
+    standard 3x forward-cost estimate for conv nets)."""
+    return 3.0 * 2.0 * sum(forward_macs(net).values())

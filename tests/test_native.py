@@ -123,3 +123,112 @@ def test_feeds_solver(record_file):
         assert np.isfinite(loss)
     finally:
         loader.close()
+
+
+def test_native_feeds_from_arrays_matches_python_transform(tmp_path):
+    """The shard-file + native-loader path produces the same pixel math as
+    the Python transformer: (pixel - mean) * scale."""
+    from sparknet_tpu.data.native_loader import native_feeds_from_arrays
+
+    rng = np.random.RandomState(0)
+    x = rng.randint(0, 256, size=(8, 3, 6, 6)).astype(np.uint8)
+    y = np.arange(8)  # unique labels so records can be matched after reorder
+    mean = rng.rand(3, 6, 6).astype(np.float32) * 100
+    feeds = native_feeds_from_arrays([(x, y)], mean=mean, batch=8,
+                                     out_dir=str(tmp_path), scale=0.5,
+                                     train=False, num_threads=1, seed0=0)
+    b = feeds[0]()
+    assert b["data"].shape == (8, 3, 6, 6)
+    assert sorted(b["label"].tolist()) == sorted(y.tolist())
+    # find each record by label and compare pixel math (test mode may
+    # still reorder vs input through the reader queue)
+    for i in range(8):
+        j = int(np.where(b["label"] == y[i])[0][0])
+        np.testing.assert_allclose(
+            b["data"][j], (x[i].astype(np.float32) - mean) * 0.5,
+            rtol=1e-5, atol=1e-4)
+    feeds[0].close()
+
+
+def test_native_feeds_reject_wide_labels(tmp_path):
+    from sparknet_tpu.data.native_loader import native_feeds_from_arrays
+
+    x = np.zeros((4, 3, 4, 4), dtype=np.uint8)
+    y = np.asarray([0, 1, 2, 999])
+    with pytest.raises(ValueError, match="1 byte"):
+        native_feeds_from_arrays([(x, y)], batch=4, out_dir=str(tmp_path))
+
+
+def test_run_round_prefetch_stages_next_round():
+    """set_prefetch(True): when run_round returns, round N+1's batches are
+    already staged (pulled AND device-transferred) — the app-level
+    double-buffer contract (VERDICT r1 item 3; reference
+    base_data_layer.cpp:70-98)."""
+    from sparknet_tpu.parallel.dist import DistributedSolver
+    from sparknet_tpu.parallel.mesh import make_mesh
+    from sparknet_tpu.proto import caffe_pb
+    from sparknet_tpu.proto.textformat import parse
+
+    net_txt = """
+layer { name: "data" type: "MemoryData" top: "data" top: "label"
+  memory_data_param { batch_size: 4 channels: 1 height: 5 width: 5 } }
+layer { name: "ip" type: "InnerProduct" bottom: "data" top: "ip"
+  inner_product_param { num_output: 3
+    weight_filler { type: "gaussian" std: 0.1 } } }
+layer { name: "loss" type: "SoftmaxWithLoss" bottom: "ip" bottom: "label"
+  top: "loss" }
+"""
+    sp = caffe_pb.SolverParameter(parse(
+        'base_lr: 0.05\nlr_policy: "fixed"\nmomentum: 0.9\nrandom_seed: 3'))
+    sp.msg.set("net_param", caffe_pb.parse_net_text(net_txt).msg)
+
+    pulls = {"n": 0}
+
+    def make_sources(n):
+        out = []
+        for w in range(n):
+            rng = np.random.RandomState(w)
+
+            def src(rng=rng):
+                pulls["n"] += 1
+                return {"data": rng.rand(4, 1, 5, 5).astype(np.float32),
+                        "label": rng.randint(0, 3, (4,)).astype(np.int32)}
+            out.append(src)
+        return out
+
+    # prefetch on: after round 0 returns, round 1 is staged => 2 rounds of
+    # pulls consumed after ONE run_round
+    s = DistributedSolver(sp, mesh=make_mesh(4), tau=2)
+    s.set_train_data(make_sources(4))
+    s.set_prefetch(True)
+    s.run_round()
+    assert s._staged is not None
+    assert pulls["n"] == 2 * 4 * 2  # two rounds x 4 workers x tau=2
+
+    # numerical equivalence with the unprefetched path
+    a = DistributedSolver(sp, mesh=make_mesh(4), tau=2)
+    a.set_train_data(make_sources(4))
+    losses_a = [a.run_round() for _ in range(3)]
+    b = DistributedSolver(sp, mesh=make_mesh(4), tau=2)
+    b.set_train_data(make_sources(4))
+    b.set_prefetch(True)
+    losses_b = [b.run_round() for _ in range(3)]
+    np.testing.assert_allclose(losses_a, losses_b, rtol=1e-6)
+    for k, v in a.params_w.items():
+        np.testing.assert_allclose(np.asarray(v), np.asarray(b.params_w[k]),
+                                   rtol=1e-6, atol=1e-7, err_msg=k)
+
+
+def test_cifar_app_native_feed_end_to_end(tmp_path):
+    """CifarApp trains through the native prefetcher feed + round
+    double-buffering (the integrated hot path)."""
+    from sparknet_tpu.apps import cifar_app
+    from sparknet_tpu.parallel.mesh import make_mesh
+
+    acc = cifar_app.run(2, model="quick", rounds=2, synthetic=True,
+                        mesh=make_mesh(2), batch_size=8, tau=2,
+                        native_feed=True,
+                        log_path=str(tmp_path / "log.txt"))
+    assert 0.0 <= acc <= 1.0
+    assert "native prefetcher feeds enabled" in \
+        open(tmp_path / "log.txt").read()
