@@ -1,0 +1,102 @@
+"""Compiled-ablation profile of the GoogLeNet train step on TPU.
+
+Per-layer eager timing is useless over a remote-compile tunnel (every layer
+pays ~150 ms of RPC latency), so attribution is done by ablation: each
+variant is ONE jitted program measured with the bench chain protocol.
+Variants: drop aux-loss heads, neutralize LRN, swap LRN implementations
+(SPARKNET_LRN_IMPL), batch scaling."""
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from sparknet_tpu.core.net import Net
+from sparknet_tpu.proto import caffe_pb
+from sparknet_tpu.solver import updates
+from sparknet_tpu.solver.solver import make_single_step
+
+D = "/root/reference/caffe/models/bvlc_googlenet"
+
+
+def build_step(batch, drop_aux=False, lrn_impl=None, no_lrn=False):
+    if lrn_impl:
+        os.environ["SPARKNET_LRN_IMPL"] = lrn_impl
+    else:
+        os.environ.pop("SPARKNET_LRN_IMPL", None)
+    npm = caffe_pb.load_net_prototxt(D + "/train_val.prototxt")
+    if drop_aux or no_lrn:
+        keep = []
+        for l in npm.layers:
+            nm = str(l.name)
+            if drop_aux and (nm.startswith("loss1/") or nm.startswith("loss2/")):
+                continue
+            if no_lrn and l.type == "LRN":
+                l.msg.set("type", "Power")  # identity: attribution no-op
+            keep.append(l)
+        npm.msg.set_list("layer", [l.msg for l in keep])
+    net = Net(npm, "TRAIN", batch_override=batch)
+    sp = caffe_pb.load_solver_prototxt(D + "/solver.prototxt")
+    params = net.init_params(0)
+    state = updates.init_state(params, sp.resolved_type())
+    step = jax.jit(make_single_step(net, sp, precision="bfloat16"),
+                   donate_argnums=(0, 1))
+    return net, step, params, state
+
+
+def measure(batch, **kw):
+    net, step, params, state = build_step(batch, **kw)
+    rng = np.random.RandomState(0)
+    data = jnp.asarray(rng.rand(batch, 3, 224, 224).astype(np.float32))
+    label = jnp.asarray(rng.randint(0, 1000, (batch,)).astype(np.int32))
+    key = jax.random.PRNGKey(0)
+    it = [0]
+
+    def chain(n):
+        nonlocal params, state
+        t0 = time.perf_counter()
+        loss = None
+        for _ in range(n):
+            params, state, loss = step(
+                params, state, jnp.int32(it[0]),
+                {"data": data, "label": label},
+                jax.random.fold_in(key, it[0]))
+            it[0] += 1
+        float(loss)
+        return time.perf_counter() - t0
+
+    chain(3)
+    rates = []
+    for _ in range(3):
+        s = chain(2)
+        l = chain(12)
+        rates.append(10 * batch / (l - s))
+    return float(np.median(rates))
+
+
+def main():
+    for name, batch, kw in [
+        ("baseline_b64", 64, dict()),
+        ("no_aux_heads_b64", 64, dict(drop_aux=True)),
+        ("no_lrn_b64", 64, dict(no_lrn=True)),
+        ("lrn_pallas_b64", 64, dict(lrn_impl="pallas")),
+        ("lrn_matmul_b64", 64, dict(lrn_impl="matmul")),
+        ("baseline_b128", 128, dict()),
+        ("baseline_b256", 256, dict()),
+    ]:
+        try:
+            r = measure(batch, **kw)
+            print(json.dumps({"config": name,
+                              "imgs_per_sec": round(r, 1)}), flush=True)
+        except Exception as e:
+            print(json.dumps({"config": name, "error": str(e)[:200]}),
+                  flush=True)
+
+
+if __name__ == "__main__":
+    main()

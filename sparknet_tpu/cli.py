@@ -84,7 +84,17 @@ def cmd_train(args) -> int:
     handler = SignalHandler(parse_effect(args.sigint_effect),
                             parse_effect(args.sighup_effect)).install()
     solver.action_source = handler
-    source, _ = _load_arrays(args.data, args.batch or 100)
+    if args.data:
+        source, _ = _load_arrays(args.data, args.batch or 100)
+    else:
+        # self-feeding net: the data layers name their own sources
+        # (reference `caffe train` needs no data flag, tools/caffe.cpp:160)
+        from .data.feeds import make_net_feeds
+
+        source = make_net_feeds(solver.net_param, "TRAIN", seed=0)
+        if source is None:
+            raise SystemExit(
+                "net has no self-feeding data layer; pass --data")
     solver.set_train_data(source)
     n = args.iterations or int(sp.max_iter) or 100
     display = int(sp.display) or 50
@@ -136,11 +146,26 @@ def _train_distributed(args, sp, net) -> int:
         solver.restore(args.snapshot)
     handler = SignalHandler(parse_effect(args.sigint_effect),
                             parse_effect(args.sighup_effect)).install()
-    # one shared batch list; worker w starts count/n batches into the cycle
-    # (the RDD-partition analogue, without n copies of the dataset in RAM)
-    batches = _load_batch_list(args.data, args.batch or 100)
-    solver.set_train_data([_batch_source(batches, w * len(batches) // n)
-                           for w in range(n)])
+    if args.data:
+        # one shared batch list; worker w starts count/n batches into the
+        # cycle (the RDD-partition analogue, without n copies in RAM)
+        batches = _load_batch_list(args.data, args.batch or 100)
+        solver.set_train_data([_batch_source(batches,
+                                             w * len(batches) // n)
+                               for w in range(n)])
+    else:
+        # self-feeding net: ONE shared stream, workers pull disjoint
+        # consecutive batches — the reference's DataReader semantics (a
+        # single DB-reading thread feeding all solvers,
+        # data_reader.cpp:15-31).  _stage_round pulls worker by worker, so
+        # sharing the callable is race-free.
+        from .data.feeds import make_net_feeds
+
+        shared = make_net_feeds(solver.net.net_param, "TRAIN", seed=0)
+        if shared is None:
+            raise SystemExit(
+                "net has no self-feeding data layer; pass --data")
+        solver.set_train_data([shared] * n)
     n_iters = args.iterations or int(sp.max_iter) or 100
     with _maybe_profile(args):
         while solver.iter < n_iters:
@@ -166,13 +191,24 @@ def cmd_test(args) -> int:
 
     net = caffe_pb.load_net_prototxt(args.model)
     bs = args.batch or 100
-    net = caffe_pb.replace_data_layers(net, bs, bs, 3, 32, 32)
+    if args.data:
+        net = caffe_pb.replace_data_layers(net, bs, bs, 3, 32, 32)
     sp = caffe_pb.SolverParameter()
     sp.msg.set("net_param", net.msg)
     solver = Solver(sp)
     if args.weights:
         solver.load_weights(args.weights)
-    source, n_avail = _load_arrays(args.data, bs)
+    if args.data:
+        source, n_avail = _load_arrays(args.data, bs)
+    else:
+        from .data.feeds import make_net_feeds
+
+        source = make_net_feeds(net, "TEST", seed=0)
+        if source is None:
+            raise SystemExit(
+                "net has no self-feeding TEST data layer; pass --data")
+        n_avail = 50  # the reference CLI default (tools/caffe.cpp:39
+        # FLAGS_iterations); batch size comes from the prototxt here
     n = args.iterations or n_avail
     solver.set_test_data(source, n)
     scores = solver.test()
@@ -292,7 +328,10 @@ def main(argv=None) -> int:
 
     t = sub.add_parser("train")
     t.add_argument("--solver", required=True)
-    t.add_argument("--data", required=True)
+    t.add_argument("--data",
+                   help="CIFAR dir / .npz batches; omit when the net's "
+                        "data layers are self-feeding (Data/ImageData/"
+                        "WindowData/HDF5Data with a source)")
     t.add_argument("--weights")
     t.add_argument("--snapshot")
     t.add_argument("--iterations", type=int)
@@ -316,7 +355,8 @@ def main(argv=None) -> int:
     te = sub.add_parser("test")
     te.add_argument("--model", required=True)
     te.add_argument("--weights")
-    te.add_argument("--data", required=True)
+    te.add_argument("--data",
+                    help="omit when the net self-feeds (see train)")
     te.add_argument("--iterations", type=int)
     te.add_argument("--batch", type=int)
     te.set_defaults(fn=cmd_test)

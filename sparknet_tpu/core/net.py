@@ -381,13 +381,26 @@ def _data_layer_shapes(net: Net, layer: LayerParameter,
 
             src = str(dp.source)
             if src and _os.path.exists(src):
-                from ..data.store import ArrayStoreCursor
+                from ..data.lmdb_io import is_datum_db
 
-                try:
-                    chw = ArrayStoreCursor(src).datum_shape  # type: ignore
-                except Exception:
-                    pass  # not an ArrayStore (e.g. a Caffe LMDB dir) —
-                    # fall through to the data_shapes error below
+                if is_datum_db(src):
+                    # reference-made LMDB: reshape from the first Datum
+                    # (data_layer.cpp DataLayerSetUp)
+                    from ..data.lmdb_io import read_datum_db
+
+                    try:
+                        img, _ = next(iter(read_datum_db(src)))
+                        chw = tuple(img.shape)  # type: ignore[assignment]
+                    except Exception:
+                        pass
+                else:
+                    from ..data.store import ArrayStoreCursor
+
+                    try:
+                        chw = ArrayStoreCursor(src).datum_shape  # type: ignore
+                    except Exception:
+                        pass  # unknown source — fall through to the
+                        # data_shapes error below
     elif ltype == "ImageData":
         ip = layer.image_data_param
         batch = int(ip.batch_size)

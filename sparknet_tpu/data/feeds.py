@@ -1,0 +1,196 @@
+"""Self-feeding nets: build pull-style DataSources straight from a
+prototxt's own data layers.
+
+In the reference, `caffe train --solver=...` needs no data flags because
+every data layer reads its own source (DB cursor, image list, window file,
+HDF5 list — caffe/src/caffe/layers/*_data_layer.cpp).  This module gives the
+framework the same property: `make_net_feeds(net_param, phase)` returns a
+{top_name...}-producing DataSource per data layer, dispatched by layer type:
+
+- Data       -> ArrayStore or LMDB-of-Datums cursor (db_lmdb.cpp role),
+                with TransformationParameter applied (DataTransformer)
+- ImageData  -> listfile of `path label` lines, decode + resize + transform
+                (image_data_layer.cpp:36-124)
+- WindowData -> fg/bg ROI sampler (window_data.py)
+- HDF5Data   -> HDF5DataSource over the listfile of .h5 files
+- MemoryData/JavaData -> caller-fed (returns None; the Solver API supplies
+                these, Net.scala:83-88 setTrainData)
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+
+def _transformer_from_layer(layer, phase: str, seed: Optional[int]):
+    from ..proto.binaryproto import read_mean_binaryproto
+    from .transform import DataTransformer
+
+    tp = layer.transform_param
+    mean_image = None
+    if str(tp.mean_file):
+        mean_image = read_mean_binaryproto(str(tp.mean_file))
+    return DataTransformer(scale=float(tp.scale),
+                           crop_size=int(tp.crop_size),
+                           mirror=bool(tp.mirror), mean_image=mean_image,
+                           mean_values=tp.mean_values, phase=phase,
+                           seed=seed)
+
+
+def _data_feed(layer, phase: str, seed: Optional[int]):
+    """Data layer: ArrayStore dir or reference LMDB (Datum records)."""
+    dp = layer.data_param
+    src = str(dp.source)
+    batch = int(dp.batch_size)
+    tf = _transformer_from_layer(layer, phase, seed)
+    from .lmdb_io import is_datum_db
+
+    if is_datum_db(src):
+        from .lmdb_io import read_datum_db
+
+        def record_stream():
+            while True:
+                yield from read_datum_db(src)
+    else:
+        from .store import ArrayStoreCursor
+
+        cur = ArrayStoreCursor(src)
+        if len(cur) == 0:
+            raise ValueError(f"empty data source {src!r}")
+
+        def record_stream():
+            while True:
+                img, label = cur.next()
+                yield img, label
+
+    stream = record_stream()
+    tops = list(layer.tops)
+
+    def feed() -> Dict[str, np.ndarray]:
+        imgs, labels = [], []
+        for _ in range(batch):
+            img, label = next(stream)
+            imgs.append(img)
+            labels.append(label)
+        out = {tops[0]: tf(np.stack(imgs))}
+        if len(tops) > 1:
+            out[tops[1]] = np.asarray(labels, dtype=np.int32)
+        return out
+
+    return feed
+
+
+def _image_data_feed(layer, phase: str, seed: Optional[int]):
+    """ImageData layer: `path label` listfile with decode/resize
+    (reference: image_data_layer.cpp:36-124 — shuffle, new_height/width,
+    root_folder)."""
+    ip = layer.image_data_param
+    tf = _transformer_from_layer(layer, phase, seed)
+    entries: List[Tuple[str, int]] = []
+    with open(str(ip.source)) as f:
+        for line in f:
+            line = line.strip()
+            if line:
+                path, label = line.rsplit(None, 1)
+                entries.append((os.path.join(str(ip.root_folder), path),
+                                int(label)))
+    if not entries:
+        raise ValueError(f"empty image list {str(ip.source)!r}")
+    if bool(ip.shuffle):
+        np.random.RandomState(seed).shuffle(entries)
+    batch = int(ip.batch_size)
+    nh, nw = int(ip.new_height) or None, int(ip.new_width) or None
+    tops = list(layer.tops)
+    state = {"i": int(ip.rand_skip)}
+
+    def feed() -> Dict[str, np.ndarray]:
+        from .scale_convert import decode_and_resize
+
+        imgs, labels = [], []
+        while len(imgs) < batch:
+            path, label = entries[state["i"] % len(entries)]
+            state["i"] += 1
+            with open(path, "rb") as f:
+                arr = decode_and_resize(f.read(), nh, nw)
+            if arr is None:
+                continue  # corrupt images skipped (image_data_layer caveat)
+            imgs.append(arr)
+            labels.append(label)
+        out = {tops[0]: tf(np.stack(imgs))}
+        if len(tops) > 1:
+            out[tops[1]] = np.asarray(labels, dtype=np.int32)
+        return out
+
+    return feed
+
+
+def _rename_tops(feed, tops: List[str]):
+    """Window/HDF5 sources produce canonical keys; map them to the layer's
+    actual top names."""
+
+    def renamed() -> Dict[str, np.ndarray]:
+        batch = feed()
+        vals = list(batch.values())
+        return {t: v for t, v in zip(tops, vals)}
+
+    return renamed
+
+
+def make_data_feed(layer, phase: str = "TRAIN",
+                   seed: Optional[int] = None):
+    """DataSource for one data layer, or None for caller-fed types."""
+    ltype = str(layer.type)
+    if ltype == "Data":
+        return _data_feed(layer, phase, seed)
+    if ltype == "ImageData":
+        return _image_data_feed(layer, phase, seed)
+    if ltype == "WindowData":
+        from .window_data import WindowDataFeed
+
+        return _rename_tops(WindowDataFeed.from_layer_param(layer,
+                                                            seed=seed),
+                            list(layer.tops))
+    if ltype == "HDF5Data":
+        from .hdf5_data import HDF5DataSource
+
+        hp = layer.hdf5_data_param
+        return HDF5DataSource(str(hp.source), list(layer.tops),
+                              int(hp.batch_size),
+                              shuffle=bool(hp.shuffle), seed=seed)
+    return None  # MemoryData/JavaData/DummyData: fed by the caller
+
+
+def make_net_feeds(net_param, phase: str = "TRAIN",
+                   seed: Optional[int] = None) -> Optional[Callable]:
+    """One merged DataSource covering every self-feeding data layer active
+    in `phase` (a net can have several, e.g. data + ground-truth HDF5).
+    Returns None when the phase has no self-feeding layer."""
+    from ..core.net import phase_matches
+    from ..proto.caffe_pb import NetState
+    from ..proto.textformat import Message
+
+    state = NetState(Message())
+    state.msg.set("phase", phase)
+    feeds = []
+    for i, layer in enumerate(net_param.layers):
+        if not phase_matches(layer, state):
+            continue
+        feed = make_data_feed(layer, phase,
+                              seed=None if seed is None else seed + i)
+        if feed is not None:
+            feeds.append(feed)
+    if not feeds:
+        return None
+    if len(feeds) == 1:
+        return feeds[0]
+
+    def merged() -> Dict[str, np.ndarray]:
+        out: Dict[str, np.ndarray] = {}
+        for f in feeds:
+            out.update(f())
+        return out
+
+    return merged

@@ -59,6 +59,14 @@ def _even(n: int) -> int:
     return (n + 1) & ~1
 
 
+def is_datum_db(path: str) -> bool:
+    """True when `path` is an LMDB environment directory (the data.mdb
+    layout liblmdb writes) — the dispatch predicate shared by the Data-layer
+    feed and the net's shape probe."""
+    return os.path.isdir(path) and os.path.exists(
+        os.path.join(path, "data.mdb"))
+
+
 # ------------------------------------------------------------------- reader
 
 class LMDBReader:

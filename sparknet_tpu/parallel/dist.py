@@ -106,6 +106,8 @@ class DistributedSolver:
         self._rng = jax.random.PRNGKey(seed if seed >= 0 else 0)
         self.train_sources: Optional[List[DataSource]] = None
         self.test_source: Optional[DataSource] = None
+        self._staged = None      # (batches, rngs) staged for the next round
+        self._prefetch = False   # set_prefetch: overlap staging with compute
         self._num_test_batches = 0
         self._round_fns: Dict[bool, Any] = {}
         self._test_step = jax.jit(self._build_test_step())
@@ -169,9 +171,13 @@ class DistributedSolver:
                     # ...plus the cross-slice average over DCN on
                     # dcn_interval rounds
                     params = jax.lax.pmean(params, DCN_AXIS)
+            # report the GLOBAL mean round loss, replicated — without this
+            # the P() out-spec hands back one shard's local loss, and
+            # multi-process runs would disagree on the value
+            loss = jax.lax.pmean(jnp.mean(losses), sync_axes)
             return (jax.tree.map(lambda a: a[None], params),
                     jax.tree.map(lambda a: a[None], state),
-                    jnp.mean(losses))
+                    loss)
 
         wspec = self._dataspec
         mapped = shard_map(
@@ -197,6 +203,7 @@ class DistributedSolver:
         (CifarApp.scala:120-130 zipPartitions)."""
         assert len(sources) == self.n_workers
         self.train_sources = sources
+        self._staged = None  # staged batches came from the old sources
 
     def set_test_data(self, source: DataSource, num_batches: int) -> None:
         self.test_source = source
@@ -225,10 +232,12 @@ class DistributedSolver:
             return jax.device_put(jnp.asarray(arr), self._wsh)
         return jax.make_array_from_process_local_data(self._wsh, arr)
 
-    def run_round(self) -> float:
-        """One outer round: τ local steps per worker + weight average
-        (reference: one iteration of the while(true) driver loop,
-        CifarApp.scala:95-136).  Returns mean loss over the round."""
+    def _stage_round(self, round_idx: int):
+        """Pull τ host batches per local worker and start their device
+        transfer — the host half of a round, separable from the compute so
+        it can overlap the PREVIOUS round's device execution (the role of
+        the reference's triple-buffered prefetch,
+        base_data_layer.cpp:70-98 PREFETCH_COUNT=3)."""
         assert self.train_sources is not None, "set_train_data first"
         local = self.local_worker_ids()
         if not local:
@@ -245,17 +254,68 @@ class DistributedSolver:
                                for k in pulls[0]})
         stacked = {k: np.stack([pw[k] for pw in per_worker])
                    for k in per_worker[0]}
+        # device_put dispatches the copy asynchronously; it lands while the
+        # in-flight round computes
         batches = {k: self._put_worker_major(v) for k, v in stacked.items()}
         all_rngs = np.asarray(jax.random.split(
-            jax.random.fold_in(self._rng, self.round), self.n_workers))
+            jax.random.fold_in(self._rng, round_idx), self.n_workers))
         rngs = self._put_worker_major(all_rngs[np.asarray(local)])
+        return batches, rngs
+
+    def set_prefetch(self, on: bool = True) -> None:
+        """Enable one-round-ahead staging: while round N computes on
+        device, round N+1's batches are pulled and transferred on a host
+        thread.  Only valid when the data sources are round-agnostic
+        streams (a feed that must be reset per round — e.g. the CifarApp
+        windowed sampler — would be pulled one round early)."""
+        self._prefetch = bool(on)
+
+    def run_round(self, prefetch_next: Optional[bool] = None) -> float:
+        """One outer round: τ local steps per worker + weight average
+        (reference: one iteration of the while(true) driver loop,
+        CifarApp.scala:95-136).  Returns mean loss over the round.
+
+        With set_prefetch(True), round N+1's host pulls and device
+        transfers overlap round N's device execution (double buffering —
+        the driver-loop analogue of the reference's prefetch thread).
+        `prefetch_next=False` skips the look-ahead (pass it on the final
+        round so the run doesn't pull a batch set nobody will consume)."""
+        staged = self._staged
+        if staged is None:
+            staged = self._stage_round(self.round)
+        self._staged = None
+        batches, rngs = staged
         avg_dcn = (not self.has_dcn
                    or self.round % self.dcn_interval == self.dcn_interval - 1)
+        # async dispatch: the jitted round returns immediately
         self.params_w, self.state_w, loss = self._round_fn(avg_dcn)(
             self.params_w, self.state_w, jnp.int32(self.iter), batches, rngs)
         self.iter += self.tau
         self.round += 1
-        return float(loss)
+        if prefetch_next is None:
+            prefetch_next = self._prefetch
+        if prefetch_next:
+            import threading
+
+            err: List[BaseException] = []
+
+            def stage_next():
+                try:
+                    self._staged = self._stage_round(self.round)
+                except BaseException as e:  # re-raised on the caller below
+                    err.append(e)
+
+            t = threading.Thread(target=stage_next, daemon=True)
+            t.start()
+            val = float(loss)  # blocks on the device; staging overlaps
+            t.join()
+            if err:
+                # a swallowed staging failure would surface a round late
+                # with the stream silently offset — fail loudly now
+                raise err[0]
+        else:
+            val = float(loss)
+        return val
 
     def test(self, num_batches: Optional[int] = None) -> Dict[str, float]:
         """Evaluate the averaged model (reference: CifarApp.scala:101-116).
@@ -320,6 +380,7 @@ class DistributedSolver:
                                      state0, extra=extra)
 
     def restore(self, path: str) -> None:
+        self._staged = None  # staged batches belong to the pre-restore round
         path = resolve_solverstate_path(path)
         if path.endswith(".solverstate") or path.endswith(".h5"):
             # reference-format pair written by snapshot_caffe_style: weights

@@ -1,0 +1,106 @@
+"""Device-side transform vs the host DataTransformer
+(reference: caffe/src/caffe/data_transformer.cpp semantics)."""
+
+import jax
+import numpy as np
+
+from sparknet_tpu.data.transform import DataTransformer
+from sparknet_tpu.ops.device_transform import (fuse_transform_into_step,
+                                               make_device_transformer)
+
+
+def _pool(n=6, size=12, seed=0):
+    rng = np.random.RandomState(seed)
+    x = rng.randint(0, 256, size=(n, 3, size, size)).astype(np.uint8)
+    mean = rng.rand(3, size, size).astype(np.float32) * 50
+    return x, mean
+
+
+def test_test_phase_matches_host_exactly():
+    """Center crop + mean + scale is deterministic: device == host."""
+    x, mean = _pool()
+    host = DataTransformer(crop_size=8, mean_image=mean, scale=0.25,
+                           phase="TEST")
+    dev = make_device_transformer(crop_size=8, mean_image=mean, scale=0.25,
+                                  phase="TEST")
+    got = np.asarray(jax.jit(dev)(x, jax.random.PRNGKey(0)))
+    np.testing.assert_allclose(got, host(x), rtol=1e-5, atol=1e-4)
+
+
+def test_mean_values_path():
+    x, _ = _pool()
+    host = DataTransformer(crop_size=0, mean_values=[10., 20., 30.],
+                           phase="TEST")
+    dev = make_device_transformer(mean_values=[10., 20., 30.], phase="TEST")
+    got = np.asarray(dev(x, jax.random.PRNGKey(0)))
+    np.testing.assert_allclose(got, host(x), rtol=1e-5, atol=1e-4)
+
+
+def test_train_phase_random_crop_semantics():
+    """Each output must equal SOME crop window of its input with the mean
+    subtracted at that window (possibly mirrored) — the reference's
+    per-image random-crop contract."""
+    x, mean = _pool(n=4, size=10)
+    dev = make_device_transformer(crop_size=6, mirror=True, mean_image=mean,
+                                  phase="TRAIN")
+    out = np.asarray(dev(x, jax.random.PRNGKey(3)))
+    assert out.shape == (4, 3, 6, 6)
+    for i in range(4):
+        found = False
+        xf = x[i].astype(np.float32) - mean
+        for r in range(5):
+            for c in range(5):
+                win = xf[:, r:r + 6, c:c + 6]
+                if np.allclose(out[i], win, atol=1e-3) or \
+                        np.allclose(out[i], win[:, :, ::-1], atol=1e-3):
+                    found = True
+                    break
+            if found:
+                break
+        assert found, f"output {i} is not any crop window of its input"
+
+
+def test_train_crops_vary_per_image_and_per_call():
+    x, _ = _pool(n=8, size=16)
+    dev = make_device_transformer(crop_size=8, phase="TRAIN")
+    a = np.asarray(dev(x, jax.random.PRNGKey(0)))
+    b = np.asarray(dev(x, jax.random.PRNGKey(1)))
+    assert not np.allclose(a, b), "different rng must give different crops"
+
+
+def test_fused_step_trains():
+    """uint8 batch -> fused transform+train step under ONE jit (the raw-
+    bytes-over-the-wire feed pattern bench.py measures)."""
+    import jax.numpy as jnp
+
+    from sparknet_tpu.proto import caffe_pb
+    from sparknet_tpu.proto.textformat import parse
+    from sparknet_tpu.solver import updates
+    from sparknet_tpu.solver.solver import Solver, make_single_step
+
+    net_txt = """
+layer { name: "data" type: "MemoryData" top: "data" top: "label"
+  memory_data_param { batch_size: 4 channels: 3 height: 8 width: 8 } }
+layer { name: "ip" type: "InnerProduct" bottom: "data" top: "ip"
+  inner_product_param { num_output: 3
+    weight_filler { type: "gaussian" std: 0.1 } } }
+layer { name: "loss" type: "SoftmaxWithLoss" bottom: "ip" bottom: "label"
+  top: "loss" }
+"""
+    sp = caffe_pb.SolverParameter(parse(
+        'base_lr: 0.01\nlr_policy: "fixed"\nmomentum: 0.9\nrandom_seed: 5'))
+    sp.msg.set("net_param", caffe_pb.parse_net_text(net_txt).msg)
+    solver = Solver(sp)
+    step = make_single_step(solver.net, sp)
+    tf = make_device_transformer(crop_size=8, mirror=True, phase="TRAIN")
+    fused = jax.jit(fuse_transform_into_step(tf, step))
+
+    rng = np.random.RandomState(0)
+    raw = rng.randint(0, 256, size=(4, 3, 12, 12)).astype(np.uint8)
+    label = rng.randint(0, 3, size=(4,)).astype(np.int32)
+    params, state = solver.params, solver.state
+    for i in range(3):
+        params, state, loss = fused(params, state, jnp.int32(i),
+                                    {"data": raw, "label": label},
+                                    jax.random.PRNGKey(i))
+    assert np.isfinite(float(loss))

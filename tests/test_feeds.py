@@ -1,0 +1,180 @@
+"""Self-feeding nets: DataSources built from the prototxt's own data layers
+(reference: caffe/src/caffe/layers/*_data_layer.cpp self-reading setup)."""
+
+import os
+
+import numpy as np
+import pytest
+
+from sparknet_tpu.data.feeds import make_data_feed, make_net_feeds
+from sparknet_tpu.proto import caffe_pb
+from sparknet_tpu.proto.textformat import parse
+
+
+def _write_store(tmp_path, n=40, shape=(3, 12, 12), classes=5, seed=0):
+    from sparknet_tpu.data.store import ArrayStoreWriter
+
+    rng = np.random.RandomState(seed)
+    imgs = rng.randint(0, 256, size=(n,) + shape).astype(np.uint8)
+    labels = rng.randint(0, classes, size=n)
+    path = str(tmp_path / "store")
+    w = ArrayStoreWriter(path)
+    for i in range(n):
+        w.put(imgs[i], int(labels[i]))
+    w.close()
+    return path, imgs, labels
+
+
+def test_data_layer_feed_from_arraystore(tmp_path):
+    path, imgs, labels = _write_store(tmp_path)
+    net = caffe_pb.parse_net_text(f"""
+layer {{ name: "data" type: "Data" top: "data" top: "label"
+  data_param {{ source: "{path}" batch_size: 8 }}
+  transform_param {{ scale: 0.5 }} }}
+""")
+    feed = make_data_feed(net.layers[0], "TEST", seed=0)
+    b = feed()
+    assert b["data"].shape == (8, 3, 12, 12)
+    np.testing.assert_allclose(b["data"][0],
+                               imgs[0].astype(np.float32) * 0.5, rtol=1e-6)
+    assert list(b["label"]) == list(labels[:8])
+
+
+def test_data_layer_feed_from_lmdb(tmp_path):
+    from sparknet_tpu.data.lmdb_io import write_datum_lmdb
+
+    rng = np.random.RandomState(1)
+    imgs = rng.randint(0, 256, size=(20, 3, 10, 10)).astype(np.uint8)
+    db = str(tmp_path / "db")
+    write_datum_lmdb(db, ((imgs[i], i % 4) for i in range(20)))
+    net = caffe_pb.parse_net_text(f"""
+layer {{ name: "data" type: "Data" top: "data" top: "label"
+  data_param {{ source: "{db}" batch_size: 5 backend: LMDB }} }}
+""")
+    feed = make_data_feed(net.layers[0], "TEST", seed=0)
+    b = feed()
+    assert b["data"].shape == (5, 3, 10, 10)
+    np.testing.assert_allclose(b["data"][0], imgs[0].astype(np.float32))
+
+
+def test_image_data_feed(tmp_path):
+    from PIL import Image
+
+    rng = np.random.RandomState(2)
+    lines = []
+    for i in range(6):
+        arr = rng.randint(0, 256, size=(20, 24, 3)).astype(np.uint8)
+        p = str(tmp_path / f"im{i}.png")
+        Image.fromarray(arr).save(p)
+        lines.append(f"im{i}.png {i % 3}")
+    listfile = str(tmp_path / "list.txt")
+    open(listfile, "w").write("\n".join(lines) + "\n")
+    net = caffe_pb.parse_net_text(f"""
+layer {{ name: "data" type: "ImageData" top: "data" top: "label"
+  image_data_param {{ source: "{listfile}" batch_size: 4 new_height: 16
+    new_width: 16 root_folder: "{tmp_path}/" }} }}
+""")
+    feed = make_data_feed(net.layers[0], "TEST", seed=0)
+    b = feed()
+    assert b["data"].shape == (4, 3, 16, 16)
+    assert list(b["label"]) == [0, 1, 2, 0]
+
+
+def test_make_net_feeds_phase_filtering(tmp_path):
+    path, _, _ = _write_store(tmp_path)
+    net = caffe_pb.parse_net_text(f"""
+layer {{ name: "tr" type: "Data" top: "data" top: "label"
+  include {{ phase: TRAIN }}
+  data_param {{ source: "{path}" batch_size: 4 }} }}
+layer {{ name: "te" type: "Data" top: "data" top: "label"
+  include {{ phase: TEST }}
+  data_param {{ source: "{path}" batch_size: 2 }} }}
+""")
+    tr = make_net_feeds(net, "TRAIN")
+    te = make_net_feeds(net, "TEST")
+    assert tr()["data"].shape[0] == 4
+    assert te()["data"].shape[0] == 2
+
+
+def test_make_net_feeds_none_for_memory_data():
+    net = caffe_pb.parse_net_text("""
+layer { name: "data" type: "MemoryData" top: "data" top: "label"
+  memory_data_param { batch_size: 4 channels: 1 height: 5 width: 5 } }
+""")
+    assert make_net_feeds(net, "TRAIN") is None
+
+
+def test_solver_trains_from_self_feeding_net(tmp_path):
+    """End to end: prototxt Data layer over a store -> Solver with no
+    external feed, incl. shape inference from the store."""
+    from sparknet_tpu.solver.solver import Solver
+
+    path, _, _ = _write_store(tmp_path)
+    net_txt = f"""
+layer {{ name: "data" type: "Data" top: "data" top: "label"
+  data_param {{ source: "{path}" batch_size: 8 }} }}
+layer {{ name: "ip" type: "InnerProduct" bottom: "data" top: "ip"
+  inner_product_param {{ num_output: 5
+    weight_filler {{ type: "gaussian" std: 0.05 }} }} }}
+layer {{ name: "loss" type: "SoftmaxWithLoss" bottom: "ip" bottom: "label"
+  top: "loss" }}
+"""
+    sp = caffe_pb.SolverParameter(parse(
+        'base_lr: 0.01\nlr_policy: "fixed"\nmomentum: 0.9\nrandom_seed: 2'))
+    sp.msg.set("net_param", caffe_pb.parse_net_text(net_txt).msg)
+    solver = Solver(sp)
+    feed = make_net_feeds(sp.net_param, "TRAIN", seed=0)
+    assert feed is not None
+    solver.set_train_data(feed)
+    assert np.isfinite(solver.step(3))
+
+
+def test_cli_train_self_feeding(tmp_path):
+    """`cli train` without --data on a self-feeding net (the reference's
+    `caffe train --solver=...` shape, tools/caffe.cpp:160)."""
+    from sparknet_tpu.cli import main as cli_main
+
+    path, _, _ = _write_store(tmp_path)
+    net_path = str(tmp_path / "net.prototxt")
+    open(net_path, "w").write(f"""
+layer {{ name: "data" type: "Data" top: "data" top: "label"
+  data_param {{ source: "{path}" batch_size: 8 }} }}
+layer {{ name: "ip" type: "InnerProduct" bottom: "data" top: "ip"
+  inner_product_param {{ num_output: 5
+    weight_filler {{ type: "gaussian" std: 0.05 }} }} }}
+layer {{ name: "loss" type: "SoftmaxWithLoss" bottom: "ip" bottom: "label"
+  top: "loss" }}
+""")
+    solver_path = str(tmp_path / "solver.prototxt")
+    open(solver_path, "w").write(
+        f'net: "{net_path}"\nbase_lr: 0.01\nlr_policy: "fixed"\n'
+        f'momentum: 0.9\nmax_iter: 3\n')
+    out = str(tmp_path / "w.npz")
+    assert cli_main(["train", "--solver", solver_path, "--out", out]) == 0
+    assert os.path.exists(out)
+
+
+def test_cli_train_distributed_self_feeding(tmp_path):
+    """`cli train --workers N` without --data: one shared self-feed, the
+    reference's single-DataReader semantics (data_reader.cpp:15-31)."""
+    from sparknet_tpu.cli import main as cli_main
+
+    path, _, _ = _write_store(tmp_path, n=64)
+    net_path = str(tmp_path / "net.prototxt")
+    open(net_path, "w").write(f"""
+layer {{ name: "data" type: "Data" top: "data" top: "label"
+  data_param {{ source: "{path}" batch_size: 4 }} }}
+layer {{ name: "ip" type: "InnerProduct" bottom: "data" top: "ip"
+  inner_product_param {{ num_output: 5
+    weight_filler {{ type: "gaussian" std: 0.05 }} }} }}
+layer {{ name: "loss" type: "SoftmaxWithLoss" bottom: "ip" bottom: "label"
+  top: "loss" }}
+""")
+    solver_path = str(tmp_path / "solver.prototxt")
+    open(solver_path, "w").write(
+        f'net: "{net_path}"\nbase_lr: 0.01\nlr_policy: "fixed"\n'
+        f'momentum: 0.9\nmax_iter: 4\n')
+    out = str(tmp_path / "w.npz")
+    assert cli_main(["train", "--solver", solver_path, "--workers", "2",
+                     "--tau", "2", "--out", out]) == 0
+    assert os.path.exists(out)

@@ -29,6 +29,10 @@ ZOO = [
     ("caffe/examples/cifar10/cifar10_full_sigmoid_train_test_bn.prototxt",
      CIFAR),
     ("caffe/examples/mnist/lenet_train_test.prototxt", MNIST),
+    # siamese towers share weights via param{name} (ContrastiveLoss)
+    ("caffe/examples/siamese/mnist_siamese_train_test.prototxt",
+     {"pair_data": (2, 2, 28, 28), "sim": (2,)}),
+    ("caffe/examples/siamese/mnist_siamese.prototxt", None),
     ("caffe/examples/mnist/lenet_auto_train.prototxt", MNIST),
     ("caffe/examples/mnist/mnist_autoencoder.prototxt", MNIST),
     ("caffe/models/bvlc_alexnet/train_val.prototxt", None),
@@ -65,3 +69,35 @@ def test_zoo_model_builds(rel, data_shapes, phase):
     # TRAIN phase of train_test nets must expose a loss to optimize
     if phase == "TRAIN" and "train" in rel:
         assert net.loss_terms, f"{rel} TRAIN phase has no loss"
+
+
+def test_siamese_trains_with_shared_weights():
+    """The siamese example trains end to end: the two towers share weight
+    blobs via param{name} (reference: examples/siamese/readme.md; net.cpp
+    param-sharing), so the net has ONE set of conv/ip params and the
+    contrastive loss backpropagates through both towers."""
+    import numpy as np
+
+    from sparknet_tpu.proto.textformat import parse
+    from sparknet_tpu.solver.solver import Solver
+
+    net_param = caffe_pb.load_net_prototxt(reference_path(
+        "caffe/examples/siamese/mnist_siamese_train_test.prototxt"))
+    sp = caffe_pb.SolverParameter(parse(
+        'base_lr: 0.01\nlr_policy: "fixed"\nmomentum: 0.9\nrandom_seed: 4'))
+    sp.msg.set("net_param", net_param.msg)
+    solver = Solver(sp, data_shapes={"pair_data": (8, 2, 28, 28),
+                                     "sim": (8,)})
+    rng = np.random.RandomState(0)
+
+    def src():
+        return {"pair_data": rng.rand(8, 2, 28, 28).astype(np.float32),
+                "sim": (rng.rand(8) < 0.5).astype(np.float32)}
+
+    solver.set_train_data(src)
+    l0 = solver.step(1)
+    l5 = solver.step(5)
+    assert np.isfinite(l0) and np.isfinite(l5)
+    # shared params: tower-2 layers (conv1_p etc.) must NOT own params
+    assert not any("_p/" in k for k in solver.params), \
+        sorted(solver.params)[:8]

@@ -1,0 +1,88 @@
+"""Worker process for the two-process jax.distributed test
+(tests/test_multihost.py::test_two_process_distributed_round).
+
+Each process owns 2 virtual CPU devices (one slice of a (2 slices x 2
+workers) hierarchical mesh) and must: see the global 4-device mesh, claim
+exactly its own worker rows, feed only those rows, and agree on the round
+loss through the cross-process collectives."""
+
+import json
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=2").strip()
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+NET = """
+layer { name: "data" type: "MemoryData" top: "data" top: "label"
+  memory_data_param { batch_size: 4 channels: 1 height: 5 width: 5 } }
+layer { name: "ip" type: "InnerProduct" bottom: "data" top: "ip"
+  inner_product_param { num_output: 3
+    weight_filler { type: "gaussian" std: 0.1 } } }
+layer { name: "loss" type: "SoftmaxWithLoss" bottom: "ip" bottom: "label"
+  top: "loss" }
+"""
+
+
+def main() -> None:
+    rank = int(sys.argv[1])
+    port = sys.argv[2]
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+
+    import numpy as np
+
+    from sparknet_tpu.parallel.dist import DistributedSolver
+    from sparknet_tpu.parallel.mesh import (init_distributed,
+                                            make_hierarchical_mesh)
+    from sparknet_tpu.proto import caffe_pb
+    from sparknet_tpu.proto.textformat import parse
+
+    init_distributed(f"localhost:{port}", num_processes=2, process_id=rank)
+    assert jax.process_count() == 2, jax.process_count()
+    assert jax.device_count() == 4 and jax.local_device_count() == 2
+
+    sp = caffe_pb.SolverParameter(parse(
+        'base_lr: 0.05\nlr_policy: "fixed"\nmomentum: 0.9\nrandom_seed: 7'))
+    sp.msg.set("net_param", caffe_pb.parse_net_text(NET).msg)
+    mesh = make_hierarchical_mesh(2)  # one slice per process
+    solver = DistributedSolver(sp, mesh=mesh, tau=2, dcn_interval=2)
+
+    local = solver.local_worker_ids()
+
+    def src(w):
+        rng = np.random.RandomState(w)
+
+        def pull():
+            return {"data": rng.rand(4, 1, 5, 5).astype(np.float32),
+                    "label": rng.randint(0, 3, (4,)).astype(np.int32)}
+        return pull
+
+    # every process supplies the full source list; run_round pulls local
+    # rows only (the per-executor zipPartitions locality)
+    solver.set_train_data([src(w) for w in range(solver.n_workers)])
+    losses = [solver.run_round() for _ in range(2)]
+
+    # mid-schedule eval on the replica mean crosses processes too
+    fixed = {"data": np.random.RandomState(99).rand(4, 1, 5, 5)
+             .astype(np.float32),
+             "label": np.random.RandomState(99).randint(0, 3, (4,))
+             .astype(np.int32)}
+    solver.set_test_data(lambda: fixed, 1)
+    eval_loss = solver.test()["loss"]
+
+    print(json.dumps(dict(rank=rank, n_devices=jax.device_count(),
+                          local_workers=local,
+                          losses=[round(float(l), 6) for l in losses],
+                          eval_loss=round(float(eval_loss), 6))), flush=True)
+
+
+if __name__ == "__main__":
+    main()
