@@ -42,24 +42,39 @@ MODEL_PROTO = {
 
 def build_solver(model: str, n_workers: int, tau: int, batch_size: int,
                  test_batch: int, mesh=None, crop: int = CROPPED,
-                 dcn_interval: int = 1) -> DistributedSolver:
+                 dcn_interval: int = 1, mean_image=None,
+                 device_transform: bool = False) -> DistributedSolver:
+    """device_transform: fuse the crop/mirror/mean pipeline into the
+    compiled round (ops/device_transform.py) — feeds then ship raw uint8
+    256x256 images, 4x less host->device traffic and no host transform
+    loop (the TPU-native data-path split, BENCH_NOTES.md)."""
     d = MODEL_PROTO[model]
     net = caffe_pb.load_net_prototxt(os.path.join(d, "train_val.prototxt"))
     net = caffe_pb.replace_data_layers(net, batch_size, test_batch, 3, crop,
                                        crop)
     sp = caffe_pb.load_solver_prototxt_with_net(
         os.path.join(d, "solver.prototxt"), net)
+    dt = dte = None
+    if device_transform:
+        from ..ops.device_transform import make_device_transformer
+
+        dt = make_device_transformer(crop_size=crop, mirror=True,
+                                     mean_image=mean_image, phase="TRAIN")
+        dte = make_device_transformer(crop_size=crop, mean_image=mean_image,
+                                      phase="TEST")
     return DistributedSolver(sp, n_workers=n_workers, tau=tau, mesh=mesh,
-                             dcn_interval=dcn_interval)
+                             dcn_interval=dcn_interval, device_transform=dt,
+                             device_transform_eval=dte)
 
 
 class ShardFeed:
-    """Streams this worker's tar shards through decode -> transform; loops
-    forever (the reference re-runs partitions each round)."""
+    """Streams this worker's tar shards through decode (-> host transform
+    when one is given; raw uint8 otherwise, for the device-transform
+    path); loops forever (the reference re-runs partitions each round)."""
 
     def __init__(self, loader: ImageNetLoader, shards: List[str],
                  label_file: str, batch_size: int,
-                 transformer: DataTransformer) -> None:
+                 transformer: Optional[DataTransformer]) -> None:
         self.loader = loader
         self.shards = shards
         self.label_file = label_file
@@ -81,6 +96,8 @@ class ShardFeed:
         except StopIteration:
             self._it = self._fresh()
             imgs, labels = next(self._it)
+        if self.transformer is None:
+            return {"data": imgs, "label": labels}  # raw uint8, on-device tf
         return {"data": self.transformer(imgs), "label": labels}
 
 
@@ -104,15 +121,28 @@ def run(num_workers: int, *, shards_dir: str = "", label_file: str = "",
         log_path: Optional[str] = None, crop: int = CROPPED,
         test_every: int = 10, dcn_interval: int = 1,
         snapshot_every_rounds: int = 0, snapshot_prefix: str = "",
-        resume: str = "") -> float:
+        resume: str = "", device_transform: Optional[bool] = None) -> float:
+    """device_transform (default: on for real data): ship raw uint8 from
+    the shard feeds and run crop/mirror/mean inside the compiled round —
+    the TPU-native data path (BENCH_NOTES.md); off falls back to the
+    host-side DataTransformer."""
     log = PhaseLogger(log_path or
                       f"/tmp/training_log_{int(time.time())}.txt")
     log(f"workers = {num_workers}, model = {model}, tau = {tau}")
-    solver = build_solver(model, num_workers, tau, batch_size, test_batch,
-                          mesh=mesh, crop=crop, dcn_interval=dcn_interval)
-    log("built solver")
+    if device_transform is None:
+        device_transform = not (synthetic or not shards_dir)
 
     if synthetic or not shards_dir:
+        if device_transform:
+            # the synthetic feed produces pre-transformed crops, so there
+            # is nothing for a device transform to do — don't pretend
+            raise SystemExit(
+                "--device-transform needs real shard data "
+                "(the synthetic feed is already crop-sized floats)")
+        solver = build_solver(model, num_workers, tau, batch_size,
+                              test_batch, mesh=mesh, crop=crop,
+                              dcn_interval=dcn_interval)
+        log("built solver")
         feeds = [synthetic_feed(batch_size, crop, seed=w)
                  for w in range(num_workers)]
         test_source = synthetic_feed(test_batch, crop, seed=999)
@@ -127,10 +157,19 @@ def run(num_workers: int, *, shards_dir: str = "", label_file: str = "",
                                 shards=paths[:1])
         mean = compute_mean_image(b for b, _ in [next(sample)])
         log("computed mean image")
-        train_tf = DataTransformer(crop_size=crop, mirror=True,
-                                   mean_image=mean, phase="TRAIN")
-        test_tf = DataTransformer(crop_size=crop, mean_image=mean,
-                                  phase="TEST")
+        solver = build_solver(model, num_workers, tau, batch_size,
+                              test_batch, mesh=mesh, crop=crop,
+                              dcn_interval=dcn_interval, mean_image=mean,
+                              device_transform=device_transform)
+        log("built solver")
+        if device_transform:
+            train_tf = test_tf = None  # raw uint8; transform on device
+            log("device-side transform enabled (uint8 feed)")
+        else:
+            train_tf = DataTransformer(crop_size=crop, mirror=True,
+                                       mean_image=mean, phase="TRAIN")
+            test_tf = DataTransformer(crop_size=crop, mean_image=mean,
+                                      phase="TEST")
         feeds = [ShardFeed(loader, shard_paths_for_worker(paths, w,
                                                           num_workers),
                            label_file, batch_size, train_tf)
@@ -138,6 +177,7 @@ def run(num_workers: int, *, shards_dir: str = "", label_file: str = "",
         test_source = ShardFeed(loader, paths, label_file, test_batch,
                                 test_tf)
         num_test = 10
+        solver.set_prefetch(True)  # stream feeds: stage N+1 during N
     solver.set_train_data(feeds)
     solver.set_test_data(test_source, num_test)
 
@@ -155,7 +195,7 @@ def run(num_workers: int, *, shards_dir: str = "", label_file: str = "",
             accuracy = scores.get("accuracy", 0.0)
             log(f"%-age of test set correct: {accuracy}", i=r)
         log("starting training", i=r)
-        loss = solver.run_round()
+        loss = solver.run_round(prefetch_next=r < rounds - 1)
         log(f"round loss = {loss}", i=r)
         maybe_snapshot_round(solver, log, r, snapshot_every_rounds,
                              snapshot_prefix)
@@ -173,6 +213,12 @@ def main() -> None:
     p.add_argument("--model", default="alexnet", choices=list(MODEL_PROTO))
     p.add_argument("--rounds", type=int, default=100)
     p.add_argument("--synthetic", action="store_true")
+    p.add_argument("--device-transform", dest="device_transform",
+                   action="store_true", default=None,
+                   help="augment on device from raw uint8 feeds "
+                        "(default: on for real data)")
+    p.add_argument("--no-device-transform", dest="device_transform",
+                   action="store_false")
     from ..utils.compile_cache import (apply_platform_env,
                                       maybe_enable_compile_cache)
     from .common import (add_distributed_args, add_snapshot_args,
@@ -189,7 +235,8 @@ def main() -> None:
         model=a.model, rounds=a.rounds, synthetic=a.synthetic, mesh=mesh,
         dcn_interval=a.dcn_interval, batch_size=a.batch, tau=a.tau,
         snapshot_every_rounds=a.snapshot_every_rounds,
-        snapshot_prefix=a.snapshot_prefix, resume=a.resume)
+        snapshot_prefix=a.snapshot_prefix, resume=a.resume,
+        device_transform=a.device_transform)
 
 
 if __name__ == "__main__":

@@ -1059,11 +1059,17 @@ def build_infogain_loss(net: Net, layer: LayerParameter, bshapes):
     src = str(layer.infogain_loss_param.source)
     H = None
     if len(bshapes) < 3 and src:
-        H = jnp.asarray(np.load(src)) if src.endswith(".npy") else None
-        if H is None:
-            raise NotImplementedError(
-                "InfogainLoss matrix must come from a 3rd bottom or a .npy "
-                "source file")
+        if src.endswith(".npy"):
+            H = jnp.asarray(np.load(src))
+        else:
+            # the reference format: a BlobProto binary file
+            # (infogain_loss_layer.cpp:18-26 ReadProtoFromBinaryFile)
+            from ..proto.binaryproto import parse_blob
+
+            with open(src, "rb") as f:
+                arr = parse_blob(f.read())
+            H = jnp.asarray(arr.reshape(arr.shape[-2], arr.shape[-1])
+                            if arr.ndim > 2 else arr)
 
     def fn(pvals, bvals, rng, train):
         mat = bvals[2] if len(bvals) > 2 else H

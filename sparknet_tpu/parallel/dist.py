@@ -68,8 +68,15 @@ class DistributedSolver:
                  data_shapes: Optional[Dict[str, Any]] = None,
                  batch_override: Optional[int] = None,
                  mesh=None, precision: Optional[str] = None,
-                 dcn_interval: int = 1) -> None:
+                 dcn_interval: int = 1, device_transform=None,
+                 device_transform_eval=None) -> None:
+        """device_transform(_eval): optional jittable augmentation fns
+        (ops/device_transform.py) fused in front of the train step / test
+        forward — feeds then ship raw uint8 and the crop/mirror/mean
+        arithmetic runs on device inside the compiled round."""
         assert mode in ("average", "sync")
+        self.device_transform = device_transform
+        self.device_transform_eval = device_transform_eval
         self.param = solver_param
         self.precision = resolve_precision(solver_param, precision)
         self.mode = mode
@@ -146,6 +153,11 @@ class DistributedSolver:
         stepper = make_single_step(self.net, self.param,
                                    precision=self.precision,
                                    grad_sync=grad_sync)
+        if self.device_transform is not None:
+            from ..ops.device_transform import fuse_transform_into_step
+
+            stepper = fuse_transform_into_step(self.device_transform,
+                                               stepper)
 
         def round_shard(params, state, it0, batches, rng):
             # shard_map hands us the leading worker-block of size 1: strip it.
@@ -190,8 +202,15 @@ class DistributedSolver:
     def _build_test_step(self):
         net = self.test_net
         outputs = net.output_blobs
+        eval_tf = self.device_transform_eval
 
         def test_step(params, inputs):
+            if eval_tf is not None:
+                # deterministic TEST-phase transform (center crop): rng
+                # argument unused, pass a fixed key
+                inputs = {**inputs,
+                          "data": eval_tf(inputs["data"],
+                                          jax.random.PRNGKey(0))}
             blobs, _ = net.apply(params, inputs, train=False)
             return {k: blobs[k] for k in outputs}
 

@@ -1,0 +1,147 @@
+"""GSPMD trainer: data + tensor parallelism in ONE jit with
+compiler-inserted collectives.
+
+The shard_map round (`parallel/dist.py`) implements the reference's
+*algorithm* — τ-step local SGD + explicit weight `pmean` (SURVEY.md §2.3).
+This module is the other TPU-native scaling path, for models that outgrow a
+chip or want per-step sync without manual collectives: annotate a
+`NamedSharding` per array over a `(workers, model)` mesh and let XLA place
+every all-reduce/all-gather (the scaling-book recipe: pick a mesh, annotate
+shardings, let the compiler insert collectives).
+
+- batch axis shards over `workers` → XLA inserts the gradient all-reduce
+  (the P2PSync role, parallel.cpp:271-437, with zero communication code);
+- large parameter blobs shard their output-feature dim over `model`
+  (tensor parallelism) → XLA partitions the matmuls/convs and inserts the
+  activation collectives; optimizer state inherits the same sharding, so
+  momentum updates stay fully local (ZeRO-style sharded optimizer for the
+  TP dims, for free).
+
+The reference has no TP anywhere (SURVEY.md §2.3 inventory); this is
+beyond-parity capability, exercised by `__graft_entry__.dryrun_multichip`.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..proto.caffe_pb import SolverParameter
+from ..solver import updates
+from ..solver.solver import make_single_step, resolve_precision
+from .mesh import MODEL_AXIS, WORKER_AXIS
+
+
+def infer_tp_specs(net, mesh: Mesh, *, min_tp_elems: int = 1 << 16
+                   ) -> Dict[str, P]:
+    """PartitionSpec per parameter: shard dim 0 (output features for both
+    IP `(out, in)` and conv `(O, I, kh, kw)` blobs) over the `model` axis
+    when the blob is big enough and divides evenly; everything else —
+    small blobs, biases of unsharded layers, BatchNorm stats — replicates.
+    A bias shards with its weight so the layer's output features stay
+    aligned."""
+    m = mesh.shape.get(MODEL_AXIS, 1)
+    specs: Dict[str, P] = {}
+    sharded_layers = set()
+    for key, pi in net.param_inits.items():
+        shape = tuple(pi.shape)
+        layer, idx = key.rsplit("/", 1)
+        if (m > 1 and not pi.is_stat and idx == "0" and len(shape) >= 2
+                and int(np.prod(shape)) >= min_tp_elems
+                and shape[0] % m == 0):
+            specs[key] = P(MODEL_AXIS, *([None] * (len(shape) - 1)))
+            sharded_layers.add(layer)
+        else:
+            specs[key] = P()
+    for key, pi in net.param_inits.items():
+        layer, idx = key.rsplit("/", 1)
+        shape = tuple(pi.shape)
+        # bias (blob 1) of a sharded layer: 1-d over the same features
+        if (layer in sharded_layers and idx == "1" and len(shape) == 1
+                and shape[0] % m == 0 and not pi.is_stat):
+            specs[key] = P(MODEL_AXIS)
+    return specs
+
+
+class GspmdTrainer:
+    """Per-step synchronous DP(+TP) trainer: one jitted step, shardings
+    annotated, collectives compiler-inserted.  API mirrors the single-chip
+    Solver's step loop so apps can swap it in."""
+
+    def __init__(self, solver_param: SolverParameter, *, mesh: Mesh,
+                 net_param=None, precision: Optional[str] = None,
+                 min_tp_elems: int = 1 << 16,
+                 data_shapes: Optional[Dict[str, Any]] = None,
+                 batch_override: Optional[int] = None) -> None:
+        from ..core.net import Net
+
+        self.param = solver_param
+        self.mesh = mesh
+        if net_param is None:
+            net_param = (solver_param.net_param
+                         or solver_param.train_net_param)
+        assert net_param is not None, "solver needs an inline net"
+        self.net = Net(net_param, "TRAIN", data_shapes=data_shapes,
+                       batch_override=batch_override)
+        self.precision = resolve_precision(solver_param, precision)
+
+        pspecs = infer_tp_specs(self.net, mesh, min_tp_elems=min_tp_elems)
+        self.param_specs = pspecs
+        seed = int(solver_param.random_seed)
+        params0 = self.net.init_params(seed if seed >= 0 else 0)
+        shard = lambda spec: NamedSharding(mesh, spec)  # noqa: E731
+        self.params = {k: jax.device_put(v, shard(pspecs[k]))
+                       for k, v in params0.items()}
+        state0 = updates.init_state(params0,
+                                    solver_param.resolved_type())
+        # optimizer slots mirror their parameter's sharding (sharded-
+        # optimizer for TP dims)
+        self.state = {k: tuple(jax.device_put(h, shard(pspecs[k]))
+                               for h in hs)
+                      for k, hs in state0.items()}
+        self._data_sharding = shard(P(WORKER_AXIS))
+        self._repl = shard(P())
+
+        single = make_single_step(self.net, solver_param,
+                                  precision=self.precision)
+        param_sh = {k: shard(s) for k, s in pspecs.items()}
+        state_sh = {k: tuple(shard(pspecs[k]) for _ in hs)
+                    for k, hs in state0.items()}
+        in_sh = (param_sh, state_sh, self._repl, None, self._repl)
+        out_sh = (param_sh, state_sh, self._repl)
+        self._step = jax.jit(single, in_shardings=in_sh,
+                             out_shardings=out_sh, donate_argnums=(0, 1))
+        self.iter = 0
+        self._rng = jax.random.PRNGKey(seed if seed >= 0 else 0)
+        self.train_source = None
+
+    # ----------------------------------------------------------------- api
+    def set_train_data(self, source) -> None:
+        self.train_source = source
+
+    def tp_sharded_params(self) -> Dict[str, Tuple[int, ...]]:
+        """Which parameters actually shard over the model axis (for
+        introspection/tests)."""
+        return {k: tuple(self.net.param_inits[k].shape)
+                for k, s in self.param_specs.items()
+                if s != P() and MODEL_AXIS in s}
+
+    def step(self, n: int = 1) -> float:
+        assert self.train_source is not None, "set_train_data first"
+        loss = None
+        for _ in range(n):
+            batch = self.train_source()
+            inputs = {k: jax.device_put(np.asarray(v),
+                                        self._data_sharding
+                                        if np.asarray(v).ndim >= 1
+                                        else self._repl)
+                      for k, v in batch.items()}
+            rng = jax.random.fold_in(self._rng, self.iter)
+            self.params, self.state, loss = self._step(
+                self.params, self.state, jnp.int32(self.iter), inputs, rng)
+            self.iter += 1
+        return float(loss)

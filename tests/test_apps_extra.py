@@ -129,3 +129,56 @@ def test_imagenet_app_snapshot_resume(tmp_path):
     for k in da.files:
         np.testing.assert_allclose(da[k], db[k], rtol=1e-6, atol=1e-7,
                                    err_msg=k)
+
+
+def _tiny_imagenet_shards(tmp_path, n_imgs=16, size=40):
+    """Two tar shards of JPEGs + a label file."""
+    import io
+    import tarfile
+
+    from PIL import Image
+
+    rng = np.random.RandomState(0)
+    names = []
+    for s in range(2):
+        with tarfile.open(tmp_path / f"shard{s}.tar", "w") as tf:
+            for i in range(n_imgs // 2):
+                name = f"img_{s}_{i}.jpg"
+                buf = io.BytesIO()
+                Image.fromarray(rng.randint(0, 255, (size, size, 3))
+                                .astype(np.uint8)).save(buf, format="JPEG")
+                data = buf.getvalue()
+                info = tarfile.TarInfo(name)
+                info.size = len(data)
+                tf.addfile(info, io.BytesIO(data))
+                names.append(name)
+    (tmp_path / "labels.txt").write_text(
+        "\n".join(f"{n} {i % 7}" for i, n in enumerate(names)))
+    return str(tmp_path), str(tmp_path / "labels.txt")
+
+
+def test_imagenet_app_device_transform_path(tmp_path):
+    """Real-data flow with the device-side transform: raw uint8 shard
+    feeds, crop/mirror/mean fused into the compiled round, prefetch on."""
+    import tarfile  # noqa: F401  (fixture dependency)
+
+    shards, labels = _tiny_imagenet_shards(tmp_path)
+    acc = imagenet_app.run(
+        2, shards_dir=shards, label_file=labels, model="alexnet",
+        rounds=1, batch_size=2, tau=1, test_batch=2, test_every=100,
+        mesh=make_mesh(2), crop=33, device_transform=True,
+        log_path=str(tmp_path / "log.txt"))
+    assert 0.0 <= acc <= 1.0
+    log = open(tmp_path / "log.txt").read()
+    assert "device-side transform enabled" in log
+
+
+def test_imagenet_app_host_transform_path(tmp_path):
+    """Same flow with the host DataTransformer (--no-device-transform)."""
+    shards, labels = _tiny_imagenet_shards(tmp_path)
+    acc = imagenet_app.run(
+        2, shards_dir=shards, label_file=labels, model="alexnet",
+        rounds=1, batch_size=2, tau=1, test_batch=2, test_every=100,
+        mesh=make_mesh(2), crop=33, device_transform=False,
+        log_path=str(tmp_path / "log.txt"))
+    assert 0.0 <= acc <= 1.0

@@ -192,3 +192,36 @@ def test_deploy_net_with_input_fields():
     assert net.input_blobs == ["data"]
     assert net.blob_shapes["data"] == (10, 3, 227, 227)
     assert net.blob_shapes["prob"] == (10, 1000)
+
+
+def test_infogain_h_from_binaryproto(tmp_path):
+    """InfogainLoss loads its H matrix from the reference's BlobProto
+    binary format (infogain_loss_layer.cpp:18-26), not just .npy."""
+    import numpy as np
+
+    from sparknet_tpu.proto.binaryproto import write_blob
+
+    rng = np.random.RandomState(0)
+    H = rng.rand(3, 3).astype(np.float32)
+    path = str(tmp_path / "H.binaryproto")
+    open(path, "wb").write(write_blob(H))
+    net_txt = f"""
+layer {{ name: "data" type: "MemoryData" top: "data" top: "label"
+  memory_data_param {{ batch_size: 4 channels: 3 height: 1 width: 1 }} }}
+layer {{ name: "prob" type: "Softmax" bottom: "data" top: "prob" }}
+layer {{ name: "loss" type: "InfogainLoss" bottom: "prob" bottom: "label"
+  top: "loss" infogain_loss_param {{ source: "{path}" }} }}
+"""
+    from sparknet_tpu.proto import caffe_pb
+
+    net = Net(caffe_pb.parse_net_text(net_txt), "TRAIN")
+    params = net.init_params(0)
+    x = rng.rand(4, 3, 1, 1).astype(np.float32)
+    y = rng.randint(0, 3, (4,)).astype(np.int32)
+    blobs, _ = net.apply(params, {"data": x, "label": y}, train=True)
+    # hand-computed: -sum_j H[label,j] log p_j / N
+    import jax.numpy as jnp
+    p = np.asarray(blobs["prob"]).reshape(4, 3)
+    expect = -sum(np.dot(H[y[i]], np.log(np.maximum(p[i], 1e-20)))
+                  for i in range(4)) / 4
+    np.testing.assert_allclose(float(blobs["loss"]), expect, rtol=1e-5)
