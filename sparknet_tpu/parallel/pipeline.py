@@ -1,0 +1,306 @@
+"""Pipeline parallelism: GPipe-style microbatched training over a chain of
+devices.
+
+The reference has no pipeline parallelism (SURVEY.md §2.3 — not required
+for parity); this is beyond-parity capability completing the framework's
+parallelism inventory (DP: dist.py / gspmd.py, TP: gspmd.py, SP:
+ring_attention.py, PP: here).
+
+Design: the net's layer graph is cut into S consecutive stages.  Each stage
+compiles to its own XLA program pinned to one device of a `pipe` chain;
+activations stay device-resident and hop stage-to-stage as device arrays
+(ICI neighbor transfers on real hardware).  Training follows the GPipe
+schedule (arXiv:1811.06965): a round splits the batch into M microbatches,
+streams them through the forward chain, then replays the saved VJPs in
+reverse to accumulate per-stage gradients; the optimizer update applies the
+shared Caffe-exact pipeline (clip -> regularize -> LR policy -> update) to
+every stage's params.  Gradients are summed over microbatches and divided
+by M, so the result is numerically the plain single-device step on the full
+batch — asserted exactly in tests/test_pipeline.py.
+
+Host-orchestrated scheduling (one dispatch per stage per microbatch) is the
+deliberate trade: stages keep their natural, heterogeneous activation
+shapes (conv nets shrink spatially) with no padded uniform buffers, at the
+cost of O(S*M) dispatches per round — fine when microbatches are large, the
+regime PP exists for.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..proto.caffe_pb import SolverParameter
+from ..solver import updates
+from ..solver.lr_policies import learning_rate
+from ..solver.solver import resolve_precision
+
+
+def split_stages(net, n_stages: int) -> List[List[int]]:
+    """Cut net.layers into n_stages consecutive runs, balanced by parameter
+    count (the dominant per-stage cost for fc-heavy tails).  Data/feed
+    layers (no bottoms) stay in stage 0."""
+    sizes = []
+    for bl in net.layers:
+        n = sum(int(np.prod(net.param_inits[k].shape))
+                for k in bl.param_keys)
+        sizes.append(max(n, 1))
+    total = float(sum(sizes))
+    target = total / n_stages
+    stages: List[List[int]] = [[] for _ in range(n_stages)]
+    acc = 0.0
+    s = 0
+    for i, bl in enumerate(net.layers):
+        if s < n_stages - 1 and acc >= target * (s + 1) and stages[s]:
+            s += 1
+        stages[s].append(i)
+        acc += sizes[i]
+    return stages
+
+
+class PipelineTrainer:
+    """GPipe microbatch trainer over a device chain.
+
+    API mirrors the single-chip Solver's step loop.  `devices` defaults to
+    the first n_stages of jax.devices() (a `pipe` chain)."""
+
+    def __init__(self, solver_param: SolverParameter, *, n_stages: int,
+                 n_micro: int, net_param=None,
+                 devices: Optional[Sequence[Any]] = None,
+                 data_shapes: Optional[Dict[str, Any]] = None,
+                 batch_override: Optional[int] = None,
+                 precision: Optional[str] = None) -> None:
+        from ..core.net import Net
+
+        self.param = solver_param
+        self.n_micro = int(n_micro)
+        if net_param is None:
+            net_param = (solver_param.net_param
+                         or solver_param.train_net_param)
+        assert net_param is not None, "solver needs an inline net"
+        self.net = Net(net_param, "TRAIN", data_shapes=data_shapes,
+                       batch_override=batch_override)
+        self.precision = resolve_precision(solver_param, precision)
+        self.devices = list(devices if devices is not None
+                            else jax.devices()[:n_stages])
+        if len(self.devices) < n_stages:
+            raise ValueError(f"need {n_stages} devices, have "
+                             f"{len(self.devices)}")
+        self.stage_layers = split_stages(self.net, n_stages)
+        self.n_stages = n_stages
+
+        seed = int(solver_param.random_seed)
+        params0 = self.net.init_params(seed if seed >= 0 else 0)
+        self._key_stage: Dict[str, int] = {}
+        for s, idxs in enumerate(self.stage_layers):
+            for i in idxs:
+                for k in self.net.layers[i].param_keys:
+                    self._key_stage.setdefault(k, s)
+        # each stage's params live on its own device
+        self.params = {k: jax.device_put(v,
+                                         self.devices[self._key_stage[k]])
+                       for k, v in params0.items()}
+        state0 = updates.init_state(params0, solver_param.resolved_type())
+        self.state = {k: tuple(jax.device_put(
+            h, self.devices[self._key_stage[k]]) for h in hs)
+            for k, hs in state0.items()}
+        self.iter = 0
+        self._rng = jax.random.PRNGKey(seed if seed >= 0 else 0)
+        self.train_source = None
+        # static properties of the cut, computed once
+        self._stat_keys = set(self.net.stat_keys())
+        self._keeps = [self._carry_blobs(s) for s in range(n_stages)]
+        self._loss_stage: Dict[str, int] = {}
+        for st, idxs in enumerate(self.stage_layers):
+            for i in idxs:
+                for top in self.net.layers[i].tops:
+                    self._loss_stage.setdefault(top, st)
+        # per-stage compiled programs: forward (activations + loss + BN
+        # stats) and rematerializing backward (GPipe recomputes the stage
+        # forward under vjp instead of saving live residuals)
+        self._stage_raw = [self._make_stage_fn(s) for s in range(n_stages)]
+        self._fwd = [jax.jit(f) for f in self._stage_raw]
+        self._bwd = [jax.jit(self._make_bwd(s)) for s in range(n_stages)]
+        from ..solver.solver import make_update_fn
+
+        self._update_fn = jax.jit(make_update_fn(self.net, solver_param),
+                                  donate_argnums=(0, 1))
+
+    # ----------------------------------------------------------- stage fns
+    def _make_stage_fn(self, s: int):
+        """Stage forward: (stage_params, carried_blobs, rng) ->
+        (carried_blobs', loss_contrib, stat_updates).  Carries exactly the
+        blobs later stages still need (self._keeps[s], from the cut);
+        stat_updates are BatchNorm running-stat refreshes, written back to
+        the owning stage's params (make_single_step does the same)."""
+        net = self.net
+        idxs = self.stage_layers[s]
+        half = self.precision == "bfloat16"
+        stat_keys = set(net.stat_keys())
+
+        def fn(stage_params, blobs, rng):
+            blobs = dict(blobs)
+            loss = jnp.float32(0.0)
+            stats_out = {}
+            for i in idxs:
+                bl = net.layers[i]
+                pvals = [stage_params[k] for k in bl.param_keys]
+                if half:
+                    pvals = [p.astype(jnp.bfloat16)
+                             if (k not in stat_keys and
+                                 jnp.issubdtype(p.dtype, jnp.floating))
+                             else p
+                             for k, p in zip(bl.param_keys, pvals)]
+                bvals = [blobs[b] for b in bl.bottoms]
+                layer_rng = (jax.random.fold_in(rng, i)
+                             if bl.needs_rng else None)
+                tops, stats = bl.fn(pvals, bvals, layer_rng, True)
+                stats_out.update(stats)
+                for t, v in zip(bl.tops, tops):
+                    blobs[t] = v
+            # loss terms produced in this stage (same accumulation as
+            # Net.apply, core/net.py: loss += w * sum(blob))
+            for name, weight in net.loss_terms:
+                if name in blobs and self._loss_stage.get(name) == s:
+                    loss = loss + jnp.float32(weight) * jnp.sum(
+                        blobs[name]).astype(jnp.float32)
+            keep = self._keeps[s]
+            return {k: blobs[k] for k in keep}, loss, stats_out
+
+        return fn
+
+    def _make_bwd(self, s: int):
+        """Rematerializing stage backward (GPipe: recompute the stage
+        forward under vjp instead of holding residuals across the
+        schedule): (params, blobs_in, cot_carry, cot_loss, rng) ->
+        (g_params, g_blobs)."""
+        raw = self._stage_raw[s]
+
+        def bwd(ps, blobs, cot_carry, cot_loss, rng):
+            def f(ps, blobs):
+                carry, loss, _stats = raw(ps, blobs, rng)
+                return carry, loss
+
+            _, vjp = jax.vjp(f, ps, blobs)
+            return vjp((cot_carry, cot_loss))
+
+        return bwd
+
+    def _carry_blobs(self, s: int) -> List[str]:
+        """Blobs that must cross the s -> s+1 boundary: produced (or fed)
+        at stage <= s and consumed at stage > s."""
+        # first stage where each blob becomes available (setdefault: an
+        # in-place layer like ReLU re-produces its bottom under the same
+        # name in a later stage — the value still first exists, and is
+        # carried, from its original producer)
+        produced: Dict[str, int] = {b: 0 for b in self.net.input_blobs}
+        for t, idxs in enumerate(self.stage_layers):
+            for i in idxs:
+                for top in self.net.layers[i].tops:
+                    produced.setdefault(top, t)
+        needed = set()
+        for t in range(s + 1, self.n_stages):
+            for i in self.stage_layers[t]:
+                for b in self.net.layers[i].bottoms:
+                    if produced.get(b, self.n_stages) <= s:
+                        needed.add(b)
+        return sorted(needed)
+
+    # ---------------------------------------------------------------- api
+    def set_train_data(self, source: Callable[[], Dict[str, Any]]) -> None:
+        self.train_source = source
+
+    def stage_of(self, key: str) -> int:
+        return self._key_stage[key]
+
+    def step(self, n: int = 1) -> float:
+        """n full-batch iterations, each = GPipe forward stream + VJP
+        replay + one shared-pipeline update."""
+        assert self.train_source is not None, "set_train_data first"
+        loss_val = 0.0
+        for _ in range(n):
+            batch = {k: np.asarray(v)
+                     for k, v in self.train_source().items()}
+            loss_val = self._one_iteration(batch)
+            self.iter += 1
+        return loss_val
+
+    def _one_iteration(self, batch: Dict[str, np.ndarray]) -> float:
+        M, S = self.n_micro, self.n_stages
+        n = next(iter(batch.values())).shape[0]
+        if n % M:
+            raise ValueError(
+                f"batch size {n} must divide n_micro={M}: unequal "
+                f"microbatches would skew the per-micro loss "
+                f"normalization away from the full-batch step")
+        rng = jax.random.fold_in(self._rng, self.iter)
+        micro = [{k: v[m::M] for k, v in batch.items()} for m in range(M)]
+        stage_params = [
+            {k: self.params[k] for k in self._key_stage
+             if self._key_stage[k] == s} for s in range(S)]
+
+        # forward stream: each (stage, micro) runs its compiled program;
+        # the GPipe overlap emerges from async dispatch — stage s works on
+        # micro m while stage s-1 runs micro m+1 (per-device XLA queues).
+        # BN stats chain micro-to-micro (M sequential refreshes, the same
+        # accumulation M sequential full forwards would produce).
+        inputs: List[List[Any]] = [[None] * M for _ in range(S)]
+        mrngs: List[Any] = [jax.random.fold_in(rng, m) for m in range(M)]
+        loss_parts: List[Any] = []  # every stage's contribution (aux heads)
+        for m in range(M):
+            carry = {k: jax.device_put(v, self.devices[0])
+                     for k, v in micro[m].items()}
+            for s in range(S):
+                inputs[s][m] = carry
+                carry, loss, stats = self._fwd[s](stage_params[s], carry,
+                                                  mrngs[m])
+                loss_parts.append(loss)
+                if stats:
+                    stage_params[s] = {**stage_params[s], **stats}
+                if s < S - 1:
+                    carry = {k: jax.device_put(v, self.devices[s + 1])
+                             for k, v in carry.items()}
+
+        # backward: rematerializing per-stage VJP, reverse stage order per
+        # microbatch.  Stage s's carry keys are keep_s; their cotangent is
+        # exactly the g_blobs the stage-(s+1) backward produced.
+        grads_acc: List[Optional[Dict[str, Any]]] = [None] * S
+        for m in range(M):
+            cot: Dict[str, Any] = {}  # last stage carries no blobs
+            for s in reversed(range(S)):
+                # equal microbatches: full-batch loss = mean of micro
+                # losses, so each micro loss seeds with cotangent 1/M
+                g_params, g_blobs = self._bwd[s](
+                    stage_params[s], inputs[s][m], cot,
+                    jnp.float32(1.0 / M), mrngs[m])
+                grads_acc[s] = (g_params if grads_acc[s] is None else
+                                {k: grads_acc[s][k] + g
+                                 for k, g in g_params.items()})
+                if s > 0:
+                    cot = {k: jax.device_put(v, self.devices[s - 1])
+                           for k, v in g_blobs.items()}
+
+        total_loss = sum(float(l) for l in loss_parts) / M
+        # one update per stage with the shared Caffe pipeline.  Stat
+        # params stay OUT of the (buffer-donating) update — they are
+        # forward-refreshed, not gradient-trained, and passing them
+        # through donation would leave dead buffers in self.params
+        for s in range(S):
+            learn = {k: v for k, v in stage_params[s].items()
+                     if k not in self._stat_keys}
+            for k, v in stage_params[s].items():
+                if k in self._stat_keys:
+                    self.params[k] = v  # refreshed running stats
+            if not learn:
+                continue
+            sub_state = {k: self.state[k] for k in learn}
+            grads = {k: grads_acc[s][k] for k in learn}
+            new_p, new_s = self._update_fn(learn, sub_state, grads,
+                                           jnp.int32(self.iter))
+            for k in new_p:
+                self.params[k] = new_p[k]
+                self.state[k] = new_s[k]
+        return total_loss

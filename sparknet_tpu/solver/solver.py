@@ -84,6 +84,33 @@ def make_loss_fn(net: Net, precision: str):
     return loss_fn
 
 
+def make_update_fn(net: Net, sp: SolverParameter):
+    """The shared post-gradient pipeline as a pure function
+    (params, state, grads, it) -> (new_params, new_state): clip ->
+    regularize -> LR policy -> solver update, in the reference's order
+    (SGDSolver::ApplyUpdate, sgd_solver.cpp:102-240).  Used by
+    make_single_step and by trainers that produce gradients their own way
+    (the GPipe pipeline) so the update math exists once."""
+    clip = float(sp.clip_gradients)
+    weight_decay = float(sp.weight_decay)
+    reg_type = str(sp.regularization_type)
+    hyper = dict(momentum=float(sp.momentum), delta=float(sp.delta),
+                 momentum2=float(sp.momentum2), rms_decay=float(sp.rms_decay))
+    solver_type = sp.resolved_type()
+    lr_mults = net.lr_multipliers()
+    decay_mults = net.decay_multipliers()
+
+    def update(params, state, grads, it):
+        grads = updates.clip_gradients(grads, clip)
+        grads = updates.regularize(params, grads, weight_decay, decay_mults,
+                                   reg_type)
+        rate = learning_rate(sp, it)
+        return updates.apply_update(solver_type, params, grads, state,
+                                    rate, it, lr_mults=lr_mults, **hyper)
+
+    return update
+
+
 def make_single_step(net: Net, sp: SolverParameter,
                      precision: Optional[str] = None,
                      grad_sync: Optional[Callable] = None):
@@ -99,29 +126,16 @@ def make_single_step(net: Net, sp: SolverParameter,
     clip/regularize/update pipeline — the distributed trainer's per-step
     gradient `pmean` (the P2PSync on_gradients_ready analogue,
     parallel.cpp:325-381) plugs in here so the update math exists once."""
-    clip = float(sp.clip_gradients)
-    weight_decay = float(sp.weight_decay)
-    reg_type = str(sp.regularization_type)
-    hyper = dict(momentum=float(sp.momentum), delta=float(sp.delta),
-                 momentum2=float(sp.momentum2), rms_decay=float(sp.rms_decay))
-    solver_type = sp.resolved_type()
-    lr_mults = net.lr_multipliers()
-    decay_mults = net.decay_multipliers()
     precision = resolve_precision(sp, precision)
     loss_fn = make_loss_fn(net, precision)
+    update = make_update_fn(net, sp)
 
     def single_step(params, state, it, inputs, rng):
         (loss, stats), grads = jax.value_and_grad(
             loss_fn, has_aux=True)(params, inputs, rng)
         if grad_sync is not None:
             grads, loss = grad_sync(grads, loss)
-        grads = updates.clip_gradients(grads, clip)
-        grads = updates.regularize(params, grads, weight_decay, decay_mults,
-                                   reg_type)
-        rate = learning_rate(sp, it)
-        new_p, new_s = updates.apply_update(
-            solver_type, params, grads, state, rate, it,
-            lr_mults=lr_mults, **hyper)
+        new_p, new_s = update(params, state, grads, it)
         for k, v in stats.items():
             new_p[k] = v
         return new_p, new_s, loss

@@ -1,0 +1,136 @@
+"""GPipe pipeline parallelism: per-stage programs on a device chain,
+numerically identical to the single-device full-batch step."""
+
+import jax
+import numpy as np
+import pytest
+
+from sparknet_tpu.parallel.pipeline import PipelineTrainer, split_stages
+from sparknet_tpu.proto import caffe_pb
+from sparknet_tpu.proto.textformat import parse
+from sparknet_tpu.solver.solver import Solver
+
+NET = """
+layer { name: "data" type: "MemoryData" top: "data" top: "label"
+  memory_data_param { batch_size: 8 channels: 3 height: 8 width: 8 } }
+layer { name: "conv1" type: "Convolution" bottom: "data" top: "conv1"
+  convolution_param { num_output: 8 kernel_size: 3 pad: 1
+    weight_filler { type: "gaussian" std: 0.1 } } }
+layer { name: "relu1" type: "ReLU" bottom: "conv1" top: "conv1" }
+layer { name: "pool1" type: "Pooling" bottom: "conv1" top: "pool1"
+  pooling_param { pool: MAX kernel_size: 2 stride: 2 } }
+layer { name: "ip1" type: "InnerProduct" bottom: "pool1" top: "ip1"
+  inner_product_param { num_output: 32
+    weight_filler { type: "gaussian" std: 0.05 } } }
+layer { name: "relu2" type: "ReLU" bottom: "ip1" top: "ip1" }
+layer { name: "ip2" type: "InnerProduct" bottom: "ip1" top: "ip2"
+  inner_product_param { num_output: 10
+    weight_filler { type: "gaussian" std: 0.05 } } }
+layer { name: "loss" type: "SoftmaxWithLoss" bottom: "ip2" bottom: "label"
+  top: "loss" }
+"""
+
+
+def _sp():
+    sp = caffe_pb.SolverParameter(parse(
+        'base_lr: 0.05\nlr_policy: "fixed"\nmomentum: 0.9\n'
+        'weight_decay: 0.0005\nrandom_seed: 13'))
+    sp.msg.set("net_param", caffe_pb.parse_net_text(NET).msg)
+    return sp
+
+
+def _stream(n=5, seed=3):
+    rng = np.random.RandomState(seed)
+    return [{"data": rng.rand(8, 3, 8, 8).astype(np.float32),
+             "label": rng.randint(0, 10, (8,)).astype(np.int32)}
+            for _ in range(n)]
+
+
+def test_split_stages_consecutive_and_complete():
+    from sparknet_tpu.core.net import Net
+
+    net = Net(caffe_pb.parse_net_text(NET), "TRAIN")
+    stages = split_stages(net, 3)
+    flat = [i for st in stages for i in st]
+    assert flat == list(range(len(net.layers)))
+    assert all(st for st in stages)
+
+
+@pytest.mark.parametrize("n_stages,n_micro", [(2, 2), (4, 4), (3, 2)])
+def test_pipeline_matches_single_device(n_stages, n_micro):
+    """S-stage pipeline with M microbatches == the plain full-batch step
+    (GPipe is exact for non-BN nets): loss AND parameters, several
+    iterations deep (momentum included)."""
+    stream = _stream()
+    pt = PipelineTrainer(_sp(), n_stages=n_stages, n_micro=n_micro)
+    it = iter(stream)
+    pt.set_train_data(lambda: next(it))
+
+    ref = Solver(_sp())
+    it2 = iter(stream)
+
+    def reorder(batch):
+        # pipeline microbatches are strided interleaves of the batch; the
+        # loss/grad mean is permutation-invariant, so feed the same batch
+        return batch
+
+    ref.set_train_data(lambda: reorder(next(it2)))
+
+    for _ in range(3):
+        lp = pt.step(1)
+        lr = ref.step(1)
+    np.testing.assert_allclose(lp, lr, rtol=2e-5)
+    for k, v in ref.params.items():
+        np.testing.assert_allclose(np.asarray(pt.params[k]), np.asarray(v),
+                                   rtol=2e-4, atol=1e-6, err_msg=k)
+
+
+def test_pipeline_params_live_on_stage_devices():
+    pt = PipelineTrainer(_sp(), n_stages=4, n_micro=2)
+    devs = {pt.stage_of(k): list(pt.params[k].devices())[0]
+            for k in pt.params}
+    assert len(set(devs.values())) > 1, "stages must span devices"
+    for k in pt.params:
+        assert list(pt.params[k].devices())[0] == \
+            pt.devices[pt.stage_of(k)]
+
+
+def test_pipeline_batch_not_divisible_raises():
+    pt = PipelineTrainer(_sp(), n_stages=2, n_micro=3)
+    rng = np.random.RandomState(0)
+    pt.set_train_data(lambda: {
+        "data": rng.rand(8, 3, 8, 8).astype(np.float32),
+        "label": rng.randint(0, 10, (8,)).astype(np.int32)})
+    with pytest.raises(ValueError, match="divide"):
+        pt.step(1)
+
+
+def test_pipeline_batchnorm_stats_refresh():
+    """BatchNorm running stats update through the pipeline (the stage
+    forward's stat outputs are written back, chained across microbatches —
+    without this TEST-phase inference would silently use mean=0/var=1)."""
+    net_txt = """
+layer { name: "data" type: "MemoryData" top: "data" top: "label"
+  memory_data_param { batch_size: 8 channels: 3 height: 4 width: 4 } }
+layer { name: "bn" type: "BatchNorm" bottom: "data" top: "bn" }
+layer { name: "ip" type: "InnerProduct" bottom: "bn" top: "ip"
+  inner_product_param { num_output: 5
+    weight_filler { type: "gaussian" std: 0.1 } } }
+layer { name: "loss" type: "SoftmaxWithLoss" bottom: "ip" bottom: "label"
+  top: "loss" }
+"""
+    sp = caffe_pb.SolverParameter(parse(
+        'base_lr: 0.01\nlr_policy: "fixed"\nmomentum: 0.9\nrandom_seed: 1'))
+    sp.msg.set("net_param", caffe_pb.parse_net_text(net_txt).msg)
+    pt = PipelineTrainer(sp, n_stages=2, n_micro=2)
+    stat_keys = [k for k in pt.params if k in pt._stat_keys]
+    assert stat_keys, "net should have BN stat params"
+    before = {k: np.asarray(pt.params[k]).copy() for k in stat_keys}
+    rng = np.random.RandomState(0)
+    pt.set_train_data(lambda: {
+        "data": (rng.rand(8, 3, 4, 4) * 3 + 1).astype(np.float32),
+        "label": rng.randint(0, 5, (8,)).astype(np.int32)})
+    pt.step(2)
+    changed = [k for k in stat_keys
+               if not np.allclose(before[k], np.asarray(pt.params[k]))]
+    assert changed, "BN running stats must refresh during training"
