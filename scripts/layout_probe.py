@@ -1,0 +1,92 @@
+"""Probe: NCHW vs NHWC conv layout on TPU, fwd+bwd, bf16.
+
+Representative shapes from AlexNet and GoogLeNet (the two bench models).
+Each measurement is ONE compiled program scanning `iters` dependent
+fwd+bwd conv steps, so per-launch dispatch noise (severe on the tunneled
+dev platform) cancels.  Decides whether an internal-NHWC layout pass is
+worth building.
+"""
+
+import sys
+import time
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+SHAPES = [
+    # name, N, C, H, W, K(out), kh, stride, pad
+    ("alex_conv1", 256, 3, 227, 227, 96, 11, 4, 0),
+    ("alex_conv2", 256, 96, 27, 27, 256, 5, 1, 2),
+    ("alex_conv3", 256, 256, 13, 13, 384, 3, 1, 1),
+    ("goog_conv1", 64, 3, 224, 224, 64, 7, 2, 3),
+    ("goog_conv2", 64, 64, 56, 56, 192, 3, 1, 1),
+    ("goog_3a_3x3", 64, 96, 28, 28, 128, 3, 1, 1),
+    ("goog_4a_1x1", 64, 480, 14, 14, 192, 1, 1, 0),
+]
+
+ITERS = 30
+
+
+def chain_time(make_loss, x, wt):
+    """One jitted scan of ITERS dependent grad steps; returns s/step."""
+    grad = jax.grad(lambda w_, x_: make_loss(x_, w_))
+
+    @jax.jit
+    def run(w0):
+        def body(w_, _):
+            g = grad(w_, x)
+            return (w_ - 1e-12 * g).astype(w_.dtype), ()
+        wN, _ = lax.scan(body, w0, None, length=ITERS)
+        return jnp.sum(wN.astype(jnp.float32))
+
+    jax.block_until_ready(run(wt))  # compile
+    t0 = time.perf_counter()
+    jax.block_until_ready(run(wt))
+    return (time.perf_counter() - t0) / ITERS
+
+
+def main():
+    rng = np.random.RandomState(0)
+    print("device:", jax.devices()[0])
+    tot = {"NCHW": 0.0, "NHWC": 0.0}
+    for name, n, c, h, w, k, kh, st, pd in SHAPES:
+        oh = (h + 2 * pd - kh) // st + 1
+        # fwd + weight-grad only: the chain takes grad w.r.t. the weights,
+        # so XLA dead-code-eliminates the input-gradient conv
+        flops = 2 * n * k * c * kh * kh * oh * oh * 2
+
+        x_nchw = jnp.asarray(rng.rand(n, c, h, w), jnp.bfloat16)
+        w_oihw = jnp.asarray(rng.rand(k, c, kh, kh), jnp.bfloat16)
+        x_nhwc = jnp.transpose(x_nchw, (0, 2, 3, 1))
+        w_hwio = jnp.transpose(w_oihw, (2, 3, 1, 0))
+
+        def loss_nchw(x, wt):
+            y = lax.conv_general_dilated(
+                x, wt, (st, st), [(pd, pd), (pd, pd)],
+                dimension_numbers=("NCHW", "OIHW", "NCHW"))
+            return jnp.sum(y.astype(jnp.float32))
+
+        def loss_nhwc(x, wt):
+            y = lax.conv_general_dilated(
+                x, wt, (st, st), [(pd, pd), (pd, pd)],
+                dimension_numbers=("NHWC", "HWIO", "NHWC"))
+            return jnp.sum(y.astype(jnp.float32))
+
+        t1 = chain_time(loss_nchw, x_nchw, w_oihw)
+        t2 = chain_time(loss_nhwc, x_nhwc, w_hwio)
+        tot["NCHW"] += t1
+        tot["NHWC"] += t2
+        print(f"{name:14s} NCHW {t1*1e3:7.2f} ms ({flops/t1/1e12:6.1f} TF/s)"
+              f"  NHWC {t2*1e3:7.2f} ms ({flops/t2/1e12:6.1f} TF/s)"
+              f"  ratio {t1/t2:5.2f}x")
+        sys.stdout.flush()
+    print(f"TOTAL          NCHW {tot['NCHW']*1e3:7.2f} ms   "
+          f"NHWC {tot['NHWC']*1e3:7.2f} ms   "
+          f"ratio {tot['NCHW']/tot['NHWC']:5.2f}x")
+
+
+if __name__ == "__main__":
+    main()

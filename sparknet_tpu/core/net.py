@@ -180,6 +180,35 @@ class Net:
                 if w != 0.0:
                     self.loss_terms.append((t, float(w)))
 
+        # compiled Filter keeps static capacity with zeroed padding rows
+        # (see build_filter); zeros are NOT neutral inside loss layers
+        # (a zero logit row still contributes log(C) to SoftmaxWithLoss and
+        # inflates the normalizer), so flag filtered blobs that reach one —
+        # the reference forwards only selected rows (filter_layer.cpp)
+        tainted: set = set()
+        for bl in self.layers:
+            if bl.type == "Filter":
+                tainted.update(bl.tops[:-1])  # data tops, not __count
+        loss_blobs = {t for t, _ in self.loss_terms}
+        for bl in self.layers:
+            hit = tainted.intersection(bl.bottoms)
+            if not hit:
+                continue
+            # anything that AVERAGES over items counts the padding: loss
+            # layers, Accuracy, and any layer given an explicit loss_weight
+            if (bl.type in LOSS_TYPES or bl.type == "Accuracy"
+                    or loss_blobs.intersection(bl.tops)):
+                import warnings
+                warnings.warn(
+                    f"layer {bl.name!r} ({bl.type}) consumes "
+                    f"Filter-derived blob(s) {sorted(hit)}: the compiled "
+                    f"Filter pads rejected rows with zeros, which "
+                    f"loss/accuracy reductions count; slice top[:count] "
+                    f"host-side (ops.filter_op) for reference filter "
+                    f"semantics", stacklevel=2)
+            else:
+                tainted.update(bl.tops)
+
     def _layer_params(self, layer: LayerParameter,
                       specs: List[Tuple[Tuple[int, ...], FillerParameter]],
                       default_lr: Sequence[float] = (),
@@ -873,10 +902,58 @@ def build_batch_reindex(net: Net, layer: LayerParameter, bshapes):
 
 @register("Filter")
 def build_filter(net: Net, layer: LayerParameter, bshapes):
-    raise NotImplementedError(
-        "Filter produces data-dependent shapes, which cannot be compiled for "
-        "TPU; use ops.filter_op host-side instead "
-        "(reference: caffe/src/caffe/layers/filter_layer.cpp)")
+    """TPU-native Filter (reference: caffe/src/caffe/layers/filter_layer.cpp).
+
+    The reference emits tops shaped (num_selected, ...) — a data-dependent
+    shape that cannot exist in a compiled XLA program.  The TPU redesign keeps
+    static capacity: selected items are packed to the front **in original
+    order** (as the reference's indices_to_forward_ loop does), trailing rows
+    are zeroed, and the live count rides as an extra scalar top
+    `<name>__count` so the host slices `top[:count]`.  `ops.filter_op` still
+    gives the exact reference shape for eager/host use.  Backward matches
+    filter_layer.cpp:67-92: gradients scatter to the selected rows and are
+    zero elsewhere — jnp.take's VJP is exactly that scatter, and the zeroed
+    padding rows contribute nothing.
+    """
+    n = int(bshapes[0][0])
+    if len(layer.tops) != len(layer.bottoms) - 1:
+        raise ValueError(
+            f"Filter {layer.name!r}: needs one top per data bottom "
+            f"(got {len(layer.tops)} tops for {len(layer.bottoms) - 1} "
+            f"data bottoms; reference filter_layer.cpp checks the same)")
+    for s in bshapes[:-1]:
+        if int(s[0]) != n:
+            raise ValueError(
+                f"Filter {layer.name!r}: all data bottoms must share the "
+                f"batch dim (got {[tuple(x) for x in bshapes[:-1]]})")
+    if int(np.prod(bshapes[-1])) != n:
+        raise ValueError(
+            f"Filter {layer.name!r}: selector must have one value per item "
+            f"(selector shape {tuple(bshapes[-1])}, batch {n})")
+    out_shapes = [tuple(s) for s in bshapes[:-1]] + [(1,)]
+    tops = list(layer.tops) + [f"{layer.name}__count"]
+
+    def fn(pvals, bvals, rng, train):
+        sel = bvals[-1].reshape(-1)
+        mask = sel != 0
+        count = jnp.sum(mask.astype(jnp.int32))
+        # order-preserving pack without relying on sort stability: selected
+        # items keep key i in [0, n), rejected get n + i — one int argsort
+        idx = jnp.arange(n, dtype=jnp.int32)
+        order = jnp.argsort(jnp.where(mask, idx, n + idx))
+        keep = idx < count
+        outs = []
+        for x in bvals[:-1]:
+            packed = jnp.take(x, order, axis=0)
+            bc = keep.reshape((n,) + (1,) * (x.ndim - 1))
+            outs.append(jnp.where(bc, packed, jnp.zeros_like(packed)))
+        outs.append(count.reshape(1).astype(jnp.float32))
+        return outs, {}
+
+    bl = BuiltLayer(name=str(layer.name), type=str(layer.type),
+                    bottoms=layer.bottoms, tops=tops,
+                    param_keys=[], fn=fn, needs_rng=False)
+    return bl, out_shapes, []
 
 
 @register("Silence")
@@ -967,6 +1044,64 @@ def build_attention(net: Net, layer: LayerParameter, bshapes):
         return [y], {}
 
     return _simple(net, layer, fn, [(n, s, e)], pinits)
+
+
+@register("MoE")
+def build_moe(net: Net, layer: LayerParameter, bshapes):
+    """Mixture-of-experts FFN — this framework's own extension layer
+    (moe_param; see proto/caffe_pb.py MoEParameter and ops/moe.py).  Bottom
+    (N, M) or (N, S, M); top has the same shape.  Blobs, Caffe-style:
+    gate (M, E), w1 (E, M, H), [b1 (E, H)], w2 (E, H, M), [b2 (E, M)].
+    Tokens routed past expert capacity produce zeros — compose with an
+    Eltwise SUM skip for the standard residual block.  The Switch
+    load-balancing aux loss rides an extra `<name>__aux_loss` top joined to
+    the training objective with weight aux_loss_weight; expert-parallel
+    execution over a mesh axis lives in parallel/expert.py."""
+    mp = layer.moe_param
+    shape = tuple(int(d) for d in bshapes[0])
+    if len(shape) not in (2, 3):
+        raise ValueError(f"MoE {layer.name!r}: bottom must be (N, M) or "
+                         f"(N, S, M), got {shape}")
+    m = shape[-1]
+    e = int(mp.num_experts)
+    h = int(mp.hidden_dim) or 4 * m
+    k = int(mp.k)
+    cf = float(mp.capacity_factor)
+    if not 1 <= k <= e:
+        raise ValueError(f"MoE {layer.name!r}: k={k} must be in [1, {e}]")
+    bias = bool(mp.bias_term)
+    wf = mp.weight_filler
+    if not wf.msg.has("type"):
+        wf = _default_filler(type="xavier")
+    specs = [((m, e), wf), ((e, m, h), wf)]
+    if bias:
+        specs.append(((e, h), mp.bias_filler))
+    specs.append(((e, h, m), wf))
+    if bias:
+        specs.append(((e, m), mp.bias_filler))
+    pinits = net._layer_params(layer, specs)
+    aux_top = f"{layer.name}__aux_loss"
+    aux_w = float(mp.aux_loss_weight)
+    if aux_w > 0:
+        net.loss_terms.append((aux_top, aux_w))
+
+    def fn(pvals, bvals, rng, train):
+        if bias:
+            gate_w, w1, b1, w2, b2 = pvals
+        else:
+            gate_w, w1, w2 = pvals
+            b1 = jnp.zeros((w1.shape[0], w1.shape[2]), w1.dtype)
+            b2 = jnp.zeros((w2.shape[0], w2.shape[2]), w2.dtype)
+        y, aux = ops.moe_ffn(bvals[0], gate_w, w1, b1, w2, b2, k=k,
+                             capacity_factor=cf)
+        return [y, aux.reshape(1)], {}
+
+    bl = BuiltLayer(name=str(layer.name), type=str(layer.type),
+                    bottoms=layer.bottoms,
+                    tops=list(layer.tops) + [aux_top],
+                    param_keys=[pi.key for pi in pinits], fn=fn,
+                    needs_rng=False)
+    return bl, [shape, (1,)], pinits
 
 
 @register("Python")

@@ -16,7 +16,15 @@ reverse to accumulate per-stage gradients; the optimizer update applies the
 shared Caffe-exact pipeline (clip -> regularize -> LR policy -> update) to
 every stage's params.  Gradients are summed over microbatches and divided
 by M, so the result is numerically the plain single-device step on the full
-batch — asserted exactly in tests/test_pipeline.py.
+batch — asserted exactly in tests/test_pipeline.py.  The 1/M weighting is
+exact when each micro loss normalizes proportionally to its item count
+(all bundled losses); a SoftmaxWithLoss with `ignore_label` normalizes by
+its own micro valid count instead, making this the mean-of-micro-means —
+the same semantics Caffe's own `iter_size` accumulation has
+(solver.cpp:221-224 divides the summed loss by iter_size, and
+sgd_solver.cpp:120-123 the gradients, regardless of per-sub-batch valid
+counts), so parity with the reference's accumulation behavior is
+preserved even there.
 
 Host-orchestrated scheduling (one dispatch per stage per microbatch) is the
 deliberate trade: stages keep their natural, heterogeneous activation
@@ -27,7 +35,7 @@ regime PP exists for.
 
 from __future__ import annotations
 
-from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+from typing import Any, Callable, Dict, List, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
@@ -35,7 +43,6 @@ import numpy as np
 
 from ..proto.caffe_pb import SolverParameter
 from ..solver import updates
-from ..solver.lr_policies import learning_rate
 from ..solver.solver import resolve_precision
 
 
@@ -77,6 +84,11 @@ class PipelineTrainer:
 
         self.param = solver_param
         self.n_micro = int(n_micro)
+        if int(solver_param.iter_size) > 1:
+            raise NotImplementedError(
+                "PipelineTrainer does not implement iter_size accumulation"
+                " — raise n_micro (microbatching already accumulates) or"
+                " use the single-chip Solver")
         if net_param is None:
             net_param = (solver_param.net_param
                          or solver_param.train_net_param)
@@ -94,11 +106,24 @@ class PipelineTrainer:
 
         seed = int(solver_param.random_seed)
         params0 = self.net.init_params(seed if seed >= 0 else 0)
+        # a param's HOME is the first stage that uses it; Caffe param
+        # sharing (ParamSpec name, net.cpp AppendParam) can make later
+        # stages use it too — they receive a per-iteration copy and their
+        # gradient contributions are summed back at the home (the same
+        # total a single-device autodiff through both uses produces)
         self._key_stage: Dict[str, int] = {}
+        self._stage_keys: List[List[str]] = []
         for s, idxs in enumerate(self.stage_layers):
+            used: List[str] = []
             for i in idxs:
                 for k in self.net.layers[i].param_keys:
                     self._key_stage.setdefault(k, s)
+                    if k not in used:
+                        used.append(k)
+            self._stage_keys.append(used)
+        self._home_keys: List[List[str]] = [[] for _ in range(n_stages)]
+        for k, s in self._key_stage.items():
+            self._home_keys[s].append(k)
         # each stage's params live on its own device
         self.params = {k: jax.device_put(v,
                                          self.devices[self._key_stage[k]])
@@ -126,8 +151,13 @@ class PipelineTrainer:
         self._bwd = [jax.jit(self._make_bwd(s)) for s in range(n_stages)]
         from ..solver.solver import make_update_fn
 
-        self._update_fn = jax.jit(make_update_fn(self.net, solver_param),
-                                  donate_argnums=(0, 1))
+        # clipping needs the GLOBAL grad norm (sgd_solver.cpp:81-100); the
+        # update fn runs once per stage, so the pipeline clips across all
+        # stages itself and disables the per-call clip
+        self._clip = float(solver_param.clip_gradients)
+        self._update_fn = jax.jit(
+            make_update_fn(self.net, solver_param, clip_override=0.0),
+            donate_argnums=(0, 1))
 
     # ----------------------------------------------------------- stage fns
     def _make_stage_fn(self, s: int):
@@ -143,6 +173,14 @@ class PipelineTrainer:
 
         def fn(stage_params, blobs, rng):
             blobs = dict(blobs)
+            if half:
+                # cast carried activations/inputs to bf16 like the
+                # single-chip step does (make_loss_fn, solver.py) — the
+                # cast is differentiable so cotangents land on the fp32
+                # originals; int blobs (labels) pass through
+                blobs = {k: v.astype(jnp.bfloat16)
+                         if jnp.issubdtype(v.dtype, jnp.floating) else v
+                         for k, v in blobs.items()}
             loss = jnp.float32(0.0)
             stats_out = {}
             for i in idxs:
@@ -167,6 +205,11 @@ class PipelineTrainer:
                 if name in blobs and self._loss_stage.get(name) == s:
                     loss = loss + jnp.float32(weight) * jnp.sum(
                         blobs[name]).astype(jnp.float32)
+            if half:
+                # BN running stats persist fp32 (solver.py _cast_tree)
+                stats_out = {k: v.astype(jnp.float32)
+                             if jnp.issubdtype(v.dtype, jnp.floating) else v
+                             for k, v in stats_out.items()}
             keep = self._keeps[s]
             return {k: blobs[k] for k in keep}, loss, stats_out
 
@@ -233,14 +276,17 @@ class PipelineTrainer:
         n = next(iter(batch.values())).shape[0]
         if n % M:
             raise ValueError(
-                f"batch size {n} must divide n_micro={M}: unequal "
+                f"batch size {n} must be divisible by n_micro={M}: unequal "
                 f"microbatches would skew the per-micro loss "
                 f"normalization away from the full-batch step")
         rng = jax.random.fold_in(self._rng, self.iter)
         micro = [{k: v[m::M] for k, v in batch.items()} for m in range(M)]
+        # every key a stage USES; shared params homed elsewhere are copied
+        # to the stage's device for this iteration
         stage_params = [
-            {k: self.params[k] for k in self._key_stage
-             if self._key_stage[k] == s} for s in range(S)]
+            {k: (self.params[k] if self._key_stage[k] == s
+                 else jax.device_put(self.params[k], self.devices[s]))
+             for k in self._stage_keys[s]} for s in range(S)]
 
         # forward stream: each (stage, micro) runs its compiled program;
         # the GPipe overlap emerges from async dispatch — stage s works on
@@ -284,20 +330,47 @@ class PipelineTrainer:
                            for k, v in g_blobs.items()}
 
         total_loss = sum(float(l) for l in loss_parts) / M
-        # one update per stage with the shared Caffe pipeline.  Stat
+        # merge gradients at each param's home: a shared param used by
+        # several stages sums their contributions, exactly what one
+        # single-device autodiff through all its uses yields
+        merged: Dict[str, Any] = {}
+        for s in range(S):
+            if grads_acc[s] is None:
+                continue
+            for k, g in grads_acc[s].items():
+                if k in self._stat_keys:
+                    continue
+                g = jax.device_put(g, self.devices[self._key_stage[k]])
+                merged[k] = g if k not in merged else merged[k] + g
+        if self._clip > 0 and merged:
+            # global-L2-norm clip across every stage's gradients (the
+            # reference computes ONE norm over all learnable params,
+            # sgd_solver.cpp:81-100); partial sums reduce device-locally,
+            # the scalar combines on host
+            sumsq = sum(float(jnp.sum(jnp.square(g)))
+                        for g in merged.values())
+            l2 = float(np.sqrt(sumsq))
+            if l2 > self._clip:
+                scale = self._clip / max(l2, 1e-12)
+                merged = {k: g * scale for k, g in merged.items()}
+        # refreshed BN running stats write straight back (stages refresh
+        # independent copies within the iteration; for the edge case of a
+        # stat param shared ACROSS stages, the last stage's refresh wins)
+        for s in range(S):
+            for k, v in stage_params[s].items():
+                if k in self._stat_keys:
+                    self.params[k] = v
+        # one update per home stage with the shared Caffe pipeline.  Stat
         # params stay OUT of the (buffer-donating) update — they are
         # forward-refreshed, not gradient-trained, and passing them
         # through donation would leave dead buffers in self.params
         for s in range(S):
-            learn = {k: v for k, v in stage_params[s].items()
+            learn = {k: self.params[k] for k in self._home_keys[s]
                      if k not in self._stat_keys}
-            for k, v in stage_params[s].items():
-                if k in self._stat_keys:
-                    self.params[k] = v  # refreshed running stats
             if not learn:
                 continue
             sub_state = {k: self.state[k] for k in learn}
-            grads = {k: grads_acc[s][k] for k in learn}
+            grads = {k: merged[k] for k in learn}
             new_p, new_s = self._update_fn(learn, sub_state, grads,
                                            jnp.int32(self.iter))
             for k in new_p:

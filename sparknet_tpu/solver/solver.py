@@ -84,14 +84,21 @@ def make_loss_fn(net: Net, precision: str):
     return loss_fn
 
 
-def make_update_fn(net: Net, sp: SolverParameter):
+def make_update_fn(net: Net, sp: SolverParameter, *,
+                   clip_override: Optional[float] = None):
     """The shared post-gradient pipeline as a pure function
     (params, state, grads, it) -> (new_params, new_state): clip ->
     regularize -> LR policy -> solver update, in the reference's order
     (SGDSolver::ApplyUpdate, sgd_solver.cpp:102-240).  Used by
     make_single_step and by trainers that produce gradients their own way
-    (the GPipe pipeline) so the update math exists once."""
-    clip = float(sp.clip_gradients)
+    (the GPipe pipeline) so the update math exists once.
+
+    `clip_override` replaces the solver's clip_gradients — a trainer that
+    calls this per param subset (the pipeline: one call per stage) must do
+    its own GLOBAL-norm clip first and pass 0 here, or the norm would be
+    computed per subset instead of over all params as the reference does."""
+    clip = float(sp.clip_gradients if clip_override is None
+                 else clip_override)
     weight_decay = float(sp.weight_decay)
     reg_type = str(sp.regularization_type)
     hyper = dict(momentum=float(sp.momentum), delta=float(sp.delta),

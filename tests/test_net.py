@@ -225,3 +225,78 @@ layer {{ name: "loss" type: "InfogainLoss" bottom: "prob" bottom: "label"
     expect = -sum(np.dot(H[y[i]], np.log(np.maximum(p[i], 1e-20)))
                   for i in range(4)) / 4
     np.testing.assert_allclose(float(blobs["loss"]), expect, rtol=1e-5)
+
+
+def test_filter_layer_compiled():
+    """Compiled Filter: packed-to-front static-capacity redesign of the
+    reference's data-dependent-shape layer (filter_layer.cpp).  Forward
+    must agree with the exact-shape host op on the selected prefix, padding
+    must be zero, the __count top must be right, and gradients must scatter
+    only to selected rows (filter_layer.cpp:67-92)."""
+    import jax
+    import numpy as np
+
+    from sparknet_tpu import ops
+    from sparknet_tpu.proto import caffe_pb
+
+    net_txt = """
+layer { name: "data" type: "MemoryData" top: "data" top: "sel"
+  memory_data_param { batch_size: 6 channels: 3 height: 2 width: 2 } }
+layer { name: "filt" type: "Filter" bottom: "data" bottom: "sel"
+  top: "fdata" }
+"""
+    net = Net(caffe_pb.parse_net_text(net_txt), "TRAIN",
+              data_shapes={"data": (6, 3, 2, 2), "sel": (6,)})
+    assert net.blob_shapes["fdata"] == (6, 3, 2, 2)
+    assert net.blob_shapes["filt__count"] == (1,)
+    params = net.init_params(0)
+    rng = np.random.RandomState(0)
+    x = rng.rand(6, 3, 2, 2).astype(np.float32)
+    sel = np.array([1, 0, 1, 1, 0, 1], dtype=np.float32)
+
+    fwd = jax.jit(lambda p, i: net.apply(p, i, train=True)[0])
+    blobs = fwd(params, {"data": x, "sel": sel})
+    exact = np.asarray(ops.filter_op([x], sel)[0])
+    count = int(blobs["filt__count"][0])
+    assert count == 4
+    np.testing.assert_allclose(np.asarray(blobs["fdata"])[:count], exact,
+                               rtol=1e-6)
+    np.testing.assert_array_equal(np.asarray(blobs["fdata"])[count:], 0.0)
+
+    # gradient: d sum(fdata) / d data = 1 on selected rows, 0 on rejected
+    g = jax.grad(
+        lambda d: float(0) + jax.numpy.sum(
+            net.apply(params, {"data": d, "sel": sel}, train=True,
+                      )[0]["fdata"]))(x)
+    g = np.asarray(g)
+    for i, s in enumerate(sel):
+        np.testing.assert_array_equal(g[i], 1.0 if s else 0.0)
+
+
+def test_filter_feeding_loss_warns():
+    """The compiled Filter's zero padding is not neutral in a loss layer;
+    building such a net must warn (reference filter_layer.cpp forwards
+    only selected rows)."""
+    import warnings
+
+    from sparknet_tpu.proto import caffe_pb
+
+    net_txt = """
+layer { name: "data" type: "MemoryData" top: "data" top: "sel"
+  memory_data_param { batch_size: 4 channels: 3 height: 1 width: 1 } }
+layer { name: "lab" type: "DummyData" top: "label"
+  dummy_data_param { shape { dim: 4 } } }
+layer { name: "filt" type: "Filter" bottom: "data" bottom: "sel"
+  top: "fdata" }
+layer { name: "ip" type: "InnerProduct" bottom: "fdata" top: "ip"
+  inner_product_param { num_output: 3
+    weight_filler { type: "gaussian" std: 0.1 } } }
+layer { name: "loss" type: "SoftmaxWithLoss" bottom: "ip" bottom: "label"
+  top: "loss" }
+"""
+    with warnings.catch_warnings(record=True) as rec:
+        warnings.simplefilter("always")
+        Net(caffe_pb.parse_net_text(net_txt), "TRAIN",
+            data_shapes={"data": (4, 3, 1, 1), "sel": (4,)})
+    assert any("Filter-derived" in str(w.message) for w in rec), \
+        [str(w.message) for w in rec]

@@ -66,15 +66,12 @@ def test_pipeline_matches_single_device(n_stages, n_micro):
     it = iter(stream)
     pt.set_train_data(lambda: next(it))
 
+    # pipeline microbatches are strided interleaves of the batch, but the
+    # loss/grad mean is permutation-invariant, so the reference consumes
+    # the identical batches unchanged
     ref = Solver(_sp())
     it2 = iter(stream)
-
-    def reorder(batch):
-        # pipeline microbatches are strided interleaves of the batch; the
-        # loss/grad mean is permutation-invariant, so feed the same batch
-        return batch
-
-    ref.set_train_data(lambda: reorder(next(it2)))
+    ref.set_train_data(lambda: next(it2))
 
     for _ in range(3):
         lp = pt.step(1)
@@ -101,7 +98,7 @@ def test_pipeline_batch_not_divisible_raises():
     pt.set_train_data(lambda: {
         "data": rng.rand(8, 3, 8, 8).astype(np.float32),
         "label": rng.randint(0, 10, (8,)).astype(np.int32)})
-    with pytest.raises(ValueError, match="divide"):
+    with pytest.raises(ValueError, match="divisible"):
         pt.step(1)
 
 
@@ -134,3 +131,113 @@ layer { name: "loss" type: "SoftmaxWithLoss" bottom: "ip" bottom: "label"
     changed = [k for k in stat_keys
                if not np.allclose(before[k], np.asarray(pt.params[k]))]
     assert changed, "BN running stats must refresh during training"
+
+
+SHARED_NET = """
+layer { name: "data" type: "MemoryData" top: "data" top: "label"
+  memory_data_param { batch_size: 8 channels: 1 height: 6 width: 6 } }
+layer { name: "ip_a" type: "InnerProduct" bottom: "data" top: "ip_a"
+  param { name: "w_shared" } param { name: "b_shared" }
+  inner_product_param { num_output: 36
+    weight_filler { type: "gaussian" std: 0.05 } } }
+layer { name: "relu_a" type: "ReLU" bottom: "ip_a" top: "ip_a" }
+layer { name: "reshape_a" type: "Reshape" bottom: "ip_a" top: "resh_a"
+  reshape_param { shape { dim: 0 dim: 1 dim: 6 dim: 6 } } }
+layer { name: "big" type: "InnerProduct" bottom: "resh_a" top: "big"
+  inner_product_param { num_output: 64
+    weight_filler { type: "gaussian" std: 0.05 } } }
+layer { name: "relu_b" type: "ReLU" bottom: "big" top: "big" }
+layer { name: "narrow" type: "InnerProduct" bottom: "big" top: "narrow"
+  inner_product_param { num_output: 36
+    weight_filler { type: "gaussian" std: 0.05 } } }
+layer { name: "reshape_b" type: "Reshape" bottom: "narrow" top: "resh_b"
+  reshape_param { shape { dim: 0 dim: 1 dim: 6 dim: 6 } } }
+layer { name: "ip_b" type: "InnerProduct" bottom: "resh_b" top: "ip_b"
+  param { name: "w_shared" } param { name: "b_shared" }
+  inner_product_param { num_output: 36
+    weight_filler { type: "gaussian" std: 0.05 } } }
+layer { name: "ip_out" type: "InnerProduct" bottom: "ip_b" top: "ip_out"
+  inner_product_param { num_output: 5
+    weight_filler { type: "gaussian" std: 0.05 } } }
+layer { name: "loss" type: "SoftmaxWithLoss" bottom: "ip_out" bottom: "label"
+  top: "loss" }
+"""
+
+
+def test_pipeline_shared_params_across_stages():
+    """Caffe param sharing (ParamSpec name, net.cpp AppendParam) with the
+    two sharing layers cut into DIFFERENT stages: the later stage gets a
+    copy, gradients sum at the home, and the result equals the
+    single-device step."""
+    sp = caffe_pb.SolverParameter(parse(
+        'base_lr: 0.05\nlr_policy: "fixed"\nmomentum: 0.9\n'
+        'weight_decay: 0.0005\nrandom_seed: 13'))
+    sp.msg.set("net_param", caffe_pb.parse_net_text(SHARED_NET).msg)
+
+    rng = np.random.RandomState(5)
+    stream = [{"data": rng.rand(8, 1, 6, 6).astype(np.float32),
+               "label": rng.randint(0, 5, (8,)).astype(np.int32)}
+              for _ in range(4)]
+
+    pt = PipelineTrainer(sp, n_stages=3, n_micro=2)
+    home = pt.stage_of("w_shared")
+    users = [s for s, ks in enumerate(pt._stage_keys) if "w_shared" in ks]
+    assert len(users) > 1 and home == users[0], \
+        f"cut must split the sharing layers (got stages {users})"
+
+    it = iter(stream)
+    pt.set_train_data(lambda: next(it))
+    ref = Solver(sp)
+    it2 = iter(stream)
+    ref.set_train_data(lambda: next(it2))
+    for _ in range(3):
+        lp = pt.step(1)
+        lr = ref.step(1)
+    np.testing.assert_allclose(lp, lr, rtol=2e-5)
+    for k, v in ref.params.items():
+        np.testing.assert_allclose(np.asarray(pt.params[k]), np.asarray(v),
+                                   rtol=2e-4, atol=1e-6, err_msg=k)
+
+
+def test_pipeline_bfloat16_runs_half_activations():
+    """precision=bfloat16 must cast carried activations, not just params,
+    so inter-stage traffic and compute ride the MXU bf16 path."""
+    sp = _sp()
+    sp.msg.set("precision", "bfloat16")
+    pt = PipelineTrainer(sp, n_stages=2, n_micro=2)
+    # probe the stage-0 forward directly: float carry comes back bf16
+    import jax.numpy as jnp
+    stream = _stream(1)
+    sp0 = {k: pt.params[k] for k in pt._stage_keys[0]}
+    carry, loss, _ = pt._fwd[0](sp0, {k: jnp.asarray(v)
+                                      for k, v in stream[0].items()},
+                                jax.random.PRNGKey(0))
+    float_carries = [v for v in carry.values()
+                     if jnp.issubdtype(v.dtype, jnp.floating)]
+    assert float_carries and all(v.dtype == jnp.bfloat16
+                                 for v in float_carries)
+    pt.set_train_data(lambda: iter(stream).__next__())
+    assert np.isfinite(pt.step(1))
+
+
+def test_pipeline_clip_gradients_matches_single_device():
+    """clip_gradients must clip on the GLOBAL norm across all stages
+    (sgd_solver.cpp:81-100), not per stage."""
+    sp = _sp()
+    sp.msg.set("clip_gradients", 0.05)  # small enough to always engage
+    stream = _stream()
+    pt = PipelineTrainer(sp, n_stages=3, n_micro=2)
+    it = iter(stream)
+    pt.set_train_data(lambda: next(it))
+    sp2 = _sp()
+    sp2.msg.set("clip_gradients", 0.05)
+    ref = Solver(sp2)
+    it2 = iter(stream)
+    ref.set_train_data(lambda: next(it2))
+    for _ in range(3):
+        lp = pt.step(1)
+        lr = ref.step(1)
+    np.testing.assert_allclose(lp, lr, rtol=2e-5)
+    for k, v in ref.params.items():
+        np.testing.assert_allclose(np.asarray(pt.params[k]), np.asarray(v),
+                                   rtol=2e-4, atol=1e-6, err_msg=k)
