@@ -39,7 +39,12 @@ def _load_batch_list(path: str, batch: int):
     else:
         z = np.load(path)
         data, label = z["data"].astype(np.float32), z["label"]
-    return part.make_minibatches(data, label, batch)
+    batches = part.make_minibatches(data, label, batch)
+    if not batches:
+        raise SystemExit(
+            f"data yielded no full batches of {batch} (batching drops the "
+            f"remainder, ScaleAndConvert.scala:45-91) — lower --batch")
+    return batches
 
 
 def _batch_source(batches, start: int = 0):
@@ -54,12 +59,6 @@ def _batch_source(batches, start: int = 0):
     return source
 
 
-def _load_arrays(path: str, batch: int):
-    """Yield {data,label} batches forever from a CIFAR dir or an .npz."""
-    batches = _load_batch_list(path, batch)
-    return _batch_source(batches), len(batches)
-
-
 def cmd_train(args) -> int:
     from .proto import caffe_pb
     from .solver.solver import Solver
@@ -68,14 +67,18 @@ def cmd_train(args) -> int:
     sp = caffe_pb.load_solver_prototxt(args.solver)
     net_path = str(sp.net or sp.train_net)
     net = caffe_pb.load_net_prototxt(net_path) if net_path else None
-    if net is not None and args.data:
-        first = net.layers[0]
+    batches = (_load_batch_list(args.data, args.batch or 100)
+               if args.data else None)
+    if net is not None and batches is not None:
         bs = args.batch or 100
-        c, h, w = (3, 32, 32)
-        net = caffe_pb.replace_data_layers(net, bs, bs, c, h, w)
+        # data-layer shapes come from the actual arrays (the reference
+        # reads C/H/W off the first datum, data_layer.cpp DataLayerSetUp)
+        c, h, w = batches[0][0].shape[1:]
+        net = caffe_pb.replace_data_layers(net, bs, bs, int(c), int(h),
+                                           int(w))
         sp = caffe_pb.load_solver_prototxt_with_net(args.solver, net)
     if args.workers and args.workers > 1:
-        return _train_distributed(args, sp, net)
+        return _train_distributed(args, sp, net, batches)
     solver = Solver(sp, net_param=net)
     if args.weights:
         solver.load_weights(args.weights)  # warm start (tools/caffe.cpp:169)
@@ -84,8 +87,8 @@ def cmd_train(args) -> int:
     handler = SignalHandler(parse_effect(args.sigint_effect),
                             parse_effect(args.sighup_effect)).install()
     solver.action_source = handler
-    if args.data:
-        source, _ = _load_arrays(args.data, args.batch or 100)
+    if batches is not None:
+        source = _batch_source(batches)
     else:
         # self-feeding net: the data layers name their own sources
         # (reference `caffe train` needs no data flag, tools/caffe.cpp:160)
@@ -126,7 +129,7 @@ def _maybe_profile(args):
     return contextlib.nullcontext()
 
 
-def _train_distributed(args, sp, net) -> int:
+def _train_distributed(args, sp, net, batches=None) -> int:
     """Multi-worker dispatch (the analogue of `caffe train --gpu=0,1,..`,
     reference: tools/caffe.cpp:209-215 spawning P2PSync, and of the apps'
     driver loops): τ local steps per worker per round + weight averaging
@@ -146,10 +149,10 @@ def _train_distributed(args, sp, net) -> int:
         solver.restore(args.snapshot)
     handler = SignalHandler(parse_effect(args.sigint_effect),
                             parse_effect(args.sighup_effect)).install()
-    if args.data:
-        # one shared batch list; worker w starts count/n batches into the
-        # cycle (the RDD-partition analogue, without n copies in RAM)
-        batches = _load_batch_list(args.data, args.batch or 100)
+    if batches is not None:
+        # one shared batch list (loaded once by cmd_train); worker w starts
+        # count/n batches into the cycle (the RDD-partition analogue,
+        # without n copies in RAM)
         solver.set_train_data([_batch_source(batches,
                                              w * len(batches) // n)
                                for w in range(n)])
@@ -191,15 +194,18 @@ def cmd_test(args) -> int:
 
     net = caffe_pb.load_net_prototxt(args.model)
     bs = args.batch or 100
-    if args.data:
-        net = caffe_pb.replace_data_layers(net, bs, bs, 3, 32, 32)
+    batches = _load_batch_list(args.data, bs) if args.data else None
+    if batches is not None:
+        c, h, w = batches[0][0].shape[1:]
+        net = caffe_pb.replace_data_layers(net, bs, bs, int(c), int(h),
+                                           int(w))
     sp = caffe_pb.SolverParameter()
     sp.msg.set("net_param", net.msg)
     solver = Solver(sp)
     if args.weights:
         solver.load_weights(args.weights)
-    if args.data:
-        source, n_avail = _load_arrays(args.data, bs)
+    if batches is not None:
+        source, n_avail = _batch_source(batches), len(batches)
     else:
         from .data.feeds import make_net_feeds
 

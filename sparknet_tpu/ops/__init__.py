@@ -9,6 +9,7 @@ from .attention import attention, blockwise_attention
 from .conv import conv2d, conv_out_dim, deconv2d, deconv_out_dim, im2col
 from .dense import embed, inner_product
 from .lrn import lrn, lrn_across_channels, lrn_within_channel
+from .moe import expert_capacity, moe_ffn, top_k_gating
 from .losses import (accuracy, argmax, contrastive_loss, euclidean_loss,
                      hinge_loss, infogain_loss, multinomial_logistic_loss,
                      sigmoid_cross_entropy_loss, softmax, softmax_with_loss)

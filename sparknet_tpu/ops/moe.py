@@ -1,0 +1,105 @@
+"""Mixture-of-Experts ops: top-k gating with static capacity, dense MoE FFN.
+
+The reference has no MoE anywhere (SURVEY.md §2.3: expert parallelism absent;
+the layer zoo is image-CNN only) — this module exists because the parallelism
+inventory (DP/TP/PP/SP/EP) is first-class in the TPU build.  Expert-parallel
+execution over a mesh axis lives one level up in parallel/expert.py; here are
+the pure single-device ops it is verified against.
+
+Design is GShard/Switch-style (arXiv:2006.16668, 2101.03961) shaped for the
+MXU: every tensor is static-shape, token→expert routing is expressed as
+one-hot dispatch/combine tensors consumed by einsums (matmuls), and each
+expert processes a fixed `capacity` of token slots.  Tokens routed past an
+expert's capacity are dropped (their combine weight is zero, so the residual
+path — the caller's skip connection — carries them), exactly the standard
+capacity-factor semantics.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def expert_capacity(n_tokens: int, n_experts: int, k: int,
+                    capacity_factor: float) -> int:
+    """Fixed per-expert token slots: ceil(k·T/E · factor), min 1."""
+    cap = int(-(-k * n_tokens * capacity_factor // n_experts))
+    return max(cap, 1)
+
+
+def top_k_gating(x: jax.Array, gate_w: jax.Array, *, k: int,
+                 capacity: int,
+                 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Route (T, M) tokens to the top-k of E experts with static capacity.
+
+    Returns (combine, dispatch, aux_loss):
+      combine  (T, E, C) float — gate probability of token t in expert e's
+               slot c (zero everywhere the token isn't placed);
+      dispatch (T, E, C) float 0/1 — the same placement without the weight;
+      aux_loss scalar — Switch load-balancing loss E·Σ_e f_e·p_e (fraction
+               of tokens whose TOP-1 is e × mean gate prob of e), which is
+               1 at perfect balance.
+
+    Position-in-expert is assigned in token order per (choice rank, expert)
+    via cumsum, the GShard formulation; rank-r choices claim slots after all
+    rank-(r-1) choices so top-1 assignments are never bumped by top-2s.
+    """
+    t, m = x.shape
+    e = gate_w.shape[1]
+    logits = x @ gate_w                                       # (T, E)
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+
+    # top-k expert ids per token, then one-hot masks per choice rank
+    _, top_idx = jax.lax.top_k(probs, k)                      # (T, k)
+    onehots = jax.nn.one_hot(top_idx, e, dtype=probs.dtype)   # (T, k, E)
+
+    # aux loss uses rank-0 assignment (Switch: arXiv:2101.03961 eq. 4-6)
+    f = jnp.mean(onehots[:, 0, :], axis=0)                    # (E,)
+    p = jnp.mean(probs, axis=0)                               # (E,)
+    aux_loss = e * jnp.sum(f * p)
+
+    # slot assignment: flatten choices rank-major so cumsum gives rank-0
+    # choices of ALL tokens positions before any rank-1 choice
+    flat = jnp.transpose(onehots, (1, 0, 2)).reshape(k * t, e)
+    pos = jnp.cumsum(flat, axis=0) - flat                     # (k·T, E)
+    keep = flat * (pos < capacity)
+    slot = jax.nn.one_hot(pos.astype(jnp.int32), capacity,
+                          dtype=probs.dtype) * keep[..., None]
+    # back to (T, k, E, C), sum over choice rank (a token can't pick the
+    # same expert twice via top_k, so the sum is still one-hot)
+    dispatch = jnp.sum(slot.reshape(k, t, e, capacity), axis=0)
+
+    # combine weight = raw softmax prob of the chosen expert (Switch-style;
+    # un-renormalized so a dropped top-1 doesn't inflate the top-2's share)
+    combine = dispatch * probs[:, :, None]                    # (T, E, C)
+    return combine, dispatch, aux_loss
+
+
+def moe_ffn(x: jax.Array, gate_w: jax.Array, w1: jax.Array, b1: jax.Array,
+            w2: jax.Array, b2: jax.Array, *, k: int = 1,
+            capacity_factor: float = 1.25,
+            ) -> Tuple[jax.Array, jax.Array]:
+    """Dense (single-device) MoE feed-forward: (…, M) -> (…, M).
+
+    gate_w (M, E); w1 (E, M, H), b1 (E, H), w2 (E, H, M), b2 (E, M).
+    Leading axes flatten to a token axis.  Returns (y, aux_loss).  Dropped
+    tokens yield zeros — callers add the residual/skip path.
+    """
+    lead = x.shape[:-1]
+    m = x.shape[-1]
+    xt = x.reshape(-1, m)
+    t = xt.shape[0]
+    e = gate_w.shape[1]
+    cap = expert_capacity(t, e, k, capacity_factor)
+    combine, dispatch, aux = top_k_gating(xt, gate_w, k=k, capacity=cap)
+    # dispatch tokens into expert slot buffers: (E, C, M)
+    buf = jnp.einsum("tec,tm->ecm", dispatch, xt)
+    h = jax.nn.relu(jnp.einsum("ecm,emh->ech", buf, w1) + b1[:, None, :])
+    out = jnp.einsum("ech,ehm->ecm", h, w2) + b2[:, None, :]
+    # only filled slots may contribute (empty slots still got b2)
+    out = out * jnp.sum(dispatch, axis=0)[..., None]
+    y = jnp.einsum("tec,ecm->tm", combine, out)
+    return y.reshape(*lead, m), aux

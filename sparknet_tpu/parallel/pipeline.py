@@ -345,21 +345,24 @@ class PipelineTrainer:
         if self._clip > 0 and merged:
             # global-L2-norm clip across every stage's gradients (the
             # reference computes ONE norm over all learnable params,
-            # sgd_solver.cpp:81-100); partial sums reduce device-locally,
-            # the scalar combines on host
-            sumsq = sum(float(jnp.sum(jnp.square(g)))
-                        for g in merged.values())
-            l2 = float(np.sqrt(sumsq))
+            # sgd_solver.cpp:81-100); square-sums accumulate device-side
+            # per home device, then ONE host sync per device
+            per_dev: Dict[int, Any] = {}
+            for k, g in merged.items():
+                s = self._key_stage[k]
+                sq = jnp.sum(jnp.square(g))
+                per_dev[s] = sq if s not in per_dev else per_dev[s] + sq
+            l2 = float(np.sqrt(sum(float(v) for v in per_dev.values())))
             if l2 > self._clip:
                 scale = self._clip / max(l2, 1e-12)
                 merged = {k: g * scale for k, g in merged.items()}
-        # refreshed BN running stats write straight back (stages refresh
-        # independent copies within the iteration; for the edge case of a
-        # stat param shared ACROSS stages, the last stage's refresh wins)
+        # refreshed BN running stats write straight back from each param's
+        # HOME stage copy (it lives on the home device; a non-home copy of
+        # a cross-stage-shared stat would strand the param elsewhere)
         for s in range(S):
-            for k, v in stage_params[s].items():
+            for k in self._home_keys[s]:
                 if k in self._stat_keys:
-                    self.params[k] = v
+                    self.params[k] = stage_params[s][k]
         # one update per home stage with the shared Caffe pipeline.  Stat
         # params stay OUT of the (buffer-donating) update — they are
         # forward-refreshed, not gradient-trained, and passing them

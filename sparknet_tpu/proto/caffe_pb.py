@@ -371,6 +371,25 @@ class AttentionParameter(View):
         return FillerParameter(self.msg.get("bias_filler"))
 
 
+class MoEParameter(View):
+    """Framework-extension layer param (like AttentionParameter — the
+    JavaDataParameter precedent, caffe.proto:991): mixture-of-experts FFN
+    with top-k routing and static capacity (ops/moe.py); expert-parallel
+    execution over a mesh axis lives in parallel/expert.py.  hidden_dim 0
+    means 4x the input width.  aux_loss_weight adds the Switch
+    load-balancing loss to the training objective."""
+    DEFAULTS = dict(num_experts=1, hidden_dim=0, k=1, capacity_factor=1.25,
+                    aux_loss_weight=0.01, bias_term=True)
+
+    @property
+    def weight_filler(self):
+        return FillerParameter(self.msg.get("weight_filler"))
+
+    @property
+    def bias_filler(self):
+        return FillerParameter(self.msg.get("bias_filler"))
+
+
 class PythonParameter(View):
     # caffe.proto:810-817 — module/layer name a user PythonLayer class,
     # param_str is free-form config handed to the instance before setup()
@@ -474,6 +493,7 @@ _PARAM_VIEWS = {
     "java_data_param": JavaDataParameter,
     "python_param": PythonParameter,
     "attention_param": AttentionParameter,
+    "moe_param": MoEParameter,
 }
 
 

@@ -345,7 +345,10 @@ class Solver:
         for _ in range(n):
             outs = self._test_step(self.params, self._pull(self.test_source))
             for k, v in outs.items():
-                totals[k] = totals.get(k, 0.0) + float(v)
+                # sum over blob elements: the reference accumulates every
+                # element of each output blob (solver.cpp:435-443); loss/
+                # accuracy tops are scalars so this is the identity there
+                totals[k] = totals.get(k, 0.0) + float(jnp.sum(v))
         return {k: v / n for k, v in totals.items()}
 
     def forward(self, inputs: Dict[str, Any]) -> Dict[str, jnp.ndarray]:

@@ -138,3 +138,52 @@ def test_signal_handler_polling():
         assert h.get_requested_action() is SolverAction.STOP
     finally:
         h.uninstall()
+
+
+MOE_NET = """
+name: "moe_demo"
+layer { name: "data" type: "MemoryData" top: "data" top: "label"
+  memory_data_param { batch_size: 16 channels: 8 height: 1 width: 1 } }
+layer { name: "flat" type: "Flatten" bottom: "data" top: "flat" }
+layer { name: "moe" type: "MoE" bottom: "flat" top: "moe"
+  moe_param { num_experts: 4 hidden_dim: 16 k: 2 aux_loss_weight: 0.01 } }
+layer { name: "res" type: "Eltwise" bottom: "flat" bottom: "moe" top: "res"
+  eltwise_param { operation: SUM } }
+layer { name: "ip" type: "InnerProduct" bottom: "res" top: "ip"
+  inner_product_param { num_output: 4
+    weight_filler { type: "gaussian" std: 0.1 } } }
+layer { name: "loss" type: "SoftmaxWithLoss" bottom: "ip" bottom: "label"
+  top: "loss" }
+"""
+
+
+def test_train_and_test_verbs_non_cifar_shape(tmp_path, capsys):
+    """--data shapes must come from the arrays, not a hardcoded 3x32x32
+    (regression: the npz path only worked for CIFAR shapes) — driven with
+    the MoE extension layer end to end."""
+    net_p = str(tmp_path / "net.prototxt")
+    open(net_p, "w").write(MOE_NET)
+    solver_p = str(tmp_path / "solver.prototxt")
+    open(solver_p, "w").write(
+        f'net: "{net_p}"\nbase_lr: 0.1\nlr_policy: "fixed"\n'
+        f'momentum: 0.9\nmax_iter: 10\ndisplay: 5\nrandom_seed: 7\n')
+    rng = np.random.RandomState(0)
+    data = rng.rand(64, 8, 1, 1).astype(np.float32)
+    label = (data.reshape(64, 8).argmax(axis=1) % 4).astype(np.int32)
+    npz = str(tmp_path / "d.npz")
+    np.savez(npz, data=data, label=label)
+    out = str(tmp_path / "w.npz")
+
+    assert cli.main(["train", "--solver", solver_p, "--data", npz,
+                     "--batch", "16", "--out", out]) == 0
+    assert os.path.exists(out)
+    assert cli.main(["test", "--model", net_p, "--weights", out,
+                     "--data", npz, "--batch", "16",
+                     "--iterations", "4"]) == 0
+    text = capsys.readouterr().out
+    assert "loss" in text and "moe__aux_loss" in text
+
+    # batch larger than the dataset: a clear SystemExit, not a crash
+    with pytest.raises(SystemExit, match="full batches"):
+        cli.main(["test", "--model", net_p, "--weights", out,
+                  "--data", npz, "--batch", "100", "--iterations", "1"])
