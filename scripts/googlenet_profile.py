@@ -24,13 +24,14 @@ from sparknet_tpu.solver.solver import make_single_step
 D = "/root/reference/caffe/models/bvlc_googlenet"
 
 
-def build_step(batch, drop_aux=False, lrn_impl=None, no_lrn=False):
+def build_step(batch, drop_aux=False, lrn_impl=None, no_lrn=False,
+               pool_to_ave=False, no_dropout=False):
     if lrn_impl:
         os.environ["SPARKNET_LRN_IMPL"] = lrn_impl
     else:
         os.environ.pop("SPARKNET_LRN_IMPL", None)
     npm = caffe_pb.load_net_prototxt(D + "/train_val.prototxt")
-    if drop_aux or no_lrn:
+    if drop_aux or no_lrn or pool_to_ave or no_dropout:
         keep = []
         for l in npm.layers:
             nm = str(l.name)
@@ -38,6 +39,13 @@ def build_step(batch, drop_aux=False, lrn_impl=None, no_lrn=False):
                 continue
             if no_lrn and l.type == "LRN":
                 l.msg.set("type", "Power")  # identity: attribution no-op
+            if pool_to_ave and l.type == "Pooling" and \
+                    str(l.pooling_param.pool) == "MAX":
+                # same kernel/stride/shape, cheaper reduce: isolates the
+                # cost of max-pool fwd+bwd (select/scatter) vs mean
+                l.pooling_param.msg.set("pool", "AVE")
+            if no_dropout and l.type == "Dropout":
+                l.msg.set("type", "Power")
             keep.append(l)
         npm.msg.set_list("layer", [l.msg for l in keep])
     net = Net(npm, "TRAIN", batch_override=batch)
@@ -80,7 +88,7 @@ def measure(batch, **kw):
 
 
 def main():
-    for name, batch, kw in [
+    variants = [
         ("baseline_b64", 64, dict()),
         ("no_aux_heads_b64", 64, dict(drop_aux=True)),
         ("no_lrn_b64", 64, dict(no_lrn=True)),
@@ -88,7 +96,13 @@ def main():
         ("lrn_matmul_b64", 64, dict(lrn_impl="matmul")),
         ("baseline_b128", 128, dict()),
         ("baseline_b256", 256, dict()),
-    ]:
+        ("maxpool_to_ave_b64", 64, dict(pool_to_ave=True)),
+        ("no_dropout_b64", 64, dict(no_dropout=True)),
+    ]
+    only = set(sys.argv[1:])
+    if only:
+        variants = [v for v in variants if v[0] in only]
+    for name, batch, kw in variants:
         try:
             r = measure(batch, **kw)
             print(json.dumps({"config": name,

@@ -31,7 +31,7 @@ def expert_capacity(n_tokens: int, n_experts: int, k: int,
 
 
 def top_k_gating(x: jax.Array, gate_w: jax.Array, *, k: int,
-                 capacity: int,
+                 capacity: int, return_load_stats: bool = False,
                  ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """Route (T, M) tokens to the top-k of E experts with static capacity.
 
@@ -41,7 +41,11 @@ def top_k_gating(x: jax.Array, gate_w: jax.Array, *, k: int,
       dispatch (T, E, C) float 0/1 — the same placement without the weight;
       aux_loss scalar — Switch load-balancing loss E·Σ_e f_e·p_e (fraction
                of tokens whose TOP-1 is e × mean gate prob of e), which is
-               1 at perfect balance.
+               1 at perfect balance.  With return_load_stats=True the third
+               element is instead the pair (f, p) so a sharded caller can
+               average them across shards BEFORE forming the product (the
+               loss is nonlinear in f/p; parallel/expert.py needs this for
+               exactness).
 
     Position-in-expert is assigned in token order per (choice rank, expert)
     via cumsum, the GShard formulation; rank-r choices claim slots after all
@@ -75,6 +79,8 @@ def top_k_gating(x: jax.Array, gate_w: jax.Array, *, k: int,
     # combine weight = raw softmax prob of the chosen expert (Switch-style;
     # un-renormalized so a dropped top-1 doesn't inflate the top-2's share)
     combine = dispatch * probs[:, :, None]                    # (T, E, C)
+    if return_load_stats:
+        return combine, dispatch, (f, p)
     return combine, dispatch, aux_loss
 
 

@@ -44,15 +44,75 @@ def max_pool(x: jax.Array, kernel: Tuple[int, int], *,
              stride: Tuple[int, int] = (1, 1),
              pad: Tuple[int, int] = (0, 0)) -> jax.Array:
     """MAX pooling; padding never wins (reference clips the window to the
-    valid region, pooling_layer.cpp:155-169 — identical to -inf padding)."""
-    _, _, ph, pw = x.shape[0], x.shape[1], x.shape[2], x.shape[3]
-    oh, ow, pad_h, pad_w = _window_geometry((ph, pw), kernel, pad, stride)
-    y = lax.reduce_window(
+    valid region, pooling_layer.cpp:155-169 — identical to -inf padding).
+
+    Gradient: XLA's native SelectAndScatter by default.  An alternative
+    custom VJP (kernel-unrolled compare/dilate/add, Caffe-exact first-max
+    tie routing) is selectable with SPARKNET_MAXPOOL_BWD=unrolled — it was
+    built on the hypothesis that SelectAndScatter dominates the measured
+    ~17% max-pool share of the GoogLeNet step, but MEASURED 2.5x SLOWER on
+    TPU v5e (9x full-map HBM traffic; GOOGLENET_PROFILE.md round-2 note),
+    so the native path stays the default."""
+    import os
+
+    if os.environ.get("SPARKNET_MAXPOOL_BWD") == "unrolled":
+        return _max_pool(x, tuple(kernel), tuple(stride), tuple(pad))
+    return _max_pool_raw(x, tuple(kernel), tuple(stride), tuple(pad))
+
+
+def _max_pool_raw(x, kernel, stride, pad):
+    oh, ow, pad_h, pad_w = _window_geometry(
+        (x.shape[2], x.shape[3]), kernel, pad, stride)
+    return lax.reduce_window(
         x, -jnp.inf, lax.max,
         window_dimensions=(1, 1, kernel[0], kernel[1]),
         window_strides=(1, 1, stride[0], stride[1]),
         padding=((0, 0), (0, 0), pad_h, pad_w))
-    return y
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(1, 2, 3))
+def _max_pool(x, kernel, stride, pad):
+    return _max_pool_raw(x, kernel, stride, pad)
+
+
+def _max_pool_fwd(x, kernel, stride, pad):
+    y = _max_pool_raw(x, kernel, stride, pad)
+    return y, (x, y)
+
+
+def _max_pool_bwd(kernel, stride, pad, res, g):
+    x, y = res
+    n, c, h, w = x.shape
+    oh, ow, pad_h, pad_w = _window_geometry((h, w), kernel, pad, stride)
+    hp, wp = h + pad_h[0] + pad_h[1], w + pad_w[0] + pad_w[1]
+    xp = jnp.pad(x, ((0, 0), (0, 0), pad_h, pad_w),
+                 constant_values=-jnp.inf)
+    taken = jnp.zeros((n, c, oh, ow), dtype=bool)
+    gx = jnp.zeros((n, c, hp, wp), dtype=g.dtype)
+    # window positions in the reference's scan order (row-major within the
+    # window) so first-wins tie routing matches pooling_layer.cpp exactly
+    for i in range(kernel[0]):
+        for j in range(kernel[1]):
+            patch = lax.slice(
+                xp, (0, 0, i, j),
+                (n, c, i + (oh - 1) * stride[0] + 1,
+                 j + (ow - 1) * stride[1] + 1),
+                (1, 1, stride[0], stride[1]))
+            win = (patch == y) & ~taken
+            taken = taken | win
+            contrib = jnp.where(win, g, jnp.zeros((), g.dtype))
+            # place contributions back on the strided input grid:
+            # interior padding dilates by the stride, low/high shift to
+            # window offset (i, j) — pure pad+add, no scatter
+            gx = gx + lax.pad(
+                contrib, jnp.zeros((), g.dtype),
+                ((0, 0, 0), (0, 0, 0),
+                 (i, hp - (i + (oh - 1) * stride[0] + 1), stride[0] - 1),
+                 (j, wp - (j + (ow - 1) * stride[1] + 1), stride[1] - 1)))
+    return (gx[:, :, pad_h[0]:pad_h[0] + h, pad_w[0]:pad_w[0] + w],)
+
+
+_max_pool.defvjp(_max_pool_fwd, _max_pool_bwd)
 
 
 def _ave_divisor(size: Tuple[int, int], kernel: Tuple[int, int],

@@ -14,7 +14,9 @@ Capacity note: each device grants every expert `capacity` slots for its OWN
 tokens (per-source-device capacity), so an expert's total work is
 n_devices·capacity slots.  With a capacity_factor high enough that nothing
 drops, the result is numerically identical to the dense `ops.moe.moe_ffn`
-on the gathered tokens — asserted in tests/test_moe.py.
+on the gathered tokens; the Switch aux loss is ALWAYS the exact dense
+global-batch value (load stats pmean-ed across shards before the nonlinear
+product).  Both asserted in tests/test_moe.py.
 """
 
 from __future__ import annotations
@@ -44,30 +46,41 @@ def moe_ffn_ep(x: jax.Array, gate_w: jax.Array, w1: jax.Array,
     e_loc = w1.shape[0]
     assert e_loc * n == n_experts, (e_loc, n, n_experts)
 
-    combine, dispatch, aux = top_k_gating(x, gate_w, k=k, capacity=capacity)
-    # local slot buffers for EVERY expert: (E, C, M)
+    combine, dispatch, (f, p) = top_k_gating(x, gate_w, k=k,
+                                             capacity=capacity,
+                                             return_load_stats=True)
+    # the Switch loss is nonlinear in (f, p): average the load stats over
+    # shards FIRST, then form E·Σ f·p — exactly the dense global-batch aux
+    # (equal shard sizes are guaranteed by the wrapper's t % n check)
+    f_g = jax.lax.pmean(f, axis_name)
+    p_g = jax.lax.pmean(p, axis_name)
+    aux = n_experts * jnp.sum(f_g * p_g)
+
+    # local slot buffers for EVERY expert, with the filled-slot mask riding
+    # as one extra feature column so a single all_to_all ships both: the
+    # FFN must know which slots are real (empty slots still get b2)
     buf = jnp.einsum("tec,tm->ecm", dispatch, x)
+    filled = jnp.sum(dispatch, axis=0)                       # (E, C)
+    buf = jnp.concatenate([buf, filled[..., None]], axis=-1)
     # ship slots to the experts' owners: split E into (n, E_loc) and trade
     # the device axis — afterwards axis 0 indexes the SOURCE device of the
     # tokens and the E_loc axis is this device's own experts
-    buf = buf.reshape(n, e_loc, capacity, m)
+    buf = buf.reshape(n, e_loc, capacity, m + 1)
     buf = jax.lax.all_to_all(buf, axis_name, split_axis=0, concat_axis=0,
-                             tiled=False)                  # (n, E_loc, C, M)
-    filled = jnp.sum(dispatch, axis=0).reshape(n, e_loc, capacity)
-    filled = jax.lax.all_to_all(filled, axis_name, split_axis=0,
-                                concat_axis=0, tiled=False)
+                             tiled=False)              # (n, E_loc, C, M+1)
+    buf, filled = buf[..., :m], buf[..., m]
 
     h = jax.nn.relu(jnp.einsum("necm,emh->nech", buf, w1)
                     + b1[None, :, None, :])
     out = jnp.einsum("nech,ehm->necm", h, w2) + b2[None, :, None, :]
-    out = out * filled[..., None]  # empty slots still got b2
+    out = out * filled[..., None]
 
     # ship results home and combine with the local gate weights
     out = jax.lax.all_to_all(out, axis_name, split_axis=0, concat_axis=0,
                              tiled=False)
     out = out.reshape(n * e_loc, capacity, m)              # (E, C, M)
     y = jnp.einsum("tec,ecm->tm", combine, out)
-    return y, jax.lax.pmean(aux, axis_name)
+    return y, aux
 
 
 def expert_parallel_moe(x: jax.Array, gate_w: jax.Array, w1: jax.Array,
@@ -83,6 +96,9 @@ def expert_parallel_moe(x: jax.Array, gate_w: jax.Array, w1: jax.Array,
     if mesh is None:
         devs = jax.devices()
         n = n_devices or len(devs)
+        if len(devs) < n:
+            raise ValueError(f"need {n} devices for the expert axis, have "
+                             f"{len(devs)}")
         mesh = Mesh(devs[:n], (EXPERT_AXIS,))
     n = mesh.shape[EXPERT_AXIS]
     lead = x.shape[:-1]
@@ -91,8 +107,8 @@ def expert_parallel_moe(x: jax.Array, gate_w: jax.Array, w1: jax.Array,
     t = xt.shape[0]
     e = gate_w.shape[1]
     if t % n or e % n:
-        raise ValueError(f"tokens {t} and experts {e} must divide the "
-                         f"expert axis size {n}")
+        raise ValueError(f"tokens {t} and experts {e} must each be "
+                         f"divisible by the expert axis size {n}")
     # per-source-device capacity so slot buffers are static per device
     cap = expert_capacity(t // n, e, k, capacity_factor)
 

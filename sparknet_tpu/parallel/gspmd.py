@@ -130,6 +130,36 @@ class GspmdTrainer:
                 for k, s in self.param_specs.items()
                 if s != P() and MODEL_AXIS in s}
 
+    def snapshot(self, path: str) -> str:
+        """Write the native snapshot triple (iter + params + solver state);
+        sharded arrays gather to host on write (reference role:
+        Solver::Snapshot, solver.cpp:446-466)."""
+        from ..solver.solver import write_native_snapshot
+
+        return write_native_snapshot(path, self.iter, self.params,
+                                     self.state)
+
+    def restore(self, path: str) -> None:
+        """Exact resume: params AND optimizer slots return to their mesh
+        shardings, so the post-restore trajectory equals the uninterrupted
+        run (reference: Solver::Restore)."""
+        from ..solver.solver import parse_native_snapshot
+
+        it, params, state = parse_native_snapshot(path)
+        missing = set(self.params) - set(params)
+        if missing:
+            raise ValueError(f"snapshot lacks params: {sorted(missing)}")
+
+        def shard(k):
+            return NamedSharding(self.mesh, self.param_specs[k])
+
+        self.params = {k: jax.device_put(jnp.asarray(params[k]), shard(k))
+                       for k in self.params}
+        self.state = {k: tuple(jax.device_put(jnp.asarray(h), shard(k))
+                               for h in state[k])
+                      for k in self.state}
+        self.iter = int(it)
+
     def step(self, n: int = 1) -> float:
         assert self.train_source is not None, "set_train_data first"
         loss = None

@@ -259,6 +259,36 @@ class PipelineTrainer:
     def stage_of(self, key: str) -> int:
         return self._key_stage[key]
 
+    def snapshot(self, path: str) -> str:
+        """Write the native snapshot triple (iter + params + solver state);
+        per-stage device arrays gather to host on write (reference role:
+        Solver::Snapshot, solver.cpp:446-466)."""
+        from ..solver.solver import write_native_snapshot
+
+        return write_native_snapshot(path, self.iter, self.params,
+                                     self.state)
+
+    def restore(self, path: str) -> None:
+        """Exact resume: params and optimizer slots return to their home
+        stage's device, so the post-restore trajectory equals the
+        uninterrupted run (reference: Solver::Restore)."""
+        from ..solver.solver import parse_native_snapshot
+
+        it, params, state = parse_native_snapshot(path)
+        missing = set(self.params) - set(params)
+        if missing:
+            raise ValueError(f"snapshot lacks params: {sorted(missing)}")
+        self.params = {
+            k: jax.device_put(jnp.asarray(params[k]),
+                              self.devices[self._key_stage[k]])
+            for k in self.params}
+        self.state = {
+            k: tuple(jax.device_put(jnp.asarray(h),
+                                    self.devices[self._key_stage[k]])
+                     for h in state[k])
+            for k in self.state}
+        self.iter = int(it)
+
     def step(self, n: int = 1) -> float:
         """n full-batch iterations, each = GPipe forward stream + VJP
         replay + one shared-pipeline update."""

@@ -254,6 +254,57 @@ def cmd_detect(args) -> int:
     return 0
 
 
+def cmd_parse_log(args) -> int:
+    """Parse a training log into train/test CSV tables (reference:
+    tools/extra/parse_log.py writes <log>.train / <log>.test with
+    NumIters,Seconds,… columns).  Understands both log formats this
+    framework emits: the CLI's "Iteration N, loss = X" lines and the apps'
+    PhaseLogger lines "<elapsed>: iteration N: round loss = X" /
+    "… %-age of test set correct: X" (CifarApp.scala:36-46 format)."""
+    import csv
+    import re
+
+    text = open(args.logfile).read().splitlines()
+    pl = re.compile(r"^(?P<sec>\d+(?:\.\d+)?): (?:iteration (?P<it>\d+): )?"
+                    r"(?P<msg>.*)$")
+    cli_train = re.compile(r"^Iteration (?P<it>\d+), loss = "
+                           r"(?P<loss>[-+.\deE]+)")
+    train_rows = []
+    test_rows = []
+    for line in text:
+        m = cli_train.match(line)
+        if m:
+            train_rows.append((int(m["it"]), "", float(m["loss"])))
+            continue
+        m = pl.match(line)
+        if not m:
+            continue
+        sec = float(m["sec"])
+        it = int(m["it"]) if m["it"] else ""
+        msg = m["msg"]
+        lm = re.match(r"round loss = ([-+.\deE]+)", msg)
+        if lm:
+            train_rows.append((it, sec, float(lm.group(1))))
+            continue
+        am = re.match(r"(?:final )?%-age of test set correct: "
+                      r"([-+.\deE]+)", msg)
+        if am:
+            test_rows.append((it, sec, float(am.group(1))))
+    base = args.output_dir.rstrip("/") + "/" + \
+        args.logfile.rsplit("/", 1)[-1]
+    for suffix, rows, cols in ((".train", train_rows,
+                                ["NumIters", "Seconds", "loss"]),
+                               (".test", test_rows,
+                                ["NumIters", "Seconds", "accuracy"])):
+        with open(base + suffix, "w", newline="") as f:
+            w = csv.writer(f)
+            w.writerow(cols)
+            w.writerows(rows)
+    print(f"Wrote {base}.train ({len(train_rows)} rows) and "
+          f"{base}.test ({len(test_rows)} rows)")
+    return 0
+
+
 def register(sub) -> None:
     u = sub.add_parser("upgrade_net_proto_text")
     u.add_argument("input")
@@ -325,6 +376,11 @@ def register(sub) -> None:
     de.add_argument("--raw_scale", type=float, default=255.0)
     de.add_argument("--context_pad", type=int, default=0)
     de.set_defaults(fn=cmd_detect)
+
+    p = sub.add_parser("parse_log")
+    p.add_argument("logfile")
+    p.add_argument("output_dir", nargs="?", default=".")
+    p.set_defaults(fn=cmd_parse_log)
 
     from . import draw_net
     draw_net.register(sub)

@@ -115,10 +115,48 @@ def test_expert_parallel_matches_dense():
     y_ep, aux_ep = expert_parallel_moe(jnp.asarray(x), *args,
                                        n_devices=8, k=2,
                                        capacity_factor=8.0)
-    y_dense, _ = moe_ffn(jnp.asarray(x), *args, k=2, capacity_factor=8.0)
+    y_dense, aux_dense = moe_ffn(jnp.asarray(x), *args, k=2,
+                                 capacity_factor=8.0)
     np.testing.assert_allclose(np.asarray(y_ep), np.asarray(y_dense),
                                rtol=2e-4, atol=1e-5)
-    assert np.isfinite(float(aux_ep))
+    np.testing.assert_allclose(float(aux_ep), float(aux_dense), rtol=1e-5)
+
+
+def test_expert_parallel_aux_exact_under_shard_imbalance():
+    """The Switch aux loss is nonlinear in the load stats, so averaging
+    per-shard losses would be wrong when shards route differently; EP must
+    pmean the stats FIRST and reproduce the dense global-batch aux."""
+    if len(jax.devices()) < 2:
+        pytest.skip("needs 2 devices")
+    from sparknet_tpu.parallel.expert import expert_parallel_moe
+
+    rng = np.random.RandomState(7)
+    n, m, e, h = 2, 8, 2, 8
+    t = 16
+    # shard 0's tokens all prefer expert 0, shard 1's all prefer expert 1
+    gate_w = np.zeros((m, e), np.float32)
+    gate_w[0, 0] = gate_w[1, 1] = 5.0
+    x = np.tile(np.eye(2, m, dtype=np.float32)[:, None, :],
+                (1, t // 2, 1)).reshape(t, m)
+    x += rng.rand(t, m).astype(np.float32) * 0.01
+    _w = _params(rng, m, e, h)
+    args = tuple(map(jnp.asarray, (gate_w,) + _w[1:]))
+    _, aux_ep = expert_parallel_moe(jnp.asarray(x), *args, n_devices=n,
+                                    k=1, capacity_factor=4.0)
+    _, aux_dense = moe_ffn(jnp.asarray(x), *args, k=1, capacity_factor=4.0)
+    np.testing.assert_allclose(float(aux_ep), float(aux_dense), rtol=1e-5)
+    # sanity: global balance is perfect (aux ~ 1), per-shard would be ~2
+    assert 0.9 < float(aux_dense) < 1.2, float(aux_dense)
+
+
+def test_expert_parallel_too_few_devices_raises():
+    from sparknet_tpu.parallel.expert import expert_parallel_moe
+
+    rng = np.random.RandomState(0)
+    args = tuple(map(jnp.asarray, _params(rng, 8, 64, 8)))
+    with pytest.raises(ValueError, match="need .* devices"):
+        expert_parallel_moe(jnp.asarray(rng.rand(64, 8).astype(np.float32)),
+                            *args, n_devices=len(jax.devices()) + 1, k=1)
 
 
 def test_moe_layer_trains():

@@ -393,3 +393,34 @@ def test_elementwise_grad_sweep(rng, name, f, domain):
     elif domain == "away_from_zero":
         x = np.where(np.abs(x) < 0.1, x + 0.3, x)  # keep off the kink
     check_grad(f, x)
+
+
+def test_max_pool_unrolled_bwd_matches_native(monkeypatch):
+    """SPARKNET_MAXPOOL_BWD=unrolled routes gradients identically to the
+    native SelectAndScatter path on continuous data, and first-max-wins on
+    ties (pooling_layer.cpp:163-168 strict > update)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from sparknet_tpu.ops import pooling
+
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(2, 3, 13, 9).astype(np.float32))
+
+    def loss(x):
+        return jnp.sum(jnp.sin(pooling.max_pool(x, (3, 3), stride=(2, 2),
+                                                pad=(1, 1))))
+
+    g_native = jax.grad(loss)(x)
+    monkeypatch.setenv("SPARKNET_MAXPOOL_BWD", "unrolled")
+    g_unrolled = jax.grad(loss)(x)
+    np.testing.assert_allclose(np.asarray(g_unrolled),
+                               np.asarray(g_native), rtol=1e-5, atol=1e-6)
+
+    ones = jnp.ones((1, 1, 4, 4), jnp.float32)
+    gt = jax.grad(lambda v: jnp.sum(pooling.max_pool(v, (2, 2),
+                                                     stride=(2, 2))))(ones)
+    expect = np.zeros((4, 4), np.float32)
+    expect[0::2, 0::2] = 1.0
+    np.testing.assert_array_equal(np.asarray(gt)[0, 0], expect)

@@ -241,3 +241,29 @@ def test_pipeline_clip_gradients_matches_single_device():
     for k, v in ref.params.items():
         np.testing.assert_allclose(np.asarray(pt.params[k]), np.asarray(v),
                                    rtol=2e-4, atol=1e-6, err_msg=k)
+
+
+def test_pipeline_snapshot_resume_exact(tmp_path):
+    """Kill-and-resume == uninterrupted run for the GPipe trainer; params
+    and momentum return to their home-stage devices."""
+    stream = _stream(12)
+    pt = PipelineTrainer(_sp(), n_stages=3, n_micro=2)
+    it1 = iter(stream)
+    pt.set_train_data(lambda: next(it1))
+    pt.step(3)
+    snap = pt.snapshot(str(tmp_path / "s.npz"))
+    pt.step(3)
+    expect = {k: np.asarray(v) for k, v in pt.params.items()}
+
+    p2 = PipelineTrainer(_sp(), n_stages=3, n_micro=2)
+    p2.restore(snap)
+    assert p2.iter == 3
+    for k in p2.params:
+        assert list(p2.params[k].devices())[0] == \
+            p2.devices[p2.stage_of(k)], k
+    it2 = iter(stream[3:])
+    p2.set_train_data(lambda: next(it2))
+    p2.step(3)
+    for k, v in expect.items():
+        np.testing.assert_allclose(np.asarray(p2.params[k]), v,
+                                   rtol=1e-6, atol=1e-7, err_msg=k)

@@ -95,3 +95,28 @@ layer { name: "loss" type: "SoftmaxWithLoss" bottom: "ip1" bottom: "label"
     assert main(["extract_features", "--model", str(model), "--data",
                  str(npz), "--blobs", "ip1", "--output", str(out),
                  "--batch", "100", "--size", "12"]) == 1
+
+
+def test_parse_log(tmp_path, capsys):
+    """parse_log turns both log dialects into train/test CSVs (reference:
+    tools/extra/parse_log.py interface)."""
+    import csv
+
+    from sparknet_tpu import cli
+
+    log = tmp_path / "training_log_123.txt"
+    log.write_text(
+        "0.52: rounds = 4, workers = 2, model = cifar10_quick\n"
+        "1.10: iteration 0: starting training\n"
+        "5.25: iteration 0: round loss = 2.301\n"
+        "9.75: iteration 1: %-age of test set correct: 0.42\n"
+        "12.00: iteration 1: round loss = 1.95\n"
+        "30.10: final %-age of test set correct: 0.61\n"
+        "Iteration 50, loss = 1.801\n")
+    assert cli.main(["parse_log", str(log), str(tmp_path)]) == 0
+    train = list(csv.reader(open(str(log) + ".train")))
+    test = list(csv.reader(open(str(log) + ".test")))
+    assert train[0] == ["NumIters", "Seconds", "loss"]
+    assert [r[2] for r in train[1:]] == ["2.301", "1.95", "1.801"]
+    assert test[0] == ["NumIters", "Seconds", "accuracy"]
+    assert [r[2] for r in test[1:]] == ["0.42", "0.61"]
