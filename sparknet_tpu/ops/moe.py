@@ -17,7 +17,7 @@ capacity-factor semantics.
 
 from __future__ import annotations
 
-from typing import Tuple
+from typing import Any, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -32,7 +32,7 @@ def expert_capacity(n_tokens: int, n_experts: int, k: int,
 
 def top_k_gating(x: jax.Array, gate_w: jax.Array, *, k: int,
                  capacity: int, return_load_stats: bool = False,
-                 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+                 ) -> Tuple[jax.Array, jax.Array, Any]:
     """Route (T, M) tokens to the top-k of E experts with static capacity.
 
     Returns (combine, dispatch, aux_loss):

@@ -46,18 +46,138 @@ def max_pool(x: jax.Array, kernel: Tuple[int, int], *,
     """MAX pooling; padding never wins (reference clips the window to the
     valid region, pooling_layer.cpp:155-169 — identical to -inf padding).
 
-    Gradient: XLA's native SelectAndScatter by default.  An alternative
-    custom VJP (kernel-unrolled compare/dilate/add, Caffe-exact first-max
-    tie routing) is selectable with SPARKNET_MAXPOOL_BWD=unrolled — it was
-    built on the hypothesis that SelectAndScatter dominates the measured
-    ~17% max-pool share of the GoogLeNet step, but MEASURED 2.5x SLOWER on
-    TPU v5e (9x full-map HBM traffic; GOOGLENET_PROFILE.md round-2 note),
-    so the native path stays the default."""
+    Gradient: XLA's native SelectAndScatter.  It is ~24% of a GoogLeNet
+    step (uniform-routing ablation 4,216 -> 5,502 img/s), so five
+    alternative formulations were built and measured on TPU v5e; ALL lost
+    (unrolled dilate/add 1,654, one-hot grouped conv 1,275, stride-residue
+    interleave 2,772 — kept here as "residue" in its faster tree-min tie
+    form, 2,635 — and fwd-index 2,650 img/s vs 4,216 native) — the kernel-size many strided passes over the map cost
+    more than the select they avoid, and Mosaic rejects strided slices so
+    a fused Pallas kernel is blocked (full log: GOOGLENET_PROFILE.md).
+    The two instructive variants stay selectable for future hardware:
+    SPARKNET_MAXPOOL_BWD=unrolled|residue (both Caffe-exact first-max tie
+    routing, gradient-equivalence tested) and =uniform (attribution only,
+    wrong gradients)."""
     import os
 
-    if os.environ.get("SPARKNET_MAXPOOL_BWD") == "unrolled":
+    impl = os.environ.get("SPARKNET_MAXPOOL_BWD")
+    if impl == "unrolled":
         return _max_pool(x, tuple(kernel), tuple(stride), tuple(pad))
+    if impl == "uniform":  # ATTRIBUTION ONLY: wrong gradients (AVE-style
+        # uniform routing) to isolate SelectAndScatter's cost from the
+        # backward's data movement
+        return _max_pool_uniform_bwd(x, tuple(kernel), tuple(stride),
+                                     tuple(pad))
+    if impl == "residue":
+        return _max_pool_residue(x, tuple(kernel), tuple(stride),
+                                 tuple(pad))
+    if impl not in (None, "", "native"):
+        raise ValueError(
+            f"SPARKNET_MAXPOOL_BWD={impl!r}: expected native, unrolled, "
+            f"residue, or uniform (the other formulations from the "
+            f"GOOGLENET_PROFILE.md study were removed as strictly worse)")
     return _max_pool_raw(x, tuple(kernel), tuple(stride), tuple(pad))
+
+
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(1, 2, 3))
+def _max_pool_residue(x, kernel, stride, pad):
+    return _max_pool_raw(x, kernel, stride, pad)
+
+
+def _max_pool_residue_fwd(x, kernel, stride, pad):
+    y = _max_pool_raw(x, kernel, stride, pad)
+    return y, (x, y)
+
+
+def _max_pool_residue_bwd(kernel, stride, pad, res, g):
+    """Exact max routing via stride-residue decomposition.
+
+    Input row u receives only from window offsets i with i ≡ u+pad (mod
+    stride), so the scatter splits into stride² independent CLASS maps:
+    each of the kernel's one-hot masks accumulates (with an integer shift)
+    into its class on the SMALL pooled grid, and one interleaving reshape
+    assembles gx — one full-map write, no SelectAndScatter, no dilated
+    conv.  First-max-wins tie routing as pooling_layer.cpp:163-168."""
+    x, y = res
+    n, c, h, w = x.shape
+    kh, kw = kernel
+    sh, sw = stride
+    oh, ow, pad_h, pad_w = _window_geometry((h, w), kernel, pad, stride)
+    hp, wp = h + pad_h[0] + pad_h[1], w + pad_w[0] + pad_w[1]
+    lh, lw = -(-hp // sh), -(-wp // sw)
+    xp = jnp.pad(x, ((0, 0), (0, 0), pad_h, pad_w),
+                 constant_values=-jnp.inf)
+    # first-max-wins via a parallel tree-min over offset indices (no
+    # sequential taken-chain): eq masks and the min combine in parallel
+    eqs = []
+    first = None
+    big = jnp.int32(kh * kw)
+    for i in range(kh):
+        for j in range(kw):
+            patch = lax.slice(
+                xp, (0, 0, i, j),
+                (n, c, i + (oh - 1) * sh + 1, j + (ow - 1) * sw + 1),
+                (1, 1, sh, sw))
+            eq = patch == y
+            eqs.append(eq)
+            cand = jnp.where(eq, jnp.int32(i * kw + j), big)
+            first = cand if first is None else jnp.minimum(first, cand)
+    zero = jnp.zeros((n, c, lh, lw), dtype=g.dtype)
+    classes = [[zero] * sw for _ in range(sh)]
+    for i in range(kh):
+        for j in range(kw):
+            win = eqs[i * kw + j] & (first == i * kw + j)
+            m = jnp.where(win, g, jnp.zeros((), g.dtype))
+            dh, dw = i // sh, j // sw
+            shifted = jnp.pad(m, ((0, 0), (0, 0),
+                                  (dh, lh - oh - dh),
+                                  (dw, lw - ow - dw)))
+            classes[i % sh][j % sw] = classes[i % sh][j % sw] + shifted
+    # interleave class maps: (n, c, lh, sh, lw, sw) -> (n, c, lh*sh, lw*sw)
+    grid = jnp.stack([jnp.stack(row, axis=-1) for row in classes],
+                     axis=-3)  # rows: (n,c,lh,lw,sw) -> (n,c,lh,sh,lw,sw)
+    gx = grid.reshape(n, c, lh * sh, lw * sw)
+    return (lax.slice(gx, (0, 0, pad_h[0], pad_w[0]),
+                      (n, c, pad_h[0] + h, pad_w[0] + w)),)
+
+
+_max_pool_residue.defvjp(_max_pool_residue_fwd, _max_pool_residue_bwd)
+
+
+
+
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(1, 2, 3))
+def _max_pool_uniform_bwd(x, kernel, stride, pad):
+    return _max_pool_raw(x, kernel, stride, pad)
+
+
+def _max_pool_uniform_fwd_rule(x, kernel, stride, pad):
+    return _max_pool_raw(x, kernel, stride, pad), x.shape
+
+
+def _max_pool_uniform_bwd_rule(kernel, stride, pad, x_shape, g):
+    # route g/|window| uniformly — the transpose of AVE pooling's sum,
+    # which XLA lowers to a dilated reduce_window (no select)
+    n, c, h, w = x_shape
+    oh, ow, pad_h, pad_w = _window_geometry((h, w), kernel, pad, stride)
+    gd = lax.pad(g / (kernel[0] * kernel[1]), jnp.zeros((), g.dtype),
+                 ((0, 0, 0), (0, 0, 0),
+                  (kernel[0] - 1 - pad_h[0], kernel[0] - 1 - pad_h[1],
+                   stride[0] - 1),
+                  (kernel[1] - 1 - pad_w[0], kernel[1] - 1 - pad_w[1],
+                   stride[1] - 1)))
+    gx = lax.reduce_window(
+        gd, 0.0, lax.add, window_dimensions=(1, 1, kernel[0], kernel[1]),
+        window_strides=(1, 1, 1, 1), padding="VALID")
+    return (gx[:, :, :h, :w],)
+
+
+_max_pool_uniform_bwd.defvjp(_max_pool_uniform_fwd_rule,
+                             _max_pool_uniform_bwd_rule)
 
 
 def _max_pool_raw(x, kernel, stride, pad):

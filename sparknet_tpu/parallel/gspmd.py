@@ -149,6 +149,10 @@ class GspmdTrainer:
         missing = set(self.params) - set(params)
         if missing:
             raise ValueError(f"snapshot lacks params: {sorted(missing)}")
+        missing_state = set(self.state) - set(state)
+        if missing_state:
+            raise ValueError(
+                f"snapshot lacks solver state for: {sorted(missing_state)}")
 
         def shard(k):
             return NamedSharding(self.mesh, self.param_specs[k])

@@ -278,6 +278,10 @@ class PipelineTrainer:
         missing = set(self.params) - set(params)
         if missing:
             raise ValueError(f"snapshot lacks params: {sorted(missing)}")
+        missing_state = set(self.state) - set(state)
+        if missing_state:
+            raise ValueError(
+                f"snapshot lacks solver state for: {sorted(missing_state)}")
         self.params = {
             k: jax.device_put(jnp.asarray(params[k]),
                               self.devices[self._key_stage[k]])

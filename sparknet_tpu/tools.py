@@ -271,16 +271,22 @@ def cmd_parse_log(args) -> int:
                            r"(?P<loss>[-+.\deE]+)")
     train_rows = []
     test_rows = []
+    last_it = 0
+    last_sec = 0.0
     for line in text:
         m = cli_train.match(line)
         if m:
-            train_rows.append((int(m["it"]), "", float(m["loss"])))
+            # numeric columns throughout (loadtxt-compatible, like the
+            # reference parse_log.py): CLI lines carry no elapsed time,
+            # reuse the last seen
+            last_it = int(m["it"])
+            train_rows.append((last_it, last_sec, float(m["loss"])))
             continue
         m = pl.match(line)
         if not m:
             continue
-        sec = float(m["sec"])
-        it = int(m["it"]) if m["it"] else ""
+        sec = last_sec = float(m["sec"])
+        it = last_it = int(m["it"]) if m["it"] else last_it
         msg = m["msg"]
         lm = re.match(r"round loss = ([-+.\deE]+)", msg)
         if lm:

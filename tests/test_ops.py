@@ -424,3 +424,30 @@ def test_max_pool_unrolled_bwd_matches_native(monkeypatch):
     expect = np.zeros((4, 4), np.float32)
     expect[0::2, 0::2] = 1.0
     np.testing.assert_array_equal(np.asarray(gt)[0, 0], expect)
+
+
+def test_max_pool_residue_bwd_matches_native(monkeypatch):
+    """SPARKNET_MAXPOOL_BWD=residue (stride-residue interleave) is
+    gradient-identical to the native path, ceil-mode and padding
+    included."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from sparknet_tpu.ops import pooling
+
+    rng = np.random.RandomState(1)
+    for (h, w, k, s, p) in [(13, 9, 3, 2, 1), (8, 8, 2, 2, 0),
+                            (14, 14, 5, 3, 2)]:
+        x = jnp.asarray(rng.randn(2, 4, h, w).astype(np.float32))
+
+        def loss(x):
+            return jnp.sum(jnp.sin(pooling.max_pool(
+                x, (k, k), stride=(s, s), pad=(p, p))))
+
+        monkeypatch.delenv("SPARKNET_MAXPOOL_BWD", raising=False)
+        g_native = jax.grad(loss)(x)
+        monkeypatch.setenv("SPARKNET_MAXPOOL_BWD", "residue")
+        g_res = jax.grad(loss)(x)
+        np.testing.assert_allclose(np.asarray(g_res), np.asarray(g_native),
+                                   rtol=1e-5, atol=1e-6)
