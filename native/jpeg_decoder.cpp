@@ -1,0 +1,176 @@
+// Parallel JPEG decode + resize for the host data path.
+//
+// Role: the reference decodes JPEGs on the JVM with ImageIO/twelvemonkeys
+// inside Spark executor parallelism (reference:
+// src/main/scala/preprocessing/ScaleAndConvert.scala:16-27); a TPU-VM host
+// has no executor fleet, so ImageNet-scale decode (256 imgs/step) needs
+// native threads (SURVEY.md §7 "hard parts": input pipeline throughput).
+// This library decodes a whole minibatch across a thread pool with libjpeg,
+// DCT-prescales to the nearest power-of-two fraction >= target, finishes
+// with bilinear resample, and emits planar RGB CHW uint8 — the ByteImage
+// layout.  Corrupt images set ok[i]=0 and the caller drops them, matching
+// ScaleAndConvert.scala:17-26.
+//
+// C API (ctypes-friendly, mirrors the libccaffe flat-function style,
+// reference: libccaffe/ccaffe.h):
+//   snt_jpeg_decode_batch(bufs, lens, n, th, tw, n_threads, out, ok)
+//     out: n * 3 * th * tw uint8 (CHW per image); ok: n bytes 0/1.
+
+#include <algorithm>
+#include <atomic>
+#include <cstdint>
+#include <cstring>
+#include <csetjmp>
+#include <thread>
+#include <vector>
+
+#include <jpeglib.h>
+
+namespace {
+
+struct ErrorMgr {
+  jpeg_error_mgr pub;
+  jmp_buf setjmp_buffer;
+};
+
+void ErrorExit(j_common_ptr cinfo) {
+  ErrorMgr* err = reinterpret_cast<ErrorMgr*>(cinfo->err);
+  longjmp(err->setjmp_buffer, 1);
+}
+
+// silent, but keep the warning counter (the default emit_message is what
+// increments num_warnings; DecodeRGB treats any warning as corrupt)
+void EmitNothing(j_common_ptr cinfo, int msg_level) {
+  if (msg_level < 0) cinfo->err->num_warnings++;
+}
+
+// Decode one JPEG to interleaved RGB at the libjpeg-prescaled size.
+// Returns false on corrupt input.
+bool DecodeRGB(const uint8_t* buf, long len, int target_h, int target_w,
+               std::vector<uint8_t>* rgb, int* out_h, int* out_w) {
+  jpeg_decompress_struct cinfo;
+  ErrorMgr jerr;
+  // heap-owning locals live BEFORE the setjmp: a longjmp must not skip
+  // their destructors (UB + leak per corrupt image otherwise)
+  std::vector<uint8_t> row;
+  cinfo.err = jpeg_std_error(&jerr.pub);
+  jerr.pub.error_exit = ErrorExit;
+  jerr.pub.emit_message = EmitNothing;
+  if (setjmp(jerr.setjmp_buffer)) {
+    jpeg_destroy_decompress(&cinfo);
+    return false;
+  }
+  jpeg_create_decompress(&cinfo);
+  jpeg_mem_src(&cinfo, buf, static_cast<unsigned long>(len));
+  if (jpeg_read_header(&cinfo, TRUE) != JPEG_HEADER_OK) {
+    jpeg_destroy_decompress(&cinfo);
+    return false;
+  }
+  cinfo.out_color_space = JCS_RGB;
+  // DCT prescale: pick denom in {1,2,4,8} keeping both dims >= target
+  // (the bilinear finish then only ever downsamples by < 2x per axis)
+  if (target_h > 0 && target_w > 0) {
+    unsigned denom = 1;
+    while (denom < 8 &&
+           cinfo.image_height / (denom * 2) >= (unsigned)target_h &&
+           cinfo.image_width / (denom * 2) >= (unsigned)target_w) {
+      denom *= 2;
+    }
+    cinfo.scale_num = 1;
+    cinfo.scale_denom = denom;
+  }
+  jpeg_start_decompress(&cinfo);
+  const int h = cinfo.output_height;
+  const int w = cinfo.output_width;
+  // out_color_space=JCS_RGB makes libjpeg convert grayscale/YCbCr itself;
+  // anything it can't convert (e.g. CMYK sources) is rejected
+  if (cinfo.output_components != 3) {
+    jpeg_abort_decompress(&cinfo);
+    jpeg_destroy_decompress(&cinfo);
+    return false;
+  }
+  row.resize(static_cast<size_t>(w) * 3);
+  rgb->assign(static_cast<size_t>(h) * w * 3, 0);
+  for (int y = 0; y < h; ++y) {
+    uint8_t* rp = row.data();
+    jpeg_read_scanlines(&cinfo, &rp, 1);
+    std::memcpy(rgb->data() + static_cast<size_t>(y) * w * 3, row.data(),
+                static_cast<size_t>(w) * 3);
+  }
+  jpeg_finish_decompress(&cinfo);
+  // truncated/corrupt-but-recoverable streams only WARN (libjpeg fills
+  // missing scanlines); count them as corrupt like the reference's decoder
+  // failures (ScaleAndConvert.scala:17-26 drops on any decode exception)
+  const bool clean = cinfo.err->num_warnings == 0;
+  jpeg_destroy_decompress(&cinfo);
+  *out_h = h;
+  *out_w = w;
+  return clean;
+}
+
+// Interleaved (h, w, 3) -> planar CHW (3, th, tw) with bilinear resample
+// (align-corners=false, the Thumbnails.forceSize-style full-image map).
+void ResizeToPlanar(const std::vector<uint8_t>& rgb, int h, int w, int th,
+                    int tw, uint8_t* out) {
+  const float sy = static_cast<float>(h) / th;
+  const float sx = static_cast<float>(w) / tw;
+  for (int y = 0; y < th; ++y) {
+    float fy = (y + 0.5f) * sy - 0.5f;
+    fy = std::max(0.0f, std::min(fy, static_cast<float>(h - 1)));
+    const int y0 = static_cast<int>(fy);
+    const int y1 = std::min(y0 + 1, h - 1);
+    const float wy = fy - y0;
+    for (int x = 0; x < tw; ++x) {
+      float fx = (x + 0.5f) * sx - 0.5f;
+      fx = std::max(0.0f, std::min(fx, static_cast<float>(w - 1)));
+      const int x0 = static_cast<int>(fx);
+      const int x1 = std::min(x0 + 1, w - 1);
+      const float wx = fx - x0;
+      for (int c = 0; c < 3; ++c) {
+        const float v00 = rgb[(static_cast<size_t>(y0) * w + x0) * 3 + c];
+        const float v01 = rgb[(static_cast<size_t>(y0) * w + x1) * 3 + c];
+        const float v10 = rgb[(static_cast<size_t>(y1) * w + x0) * 3 + c];
+        const float v11 = rgb[(static_cast<size_t>(y1) * w + x1) * 3 + c];
+        const float v = (1 - wy) * ((1 - wx) * v00 + wx * v01) +
+                        wy * ((1 - wx) * v10 + wx * v11);
+        out[(static_cast<size_t>(c) * th + y) * tw + x] =
+            static_cast<uint8_t>(v + 0.5f);
+      }
+    }
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+// Decode n JPEG buffers to (n, 3, th, tw) uint8 planar RGB using
+// n_threads workers.  ok[i] = 1 on success, 0 for corrupt/unsupported.
+void snt_jpeg_decode_batch(const uint8_t** bufs, const long* lens, int n,
+                           int th, int tw, int n_threads, uint8_t* out,
+                           uint8_t* ok) {
+  std::atomic<int> next(0);
+  const size_t img_size = static_cast<size_t>(3) * th * tw;
+  auto worker = [&]() {
+    std::vector<uint8_t> rgb;
+    for (;;) {
+      const int i = next.fetch_add(1);
+      if (i >= n) return;
+      int h = 0, w = 0;
+      if (DecodeRGB(bufs[i], lens[i], th, tw, &rgb, &h, &w)) {
+        ResizeToPlanar(rgb, h, w, th, tw, out + img_size * i);
+        ok[i] = 1;
+      } else {
+        std::memset(out + img_size * i, 0, img_size);
+        ok[i] = 0;
+      }
+    }
+  };
+  const int nt = std::max(1, std::min(n_threads, n));
+  std::vector<std::thread> threads;
+  threads.reserve(nt);
+  for (int t = 0; t < nt; ++t) threads.emplace_back(worker);
+  for (auto& t : threads) t.join();
+}
+
+}  // extern "C"

@@ -1,0 +1,55 @@
+"""Host JPEG-decode throughput: PIL single-thread vs native libjpeg pool.
+
+The number that matters for ImageNet training is images/second through
+decode+resize to 256x256 (reference pipeline shape:
+preprocessing/ScaleAndConvert.scala:16-27; AlexNet consumes 256/step).
+Run on the TPU-VM host: `python scripts/decode_bench.py [n_imgs]`.
+"""
+
+import io
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+
+def main():
+    from PIL import Image
+
+    from sparknet_tpu.data import native_jpeg
+    from sparknet_tpu.data.scale_convert import decode_and_resize
+
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 256
+    rng = np.random.RandomState(0)
+    bufs = []
+    for i in range(n):
+        # ImageNet-ish source sizes around 500x375
+        h, w = 375 + (i % 5) * 17, 500 - (i % 7) * 23
+        arr = (rng.rand(h, w, 3) * 255).astype(np.uint8)
+        b = io.BytesIO()
+        Image.fromarray(arr).save(b, format="JPEG", quality=87)
+        bufs.append(b.getvalue())
+    mb = sum(len(b) for b in bufs) / 1e6
+    print(f"{n} jpegs, {mb:.1f} MB total")
+
+    t0 = time.perf_counter()
+    kept = sum(decode_and_resize(b, 256, 256) is not None for b in bufs)
+    t_pil = time.perf_counter() - t0
+    print(f"PIL single-thread : {n / t_pil:8.1f} img/s ({kept} ok)")
+
+    if not native_jpeg.available():
+        print("native pool      : not built (make -C native)")
+        return
+    for threads in (1, 4, 8, 16):
+        t0 = time.perf_counter()
+        _, ok = native_jpeg.decode_batch(bufs, 256, 256,
+                                         n_threads=threads)
+        dt = time.perf_counter() - t0
+        print(f"native {threads:2d} threads: {n / dt:8.1f} img/s "
+              f"({int(ok.sum())} ok)")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,103 @@
+"""ctypes wrapper for the native parallel JPEG decoder
+(native/jpeg_decoder.cpp).
+
+The reference decodes JPEGs with JVM ImageIO under Spark executor
+parallelism (reference: preprocessing/ScaleAndConvert.scala:16-27); on a
+TPU-VM the equivalent is a libjpeg thread pool.  `decode_batch` returns the
+planar-RGB uint8 batch plus a keep-mask — corrupt images are dropped by the
+caller exactly like ScaleAndConvert.scala:17-26.  Falls back to None when
+the shared library isn't built (callers then use the PIL path in
+data/scale_convert.py).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+_LIB: Optional[ctypes.CDLL] = None
+_TRIED = False
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _LIB, _TRIED
+    if _TRIED:
+        return _LIB
+    _TRIED = True
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__)))), "native",
+        "libsparknet_jpeg.so")
+    override = os.environ.get("SPARKNET_JPEG_LIB")
+    if override:
+        path = override
+    if not os.path.exists(path):
+        return None
+    try:
+        lib = ctypes.CDLL(path)
+    except OSError:
+        return None
+    lib.snt_jpeg_decode_batch.argtypes = [
+        ctypes.POINTER(ctypes.c_char_p), ctypes.POINTER(ctypes.c_long),
+        ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+        ctypes.POINTER(ctypes.c_uint8), ctypes.POINTER(ctypes.c_uint8)]
+    lib.snt_jpeg_decode_batch.restype = None
+    _LIB = lib
+    return _LIB
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def decode_batch(bufs: Sequence[bytes], height: int, width: int, *,
+                 n_threads: int = 8,
+                 ) -> Optional[Tuple[np.ndarray, np.ndarray]]:
+    """Decode JPEG byte strings to ((n, 3, height, width) uint8, ok mask).
+
+    Returns None when the native library isn't available."""
+    lib = _load()
+    if lib is None:
+        return None
+    n = len(bufs)
+    out = np.empty((n, 3, height, width), dtype=np.uint8)
+    ok = np.zeros((n,), dtype=np.uint8)
+    if n == 0:
+        return out, ok.astype(bool)
+    # c_char_p from a bytes object points at its internal buffer and the
+    # array keeps the bytes alive for the duration of the call
+    arr_t = ctypes.c_char_p * n
+    ptrs = arr_t(*[b if b else b"\x00" for b in bufs])
+    lens = (ctypes.c_long * n)(*[len(b) for b in bufs])
+    lib.snt_jpeg_decode_batch(
+        ctypes.cast(ptrs, ctypes.POINTER(ctypes.c_char_p)),
+        ctypes.cast(lens, ctypes.POINTER(ctypes.c_long)),
+        n, height, width, n_threads,
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        ok.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)))
+    return out, ok.astype(bool)
+
+
+def decode_batch_or_fallback(bufs: Sequence[bytes], height: int,
+                             width: int, *, n_threads: int = 8,
+                             ) -> Tuple[np.ndarray, np.ndarray]:
+    """Native decode when built, else the PIL path — same contract."""
+    got = decode_batch(bufs, height, width, n_threads=n_threads)
+    if got is not None:
+        return got
+    from .scale_convert import decode_and_resize
+
+    imgs: List[np.ndarray] = []
+    ok = np.zeros((len(bufs),), dtype=bool)
+    blank = np.zeros((3, height, width), dtype=np.uint8)
+    for i, b in enumerate(bufs):
+        arr = decode_and_resize(b, height, width)
+        if arr is None:
+            imgs.append(blank)
+        else:
+            imgs.append(arr)
+            ok[i] = True
+    return np.stack(imgs) if imgs else \
+        np.zeros((0, 3, height, width), np.uint8), ok

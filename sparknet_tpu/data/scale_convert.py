@@ -31,11 +31,44 @@ def decode_and_resize(jpeg_bytes: bytes, height: Optional[int] = None,
 
 
 def convert_stream(pairs: Iterable[Tuple[bytes, int]], height: int,
-                   width: int) -> Iterator[Tuple[np.ndarray, int]]:
-    for raw, label in pairs:
-        arr = decode_and_resize(raw, height, width)
-        if arr is not None:
-            yield arr, label
+                   width: int, *, chunk: int = 64,
+                   ) -> Iterator[Tuple[np.ndarray, int]]:
+    """Decode/resize a (bytes, label) stream, dropping corrupt images.
+
+    When the native libjpeg thread pool is built (native/jpeg_decoder.cpp,
+    data/native_jpeg.py) images decode `chunk` at a time across threads —
+    the TPU-VM stand-in for the reference's Spark-executor decode
+    parallelism (ScaleAndConvert.scala:16-27).  Images the native decoder
+    rejects get one PIL second chance (it also reads PNG); only then are
+    they dropped."""
+    from . import native_jpeg
+
+    if not (height and width) or not native_jpeg.available():
+        for raw, label in pairs:
+            arr = decode_and_resize(raw, height, width)
+            if arr is not None:
+                yield arr, label
+        return
+
+    def flush(buf):
+        out, ok = native_jpeg.decode_batch([b for b, _ in buf], height,
+                                           width)
+        for i, (raw, label) in enumerate(buf):
+            if ok[i]:
+                yield out[i], label
+            else:
+                arr = decode_and_resize(raw, height, width)
+                if arr is not None:
+                    yield arr, label
+
+    buf: List[Tuple[bytes, int]] = []
+    for item in pairs:
+        buf.append(item)
+        if len(buf) >= chunk:
+            yield from flush(buf)
+            buf = []
+    if buf:
+        yield from flush(buf)
 
 
 def make_minibatch_stream(pairs: Iterable[Tuple[np.ndarray, int]],

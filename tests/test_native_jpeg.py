@@ -1,0 +1,121 @@
+"""Native parallel JPEG decoder (native/jpeg_decoder.cpp) vs the PIL path.
+
+The decode itself must agree closely with PIL (both ride libjpeg); the
+resize is bilinear vs PIL's filter, so resized comparisons use a mean
+tolerance.  Corrupt images must drop via the ok-mask exactly like
+ScaleAndConvert.scala:17-26."""
+
+import io
+
+import numpy as np
+import pytest
+
+from sparknet_tpu.data import native_jpeg
+
+pytestmark = pytest.mark.skipif(not native_jpeg.available(),
+                                reason="libsparknet_jpeg.so not built")
+
+
+def _jpeg_bytes(arr, quality=95):
+    from PIL import Image
+
+    buf = io.BytesIO()
+    Image.fromarray(arr).save(buf, format="JPEG", quality=quality)
+    return buf.getvalue()
+
+
+def _ref_decode(b, h, w):
+    from sparknet_tpu.data.scale_convert import decode_and_resize
+
+    return decode_and_resize(b, h, w)
+
+
+def test_decode_no_resize_matches_pil():
+    rng = np.random.RandomState(0)
+    img = (rng.rand(40, 56, 3) * 255).astype(np.uint8)
+    b = _jpeg_bytes(img)
+    out, ok = native_jpeg.decode_batch([b], 40, 56)
+    assert ok.all()
+    ref = _ref_decode(b, None, None)
+    # same libjpeg underneath: decoded pixels should be near-identical
+    diff = np.abs(out[0].astype(int) - ref.astype(int))
+    assert diff.mean() < 1.0 and diff.max() <= 16, (diff.mean(), diff.max())
+
+
+def test_decode_with_resize_close_to_pil():
+    rng = np.random.RandomState(1)
+    img = (rng.rand(300, 400, 3) * 255).astype(np.uint8)
+    # smooth the noise so resampling-filter differences stay small
+    img = np.asarray(img, dtype=np.float32)
+    img = (img[:-1:2, :-1:2] + img[1::2, 1::2]) / 2
+    img = np.repeat(np.repeat(img, 2, 0), 2, 1).astype(np.uint8)
+    b = _jpeg_bytes(img)
+    out, ok = native_jpeg.decode_batch([b], 227, 227)
+    assert ok.all()
+    ref = _ref_decode(b, 227, 227)
+    diff = np.abs(out[0].astype(int) - ref.astype(int))
+    assert diff.mean() < 8.0, diff.mean()
+
+
+def test_corrupt_and_empty_inputs_masked():
+    rng = np.random.RandomState(2)
+    good = _jpeg_bytes((rng.rand(64, 64, 3) * 255).astype(np.uint8))
+    out, ok = native_jpeg.decode_batch(
+        [good, b"not a jpeg", b"", good[: len(good) // 3]], 32, 32)
+    assert ok.tolist() == [True, False, False, False]
+    assert out.shape == (4, 3, 32, 32)
+    assert (out[1] == 0).all()
+
+
+def test_grayscale_replicates_channels():
+    from PIL import Image
+
+    rng = np.random.RandomState(3)
+    gray = (rng.rand(50, 50) * 255).astype(np.uint8)
+    buf = io.BytesIO()
+    Image.fromarray(gray, mode="L").save(buf, format="JPEG", quality=95)
+    out, ok = native_jpeg.decode_batch([buf.getvalue()], 50, 50)
+    assert ok.all()
+    np.testing.assert_array_equal(out[0, 0], out[0, 1])
+    np.testing.assert_array_equal(out[0, 0], out[0, 2])
+
+
+def test_batch_threads_match_single():
+    rng = np.random.RandomState(4)
+    bufs = [_jpeg_bytes((rng.rand(100 + 7 * i, 120, 3) * 255
+                         ).astype(np.uint8)) for i in range(16)]
+    a, ok_a = native_jpeg.decode_batch(bufs, 64, 64, n_threads=8)
+    b, ok_b = native_jpeg.decode_batch(bufs, 64, 64, n_threads=1)
+    assert ok_a.all() and ok_b.all()
+    np.testing.assert_array_equal(a, b)
+
+
+def test_fallback_contract(monkeypatch):
+    """decode_batch_or_fallback gives the same shapes/mask through the PIL
+    path when the native library is unavailable."""
+    rng = np.random.RandomState(5)
+    good = _jpeg_bytes((rng.rand(48, 48, 3) * 255).astype(np.uint8))
+    native = native_jpeg.decode_batch_or_fallback([good, b"bad"], 32, 32)
+    monkeypatch.setattr(native_jpeg, "_LIB", None)
+    monkeypatch.setattr(native_jpeg, "_TRIED", True)
+    pil = native_jpeg.decode_batch_or_fallback([good, b"bad"], 32, 32)
+    assert native[0].shape == pil[0].shape == (2, 3, 32, 32)
+    assert native[1].tolist() == pil[1].tolist() == [True, False]
+
+
+def test_convert_stream_uses_native_and_drops_corrupt():
+    """The shared convert_stream pipeline (imagenet.batches feeds through
+    it) produces the same kept-set through the native pool as the PIL
+    path, corrupt entries dropped."""
+    from sparknet_tpu.data import scale_convert
+
+    rng = np.random.RandomState(6)
+    pairs = []
+    for i in range(10):
+        pairs.append((_jpeg_bytes((rng.rand(80, 90, 3) * 255
+                                   ).astype(np.uint8)), i))
+    pairs.insert(3, (b"corrupt!", 99))
+    got = list(scale_convert.convert_stream(iter(pairs), 32, 32, chunk=4))
+    assert [lbl for _, lbl in got] == list(range(10))
+    assert all(a.shape == (3, 32, 32) and a.dtype == np.uint8
+               for a, _ in got)
