@@ -7,12 +7,14 @@ Run on the TPU-VM host: `python scripts/decode_bench.py [n_imgs]`.
 """
 
 import io
+import os
 import sys
 import time
 
 import numpy as np
 
-sys.path.insert(0, __file__.rsplit("/", 2)[0])
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
 
 
 def main():

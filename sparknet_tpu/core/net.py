@@ -997,9 +997,9 @@ def build_attention(net: Net, layer: LayerParameter, bshapes):
         raise ValueError(f"embed dim {e} not divisible by num_heads {heads}")
     causal = bool(ap.causal)
     method = str(ap.method)
-    if method not in ("dense", "blockwise"):
+    if method not in ("dense", "blockwise", "flash"):
         raise ValueError(f"attention method {method!r}; expected "
-                         f"'dense' or 'blockwise'")
+                         f"'dense', 'blockwise', or 'flash'")
     block = int(ap.block_size)
     if method == "blockwise" and s % block:
         raise ValueError(
@@ -1035,6 +1035,9 @@ def build_attention(net: Net, layer: LayerParameter, bshapes):
         if method == "blockwise":
             o = ops.blockwise_attention(q, k, v, block_size=block,
                                         causal=causal)
+        elif method == "flash":
+            # fused Pallas kernel on TPU; same-math fallback elsewhere
+            o = ops.flash_attention_tpu(q, k, v, causal=causal)
         else:
             o = ops.attention(q, k, v, causal=causal)
         o = o.transpose(0, 2, 1, 3).reshape(n, s, e)

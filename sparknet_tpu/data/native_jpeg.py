@@ -78,26 +78,3 @@ def decode_batch(bufs: Sequence[bytes], height: int, width: int, *,
         out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
         ok.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)))
     return out, ok.astype(bool)
-
-
-def decode_batch_or_fallback(bufs: Sequence[bytes], height: int,
-                             width: int, *, n_threads: int = 8,
-                             ) -> Tuple[np.ndarray, np.ndarray]:
-    """Native decode when built, else the PIL path — same contract."""
-    got = decode_batch(bufs, height, width, n_threads=n_threads)
-    if got is not None:
-        return got
-    from .scale_convert import decode_and_resize
-
-    imgs: List[np.ndarray] = []
-    ok = np.zeros((len(bufs),), dtype=bool)
-    blank = np.zeros((3, height, width), dtype=np.uint8)
-    for i, b in enumerate(bufs):
-        arr = decode_and_resize(b, height, width)
-        if arr is None:
-            imgs.append(blank)
-        else:
-            imgs.append(arr)
-            ok[i] = True
-    return np.stack(imgs) if imgs else \
-        np.zeros((0, 3, height, width), np.uint8), ok

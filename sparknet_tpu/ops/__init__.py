@@ -5,7 +5,8 @@ Layer class hierarchy — composition happens in core.net."""
 
 from .activations import (absval, bnll, dropout, exp, log, power, prelu, relu,
                           sigmoid, tanh, threshold)
-from .attention import attention, blockwise_attention
+from .attention import (attention, blockwise_attention,
+                        flash_attention_tpu)
 from .conv import conv2d, conv_out_dim, deconv2d, deconv_out_dim, im2col
 from .dense import embed, inner_product
 from .lrn import lrn, lrn_across_channels, lrn_within_channel

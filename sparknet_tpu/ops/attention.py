@@ -64,6 +64,49 @@ def _block_update(carry, q, k, v, scale, mask):
     return (o_new, m_new, l_new)
 
 
+def flash_attention_tpu(q: jax.Array, k: jax.Array, v: jax.Array, *,
+                        causal: bool = False,
+                        scale: Optional[float] = None) -> jax.Array:
+    """Flash attention: the fused Pallas kernel jax ships
+    (jax.experimental.pallas.ops.tpu.flash_attention) when explicitly
+    enabled, else `blockwise_attention` — the same online-softmax
+    recurrence through XLA, asserted equivalent in tests/test_attention.py.
+
+    The Pallas kernel is OPT-IN via SPARKNET_FLASH_ATTENTION=1 rather than
+    auto-selected on TPU: on this project's tunneled dev platform the
+    shipped kernel HANGS at compile (not an exception a fallback could
+    catch), so the safe default is the XLA path; flip the env on a real
+    TPU-VM after a smoke run."""
+    import os
+
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    try:
+        if os.environ.get("SPARKNET_FLASH_ATTENTION") != "1":
+            raise NotImplementedError("pallas flash kernel is opt-in")
+        if jax.devices()[0].platform != "tpu":
+            raise NotImplementedError("flash kernel is TPU-only")
+        from jax.experimental.pallas.ops.tpu.flash_attention import \
+            flash_attention
+
+        return flash_attention(q, k, v, causal=causal, sm_scale=scale)
+    except Exception as e:
+        if os.environ.get("SPARKNET_FLASH_ATTENTION") == "1":
+            import warnings
+
+            warnings.warn(f"SPARKNET_FLASH_ATTENTION=1 but the pallas "
+                          f"kernel was not used ({e}); falling back to "
+                          f"blockwise attention", stacklevel=2)
+        block = min(128, q.shape[2])
+        if k.shape[2] % block:
+            block = 1
+            for b in range(1, min(129, k.shape[2] + 1)):
+                if k.shape[2] % b == 0:
+                    block = b
+        return blockwise_attention(q, k, v, block_size=block,
+                                   causal=causal, scale=scale)
+
+
 def blockwise_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
                         block_size: int, causal: bool = False,
                         scale: Optional[float] = None) -> jax.Array:

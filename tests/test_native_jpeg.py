@@ -90,19 +90,6 @@ def test_batch_threads_match_single():
     np.testing.assert_array_equal(a, b)
 
 
-def test_fallback_contract(monkeypatch):
-    """decode_batch_or_fallback gives the same shapes/mask through the PIL
-    path when the native library is unavailable."""
-    rng = np.random.RandomState(5)
-    good = _jpeg_bytes((rng.rand(48, 48, 3) * 255).astype(np.uint8))
-    native = native_jpeg.decode_batch_or_fallback([good, b"bad"], 32, 32)
-    monkeypatch.setattr(native_jpeg, "_LIB", None)
-    monkeypatch.setattr(native_jpeg, "_TRIED", True)
-    pil = native_jpeg.decode_batch_or_fallback([good, b"bad"], 32, 32)
-    assert native[0].shape == pil[0].shape == (2, 3, 32, 32)
-    assert native[1].tolist() == pil[1].tolist() == [True, False]
-
-
 def test_convert_stream_uses_native_and_drops_corrupt():
     """The shared convert_stream pipeline (imagenet.batches feeds through
     it) produces the same kept-set through the native pool as the PIL
