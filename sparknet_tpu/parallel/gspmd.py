@@ -131,9 +131,16 @@ class GspmdTrainer:
                 if s != P() and MODEL_AXIS in s}
 
     def snapshot(self, path: str) -> str:
-        """Write the native snapshot triple (iter + params + solver state);
-        sharded arrays gather to host on write (reference role:
-        Solver::Snapshot, solver.cpp:446-466)."""
+        """Write the snapshot triple (iter + params + solver state).
+        Extension-less paths write an orbax checkpoint directory — sharded
+        arrays save WITHOUT a host gather, the multihost-safe path
+        (utils/orbax_ckpt.py); `.npz` keeps the native single-file format
+        (reference role: Solver::Snapshot, solver.cpp:446-466)."""
+        from ..utils import orbax_ckpt
+
+        if orbax_ckpt.is_orbax_path(path):
+            return orbax_ckpt.save(path, self.iter, self.params,
+                                   self.state)
         from ..solver.solver import write_native_snapshot
 
         return write_native_snapshot(path, self.iter, self.params,
@@ -142,10 +149,23 @@ class GspmdTrainer:
     def restore(self, path: str) -> None:
         """Exact resume: params AND optimizer slots return to their mesh
         shardings, so the post-restore trajectory equals the uninterrupted
-        run (reference: Solver::Restore)."""
-        from ..solver.solver import parse_native_snapshot
+        run (reference: Solver::Restore).  Orbax directories restore each
+        array straight into its mesh sharding."""
+        from ..utils import orbax_ckpt
 
-        it, params, state = parse_native_snapshot(path)
+        if orbax_ckpt.is_orbax_path(path):
+            unknown = set(orbax_ckpt.param_keys(path)) - set(self.params)
+            if unknown:
+                raise ValueError(
+                    f"checkpoint has params this net lacks: "
+                    f"{sorted(unknown)}")
+            it, params, state = orbax_ckpt.restore(
+                path, sharding_for=lambda k: NamedSharding(
+                    self.mesh, self.param_specs[k]))
+        else:
+            from ..solver.solver import parse_native_snapshot
+
+            it, params, state = parse_native_snapshot(path)
         missing = set(self.params) - set(params)
         if missing:
             raise ValueError(f"snapshot lacks params: {sorted(missing)}")

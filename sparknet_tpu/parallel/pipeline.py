@@ -260,9 +260,15 @@ class PipelineTrainer:
         return self._key_stage[key]
 
     def snapshot(self, path: str) -> str:
-        """Write the native snapshot triple (iter + params + solver state);
-        per-stage device arrays gather to host on write (reference role:
+        """Write the snapshot triple (iter + params + solver state).
+        Extension-less paths use the orbax backend (utils/orbax_ckpt.py);
+        `.npz` keeps the native single-file format (reference role:
         Solver::Snapshot, solver.cpp:446-466)."""
+        from ..utils import orbax_ckpt
+
+        if orbax_ckpt.is_orbax_path(path):
+            return orbax_ckpt.save(path, self.iter, self.params,
+                                   self.state)
         from ..solver.solver import write_native_snapshot
 
         return write_native_snapshot(path, self.iter, self.params,
@@ -272,9 +278,25 @@ class PipelineTrainer:
         """Exact resume: params and optimizer slots return to their home
         stage's device, so the post-restore trajectory equals the
         uninterrupted run (reference: Solver::Restore)."""
-        from ..solver.solver import parse_native_snapshot
+        from ..utils import orbax_ckpt
 
-        it, params, state = parse_native_snapshot(path)
+        if orbax_ckpt.is_orbax_path(path):
+            from jax.sharding import SingleDeviceSharding
+
+            unknown = set(orbax_ckpt.param_keys(path)) - set(self.params)
+            if unknown:
+                raise ValueError(
+                    f"checkpoint has params this net lacks: "
+                    f"{sorted(unknown)}")
+            # restore each array directly onto its home-stage device (no
+            # default-device detour, no topology warning)
+            it, params, state = orbax_ckpt.restore(
+                path, sharding_for=lambda k: SingleDeviceSharding(
+                    self.devices[self._key_stage[k]]))
+        else:
+            from ..solver.solver import parse_native_snapshot
+
+            it, params, state = parse_native_snapshot(path)
         missing = set(self.params) - set(params)
         if missing:
             raise ValueError(f"snapshot lacks params: {sorted(missing)}")

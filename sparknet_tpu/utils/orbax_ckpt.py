@@ -1,0 +1,78 @@
+"""Orbax checkpoint backend: sharded, multihost-safe snapshots.
+
+The native `.npz` triple (solver/solver.py write_native_snapshot) gathers
+every array to one host — fine single-host, wrong for pods where each
+process owns only its shards.  Orbax writes each process's shards in
+parallel and restores with shardings applied, which is the TPU-idiomatic
+checkpoint path (role of Solver::Snapshot/Restore, reference:
+caffe/src/caffe/solver.cpp:446-466, at pod scale).
+
+The payload mirrors the native triple exactly: {"iter", "params",
+"state"}, with optimizer slot tuples stored as lists (orbax pytrees).
+`GspmdTrainer.snapshot/restore` and `PipelineTrainer.snapshot/restore`
+dispatch here when the path has no file extension (a checkpoint
+directory); extensioned paths keep the npz/caffe formats.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import numpy as np
+
+
+def _checkpointer():
+    import orbax.checkpoint as ocp
+
+    return ocp.PyTreeCheckpointer()
+
+
+def is_orbax_path(path: str) -> bool:
+    """Directory-style paths (no extension) select the orbax backend."""
+    return not os.path.splitext(path)[1]
+
+
+def param_keys(path: str):
+    """Param keys recorded in a checkpoint (for pre-restore validation)."""
+    tree = _checkpointer().metadata(
+        os.path.abspath(path)).item_metadata.tree
+    return list(tree["params"])
+
+
+def save(path: str, it: int, params: Dict[str, jax.Array],
+         state: Dict[str, Tuple[jax.Array, ...]]) -> str:
+    payload = {"iter": np.int64(it), "params": dict(params),
+               "state": {k: list(v) for k, v in state.items()}}
+    _checkpointer().save(os.path.abspath(path), payload, force=True)
+    return path
+
+
+def restore(path: str, *,
+            sharding_for: Optional[Callable[[str], Any]] = None,
+            ) -> Tuple[int, Dict[str, Any], Dict[str, Tuple[Any, ...]]]:
+    """Returns (iter, params, state).  `sharding_for(key)` supplies the
+    target sharding per param key so arrays restore directly into their
+    mesh placement (no host-gathered intermediate)."""
+    import orbax.checkpoint as ocp
+
+    path = os.path.abspath(path)
+    ckpt = _checkpointer()
+    if sharding_for is None:
+        payload = ckpt.restore(path)
+    else:
+        tree = ckpt.metadata(path).item_metadata.tree
+        restore_args = {
+            "iter": ocp.RestoreArgs(),
+            "params": {k: ocp.ArrayRestoreArgs(sharding=sharding_for(k))
+                       for k in tree["params"]},
+            "state": {k: [ocp.ArrayRestoreArgs(sharding=sharding_for(k))
+                          for _ in v]
+                      for k, v in tree["state"].items()},
+        }
+        payload = ckpt.restore(path, restore_args=restore_args)
+    it = int(np.asarray(payload["iter"]))
+    params = dict(payload["params"])
+    state = {k: tuple(v) for k, v in payload["state"].items()}
+    return it, params, state

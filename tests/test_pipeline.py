@@ -243,15 +243,17 @@ def test_pipeline_clip_gradients_matches_single_device():
                                    rtol=2e-4, atol=1e-6, err_msg=k)
 
 
-def test_pipeline_snapshot_resume_exact(tmp_path):
+@pytest.mark.parametrize("fname", ["s.npz", "ckpt"])
+def test_pipeline_snapshot_resume_exact(tmp_path, fname):
     """Kill-and-resume == uninterrupted run for the GPipe trainer; params
-    and momentum return to their home-stage devices."""
+    and momentum return to their home-stage devices.  "ckpt" (no
+    extension) exercises the orbax directory backend."""
     stream = _stream(12)
     pt = PipelineTrainer(_sp(), n_stages=3, n_micro=2)
     it1 = iter(stream)
     pt.set_train_data(lambda: next(it1))
     pt.step(3)
-    snap = pt.snapshot(str(tmp_path / "s.npz"))
+    snap = pt.snapshot(str(tmp_path / fname))
     pt.step(3)
     expect = {k: np.asarray(v) for k, v in pt.params.items()}
 
