@@ -117,9 +117,14 @@ class Net:
     def __init__(self, net_param: NetParameter, phase: str = "TRAIN", *,
                  data_shapes: Optional[Dict[str, Sequence[int]]] = None,
                  level: int = 0, stages: Sequence[str] = (),
-                 batch_override: Optional[int] = None) -> None:
+                 batch_override: Optional[int] = None,
+                 remat: bool = False) -> None:
         self.net_param = net_param
         self.phase = phase
+        # jax.checkpoint each parameterized layer (see apply); flip with
+        # Net(..., remat=True) or solver prototxt `remat: true` when a
+        # model's activations outgrow HBM
+        self.remat = bool(remat)
         state = NetState(Message())
         state.msg.set("phase", phase)
         state.msg.set("level", level)
@@ -298,7 +303,16 @@ class Net:
                          if (bl.needs_rng and rng is not None) else None)
             pvals = [params[k] for k in bl.param_keys]
             bvals = [blobs[b] for b in bl.bottoms]
-            tops, updates = bl.fn(pvals, bvals, layer_rng, train)
+            fn = bl.fn
+            if self.remat and bl.param_keys:
+                # layer-wise rematerialization: drop this layer's forward
+                # intermediates and recompute them during backward —
+                # HBM-for-FLOPs, the jax.checkpoint recipe.  Parameterless
+                # layers (relu/pool/reshape) stay un-wrapped: their inputs
+                # are other layers' saved outputs anyway.  static_argnums
+                # covers train; rng is a traced array and passes through.
+                fn = jax.checkpoint(bl.fn, static_argnums=(3,))
+            tops, updates = fn(pvals, bvals, layer_rng, train)
             for t, v in zip(bl.tops, tops):
                 blobs[t] = v
             stat_updates.update(updates)

@@ -138,13 +138,8 @@ class GspmdTrainer:
         (reference role: Solver::Snapshot, solver.cpp:446-466)."""
         from ..utils import orbax_ckpt
 
-        if orbax_ckpt.is_orbax_path(path):
-            return orbax_ckpt.save(path, self.iter, self.params,
-                                   self.state)
-        from ..solver.solver import write_native_snapshot
-
-        return write_native_snapshot(path, self.iter, self.params,
-                                     self.state)
+        return orbax_ckpt.save_auto(path, self.iter, self.params,
+                                    self.state)
 
     def restore(self, path: str) -> None:
         """Exact resume: params AND optimizer slots return to their mesh
@@ -153,19 +148,10 @@ class GspmdTrainer:
         array straight into its mesh sharding."""
         from ..utils import orbax_ckpt
 
-        if orbax_ckpt.is_orbax_path(path):
-            unknown = set(orbax_ckpt.param_keys(path)) - set(self.params)
-            if unknown:
-                raise ValueError(
-                    f"checkpoint has params this net lacks: "
-                    f"{sorted(unknown)}")
-            it, params, state = orbax_ckpt.restore(
-                path, sharding_for=lambda k: NamedSharding(
-                    self.mesh, self.param_specs[k]))
-        else:
-            from ..solver.solver import parse_native_snapshot
-
-            it, params, state = parse_native_snapshot(path)
+        it, params, state = orbax_ckpt.restore_auto(
+            path, known_params=self.params,
+            sharding_for=lambda k: NamedSharding(self.mesh,
+                                                 self.param_specs[k]))
         missing = set(self.params) - set(params)
         if missing:
             raise ValueError(f"snapshot lacks params: {sorted(missing)}")

@@ -266,37 +266,23 @@ class PipelineTrainer:
         Solver::Snapshot, solver.cpp:446-466)."""
         from ..utils import orbax_ckpt
 
-        if orbax_ckpt.is_orbax_path(path):
-            return orbax_ckpt.save(path, self.iter, self.params,
-                                   self.state)
-        from ..solver.solver import write_native_snapshot
-
-        return write_native_snapshot(path, self.iter, self.params,
-                                     self.state)
+        return orbax_ckpt.save_auto(path, self.iter, self.params,
+                                    self.state)
 
     def restore(self, path: str) -> None:
         """Exact resume: params and optimizer slots return to their home
         stage's device, so the post-restore trajectory equals the
         uninterrupted run (reference: Solver::Restore)."""
+        from jax.sharding import SingleDeviceSharding
+
         from ..utils import orbax_ckpt
 
-        if orbax_ckpt.is_orbax_path(path):
-            from jax.sharding import SingleDeviceSharding
-
-            unknown = set(orbax_ckpt.param_keys(path)) - set(self.params)
-            if unknown:
-                raise ValueError(
-                    f"checkpoint has params this net lacks: "
-                    f"{sorted(unknown)}")
-            # restore each array directly onto its home-stage device (no
-            # default-device detour, no topology warning)
-            it, params, state = orbax_ckpt.restore(
-                path, sharding_for=lambda k: SingleDeviceSharding(
-                    self.devices[self._key_stage[k]]))
-        else:
-            from ..solver.solver import parse_native_snapshot
-
-            it, params, state = parse_native_snapshot(path)
+        # orbax arrays restore directly onto their home-stage device (no
+        # default-device detour, no topology warning)
+        it, params, state = orbax_ckpt.restore_auto(
+            path, known_params=self.params,
+            sharding_for=lambda k: SingleDeviceSharding(
+                self.devices[self._key_stage[k]]))
         missing = set(self.params) - set(params)
         if missing:
             raise ValueError(f"snapshot lacks params: {sorted(missing)}")

@@ -603,6 +603,20 @@ class SolverParameter(View):
         return [int(v) for v in self.msg.getlist("test_iter")]
 
     @property
+    def train_state(self) -> Optional["NetState"]:
+        """NetState merged into the TRAIN net's filter state
+        (caffe.proto:135; phase is forced to TRAIN by the solver)."""
+        m = self.msg.get("train_state")
+        return None if m is None else NetState(m)
+
+    @property
+    def test_states(self) -> List["NetState"]:
+        """One NetState per test net (caffe.proto:136); this framework
+        evaluates test net 0, matching the bridge
+        (ccaffe.cpp:235-243 solver_test -> TestAndStoreResult(0, ...))."""
+        return [NetState(m) for m in self.msg.getlist("test_state")]
+
+    @property
     def stepvalues(self) -> List[int]:
         return [int(v) for v in self.msg.getlist("stepvalue")]
 

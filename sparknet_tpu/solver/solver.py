@@ -165,10 +165,24 @@ class Solver:
         if net_param is None:
             raise ValueError("solver has no net")
         self.net_param = net_param
+        # framework-extension solver field `remat: true`: jax.checkpoint
+        # every parameterized layer in the TRAIN net (HBM-for-FLOPs; the
+        # TEST net has no backward, so nothing to rematerialize)
+        remat = bool(solver_param.msg.get("remat", False))
+        # SolverParameter train_state / test_state (caffe.proto:135-136)
+        # feed the nets' NetStateRule filtering; one test net is built —
+        # net 0, the one the bridge evaluates (ccaffe.cpp:235-243).
+        ts = solver_param.train_state
+        tss = solver_param.test_states
+        t0 = tss[0] if tss else None
         self.net = Net(net_param, "TRAIN", data_shapes=data_shapes,
-                       batch_override=batch_override)
+                       batch_override=batch_override, remat=remat,
+                       level=int(ts.level) if ts else 0,
+                       stages=ts.stages if ts else ())
         self.test_net = Net(net_param, "TEST", data_shapes=data_shapes,
-                            batch_override=batch_override)
+                            batch_override=batch_override,
+                            level=int(t0.level) if t0 else 0,
+                            stages=t0.stages if t0 else ())
         self.solver_type = solver_param.resolved_type()
 
         seed = int(solver_param.random_seed)

@@ -34,11 +34,35 @@ def is_orbax_path(path: str) -> bool:
     return not os.path.splitext(path)[1]
 
 
-def param_keys(path: str):
-    """Param keys recorded in a checkpoint (for pre-restore validation)."""
-    tree = _checkpointer().metadata(
-        os.path.abspath(path)).item_metadata.tree
-    return list(tree["params"])
+def save_auto(path: str, it: int, params, state) -> str:
+    """Extension-less path -> orbax directory; anything else (or orbax not
+    installed — it is the optional `ckpt` extra) -> the native .npz
+    triple, so a mid-training SIGINT snapshot never dies on a missing
+    optional dependency."""
+    if is_orbax_path(path):
+        try:
+            return save(path, it, params, state)
+        except ImportError:
+            import warnings
+
+            warnings.warn("orbax-checkpoint not installed; writing the "
+                          "native .npz triple instead", stacklevel=2)
+    from ..solver.solver import write_native_snapshot
+
+    return write_native_snapshot(path, it, params, state)
+
+
+def restore_auto(path: str, *, known_params=None,
+                 sharding_for: Optional[Callable[[str], Any]] = None,
+                 ) -> Tuple[int, Dict[str, Any], Dict[str, Tuple[Any, ...]]]:
+    """Counterpart of save_auto: orbax directory when present, else the
+    legacy extension-less `.npz` the native writer produces."""
+    if is_orbax_path(path) and os.path.isdir(path):
+        return restore(path, known_params=known_params,
+                       sharding_for=sharding_for)
+    from ..solver.solver import parse_native_snapshot
+
+    return parse_native_snapshot(path)
 
 
 def save(path: str, it: int, params: Dict[str, jax.Array],
@@ -49,20 +73,27 @@ def save(path: str, it: int, params: Dict[str, jax.Array],
     return path
 
 
-def restore(path: str, *,
+def restore(path: str, *, known_params=None,
             sharding_for: Optional[Callable[[str], Any]] = None,
             ) -> Tuple[int, Dict[str, Any], Dict[str, Tuple[Any, ...]]]:
     """Returns (iter, params, state).  `sharding_for(key)` supplies the
     target sharding per param key so arrays restore directly into their
-    mesh placement (no host-gathered intermediate)."""
+    mesh placement (no host-gathered intermediate).  `known_params`
+    pre-validates the checkpoint's param keys against the caller's net
+    using the metadata already in hand (one metadata read)."""
     import orbax.checkpoint as ocp
 
     path = os.path.abspath(path)
     ckpt = _checkpointer()
+    tree = ckpt.metadata(path).item_metadata.tree
+    if known_params is not None:
+        unknown = set(tree["params"]) - set(known_params)
+        if unknown:
+            raise ValueError(f"checkpoint has params this net lacks: "
+                             f"{sorted(unknown)}")
     if sharding_for is None:
         payload = ckpt.restore(path)
     else:
-        tree = ckpt.metadata(path).item_metadata.tree
         restore_args = {
             "iter": ocp.RestoreArgs(),
             "params": {k: ocp.ArrayRestoreArgs(sharding=sharding_for(k))

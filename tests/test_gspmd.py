@@ -105,9 +105,12 @@ def test_gspmd_pure_dp_when_no_model_axis():
     assert np.isfinite(t.step(2))
 
 
-def test_gspmd_snapshot_resume_exact(tmp_path):
+@pytest.mark.parametrize("fname", ["s.npz", "ckpt"])
+def test_gspmd_snapshot_resume_exact(tmp_path, fname):
     """Kill-and-resume == uninterrupted run: params, optimizer slots, and
-    the RNG stream (iter-keyed) all restore, with TP shardings reapplied."""
+    the RNG stream (iter-keyed) all restore, with TP shardings reapplied.
+    "ckpt" (no extension) exercises the orbax directory backend with
+    sharded save/restore."""
     import numpy as np
 
     sp = _sp()
@@ -117,7 +120,7 @@ def test_gspmd_snapshot_resume_exact(tmp_path):
     it1 = iter(stream)
     t1.set_train_data(lambda: next(it1))
     t1.step(3)
-    snap = t1.snapshot(str(tmp_path / "s.npz"))
+    snap = t1.snapshot(str(tmp_path / fname))
     t1.step(3)
     expect = {k: np.asarray(v) for k, v in t1.params.items()}
 
@@ -126,36 +129,6 @@ def test_gspmd_snapshot_resume_exact(tmp_path):
     t2.restore(snap)
     assert t2.iter == 3
     # sharded params stay sharded after restore
-    for k in t2.tp_sharded_params():
-        assert not t2.params[k].sharding.is_fully_replicated, k
-    it2 = iter(stream[3:])
-    t2.set_train_data(lambda: next(it2))
-    t2.step(3)
-    for k, v in expect.items():
-        np.testing.assert_allclose(np.asarray(t2.params[k]), v,
-                                   rtol=1e-6, atol=1e-7, err_msg=k)
-
-
-def test_gspmd_orbax_snapshot_resume_exact(tmp_path):
-    """Extension-less path = orbax checkpoint directory: sharded save (no
-    host gather), restore straight into mesh shardings, exact resume."""
-    import numpy as np
-
-    sp = _sp()
-    stream = _stream(12)
-    t1 = GspmdTrainer(sp, mesh=make_mesh(4, model_parallel=2),
-                      min_tp_elems=1 << 10)
-    it1 = iter(stream)
-    t1.set_train_data(lambda: next(it1))
-    t1.step(3)
-    snap = t1.snapshot(str(tmp_path / "ckpt"))
-    t1.step(3)
-    expect = {k: np.asarray(v) for k, v in t1.params.items()}
-
-    t2 = GspmdTrainer(_sp(), mesh=make_mesh(4, model_parallel=2),
-                      min_tp_elems=1 << 10)
-    t2.restore(snap)
-    assert t2.iter == 3
     for k in t2.tp_sharded_params():
         assert not t2.params[k].sharding.is_fully_replicated, k
     it2 = iter(stream[3:])

@@ -261,3 +261,55 @@ def test_solver_from_bundled_prototxt():
     assert np.isfinite(loss)
     assert solver.solver_type == "SGD"
     assert float(learning_rate(solver.param, 0)) == pytest.approx(0.01)
+
+
+def test_remat_matches_plain_training():
+    """remat: true (layer-wise jax.checkpoint) must change memory, not
+    math: losses and params track the plain run exactly."""
+    import jax
+    import numpy as np
+
+    from sparknet_tpu.proto import caffe_pb
+    from sparknet_tpu.proto.textformat import parse
+    from sparknet_tpu.solver.solver import Solver
+
+    net_txt = """
+layer { name: "data" type: "MemoryData" top: "data" top: "label"
+  memory_data_param { batch_size: 8 channels: 3 height: 8 width: 8 } }
+layer { name: "conv1" type: "Convolution" bottom: "data" top: "conv1"
+  convolution_param { num_output: 8 kernel_size: 3 pad: 1
+    weight_filler { type: "gaussian" std: 0.1 } } }
+layer { name: "relu1" type: "ReLU" bottom: "conv1" top: "conv1" }
+layer { name: "ip1" type: "InnerProduct" bottom: "conv1" top: "ip1"
+  inner_product_param { num_output: 10
+    weight_filler { type: "gaussian" std: 0.05 } } }
+layer { name: "loss" type: "SoftmaxWithLoss" bottom: "ip1" bottom: "label"
+  top: "loss" }
+"""
+
+    def build(remat):
+        txt = ('base_lr: 0.05\nlr_policy: "fixed"\nmomentum: 0.9\n'
+               'random_seed: 11\n')
+        if remat:
+            txt += "remat: true\n"
+        sp = caffe_pb.SolverParameter(parse(txt))
+        sp.msg.set("net_param", caffe_pb.parse_net_text(net_txt).msg)
+        return Solver(sp)
+
+    rng = np.random.RandomState(0)
+    batches = [{"data": rng.rand(8, 3, 8, 8).astype(np.float32),
+                "label": rng.randint(0, 10, (8,)).astype(np.int32)}
+               for _ in range(4)]
+    results = []
+    for remat in (False, True):
+        s = build(remat)
+        it = iter(batches)
+        s.set_train_data(lambda: next(it))
+        losses = [s.step(1) for _ in range(4)]
+        results.append((losses, {k: np.asarray(v)
+                                 for k, v in s.params.items()}))
+    np.testing.assert_allclose(results[0][0], results[1][0], rtol=1e-6)
+    for k, v in results[0][1].items():
+        np.testing.assert_allclose(results[1][1][k], v, rtol=1e-6,
+                                   atol=1e-7, err_msg=k)
+    assert build(True).net.remat and not build(False).net.remat
