@@ -1,0 +1,36 @@
+"""Programmatic model zoo: the bundled reference families as DSL builders.
+
+The prototxt importer (proto/caffe_pb.py) is the faithful-training path —
+it reproduces the reference's fillers and per-blob lr_mult exactly.  This
+package is the *programmatic* API (the role of pycaffe's net_spec.py and
+the Scala DSL, reference: caffe/python/caffe/net_spec.py,
+src/main/scala/libs/Layers.scala): each builder emits a NetParameter whose
+layer graph and parameter shapes match the bundled prototxt family —
+asserted against the reference files in tests/test_models.py.
+"""
+
+from .alexnet import alexnet
+from .cifar import cifar10_quick
+from .googlenet import googlenet
+from .lenet import lenet
+
+_REGISTRY = {
+    "lenet": lenet,
+    "cifar10_quick": cifar10_quick,
+    "alexnet": alexnet,
+    "googlenet": googlenet,
+}
+
+
+def get_model(name: str, **kw):
+    """Build a registered model family by name."""
+    try:
+        builder = _REGISTRY[name]
+    except KeyError:
+        raise ValueError(f"unknown model {name!r}; have "
+                         f"{sorted(_REGISTRY)}") from None
+    return builder(**kw)
+
+
+def model_names():
+    return sorted(_REGISTRY)

@@ -1,0 +1,48 @@
+"""AlexNet (reference: caffe/models/bvlc_alexnet/train_val.prototxt)."""
+
+from __future__ import annotations
+
+from ..core.layers_dsl import (accuracy_layer, convolution_layer,
+                               dropout_layer, inner_product_layer,
+                               lrn_layer, memory_data_layer, net_param,
+                               pooling_layer, relu_layer,
+                               softmax_with_loss_layer)
+
+
+def alexnet(batch: int = 256, n_classes: int = 1000, crop: int = 227):
+    """The grouped-conv AlexNet: 5 convs (groups on 2/4/5), two LRNs,
+    three max pools, fc6/fc7 with dropout, fc8 classifier."""
+    return net_param(
+        "AlexNet",
+        memory_data_layer("data", ["data", "label"], batch=batch,
+                          channels=3, height=crop, width=crop),
+        convolution_layer("conv1", "data", num_output=96, kernel_size=11,
+                          stride=4),
+        relu_layer("relu1", "conv1"),
+        lrn_layer("norm1", "conv1", local_size=5, alpha=1e-4, beta=0.75),
+        pooling_layer("pool1", "norm1", pool="MAX", kernel_size=3, stride=2),
+        convolution_layer("conv2", "pool1", num_output=256, kernel_size=5,
+                          pad=2, group=2),
+        relu_layer("relu2", "conv2"),
+        lrn_layer("norm2", "conv2", local_size=5, alpha=1e-4, beta=0.75),
+        pooling_layer("pool2", "norm2", pool="MAX", kernel_size=3, stride=2),
+        convolution_layer("conv3", "pool2", num_output=384, kernel_size=3,
+                          pad=1),
+        relu_layer("relu3", "conv3"),
+        convolution_layer("conv4", "conv3", num_output=384, kernel_size=3,
+                          pad=1, group=2),
+        relu_layer("relu4", "conv4"),
+        convolution_layer("conv5", "conv4", num_output=256, kernel_size=3,
+                          pad=1, group=2),
+        relu_layer("relu5", "conv5"),
+        pooling_layer("pool5", "conv5", pool="MAX", kernel_size=3, stride=2),
+        inner_product_layer("fc6", "pool5", num_output=4096),
+        relu_layer("relu6", "fc6"),
+        dropout_layer("drop6", "fc6", ratio=0.5),
+        inner_product_layer("fc7", "fc6", num_output=4096),
+        relu_layer("relu7", "fc7"),
+        dropout_layer("drop7", "fc7", ratio=0.5),
+        inner_product_layer("fc8", "fc7", num_output=n_classes),
+        softmax_with_loss_layer("loss", ["fc8", "label"]),
+        accuracy_layer("accuracy", ["fc8", "label"], phase="TEST"),
+    )

@@ -1,0 +1,35 @@
+"""CIFAR-10 quick (reference:
+caffe/examples/cifar10/cifar10_quick_train_test.prototxt)."""
+
+from __future__ import annotations
+
+from ..core.layers_dsl import (accuracy_layer, convolution_layer,
+                               inner_product_layer, memory_data_layer,
+                               net_param, pooling_layer, relu_layer,
+                               softmax_with_loss_layer)
+
+
+def cifar10_quick(batch: int = 100, n_classes: int = 10):
+    """conv32-pool-relu / conv32-relu-avepool / conv64-relu-avepool /
+    ip64-ip10 — note the reference's conv1 pools BEFORE relu."""
+    return net_param(
+        "CIFAR10_quick",
+        memory_data_layer("cifar", ["data", "label"], batch=batch,
+                          channels=3, height=32, width=32),
+        convolution_layer("conv1", "data", num_output=32, kernel_size=5,
+                          pad=2),
+        pooling_layer("pool1", "conv1", pool="MAX", kernel_size=3, stride=2),
+        relu_layer("relu1", "pool1"),
+        convolution_layer("conv2", "pool1", num_output=32, kernel_size=5,
+                          pad=2),
+        relu_layer("relu2", "conv2"),
+        pooling_layer("pool2", "conv2", pool="AVE", kernel_size=3, stride=2),
+        convolution_layer("conv3", "pool2", num_output=64, kernel_size=5,
+                          pad=2),
+        relu_layer("relu3", "conv3"),
+        pooling_layer("pool3", "conv3", pool="AVE", kernel_size=3, stride=2),
+        inner_product_layer("ip1", "pool3", num_output=64),
+        inner_product_layer("ip2", "ip1", num_output=n_classes),
+        softmax_with_loss_layer("loss", ["ip2", "label"]),
+        accuracy_layer("accuracy", ["ip2", "label"], phase="TEST"),
+    )

@@ -1,0 +1,139 @@
+"""GoogLeNet (reference: caffe/models/bvlc_googlenet/train_val.prototxt).
+
+Built from an `inception()` helper — the programmatic form the prototxt
+spells out 9 times.  Aux heads (loss1/loss2, weight 0.3) are TRAIN-phase
+regularizers exactly as in the reference; `aux=False` drops them for a
+deploy-style trunk."""
+
+from __future__ import annotations
+
+from typing import List
+
+from ..core.layers_dsl import (accuracy_layer, concat_layer,
+                               convolution_layer, dropout_layer,
+                               inner_product_layer, lrn_layer,
+                               memory_data_layer, net_param, pooling_layer,
+                               relu_layer, softmax_with_loss_layer)
+from ..proto.textformat import Message
+
+# (1x1, 3x3_reduce, 3x3, 5x5_reduce, 5x5, pool_proj) per inception block
+INCEPTION_CFG = {
+    "3a": (64, 96, 128, 16, 32, 32),
+    "3b": (128, 128, 192, 32, 96, 64),
+    "4a": (192, 96, 208, 16, 48, 64),
+    "4b": (160, 112, 224, 24, 64, 64),
+    "4c": (128, 128, 256, 24, 64, 64),
+    "4d": (112, 144, 288, 32, 64, 64),
+    "4e": (256, 160, 320, 32, 128, 128),
+    "5a": (256, 160, 320, 32, 128, 128),
+    "5b": (384, 192, 384, 48, 128, 128),
+}
+
+
+def inception(block: str, bottom: str, cfg) -> List[Message]:
+    """One inception module: four parallel branches concatenated on
+    channels (reference: train_val.prototxt inception_* groups)."""
+    p = f"inception_{block}"
+    c1, c3r, c3, c5r, c5, cp = cfg
+    return [
+        convolution_layer(f"{p}/1x1", bottom, num_output=c1, kernel_size=1),
+        relu_layer(f"{p}/relu_1x1", f"{p}/1x1"),
+        convolution_layer(f"{p}/3x3_reduce", bottom, num_output=c3r,
+                          kernel_size=1),
+        relu_layer(f"{p}/relu_3x3_reduce", f"{p}/3x3_reduce"),
+        convolution_layer(f"{p}/3x3", f"{p}/3x3_reduce", num_output=c3,
+                          kernel_size=3, pad=1),
+        relu_layer(f"{p}/relu_3x3", f"{p}/3x3"),
+        convolution_layer(f"{p}/5x5_reduce", bottom, num_output=c5r,
+                          kernel_size=1),
+        relu_layer(f"{p}/relu_5x5_reduce", f"{p}/5x5_reduce"),
+        convolution_layer(f"{p}/5x5", f"{p}/5x5_reduce", num_output=c5,
+                          kernel_size=5, pad=2),
+        relu_layer(f"{p}/relu_5x5", f"{p}/5x5"),
+        pooling_layer(f"{p}/pool", bottom, pool="MAX", kernel_size=3,
+                      stride=1, pad=1),
+        convolution_layer(f"{p}/pool_proj", f"{p}/pool", num_output=cp,
+                          kernel_size=1),
+        relu_layer(f"{p}/relu_pool_proj", f"{p}/pool_proj"),
+        concat_layer(f"{p}/output",
+                     [f"{p}/1x1", f"{p}/3x3", f"{p}/5x5", f"{p}/pool_proj"]),
+    ]
+
+
+def _aux_head(idx: int, bottom: str, n_classes: int) -> List[Message]:
+    """Auxiliary classifier (reference: loss1/* at 4a, loss2/* at 4d;
+    ave-pool 5x5 s3 -> 1x1 conv 128 -> fc 1024 -> dropout 0.7 -> fc)."""
+    p = f"loss{idx}"
+    layers = [
+        pooling_layer(f"{p}/ave_pool", bottom, pool="AVE", kernel_size=5,
+                      stride=3),
+        convolution_layer(f"{p}/conv", f"{p}/ave_pool", num_output=128,
+                          kernel_size=1),
+        relu_layer(f"{p}/relu_conv", f"{p}/conv"),
+        inner_product_layer(f"{p}/fc", f"{p}/conv", num_output=1024),
+        relu_layer(f"{p}/relu_fc", f"{p}/fc"),
+        dropout_layer(f"{p}/drop_fc", f"{p}/fc", ratio=0.7),
+        inner_product_layer(f"{p}/classifier", f"{p}/fc",
+                            num_output=n_classes),
+        # the reference names BOTH aux tops ".../loss1" — loss1/loss1 and
+        # loss2/loss1 (train_val.prototxt quirk, kept for parity)
+        softmax_with_loss_layer(f"{p}/loss", [f"{p}/classifier", "label"],
+                                top=f"{p}/loss1"),
+    ]
+    # aux losses carry weight 0.3 (train_val.prototxt loss_weight: 0.3)
+    layers[-1].add("loss_weight", 0.3)
+    return layers
+
+
+def googlenet(batch: int = 32, n_classes: int = 1000, crop: int = 224,
+              aux: bool = True):
+    layers: List[Message] = [
+        memory_data_layer("data", ["data", "label"], batch=batch,
+                          channels=3, height=crop, width=crop),
+        convolution_layer("conv1/7x7_s2", "data", num_output=64,
+                          kernel_size=7, stride=2, pad=3),
+        relu_layer("conv1/relu_7x7", "conv1/7x7_s2"),
+        pooling_layer("pool1/3x3_s2", "conv1/7x7_s2", pool="MAX",
+                      kernel_size=3, stride=2),
+        lrn_layer("pool1/norm1", "pool1/3x3_s2", local_size=5, alpha=1e-4,
+                  beta=0.75),
+        convolution_layer("conv2/3x3_reduce", "pool1/norm1", num_output=64,
+                          kernel_size=1),
+        relu_layer("conv2/relu_3x3_reduce", "conv2/3x3_reduce"),
+        convolution_layer("conv2/3x3", "conv2/3x3_reduce", num_output=192,
+                          kernel_size=3, pad=1),
+        relu_layer("conv2/relu_3x3", "conv2/3x3"),
+        lrn_layer("conv2/norm2", "conv2/3x3", local_size=5, alpha=1e-4,
+                  beta=0.75),
+        pooling_layer("pool2/3x3_s2", "conv2/norm2", pool="MAX",
+                      kernel_size=3, stride=2),
+    ]
+    layers += inception("3a", "pool2/3x3_s2", INCEPTION_CFG["3a"])
+    layers += inception("3b", "inception_3a/output", INCEPTION_CFG["3b"])
+    layers.append(pooling_layer("pool3/3x3_s2", "inception_3b/output",
+                                pool="MAX", kernel_size=3, stride=2))
+    layers += inception("4a", "pool3/3x3_s2", INCEPTION_CFG["4a"])
+    if aux:
+        layers += _aux_head(1, "inception_4a/output", n_classes)
+    layers += inception("4b", "inception_4a/output", INCEPTION_CFG["4b"])
+    layers += inception("4c", "inception_4b/output", INCEPTION_CFG["4c"])
+    layers += inception("4d", "inception_4c/output", INCEPTION_CFG["4d"])
+    if aux:
+        layers += _aux_head(2, "inception_4d/output", n_classes)
+    layers += inception("4e", "inception_4d/output", INCEPTION_CFG["4e"])
+    layers.append(pooling_layer("pool4/3x3_s2", "inception_4e/output",
+                                pool="MAX", kernel_size=3, stride=2))
+    layers += inception("5a", "pool4/3x3_s2", INCEPTION_CFG["5a"])
+    layers += inception("5b", "inception_5a/output", INCEPTION_CFG["5b"])
+    layers += [
+        pooling_layer("pool5/7x7_s1", "inception_5b/output", pool="AVE",
+                      kernel_size=7, stride=1),
+        dropout_layer("pool5/drop_7x7_s1", "pool5/7x7_s1", ratio=0.4),
+        inner_product_layer("loss3/classifier", "pool5/7x7_s1",
+                            num_output=n_classes),
+        softmax_with_loss_layer("loss3/loss3",
+                                ["loss3/classifier", "label"]),
+        accuracy_layer("loss3/top-1", ["loss3/classifier", "label"],
+                       phase="TEST"),
+    ]
+    return net_param("GoogleNet", *layers)

@@ -29,7 +29,6 @@ import numpy as np
 from jax import shard_map
 from jax.sharding import NamedSharding, PartitionSpec as P
 
-from ..core.net import Net
 from ..proto.caffe_pb import NetParameter, SolverParameter
 from ..solver import updates
 from ..solver.solver import (DataSource, load_params_file,
@@ -92,10 +91,14 @@ class DistributedSolver:
             "dcn_interval needs a (dcn, workers) mesh"
         self.n_workers = self.mesh.shape[WORKER_AXIS] * (
             self.mesh.shape[DCN_AXIS] if self.has_dcn else 1)
-        self.net = Net(net_param, "TRAIN", data_shapes=data_shapes,
-                       batch_override=batch_override)
-        self.test_net = Net(net_param, "TEST", data_shapes=data_shapes,
-                            batch_override=batch_override)
+        from ..solver.solver import build_test_net, build_train_net
+
+        self.net = build_train_net(solver_param, net_param,
+                                   data_shapes=data_shapes,
+                                   batch_override=batch_override)
+        self.test_net = build_test_net(solver_param, net_param,
+                                       data_shapes=data_shapes,
+                                       batch_override=batch_override)
         seed = int(solver_param.random_seed)
         params0 = self.net.init_params(seed if seed >= 0 else 0)
         state0 = updates.init_state(params0, solver_param.resolved_type())

@@ -77,16 +77,17 @@ class GspmdTrainer:
                  min_tp_elems: int = 1 << 16,
                  data_shapes: Optional[Dict[str, Any]] = None,
                  batch_override: Optional[int] = None) -> None:
-        from ..core.net import Net
-
         self.param = solver_param
         self.mesh = mesh
         if net_param is None:
             net_param = (solver_param.net_param
                          or solver_param.train_net_param)
         assert net_param is not None, "solver needs an inline net"
-        self.net = Net(net_param, "TRAIN", data_shapes=data_shapes,
-                       batch_override=batch_override)
+        from ..solver.solver import build_train_net
+
+        self.net = build_train_net(solver_param, net_param,
+                                   data_shapes=data_shapes,
+                                   batch_override=batch_override)
         self.precision = resolve_precision(solver_param, precision)
 
         pspecs = infer_tp_specs(self.net, mesh, min_tp_elems=min_tp_elems)

@@ -80,8 +80,6 @@ class PipelineTrainer:
                  data_shapes: Optional[Dict[str, Any]] = None,
                  batch_override: Optional[int] = None,
                  precision: Optional[str] = None) -> None:
-        from ..core.net import Net
-
         self.param = solver_param
         self.n_micro = int(n_micro)
         if int(solver_param.iter_size) > 1:
@@ -93,8 +91,11 @@ class PipelineTrainer:
             net_param = (solver_param.net_param
                          or solver_param.train_net_param)
         assert net_param is not None, "solver needs an inline net"
-        self.net = Net(net_param, "TRAIN", data_shapes=data_shapes,
-                       batch_override=batch_override)
+        from ..solver.solver import build_train_net
+
+        self.net = build_train_net(solver_param, net_param,
+                                   data_shapes=data_shapes,
+                                   batch_override=batch_override)
         self.precision = resolve_precision(solver_param, precision)
         self.devices = list(devices if devices is not None
                             else jax.devices()[:n_stages])

@@ -56,6 +56,33 @@ def resolve_precision(sp: SolverParameter,
     return precision
 
 
+def build_train_net(sp: SolverParameter, net_param, *,
+                    data_shapes=None, batch_override=None) -> Net:
+    """TRAIN-phase Net honoring the solver's net-filter and extension
+    fields: train_state stages/level (caffe.proto:135) and `remat: true`
+    (layer-wise jax.checkpoint).  Every trainer builds its train net here
+    so the solver fields mean the same thing everywhere."""
+    ts = sp.train_state
+    return Net(net_param, "TRAIN", data_shapes=data_shapes,
+               batch_override=batch_override,
+               remat=bool(sp.msg.get("remat", False)),
+               level=int(ts.level) if ts else 0,
+               stages=ts.stages if ts else ())
+
+
+def build_test_net(sp: SolverParameter, net_param, *,
+                   data_shapes=None, batch_override=None) -> Net:
+    """TEST-phase Net under the solver's first test_state
+    (caffe.proto:136) — net 0, the one the bridge evaluates
+    (ccaffe.cpp:235-243)."""
+    tss = sp.test_states
+    t0 = tss[0] if tss else None
+    return Net(net_param, "TEST", data_shapes=data_shapes,
+               batch_override=batch_override,
+               level=int(t0.level) if t0 else 0,
+               stages=t0.stages if t0 else ())
+
+
 def make_loss_fn(net: Net, precision: str):
     """Training loss closure; under "bfloat16" the fp32 master params and
     float inputs are cast to bf16 for forward/backward (the cast is
@@ -165,24 +192,12 @@ class Solver:
         if net_param is None:
             raise ValueError("solver has no net")
         self.net_param = net_param
-        # framework-extension solver field `remat: true`: jax.checkpoint
-        # every parameterized layer in the TRAIN net (HBM-for-FLOPs; the
-        # TEST net has no backward, so nothing to rematerialize)
-        remat = bool(solver_param.msg.get("remat", False))
-        # SolverParameter train_state / test_state (caffe.proto:135-136)
-        # feed the nets' NetStateRule filtering; one test net is built —
-        # net 0, the one the bridge evaluates (ccaffe.cpp:235-243).
-        ts = solver_param.train_state
-        tss = solver_param.test_states
-        t0 = tss[0] if tss else None
-        self.net = Net(net_param, "TRAIN", data_shapes=data_shapes,
-                       batch_override=batch_override, remat=remat,
-                       level=int(ts.level) if ts else 0,
-                       stages=ts.stages if ts else ())
-        self.test_net = Net(net_param, "TEST", data_shapes=data_shapes,
-                            batch_override=batch_override,
-                            level=int(t0.level) if t0 else 0,
-                            stages=t0.stages if t0 else ())
+        self.net = build_train_net(solver_param, net_param,
+                                   data_shapes=data_shapes,
+                                   batch_override=batch_override)
+        self.test_net = build_test_net(solver_param, net_param,
+                                       data_shapes=data_shapes,
+                                       batch_override=batch_override)
         self.solver_type = solver_param.resolved_type()
 
         seed = int(solver_param.random_seed)

@@ -36,11 +36,12 @@ def test_siamese_contrastive_training_learns():
                       'random_seed: 3\n')
     solver = Solver(sp, data_shapes={"pair_data": (n, 2, 28, 28),
                                      "sim": (n,)})
-    # weight sharing across towers must be real: conv1/conv1_p use the
-    # same underlying keys
+    # weight sharing across towers must be real: the _p tower layers
+    # resolve to the SAME ParamSpec-named keys, introducing none of their
+    # own ("conv1_p/0"-style keys would mean separate storage)
     keys = set(solver.net.param_keys)
-    assert any(k.startswith("conv1_w") or k == "conv1_w" for k in keys) or \
-        len(keys) < 2 * 5, "towers should share parameters"
+    assert not any("_p" in k for k in keys), sorted(keys)
+    assert "conv1_w" in keys and "conv1_b" in keys, sorted(keys)
 
     rng = np.random.RandomState(0)
     centers = rng.rand(2, 28, 28).astype(np.float32)
