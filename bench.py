@@ -209,12 +209,51 @@ def bench_model(name, model_dir, batch, crop, n_classes=1000):
     return out
 
 
+LAST_GOOD = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         "BENCH_LAST_GOOD.json")
+
+
+def _device_responsive(timeout_s: int = 240) -> bool:
+    """Probe the accelerator in a subprocess with a hard timeout: the
+    tunneled dev platform can wedge so that the first compile hangs
+    forever (not an exception), which would hang the whole bench."""
+    import subprocess
+
+    code = ("import jax, jax.numpy as jnp;"
+            "print(float(jax.jit(lambda a: (a @ a).sum())"
+            "(jnp.ones((256, 256)))))")
+    try:
+        r = subprocess.run([sys.executable, "-c", code],
+                           timeout=timeout_s, capture_output=True)
+    except subprocess.TimeoutExpired:
+        return False
+    if r.returncode != 0:
+        # fast deterministic failure is NOT the hang this guards against:
+        # surface it instead of masking it behind a stale record
+        sys.stderr.write(r.stderr.decode(errors="replace")[-2000:])
+        raise SystemExit("device probe failed (not a hang); see stderr")
+    return True
+
+
 def main() -> None:
     from sparknet_tpu.utils.compile_cache import (apply_platform_env,
                                                   maybe_enable_compile_cache)
 
     apply_platform_env()
     maybe_enable_compile_cache()
+
+    if not _device_responsive():
+        # emit the most recent good measurement, loudly flagged — an
+        # unreachable chip should degrade the record, not hang the driver
+        log("DEVICE UNRESPONSIVE: emitting last good result as stale")
+        try:
+            stale = json.load(open(LAST_GOOD))
+        except (OSError, ValueError):
+            raise SystemExit(
+                "device unresponsive and no readable last-good record")
+        stale["stale_due_to_unreachable_tpu"] = True
+        print(json.dumps(stale))
+        return
 
     alex = bench_model(
         "alexnet", "/root/reference/caffe/models/bvlc_alexnet", 256, 227)
@@ -226,7 +265,7 @@ def main() -> None:
         "googlenet", "/root/reference/caffe/models/bvlc_googlenet", 128,
         224)
 
-    print(json.dumps({
+    result = {
         "metric": "alexnet_train_imgs_per_sec",
         "value": alex["device_resident_imgs_per_sec"],
         "unit": "img/s",
@@ -244,7 +283,12 @@ def main() -> None:
         "googlenet_b128_imgs_per_sec":
             goog128["device_resident_imgs_per_sec"],
         "googlenet_b128_mfu": goog128["mfu"],
-    }))
+    }
+    tmp = LAST_GOOD + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(result, f)
+    os.replace(tmp, LAST_GOOD)
+    print(json.dumps(result))
 
 
 if __name__ == "__main__":

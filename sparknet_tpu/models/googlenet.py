@@ -75,13 +75,20 @@ def _aux_head(idx: int, bottom: str, n_classes: int) -> List[Message]:
         dropout_layer(f"{p}/drop_fc", f"{p}/fc", ratio=0.7),
         inner_product_layer(f"{p}/classifier", f"{p}/fc",
                             num_output=n_classes),
-        # the reference names BOTH aux tops ".../loss1" — loss1/loss1 and
-        # loss2/loss1 (train_val.prototxt quirk, kept for parity)
-        softmax_with_loss_layer(f"{p}/loss", [f"{p}/classifier", "label"],
-                                top=f"{p}/loss1"),
     ]
-    # aux losses carry weight 0.3 (train_val.prototxt loss_weight: 0.3)
-    layers[-1].add("loss_weight", 0.3)
+    # the reference names BOTH aux tops ".../loss1" — loss1/loss1 and
+    # loss2/loss1 (train_val.prototxt quirk, kept for parity); aux losses
+    # carry weight 0.3 (train_val.prototxt loss_weight: 0.3)
+    loss = softmax_with_loss_layer(f"{p}/loss", [f"{p}/classifier",
+                                                 "label"], top=f"{p}/loss1")
+    loss.add("loss_weight", 0.3)
+    layers += [
+        loss,
+        accuracy_layer(f"{p}/top-1", [f"{p}/classifier", "label"],
+                       phase="TEST"),
+        accuracy_layer(f"{p}/top-5", [f"{p}/classifier", "label"],
+                       top_k=5, phase="TEST"),
+    ]
     return layers
 
 
@@ -135,5 +142,7 @@ def googlenet(batch: int = 32, n_classes: int = 1000, crop: int = 224,
                                 ["loss3/classifier", "label"]),
         accuracy_layer("loss3/top-1", ["loss3/classifier", "label"],
                        phase="TEST"),
+        accuracy_layer("loss3/top-5", ["loss3/classifier", "label"],
+                       top_k=5, phase="TEST"),
     ]
     return net_param("GoogleNet", *layers)

@@ -31,11 +31,12 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..proto.caffe_pb import NetParameter, SolverParameter
 from ..solver import updates
-from ..solver.solver import (DataSource, load_params_file,
-                             make_single_step, parse_caffe_snapshot,
-                             parse_native_snapshot, parse_slot_arrays,
-                             resolve_precision, resolve_solverstate_path,
-                             save_params_file, write_native_snapshot)
+from ..solver.solver import (DataSource, build_test_net, build_train_net,
+                             load_params_file, make_single_step,
+                             parse_caffe_snapshot, parse_native_snapshot,
+                             parse_slot_arrays, resolve_precision,
+                             resolve_solverstate_path, save_params_file,
+                             write_native_snapshot)
 from .mesh import DCN_AXIS, WORKER_AXIS, make_mesh
 
 
@@ -91,8 +92,6 @@ class DistributedSolver:
             "dcn_interval needs a (dcn, workers) mesh"
         self.n_workers = self.mesh.shape[WORKER_AXIS] * (
             self.mesh.shape[DCN_AXIS] if self.has_dcn else 1)
-        from ..solver.solver import build_test_net, build_train_net
-
         self.net = build_train_net(solver_param, net_param,
                                    data_shapes=data_shapes,
                                    batch_override=batch_override)

@@ -32,7 +32,8 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..proto.caffe_pb import SolverParameter
 from ..solver import updates
-from ..solver.solver import make_single_step, resolve_precision
+from ..solver.solver import (build_train_net, make_single_step,
+                             resolve_precision)
 from .mesh import MODEL_AXIS, WORKER_AXIS
 
 
@@ -83,8 +84,6 @@ class GspmdTrainer:
             net_param = (solver_param.net_param
                          or solver_param.train_net_param)
         assert net_param is not None, "solver needs an inline net"
-        from ..solver.solver import build_train_net
-
         self.net = build_train_net(solver_param, net_param,
                                    data_shapes=data_shapes,
                                    batch_override=batch_override)

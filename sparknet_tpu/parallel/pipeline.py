@@ -43,7 +43,7 @@ import numpy as np
 
 from ..proto.caffe_pb import SolverParameter
 from ..solver import updates
-from ..solver.solver import resolve_precision
+from ..solver.solver import build_train_net, resolve_precision
 
 
 def split_stages(net, n_stages: int) -> List[List[int]]:
@@ -91,8 +91,6 @@ class PipelineTrainer:
             net_param = (solver_param.net_param
                          or solver_param.train_net_param)
         assert net_param is not None, "solver needs an inline net"
-        from ..solver.solver import build_train_net
-
         self.net = build_train_net(solver_param, net_param,
                                    data_shapes=data_shapes,
                                    batch_override=batch_override)

@@ -44,6 +44,13 @@ def test_model_matches_reference_shapes(name):
         f"{ {k: v for k, v in ps_ref.items() if ps_ours.get(k) != v} }")
     # loss structure (blob names + weights) must match too
     assert sorted(ours.loss_terms) == sorted(ref.loss_terms)
+    # TEST-phase evaluation heads (top-1/top-5, aux heads) must match
+    ours_t = Net(get_model(name, batch=4), "TEST")
+    ref_t = Net(caffe_pb.load_net_prototxt(path), "TEST",
+                batch_override=4, data_shapes=shapes)
+    acc = lambda n: sorted(bl.name for bl in n.layers
+                           if bl.type == "Accuracy")
+    assert acc(ours_t) == acc(ref_t), (acc(ours_t), acc(ref_t))
 
 
 def test_registry_and_training():
