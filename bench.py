@@ -284,11 +284,14 @@ def main() -> None:
             goog128["device_resident_imgs_per_sec"],
         "googlenet_b128_mfu": goog128["mfu"],
     }
-    tmp = LAST_GOOD + ".tmp"
-    with open(tmp, "w") as f:
-        json.dump(result, f)
-    os.replace(tmp, LAST_GOOD)
     print(json.dumps(result))
+    try:
+        tmp = LAST_GOOD + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(result, f)
+        os.replace(tmp, LAST_GOOD)
+    except OSError as e:
+        log(f"could not persist last-good record: {e}")
 
 
 if __name__ == "__main__":

@@ -124,9 +124,13 @@ def dropout_layer(name: str, bottom: str, *, ratio: float = 0.5,
 
 def lrn_layer(name: str, bottom: str, *, local_size: int = 5,
               alpha: float = 1.0, beta: float = 0.75,
+              norm_region: Optional[str] = None,
               top: Optional[str] = None) -> Message:
     return _layer(name, "LRN", bottom, top or name,
-                  lrn_param=_msg(local_size=local_size, alpha=alpha, beta=beta))
+                  lrn_param=_msg(local_size=local_size, alpha=alpha,
+                                 beta=beta,
+                                 norm_region=Enum(norm_region)
+                                 if norm_region else None))
 
 
 def attention_layer(name: str, bottom: str, *, num_heads: int = 1,

@@ -10,13 +10,14 @@ asserted against the reference files in tests/test_models.py.
 """
 
 from .alexnet import alexnet
-from .cifar import cifar10_quick
+from .cifar import cifar10_full, cifar10_quick
 from .googlenet import googlenet
 from .lenet import lenet
 
 _REGISTRY = {
     "lenet": lenet,
     "cifar10_quick": cifar10_quick,
+    "cifar10_full": cifar10_full,
     "alexnet": alexnet,
     "googlenet": googlenet,
 }

@@ -1,12 +1,12 @@
-"""CIFAR-10 quick (reference:
-caffe/examples/cifar10/cifar10_quick_train_test.prototxt)."""
+"""CIFAR-10 families (reference: caffe/examples/cifar10/
+cifar10_quick_train_test.prototxt, cifar10_full_train_test.prototxt)."""
 
 from __future__ import annotations
 
 from ..core.layers_dsl import (accuracy_layer, convolution_layer,
-                               inner_product_layer, memory_data_layer,
-                               net_param, pooling_layer, relu_layer,
-                               softmax_with_loss_layer)
+                               inner_product_layer, lrn_layer,
+                               memory_data_layer, net_param, pooling_layer,
+                               relu_layer, softmax_with_loss_layer)
 
 
 def cifar10_quick(batch: int = 100, n_classes: int = 10):
@@ -32,4 +32,33 @@ def cifar10_quick(batch: int = 100, n_classes: int = 10):
         inner_product_layer("ip2", "ip1", num_output=n_classes),
         softmax_with_loss_layer("loss", ["ip2", "label"]),
         accuracy_layer("accuracy", ["ip2", "label"], phase="TEST"),
+    )
+
+
+def cifar10_full(batch: int = 100, n_classes: int = 10):
+    """The 60k-iteration family: WITHIN_CHANNEL LRNs after pools 1-2,
+    pool-before-relu on conv1 (cifar10_full_train_test.prototxt)."""
+    return net_param(
+        "CIFAR10_full",
+        memory_data_layer("cifar", ["data", "label"], batch=batch,
+                          channels=3, height=32, width=32),
+        convolution_layer("conv1", "data", num_output=32, kernel_size=5,
+                          pad=2),
+        pooling_layer("pool1", "conv1", pool="MAX", kernel_size=3, stride=2),
+        relu_layer("relu1", "pool1"),
+        lrn_layer("norm1", "pool1", local_size=3, alpha=5e-5, beta=0.75,
+                  norm_region="WITHIN_CHANNEL"),
+        convolution_layer("conv2", "norm1", num_output=32, kernel_size=5,
+                          pad=2),
+        relu_layer("relu2", "conv2"),
+        pooling_layer("pool2", "conv2", pool="AVE", kernel_size=3, stride=2),
+        lrn_layer("norm2", "pool2", local_size=3, alpha=5e-5, beta=0.75,
+                  norm_region="WITHIN_CHANNEL"),
+        convolution_layer("conv3", "norm2", num_output=64, kernel_size=5,
+                          pad=2),
+        relu_layer("relu3", "conv3"),
+        pooling_layer("pool3", "conv3", pool="AVE", kernel_size=3, stride=2),
+        inner_product_layer("ip1", "pool3", num_output=n_classes),
+        softmax_with_loss_layer("loss", ["ip1", "label"]),
+        accuracy_layer("accuracy", ["ip1", "label"], phase="TEST"),
     )

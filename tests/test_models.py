@@ -18,6 +18,9 @@ REF = {
     "cifar10_quick": (
         "caffe/examples/cifar10/cifar10_quick_train_test.prototxt",
         {"data": (4, 3, 32, 32), "label": (4,)}),
+    "cifar10_full": (
+        "caffe/examples/cifar10/cifar10_full_train_test.prototxt",
+        {"data": (4, 3, 32, 32), "label": (4,)}),
     "alexnet": ("caffe/models/bvlc_alexnet/train_val.prototxt", None),
     "googlenet": ("caffe/models/bvlc_googlenet/train_val.prototxt", None),
 }
@@ -44,17 +47,20 @@ def test_model_matches_reference_shapes(name):
         f"{ {k: v for k, v in ps_ref.items() if ps_ours.get(k) != v} }")
     # loss structure (blob names + weights) must match too
     assert sorted(ours.loss_terms) == sorted(ref.loss_terms)
-    # TEST-phase evaluation heads (top-1/top-5, aux heads) must match
-    ours_t = Net(get_model(name, batch=4), "TEST")
-    ref_t = Net(caffe_pb.load_net_prototxt(path), "TEST",
-                batch_override=4, data_shapes=shapes)
-    acc = lambda n: sorted(bl.name for bl in n.layers
-                           if bl.type == "Accuracy")
-    assert acc(ours_t) == acc(ref_t), (acc(ours_t), acc(ref_t))
+    # TEST-phase evaluation heads must match: name, top_k AND wiring
+    def acc(np_):
+        return sorted(
+            (str(l.name), int(l.accuracy_param.top_k), tuple(l.bottoms))
+            for l in np_.layers if str(l.type) == "Accuracy")
+
+    ours_acc = acc(get_model(name, batch=4))
+    ref_acc = acc(caffe_pb.load_net_prototxt(path))
+    assert ours_acc == ref_acc, (ours_acc, ref_acc)
 
 
 def test_registry_and_training():
-    assert model_names() == sorted(["lenet", "cifar10_quick", "alexnet",
+    assert model_names() == sorted(["lenet", "cifar10_quick",
+                                    "cifar10_full", "alexnet",
                                     "googlenet"])
     with pytest.raises(ValueError, match="unknown model"):
         get_model("resnet50")
