@@ -107,18 +107,25 @@ def _image_data_feed(layer, phase: str, seed: Optional[int]):
     state = {"i": int(ip.rand_skip)}
 
     def feed() -> Dict[str, np.ndarray]:
-        from .scale_convert import decode_and_resize
+        # whole-batch decode through convert_stream: the native libjpeg
+        # pool when built (resize path), per-image PIL otherwise —
+        # convert_stream handles both and skips corrupt images
+        # (image_data_layer caveat)
+        from .scale_convert import convert_stream
 
         imgs, labels = [], []
         while len(imgs) < batch:
-            path, label = entries[state["i"] % len(entries)]
-            state["i"] += 1
-            with open(path, "rb") as f:
-                arr = decode_and_resize(f.read(), nh, nw)
-            if arr is None:
-                continue  # corrupt images skipped (image_data_layer caveat)
-            imgs.append(arr)
-            labels.append(label)
+            want = batch - len(imgs)
+            raws = []
+            for _ in range(want):
+                path, label = entries[state["i"] % len(entries)]
+                state["i"] += 1
+                with open(path, "rb") as f:
+                    raws.append((f.read(), label))
+            for arr, label in convert_stream(iter(raws), nh, nw,
+                                             chunk=want):
+                imgs.append(arr)
+                labels.append(label)
         out = {tops[0]: tf(np.stack(imgs))}
         if len(tops) > 1:
             out[tops[1]] = np.asarray(labels, dtype=np.int32)
