@@ -17,7 +17,8 @@ ceiling differs from real CIFAR-10 (documented in ACCURACY.md alongside the
 results); everything else — model, solver, schedule, batch protocol, test
 protocol — is the reference recipe verbatim.
 
-Run:  python scripts/accuracy_run.py [--iters 4000] [--lr1-iters 1000]
+Run:  python scripts/accuracy_run.py [--model quick|full]
+      [--iters N] [--lr1-iters N] [--lr2-iters N]  (defaults follow the model's reference budget)
 Emits one JSON line per test point and a final summary JSON line.
 """
 

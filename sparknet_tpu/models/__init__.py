@@ -9,7 +9,7 @@ layer graph and parameter shapes match the bundled prototxt family —
 asserted against the reference files in tests/test_models.py.
 """
 
-from .alexnet import alexnet
+from .alexnet import alexnet, caffenet
 from .cifar import cifar10_full, cifar10_quick
 from .googlenet import googlenet
 from .lenet import lenet
@@ -19,6 +19,7 @@ _REGISTRY = {
     "cifar10_quick": cifar10_quick,
     "cifar10_full": cifar10_full,
     "alexnet": alexnet,
+    "caffenet": caffenet,
     "googlenet": googlenet,
 }
 

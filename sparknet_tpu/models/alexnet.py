@@ -1,4 +1,9 @@
-"""AlexNet (reference: caffe/models/bvlc_alexnet/train_val.prototxt)."""
+"""AlexNet and CaffeNet (reference: caffe/models/bvlc_alexnet/
+train_val.prototxt, caffe/models/bvlc_reference_caffenet/train_val.prototxt).
+
+The two families share every parameter shape; they differ only in blocks
+1-2's order — AlexNet normalizes BEFORE pooling (conv-relu-norm-pool),
+CaffeNet after (conv-relu-pool-norm)."""
 
 from __future__ import annotations
 
@@ -9,24 +14,39 @@ from ..core.layers_dsl import (accuracy_layer, convolution_layer,
                                softmax_with_loss_layer)
 
 
-def alexnet(batch: int = 256, n_classes: int = 1000, crop: int = 227):
-    """The grouped-conv AlexNet: 5 convs (groups on 2/4/5), two LRNs,
-    three max pools, fc6/fc7 with dropout, fc8 classifier."""
+def _block12(i: int, bottom: str, conv_kw, norm_after_pool: bool):
+    """conv -> relu -> {norm,pool} in the family's order; returns
+    (layers, output blob name)."""
+    conv, pool, norm = f"conv{i}", f"pool{i}", f"norm{i}"
+    layers = [convolution_layer(conv, bottom, **conv_kw),
+              relu_layer(f"relu{i}", conv)]
+    if norm_after_pool:  # CaffeNet
+        layers += [pooling_layer(pool, conv, pool="MAX", kernel_size=3,
+                                 stride=2),
+                   lrn_layer(norm, pool, local_size=5, alpha=1e-4,
+                             beta=0.75)]
+    else:                # AlexNet
+        layers += [lrn_layer(norm, conv, local_size=5, alpha=1e-4,
+                             beta=0.75),
+                   pooling_layer(pool, norm, pool="MAX", kernel_size=3,
+                                 stride=2)]
+    return layers, norm if norm_after_pool else pool
+
+
+def _alexnet_family(name: str, batch: int, n_classes: int, crop: int,
+                    norm_after_pool: bool):
+    b1, out1 = _block12(1, "data",
+                        dict(num_output=96, kernel_size=11, stride=4),
+                        norm_after_pool)
+    b2, out2 = _block12(2, out1,
+                        dict(num_output=256, kernel_size=5, pad=2, group=2),
+                        norm_after_pool)
     return net_param(
-        "AlexNet",
+        name,
         memory_data_layer("data", ["data", "label"], batch=batch,
                           channels=3, height=crop, width=crop),
-        convolution_layer("conv1", "data", num_output=96, kernel_size=11,
-                          stride=4),
-        relu_layer("relu1", "conv1"),
-        lrn_layer("norm1", "conv1", local_size=5, alpha=1e-4, beta=0.75),
-        pooling_layer("pool1", "norm1", pool="MAX", kernel_size=3, stride=2),
-        convolution_layer("conv2", "pool1", num_output=256, kernel_size=5,
-                          pad=2, group=2),
-        relu_layer("relu2", "conv2"),
-        lrn_layer("norm2", "conv2", local_size=5, alpha=1e-4, beta=0.75),
-        pooling_layer("pool2", "norm2", pool="MAX", kernel_size=3, stride=2),
-        convolution_layer("conv3", "pool2", num_output=384, kernel_size=3,
+        *b1, *b2,
+        convolution_layer("conv3", out2, num_output=384, kernel_size=3,
                           pad=1),
         relu_layer("relu3", "conv3"),
         convolution_layer("conv4", "conv3", num_output=384, kernel_size=3,
@@ -46,3 +66,16 @@ def alexnet(batch: int = 256, n_classes: int = 1000, crop: int = 227):
         softmax_with_loss_layer("loss", ["fc8", "label"]),
         accuracy_layer("accuracy", ["fc8", "label"], phase="TEST"),
     )
+
+
+def alexnet(batch: int = 256, n_classes: int = 1000, crop: int = 227):
+    """The grouped-conv AlexNet: 5 convs (groups on 2/4/5), two LRNs
+    before their pools, fc6/fc7 with dropout, fc8 classifier."""
+    return _alexnet_family("AlexNet", batch, n_classes, crop,
+                           norm_after_pool=False)
+
+
+def caffenet(batch: int = 256, n_classes: int = 1000, crop: int = 227):
+    """CaffeNet: the pool-before-norm AlexNet variant."""
+    return _alexnet_family("CaffeNet", batch, n_classes, crop,
+                           norm_after_pool=True)

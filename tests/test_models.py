@@ -22,6 +22,8 @@ REF = {
         "caffe/examples/cifar10/cifar10_full_train_test.prototxt",
         {"data": (4, 3, 32, 32), "label": (4,)}),
     "alexnet": ("caffe/models/bvlc_alexnet/train_val.prototxt", None),
+    "caffenet": ("caffe/models/bvlc_reference_caffenet/train_val.prototxt",
+                 None),
     "googlenet": ("caffe/models/bvlc_googlenet/train_val.prototxt", None),
 }
 
@@ -60,7 +62,7 @@ def test_model_matches_reference_shapes(name):
 
 def test_registry_and_training():
     assert model_names() == sorted(["lenet", "cifar10_quick",
-                                    "cifar10_full", "alexnet",
+                                    "cifar10_full", "alexnet", "caffenet",
                                     "googlenet"])
     with pytest.raises(ValueError, match="unknown model"):
         get_model("resnet50")
