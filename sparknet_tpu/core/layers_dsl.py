@@ -168,9 +168,25 @@ def accuracy_layer(name: str, bottoms: Sequence[str], *, top_k: int = 1,
                   accuracy_param=_msg(top_k=top_k if top_k > 1 else None))
 
 
-def net_param(name: str, *layers: Message) -> NetParameter:
-    """(reference: Layers.scala:130-137 NetParam)"""
+def softmax_layer(name: str, bottom: str,
+                  top: Optional[str] = None) -> Message:
+    """Plain Softmax head (deploy nets' `prob`)."""
+    return _layer(name, "Softmax", bottom, top or name)
+
+
+def net_param(name: str, *layers: Message,
+              inputs: Optional[Dict[str, Sequence[int]]] = None,
+              ) -> NetParameter:
+    """(reference: Layers.scala:130-137 NetParam).  `inputs` declares
+    net-level deploy inputs (the legacy `input:`/`input_shape` fields,
+    net.cpp:70-103) instead of data layers."""
     m = _msg(name=name)
+    for iname, shape in (inputs or {}).items():
+        m.add("input", iname)
+        sh = Message()
+        for dim in shape:
+            sh.add("dim", int(dim))
+        m.add("input_shape", sh)
     for l in layers:
         m.add("layer", l)
     return NetParameter(m)

@@ -10,7 +10,7 @@ from __future__ import annotations
 from ..core.layers_dsl import (accuracy_layer, convolution_layer,
                                dropout_layer, inner_product_layer,
                                lrn_layer, memory_data_layer, net_param,
-                               pooling_layer, relu_layer,
+                               pooling_layer, relu_layer, softmax_layer,
                                softmax_with_loss_layer)
 
 
@@ -34,18 +34,29 @@ def _block12(i: int, bottom: str, conv_kw, norm_after_pool: bool):
 
 
 def _alexnet_family(name: str, batch: int, n_classes: int, crop: int,
-                    norm_after_pool: bool):
+                    norm_after_pool: bool, deploy: bool = False):
     b1, out1 = _block12(1, "data",
                         dict(num_output=96, kernel_size=11, stride=4),
                         norm_after_pool)
     b2, out2 = _block12(2, out1,
                         dict(num_output=256, kernel_size=5, pad=2, group=2),
                         norm_after_pool)
+    if deploy:
+        # deploy form (bvlc_*/deploy.prototxt): net-level input decl,
+        # Softmax `prob` head, no loss/accuracy (dropout layers stay —
+        # they are test-time no-ops, as in the reference deploy files)
+        head = [softmax_layer("prob", "fc8")]
+        feed = []
+        inputs = {"data": (batch, 3, crop, crop)}
+    else:
+        head = [softmax_with_loss_layer("loss", ["fc8", "label"]),
+                accuracy_layer("accuracy", ["fc8", "label"], phase="TEST")]
+        feed = [memory_data_layer("data", ["data", "label"], batch=batch,
+                                  channels=3, height=crop, width=crop)]
+        inputs = None
     return net_param(
         name,
-        memory_data_layer("data", ["data", "label"], batch=batch,
-                          channels=3, height=crop, width=crop),
-        *b1, *b2,
+        *feed, *b1, *b2,
         convolution_layer("conv3", out2, num_output=384, kernel_size=3,
                           pad=1),
         relu_layer("relu3", "conv3"),
@@ -63,19 +74,23 @@ def _alexnet_family(name: str, batch: int, n_classes: int, crop: int,
         relu_layer("relu7", "fc7"),
         dropout_layer("drop7", "fc7", ratio=0.5),
         inner_product_layer("fc8", "fc7", num_output=n_classes),
-        softmax_with_loss_layer("loss", ["fc8", "label"]),
-        accuracy_layer("accuracy", ["fc8", "label"], phase="TEST"),
+        *head,
+        inputs=inputs,
     )
 
 
-def alexnet(batch: int = 256, n_classes: int = 1000, crop: int = 227):
+def alexnet(batch: int = 256, n_classes: int = 1000, crop: int = 227,
+            deploy: bool = False):
     """The grouped-conv AlexNet: 5 convs (groups on 2/4/5), two LRNs
-    before their pools, fc6/fc7 with dropout, fc8 classifier."""
+    before their pools, fc6/fc7 with dropout, fc8 classifier.
+    deploy=True gives the bvlc_alexnet/deploy.prototxt form (input decl +
+    Softmax prob)."""
     return _alexnet_family("AlexNet", batch, n_classes, crop,
-                           norm_after_pool=False)
+                           norm_after_pool=False, deploy=deploy)
 
 
-def caffenet(batch: int = 256, n_classes: int = 1000, crop: int = 227):
+def caffenet(batch: int = 256, n_classes: int = 1000, crop: int = 227,
+             deploy: bool = False):
     """CaffeNet: the pool-before-norm AlexNet variant."""
     return _alexnet_family("CaffeNet", batch, n_classes, crop,
-                           norm_after_pool=True)
+                           norm_after_pool=True, deploy=deploy)

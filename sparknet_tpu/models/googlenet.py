@@ -13,7 +13,8 @@ from ..core.layers_dsl import (accuracy_layer, concat_layer,
                                convolution_layer, dropout_layer,
                                inner_product_layer, lrn_layer,
                                memory_data_layer, net_param, pooling_layer,
-                               relu_layer, softmax_with_loss_layer)
+                               relu_layer, softmax_layer,
+                               softmax_with_loss_layer)
 from ..proto.textformat import Message
 
 # (1x1, 3x3_reduce, 3x3, 5x5_reduce, 5x5, pool_proj) per inception block
@@ -93,10 +94,15 @@ def _aux_head(idx: int, bottom: str, n_classes: int) -> List[Message]:
 
 
 def googlenet(batch: int = 32, n_classes: int = 1000, crop: int = 224,
-              aux: bool = True):
-    layers: List[Message] = [
+              aux: bool = True, deploy: bool = False):
+    """deploy=True gives the bvlc_googlenet/deploy.prototxt form: input
+    declaration, no aux heads, Softmax `prob`."""
+    if deploy:
+        aux = False
+    layers: List[Message] = ([] if deploy else [
         memory_data_layer("data", ["data", "label"], batch=batch,
-                          channels=3, height=crop, width=crop),
+                          channels=3, height=crop, width=crop)])
+    layers += [
         convolution_layer("conv1/7x7_s2", "data", num_output=64,
                           kernel_size=7, stride=2, pad=3),
         relu_layer("conv1/relu_7x7", "conv1/7x7_s2"),
@@ -138,6 +144,12 @@ def googlenet(batch: int = 32, n_classes: int = 1000, crop: int = 224,
         dropout_layer("pool5/drop_7x7_s1", "pool5/7x7_s1", ratio=0.4),
         inner_product_layer("loss3/classifier", "pool5/7x7_s1",
                             num_output=n_classes),
+    ]
+    if deploy:
+        layers.append(softmax_layer("prob", "loss3/classifier"))
+        return net_param("GoogleNet", *layers,
+                         inputs={"data": (batch, 3, crop, crop)})
+    layers += [
         softmax_with_loss_layer("loss3/loss3",
                                 ["loss3/classifier", "label"]),
         accuracy_layer("loss3/top-1", ["loss3/classifier", "label"],
