@@ -1,0 +1,22 @@
+"""Shared assembly for the linear model families: one trunk, two
+endings — the train_val form (data layer + loss/accuracy) or the deploy
+form (net-level input declaration + Softmax `prob`), mirroring how each
+reference family ships both prototxts."""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+from ..core.layers_dsl import net_param, softmax_layer
+
+
+def finish(name: str, trunk, classifier_blob: str, *, deploy: bool,
+           input_shape: Sequence[int], feed, train_head,
+           deploy_name: Optional[str] = None):
+    """`feed` is the data layer, `train_head` the loss/accuracy layers;
+    both are used only when deploy=False."""
+    if deploy:
+        return net_param(deploy_name or name, *trunk,
+                         softmax_layer("prob", classifier_blob),
+                         inputs={"data": tuple(input_shape)})
+    return net_param(name, feed, *trunk, *train_head)

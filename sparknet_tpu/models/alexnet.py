@@ -9,9 +9,10 @@ from __future__ import annotations
 
 from ..core.layers_dsl import (accuracy_layer, convolution_layer,
                                dropout_layer, inner_product_layer,
-                               lrn_layer, memory_data_layer, net_param,
-                               pooling_layer, relu_layer, softmax_layer,
+                               lrn_layer, memory_data_layer,
+                               pooling_layer, relu_layer,
                                softmax_with_loss_layer)
+from ._common import finish
 
 
 def _block12(i: int, bottom: str, conv_kw, norm_after_pool: bool):
@@ -41,22 +42,8 @@ def _alexnet_family(name: str, batch: int, n_classes: int, crop: int,
     b2, out2 = _block12(2, out1,
                         dict(num_output=256, kernel_size=5, pad=2, group=2),
                         norm_after_pool)
-    if deploy:
-        # deploy form (bvlc_*/deploy.prototxt): net-level input decl,
-        # Softmax `prob` head, no loss/accuracy (dropout layers stay —
-        # they are test-time no-ops, as in the reference deploy files)
-        head = [softmax_layer("prob", "fc8")]
-        feed = []
-        inputs = {"data": (batch, 3, crop, crop)}
-    else:
-        head = [softmax_with_loss_layer("loss", ["fc8", "label"]),
-                accuracy_layer("accuracy", ["fc8", "label"], phase="TEST")]
-        feed = [memory_data_layer("data", ["data", "label"], batch=batch,
-                                  channels=3, height=crop, width=crop)]
-        inputs = None
-    return net_param(
-        name,
-        *feed, *b1, *b2,
+    trunk = [
+        *b1, *b2,
         convolution_layer("conv3", out2, num_output=384, kernel_size=3,
                           pad=1),
         relu_layer("relu3", "conv3"),
@@ -74,9 +61,17 @@ def _alexnet_family(name: str, batch: int, n_classes: int, crop: int,
         relu_layer("relu7", "fc7"),
         dropout_layer("drop7", "fc7", ratio=0.5),
         inner_product_layer("fc8", "fc7", num_output=n_classes),
-        *head,
-        inputs=inputs,
-    )
+    ]
+    # deploy keeps the dropout layers — test-time no-ops, as in the
+    # reference deploy files
+    return finish(
+        name, trunk, "fc8", deploy=deploy,
+        input_shape=(batch, 3, crop, crop),
+        feed=memory_data_layer("data", ["data", "label"], batch=batch,
+                               channels=3, height=crop, width=crop),
+        train_head=[softmax_with_loss_layer("loss", ["fc8", "label"]),
+                    accuracy_layer("accuracy", ["fc8", "label"],
+                                   phase="TEST")])
 
 
 def alexnet(batch: int = 256, n_classes: int = 1000, crop: int = 227,

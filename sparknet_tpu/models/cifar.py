@@ -1,21 +1,33 @@
 """CIFAR-10 families (reference: caffe/examples/cifar10/
-cifar10_quick_train_test.prototxt, cifar10_full_train_test.prototxt)."""
+cifar10_quick_train_test.prototxt, cifar10_full_train_test.prototxt;
+deploy forms cifar10_quick.prototxt, cifar10_full.prototxt)."""
 
 from __future__ import annotations
 
 from ..core.layers_dsl import (accuracy_layer, convolution_layer,
                                inner_product_layer, lrn_layer,
-                               memory_data_layer, net_param, pooling_layer,
+                               memory_data_layer, pooling_layer,
                                relu_layer, softmax_with_loss_layer)
+from ._common import finish
 
 
-def cifar10_quick(batch: int = 100, n_classes: int = 10):
+def _finish_cifar(name: str, trunk, cls_blob: str, batch: int,
+                  deploy: bool, deploy_name: str):
+    return finish(
+        name, trunk, cls_blob, deploy=deploy,
+        input_shape=(batch, 3, 32, 32), deploy_name=deploy_name,
+        feed=memory_data_layer("cifar", ["data", "label"], batch=batch,
+                               channels=3, height=32, width=32),
+        train_head=[softmax_with_loss_layer("loss", [cls_blob, "label"]),
+                    accuracy_layer("accuracy", [cls_blob, "label"],
+                                   phase="TEST")])
+
+
+def cifar10_quick(batch: int = 100, n_classes: int = 10,
+                  deploy: bool = False):
     """conv32-pool-relu / conv32-relu-avepool / conv64-relu-avepool /
     ip64-ip10 — note the reference's conv1 pools BEFORE relu."""
-    return net_param(
-        "CIFAR10_quick",
-        memory_data_layer("cifar", ["data", "label"], batch=batch,
-                          channels=3, height=32, width=32),
+    trunk = [
         convolution_layer("conv1", "data", num_output=32, kernel_size=5,
                           pad=2),
         pooling_layer("pool1", "conv1", pool="MAX", kernel_size=3, stride=2),
@@ -30,18 +42,16 @@ def cifar10_quick(batch: int = 100, n_classes: int = 10):
         pooling_layer("pool3", "conv3", pool="AVE", kernel_size=3, stride=2),
         inner_product_layer("ip1", "pool3", num_output=64),
         inner_product_layer("ip2", "ip1", num_output=n_classes),
-        softmax_with_loss_layer("loss", ["ip2", "label"]),
-        accuracy_layer("accuracy", ["ip2", "label"], phase="TEST"),
-    )
+    ]
+    return _finish_cifar("CIFAR10_quick", trunk, "ip2", batch, deploy,
+                         "CIFAR10_quick_test")
 
 
-def cifar10_full(batch: int = 100, n_classes: int = 10):
+def cifar10_full(batch: int = 100, n_classes: int = 10,
+                 deploy: bool = False):
     """The 60k-iteration family: WITHIN_CHANNEL LRNs after pools 1-2,
     pool-before-relu on conv1 (cifar10_full_train_test.prototxt)."""
-    return net_param(
-        "CIFAR10_full",
-        memory_data_layer("cifar", ["data", "label"], batch=batch,
-                          channels=3, height=32, width=32),
+    trunk = [
         convolution_layer("conv1", "data", num_output=32, kernel_size=5,
                           pad=2),
         pooling_layer("pool1", "conv1", pool="MAX", kernel_size=3, stride=2),
@@ -59,6 +69,6 @@ def cifar10_full(batch: int = 100, n_classes: int = 10):
         relu_layer("relu3", "conv3"),
         pooling_layer("pool3", "conv3", pool="AVE", kernel_size=3, stride=2),
         inner_product_layer("ip1", "pool3", num_output=n_classes),
-        softmax_with_loss_layer("loss", ["ip1", "label"]),
-        accuracy_layer("accuracy", ["ip1", "label"], phase="TEST"),
-    )
+    ]
+    return _finish_cifar("CIFAR10_full", trunk, "ip1", batch, deploy,
+                         "CIFAR10_full_deploy")

@@ -1,19 +1,20 @@
-"""LeNet (reference: caffe/examples/mnist/lenet_train_test.prototxt)."""
+"""LeNet (reference: caffe/examples/mnist/lenet_train_test.prototxt;
+deploy form lenet.prototxt)."""
 
 from __future__ import annotations
 
 from ..core.layers_dsl import (accuracy_layer, convolution_layer,
                                inner_product_layer, memory_data_layer,
-                               net_param, pooling_layer, relu_layer,
+                               pooling_layer, relu_layer,
                                softmax_with_loss_layer)
+from ._common import finish
 
 
-def lenet(batch: int = 64, n_classes: int = 10):
-    """The MNIST LeNet: conv20-pool-conv50-pool-ip500-relu-ip10."""
-    return net_param(
-        "LeNet",
-        memory_data_layer("mnist", ["data", "label"], batch=batch,
-                          channels=1, height=28, width=28),
+def lenet(batch: int = 64, n_classes: int = 10, deploy: bool = False):
+    """The MNIST LeNet: conv20-pool-conv50-pool-ip500-relu-ip10.
+    deploy=True gives the lenet.prototxt form (input decl + Softmax
+    prob)."""
+    trunk = [
         convolution_layer("conv1", "data", num_output=20, kernel_size=5),
         pooling_layer("pool1", "conv1", pool="MAX", kernel_size=2, stride=2),
         convolution_layer("conv2", "pool1", num_output=50, kernel_size=5),
@@ -21,6 +22,12 @@ def lenet(batch: int = 64, n_classes: int = 10):
         inner_product_layer("ip1", "pool2", num_output=500),
         relu_layer("relu1", "ip1"),
         inner_product_layer("ip2", "ip1", num_output=n_classes),
-        softmax_with_loss_layer("loss", ["ip2", "label"]),
-        accuracy_layer("accuracy", ["ip2", "label"], phase="TEST"),
-    )
+    ]
+    return finish(
+        "LeNet", trunk, "ip2", deploy=deploy,
+        input_shape=(batch, 1, 28, 28),
+        feed=memory_data_layer("mnist", ["data", "label"], batch=batch,
+                               channels=1, height=28, width=28),
+        train_head=[softmax_with_loss_layer("loss", ["ip2", "label"]),
+                    accuracy_layer("accuracy", ["ip2", "label"],
+                                   phase="TEST")])

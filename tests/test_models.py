@@ -92,6 +92,9 @@ def test_registry_and_training():
 
 
 DEPLOY_REF = {
+    "lenet": "caffe/examples/mnist/lenet.prototxt",
+    "cifar10_quick": "caffe/examples/cifar10/cifar10_quick.prototxt",
+    "cifar10_full": "caffe/examples/cifar10/cifar10_full.prototxt",
     "alexnet": "caffe/models/bvlc_alexnet/deploy.prototxt",
     "caffenet": "caffe/models/bvlc_reference_caffenet/deploy.prototxt",
     "googlenet": "caffe/models/bvlc_googlenet/deploy.prototxt",
@@ -115,8 +118,8 @@ def test_deploy_variant_matches_reference(name):
     assert ours.output_blobs == ["prob"] == ref.output_blobs
     params = ours.init_params(0)
     rng = np.random.RandomState(0)
-    crop = ours.blob_shapes["data"][-1]
-    probs = ours.forward(params, {"data": rng.rand(2, 3, crop, crop)
+    _, c, h, w = ours.blob_shapes["data"]
+    probs = ours.forward(params, {"data": rng.rand(2, c, h, w)
                                   .astype(np.float32)})["prob"]
     p = np.asarray(probs).reshape(2, -1)
     np.testing.assert_allclose(p.sum(axis=1), 1.0, rtol=1e-4)
