@@ -223,3 +223,44 @@ def test_expert_parallel_gradients_match_dense():
                             ["gate", "w1", "b1", "w2", "b2"]):
         np.testing.assert_allclose(np.asarray(ge), np.asarray(gd),
                                    rtol=5e-4, atol=1e-5, err_msg=name)
+
+
+def test_moe_layer_under_distributed_solver():
+    """The MoE graph layer composes with the τ-averaging DP trainer: each
+    worker runs the dense MoE (data parallel); averaging and aux-loss
+    semantics hold across the mesh."""
+    if len(jax.devices()) < 4:
+        pytest.skip("needs 4 devices")
+    from sparknet_tpu.parallel.dist import DistributedSolver
+    from sparknet_tpu.parallel.mesh import make_mesh
+    from sparknet_tpu.proto import caffe_pb
+    from sparknet_tpu.proto.textformat import parse
+
+    net_txt = """
+layer { name: "data" type: "MemoryData" top: "data" top: "label"
+  memory_data_param { batch_size: 8 channels: 8 height: 1 width: 1 } }
+layer { name: "flat" type: "Flatten" bottom: "data" top: "flat" }
+layer { name: "moe" type: "MoE" bottom: "flat" top: "moe"
+  moe_param { num_experts: 4 hidden_dim: 8 k: 2 aux_loss_weight: 0.01 } }
+layer { name: "ip" type: "InnerProduct" bottom: "moe" top: "ip"
+  inner_product_param { num_output: 3
+    weight_filler { type: "gaussian" std: 0.1 } } }
+layer { name: "loss" type: "SoftmaxWithLoss" bottom: "ip" bottom: "label"
+  top: "loss" }
+"""
+    sp = caffe_pb.SolverParameter(parse(
+        'base_lr: 0.05\nlr_policy: "fixed"\nmomentum: 0.9\nrandom_seed: 4'))
+    sp.msg.set("net_param", caffe_pb.parse_net_text(net_txt).msg)
+    solver = DistributedSolver(sp, n_workers=4, tau=2, mesh=make_mesh(4))
+    rng = np.random.RandomState(0)
+
+    def src():
+        x = rng.rand(8, 8, 1, 1).astype(np.float32)
+        y = (x.reshape(8, 8).argmax(axis=1) % 3).astype(np.int32)
+        return {"data": x, "label": y}
+
+    solver.set_train_data([src] * 4)
+    l0 = solver.run_round()
+    for _ in range(5):
+        l1 = solver.run_round()
+    assert np.isfinite(l0) and np.isfinite(l1) and l1 < l0, (l0, l1)
