@@ -251,7 +251,8 @@ layer { name: "loss" type: "SoftmaxWithLoss" bottom: "ip" bottom: "label"
     sp = caffe_pb.SolverParameter(parse(
         'base_lr: 0.05\nlr_policy: "fixed"\nmomentum: 0.9\nrandom_seed: 4'))
     sp.msg.set("net_param", caffe_pb.parse_net_text(net_txt).msg)
-    solver = DistributedSolver(sp, n_workers=4, tau=2, mesh=make_mesh(4))
+    solver = DistributedSolver(sp, tau=2, mesh=make_mesh(4))
+    assert ("moe__aux_loss", 0.01) in solver.net.loss_terms
     rng = np.random.RandomState(0)
 
     def src():
