@@ -123,3 +123,20 @@ def test_deploy_variant_matches_reference(name):
                                   .astype(np.float32)})["prob"]
     p = np.asarray(probs).reshape(2, -1)
     np.testing.assert_allclose(p.sum(axis=1), 1.0, rtol=1e-4)
+
+
+@pytest.mark.parametrize("name", ["lenet", "googlenet"])
+def test_model_prototxt_roundtrip(name):
+    """DSL-built nets serialize to valid prototxt and re-import
+    identically (the interchange contract: a models/ net can be saved,
+    shared, and loaded like any reference prototxt)."""
+    from sparknet_tpu.proto import textformat
+
+    npm = get_model(name, batch=2)
+    text = textformat.serialize(npm.msg)
+    back = caffe_pb.parse_net_text(text)
+    n1 = Net(npm, "TRAIN")
+    n2 = Net(back, "TRAIN")
+    assert _param_shapes(n1) == _param_shapes(n2)
+    assert n1.layer_names() == n2.layer_names()
+    assert sorted(n1.loss_terms) == sorted(n2.loss_terms)
