@@ -1,0 +1,242 @@
+"""The flash-attention quarantine: SPARKNET_FLASH_ATTENTION=1 can never
+hang the host process (VERDICT r2 item 3).
+
+The real Pallas kernel is known to hang at COMPILE on some platforms (it
+wedged this project's dev TPU tunnel — BENCH_NOTES.md incident), so the
+kernel may only be touched in-process after a subprocess compile-probe
+with a hard timeout has passed.  These tests fake the hanging compile with
+a sleeping child and assert the timeout kills it, the verdict caches, and
+the attention entry point falls back instead of hanging.
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from sparknet_tpu.ops.flash_probe import (PROBE_OK_MARKER,
+                                          clear_probe_cache,
+                                          probe_flash_kernel)
+
+HANG_CMD = [sys.executable, "-c", "import time; time.sleep(600)"]
+OK_CMD = [sys.executable, "-c", f"print('{PROBE_OK_MARKER}')"]
+FAIL_CMD = [sys.executable, "-c", "raise SystemExit('kernel import boom')"]
+
+
+def test_hanging_compile_is_killed_within_timeout(tmp_path):
+    """The core guarantee: a compile that would hang forever costs at most
+    the probe timeout, and the child is dead afterwards."""
+    cache = str(tmp_path / "verdict.json")
+    t0 = time.monotonic()
+    ok = probe_flash_kernel(timeout_s=1.0, cache_path=cache,
+                            probe_cmd=HANG_CMD)
+    elapsed = time.monotonic() - t0
+    assert ok is False
+    assert elapsed < 10, f"hang guard took {elapsed:.1f}s for a 1s timeout"
+    verdict = json.load(open(cache))
+    assert verdict["ok"] is False
+    assert "hang" in verdict["detail"]
+
+
+def test_negative_verdict_is_cached_not_retried(tmp_path):
+    """A timed-out probe must NOT be retried implicitly — re-probing is
+    exactly how a wedge-prone platform gets re-wedged.  The second call
+    must answer from cache without launching any child."""
+    cache = str(tmp_path / "verdict.json")
+    marker = tmp_path / "child_ran"
+    cmd = [sys.executable, "-c",
+           f"open({str(marker)!r}, 'w').write('x'); "
+           f"import time; time.sleep(600)"]
+    # generous timeout: the child must get past interpreter startup and
+    # write its marker before the kill lands (single-core test box)
+    assert probe_flash_kernel(timeout_s=5.0, cache_path=cache,
+                              probe_cmd=cmd) is False
+    assert marker.exists()
+    marker.unlink()
+    t0 = time.monotonic()
+    assert probe_flash_kernel(timeout_s=5.0, cache_path=cache,
+                              probe_cmd=cmd) is False
+    assert time.monotonic() - t0 < 0.5
+    assert not marker.exists(), "cached verdict must not relaunch the probe"
+
+
+def test_disk_cache_survives_process_memo(tmp_path):
+    """Fresh memo (clear_probe_cache drops it) + existing disk verdict:
+    the disk verdict answers, no child runs."""
+    cache = str(tmp_path / "verdict.json")
+    with open(cache, "w") as f:
+        json.dump({"ok": True, "detail": ""}, f)
+    # memo is keyed by path; a tmp_path-unique file can't be pre-memoized
+    assert probe_flash_kernel(timeout_s=1.0, cache_path=cache,
+                              probe_cmd=HANG_CMD) is True
+
+
+def test_ok_and_failing_probes(tmp_path):
+    assert probe_flash_kernel(timeout_s=30.0,
+                              cache_path=str(tmp_path / "ok.json"),
+                              probe_cmd=OK_CMD) is True
+    assert json.load(open(tmp_path / "ok.json"))["ok"] is True
+    assert probe_flash_kernel(timeout_s=30.0,
+                              cache_path=str(tmp_path / "fail.json"),
+                              probe_cmd=FAIL_CMD) is False
+    assert "exit" in json.load(open(tmp_path / "fail.json"))["detail"]
+
+
+def test_clear_probe_cache(tmp_path):
+    cache = str(tmp_path / "verdict.json")
+    assert probe_flash_kernel(timeout_s=30.0, cache_path=cache,
+                              probe_cmd=OK_CMD) is True
+    clear_probe_cache(cache)
+    assert not os.path.exists(cache)
+    # verdict can now flip: the memo was dropped along with the file
+    assert probe_flash_kernel(timeout_s=30.0, cache_path=cache,
+                              probe_cmd=FAIL_CMD) is False
+
+
+def test_flag_set_with_hanging_kernel_falls_back(tmp_path, monkeypatch):
+    """End to end: SPARKNET_FLASH_ATTENTION=1 + a kernel whose compile
+    hangs => flash_attention_tpu returns the correct result via the XLA
+    fallback, bounded by the probe timeout, with a warning."""
+    import importlib
+
+    import jax
+
+    att = importlib.import_module("sparknet_tpu.ops.attention")
+    from sparknet_tpu.ops import flash_probe
+
+    monkeypatch.setenv("SPARKNET_FLASH_ATTENTION", "1")
+    # pretend we're on a TPU so the platform gate passes and the probe runs
+    monkeypatch.setattr(
+        att.jax, "devices",
+        lambda *a: [type("D", (), {"platform": "tpu"})()])
+    monkeypatch.setattr(
+        flash_probe, "probe_flash_kernel",
+        lambda **kw: probe_flash_kernel(
+            timeout_s=1.0, cache_path=str(tmp_path / "v.json"),
+            probe_cmd=HANG_CMD))
+    rng = np.random.RandomState(0)
+    q = jax.numpy.asarray(rng.randn(1, 2, 64, 16).astype(np.float32))
+    t0 = time.monotonic()
+    with pytest.warns(UserWarning, match="probe failed or timed out"):
+        out = att.flash_attention_tpu(q, q, q, causal=True)
+    assert time.monotonic() - t0 < 30
+    ref = att.attention(q, q, q, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=1e-5)
+
+
+def test_flag_unset_never_probes(monkeypatch):
+    """Default path: no flag, no probe, no subprocess — straight to XLA."""
+    import importlib
+
+    import jax
+
+    att = importlib.import_module("sparknet_tpu.ops.attention")
+    from sparknet_tpu.ops import flash_probe
+
+    monkeypatch.delenv("SPARKNET_FLASH_ATTENTION", raising=False)
+
+    def boom(**kw):
+        raise AssertionError("probe must not run when the flag is unset")
+
+    monkeypatch.setattr(flash_probe, "probe_flash_kernel", boom)
+    rng = np.random.RandomState(1)
+    q = jax.numpy.asarray(rng.randn(1, 2, 32, 8).astype(np.float32))
+    out = att.flash_attention_tpu(q, q, q)
+    ref = att.attention(q, q, q)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+
+
+def test_probe_passed_then_kernel_failure_propagates(monkeypatch):
+    """ADVICE r2: once the probe has passed, a real kernel failure is a
+    bug and must surface, not silently degrade to the slower path."""
+    import importlib
+
+    import jax
+
+    att = importlib.import_module("sparknet_tpu.ops.attention")
+    from sparknet_tpu.ops import flash_probe
+
+    monkeypatch.setenv("SPARKNET_FLASH_ATTENTION", "1")
+    monkeypatch.setattr(
+        att.jax, "devices",
+        lambda *a: [type("D", (), {"platform": "tpu"})()])
+    monkeypatch.setattr(flash_probe, "probe_flash_kernel",
+                        lambda **kw: True)
+
+    class RuntimeFailureKernel:
+        @staticmethod
+        def flash_attention(*a, **kw):
+            raise RuntimeError("genuine kernel failure")
+
+    monkeypatch.setitem(
+        sys.modules, "jax.experimental.pallas.ops.tpu.flash_attention",
+        RuntimeFailureKernel)
+    rng = np.random.RandomState(2)
+    q = jax.numpy.asarray(rng.randn(1, 1, 16, 8).astype(np.float32))
+    with pytest.raises(RuntimeError, match="genuine kernel failure"):
+        att.flash_attention_tpu(q, q, q)
+
+
+def test_kernel_input_rejection_falls_back(monkeypatch):
+    """A kernel that REJECTS the inputs (shape/divisibility ValueError —
+    the probe's canonical shape cannot anticipate every model) falls back
+    to blockwise with a warning instead of aborting training."""
+    import importlib
+
+    import jax
+
+    att = importlib.import_module("sparknet_tpu.ops.attention")
+    from sparknet_tpu.ops import flash_probe
+
+    monkeypatch.setenv("SPARKNET_FLASH_ATTENTION", "1")
+    monkeypatch.setattr(
+        att.jax, "devices",
+        lambda *a: [type("D", (), {"platform": "tpu"})()])
+    monkeypatch.setattr(flash_probe, "probe_flash_kernel",
+                        lambda **kw: True)
+
+    class RejectingKernel:
+        @staticmethod
+        def flash_attention(*a, **kw):
+            raise ValueError("block size must divide sequence length")
+
+    monkeypatch.setitem(
+        sys.modules, "jax.experimental.pallas.ops.tpu.flash_attention",
+        RejectingKernel)
+    rng = np.random.RandomState(3)
+    q = jax.numpy.asarray(rng.randn(1, 2, 100, 8).astype(np.float32))
+    with pytest.warns(UserWarning, match="kernel rejected inputs"):
+        out = att.flash_attention_tpu(q, q, q, causal=True)
+    ref = att.attention(q, q, q, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+
+
+def test_acquisition_failure_not_cached(tmp_path):
+    """A child that cannot ACQUIRE the device (parent holds the exclusive
+    TPU lock) must not poison the disk cache with a permanent negative
+    verdict — only the in-process memo falls back."""
+    cache = str(tmp_path / "verdict.json")
+    cmd = [sys.executable, "-c",
+           "import sys; sys.stderr.write('The TPU is already in use by "
+           "another process'); raise SystemExit(1)"]
+    assert probe_flash_kernel(timeout_s=30.0, cache_path=cache,
+                              probe_cmd=cmd) is False
+    assert not os.path.exists(cache), \
+        "acquisition failure must not be cached on disk"
+
+
+def test_forced_probe_result(tmp_path, monkeypatch):
+    """SPARKNET_FLASH_PROBE_RESULT pins the verdict without any child —
+    the operator escape hatch for exclusive-lock platforms."""
+    monkeypatch.setenv("SPARKNET_FLASH_PROBE_RESULT", "ok")
+    cache = str(tmp_path / "v.json")
+    assert probe_flash_kernel(timeout_s=1.0, cache_path=cache,
+                              probe_cmd=HANG_CMD) is True
+    monkeypatch.setenv("SPARKNET_FLASH_PROBE_RESULT", "fail")
+    clear_probe_cache(cache)
+    assert probe_flash_kernel(timeout_s=1.0, cache_path=cache,
+                              probe_cmd=OK_CMD) is False
