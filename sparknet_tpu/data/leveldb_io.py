@@ -1,0 +1,618 @@
+"""Pure-Python LevelDB read (and bulk write): the reference DB tier's
+SECOND backend.
+
+The reference's DB abstraction is LMDB *and* LevelDB (reference:
+caffe/src/caffe/util/db.cpp:9-22 dispatch;
+caffe/src/caffe/util/db_leveldb.cpp:10-76), and its bundled cifar10_full
+example writes LEVELDB (`examples/cifar10/cifar10_full_train_test.prototxt:16`,
+convert_cifar_data.cpp).  `lmdb_io` conquered the LMDB page format; this
+module does the same for LevelDB's on-disk trio — CURRENT/MANIFEST, the
+32KB-block record log, and block-based SSTables — so a reference-made
+LevelDB ingests through the identical Datum path, no libleveldb needed.
+
+Format notes (leveldb 1.x, doc/log_format.md + doc/table_format.md +
+version_edit.cc / write_batch.cc):
+
+- log files (WAL `N.log` AND `MANIFEST-N` share one format): 32768-byte
+  blocks of records [crc32c u32 | length u16 | type u8 | payload], type
+  FULL=1/FIRST=2/MIDDLE=3/LAST=4 for fragment reassembly; <7 trailing
+  bytes of a block are zero padding.  The crc is leveldb-masked
+  (rotate+0xa282ead8) over type byte + payload.
+- WAL record payload = WriteBatch: seq u64 | count u32 | count x
+  {kTypeValue=1: varint-len key, varint-len value | kTypeDeletion=0:
+  varint-len key}.  A closed-but-uncompacted DB (exactly what the
+  reference's convert tools leave behind) keeps its newest records ONLY
+  here, so WAL replay is not optional.
+- MANIFEST record payload = VersionEdit: tagged fields (comparator=1,
+  log_number=2, next_file=3, last_seq=4, compact_pointer=5,
+  deleted_file=6, new_file=7 {level, file, size, smallest, largest},
+  prev_log=9); applying the edit sequence yields the live SSTable set.
+- SSTable (`N.ldb`/`N.sst`): blocks of delta-coded entries [shared
+  varint32 | non_shared varint32 | value_len varint32 | key_delta |
+  value] with a u32 restart array; each block is followed by 1 byte
+  compression type (0=raw, 1=snappy) + crc32c.  48-byte footer =
+  metaindex handle + index handle (varint64 pairs) + magic
+  0xdb4775248b80fb57.  Keys are internal: user_key + u64(seq<<8 | type).
+- snappy: varint32 uncompressed length, then literal/copy tagged
+  elements — decoded here in Python (the reference links real snappy;
+  datasets written with compression still ingest).
+
+Iteration merges every live SSTable with the WAL memtable by
+(user_key, newest-seq-wins), dropping tombstones — the view
+leveldb::DB::NewIterator gives db_leveldb.cpp's LevelDBCursor.
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+import re
+import struct
+from typing import Dict, Iterator, List, Optional, Tuple
+
+BLOCK_SIZE = 32768
+LOG_HEADER = 7  # crc u32 + length u16 + type u8
+FULL, FIRST, MIDDLE, LAST = 1, 2, 3, 4
+TYPE_DELETION, TYPE_VALUE = 0, 1
+TABLE_MAGIC = 0xDB4775248B80FB57
+FOOTER_SIZE = 48
+MASK_DELTA = 0xA282EAD8
+COMPARATOR = b"leveldb.BytewiseComparator"
+
+# VersionEdit tags (version_edit.cc)
+TAG_COMPARATOR = 1
+TAG_LOG_NUMBER = 2
+TAG_NEXT_FILE = 3
+TAG_LAST_SEQ = 4
+TAG_COMPACT_POINTER = 5
+TAG_DELETED_FILE = 6
+TAG_NEW_FILE = 7
+TAG_PREV_LOG = 9
+
+
+# ------------------------------------------------------------------ crc32c
+
+def _make_table() -> List[int]:
+    table = []
+    for i in range(256):
+        c = i
+        for _ in range(8):
+            c = (c >> 1) ^ 0x82F63B78 if c & 1 else c >> 1
+        table.append(c)
+    return table
+
+
+_CRC_TABLE = _make_table()
+
+
+def crc32c(data: bytes, crc: int = 0) -> int:
+    c = crc ^ 0xFFFFFFFF
+    tbl = _CRC_TABLE
+    for b in data:
+        c = tbl[(c ^ b) & 0xFF] ^ (c >> 8)
+    return c ^ 0xFFFFFFFF
+
+
+def crc_mask(crc: int) -> int:
+    """leveldb stores masked crcs so crc-of-crc patterns can't collide."""
+    return (((crc >> 15) | (crc << 17)) + MASK_DELTA) & 0xFFFFFFFF
+
+
+def crc_unmask(masked: int) -> int:
+    rot = (masked - MASK_DELTA) & 0xFFFFFFFF
+    return ((rot >> 17) | (rot << 15)) & 0xFFFFFFFF
+
+
+# ------------------------------------------------------------------ varint
+
+def _read_varint(buf, pos: int) -> Tuple[int, int]:
+    result, shift = 0, 0
+    while True:
+        b = buf[pos]
+        pos += 1
+        result |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return result, pos
+        shift += 7
+
+
+def _write_varint(out: bytearray, v: int) -> None:
+    while v >= 0x80:
+        out.append((v & 0x7F) | 0x80)
+        v >>= 7
+    out.append(v)
+
+
+def _read_length_prefixed(buf, pos: int) -> Tuple[bytes, int]:
+    n, pos = _read_varint(buf, pos)
+    return bytes(buf[pos:pos + n]), pos + n
+
+
+# ------------------------------------------------------------------ snappy
+
+def snappy_uncompress(data: bytes) -> bytes:
+    """Decode one snappy-compressed buffer (format_description.txt):
+    varint32 output length, then literal (tag&3==0) and copy
+    (1/2/4-byte-offset) elements; copies may overlap and run byte-wise."""
+    n, pos = _read_varint(data, 0)
+    out = bytearray()
+    while pos < len(data):
+        tag = data[pos]
+        pos += 1
+        kind = tag & 3
+        if kind == 0:  # literal
+            length = (tag >> 2) + 1
+            if length > 60:
+                extra = length - 60
+                length = int.from_bytes(data[pos:pos + extra], "little") + 1
+                pos += extra
+            out += data[pos:pos + length]
+            pos += length
+            continue
+        if kind == 1:  # copy, 3-bit length, 11-bit offset
+            length = ((tag >> 2) & 0x7) + 4
+            offset = ((tag >> 5) << 8) | data[pos]
+            pos += 1
+        elif kind == 2:  # copy, 2-byte offset
+            length = (tag >> 2) + 1
+            offset = int.from_bytes(data[pos:pos + 2], "little")
+            pos += 2
+        else:  # copy, 4-byte offset
+            length = (tag >> 2) + 1
+            offset = int.from_bytes(data[pos:pos + 4], "little")
+            pos += 4
+        if offset == 0 or offset > len(out):
+            raise ValueError("snappy copy offset out of range")
+        start = len(out) - offset
+        for i in range(length):  # overlap-safe byte-wise copy
+            out.append(out[start + i])
+    if len(out) != n:
+        raise ValueError(f"snappy length mismatch: {len(out)} != {n}")
+    return bytes(out)
+
+
+def snappy_compress_literal(data: bytes) -> bytes:
+    """Minimal VALID snappy stream: the whole payload as literals (no
+    back-references).  Used by tests to exercise the decompressor; the
+    writer emits raw blocks."""
+    out = bytearray()
+    _write_varint(out, len(data))
+    pos = 0
+    while pos < len(data):
+        chunk = data[pos:pos + 65536]
+        n = len(chunk)
+        if n <= 60:
+            out.append((n - 1) << 2)
+        else:
+            # tag>>2 = 61 announces a 2-byte little-endian (len-1)
+            out.append(61 << 2)
+            out += (n - 1).to_bytes(2, "little")
+        out += chunk
+        pos += n
+    return bytes(out)
+
+
+# ---------------------------------------------------------------- log files
+
+def read_log_records(path: str, *, verify: bool = True) -> Iterator[bytes]:
+    """Reassembled records from one log-format file (WAL or MANIFEST).
+    Stops cleanly at zero padding / a torn tail — exactly how leveldb's
+    recovery treats an unclean end of log."""
+    with open(path, "rb") as f:
+        data = f.read()
+    pos, fragments = 0, []
+    while pos + LOG_HEADER <= len(data):
+        block_left = BLOCK_SIZE - (pos % BLOCK_SIZE)
+        if block_left < LOG_HEADER:
+            pos += block_left  # zero trailer
+            continue
+        masked, length, rtype = struct.unpack_from("<IHB", data, pos)
+        if masked == 0 and length == 0 and rtype == 0:
+            pos += block_left  # padding to end of block
+            continue
+        payload = data[pos + LOG_HEADER:pos + LOG_HEADER + length]
+        if len(payload) < length or rtype not in (FULL, FIRST, MIDDLE, LAST):
+            return  # torn tail
+        if verify:
+            crc = crc32c(bytes([rtype]) + payload)
+            if crc_mask(crc) != masked:
+                return  # checksum failure == end of usable log
+        pos += LOG_HEADER + length
+        if rtype == FULL:
+            fragments = []
+            yield bytes(payload)
+        elif rtype == FIRST:
+            fragments = [payload]
+        elif rtype == MIDDLE:
+            fragments.append(payload)
+        else:  # LAST
+            fragments.append(payload)
+            yield b"".join(fragments)
+            fragments = []
+
+
+class LogWriter:
+    """log_writer.cc: records fragmented across 32KB blocks."""
+
+    def __init__(self, path: str) -> None:
+        self._f = open(path, "wb")
+        self._offset = 0
+
+    def add_record(self, payload: bytes) -> None:
+        pos, begin = 0, True
+        while True:
+            left = BLOCK_SIZE - (self._offset % BLOCK_SIZE)
+            if left < LOG_HEADER:
+                self._f.write(b"\x00" * left)
+                self._offset += left
+                left = BLOCK_SIZE
+            avail = left - LOG_HEADER
+            frag = payload[pos:pos + avail]
+            end = pos + len(frag) == len(payload)
+            rtype = (FULL if begin and end else FIRST if begin
+                     else LAST if end else MIDDLE)
+            crc = crc_mask(crc32c(bytes([rtype]) + frag))
+            self._f.write(struct.pack("<IHB", crc, len(frag), rtype) + frag)
+            self._offset += LOG_HEADER + len(frag)
+            pos += len(frag)
+            begin = False
+            if end:
+                return
+
+    def close(self) -> None:
+        self._f.close()
+
+
+# ----------------------------------------------------------------- sstable
+
+def _parse_block(raw: bytes) -> Iterator[Tuple[bytes, bytes]]:
+    """Entries of one block, un-delta-coding the keys (block.cc)."""
+    if len(raw) < 4:
+        raise ValueError("block too small")
+    n_restarts = struct.unpack_from("<I", raw, len(raw) - 4)[0]
+    limit = len(raw) - 4 * (n_restarts + 1)
+    pos, key = 0, b""
+    while pos < limit:
+        shared, pos = _read_varint(raw, pos)
+        non_shared, pos = _read_varint(raw, pos)
+        value_len, pos = _read_varint(raw, pos)
+        key = key[:shared] + raw[pos:pos + non_shared]
+        pos += non_shared
+        yield key, raw[pos:pos + value_len]
+        pos += value_len
+
+
+def _block_handle(buf, pos: int) -> Tuple[int, int, int]:
+    offset, pos = _read_varint(buf, pos)
+    size, pos = _read_varint(buf, pos)
+    return offset, size, pos
+
+
+class SSTableReader:
+    """One .ldb/.sst file: footer -> index block -> data blocks, yielding
+    internal-key entries in order (table.cc / format.cc)."""
+
+    def __init__(self, path: str, *, verify: bool = False) -> None:
+        with open(path, "rb") as f:
+            self.data = f.read()
+        if len(self.data) < FOOTER_SIZE:
+            raise ValueError(f"{path}: too small for an sstable")
+        footer = self.data[-FOOTER_SIZE:]
+        magic = struct.unpack_from("<Q", footer, FOOTER_SIZE - 8)[0]
+        if magic != TABLE_MAGIC:
+            raise ValueError(f"{path}: bad sstable magic {magic:#x}")
+        pos = 0
+        _mi_off, _mi_size, pos = _block_handle(footer, pos)
+        self._index_off, self._index_size, _ = _block_handle(footer, pos)
+        self._verify = verify
+
+    def _load_block(self, offset: int, size: int) -> bytes:
+        raw = self.data[offset:offset + size]
+        ctype = self.data[offset + size]
+        if self._verify:
+            stored = struct.unpack_from("<I", self.data, offset + size + 1)[0]
+            crc = crc_mask(crc32c(raw + bytes([ctype])))
+            if crc != stored:
+                raise ValueError(f"block at {offset}: checksum mismatch")
+        if ctype == 0:
+            return raw
+        if ctype == 1:
+            return snappy_uncompress(raw)
+        raise ValueError(f"unsupported block compression {ctype}")
+
+    def entries(self) -> Iterator[Tuple[bytes, bytes]]:
+        """(internal_key, value) across all data blocks, in key order."""
+        index = self._load_block(self._index_off, self._index_size)
+        for _sep_key, handle in _parse_block(index):
+            off, size, _ = _block_handle(handle, 0)
+            yield from _parse_block(self._load_block(off, size))
+
+
+def _split_internal(ikey: bytes) -> Tuple[bytes, int, int]:
+    """internal key -> (user_key, seq, type) (dbformat.h: trailing u64 =
+    seq<<8 | type)."""
+    tail = struct.unpack_from("<Q", ikey, len(ikey) - 8)[0]
+    return ikey[:-8], tail >> 8, tail & 0xFF
+
+
+def _make_internal(user_key: bytes, seq: int, vtype: int) -> bytes:
+    return user_key + struct.pack("<Q", (seq << 8) | vtype)
+
+
+# ----------------------------------------------------------------- manifest
+
+def read_current_manifest(path: str) -> str:
+    with open(os.path.join(path, "CURRENT")) as f:
+        name = f.read().strip()
+    return os.path.join(path, name)
+
+
+def read_manifest(manifest_path: str) -> Dict[str, object]:
+    """Apply the VersionEdit sequence; returns {files: {number: level},
+    log_number, prev_log, last_seq}."""
+    files: Dict[int, int] = {}
+    log_number = 0
+    prev_log = 0
+    last_seq = 0
+    for record in read_log_records(manifest_path):
+        pos = 0
+        while pos < len(record):
+            tag, pos = _read_varint(record, pos)
+            if tag == TAG_COMPARATOR:
+                name, pos = _read_length_prefixed(record, pos)
+                if name != COMPARATOR:
+                    raise ValueError(f"unsupported comparator {name!r}")
+            elif tag == TAG_LOG_NUMBER:
+                log_number, pos = _read_varint(record, pos)
+            elif tag == TAG_PREV_LOG:
+                prev_log, pos = _read_varint(record, pos)
+            elif tag == TAG_NEXT_FILE:
+                _, pos = _read_varint(record, pos)
+            elif tag == TAG_LAST_SEQ:
+                last_seq, pos = _read_varint(record, pos)
+            elif tag == TAG_COMPACT_POINTER:
+                _, pos = _read_varint(record, pos)
+                _, pos = _read_length_prefixed(record, pos)
+            elif tag == TAG_DELETED_FILE:
+                _level, pos = _read_varint(record, pos)
+                number, pos = _read_varint(record, pos)
+                files.pop(number, None)
+            elif tag == TAG_NEW_FILE:
+                level, pos = _read_varint(record, pos)
+                number, pos = _read_varint(record, pos)
+                _size, pos = _read_varint(record, pos)
+                _smallest, pos = _read_length_prefixed(record, pos)
+                _largest, pos = _read_length_prefixed(record, pos)
+                files[number] = level
+            else:
+                raise ValueError(f"unknown VersionEdit tag {tag}")
+    return dict(files=files, log_number=log_number, prev_log=prev_log,
+                last_seq=last_seq)
+
+
+# ------------------------------------------------------------------- reader
+
+def is_leveldb(path: str) -> bool:
+    """A LevelDB environment is a directory with a CURRENT pointer file
+    (db_impl.cc CurrentFileName) — distinct from LMDB's data.mdb layout."""
+    return os.path.isdir(path) and os.path.exists(
+        os.path.join(path, "CURRENT"))
+
+
+class LevelDBReader:
+    """Read-only merged view over a LevelDB directory — the role of
+    db_leveldb.cpp's LevelDBCursor (SeekToFirst/Next/key/value), built
+    from the live SSTables plus WAL replay."""
+
+    def __init__(self, path: str, *, verify_tables: bool = False) -> None:
+        self.path = path
+        manifest = read_manifest(read_current_manifest(path))
+        self._table_files: List[str] = []
+        for number in sorted(manifest["files"]):  # type: ignore[arg-type]
+            for ext in ("ldb", "sst"):
+                p = os.path.join(path, f"{number:06d}.{ext}")
+                if os.path.exists(p):
+                    self._table_files.append(p)
+                    break
+            else:
+                raise FileNotFoundError(
+                    f"live table {number:06d}.ldb missing from {path}")
+        self._verify = verify_tables
+        # WAL replay: logs >= the manifest's log_number hold writes newer
+        # than any sstable (an unclosed-compaction DB keeps data ONLY here)
+        floor = min(x for x in (manifest["log_number"],
+                                manifest["prev_log"] or manifest["log_number"])
+                    ) if manifest["log_number"] else 0
+        self._wal: List[Tuple[bytes, int, int, bytes]] = []
+        for p in sorted(glob.glob(os.path.join(path, "*.log"))):
+            m = re.match(r"(\d+)\.log$", os.path.basename(p))
+            if not m or int(m.group(1)) < floor:
+                continue
+            for batch in read_log_records(p):
+                seq, count = struct.unpack_from("<QI", batch, 0)
+                pos = 12
+                for _ in range(count):
+                    op = batch[pos]
+                    pos += 1
+                    key, pos = _read_length_prefixed(batch, pos)
+                    if op == TYPE_VALUE:
+                        value, pos = _read_length_prefixed(batch, pos)
+                    elif op == TYPE_DELETION:
+                        value = b""
+                    else:
+                        raise ValueError(f"bad WriteBatch op {op}")
+                    self._wal.append((key, seq, op, value))
+                    seq += 1
+        self._wal.sort(key=lambda e: (e[0], -e[1]))
+
+    def _table_iter(self, path: str):
+        for ikey, value in SSTableReader(path, verify=self._verify).entries():
+            user_key, seq, vtype = _split_internal(ikey)
+            yield user_key, seq, vtype, value
+
+    def items(self) -> Iterator[Tuple[bytes, bytes]]:
+        """Live (key, value) pairs in key order: newest sequence wins per
+        user key, deletions drop the key (the DBIter collapse)."""
+        import heapq
+
+        sources = [self._table_iter(p) for p in self._table_files]
+        if self._wal:
+            sources.append(iter(self._wal))
+        merged = heapq.merge(*sources, key=lambda e: (e[0], -e[1]))
+        current: Optional[bytes] = None
+        for user_key, _seq, vtype, value in merged:
+            if user_key == current:
+                continue  # an older sequence of an already-decided key
+            current = user_key
+            if vtype == TYPE_VALUE:
+                yield user_key, value
+
+    def __len__(self) -> int:
+        return sum(1 for _ in self.items())
+
+
+# ------------------------------------------------------------------- writer
+
+class LevelDBWriter:
+    """Bulk-load a fresh LevelDB directory: sorted entries into
+    non-overlapping level-1 SSTables + MANIFEST/CURRENT — the on-disk
+    state a clean leveldb open-write-compact-close leaves, and the fixture
+    `tests/test_leveldb.py` round-trips (mirroring the LMDB test
+    strategy).  Blocks are written raw (type 0) with real checksums."""
+
+    BLOCK_TARGET = 4096  # options.block_size default
+    TABLE_TARGET = 2 << 20  # max_file_size default
+
+    def __init__(self, path: str) -> None:
+        os.makedirs(path, exist_ok=True)
+        self.path = path
+        self.items: List[Tuple[bytes, bytes]] = []
+
+    def put(self, key: bytes, value: bytes) -> None:
+        self.items.append((bytes(key), bytes(value)))
+
+    # ---- one block
+    @staticmethod
+    def _build_block(entries: List[Tuple[bytes, bytes]],
+                     restart_interval: int = 16) -> bytes:
+        out = bytearray()
+        restarts = []
+        prev = b""
+        for i, (key, value) in enumerate(entries):
+            if i % restart_interval == 0:
+                restarts.append(len(out))
+                shared = 0
+            else:
+                shared = 0
+                for a, b in zip(prev, key):
+                    if a != b:
+                        break
+                    shared += 1
+            _write_varint(out, shared)
+            _write_varint(out, len(key) - shared)
+            _write_varint(out, len(value))
+            out += key[shared:]
+            out += value
+            prev = key
+        for r in restarts:
+            out += struct.pack("<I", r)
+        out += struct.pack("<I", len(restarts))
+        return bytes(out)
+
+    def _write_table(self, f, entries: List[Tuple[bytes, bytes]]) -> int:
+        """One sstable into open file f; returns file size."""
+        offset = 0
+
+        def emit_block(block: bytes) -> Tuple[int, int]:
+            nonlocal offset
+            crc = crc_mask(crc32c(block + b"\x00"))
+            f.write(block + b"\x00" + struct.pack("<I", crc))
+            handle = (offset, len(block))
+            offset += len(block) + 5
+            return handle
+
+        index_entries: List[Tuple[bytes, bytes]] = []
+        pending: List[Tuple[bytes, bytes]] = []
+        size = 0
+        for ikey, value in entries:
+            pending.append((ikey, value))
+            size += len(ikey) + len(value) + 8
+            if size >= self.BLOCK_TARGET:
+                off, sz = emit_block(self._build_block(pending))
+                handle = bytearray()
+                _write_varint(handle, off)
+                _write_varint(handle, sz)
+                # separator key: entries are sorted, the last key works
+                index_entries.append((pending[-1][0], bytes(handle)))
+                pending, size = [], 0
+        if pending:
+            off, sz = emit_block(self._build_block(pending))
+            handle = bytearray()
+            _write_varint(handle, off)
+            _write_varint(handle, sz)
+            index_entries.append((pending[-1][0], bytes(handle)))
+        meta_off, meta_sz = emit_block(self._build_block([]))
+        idx_off, idx_sz = emit_block(self._build_block(index_entries))
+        footer = bytearray()
+        for v in (meta_off, meta_sz, idx_off, idx_sz):
+            _write_varint(footer, v)
+        footer += b"\x00" * (FOOTER_SIZE - 8 - len(footer))
+        footer += struct.pack("<Q", TABLE_MAGIC)
+        f.write(footer)
+        return offset + FOOTER_SIZE
+
+    def commit(self) -> None:
+        # sequences follow insertion order (leveldb assigns them per
+        # write); internal-key order is (user_key asc, seq DESC), so a
+        # key put twice surfaces its newest value via the merge tie-break
+        with_seq = [(k, i + 1, v) for i, (k, v) in enumerate(self.items)]
+        with_seq.sort(key=lambda e: (e[0], -e[1]))
+        new_files: List[Tuple[int, int, bytes, bytes]] = []
+        file_no = 5
+        i = 0
+        while i < len(with_seq) or not new_files:
+            chunk: List[Tuple[bytes, int, bytes]] = []
+            size = 0
+            while i < len(with_seq) and size < self.TABLE_TARGET:
+                chunk.append(with_seq[i])
+                size += len(with_seq[i][0]) + len(with_seq[i][2])
+                i += 1
+            entries = [(_make_internal(k, seq, TYPE_VALUE), v)
+                       for k, seq, v in chunk]
+            path = os.path.join(self.path, f"{file_no:06d}.ldb")
+            with open(path, "wb") as f:
+                fsize = self._write_table(f, entries)
+            smallest = entries[0][0] if entries else b""
+            largest = entries[-1][0] if entries else b""
+            new_files.append((file_no, fsize, smallest, largest))
+            file_no += 1
+            if i >= len(with_seq):
+                break
+
+        log_no = file_no
+        LogWriter(os.path.join(self.path, f"{log_no:06d}.log")).close()
+        edit = bytearray()
+        _write_varint(edit, TAG_COMPARATOR)
+        _write_varint(edit, len(COMPARATOR))
+        edit += COMPARATOR
+        _write_varint(edit, TAG_LOG_NUMBER)
+        _write_varint(edit, log_no)
+        _write_varint(edit, TAG_NEXT_FILE)
+        _write_varint(edit, log_no + 1)
+        _write_varint(edit, TAG_LAST_SEQ)
+        _write_varint(edit, len(self.items))
+        for number, fsize, smallest, largest in new_files:
+            _write_varint(edit, TAG_NEW_FILE)
+            _write_varint(edit, 1)  # level 1: sorted, non-overlapping
+            _write_varint(edit, number)
+            _write_varint(edit, fsize)
+            _write_varint(edit, len(smallest))
+            edit += smallest
+            _write_varint(edit, len(largest))
+            edit += largest
+        manifest = f"MANIFEST-{4:06d}"
+        w = LogWriter(os.path.join(self.path, manifest))
+        w.add_record(bytes(edit))
+        w.close()
+        with open(os.path.join(self.path, "CURRENT"), "w") as f:
+            f.write(manifest + "\n")

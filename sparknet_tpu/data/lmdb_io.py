@@ -31,9 +31,10 @@ Format notes (LMDB 0.9.x, 64-bit build, the layout mdb.c documents):
   mm_last_pg u64, mm_txnid u64.  Main DB is mm_dbs[1]; empty root =
   0xFFFFFFFFFFFFFFFF.
 
-LevelDB (SSTable log/manifest) compatibility is NOT implemented; the
-reference's default backend is lmdb (caffe.proto DataParameter.DB) and its
-LevelDB databases must be converted with the reference's own tools first.
+LevelDB (SSTable/log/manifest) compatibility lives in the sibling
+`leveldb_io` module; `is_datum_db` / `open_datum_db` / `read_datum_db`
+dispatch between the two backends by directory layout, mirroring the
+reference's db.cpp:9-22 backend dispatch.
 """
 
 from __future__ import annotations
@@ -60,11 +61,30 @@ def _even(n: int) -> int:
 
 
 def is_datum_db(path: str) -> bool:
-    """True when `path` is an LMDB environment directory (the data.mdb
-    layout liblmdb writes) — the dispatch predicate shared by the Data-layer
-    feed and the net's shape probe."""
-    return os.path.isdir(path) and os.path.exists(
-        os.path.join(path, "data.mdb"))
+    """True when `path` is a reference-style Datum database directory —
+    LMDB (data.mdb layout) OR LevelDB (CURRENT/MANIFEST layout) — the
+    dispatch predicate shared by the Data-layer feed and the net's shape
+    probe (reference backend dispatch: db.cpp:9-22)."""
+    if not os.path.isdir(path):
+        return False
+    if os.path.exists(os.path.join(path, "data.mdb")):
+        return True
+    from .leveldb_io import is_leveldb
+
+    return is_leveldb(path)
+
+
+def open_datum_db(path: str):
+    """Cursor-bearing reader for either backend (db.cpp GetDB dispatch):
+    both expose .items() -> (key, value) in key order."""
+    if os.path.exists(os.path.join(path, "data.mdb")) or not os.path.isdir(
+            path):
+        return LMDBReader(path)
+    from .leveldb_io import LevelDBReader, is_leveldb
+
+    if is_leveldb(path):
+        return LevelDBReader(path)
+    return LMDBReader(path)
 
 
 # ------------------------------------------------------------------- reader
@@ -396,13 +416,14 @@ def serialize_datum(image: np.ndarray, label: int) -> bytes:
 def read_datum_db(path: str, height: Optional[int] = None,
                   width: Optional[int] = None
                   ) -> Iterator[Tuple[np.ndarray, int]]:
-    """Stream (image CHW, label) from a reference-made LMDB of Datum
-    records, decoding `encoded` datums (compressed JPEG/PNG) on the fly;
+    """Stream (image CHW, label) from a reference-made Datum database —
+    LMDB or LevelDB, dispatched by directory layout (db.cpp:9-22) —
+    decoding `encoded` datums (compressed JPEG/PNG) on the fly;
     height/width resize encoded images (convert_imageset --resize_*
     semantics — without them encoded datums keep their native sizes)."""
     from .scale_convert import decode_and_resize
 
-    for _key, value in LMDBReader(path).items():
+    for _key, value in open_datum_db(path).items():
         d = parse_datum(value)
         if d.get("encoded"):
             img = decode_and_resize(d["encoded_bytes"],  # type: ignore
@@ -451,6 +472,22 @@ def write_datum_lmdb(path: str, pairs: Iterator[Tuple[np.ndarray, int]],
     """Write (image, label) pairs as a Datum LMDB the reference can read
     (convert_imageset's DB layout, keys zero-padded in insertion order)."""
     w = LMDBWriter(path)
+    n = 0
+    for img, label in pairs:
+        w.put(key_format.format(n).encode(), serialize_datum(img, label))
+        n += 1
+    w.commit()
+    return n
+
+
+def write_datum_leveldb(path: str, pairs: Iterator[Tuple[np.ndarray, int]],
+                        key_format: str = "{:08d}") -> int:
+    """LevelDB counterpart of write_datum_lmdb — the backend the bundled
+    cifar10_full example selects (cifar10_full_train_test.prototxt:16,
+    db_leveldb.cpp:10-76); keys zero-padded in insertion order."""
+    from .leveldb_io import LevelDBWriter
+
+    w = LevelDBWriter(path)
     n = 0
     for img, label in pairs:
         w.put(key_format.format(n).encode(), serialize_datum(img, label))

@@ -103,21 +103,27 @@ def cmd_convert_imageset(args) -> int:
 
 
 def cmd_convert_db(args) -> int:
-    """Migrate between DB formats: a reference-made LMDB of Datum records
-    ingests into this framework's ArrayStore, and an ArrayStore exports to
-    an LMDB the reference can open (reference: db_lmdb.cpp:20-86 cursor,
-    convert_imageset.cpp layout)."""
+    """Migrate between DB formats: a reference-made Datum database (LMDB
+    or LevelDB — both reference backends, db.cpp:9-22) ingests into this
+    framework's ArrayStore, and an ArrayStore exports to an LMDB or
+    LevelDB the reference can open (db_lmdb.cpp:20-86, db_leveldb.cpp:
+    10-76, convert_imageset.cpp layout)."""
     from .data import lmdb_io
     from .data.store import ArrayStoreCursor
 
-    if args.direction == "lmdb-to-store":
+    if args.direction in ("lmdb-to-store", "db-to-store"):
+        # read side auto-dispatches on directory layout, so a reference
+        # LevelDB (db_leveldb.cpp) ingests through the same verb
         n = lmdb_io.convert_lmdb_to_store(
             args.input, args.output, args.resize_height or None,
             args.resize_width or None)
     else:
         cur = ArrayStoreCursor(args.input)
-        n = lmdb_io.write_datum_lmdb(
-            args.output, (cur.next() for _ in range(len(cur))))
+        pairs = (cur.next() for _ in range(len(cur)))
+        if args.direction == "store-to-leveldb":
+            n = lmdb_io.write_datum_leveldb(args.output, pairs)
+        else:
+            n = lmdb_io.write_datum_lmdb(args.output, pairs)
     print(f"Converted {n} records {args.direction}: "
           f"{args.input} -> {args.output}")
     return 0
@@ -339,7 +345,8 @@ def register(sub) -> None:
 
     cd = sub.add_parser("convert_db")
     cd.add_argument("direction",
-                    choices=["lmdb-to-store", "store-to-lmdb"])
+                    choices=["lmdb-to-store", "store-to-lmdb",
+                             "db-to-store", "store-to-leveldb"])
     cd.add_argument("input")
     cd.add_argument("output")
     cd.add_argument("--resize_height", type=int, default=0)

@@ -1,0 +1,318 @@
+"""LevelDB on-disk format tests — the reference DB tier's second backend
+(reference: caffe/src/caffe/util/db_leveldb.cpp:10-76; the bundled
+cifar10_full example writes LEVELDB,
+examples/cifar10/cifar10_full_train_test.prototxt:16).
+
+Fixture strategy mirrors tests/test_lmdb.py: our own writer produces the
+databases our reader ingests, plus hand-built WAL/snappy/tombstone cases
+the writer alone can't produce, plus structural invariants a real
+libleveldb open would check.
+"""
+
+import os
+import struct
+
+import numpy as np
+import pytest
+
+from sparknet_tpu.data import leveldb_io as ldb
+from sparknet_tpu.data.leveldb_io import (LevelDBReader, LevelDBWriter,
+                                          LogWriter, SSTableReader,
+                                          crc32c, crc_mask, crc_unmask,
+                                          is_leveldb, read_log_records,
+                                          snappy_compress_literal,
+                                          snappy_uncompress)
+from sparknet_tpu.data.lmdb_io import (is_datum_db, read_datum_db,
+                                       serialize_datum,
+                                       write_datum_leveldb)
+
+
+def _write(tmp_path, items, name="db"):
+    p = str(tmp_path / name)
+    w = LevelDBWriter(p)
+    for k, v in items:
+        w.put(k, v)
+    w.commit()
+    return p
+
+
+# ----------------------------------------------------------------- crc32c
+
+def test_crc32c_known_vectors():
+    """Published CRC-32C check values (RFC 3720 / crc32c.cc tests)."""
+    assert crc32c(b"123456789") == 0xE3069283
+    assert crc32c(b"\x00" * 32) == 0x8A9136AA
+    assert crc32c(b"\xff" * 32) == 0x62A8AB43
+
+
+def test_crc_mask_roundtrip():
+    for v in (0, 1, 0xDEADBEEF, 0xFFFFFFFF):
+        assert crc_unmask(crc_mask(v)) == v
+
+
+# ----------------------------------------------------------------- snappy
+
+def test_snappy_literal_roundtrip():
+    rng = np.random.RandomState(0)
+    data = rng.randint(0, 256, size=100000).astype(np.uint8).tobytes()
+    assert snappy_uncompress(snappy_compress_literal(data)) == data
+    assert snappy_uncompress(snappy_compress_literal(b"")) == b""
+
+
+def test_snappy_copy_elements():
+    """Hand-built streams with every copy-tag width, including the
+    overlapping-copy (run-length) case real snappy emits constantly."""
+    # "abcabcabc...": literal "abc" + overlapping copy offset 3 len 9
+    # (1-byte copies hold len-4 in 3 bits, so len <= 11)
+    out = bytearray()
+    ldb._write_varint(out, 12)
+    out += bytes([(3 - 1) << 2]) + b"abc"        # literal len 3
+    out += bytes([(1) | ((9 - 4) << 2) | (0 << 5), 3])  # 1-byte copy
+    assert snappy_uncompress(bytes(out)) == b"abc" * 4
+    # 2-byte-offset copy
+    out = bytearray()
+    ldb._write_varint(out, 8)
+    out += bytes([(4 - 1) << 2]) + b"wxyz"
+    out += bytes([2 | ((4 - 1) << 2)]) + struct.pack("<H", 4)
+    assert snappy_uncompress(bytes(out)) == b"wxyzwxyz"
+    # 4-byte-offset copy
+    out = bytearray()
+    ldb._write_varint(out, 6)
+    out += bytes([(3 - 1) << 2]) + b"pqr"
+    out += bytes([3 | ((3 - 1) << 2)]) + struct.pack("<I", 3)
+    assert snappy_uncompress(bytes(out)) == b"pqrpqr"
+
+
+# --------------------------------------------------------------- log files
+
+def test_log_roundtrip_and_fragmentation(tmp_path):
+    """Records larger than a 32KB block fragment FIRST/MIDDLE/LAST and
+    reassemble; small ones are FULL."""
+    p = str(tmp_path / "test.log")
+    rng = np.random.RandomState(1)
+    records = [b"small", rng.bytes(100000), b"", rng.bytes(40000),
+               b"tail"]
+    w = LogWriter(p)
+    for r in records:
+        w.add_record(r)
+    w.close()
+    assert list(read_log_records(p)) == records
+    # structural check: first record header says FULL with correct crc
+    raw = open(p, "rb").read()
+    masked, length, rtype = struct.unpack_from("<IHB", raw, 0)
+    assert rtype == ldb.FULL and length == 5
+    assert crc_unmask(masked) == crc32c(bytes([ldb.FULL]) + b"small")
+
+
+def test_log_torn_tail_stops_cleanly(tmp_path):
+    """A torn (half-written) record at the tail is dropped, not an error —
+    leveldb recovery semantics for an unclean shutdown."""
+    p = str(tmp_path / "torn.log")
+    w = LogWriter(p)
+    w.add_record(b"good-record")
+    w.close()
+    with open(p, "ab") as f:
+        f.write(struct.pack("<IHB", 12345, 500, ldb.FULL) + b"short")
+    assert list(read_log_records(p)) == [b"good-record"]
+
+
+# ------------------------------------------------------------- write/read
+
+def test_roundtrip_small_values(tmp_path):
+    items = [(f"k{i:03d}".encode(), f"value-{i}".encode())
+             for i in range(10)]
+    p = _write(tmp_path, items)
+    assert list(LevelDBReader(p).items()) == sorted(items)
+    assert len(LevelDBReader(p)) == 10
+    assert is_leveldb(p)
+
+
+def test_unsorted_input_is_sorted_by_key(tmp_path):
+    p = _write(tmp_path, [(b"zz", b"1"), (b"aa", b"2"), (b"mm", b"3")])
+    assert [k for k, _ in LevelDBReader(p).items()] == [b"aa", b"mm", b"zz"]
+
+
+def test_duplicate_put_newest_wins(tmp_path):
+    p = _write(tmp_path, [(b"k", b"old"), (b"other", b"x"),
+                          (b"k", b"new")])
+    assert dict(LevelDBReader(p).items()) == {b"k": b"new", b"other": b"x"}
+
+
+def test_multiblock_multifile_tables(tmp_path):
+    """Enough data to force many 4KB blocks and multiple level-1 tables."""
+    rng = np.random.RandomState(2)
+    items = [(f"{i:08d}".encode(), rng.bytes(3100)) for i in range(1500)]
+    p = _write(tmp_path, items)
+    tables = [f for f in os.listdir(p) if f.endswith(".ldb")]
+    assert len(tables) > 1, "expected the 2MB table split to trigger"
+    got = list(LevelDBReader(p, verify_tables=True).items())
+    assert got == items
+
+
+def test_empty_db(tmp_path):
+    p = _write(tmp_path, [])
+    assert list(LevelDBReader(p).items()) == []
+
+
+def test_sstable_structural_invariants(tmp_path):
+    """Footer magic, block trailer checksums, index handles — what a real
+    libleveldb Table::Open validates."""
+    p = _write(tmp_path, [(f"{i:04d}".encode(), b"v" * 50)
+                          for i in range(200)])
+    table = sorted(f for f in os.listdir(p) if f.endswith(".ldb"))[0]
+    raw = open(os.path.join(p, table), "rb").read()
+    magic = struct.unpack_from("<Q", raw, len(raw) - 8)[0]
+    assert magic == ldb.TABLE_MAGIC
+    r = SSTableReader(os.path.join(p, table), verify=True)
+    entries = list(r.entries())  # verify=True checks every block crc
+    assert len(entries) == 200
+    user_key, seq, vtype = ldb._split_internal(entries[0][0])
+    assert user_key == b"0000" and vtype == ldb.TYPE_VALUE and seq >= 1
+
+
+def test_wal_only_and_overlay_records(tmp_path):
+    """Writes that never reached an SSTable live ONLY in the WAL — the
+    state the reference's convert tools leave after Put()s without a
+    final compaction.  WAL entries overlay (newer seq) and tombstone
+    sstable records."""
+    p = _write(tmp_path, [(b"a", b"table-a"), (b"b", b"table-b"),
+                          (b"c", b"table-c")])
+    # find the manifest's live log number and append a batch to it
+    manifest = ldb.read_manifest(ldb.read_current_manifest(p))
+    log_path = os.path.join(p, f"{manifest['log_number']:06d}.log")
+    assert os.path.exists(log_path)
+    seq = manifest["last_seq"] + 1
+    batch = bytearray(struct.pack("<QI", seq, 3))
+    for op, key, value in ((ldb.TYPE_VALUE, b"b", b"wal-b"),
+                           (ldb.TYPE_DELETION, b"c", b""),
+                           (ldb.TYPE_VALUE, b"d", b"wal-d")):
+        batch.append(op)
+        ldb._write_varint(batch, len(key))
+        batch += key
+        if op == ldb.TYPE_VALUE:
+            ldb._write_varint(batch, len(value))
+            batch += value
+    w = LogWriter(log_path)
+    w.add_record(bytes(batch))
+    w.close()
+    got = dict(LevelDBReader(p).items())
+    assert got == {b"a": b"table-a",  # untouched
+                   b"b": b"wal-b",    # WAL overlays the table record
+                   b"d": b"wal-d"}    # WAL-only key; c tombstoned away
+
+
+def test_snappy_compressed_block_reads(tmp_path):
+    """A table whose blocks are snappy-compressed (type 1) — what a
+    reference build linked against real snappy writes — decodes."""
+    p = str(tmp_path / "snappy_db")
+    w = LevelDBWriter(p)
+    for i in range(50):
+        w.put(f"{i:04d}".encode(), (f"payload-{i}-" * 10).encode())
+    # monkey-build: write the table with compressed blocks by swapping the
+    # emit path — recompress each raw block after a normal commit
+    w.commit()
+    table = sorted(f for f in os.listdir(p) if f.endswith(".ldb"))[0]
+    tpath = os.path.join(p, table)
+    r = SSTableReader(tpath)
+    # rebuild the file with every block snappy-compressed
+    blocks = []
+    index = r._load_block(r._index_off, r._index_size)
+    for _k, handle in ldb._parse_block(index):
+        off, size, _ = ldb._block_handle(handle, 0)
+        blocks.append(r._load_block(off, size))
+    out = bytearray()
+    index_entries = []
+    keys = [k for k, _ in ldb._parse_block(index)]
+    for key, raw in zip(keys, blocks):
+        comp = snappy_compress_literal(raw)
+        off = len(out)
+        out += comp + b"\x01" + struct.pack(
+            "<I", crc_mask(crc32c(comp + b"\x01")))
+        h = bytearray()
+        ldb._write_varint(h, off)
+        ldb._write_varint(h, len(comp))
+        index_entries.append((key, bytes(h)))
+    meta = LevelDBWriter._build_block([])
+    meta_off = len(out)
+    out += meta + b"\x00" + struct.pack("<I", crc_mask(crc32c(meta + b"\x00")))
+    idx = LevelDBWriter._build_block(index_entries)
+    idx_off = len(out)
+    out += idx + b"\x00" + struct.pack("<I", crc_mask(crc32c(idx + b"\x00")))
+    footer = bytearray()
+    for v in (meta_off, len(meta), idx_off, len(idx)):
+        ldb._write_varint(footer, v)
+    footer += b"\x00" * (ldb.FOOTER_SIZE - 8 - len(footer))
+    footer += struct.pack("<Q", ldb.TABLE_MAGIC)
+    out += footer
+    open(tpath, "wb").write(bytes(out))
+    got = dict(LevelDBReader(p, verify_tables=True).items())
+    assert got[b"0007"] == b"payload-7-" * 10
+    assert len(got) == 50
+
+
+# ------------------------------------------------------------ integrations
+
+def test_datum_leveldb_roundtrip_and_dispatch(tmp_path):
+    """write_datum_leveldb -> read_datum_db via the backend dispatch the
+    Data layer and shape probe share (db.cpp:9-22 parity)."""
+    rng = np.random.RandomState(3)
+    imgs = rng.randint(0, 256, size=(20, 3, 32, 32)).astype(np.uint8)
+    labels = rng.randint(0, 10, size=20)
+    db = str(tmp_path / "cifar_leveldb")
+    n = write_datum_leveldb(db, ((imgs[i], int(labels[i]))
+                                 for i in range(20)))
+    assert n == 20
+    assert is_datum_db(db) and is_leveldb(db)
+
+    back = list(read_datum_db(db))
+    assert len(back) == 20
+    np.testing.assert_array_equal(back[0][0], imgs[0])
+    assert [l for _, l in back] == [int(x) for x in labels]
+
+
+def test_convert_db_cli_leveldb_directions(tmp_path):
+    """convert_db handles the LevelDB backend both ways (VERDICT r2
+    item 8 done-bar): store -> leveldb -> store."""
+    from sparknet_tpu.cli import main as cli_main
+    from sparknet_tpu.data.store import ArrayStoreCursor, ArrayStoreWriter
+
+    rng = np.random.RandomState(4)
+    imgs = rng.randint(0, 256, size=(12, 3, 8, 8)).astype(np.uint8)
+    store = str(tmp_path / "store")
+    w = ArrayStoreWriter(store)
+    for i in range(12):
+        w.put(imgs[i], i % 5)
+    w.close()
+
+    db = str(tmp_path / "as_leveldb")
+    assert cli_main(["convert_db", "store-to-leveldb", store, db]) == 0
+    assert is_leveldb(db)
+    store2 = str(tmp_path / "store2")
+    assert cli_main(["convert_db", "db-to-store", db, store2]) == 0
+    cur = ArrayStoreCursor(store2)
+    assert len(cur) == 12
+    img0, _l0 = cur.next()
+    np.testing.assert_array_equal(img0, imgs[0])
+
+
+def test_data_layer_feed_reads_leveldb(tmp_path):
+    """The cifar10_full scenario: a LEVELDB-backed Data layer
+    (cifar10_full_train_test.prototxt:14-21, `backend: LEVELDB`) feeds
+    batches through the same path the LMDB backend uses."""
+    from sparknet_tpu.data.feeds import make_data_feed
+    from sparknet_tpu.proto.caffe_pb import NetParameter
+    from sparknet_tpu.proto.textformat import parse
+
+    rng = np.random.RandomState(5)
+    imgs = rng.randint(0, 256, size=(16, 3, 8, 8)).astype(np.uint8)
+    db = str(tmp_path / "full_leveldb")
+    write_datum_leveldb(db, ((imgs[i], i % 4) for i in range(16)))
+    net = NetParameter(parse(f"""
+layer {{ name: "data" type: "Data" top: "data" top: "label"
+  data_param {{ source: "{db}" batch_size: 4 backend: LEVELDB }} }}
+"""))
+    feed = make_data_feed(net.layers[0])
+    b = feed()
+    assert b["data"].shape == (4, 3, 8, 8)
+    np.testing.assert_array_equal(b["data"][0], imgs[0])
+    assert list(b["label"][:4]) == [0, 1, 2, 3]
