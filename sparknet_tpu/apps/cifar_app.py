@@ -73,7 +73,8 @@ def load_data(args) -> Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray,
 def build_solver(model: str, n_workers: int, tau: int, mesh=None,
                  proto_dir: str = REFERENCE_PROTO_DIR,
                  batch_size: int = TRAIN_BATCH_SIZE,
-                 dcn_interval: int = 1) -> DistributedSolver:
+                 dcn_interval: int = 1,
+                 scan_unroll=1) -> DistributedSolver:
     """ProtoLoader flow (CifarApp.scala:81-89): net prototxt ->
     replaceDataLayers -> solver-with-inline-net -> instantiate."""
     net = caffe_pb.load_net_prototxt(
@@ -83,7 +84,8 @@ def build_solver(model: str, n_workers: int, tau: int, mesh=None,
     sp = caffe_pb.load_solver_prototxt_with_net(
         os.path.join(proto_dir, f"cifar10_{model}_solver.prototxt"), net)
     return DistributedSolver(sp, n_workers=n_workers, tau=tau, mesh=mesh,
-                             dcn_interval=dcn_interval)
+                             dcn_interval=dcn_interval,
+                             scan_unroll=scan_unroll)
 
 
 class WorkerFeed:

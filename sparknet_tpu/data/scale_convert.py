@@ -14,18 +14,62 @@ import numpy as np
 from .byte_image import ByteImage
 
 
+def _bilinear_resize_hwc(arr: np.ndarray, th: int, tw: int) -> np.ndarray:
+    """Center-aligned 2-tap bilinear, float32 — the EXACT math of the
+    native decoder's finish pass (native/jpeg_decoder.cpp:116-140,
+    including the +0.5 truncating round), vectorized.  Keeping the two
+    paths numerically identical means pixel output does not depend on
+    whether libsparknet_jpeg.so is built on a given host (ADVICE r2)."""
+    h, w = arr.shape[:2]
+    if (h, w) == (th, tw):
+        return arr
+    fy = np.clip((np.arange(th, dtype=np.float32) + np.float32(0.5))
+                 * np.float32(h / th) - np.float32(0.5), 0, h - 1)
+    y0 = fy.astype(np.int32)
+    y1 = np.minimum(y0 + 1, h - 1)
+    wy = (fy - y0)[:, None, None]
+    fx = np.clip((np.arange(tw, dtype=np.float32) + np.float32(0.5))
+                 * np.float32(w / tw) - np.float32(0.5), 0, w - 1)
+    x0 = fx.astype(np.int32)
+    x1 = np.minimum(x0 + 1, w - 1)
+    wx = (fx - x0)[None, :, None]
+    a = arr.astype(np.float32)
+    top = a[y0][:, x0] * (1 - wx) + a[y0][:, x1] * wx
+    bot = a[y1][:, x0] * (1 - wx) + a[y1][:, x1] * wx
+    v = top * (1 - wy) + bot * wy
+    return (v + np.float32(0.5)).astype(np.uint8)
+
+
 def decode_and_resize(jpeg_bytes: bytes, height: Optional[int] = None,
                       width: Optional[int] = None) -> Optional[np.ndarray]:
     """JPEG/PNG bytes -> (3, H, W) uint8, or None for corrupt images
     (the reference drops them, ScaleAndConvert.scala:17-26).  height/width
-    None keeps the native size (convert_imageset's no-resize default)."""
+    None keeps the native size (convert_imageset's no-resize default).
+
+    The resize path REPLICATES the native decoder (jpeg_decoder.cpp):
+    libjpeg DCT prescale to the same power-of-two fraction (PIL draft()
+    drives the identical libjpeg knob), then the same 2-tap bilinear —
+    so the PIL fallback and the native pool produce matching pixels."""
     try:
         from PIL import Image
 
-        img = Image.open(io.BytesIO(jpeg_bytes)).convert("RGB")
+        img = Image.open(io.BytesIO(jpeg_bytes))
+        if height and width and img.format == "JPEG":
+            # the native denom loop (jpeg_decoder.cpp:73-81): largest
+            # power-of-two prescale that still leaves >= target size
+            w0, h0 = img.size
+            denom = 1
+            while (denom < 8 and h0 // (denom * 2) >= height
+                   and w0 // (denom * 2) >= width):
+                denom *= 2
+            if denom > 1:
+                img.draft("RGB", (max(1, w0 // denom),
+                                  max(1, h0 // denom)))
+        img = img.convert("RGB")
+        arr = np.asarray(img, dtype=np.uint8)
         if height and width:
-            img = img.resize((width, height))
-        return np.transpose(np.asarray(img, dtype=np.uint8), (2, 0, 1))
+            arr = _bilinear_resize_hwc(arr, height, width)
+        return np.transpose(arr, (2, 0, 1))
     except Exception:
         return None
 

@@ -31,7 +31,8 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..proto.caffe_pb import NetParameter, SolverParameter
 from ..solver import updates
-from ..solver.solver import (DataSource, build_test_net, build_train_net,
+from ..solver.solver import (DataSource, accumulate_test_outputs,
+                             build_test_net, build_train_net,
                              load_params_file, make_single_step,
                              parse_caffe_snapshot, parse_native_snapshot,
                              parse_slot_arrays, resolve_precision,
@@ -69,14 +70,24 @@ class DistributedSolver:
                  batch_override: Optional[int] = None,
                  mesh=None, precision: Optional[str] = None,
                  dcn_interval: int = 1, device_transform=None,
-                 device_transform_eval=None) -> None:
+                 device_transform_eval=None, scan_unroll=1) -> None:
         """device_transform(_eval): optional jittable augmentation fns
         (ops/device_transform.py) fused in front of the train step / test
         forward — feeds then ship raw uint8 and the crop/mirror/mean
-        arithmetic runs on device inside the compiled round."""
+        arithmetic runs on device inside the compiled round.
+
+        scan_unroll: unroll factor for the τ-step lax.scan (True = fully).
+        Keep the default (rolled) on TPU — compile time scales with the
+        unroll and the rolled loop is already fast.  Set True when
+        SIMULATING a mesh on CPU devices: XLA:CPU loses its fast conv
+        kernels inside while-loop bodies (measured 38 -> 467 ms for one
+        conv gradient on this repo's dev box), and unrolling restores
+        them — the knob scripts/distacc_run.py runs the convergence study
+        through."""
         assert mode in ("average", "sync")
         self.device_transform = device_transform
         self.device_transform_eval = device_transform_eval
+        self.scan_unroll = scan_unroll
         self.param = solver_param
         self.precision = resolve_precision(solver_param, precision)
         self.mode = mode
@@ -175,8 +186,18 @@ class DistributedSolver:
                 return (p, s, it + 1), loss
 
             step_rngs = jax.random.split(rng, tau)
-            (params, state, _), losses = jax.lax.scan(
-                body, (params, state, it0), (batches, step_rngs))
+            if tau == 1:
+                # no scan node for a single local step: XLA:CPU picks its
+                # fast conv kernels only outside loop bodies (and on TPU a
+                # trip-1 loop is pure overhead)
+                inputs1 = jax.tree.map(lambda a: a[0], batches)
+                params, state, loss1 = stepper(params, state, it0,
+                                               inputs1, step_rngs[0])
+                losses = loss1[None]
+            else:
+                (params, state, _), losses = jax.lax.scan(
+                    body, (params, state, it0), (batches, step_rngs),
+                    unroll=self.scan_unroll)
             if mode == "average":
                 # the τ-interval weight average (WeightCollection mean,
                 # Net.scala:14-47) as one ICI collective...
@@ -223,8 +244,39 @@ class DistributedSolver:
         """One pull-source per worker — the RDD-partition analogue
         (CifarApp.scala:120-130 zipPartitions)."""
         assert len(sources) == self.n_workers
+        # validate BEFORE mutating: a caller that catches the ValueError
+        # must not be left with the unsafe composition armed
+        self._check_prefetch_safe(prefetch=self._prefetch, sources=sources)
         self.train_sources = sources
         self._staged = None  # staged batches came from the old sources
+
+    def _check_prefetch_safe(self, *, prefetch: Optional[bool] = None,
+                             sources=None) -> None:
+        """Refuse the prefetch × per-round-reset-feed composition: a feed
+        that must be re-windowed each round (it defines `new_round`, like
+        the CifarApp MinibatchSampler WorkerFeed) would be pulled one round
+        EARLY by the look-ahead staging and silently train on offset data.
+        A feed whose __call__ is a genuinely round-agnostic stream can
+        declare `stream_safe = True` to compose with prefetch anyway.
+
+        Called with the PROSPECTIVE prefetch/sources values before either
+        setter commits them, so a raised error leaves no unsafe state."""
+        prefetch = self._prefetch if prefetch is None else prefetch
+        sources = self.train_sources if sources is None else sources
+        if not (prefetch and sources):
+            return
+        unsafe = [i for i, s in enumerate(sources)
+                  if hasattr(s, "new_round")
+                  and not getattr(s, "stream_safe", False)]
+        if unsafe:
+            raise ValueError(
+                f"set_prefetch(True) stages round N+1's batches while "
+                f"round N computes, but train source(s) {unsafe} define "
+                f"new_round() — a per-round-reset feed would be pulled one "
+                f"round early and silently train on misaligned data. "
+                f"Disable prefetch for these sources, or set "
+                f"`stream_safe = True` on a source whose __call__ really "
+                f"is round-agnostic.")
 
     def set_test_data(self, source: DataSource, num_batches: int) -> None:
         self.test_source = source
@@ -287,8 +339,9 @@ class DistributedSolver:
         """Enable one-round-ahead staging: while round N computes on
         device, round N+1's batches are pulled and transferred on a host
         thread.  Only valid when the data sources are round-agnostic
-        streams (a feed that must be reset per round — e.g. the CifarApp
-        windowed sampler — would be pulled one round early)."""
+        streams; composing it with a per-round-reset feed (e.g. the
+        CifarApp windowed sampler) raises — see _check_prefetch_safe."""
+        self._check_prefetch_safe(prefetch=bool(on))
         self._prefetch = bool(on)
 
     def run_round(self, prefetch_next: Optional[bool] = None) -> float:
@@ -299,8 +352,11 @@ class DistributedSolver:
         With set_prefetch(True), round N+1's host pulls and device
         transfers overlap round N's device execution (double buffering —
         the driver-loop analogue of the reference's prefetch thread).
-        `prefetch_next=False` skips the look-ahead (pass it on the final
-        round so the run doesn't pull a batch set nobody will consume)."""
+        `prefetch_next=False` VETOES the look-ahead for this round (pass
+        it on the final round so the run doesn't pull a batch set nobody
+        will consume); it can only restrict, never force — prefetch stays
+        off unless set_prefetch(True) armed it (which is where the
+        per-round-reset-feed guard lives)."""
         staged = self._staged
         if staged is None:
             staged = self._stage_round(self.round)
@@ -313,8 +369,8 @@ class DistributedSolver:
             self.params_w, self.state_w, jnp.int32(self.iter), batches, rngs)
         self.iter += self.tau
         self.round += 1
-        if prefetch_next is None:
-            prefetch_next = self._prefetch
+        prefetch_next = (self._prefetch if prefetch_next is None
+                         else self._prefetch and prefetch_next)
         if prefetch_next:
             import threading
 
@@ -351,8 +407,9 @@ class DistributedSolver:
         for _ in range(n):
             batch = {k: jnp.asarray(v) for k, v in self.test_source().items()}
             outs = self._test_step(avg, batch)
-            for k, v in outs.items():
-                totals[k] = totals.get(k, 0.0) + float(v)
+            # per-element accumulation, matching the single-chip Solver
+            # (reference test_score_ semantics, solver.cpp:414-444)
+            accumulate_test_outputs(totals, outs)
         return {k: v / n for k, v in totals.items()}
 
     # ------------------------------------------------------------- weights

@@ -136,6 +136,22 @@ class PipelineTrainer:
         self.train_source = None
         # static properties of the cut, computed once
         self._stat_keys = set(self.net.stat_keys())
+        # a BN-style running stat shared across stages (ParamSpec name)
+        # only persists its HOME stage's forward refresh; the other
+        # stages' refreshes are discarded, so their contribution trains on
+        # stale statistics — warn at construction, when the cut is chosen
+        # (ADVICE r2; mirrors the Filter taint warning pattern)
+        shared_stats = [k for k in self._stat_keys
+                        if sum(k in keys for keys in self._stage_keys) > 1]
+        if shared_stats:
+            import warnings
+
+            warnings.warn(
+                f"running-stat params {sorted(shared_stats)} are shared "
+                f"across pipeline stages; only the home stage's forward "
+                f"refresh persists — non-home uses see stale statistics. "
+                f"Re-cut the pipeline so each stat param stays within one "
+                f"stage.", stacklevel=2)
         self._keeps = [self._carry_blobs(s) for s in range(n_stages)]
         self._loss_stage: Dict[str, int] = {}
         for st, idxs in enumerate(self.stage_layers):

@@ -177,6 +177,26 @@ def make_single_step(net: Net, sp: SolverParameter,
     return single_step
 
 
+def accumulate_test_outputs(totals: Dict[str, float],
+                            outs: Dict[str, Any]) -> Dict[str, float]:
+    """Accumulate one test batch's output blobs into `totals`, one slot per
+    blob ELEMENT — the reference keeps a test_score_ entry per element of
+    every output blob and reports each index separately
+    (Solver::TestAndStoreResult, solver.cpp:414-444; Test, :435-443).
+    Scalar tops (loss, accuracy) keep their plain name; a multi-element top
+    `k` gets `k[i]` per element so per-class/vector outputs are not merged
+    into one number (ADVICE r2)."""
+    for k, v in outs.items():
+        arr = np.asarray(v).ravel()
+        if arr.size == 1:
+            totals[k] = totals.get(k, 0.0) + float(arr[0])
+        else:
+            for i, x in enumerate(arr):
+                key = f"{k}[{i}]"
+                totals[key] = totals.get(key, 0.0) + float(x)
+    return totals
+
+
 class Solver:
     def __init__(self, solver_param: SolverParameter, *,
                  net_param: Optional[NetParameter] = None,
@@ -373,11 +393,7 @@ class Solver:
         totals: Dict[str, float] = {}
         for _ in range(n):
             outs = self._test_step(self.params, self._pull(self.test_source))
-            for k, v in outs.items():
-                # sum over blob elements: the reference accumulates every
-                # element of each output blob (solver.cpp:435-443); loss/
-                # accuracy tops are scalars so this is the identity there
-                totals[k] = totals.get(k, 0.0) + float(jnp.sum(v))
+            accumulate_test_outputs(totals, outs)
         return {k: v / n for k, v in totals.items()}
 
     def forward(self, inputs: Dict[str, Any]) -> Dict[str, jnp.ndarray]:

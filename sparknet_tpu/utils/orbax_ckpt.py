@@ -91,6 +91,13 @@ def restore(path: str, *, known_params=None,
         if unknown:
             raise ValueError(f"checkpoint has params this net lacks: "
                              f"{sorted(unknown)}")
+        # state keys feed sharding_for too (GspmdTrainer/PipelineTrainer
+        # pass dict-indexing lambdas): an orphan state entry would
+        # otherwise surface as an opaque KeyError from inside orbax
+        orphans = set(tree["state"]) - set(known_params)
+        if orphans:
+            raise ValueError(f"checkpoint has solver state for params "
+                             f"this net lacks: {sorted(orphans)}")
     if sharding_for is None:
         payload = ckpt.restore(path)
     else:
