@@ -25,7 +25,7 @@ D = "/root/reference/caffe/models/bvlc_googlenet"
 
 
 def build_step(batch, drop_aux=False, lrn_impl=None, no_lrn=False,
-               pool_to_ave=False, no_dropout=False):
+               pool_to_ave=False, no_dropout=False, fuse_1x1=False):
     if lrn_impl:
         os.environ["SPARKNET_LRN_IMPL"] = lrn_impl
     else:
@@ -48,6 +48,13 @@ def build_step(batch, drop_aux=False, lrn_impl=None, no_lrn=False,
                 l.msg.set("type", "Power")
             keep.append(l)
         npm.msg.set_list("layer", [l.msg for l in keep])
+    if fuse_1x1:
+        # inception branch fusion: the three same-bottom 1x1 convs of each
+        # module become one channel-concatenated GEMM + Slice (core/fuse.py)
+        from sparknet_tpu.core.fuse import fuse_sibling_1x1_convs
+
+        npm, _map, groups = fuse_sibling_1x1_convs(npm)
+        assert groups, "expected inception 1x1 groups to fuse"
     net = Net(npm, "TRAIN", batch_override=batch)
     sp = caffe_pb.load_solver_prototxt(D + "/solver.prototxt")
     params = net.init_params(0)
@@ -98,6 +105,10 @@ def main():
         ("baseline_b256", 256, dict()),
         ("maxpool_to_ave_b64", 64, dict(pool_to_ave=True)),
         ("no_dropout_b64", 64, dict(no_dropout=True)),
+        # round 3: inception 1x1 branch fusion (GOOGLENET_PROFILE.md)
+        ("fused_1x1_b64", 64, dict(fuse_1x1=True)),
+        ("fused_1x1_b128", 128, dict(fuse_1x1=True)),
+        ("fused_1x1_no_aux_b64", 64, dict(fuse_1x1=True, drop_aux=True)),
     ]
     only = set(sys.argv[1:])
     if only:

@@ -1,0 +1,120 @@
+"""Analytic MXU padding audit: where GoogLeNet's FLOPs land vs what the
+systolic array must actually burn (GOOGLENET_PROFILE.md round-3
+attribution; VERDICT r2 weak-item 1).
+
+The inception channel counts (16, 24, 32, 48, 96, 112, 144, 160, 208...)
+are not multiples of the MXU's 128 lanes, so each branch GEMM pads its
+contraction (C·KH·KW) and output-channel (O) dimensions up to hardware
+tiles.  This audit walks every Convolution/InnerProduct of a net, models
+each as the GEMM XLA lowers it to — M = batch·OH·OW spatial rows,
+K = C·KH·KW, N = O — rounds each dimension to the (8,128)-f32 /
+(16,128)-bf16 tile grid, and reports true vs padded MACs per layer and
+in aggregate.  It is a static model (XLA may choose other strategies for
+specific convs), so the numbers are an attribution guide, not a
+measurement; the measured step-time table in GOOGLENET_PROFILE.md is the
+ground truth this decomposes.
+
+Run:  python scripts/mxu_padding_audit.py [--model googlenet|alexnet]
+      [--batch 64] [--fused] [--bf16]
+One JSON line per layer plus a summary line.
+"""
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+MODEL_DIRS = {
+    "googlenet": "/root/reference/caffe/models/bvlc_googlenet",
+    "alexnet": "/root/reference/caffe/models/bvlc_alexnet",
+}
+CROP = {"googlenet": 224, "alexnet": 227}
+
+
+def _ceil_to(x: int, q: int) -> int:
+    return -(-x // q) * q
+
+
+def audit(model: str, batch: int, fused: bool, bf16: bool):
+    from sparknet_tpu.core.net import Net
+    from sparknet_tpu.proto import caffe_pb
+
+    npm = caffe_pb.load_net_prototxt(
+        os.path.join(MODEL_DIRS[model], "train_val.prototxt"))
+    npm = caffe_pb.replace_data_layers(npm, batch, batch, 3, CROP[model],
+                                       CROP[model])
+    if fused:
+        from sparknet_tpu.core.fuse import fuse_sibling_1x1_convs
+
+        npm, _m, groups = fuse_sibling_1x1_convs(npm)
+    net = Net(npm, "TRAIN", batch_override=batch)
+
+    # MXU tile grid: minor dim 128 lanes; second-minor 8 sublanes for f32,
+    # 16 for bf16 (the packing the vector memory hands the MXU)
+    sub = 16 if bf16 else 8
+    rows = []
+    tot_true = tot_pad = 0
+    for i, layer in enumerate(net.layers):
+        lt = str(npm.layers[i].type) if i < len(npm.layers) else ""
+        bl = layer
+        if bl.type not in ("Convolution", "InnerProduct"):
+            continue
+        out_shape = net.blob_shapes[bl.tops[0]]
+        if bl.type == "Convolution":
+            cp = npm.layers[net.layer_index(bl.name)].convolution_param \
+                if hasattr(net, "layer_index") else None
+        # derive GEMM dims from param + blob shapes (robust to layer kind)
+        w_shape = net.param_inits[bl.param_keys[0]].shape
+        if bl.type == "Convolution":
+            o, cin, kh, kw = w_shape
+            n, _, oh, ow = out_shape
+            m_dim, k_dim, n_dim = n * oh * ow, cin * kh * kw, o
+        else:
+            o, k_dim = w_shape
+            m_dim, n_dim = out_shape[0], o
+        true = m_dim * k_dim * n_dim
+        padded = (_ceil_to(m_dim, sub) * _ceil_to(k_dim, sub)
+                  * _ceil_to(n_dim, 128))
+        # K feeds the lane dim of the LHS too; model K to 128 as well for
+        # the stationary operand
+        padded = max(padded, _ceil_to(m_dim, sub) * _ceil_to(k_dim, 128)
+                     * _ceil_to(n_dim, 128))
+        tot_true += true
+        tot_pad += padded
+        rows.append(dict(layer=bl.name, type=bl.type,
+                         gemm=[m_dim, k_dim, n_dim],
+                         true_gmacs=round(true / 1e9, 3),
+                         padded_gmacs=round(padded / 1e9, 3),
+                         mxu_utilization=round(true / padded, 3)))
+    return rows, tot_true, tot_pad
+
+
+def main() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("--model", default="googlenet", choices=list(MODEL_DIRS))
+    p.add_argument("--batch", type=int, default=64)
+    p.add_argument("--fused", action="store_true",
+                   help="audit after fuse_sibling_1x1_convs")
+    p.add_argument("--bf16", action="store_true", default=True)
+    p.add_argument("--per-layer", action="store_true")
+    a = p.parse_args()
+
+    rows, tot_true, tot_pad = audit(a.model, a.batch, a.fused, a.bf16)
+    if a.per_layer:
+        for r in sorted(rows, key=lambda r: r["padded_gmacs"],
+                        reverse=True):
+            print(json.dumps(r))
+    worst = sorted(rows, key=lambda r: r["mxu_utilization"])[:8]
+    print(json.dumps(dict(
+        event="summary", model=a.model, batch=a.batch, fused=a.fused,
+        n_gemm_layers=len(rows),
+        true_gmacs=round(tot_true / 1e9, 1),
+        padded_gmacs=round(tot_pad / 1e9, 1),
+        aggregate_mxu_utilization=round(tot_true / tot_pad, 3),
+        worst_layers=[(r["layer"], r["mxu_utilization"]) for r in worst])))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,103 @@
+"""fuse_sibling_1x1_convs: the inception branch-fusion graph rewrite
+(GOOGLENET_PROFILE round-3 experiment; reference model:
+caffe/models/bvlc_googlenet/train_val.prototxt inception 1x1/3x3_reduce/
+5x5_reduce branches reading one bottom)."""
+
+import numpy as np
+import pytest
+
+from sparknet_tpu.core.fuse import fuse_sibling_1x1_convs
+from sparknet_tpu.core.net import Net
+from sparknet_tpu.proto import caffe_pb
+
+MINI = """
+name: "mini_inception"
+input: "data"
+input_shape { dim: 2 dim: 8 dim: 6 dim: 6 }
+layer { name: "b1" type: "Convolution" bottom: "data" top: "b1"
+  convolution_param { num_output: 4 kernel_size: 1
+    weight_filler { type: "gaussian" std: 0.1 } } }
+layer { name: "b2" type: "Convolution" bottom: "data" top: "b2"
+  convolution_param { num_output: 3 kernel_size: 1
+    weight_filler { type: "gaussian" std: 0.1 } } }
+layer { name: "b3" type: "Convolution" bottom: "data" top: "b3"
+  convolution_param { num_output: 5 kernel_size: 1
+    weight_filler { type: "gaussian" std: 0.1 } } }
+layer { name: "r1" type: "ReLU" bottom: "b1" top: "b1" }
+layer { name: "c2" type: "Convolution" bottom: "b2" top: "c2"
+  convolution_param { num_output: 2 kernel_size: 3 pad: 1
+    weight_filler { type: "gaussian" std: 0.1 } } }
+layer { name: "cat" type: "Concat" bottom: "b1" bottom: "c2" bottom: "b3"
+  top: "cat" }
+"""
+
+
+def test_rewrite_structure():
+    net_p = caffe_pb.parse_net_text(MINI)
+    fused_p, _map, groups = fuse_sibling_1x1_convs(net_p)
+    assert groups == [["b1", "b2", "b3"]]
+    types = [str(l.type) for l in fused_p.layers]
+    # one fused conv + one slice replace the three convs
+    assert types.count("Convolution") == 2  # fused + the 3x3 c2
+    assert types.count("Slice") == 1
+    sl = [l for l in fused_p.layers if str(l.type) == "Slice"][0]
+    assert [str(t) for t in sl.tops] == ["b1", "b2", "b3"]
+    assert sl.slice_param.slice_points == [4, 7]
+
+
+def test_fused_forward_matches_original():
+    """The rewrite is arithmetic-exact: mapped params produce identical
+    activations through ReLU/3x3/Concat consumers."""
+    import jax.numpy as jnp
+
+    net_p = caffe_pb.parse_net_text(MINI)
+    fused_p, map_params, groups = fuse_sibling_1x1_convs(net_p)
+    net0 = Net(net_p, "TEST")
+    net1 = Net(fused_p, "TEST")
+    p0 = net0.init_params(0)
+    p1 = {k: jnp.asarray(v) for k, v in map_params(
+        {k: np.asarray(v) for k, v in p0.items()}).items()}
+    assert set(p1) == set(net1.init_params(0))
+    x = jnp.asarray(np.random.RandomState(0).randn(2, 8, 6, 6)
+                    .astype(np.float32))
+    y0 = np.asarray(net0.forward(p0, {"data": x})["cat"])
+    y1 = np.asarray(net1.forward(p1, {"data": x})["cat"])
+    np.testing.assert_allclose(y0, y1, rtol=1e-6, atol=1e-6)
+
+
+def test_no_fusion_when_geometry_differs():
+    """Different stride/bottom/kernel never fuse."""
+    net_p = caffe_pb.parse_net_text("""
+name: "nofuse"
+input: "data"
+input_shape { dim: 1 dim: 4 dim: 8 dim: 8 }
+layer { name: "a" type: "Convolution" bottom: "data" top: "a"
+  convolution_param { num_output: 2 kernel_size: 1 stride: 2 } }
+layer { name: "b" type: "Convolution" bottom: "data" top: "b"
+  convolution_param { num_output: 2 kernel_size: 1 } }
+layer { name: "c" type: "Convolution" bottom: "b" top: "c"
+  convolution_param { num_output: 2 kernel_size: 1 } }
+""")
+    fused_p, _map, groups = fuse_sibling_1x1_convs(net_p)
+    assert groups == []
+    assert fused_p is net_p
+
+
+def test_googlenet_fuses_nine_inception_groups():
+    """Every bvlc_googlenet inception module's three same-bottom 1x1
+    convs fuse (9 modules); the fused TRAIN net still builds and keeps
+    its parameter count."""
+    net_p = caffe_pb.load_net_prototxt(
+        "/root/reference/caffe/models/bvlc_googlenet/train_val.prototxt")
+    net_p = caffe_pb.replace_data_layers(net_p, 2, 2, 3, 224, 224)
+    fused_p, map_params, groups = fuse_sibling_1x1_convs(net_p)
+    assert len(groups) == 9
+    assert all(len(g) == 3 for g in groups)
+    net0 = Net(net_p, "TRAIN")
+    net1 = Net(fused_p, "TRAIN")
+    p0 = net0.init_params(0)
+    p1 = map_params({k: np.asarray(v) for k, v in p0.items()})
+    assert set(p1) == set(net1.init_params(0))
+    n0 = sum(int(np.prod(np.shape(v))) for v in p0.values())
+    n1 = sum(int(np.prod(np.shape(v))) for v in p1.values())
+    assert n0 == n1
