@@ -209,6 +209,53 @@ def bench_model(name, model_dir, batch, crop, n_classes=1000):
     return out
 
 
+def bench_cifar_e2e(rounds: int = 6, tau: int = 100,
+                    prefetch: bool = True) -> float:
+    """Sustained HOST-FED CIFAR training throughput, prefetch on — the
+    one honest end-to-end figure this box resolves (small batches
+    amortize the tunnel's per-RPC floor; ACCURACY.md measured 1,214 img/s
+    on this path).  Emitting it as a driver-tracked field makes feed-path
+    regressions visible in BENCH_r* records (VERDICT r2 item 7).
+
+    Shape of the run: the reference cifar10_quick recipe (batch 100) as
+    one τ-step compiled round per device call, fed by a round-agnostic
+    host stream (so set_prefetch's one-round-look-ahead is safe), fresh
+    batches pulled and shipped every round."""
+    import numpy as np
+
+    from sparknet_tpu.apps.cifar_app import build_solver
+
+    batch = 100  # the reference cifar10_quick batch; ties feed + formula
+    solver = build_solver("quick", 1, tau, batch_size=batch)
+    rng = np.random.RandomState(0)
+    pool_x = rng.randint(0, 256, size=(10000, 3, 32, 32)).astype(np.uint8)
+    pool_y = rng.randint(0, 10, size=10000).astype(np.int32)
+    mean = pool_x.mean(axis=0).astype(np.float32)
+
+    class StreamFeed:
+        # cycling host stream; stream_safe by construction (no per-round
+        # window), so prefetch staging one round ahead is exact
+        stream_safe = True
+
+        def __init__(self):
+            self.i = 0
+
+        def __call__(self):
+            sel = (np.arange(batch) + self.i * batch) % len(pool_y)
+            self.i += 1
+            return {"data": pool_x[sel].astype(np.float32) - mean,
+                    "label": pool_y[sel]}
+
+    solver.set_train_data([StreamFeed()])
+    solver.set_prefetch(prefetch)  # scripts/prefetch_delta.py flips this
+    solver.run_round()  # compile + warm
+    t0 = time.perf_counter()
+    for r in range(rounds):
+        solver.run_round(prefetch_next=r < rounds - 1)
+    dt = time.perf_counter() - t0
+    return rounds * tau * batch / dt
+
+
 LAST_GOOD = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                          "BENCH_LAST_GOOD.json")
 
@@ -242,7 +289,21 @@ def main() -> None:
     apply_platform_env()
     maybe_enable_compile_cache()
 
-    if not _device_responsive():
+    # bounded wait-for-health: a TRANSIENT wedge should produce a
+    # late-but-fresh measurement, not a stale replay (VERDICT r2 item 2).
+    # Total patience and poll spacing are env-tunable for the driver.
+    wait_budget = float(os.environ.get("SPARKNET_BENCH_WAIT_S", 3600))
+    poll_sleep = float(os.environ.get("SPARKNET_BENCH_POLL_SLEEP_S", 120))
+    deadline = time.time() + wait_budget
+    healthy = _device_responsive()
+    while not healthy and time.time() < deadline:
+        remain = int(deadline - time.time())
+        log(f"device unresponsive; retrying for up to {remain}s more "
+            f"(SPARKNET_BENCH_WAIT_S={wait_budget:g})")
+        time.sleep(poll_sleep)
+        healthy = _device_responsive(timeout_s=120)
+
+    if not healthy:
         # emit the most recent good measurement, loudly flagged — an
         # unreachable chip should degrade the record, not hang the driver
         log("DEVICE UNRESPONSIVE: emitting last good result as stale")
@@ -264,6 +325,8 @@ def main() -> None:
     goog128 = bench_model(
         "googlenet", "/root/reference/caffe/models/bvlc_googlenet", 128,
         224)
+    cifar_e2e = bench_cifar_e2e()
+    log(json.dumps({"cifar_e2e_imgs_per_sec": round(cifar_e2e, 1)}))
 
     result = {
         "metric": "alexnet_train_imgs_per_sec",
@@ -283,6 +346,7 @@ def main() -> None:
         "googlenet_b128_imgs_per_sec":
             goog128["device_resident_imgs_per_sec"],
         "googlenet_b128_mfu": goog128["mfu"],
+        "cifar_e2e_imgs_per_sec": round(cifar_e2e, 1),
     }
     print(json.dumps(result))
     try:

@@ -1,0 +1,55 @@
+"""Measure the set_prefetch double-buffering win on the sustained host-fed
+CIFAR path (VERDICT r2 item 10).
+
+The claim "round N+1's host pulls and transfers overlap round N's device
+execution" (parallel/dist.py set_prefetch; role model: the reference's
+measured triple buffering, base_data_layer.cpp:70-98) had functional tests
+but no timing evidence.  This script runs the bench's cifar_e2e leg with
+prefetch ON and OFF, interleaved several times (A/B/A/B... to decorrelate
+tunnel drift), and prints per-run and median rates.  On a single-core host
+the overlap may be a wash — if so the numbers say that.
+
+Run: python scripts/prefetch_delta.py [--runs 3] [--rounds 6] [--tau 100]
+"""
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("--runs", type=int, default=3)
+    p.add_argument("--rounds", type=int, default=6)
+    p.add_argument("--tau", type=int, default=100)
+    a = p.parse_args()
+
+    from sparknet_tpu.utils.compile_cache import (apply_platform_env,
+                                                  maybe_enable_compile_cache)
+
+    apply_platform_env()
+    maybe_enable_compile_cache()
+    import numpy as np
+
+    import bench
+
+    on, off = [], []
+    for i in range(a.runs):
+        r_on = bench.bench_cifar_e2e(a.rounds, a.tau, prefetch=True)
+        r_off = bench.bench_cifar_e2e(a.rounds, a.tau, prefetch=False)
+        on.append(r_on)
+        off.append(r_off)
+        print(json.dumps(dict(run=i, prefetch_on=round(r_on, 1),
+                              prefetch_off=round(r_off, 1))), flush=True)
+    m_on, m_off = float(np.median(on)), float(np.median(off))
+    print(json.dumps(dict(event="summary", runs=a.runs,
+                          median_on=round(m_on, 1),
+                          median_off=round(m_off, 1),
+                          delta_pct=round(100 * (m_on / m_off - 1), 1))))
+
+
+if __name__ == "__main__":
+    main()
