@@ -31,6 +31,15 @@ deliberate trade: stages keep their natural, heterogeneous activation
 shapes (conv nets shrink spatially) with no padded uniform buffers, at the
 cost of O(S*M) dispatches per round — fine when microbatches are large, the
 regime PP exists for.
+
+STATUS: an ALGORITHMIC REFERENCE, not a performance path (VERDICT r2).
+The exact-equivalence tests make it the executable specification of the
+GPipe schedule against which a compiled implementation can be checked;
+production-scale pipelining (deep S, many microbatches, per-hop latency
+hidden) wants the schedule inside ONE compiled program — a shard_map over
+a `pipe` mesh axis with `ppermute` activation hops and a rolled
+microbatch loop — which trades the heterogeneous-shape freedom this
+implementation keeps.  Use gspmd.py (DP×TP) or dist.py for perf today.
 """
 
 from __future__ import annotations
