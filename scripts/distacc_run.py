@@ -26,6 +26,8 @@ Run:  JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
       python scripts/distacc_run.py [--points 1:1,1:10,4:1,4:10,8:1,8:10]
       [--iters 1000] [--full-point 8:10] [--full-iters 4000]
       [--full-lr1-iters 1000] [--out distacc.jsonl]
+A tau of "sync" (e.g. 8:sync, valid in --points and --full-point) runs
+per-step gradient pmean (mode="sync") instead of tau-averaging.
 Emits one JSON line per test mark; DISTACC.md holds the analyzed table.
 """
 
@@ -40,16 +42,21 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import numpy as np
 
 
-def run_point(nw: int, tau: int, iters: int, xtr, ytr, test_batches,
+def run_point(nw: int, tau, iters: int, xtr, ytr, test_batches,
               mean, emit, *, test_interval: int, num_test_batches: int,
               lr1_iters: int = 0) -> float:
-    """Train one (n_workers, τ) configuration; returns final accuracy."""
+    """Train one (n_workers, τ) configuration; returns final accuracy.
+    tau="sync" selects per-step gradient pmean (mode="sync", the
+    P2PSync analogue) instead of τ-step weight averaging."""
     from sparknet_tpu.apps.cifar_app import WorkerFeed, build_solver
     from sparknet_tpu.data import partition as part
 
+    mode = "sync" if tau == "sync" else "average"
+    if mode == "sync":
+        tau = 1
     # scan_unroll=True: XLA:CPU loses its fast conv kernels inside scan
     # bodies (dist.py docstring); unrolling the τ loop is ~10x here
-    solver = build_solver("quick", nw, tau, scan_unroll=True)
+    solver = build_solver("quick", nw, tau, scan_unroll=True, mode=mode)
     shards = part.partition(xtr, ytr, nw)
     feeds = [WorkerFeed(x, y, mean, 100, tau, seed=100 + w)
              for w, (x, y) in enumerate(shards)]
@@ -76,7 +83,8 @@ def run_point(nw: int, tau: int, iters: int, xtr, ytr, test_batches,
                 state["i"] = 0
                 scores = solver.test()
                 acc = float(scores.get("accuracy", 0.0))
-                emit(dict(event="test", n_workers=nw, tau=tau, stage=stage,
+                emit(dict(event="test", n_workers=nw,
+                  tau=("sync" if mode == "sync" else tau), stage=stage,
                           round=solver.round, iter=solver.iter,
                           images=solver.iter * 100 * nw,
                           loss=round(float(loss), 4),
@@ -97,7 +105,9 @@ def run_point(nw: int, tau: int, iters: int, xtr, ytr, test_batches,
 def main() -> None:
     p = argparse.ArgumentParser()
     p.add_argument("--points", default="1:1,1:10,4:1,4:10,8:1,8:10",
-                   help="comma-separated n_workers:tau grid")
+                   help="comma-separated n_workers:tau grid; tau may be "
+                        "'sync' for per-step gradient pmean (mode=sync, "
+                        "the P2PSync analogue), e.g. 8:sync")
     p.add_argument("--iters", type=int, default=1000,
                    help="per-worker iterations per grid point")
     p.add_argument("--test-interval", type=int, default=100)
@@ -143,9 +153,13 @@ def main() -> None:
               n_devices=len(jax.devices()),
               data_gen_s=round(time.time() - t0, 1), bayes_ceiling=0.91))
 
+    def parse_spec(spec):
+        nw_s, tau_s = spec.split(":")
+        return int(nw_s), ("sync" if tau_s == "sync" else int(tau_s))
+
     finals = {}
     for spec in [s for s in a.points.split(",") if s]:
-        nw, tau = (int(x) for x in spec.split(":"))
+        nw, tau = parse_spec(spec)
         t0 = time.time()
         acc = run_point(nw, tau, a.iters, xtr, ytr, test_batches, mean,
                         emit, test_interval=a.test_interval,
@@ -156,7 +170,7 @@ def main() -> None:
                   wall_s=round(time.time() - t0, 1)))
 
     if a.full_point:
-        nw, tau = (int(x) for x in a.full_point.split(":"))
+        nw, tau = parse_spec(a.full_point)
         t0 = time.time()
         acc = run_point(nw, tau, a.full_iters, xtr, ytr, test_batches,
                         mean, emit, test_interval=500,

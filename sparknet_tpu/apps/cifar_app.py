@@ -74,9 +74,11 @@ def build_solver(model: str, n_workers: int, tau: int, mesh=None,
                  proto_dir: str = REFERENCE_PROTO_DIR,
                  batch_size: int = TRAIN_BATCH_SIZE,
                  dcn_interval: int = 1,
-                 scan_unroll=1) -> DistributedSolver:
+                 scan_unroll=1, mode: str = "average") -> DistributedSolver:
     """ProtoLoader flow (CifarApp.scala:81-89): net prototxt ->
-    replaceDataLayers -> solver-with-inline-net -> instantiate."""
+    replaceDataLayers -> solver-with-inline-net -> instantiate.
+    mode="sync" selects per-step gradient pmean (the P2PSync analogue)
+    instead of τ-averaging."""
     net = caffe_pb.load_net_prototxt(
         os.path.join(proto_dir, f"cifar10_{model}_train_test.prototxt"))
     net = caffe_pb.replace_data_layers(net, batch_size, batch_size,
@@ -84,7 +86,7 @@ def build_solver(model: str, n_workers: int, tau: int, mesh=None,
     sp = caffe_pb.load_solver_prototxt_with_net(
         os.path.join(proto_dir, f"cifar10_{model}_solver.prototxt"), net)
     return DistributedSolver(sp, n_workers=n_workers, tau=tau, mesh=mesh,
-                             dcn_interval=dcn_interval,
+                             dcn_interval=dcn_interval, mode=mode,
                              scan_unroll=scan_unroll)
 
 
