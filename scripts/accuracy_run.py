@@ -87,7 +87,18 @@ def main() -> None:
     p.add_argument("--easy", action="store_true",
                    help="use the apps' easy synthetic set instead")
     p.add_argument("--out", default="")
+    p.add_argument("--snapshot", default="",
+                   help="native-snapshot path written after every test "
+                        "point; with --resume, restart from it (long runs "
+                        "survive tunnel drops)")
+    p.add_argument("--resume", action="store_true",
+                   help="restore --snapshot if it exists and continue; "
+                        "appends to --out")
     a = p.parse_args()
+    if a.snapshot and not a.snapshot.endswith(".npz"):
+        # np.savez appends .npz on write; anything else (esp. .h5, which
+        # restore() would dispatch to the HDF5 parser) breaks resume
+        p.error("--snapshot must end in .npz")
     # reference budgets: quick 4k+1k (cifar10_quick_solver*.prototxt),
     # full 60k+5k+5k (cifar10_full_solver*.prototxt)
     defaults = {"quick": (4000, 1000, 0), "full": (60000, 5000, 5000)}
@@ -121,11 +132,41 @@ def main() -> None:
     mean = xtr.astype(np.float64).mean(axis=0).astype(np.float32)
     gen_s = time.time() - t0
 
-    results = []
+    resuming = bool(a.resume and a.snapshot and os.path.exists(a.snapshot))
+    run_config = dict(model=a.model, tau=a.tau, amplitude=a.amplitude,
+                      label_noise=a.label_noise, easy=a.easy,
+                      iters=a.iters, lr1_iters=a.lr1_iters,
+                      lr2_iters=a.lr2_iters)
+    meta_path = a.snapshot + ".meta.json" if a.snapshot else ""
+    if resuming and os.path.exists(meta_path):
+        with open(meta_path) as f:
+            saved = json.load(f)
+        # iteration budgets may legitimately be extended between attempts;
+        # everything else desyncs the data stream or the stage math
+        for k in ("model", "tau", "amplitude", "label_noise", "easy"):
+            if saved.get(k) != run_config[k]:
+                sys.exit(f"--resume config mismatch: snapshot was taken "
+                         f"with {k}={saved.get(k)!r}, this run has "
+                         f"{run_config[k]!r}")
+    if a.out and not resuming and os.path.exists(a.out):
+        # fresh start: drop any previous run's lines — a stale "summary"
+        # row would satisfy run_until_done.sh's completion check
+        os.unlink(a.out)
+    if a.out and resuming and os.path.exists(a.out):
+        # a kill -9 can tear the last line mid-write; drop the fragment so
+        # appended rows stay line-parseable
+        with open(a.out, "rb+") as f:
+            data = f.read()
+            if data and not data.endswith(b"\n"):
+                f.truncate(data.rfind(b"\n") + 1)
 
     def emit(obj):
-        results.append(obj)
         print(json.dumps(obj), flush=True)
+        if a.out:
+            # stream, don't buffer: a 90-min run that dies mid-way must
+            # leave its curve on disk
+            with open(a.out, "a") as f:
+                f.write(json.dumps(obj) + "\n")
 
     ceiling = (1.0 if a.easy
                else (1 - a.label_noise) + a.label_noise / 10)
@@ -150,8 +191,28 @@ def main() -> None:
 
     solver.set_test_data(test_source, len(test_batches))
 
-    def run_stage(stage: str, iters: int) -> None:
-        rounds = iters // a.tau
+    start_iter = 0
+    if resuming:
+        solver.restore(a.snapshot)
+        start_iter = solver.iter
+        feed.fast_forward(solver.iter // a.tau, pulls_per_round=a.tau)
+        emit(dict(event="resume", iter=solver.iter, snapshot=a.snapshot))
+
+    def save_snapshot() -> None:
+        if not a.snapshot:
+            return
+        tmp = solver.snapshot(a.snapshot + ".tmp")
+        os.replace(tmp, a.snapshot)  # atomic: a mid-write kill keeps the old
+        with open(meta_path, "w") as f:
+            json.dump(run_config, f)
+
+    def run_stage(stage: str, start: int, iters: int) -> None:
+        # `start`..`start+iters` in global iterations; on resume, rounds
+        # already recorded in the snapshot are skipped
+        end = start + iters
+        if solver.iter >= end:
+            return
+        rounds = (end - solver.iter) // a.tau
         for r in range(rounds):
             feed.new_round()
             t = time.time()
@@ -164,34 +225,38 @@ def main() -> None:
                           accuracy=round(float(scores.get("accuracy", 0)), 4),
                           test_loss=round(float(scores.get("loss", 0)), 4),
                           round_s=round(dt, 2)))
+                save_snapshot()
 
     base_lr = float(solver.param.base_lr)
     wall0 = time.time()
-    run_stage(f"lr{base_lr:g}", a.iters)
+    run_stage(f"lr{base_lr:g}", 0, a.iters)
     stage1_s = time.time() - wall0
 
-    if a.lr1_iters:
+    if a.lr1_iters and solver.iter < a.iters + a.lr1_iters:
         # the reference's stage 2: resume at lr/10
         # (cifar10_{quick,full}_solver_lr1.prototxt)
         solver.param.msg.set("base_lr", base_lr / 10)
         solver._round_fns.clear()  # recompile with the new LR constant
-        run_stage(f"lr{base_lr / 10:g}", a.lr1_iters)
-    if a.lr2_iters:
+        run_stage(f"lr{base_lr / 10:g}", a.iters, a.lr1_iters)
+    if a.lr2_iters and solver.iter < a.iters + a.lr1_iters + a.lr2_iters:
         # cifar10_full stage 3: lr/100 (cifar10_full_solver_lr2.prototxt)
         solver.param.msg.set("base_lr", base_lr / 100)
         solver._round_fns.clear()
-        run_stage(f"lr{base_lr / 100:g}", a.lr2_iters)
+        run_stage(f"lr{base_lr / 100:g}", a.iters + a.lr1_iters, a.lr2_iters)
     total_s = time.time() - wall0
 
     final = solver.test()
-    imgs = (a.iters + a.lr1_iters + a.lr2_iters) * 100
+    # throughput over THIS invocation's work only — a resumed run's wall
+    # clock covers just the remaining iterations
+    imgs = (a.iters + a.lr1_iters + a.lr2_iters - start_iter) * 100
     emit(dict(event="summary",
               final_accuracy=round(float(final.get("accuracy", 0)), 4),
               iters=a.iters + a.lr1_iters + a.lr2_iters,
+              resumed_from_iter=start_iter,
               model=a.model,
               wall_clock_s=round(total_s, 1),
               stage1_s=round(stage1_s, 1),
-              train_imgs_per_s=round(imgs / total_s, 1),
+              train_imgs_per_s=round(imgs / max(total_s, 1e-9), 1),
               reference_baseline=(
                   "~75% @ 4k iters on real CIFAR-10 "
                   "(caffe/examples/cifar10/readme.md:81)" if a.model ==
@@ -199,10 +264,6 @@ def main() -> None:
                   "~81-82% @ 70k iters on real CIFAR-10 "
                   "(caffe/examples/cifar10/readme.md sigmoid discussion; "
                   "cifar10_full_solver*.prototxt budgets)")))
-    if a.out:
-        with open(a.out, "w") as f:
-            for row in results:
-                f.write(json.dumps(row) + "\n")
 
 
 if __name__ == "__main__":

@@ -1,0 +1,45 @@
+#!/bin/bash
+# Drive a long accuracy_run.py protocol to completion across axon-tunnel
+# windows (BENCH_NOTES.md: the tunnel serves a bounded window after a
+# reboot, then the relay exits; a 90-min run needs more than one window).
+#
+#   scripts/run_until_done.sh OUT_JSONL SNAPSHOT_NPZ [accuracy_run args...]
+#
+# Each attempt runs with --out OUT --snapshot SNAP --resume; a watchdog
+# kills the attempt if OUT stops growing for STALL_S seconds (covers both
+# hang-style and die-style tunnel failures), then the loop retries — the
+# snapshot written after every test point makes retries bit-exact resumes
+# (verified: kill-and-resume reproduces the uninterrupted run).
+set -u
+cd "$(dirname "$0")/.."   # accuracy_run.py is invoked repo-relative
+OUT=$1; SNAP=$2; shift 2
+STALL_S=${STALL_S:-900}
+MAX_TRIES=${MAX_TRIES:-48}
+RETRY_SLEEP=${RETRY_SLEEP:-120}
+
+for try in $(seq 1 "$MAX_TRIES"); do
+    echo "[run_until_done] attempt $try $(date -u +%FT%TZ)" >&2
+    attempt_start=$(date +%s)
+    python scripts/accuracy_run.py --out "$OUT" --snapshot "$SNAP" --resume "$@" &
+    PID=$!
+    while kill -0 "$PID" 2>/dev/null; do
+        sleep 60
+        ref=$( [ -f "$OUT" ] && stat -c %Y "$OUT" || echo 0 )
+        now=$(date +%s)
+        # floor at this attempt's start: OUT's mtime from a previous
+        # stall-killed attempt must not condemn a fresh retry mid-compile
+        [ "$ref" -lt "$attempt_start" ] && ref=$attempt_start
+        if [ $((now - ref)) -gt "$STALL_S" ]; then
+            echo "[run_until_done] stalled >${STALL_S}s, killing $PID" >&2
+            kill -9 "$PID" 2>/dev/null
+        fi
+    done
+    wait "$PID" 2>/dev/null
+    if [ -f "$OUT" ] && grep -q '"event": "summary"' "$OUT"; then
+        echo "[run_until_done] complete after $try attempt(s)" >&2
+        exit 0
+    fi
+    sleep "$RETRY_SLEEP"
+done
+echo "[run_until_done] gave up after $MAX_TRIES attempts" >&2
+exit 1

@@ -107,6 +107,17 @@ class WorkerFeed:
         self._served = 0
         self._window = 0
 
+    def fast_forward(self, n_rounds: int, pulls_per_round: int) -> None:
+        """Advance the seed stream past `n_rounds` completed rounds of
+        `pulls_per_round` __call__s each, for bit-exact resume from a
+        snapshot.  Kept HERE because it must mirror this class's draw
+        pattern: one randint in new_round plus one per mid-round window
+        reopen in __call__ — i.e. ceil(pulls/window) per round."""
+        window = min(self.tau, len(self.batches))
+        draws = -(-pulls_per_round // window)
+        for _ in range(n_rounds * draws):
+            self.rng.randint(0, 2 ** 31)
+
     def new_round(self):
         # a shard can hold fewer batches than τ (tiny/synthetic datasets on
         # many workers): the window clamps to the shard and __call__ opens a

@@ -70,3 +70,31 @@ def test_random_init_accuracy_is_chance():
     solver.set_test_data(src, 20)
     acc = solver.test()["accuracy"]
     assert 0.07 <= acc <= 0.13, acc
+
+
+def test_worker_feed_fast_forward_matches_live_rounds():
+    """fast_forward(R, pulls) must leave the seed stream exactly where R
+    live rounds of `pulls` __call__s leave it — including the τ>shard case
+    where __call__ reopens the window mid-round (the bit-exact-resume
+    contract scripts/accuracy_run.py --resume relies on)."""
+    from sparknet_tpu.apps.cifar_app import WorkerFeed
+
+    rng = np.random.RandomState(3)
+    imgs = rng.randint(0, 255, (12, 3, 32, 32)).astype(np.uint8)
+    labels = rng.randint(0, 10, (12,)).astype(np.int32)
+    mean = np.zeros((3, 32, 32), np.float32)
+
+    for tau, pulls in [(3, 3), (10, 10)]:  # window==shard(3) and τ>shard
+        live = WorkerFeed(imgs, labels, mean, batch_size=4, tau=tau, seed=7)
+        for _ in range(4):
+            live.new_round()
+            for _ in range(pulls):
+                live()
+        ffwd = WorkerFeed(imgs, labels, mean, batch_size=4, tau=tau, seed=7)
+        ffwd.fast_forward(4, pulls_per_round=pulls)
+        live.new_round()
+        ffwd.new_round()
+        for _ in range(pulls):
+            a, b = live(), ffwd()
+            np.testing.assert_array_equal(a["data"], b["data"])
+            np.testing.assert_array_equal(a["label"], b["label"])
