@@ -203,8 +203,9 @@ def main() -> None:
             return
         tmp = solver.snapshot(a.snapshot + ".tmp")
         os.replace(tmp, a.snapshot)  # atomic: a mid-write kill keeps the old
-        with open(meta_path, "w") as f:
+        with open(meta_path + ".tmp", "w") as f:
             json.dump(run_config, f)
+        os.replace(meta_path + ".tmp", meta_path)
 
     def run_stage(stage: str, start: int, iters: int) -> None:
         # `start`..`start+iters` in global iterations; on resume, rounds

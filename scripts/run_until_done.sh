@@ -11,8 +11,8 @@
 # snapshot written after every test point makes retries bit-exact resumes
 # (verified: kill-and-resume reproduces the uninterrupted run).
 set -u
+OUT=$(realpath -m "$1"); SNAP=$(realpath -m "$2"); shift 2
 cd "$(dirname "$0")/.."   # accuracy_run.py is invoked repo-relative
-OUT=$1; SNAP=$2; shift 2
 STALL_S=${STALL_S:-900}
 MAX_TRIES=${MAX_TRIES:-48}
 RETRY_SLEEP=${RETRY_SLEEP:-120}

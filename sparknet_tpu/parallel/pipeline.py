@@ -32,14 +32,16 @@ shapes (conv nets shrink spatially) with no padded uniform buffers, at the
 cost of O(S*M) dispatches per round — fine when microbatches are large, the
 regime PP exists for.
 
-STATUS: an ALGORITHMIC REFERENCE, not a performance path (VERDICT r2).
-The exact-equivalence tests make it the executable specification of the
-GPipe schedule against which a compiled implementation can be checked;
-production-scale pipelining (deep S, many microbatches, per-hop latency
-hidden) wants the schedule inside ONE compiled program — a shard_map over
-a `pipe` mesh axis with `ppermute` activation hops and a rolled
-microbatch loop — which trades the heterogeneous-shape freedom this
-implementation keeps.  Use gspmd.py (DP×TP) or dist.py for perf today.
+STATUS: an ALGORITHMIC REFERENCE for heterogeneous stage cuts (VERDICT
+r2).  The exact-equivalence tests make it the executable specification of
+the GPipe schedule.  The PERFORMANCE path is
+`pipeline_compiled.CompiledPipeline`: for stage-uniform stacks (repeated
+blocks — the regime production pipelining targets) the whole schedule
+compiles to ONE program — shard_map over a `pipe` mesh axis, `ppermute`
+activation hops, a scanned tick loop, and the backward schedule derived
+by differentiating through the forward.  This module remains the general
+fallback: it alone handles stages with heterogeneous activation shapes
+(conv nets shrinking spatially) with no padded uniform buffers.
 """
 
 from __future__ import annotations

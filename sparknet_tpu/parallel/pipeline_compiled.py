@@ -1,0 +1,257 @@
+"""Compiled pipeline parallelism: the whole GPipe round as ONE XLA program.
+
+`pipeline.py` keeps the GPipe schedule on the host — one dispatch per
+(stage, microbatch) — which preserves heterogeneous per-stage shapes but
+is an algorithmic reference, not a perf path (VERDICT r2).  This module is
+the performance path for the regime pipelining actually exists for:
+S *structurally identical* stages (a stack of repeated blocks — the
+transformer/MLP-stack shape), where the schedule can live inside one
+compiled program:
+
+- the S stages' parameters are STACKED on a leading axis and sharded over
+  a `pipe` mesh axis (one stage per device), so each device holds only its
+  own stage;
+- one `lax.scan` runs the M + S - 1 schedule ticks; every tick each device
+  applies the (same) block to its in-flight microbatch and hands the
+  activation to its neighbor with `lax.ppermute` — an ICI neighbor
+  transfer on real hardware, not a host hop;
+- the BACKWARD pipeline is not hand-scheduled at all: the tick scan +
+  ppermute chain is differentiable, so `jax.grad` through the forward
+  schedule yields the reverse schedule (ppermute transposes to the
+  opposite shift; the scan transposes to the reversed scan), compiled
+  into the same program;
+- the update is the shared Caffe-exact pipeline (clip -> regularize ->
+  LR policy -> solver update, solver/updates.py) applied to the stacked
+  params outside the shard_map — elementwise, so XLA keeps it sharded in
+  place, and the global-norm clip's cross-stage reduction is one
+  compiler-inserted collective (the reference computes ONE norm over all
+  params, sgd_solver.cpp:81-100).
+
+Net cost per round: ONE dispatch (vs O(S*M)); bubble fraction stays the
+GPipe (S-1)/(M+S-1) (arXiv:1811.06965).  Microbatch inputs are replicated
+to the mesh; stage 0 selects micro `t` at tick `t`, the last stage folds
+its block output into the loss at tick `t` for micro `t-(S-1)`.  Warmup /
+drain ticks run the block on a zeroed activation and are masked out of the
+loss; the zero-fill keeps garbage (potential NaN sources) out of the
+dataflow so the masked branches cannot poison gradients via NaN * 0.
+
+Semantics match pipeline.py's: the round loss is the mean of per-micro
+mean losses, and with equal microbatches that is exactly the plain
+full-batch step (asserted against a single-device reference in
+tests/test_pipeline_compiled.py).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Dict, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, NamedSharding
+from jax.sharding import PartitionSpec as P
+
+from ..proto.caffe_pb import SolverParameter
+from ..solver import updates
+from ..solver.lr_policies import learning_rate
+from ..solver.solver import resolve_precision
+
+
+def _shard_map(fn, mesh, in_specs, out_specs):
+    from jax import shard_map
+
+    return shard_map(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                     check_vma=False)
+
+
+class CompiledPipeline:
+    """GPipe over S identical blocks, one XLA program per training round.
+
+    block_fn(params, x) -> y
+        one stage; params is a dict of arrays, x/y one microbatch of
+        activations with IDENTICAL shape/dtype (uniform stages are what
+        make the schedule compilable — heterogeneous cuts stay on
+        pipeline.PipelineTrainer).
+    loss_fn(head_params, y, labels) -> scalar
+        the head applied to the LAST stage's output; must return the MEAN
+        loss over the microbatch's items.
+    stacked_params: dict[str, Array] with leading stage axis S.
+    head_params: dict[str, Array], replicated (may be empty).
+
+    The optimizer is the framework's shared update pipeline driven by
+    `solver_param` (type/LR policy/momentum/weight decay/clip), so a
+    CompiledPipeline round updates exactly like every other trainer."""
+
+    def __init__(self, solver_param: SolverParameter, *,
+                 block_fn: Callable, loss_fn: Callable,
+                 stacked_params: Dict[str, Any],
+                 head_params: Optional[Dict[str, Any]] = None,
+                 n_micro: int, mesh: Optional[Mesh] = None,
+                 axis: str = "pipe",
+                 devices: Optional[Sequence[Any]] = None,
+                 remat: bool = True,
+                 precision: Optional[str] = None) -> None:
+        self.param = solver_param
+        self.block_fn = block_fn
+        self.loss_fn = loss_fn
+        self.n_micro = int(n_micro)
+        self.axis = axis
+        sizes = {int(v.shape[0]) for v in stacked_params.values()}
+        if len(sizes) != 1:
+            raise ValueError(f"stacked_params leading (stage) dims differ: "
+                             f"{sorted(sizes)}")
+        self.n_stages = sizes.pop()
+        if mesh is None:
+            devs = list(devices if devices is not None
+                        else jax.devices()[:self.n_stages])
+            if len(devs) < self.n_stages:
+                raise ValueError(f"need {self.n_stages} devices, have "
+                                 f"{len(devs)}")
+            mesh = Mesh(np.array(devs), (axis,))
+        if mesh.shape[axis] != self.n_stages:
+            raise ValueError(
+                f"mesh axis {axis!r} has {mesh.shape[axis]} devices but "
+                f"params stack {self.n_stages} stages")
+        self.mesh = mesh
+        self.remat = bool(remat)
+        self.precision = resolve_precision(solver_param, precision)
+
+        stage_sh = NamedSharding(mesh, P(axis))
+        repl_sh = NamedSharding(mesh, P())
+        self.stacked = {k: jax.device_put(jnp.asarray(v), stage_sh)
+                        for k, v in stacked_params.items()}
+        self.head = {k: jax.device_put(jnp.asarray(v), repl_sh)
+                     for k, v in (head_params or {}).items()}
+        solver_type = solver_param.resolved_type()
+        flat = self._flatten(self.stacked, self.head)
+        self.state = {k: tuple(
+            jax.device_put(h, stage_sh if k.startswith("stage:")
+                           else repl_sh)
+            for h in hs)
+            for k, hs in updates.init_state(flat, solver_type).items()}
+        self.iter = 0
+        self._pipe_loss = self._make_pipe_loss()
+        self._step = self._make_step()
+        self._loss_jit = jax.jit(self._pipe_loss)
+
+    # ------------------------------------------------------------- helpers
+    @staticmethod
+    def _flatten(stacked, head):
+        out = {f"stage:{k}": v for k, v in stacked.items()}
+        out.update({f"head:{k}": v for k, v in head.items()})
+        return out
+
+    @staticmethod
+    def _split(flat):
+        stacked = {k[len("stage:"):]: v for k, v in flat.items()
+                   if k.startswith("stage:")}
+        head = {k[len("head:"):]: v for k, v in flat.items()
+                if k.startswith("head:")}
+        return stacked, head
+
+    # ---------------------------------------------------------- the round
+    def _make_pipe_loss(self):
+        S, M, axis = self.n_stages, self.n_micro, self.axis
+        T = M + S - 1
+        block = (jax.checkpoint(self.block_fn) if self.remat
+                 else self.block_fn)
+        loss_fn = self.loss_fn
+        half = self.precision == "bfloat16"
+        perm = [(i, (i + 1) % S) for i in range(S)]
+
+        def pipe_loss_sharded(stacked, head, xs, ys):
+            # under shard_map: stacked leaves are [1, ...] (this device's
+            # stage); xs/ys are the full [M, mb, ...] microbatch stacks
+            params = {k: v[0] for k, v in stacked.items()}
+            if half:
+                params = {k: v.astype(jnp.bfloat16)
+                          if jnp.issubdtype(v.dtype, jnp.floating) else v
+                          for k, v in params.items()}
+                xs = (xs.astype(jnp.bfloat16)
+                      if jnp.issubdtype(xs.dtype, jnp.floating) else xs)
+            idx = lax.axis_index(axis)
+            is_first = idx == 0
+            is_last = idx == S - 1
+            act0 = jnp.zeros(xs.shape[1:], xs.dtype)
+
+            def tick(carry, t):
+                act, loss_acc = carry
+                x_feed = lax.dynamic_index_in_dim(
+                    xs, jnp.clip(t, 0, M - 1), 0, keepdims=False)
+                x = jnp.where(is_first, x_feed, act)
+                # the micro at this stage this tick is t - idx; outside
+                # [0, M) the stage is in warmup/drain — zero the input so
+                # garbage can't flow (masked-out NaNs would still poison
+                # gradients through NaN * 0)
+                active = jnp.logical_and(t >= idx, t < M + idx)
+                x = jnp.where(active, x, jnp.zeros_like(x))
+                y = block(params, x)
+                m_out = t - (S - 1)
+                labels = lax.dynamic_index_in_dim(
+                    ys, jnp.clip(m_out, 0, M - 1), 0, keepdims=False)
+                contrib = loss_fn(head, y.astype(jnp.float32), labels)
+                loss_acc = loss_acc + jnp.where(
+                    jnp.logical_and(is_last, m_out >= 0),
+                    contrib.astype(jnp.float32), 0.0)
+                act_next = lax.ppermute(y, axis, perm)
+                return (act_next, loss_acc), None
+
+            (_, loss_acc), _ = lax.scan(
+                tick, (act0, jnp.float32(0.0)), jnp.arange(T))
+            # only the last stage accumulated; psum replicates the total
+            return lax.psum(loss_acc, axis) / M
+
+        return _shard_map(
+            pipe_loss_sharded, self.mesh,
+            in_specs=(P(axis), P(), P(), P()), out_specs=P())
+
+    def _make_step(self):
+        sp = self.param
+        pipe_loss = self._pipe_loss
+        clip = float(sp.clip_gradients)
+        weight_decay = float(sp.weight_decay)
+        reg_type = str(sp.regularization_type)
+        hyper = dict(momentum=float(sp.momentum), delta=float(sp.delta),
+                     momentum2=float(sp.momentum2),
+                     rms_decay=float(sp.rms_decay))
+        solver_type = sp.resolved_type()
+
+        @functools.partial(jax.jit, donate_argnums=(0, 1))
+        def step(flat, state, it, xs, ys):
+            stacked, head = self._split(flat)
+            loss, (g_stacked, g_head) = jax.value_and_grad(
+                pipe_loss, argnums=(0, 1))(stacked, head, xs, ys)
+            grads = self._flatten(g_stacked, g_head)
+            grads = updates.clip_gradients(grads, clip)
+            grads = updates.regularize(
+                flat, grads, weight_decay,
+                {k: 1.0 for k in flat}, reg_type)
+            rate = learning_rate(sp, it)
+            new_p, new_s = updates.apply_update(
+                solver_type, flat, grads, state, rate, it,
+                lr_mults={k: 1.0 for k in flat}, **hyper)
+            return new_p, new_s, loss
+
+        return step
+
+    def step(self, xs, ys) -> float:
+        """One training round: xs/ys are [M, micro_batch, ...] stacks of
+        the round's microbatches (M = n_micro)."""
+        if xs.shape[0] != self.n_micro:
+            raise ValueError(f"xs leading dim {xs.shape[0]} != n_micro "
+                             f"{self.n_micro}")
+        flat = self._flatten(self.stacked, self.head)
+        new_p, new_s, loss = self._step(
+            flat, self.state, jnp.int32(self.iter),
+            jnp.asarray(xs), jnp.asarray(ys))
+        self.stacked, self.head = self._split(new_p)
+        self.state = new_s
+        self.iter += 1
+        return float(loss)
+
+    def loss(self, xs, ys) -> float:
+        """Forward-only round loss (no update) — for equivalence tests."""
+        return float(self._loss_jit(self.stacked, self.head,
+                                    jnp.asarray(xs), jnp.asarray(ys)))
