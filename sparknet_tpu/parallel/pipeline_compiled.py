@@ -55,7 +55,6 @@ from jax.sharding import PartitionSpec as P
 
 from ..proto.caffe_pb import SolverParameter
 from ..solver import updates
-from ..solver.lr_policies import learning_rate
 from ..solver.solver import resolve_precision
 
 
@@ -208,15 +207,16 @@ class CompiledPipeline:
             in_specs=(P(axis), P(), P(), P()), out_specs=P())
 
     def _make_step(self):
-        sp = self.param
+        from ..solver.solver import make_update_fn
+
         pipe_loss = self._pipe_loss
-        clip = float(sp.clip_gradients)
-        weight_decay = float(sp.weight_decay)
-        reg_type = str(sp.regularization_type)
-        hyper = dict(momentum=float(sp.momentum), delta=float(sp.delta),
-                     momentum2=float(sp.momentum2),
-                     rms_decay=float(sp.rms_decay))
-        solver_type = sp.resolved_type()
+        # the SHARED update pipeline (clip -> regularize -> LR -> solver
+        # update) — per-param multipliers are 1.0 because block stacks
+        # aren't Net params and carry no ParamSpec
+        ones = {k: 1.0
+                for k in self._flatten(self.stacked, self.head)}
+        update = make_update_fn(None, self.param,
+                                lr_mults=ones, decay_mults=ones)
 
         @functools.partial(jax.jit, donate_argnums=(0, 1))
         def step(flat, state, it, xs, ys):
@@ -224,14 +224,7 @@ class CompiledPipeline:
             loss, (g_stacked, g_head) = jax.value_and_grad(
                 pipe_loss, argnums=(0, 1))(stacked, head, xs, ys)
             grads = self._flatten(g_stacked, g_head)
-            grads = updates.clip_gradients(grads, clip)
-            grads = updates.regularize(
-                flat, grads, weight_decay,
-                {k: 1.0 for k in flat}, reg_type)
-            rate = learning_rate(sp, it)
-            new_p, new_s = updates.apply_update(
-                solver_type, flat, grads, state, rate, it,
-                lr_mults={k: 1.0 for k in flat}, **hyper)
+            new_p, new_s = update(flat, state, grads, it)
             return new_p, new_s, loss
 
         return step
@@ -239,9 +232,15 @@ class CompiledPipeline:
     def step(self, xs, ys) -> float:
         """One training round: xs/ys are [M, micro_batch, ...] stacks of
         the round's microbatches (M = n_micro)."""
-        if xs.shape[0] != self.n_micro:
-            raise ValueError(f"xs leading dim {xs.shape[0]} != n_micro "
-                             f"{self.n_micro}")
+        xs, ys = jnp.asarray(xs), jnp.asarray(ys)
+        if xs.shape[0] != self.n_micro or ys.shape[0] != self.n_micro:
+            raise ValueError(
+                f"xs/ys leading dims {xs.shape[0]}/{ys.shape[0]} != "
+                f"n_micro {self.n_micro}")
+        if ys.ndim < 2 or ys.shape[1] != xs.shape[1]:
+            raise ValueError(
+                f"ys shape {ys.shape} does not pair with xs {xs.shape}: "
+                f"expected [n_micro, micro_batch, ...] targets")
         flat = self._flatten(self.stacked, self.head)
         new_p, new_s, loss = self._step(
             flat, self.state, jnp.int32(self.iter),
@@ -255,3 +254,40 @@ class CompiledPipeline:
         """Forward-only round loss (no update) — for equivalence tests."""
         return float(self._loss_jit(self.stacked, self.head,
                                     jnp.asarray(xs), jnp.asarray(ys)))
+
+    # ------------------------------------------------------- checkpointing
+    def snapshot(self, path: str) -> str:
+        """Snapshot triple (iter + flat params + solver state), same
+        backends as the other trainers (reference role: Solver::Snapshot,
+        solver.cpp:446-466)."""
+        from ..utils import orbax_ckpt
+
+        return orbax_ckpt.save_auto(
+            path, self.iter, self._flatten(self.stacked, self.head),
+            self.state)
+
+    def restore(self, path: str) -> None:
+        """Exact resume: stage params return pipe-sharded, head/state
+        replicated, so the post-restore trajectory equals the
+        uninterrupted run (reference: Solver::Restore)."""
+        from ..utils import orbax_ckpt
+
+        stage_sh = NamedSharding(self.mesh, P(self.axis))
+        repl_sh = NamedSharding(self.mesh, P())
+
+        def sharding_for(k):
+            return stage_sh if k.startswith("stage:") else repl_sh
+
+        known = self._flatten(self.stacked, self.head)
+        it, params, state = orbax_ckpt.restore_auto(
+            path, known_params=known, sharding_for=sharding_for)
+        missing = set(known) - set(params)
+        if missing:
+            raise ValueError(f"snapshot lacks params: {sorted(missing)}")
+        flat = {k: jax.device_put(jnp.asarray(params[k]), sharding_for(k))
+                for k in known}
+        self.stacked, self.head = self._split(flat)
+        self.state = {k: tuple(jax.device_put(jnp.asarray(h),
+                                              sharding_for(k))
+                               for h in state[k]) for k in state}
+        self.iter = int(it)

@@ -111,8 +111,10 @@ def make_loss_fn(net: Net, precision: str):
     return loss_fn
 
 
-def make_update_fn(net: Net, sp: SolverParameter, *,
-                   clip_override: Optional[float] = None):
+def make_update_fn(net: Optional[Net], sp: SolverParameter, *,
+                   clip_override: Optional[float] = None,
+                   lr_mults: Optional[Dict[str, float]] = None,
+                   decay_mults: Optional[Dict[str, float]] = None):
     """The shared post-gradient pipeline as a pure function
     (params, state, grads, it) -> (new_params, new_state): clip ->
     regularize -> LR policy -> solver update, in the reference's order
@@ -123,7 +125,11 @@ def make_update_fn(net: Net, sp: SolverParameter, *,
     `clip_override` replaces the solver's clip_gradients — a trainer that
     calls this per param subset (the pipeline: one call per stage) must do
     its own GLOBAL-norm clip first and pass 0 here, or the norm would be
-    computed per subset instead of over all params as the reference does."""
+    computed per subset instead of over all params as the reference does.
+
+    `lr_mults`/`decay_mults` override the net's per-param multipliers —
+    required when `net` is None (trainers whose params aren't a Net's,
+    e.g. CompiledPipeline's block stacks)."""
     clip = float(sp.clip_gradients if clip_override is None
                  else clip_override)
     weight_decay = float(sp.weight_decay)
@@ -131,8 +137,10 @@ def make_update_fn(net: Net, sp: SolverParameter, *,
     hyper = dict(momentum=float(sp.momentum), delta=float(sp.delta),
                  momentum2=float(sp.momentum2), rms_decay=float(sp.rms_decay))
     solver_type = sp.resolved_type()
-    lr_mults = net.lr_multipliers()
-    decay_mults = net.decay_multipliers()
+    if lr_mults is None:
+        lr_mults = net.lr_multipliers()
+    if decay_mults is None:
+        decay_mults = net.decay_multipliers()
 
     def update(params, state, grads, it):
         grads = updates.clip_gradients(grads, clip)

@@ -149,3 +149,42 @@ def test_rejects_mismatched_stage_dims():
         CompiledPipeline(_solver_param(), block_fn=block_fn,
                          loss_fn=loss_fn, stacked_params=stacked,
                          head_params=head, n_micro=M)
+
+
+def test_snapshot_restore_exact_resume(tmp_path):
+    """Kill-and-resume: restore must reproduce the uninterrupted
+    trajectory exactly (same contract as every other trainer)."""
+    _need_devices(S)
+    stacked, head, _, _ = _init()
+    sp = _solver_param()
+    rng = np.random.RandomState(5)
+    batches = [(rng.randn(M, MB, F).astype(np.float32),
+                rng.randint(0, C, (M, MB)).astype(np.int32))
+               for _ in range(4)]
+
+    solo = CompiledPipeline(sp, block_fn=block_fn, loss_fn=loss_fn,
+                            stacked_params=stacked, head_params=head,
+                            n_micro=M)
+    for xs, ys in batches:
+        solo.step(xs, ys)
+
+    a = CompiledPipeline(sp, block_fn=block_fn, loss_fn=loss_fn,
+                         stacked_params=stacked, head_params=head,
+                         n_micro=M)
+    a.step(*batches[0])
+    a.step(*batches[1])
+    snap = a.snapshot(str(tmp_path / "pipe.npz"))
+
+    b = CompiledPipeline(sp, block_fn=block_fn, loss_fn=loss_fn,
+                         stacked_params=stacked, head_params=head,
+                         n_micro=M)
+    b.restore(snap)
+    assert b.iter == 2
+    b.step(*batches[2])
+    b.step(*batches[3])
+    for k in solo.stacked:
+        np.testing.assert_array_equal(np.asarray(solo.stacked[k]),
+                                      np.asarray(b.stacked[k]))
+    for k in solo.head:
+        np.testing.assert_array_equal(np.asarray(solo.head[k]),
+                                      np.asarray(b.head[k]))
