@@ -137,9 +137,18 @@ def _tokenize(text: str) -> Iterator[tuple[str, str]]:
 
 
 class _Parser:
+    # textformat nests shallowly (LayerParameter -> per-layer param ->
+    # filler is ~4 deep; give 25x headroom); the cap turns a pathological
+    # input's RecursionError into the same clean ValueError every other
+    # malformed input gets.  It must stay well under Python's recursion
+    # limit counted in FRAMES PER LEVEL — the colon-message syntax
+    # (`a: { ... }`) recurses through _parse_scalar, 3 frames/level
+    MAX_DEPTH = 100
+
     def __init__(self, text: str) -> None:
         self._toks = list(_tokenize(text))
         self._i = 0
+        self._depth = 0
 
     def _peek(self) -> tuple[str, str]:
         return self._toks[self._i]
@@ -150,6 +159,16 @@ class _Parser:
         return t
 
     def parse_message(self, terminator: Optional[str] = None) -> Message:
+        self._depth += 1
+        if self._depth > self.MAX_DEPTH:
+            raise ValueError(
+                f"message nesting exceeds {self.MAX_DEPTH} levels")
+        try:
+            return self._parse_message_body(terminator)
+        finally:
+            self._depth -= 1
+
+    def _parse_message_body(self, terminator: Optional[str]) -> Message:
         msg = Message()
         while True:
             kind, tok = self._peek()

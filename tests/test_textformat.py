@@ -132,3 +132,48 @@ def test_alexnet_conv_params():
     conv2 = [l for l in net.layers if l.name == "conv2"][0]
     assert conv2.convolution_param.group == 2
     assert conv2.convolution_param.pad == (2, 2)
+
+
+class TestMalformedInput:
+    """Every malformed input must die with a clean ValueError naming the
+    problem — never a RecursionError/IndexError/KeyError (the reference
+    delegates this to protobuf's TextFormat parser; ccaffe.cpp:275-304
+    surfaces failures as a boolean)."""
+
+    CASES = {
+        "unterminated message": 'layer { name: "x" type: "ReLU" ',
+        "garbage tokens": "layer &&& }{",
+        "stray closing brace": 'name: "n" } layer { }',
+        "bad number": "base_lr: 0.0.1",
+        "missing colon": 'layer { name "x" }',
+        "bracket list unclosed": "test_iter: [1, 2",
+        "angle terminator mismatch": "layer < name: \"x\" }",
+    }
+
+    def test_malformed_inputs_raise_value_error(self):
+        from sparknet_tpu.proto.textformat import parse
+
+        for label, txt in self.CASES.items():
+            with pytest.raises(ValueError):
+                parse(txt)
+
+    def test_pathological_nesting_is_a_clean_error(self):
+        """2000-deep nesting must hit the depth cap, not blow the Python
+        stack (a RecursionError escaping from a parser is a crash, not a
+        parse failure) — in BOTH message syntaxes: `a { }` recurses 2
+        frames/level, the colon form `a: { }` 3 frames/level."""
+        from sparknet_tpu.proto.textformat import parse
+
+        with pytest.raises(ValueError, match="nesting"):
+            parse("a { " * 2000 + "}" * 2000)
+        with pytest.raises(ValueError, match="nesting"):
+            parse("a: { " * 2000 + "}" * 2000)
+
+    def test_identifier_scalars_still_parse(self):
+        """Unquoted identifiers are legal scalar values (enum syntax:
+        `pool: MAX`, caffe.proto PoolingParameter) — the hardening must
+        not break them."""
+        from sparknet_tpu.proto.textformat import parse
+
+        m = parse("pooling_param { pool: MAX }")
+        assert str(m.get("pooling_param").get("pool")) == "MAX"
