@@ -107,7 +107,14 @@ def crc_unmask(masked: int) -> int:
 
 def _read_varint(buf, pos: int) -> Tuple[int, int]:
     result, shift = 0, 0
+    n = len(buf)
     while True:
+        if pos >= n:
+            raise ValueError(f"truncated varint at byte {pos}")
+        if shift > 63:
+            # leveldb's GetVarint64 rejects >10-byte varints; fail O(1)
+            # instead of grinding a bigint across a corrupt 0x80 run
+            raise ValueError(f"varint longer than 10 bytes at {pos}")
         b = buf[pos]
         pos += 1
         result |= (b & 0x7F) << shift
@@ -125,6 +132,9 @@ def _write_varint(out: bytearray, v: int) -> None:
 
 def _read_length_prefixed(buf, pos: int) -> Tuple[bytes, int]:
     n, pos = _read_varint(buf, pos)
+    if pos + n > len(buf):
+        raise ValueError(f"truncated length-prefixed value: declares {n} "
+                         f"bytes, {len(buf) - pos} remain")
     return bytes(buf[pos:pos + n]), pos + n
 
 
@@ -354,7 +364,9 @@ def read_manifest(manifest_path: str) -> Dict[str, object]:
     log_number = 0
     prev_log = 0
     last_seq = 0
+    n_records = 0
     for record in read_log_records(manifest_path):
+        n_records += 1
         pos = 0
         while pos < len(record):
             tag, pos = _read_varint(record, pos)
@@ -386,6 +398,13 @@ def read_manifest(manifest_path: str) -> Dict[str, object]:
                 files[number] = level
             else:
                 raise ValueError(f"unknown VersionEdit tag {tag}")
+    if n_records == 0:
+        # a valid MANIFEST always carries at least one VersionEdit; zero
+        # usable records means the file is corrupt or not a manifest —
+        # fail like leveldb's VersionSet::Recover (Status::Corruption)
+        # instead of silently presenting an empty database
+        raise ValueError(f"corrupt or empty MANIFEST: no usable records "
+                         f"in {manifest_path}")
     return dict(files=files, log_number=log_number, prev_log=prev_log,
                 last_seq=last_seq)
 

@@ -102,9 +102,14 @@ class LMDBReader:
         # (all access below is struct.unpack_from / slicing, both mmap-safe)
         self._f = open(path, "rb")
         self.buf = mmap.mmap(self._f.fileno(), 0, access=mmap.ACCESS_READ)
-        meta0 = self._parse_meta(0, DEFAULT_PSIZE)
-        psize = meta0["psize"]
-        meta1 = self._parse_meta(psize, psize)
+        try:
+            meta0 = self._parse_meta(0, DEFAULT_PSIZE)
+            psize = meta0["psize"]
+            meta1 = self._parse_meta(psize, psize)
+        except struct.error as e:
+            # a file too small to hold the two meta pages isn't an LMDB —
+            # same clean failure as a bad magic
+            raise ValueError(f"not an LMDB data file ({e})") from None
         self.meta = meta0 if meta0["txnid"] >= meta1["txnid"] else meta1
         self.psize = self.meta["psize"]
         self.entries = self.meta["entries"]

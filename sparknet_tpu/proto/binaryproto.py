@@ -29,7 +29,15 @@ import numpy as np
 def _read_varint(buf: bytes, pos: int) -> Tuple[int, int]:
     result = 0
     shift = 0
+    n = len(buf)
     while True:
+        if pos >= n:
+            raise ValueError(f"truncated varint at byte {pos}")
+        if shift > 63:
+            # protobuf caps varints at 10 bytes; without this a corrupt
+            # run of 0x80 continuation bytes grinds a growing bigint for
+            # the whole buffer instead of failing in O(1)
+            raise ValueError(f"varint longer than 10 bytes at {pos}")
         b = buf[pos]
         pos += 1
         result |= (b & 0x7F) << shift
@@ -59,13 +67,24 @@ def iter_fields(buf: bytes) -> Iterator[Tuple[int, int, object]]:
         if wt == 0:
             val, pos = _read_varint(buf, pos)
         elif wt == 1:
+            if pos + 8 > n:
+                raise ValueError(f"truncated fixed64 field {field}")
             val = buf[pos:pos + 8]
             pos += 8
         elif wt == 2:
             ln, pos = _read_varint(buf, pos)
+            if pos + ln > n:
+                # a short slice here would SILENTLY load a truncated blob
+                # (e.g. an interrupted .caffemodel copy) — fail like the
+                # reference's protobuf parser does
+                raise ValueError(
+                    f"truncated length-delimited field {field}: "
+                    f"declares {ln} bytes, {n - pos} remain")
             val = buf[pos:pos + ln]
             pos += ln
         elif wt == 5:
+            if pos + 4 > n:
+                raise ValueError(f"truncated fixed32 field {field}")
             val = buf[pos:pos + 4]
             pos += 4
         else:

@@ -79,3 +79,42 @@ def test_solver_warm_start_from_caffemodel(tmp_path):
     for k in a.params:
         np.testing.assert_array_equal(np.asarray(a.params[k]),
                                       np.asarray(b.params[k]))
+
+
+def test_malformed_binaryproto_raises_value_error(tmp_path):
+    """Truncated or garbage .caffemodel bytes must die with ValueError —
+    in particular a length-delimited field whose declared size exceeds the
+    remaining bytes must NOT silently load a truncated blob (an
+    interrupted snapshot copy is exactly this shape; the reference's
+    protobuf parser fails it too)."""
+    import pytest
+    from sparknet_tpu.proto.binaryproto import read_caffemodel
+
+    cases = {
+        "truncated_varint": b"\xff",
+        "truncated_length_field": b"\x0a\xff\xff\xff\xff\x7f" + b"x" * 10,
+        "bad_wire_type": bytes([0x06]) + b"\x00" * 8,
+        "truncated_fixed32": b"\x0d\x00",
+    }
+    for name, blob in cases.items():
+        p = tmp_path / f"{name}.caffemodel"
+        p.write_bytes(blob)
+        with pytest.raises(ValueError):
+            read_caffemodel(str(p))
+
+
+def test_overlong_varint_fails_fast(tmp_path):
+    """A corrupt run of 0x80 continuation bytes must fail in O(1) (real
+    protobuf caps varints at 10 bytes), not grind a growing bigint across
+    the buffer."""
+    import time
+
+    import pytest
+    from sparknet_tpu.proto.binaryproto import read_caffemodel
+
+    p = tmp_path / "evil.caffemodel"
+    p.write_bytes(b"\x80" * (1 << 20))  # 1 MB of continuation bytes
+    t0 = time.time()
+    with pytest.raises(ValueError, match="varint"):
+        read_caffemodel(str(p))
+    assert time.time() - t0 < 1.0, "rejection was not O(1)"

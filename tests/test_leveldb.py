@@ -316,3 +316,20 @@ layer {{ name: "data" type: "Data" top: "data" top: "label"
     assert b["data"].shape == (4, 3, 8, 8)
     np.testing.assert_array_equal(b["data"][0], imgs[0])
     assert list(b["label"][:4]) == [0, 1, 2, 3]
+
+
+def test_corrupt_manifest_rejected(tmp_path):
+    """A directory whose MANIFEST yields no usable records must raise
+    ValueError (leveldb's VersionSet::Recover -> Status::Corruption), not
+    silently present an empty database."""
+    import pytest
+    from sparknet_tpu.data.leveldb_io import LevelDBReader
+
+    for name, blob in [("empty", b""), ("garbage", os.urandom(200)),
+                       ("zeros", b"\x00" * 4096)]:
+        db = tmp_path / f"db_{name}"
+        db.mkdir()
+        (db / "CURRENT").write_bytes(b"MANIFEST-000002\n")
+        (db / "MANIFEST-000002").write_bytes(blob)
+        with pytest.raises(ValueError, match="MANIFEST"):
+            list(LevelDBReader(str(db)).items())

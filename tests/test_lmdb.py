@@ -211,3 +211,14 @@ def test_convert_rejects_mixed_shapes_and_floats(tmp_path):
     with pytest.raises(ValueError, match="float_data"):
         convert_lmdb_to_store(str(tmp_path / "floats"),
                               str(tmp_path / "out2"))
+
+
+def test_non_lmdb_files_rejected_cleanly(tmp_path):
+    """Files that aren't LMDB (too short, wrong magic, zeroed) must raise
+    ValueError from the reader, never struct.error/IndexError."""
+    for name, blob in [("tiny", b"\xff"), ("garbage", b"\x5a" * 200),
+                       ("zeros", b"\x00" * 4096)]:
+        p = tmp_path / f"{name}.mdb"
+        p.write_bytes(blob)
+        with pytest.raises(ValueError):
+            list(read_datum_db(str(p)))
