@@ -18,13 +18,30 @@ def _open(path: str):
 
 
 def read_idx(path: str) -> np.ndarray:
-    with _open(path) as f:
-        magic = struct.unpack(">I", f.read(4))[0]
-        ndim = magic & 0xFF
-        dtype_code = (magic >> 8) & 0xFF
-        assert dtype_code == 0x08, "only ubyte idx supported"
-        dims = struct.unpack(">" + "I" * ndim, f.read(4 * ndim))
-        data = np.frombuffer(f.read(), dtype=np.uint8)
+    try:
+        with _open(path) as f:
+            head = f.read(4)
+            if len(head) < 4:
+                raise ValueError(f"{path}: truncated idx header")
+            magic = struct.unpack(">I", head)[0]
+            ndim = magic & 0xFF
+            dtype_code = (magic >> 8) & 0xFF
+            if magic >> 16 or dtype_code != 0x08:
+                raise ValueError(f"{path}: not a ubyte idx file "
+                                 f"(magic {magic:#010x})")
+            raw_dims = f.read(4 * ndim)
+            if len(raw_dims) < 4 * ndim:
+                raise ValueError(f"{path}: truncated idx dimension table")
+            dims = struct.unpack(">" + "I" * ndim, raw_dims)
+            data = np.frombuffer(f.read(), dtype=np.uint8)
+    except (EOFError, gzip.BadGzipFile, OSError) as e:
+        # a cut-short or corrupt .gz stream fails inside read(), before
+        # any of the checks above — keep the ValueError contract
+        raise ValueError(f"{path}: unreadable idx file ({e})") from None
+    expect = int(np.prod(dims, dtype=np.int64))  # prod(()) == 1: scalar idx
+    if data.size != expect:
+        raise ValueError(f"{path}: idx declares {dims} = {expect} bytes, "
+                         f"file holds {data.size}")
     return data.reshape(dims)
 
 

@@ -222,3 +222,55 @@ def test_store_datum_shape_index_and_legacy(tmp_path):
     del meta["shape"]
     json.dump(meta, open(idx, "w"))
     assert ArrayStoreCursor(path).datum_shape == (3, 9, 7)
+
+
+def test_malformed_idx_files_raise_value_error(tmp_path):
+    """Truncated/garbage idx files must die with ValueError naming the
+    file — never struct.error or a bare reshape error (the reference
+    pipeline delegates to LMDB conversion which validates likewise)."""
+    from sparknet_tpu.data.mnist import read_idx
+
+    cases = {
+        "empty": b"",
+        "short_magic": b"\x00\x00",
+        "bad_magic": b"\xde\xad\xbe\xef" + b"\x00" * 16,
+        "truncated_dims": b"\x00\x00\x08\x03\x00\x00",
+        "payload_mismatch": b"\x00\x00\x08\x01\x00\x00\x00\x0a" + b"\x01" * 3,
+    }
+    for name, blob in cases.items():
+        p = tmp_path / f"{name}.idx"
+        p.write_bytes(blob)
+        with pytest.raises(ValueError):
+            read_idx(str(p))
+
+
+def test_valid_idx_roundtrip(tmp_path):
+    """The hardening must not break well-formed idx files."""
+    import struct as _struct
+
+    from sparknet_tpu.data.mnist import read_idx
+
+    arr = np.arange(24, dtype=np.uint8).reshape(2, 3, 4)
+    blob = _struct.pack(">I", 0x00000803) + _struct.pack(">III", 2, 3, 4) \
+        + arr.tobytes()
+    p = tmp_path / "ok.idx"
+    p.write_bytes(blob)
+    np.testing.assert_array_equal(read_idx(str(p)), arr)
+
+
+def test_truncated_gz_idx_raises_value_error(tmp_path):
+    """A cut-short .gz stream fails inside read() — it must still honor
+    the ValueError contract and name the file."""
+    import gzip as _gzip
+
+    from sparknet_tpu.data.mnist import read_idx
+
+    ok = tmp_path / "t.idx.gz"
+    with _gzip.open(ok, "wb") as f:
+        f.write(b"\x00\x00\x08\x01\x00\x00\x00\x02\xaa\xbb")
+    blob = ok.read_bytes()
+    (tmp_path / "cut.idx.gz").write_bytes(blob[:len(blob) - 6])
+    with pytest.raises(ValueError, match="cut.idx.gz"):
+        read_idx(str(tmp_path / "cut.idx.gz"))
+    # the intact twin still reads
+    assert read_idx(str(ok)).tolist() == [0xAA, 0xBB]

@@ -313,3 +313,26 @@ layer { name: "loss" type: "SoftmaxWithLoss" bottom: "ip1" bottom: "label"
         np.testing.assert_allclose(results[1][1][k], v, rtol=1e-6,
                                    atol=1e-7, err_msg=k)
     assert build(True).net.remat and not build(False).net.remat
+
+
+def test_every_reference_solver_type_is_implemented():
+    """Solver-registry parity from the reference tree itself: every
+    REGISTER_SOLVER_CLASS name in caffe/src/caffe/solvers must have an
+    update implementation here (solver_factory.hpp registry role)."""
+    import glob
+    import os
+    import re
+
+    from sparknet_tpu.solver.updates import N_SLOTS
+    from tests.conftest import reference_path
+
+    src = reference_path("caffe/src/caffe/solvers")
+    if not os.path.isdir(src):
+        pytest.skip("reference solvers source not present")
+    names = set()
+    for path in glob.glob(os.path.join(src, "*.cpp")):
+        names |= set(re.findall(r"REGISTER_SOLVER_CLASS\((\w+)\)",
+                                open(path, errors="ignore").read()))
+    assert names, "no solver registrations found"
+    missing = sorted(names - set(N_SLOTS))
+    assert not missing, f"reference solver types unimplemented: {missing}"
