@@ -63,6 +63,9 @@ class WindowDataset:
 
         def take() -> str:
             nonlocal pos
+            if pos >= len(tokens):
+                raise ValueError(
+                    f"{source}: window file ends mid-entry at token {pos}")
             t = tokens[pos]
             pos += 1
             return t

@@ -175,3 +175,20 @@ layer {{ name: "loss" type: "SoftmaxWithLoss" bottom: "ip" bottom: "label"
     solver.set_train_data(feed)
     loss = solver.step(3)
     assert np.isfinite(loss)
+
+
+def test_malformed_window_files_raise_value_error(tmp_path):
+    """Garbage or mid-entry-truncated window files must die with a clean
+    ValueError (window_data_layer.cpp delegates to stream extraction +
+    CHECK failures), never IndexError."""
+    cases = {
+        "empty": "",
+        "garbage": "not a window file\n###\n",
+        "mid_entry": "# 0\n/img.jpg\n3\n",
+        "non_numeric": "# 0\n/img.jpg\nx y z\n2\n",
+    }
+    for name, txt in cases.items():
+        p = tmp_path / f"{name}.txt"
+        p.write_text(txt)
+        with pytest.raises(ValueError):
+            WindowDataset(str(p))
