@@ -523,6 +523,31 @@ def build_dummy_data(net: Net, layer: LayerParameter, bshapes):
 
 # ------------------------------------------------------------ learnable layers
 
+def _check_dims(layer: LayerParameter, **dims: int) -> None:
+    """Caffe CHECK-fails non-positive structural dims at SetUp (e.g.
+    base_conv_layer.cpp num_output/kernel CHECK_GT); a missing per-layer
+    param submessage otherwise builds a zero-width layer silently or
+    dies in the XLA shape verifier far from the cause."""
+    for name, v in dims.items():
+        if v <= 0:
+            raise ValueError(
+                f"layer {str(layer.name)!r} ({str(layer.type)}): {name} "
+                f"must be positive, got {v} — is the layer's param "
+                f"submessage missing or the input too small?")
+
+
+def _check_group(layer: LayerParameter, channels: int, num_output: int,
+                 groups: int) -> None:
+    """base_conv_layer.cpp CHECKs channels % group == 0 and
+    num_output % group == 0; without this, c // groups silently
+    truncates (or zeroes) the filter's input-channel width."""
+    if groups <= 0 or channels % groups or num_output % groups:
+        raise ValueError(
+            f"layer {str(layer.name)!r} ({str(layer.type)}): group="
+            f"{groups} must divide both channels={channels} and "
+            f"num_output={num_output}")
+
+
 @register("Convolution")
 def build_conv(net: Net, layer: LayerParameter, bshapes):
     cp = layer.convolution_param
@@ -535,6 +560,9 @@ def build_conv(net: Net, layer: LayerParameter, bshapes):
     co = int(cp.num_output)
     oh = ops.conv_out_dim(h, kh, ph, sh, dh)
     ow = ops.conv_out_dim(w, kw, pw, sw, dw)
+    _check_dims(layer, num_output=co, kernel_h=kh, kernel_w=kw,
+                out_h=oh, out_w=ow)
+    _check_group(layer, c, co, groups)
     specs = [((co, c // groups, kh, kw), cp.weight_filler)]
     if cp.bias_term:
         specs.append(((co,), cp.bias_filler))
@@ -562,6 +590,9 @@ def build_deconv(net: Net, layer: LayerParameter, bshapes):
     co = int(cp.num_output)
     oh = ops.deconv_out_dim(h, kh, ph, sh, dh)
     ow = ops.deconv_out_dim(w, kw, pw, sw, dw)
+    _check_dims(layer, num_output=co, kernel_h=kh, kernel_w=kw,
+                out_h=oh, out_w=ow)
+    _check_group(layer, c, co, groups)
     specs = [((c, co // groups, kh, kw), cp.weight_filler)]
     if cp.bias_term:
         specs.append(((co,), cp.bias_filler))
@@ -582,6 +613,7 @@ def build_inner_product(net: Net, layer: LayerParameter, bshapes):
     ip = layer.inner_product_param
     axis = int(ip.axis)
     co = int(ip.num_output)
+    _check_dims(layer, num_output=co)
     bshape = bshapes[0]
     fan_in = _prod(bshape[axis:])
     lead = tuple(bshape[:axis])
@@ -602,6 +634,7 @@ def build_inner_product(net: Net, layer: LayerParameter, bshapes):
 def build_embed(net: Net, layer: LayerParameter, bshapes):
     ep = layer.embed_param
     co, vocab = int(ep.num_output), int(ep.input_dim)
+    _check_dims(layer, num_output=co, input_dim=vocab)
     specs = [((vocab, co), ep.weight_filler)]
     if ep.bias_term:
         specs.append(((co,), ep.bias_filler))
@@ -712,6 +745,7 @@ def build_pooling(net: Net, layer: LayerParameter, bshapes):
     sh, sw = pp.strides
     oh = ops.pool_out_dim(h, kh, ph, sh)
     ow = ops.pool_out_dim(w, kw, pw, sw)
+    _check_dims(layer, kernel_h=kh, kernel_w=kw, out_h=oh, out_w=ow)
     needs_rng = mode == "STOCHASTIC"
 
     def fn(pvals, bvals, rng, train):

@@ -325,3 +325,49 @@ def test_every_reference_layer_type_has_a_builder():
     assert names, "no registrations found — reference layout changed?"
     missing = sorted(names - set(_BUILDERS))
     assert not missing, f"reference layer types without builders: {missing}"
+
+
+def test_zero_width_and_impossible_layers_rejected_at_build():
+    """A missing per-layer param submessage (num_output=0) or a kernel
+    larger than its input must fail at BUILD with a layer-naming
+    ValueError — Caffe CHECK-fails these at SetUp
+    (base_conv_layer.cpp/inner_product_layer.cpp CHECK_GT); silently
+    building a zero-width layer or dying in the XLA verifier is not
+    acceptable."""
+    base = '''
+layer { name: "d" type: "MemoryData" top: "data" top: "label"
+  memory_data_param { batch_size: 2 channels: 1 height: 4 width: 4 } }
+'''
+    cases = {
+        "ip_no_param": 'layer { name: "ip" type: "InnerProduct" '
+                       'bottom: "data" top: "ip" }',
+        "conv_no_param": 'layer { name: "c" type: "Convolution" '
+                         'bottom: "data" top: "c" }',
+        "conv_kernel_too_big": '''
+layer { name: "c" type: "Convolution" bottom: "data" top: "c"
+  convolution_param { num_output: 2 kernel_size: 9 } }''',
+        "embed_no_param": 'layer { name: "e" type: "Embed" '
+                          'bottom: "data" top: "e" }',
+    }
+    for name, body in cases.items():
+        with pytest.raises(ValueError, match="must be positive"):
+            Net(caffe_pb.parse_net_text(base + body), "TRAIN")
+
+
+def test_indivisible_group_and_oversized_pool_rejected():
+    """Grouped-conv divisibility (base_conv_layer.cpp CHECKs channels %
+    group == 0 and num_output % group == 0) and pooling out-dims are
+    validated at build, same contract as the conv/IP checks."""
+    base = '''
+layer { name: "d" type: "MemoryData" top: "data" top: "label"
+  memory_data_param { batch_size: 2 channels: 3 height: 4 width: 4 } }
+'''
+    with pytest.raises(ValueError, match="group"):
+        Net(caffe_pb.parse_net_text(base + '''
+layer { name: "c" type: "Convolution" bottom: "data" top: "c"
+  convolution_param { num_output: 4 kernel_size: 3 group: 2 } }'''),
+            "TRAIN")
+    with pytest.raises(ValueError, match="must be positive"):
+        Net(caffe_pb.parse_net_text(base + '''
+layer { name: "p" type: "Pooling" bottom: "data" top: "p"
+  pooling_param { pool: MAX kernel_size: 9 } }'''), "TRAIN")
