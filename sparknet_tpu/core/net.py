@@ -812,6 +812,16 @@ def build_concat(net: Net, layer: LayerParameter, bshapes):
     axis = int(layer.concat_param.axis)
     if layer.concat_param.msg.has("concat_dim"):
         axis = int(layer.concat_param.concat_dim)
+    axis %= len(bshapes[0])  # CanonicalAxisIndex (concat_layer.cpp:30)
+    for s in bshapes[1:]:
+        # concat_layer.cpp CHECKs every non-concat dim matches bottom[0]
+        if (len(s) != len(bshapes[0]) or
+                any(s[d] != bshapes[0][d] for d in range(len(s))
+                    if d != axis)):
+            raise ValueError(
+                f"layer {str(layer.name)!r} (Concat): non-concat dims "
+                f"must match along axis {axis}, got "
+                f"{[tuple(b) for b in bshapes]}")
     out = list(bshapes[0])
     out[axis] = sum(int(s[axis]) for s in bshapes)
 
@@ -890,6 +900,12 @@ def build_eltwise(net: Net, layer: LayerParameter, bshapes):
     ep = layer.eltwise_param
     op = str(ep.operation)
     coeffs = ep.coeffs or None
+    mismatched = [s for s in bshapes[1:] if tuple(s) != tuple(bshapes[0])]
+    if mismatched:
+        # eltwise_layer.cpp CHECKs every bottom shape equals bottom[0]'s
+        raise ValueError(
+            f"layer {str(layer.name)!r} (Eltwise): bottom shapes must all "
+            f"match, got {[tuple(s) for s in bshapes]}")
 
     def fn(pvals, bvals, rng, train):
         return [ops.eltwise(bvals, operation=op, coeffs=coeffs)], {}

@@ -371,3 +371,53 @@ layer { name: "c" type: "Convolution" bottom: "data" top: "c"
         Net(caffe_pb.parse_net_text(base + '''
 layer { name: "p" type: "Pooling" bottom: "data" top: "p"
   pooling_param { pool: MAX kernel_size: 9 } }'''), "TRAIN")
+
+
+def test_eltwise_and_concat_shape_mismatch_rejected_at_build():
+    """eltwise_layer.cpp / concat_layer.cpp CHECK bottom-shape agreement
+    at SetUp; mismatches must be a build-time layer-naming ValueError,
+    not a trace-time broadcast error."""
+    base = '''
+layer { name: "d" type: "MemoryData" top: "data" top: "label"
+  memory_data_param { batch_size: 2 channels: 3 height: 4 width: 4 } }
+layer { name: "ip1" type: "InnerProduct" bottom: "data" top: "a"
+  inner_product_param { num_output: 3 } }
+layer { name: "ip2" type: "InnerProduct" bottom: "data" top: "b"
+  inner_product_param { num_output: 5 } }
+'''
+    with pytest.raises(ValueError, match="Eltwise"):
+        Net(caffe_pb.parse_net_text(
+            base + 'layer { name: "e" type: "Eltwise" bottom: "a" '
+                   'bottom: "b" top: "e" }'), "TRAIN")
+    with pytest.raises(ValueError, match="Concat"):
+        Net(caffe_pb.parse_net_text(base + '''
+layer { name: "c" type: "Concat" bottom: "a" bottom: "b" top: "c"
+  concat_param { axis: 0 } }'''), "TRAIN")
+    # matched shapes still concat on the channel axis (googlenet form)
+    ok = Net(caffe_pb.parse_net_text(base + '''
+layer { name: "ip3" type: "InnerProduct" bottom: "data" top: "c3"
+  inner_product_param { num_output: 5 } }
+layer { name: "cc" type: "Concat" bottom: "b" bottom: "c3" top: "cc"
+  concat_param { axis: 1 } }'''), "TRAIN")
+    assert ok.blob_shapes["cc"] == (2, 10)
+
+
+def test_concat_negative_axis_and_rank_mismatch():
+    """axis: -1 is legal (CanonicalAxisIndex, concat_layer.cpp:30) and
+    must still build; a rank-mismatched bottom must raise the
+    layer-naming ValueError, not IndexError."""
+    base = '''
+layer { name: "d" type: "MemoryData" top: "data" top: "label"
+  memory_data_param { batch_size: 2 channels: 3 height: 4 width: 4 } }
+layer { name: "s" type: "Split" bottom: "data" top: "s1" top: "s2" }
+'''
+    ok = Net(caffe_pb.parse_net_text(base + '''
+layer { name: "cc" type: "Concat" bottom: "s1" bottom: "s2" top: "cc"
+  concat_param { axis: -1 } }'''), "TRAIN")
+    assert ok.blob_shapes["cc"] == (2, 3, 4, 8)
+    with pytest.raises(ValueError, match="Concat"):
+        Net(caffe_pb.parse_net_text(base + '''
+layer { name: "ip" type: "InnerProduct" bottom: "data" top: "flat"
+  inner_product_param { num_output: 5 } }
+layer { name: "cc" type: "Concat" bottom: "s1" bottom: "flat" top: "cc"
+  concat_param { axis: 2 } }'''), "TRAIN")
