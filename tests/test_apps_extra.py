@@ -166,7 +166,10 @@ def test_imagenet_app_device_transform_path(tmp_path):
     acc = imagenet_app.run(
         2, shards_dir=shards, label_file=labels, model="alexnet",
         rounds=1, batch_size=2, tau=1, test_batch=2, test_every=100,
-        mesh=make_mesh(2), crop=33, device_transform=True,
+        # crop must keep AlexNet's spatial chain positive (>= 39 gives
+        # pool5 1x1); 33 made pool5 0x0 — a degenerate net the
+        # build-time dim validation now rejects
+        mesh=make_mesh(2), crop=49, device_transform=True,
         log_path=str(tmp_path / "log.txt"))
     assert 0.0 <= acc <= 1.0
     log = open(tmp_path / "log.txt").read()
@@ -179,6 +182,6 @@ def test_imagenet_app_host_transform_path(tmp_path):
     acc = imagenet_app.run(
         2, shards_dir=shards, label_file=labels, model="alexnet",
         rounds=1, batch_size=2, tau=1, test_batch=2, test_every=100,
-        mesh=make_mesh(2), crop=33, device_transform=False,
+        mesh=make_mesh(2), crop=49, device_transform=False,
         log_path=str(tmp_path / "log.txt"))
     assert 0.0 <= acc <= 1.0
