@@ -188,3 +188,25 @@ def test_snapshot_restore_exact_resume(tmp_path):
     for k in solo.head:
         np.testing.assert_array_equal(np.asarray(solo.head[k]),
                                       np.asarray(b.head[k]))
+
+
+def test_bfloat16_path_trains_with_fp32_master_weights():
+    """precision='bfloat16' casts inside the differentiated schedule
+    (activations + per-stage param copies) while master weights and
+    optimizer slots stay fp32 — the same mixed-precision contract the
+    single-chip step has (solver.py resolve_precision)."""
+    _need_devices(S)
+    stacked, head, xs, ys = _init()
+    pipe = CompiledPipeline(_solver_param(), block_fn=block_fn,
+                            loss_fn=loss_fn, stacked_params=stacked,
+                            head_params=head, n_micro=M,
+                            precision="bfloat16")
+    l0 = pipe.step(xs, ys)
+    for _ in range(5):
+        l1 = pipe.step(xs, ys)
+    assert np.isfinite(l1) and l1 < l0, (l0, l1)
+    for k, v in {**pipe.stacked, **pipe.head}.items():
+        assert v.dtype == jnp.float32, (k, v.dtype)
+    for k, hs in pipe.state.items():
+        for h in hs:
+            assert h.dtype == jnp.float32, (k, h.dtype)
