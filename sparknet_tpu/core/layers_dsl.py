@@ -52,10 +52,26 @@ def _layer(name: str, type_: str, bottoms, tops, phase: Optional[str] = None,
     if phase:
         # NetStateRule include (reference: Layers.scala:27-35 RDDLayer)
         m.add("include", _msg(phase=Enum(phase)))
-    for k, v in params.items():
-        if v is not None:
-            m.add(k, v)
+    # same None-skip + repeated-field expansion as _msg (each params key
+    # occurs once, so add-per-item preserves multimap semantics)
+    for k, v in _msg(**params).items():
+        m.add(k, v)
     return m
+
+
+def _param_specs(lr_mult, decay_mult) -> Optional[List[Message]]:
+    """Per-blob ParamSpec messages — weight first, bias second (reference:
+    caffe.proto ParamSpec; the fine-tuning knob behind
+    finetune_flickr_style/train_val.prototxt fc8_flickr's lr_mult 10/20)."""
+    if lr_mult is None and decay_mult is None:
+        return None
+    lrs = list(lr_mult) if lr_mult is not None else []
+    dks = list(decay_mult) if decay_mult is not None else []
+    specs = []
+    for i in range(max(len(lrs), len(dks))):
+        specs.append(_msg(lr_mult=lrs[i] if i < len(lrs) else None,
+                          decay_mult=dks[i] if i < len(dks) else None))
+    return specs
 
 
 def _filler(spec: Union[None, str, Dict[str, Any]]) -> Optional[Message]:
@@ -80,9 +96,12 @@ def convolution_layer(name: str, bottom: str, *, num_output: int,
                       group: int = 1,
                       weight_filler: Union[None, str, Dict] = "xavier",
                       bias_filler: Union[None, str, Dict] = None,
+                      lr_mult: Optional[Sequence[float]] = None,
+                      decay_mult: Optional[Sequence[float]] = None,
                       top: Optional[str] = None) -> Message:
     """(reference: Layers.scala:42-56 ConvolutionLayer)"""
     return _layer(name, "Convolution", bottom, top or name,
+                  param=_param_specs(lr_mult, decay_mult),
                   convolution_param=_msg(
                       num_output=num_output, kernel_size=kernel_size,
                       stride=stride, pad=pad or None, group=group if group > 1
@@ -102,9 +121,12 @@ def pooling_layer(name: str, bottom: str, *, pool: str = "MAX",
 def inner_product_layer(name: str, bottom: str, *, num_output: int,
                         weight_filler: Union[None, str, Dict] = "xavier",
                         bias_filler: Union[None, str, Dict] = None,
+                        lr_mult: Optional[Sequence[float]] = None,
+                        decay_mult: Optional[Sequence[float]] = None,
                         top: Optional[str] = None) -> Message:
     """(reference: Layers.scala:88-100 InnerProductLayer)"""
     return _layer(name, "InnerProduct", bottom, top or name,
+                  param=_param_specs(lr_mult, decay_mult),
                   inner_product_param=_msg(
                       num_output=num_output,
                       weight_filler=_filler(weight_filler),

@@ -1,18 +1,21 @@
 """Programmatic model zoo: the bundled reference families as DSL builders.
 
 The prototxt importer (proto/caffe_pb.py) is the faithful-training path —
-it reproduces the reference's fillers and per-blob lr_mult exactly.  This
-package is the *programmatic* API (the role of pycaffe's net_spec.py and
-the Scala DSL, reference: caffe/python/caffe/net_spec.py,
+it reproduces the reference's fillers exactly.  This package is the
+*programmatic* API (the role of pycaffe's net_spec.py and the Scala DSL,
+reference: caffe/python/caffe/net_spec.py,
 src/main/scala/libs/Layers.scala): each builder emits a NetParameter whose
-layer graph and parameter shapes match the bundled prototxt family —
-asserted against the reference files in tests/test_models.py.
+layer graph, parameter shapes AND per-blob lr_mult/decay_mult match the
+bundled prototxt family — asserted against the reference files in
+tests/test_models.py.
 """
 
 from .alexnet import alexnet, caffenet
 from .cifar import cifar10_full, cifar10_quick
+from .flickr_style import flickr_style
 from .googlenet import googlenet
 from .lenet import lenet
+from .rcnn import rcnn_ilsvrc13
 
 _REGISTRY = {
     "lenet": lenet,
@@ -21,6 +24,8 @@ _REGISTRY = {
     "alexnet": alexnet,
     "caffenet": caffenet,
     "googlenet": googlenet,
+    "flickr_style": flickr_style,
+    "rcnn_ilsvrc13": rcnn_ilsvrc13,
 }
 
 

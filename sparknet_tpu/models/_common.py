@@ -7,16 +7,46 @@ from __future__ import annotations
 
 from typing import Optional, Sequence
 
-from ..core.layers_dsl import net_param, softmax_layer
+from ..core.layers_dsl import _param_specs, net_param, softmax_layer
+from ..proto.textformat import Message
+
+#: layer types whose blobs take the weight/bias ParamSpec pair
+_LEARNABLE = ("Convolution", "InnerProduct")
+
+
+def stamp_param_specs(layers: Sequence[Message],
+                      lr: Sequence[float] = (1.0, 2.0),
+                      decay: Optional[Sequence[float]] = None,
+                      skip: Sequence[str] = ()) -> Sequence[Message]:
+    """Stamp the family's uniform per-blob multipliers onto every learnable
+    layer that doesn't already carry explicit ParamSpecs.
+
+    The bundled families all use weight/bias lr_mult 1/2 (the bvlc models
+    add decay_mult 1/0 — e.g. bvlc_alexnet/train_val.prototxt conv1,
+    bvlc_googlenet/train_val.prototxt throughout); the exceptions
+    (cifar10_full conv3 with no specs, ip1 with decay_mult 250/0) opt out
+    via `skip` or per-layer kwargs."""
+    for m in layers:
+        if (str(m.get("type")) not in _LEARNABLE
+                or str(m.get("name")) in skip or m.has("param")):
+            continue
+        for spec in _param_specs(lr, decay):
+            m.add("param", spec)
+    return layers
 
 
 def finish(name: str, trunk, classifier_blob: str, *, deploy: bool,
            input_shape: Sequence[int], feed, train_head,
-           deploy_name: Optional[str] = None):
+           deploy_name: Optional[str] = None,
+           deploy_softmax: bool = True):
     """`feed` is the data layer, `train_head` the loss/accuracy layers;
-    both are used only when deploy=False."""
+    both are used only when deploy=False.  `deploy_softmax=False` ends the
+    deploy form at the raw classifier scores (the R-CNN deploy net, whose
+    fc-rcnn holds transplanted SVM weights —
+    bvlc_reference_rcnn_ilsvrc13/deploy.prototxt has no prob layer)."""
     if deploy:
-        return net_param(deploy_name or name, *trunk,
-                         softmax_layer("prob", classifier_blob),
+        head = [softmax_layer("prob", classifier_blob)] if deploy_softmax \
+            else []
+        return net_param(deploy_name or name, *trunk, *head,
                          inputs={"data": tuple(input_shape)})
     return net_param(name, feed, *trunk, *train_head)

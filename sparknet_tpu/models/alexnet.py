@@ -12,7 +12,7 @@ from ..core.layers_dsl import (accuracy_layer, convolution_layer,
                                lrn_layer, memory_data_layer,
                                pooling_layer, relu_layer,
                                softmax_with_loss_layer)
-from ._common import finish
+from ._common import finish, stamp_param_specs
 
 
 def _block12(i: int, bottom: str, conv_kw, norm_after_pool: bool):
@@ -35,7 +35,9 @@ def _block12(i: int, bottom: str, conv_kw, norm_after_pool: bool):
 
 
 def _alexnet_family(name: str, batch: int, n_classes: int, crop: int,
-                    norm_after_pool: bool, deploy: bool = False):
+                    norm_after_pool: bool, deploy: bool = False,
+                    classifier: str = "fc8",
+                    classifier_lr=None, deploy_softmax: bool = True):
     b1, out1 = _block12(1, "data",
                         dict(num_output=96, kernel_size=11, stride=4),
                         norm_after_pool)
@@ -60,17 +62,24 @@ def _alexnet_family(name: str, batch: int, n_classes: int, crop: int,
         inner_product_layer("fc7", "fc6", num_output=4096),
         relu_layer("relu7", "fc7"),
         dropout_layer("drop7", "fc7", ratio=0.5),
-        inner_product_layer("fc8", "fc7", num_output=n_classes),
+        inner_product_layer(classifier, "fc7", num_output=n_classes,
+                            lr_mult=classifier_lr,
+                            decay_mult=(1.0, 0.0) if classifier_lr else None),
     ]
+    # the family's uniform weight/bias multipliers (train_val.prototxt
+    # lr_mult 1/2, decay_mult 1/0 on every conv/fc); an explicit
+    # classifier_lr (fine-tuning) was stamped above and is left alone
+    stamp_param_specs(trunk, lr=(1.0, 2.0), decay=(1.0, 0.0))
     # deploy keeps the dropout layers — test-time no-ops, as in the
     # reference deploy files
     return finish(
-        name, trunk, "fc8", deploy=deploy,
+        name, trunk, classifier, deploy=deploy,
+        deploy_softmax=deploy_softmax,
         input_shape=(batch, 3, crop, crop),
         feed=memory_data_layer("data", ["data", "label"], batch=batch,
                                channels=3, height=crop, width=crop),
-        train_head=[softmax_with_loss_layer("loss", ["fc8", "label"]),
-                    accuracy_layer("accuracy", ["fc8", "label"],
+        train_head=[softmax_with_loss_layer("loss", [classifier, "label"]),
+                    accuracy_layer("accuracy", [classifier, "label"],
                                    phase="TEST")])
 
 

@@ -8,7 +8,7 @@ from ..core.layers_dsl import (accuracy_layer, convolution_layer,
                                inner_product_layer, lrn_layer,
                                memory_data_layer, pooling_layer,
                                relu_layer, softmax_with_loss_layer)
-from ._common import finish
+from ._common import finish, stamp_param_specs
 
 
 def _finish_cifar(name: str, trunk, cls_blob: str, batch: int,
@@ -43,6 +43,8 @@ def cifar10_quick(batch: int = 100, n_classes: int = 10,
         inner_product_layer("ip1", "pool3", num_output=64),
         inner_product_layer("ip2", "ip1", num_output=n_classes),
     ]
+    # cifar10_quick_train_test.prototxt: lr_mult 1/2 throughout, no decay
+    stamp_param_specs(trunk, lr=(1.0, 2.0))
     return _finish_cifar("CIFAR10_quick", trunk, "ip2", batch, deploy,
                          "CIFAR10_quick_test")
 
@@ -68,7 +70,14 @@ def cifar10_full(batch: int = 100, n_classes: int = 10,
                           pad=2),
         relu_layer("relu3", "conv3"),
         pooling_layer("pool3", "conv3", pool="AVE", kernel_size=3, stride=2),
-        inner_product_layer("ip1", "pool3", num_output=n_classes),
+        # ip1's decay_mult 250/0 is the family's L2 quirk — the prototxt
+        # regularizes the classifier 250x harder than the convs
+        # (cifar10_full_train_test.prototxt ip1 param blocks)
+        inner_product_layer("ip1", "pool3", num_output=n_classes,
+                            lr_mult=(1.0, 2.0), decay_mult=(250.0, 0.0)),
     ]
+    # conv1/conv2 carry lr_mult 1/2; conv3 has NO param specs in the
+    # reference (defaults 1/1), so it is skipped
+    stamp_param_specs(trunk, lr=(1.0, 2.0), skip=("conv3",))
     return _finish_cifar("CIFAR10_full", trunk, "ip1", batch, deploy,
                          "CIFAR10_full_deploy")

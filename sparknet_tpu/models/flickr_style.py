@@ -1,0 +1,27 @@
+"""Flickr Style fine-tuning net (reference:
+caffe/models/finetune_flickr_style/train_val.prototxt, deploy.prototxt;
+workflow: examples/03-fine-tuning.ipynb, docs readme cited in
+models/finetune_flickr_style/readme.md).
+
+CaffeNet's trunk verbatim, with the 1000-way fc8 replaced by a fresh
+20-way `fc8_flickr` carrying lr_mult 10/20 — ten times the trunk's
+multipliers, because that layer starts from random while everything else
+warm-starts from the bvlc_reference_caffenet weights
+(train_val.prototxt:351-359 comment).  Name-matched weight copy
+(`Solver.copy_trained_layers_from`) is the loading mechanism, exactly as
+`Net::CopyTrainedLayersFrom` is in the reference flow."""
+
+from __future__ import annotations
+
+from .alexnet import _alexnet_family
+
+
+def flickr_style(batch: int = 50, n_classes: int = 20, crop: int = 227,
+                 deploy: bool = False):
+    """FlickrStyleCaffeNet: batch 50 (train_val.prototxt batch_size),
+    20 style classes, 227 crop.  deploy=True gives the deploy.prototxt
+    form (input decl + Softmax prob)."""
+    return _alexnet_family("FlickrStyleCaffeNet", batch, n_classes, crop,
+                           norm_after_pool=True, deploy=deploy,
+                           classifier="fc8_flickr",
+                           classifier_lr=(10.0, 20.0))

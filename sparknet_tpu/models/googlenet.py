@@ -16,6 +16,7 @@ from ..core.layers_dsl import (accuracy_layer, concat_layer,
                                relu_layer, softmax_layer,
                                softmax_with_loss_layer)
 from ..proto.textformat import Message
+from ._common import stamp_param_specs
 
 # (1x1, 3x3_reduce, 3x3, 5x5_reduce, 5x5, pool_proj) per inception block
 INCEPTION_CFG = {
@@ -145,6 +146,9 @@ def googlenet(batch: int = 32, n_classes: int = 1000, crop: int = 224,
         inner_product_layer("loss3/classifier", "pool5/7x7_s1",
                             num_output=n_classes),
     ]
+    # bvlc_googlenet/train_val.prototxt: every learnable layer carries
+    # lr_mult 1/2 + decay_mult 1/0 (64 param pairs)
+    stamp_param_specs(layers, lr=(1.0, 2.0), decay=(1.0, 0.0))
     if deploy:
         layers.append(softmax_layer("prob", "loss3/classifier"))
         return net_param("GoogleNet", *layers,

@@ -7,7 +7,7 @@ from ..core.layers_dsl import (accuracy_layer, convolution_layer,
                                inner_product_layer, memory_data_layer,
                                pooling_layer, relu_layer,
                                softmax_with_loss_layer)
-from ._common import finish
+from ._common import finish, stamp_param_specs
 
 
 def lenet(batch: int = 64, n_classes: int = 10, deploy: bool = False):
@@ -23,6 +23,9 @@ def lenet(batch: int = 64, n_classes: int = 10, deploy: bool = False):
         relu_layer("relu1", "ip1"),
         inner_product_layer("ip2", "ip1", num_output=n_classes),
     ]
+    # lenet_train_test.prototxt: lr_mult 1/2 on every learnable layer,
+    # no decay_mult overrides
+    stamp_param_specs(trunk, lr=(1.0, 2.0))
     return finish(
         "LeNet", trunk, "ip2", deploy=deploy,
         input_shape=(batch, 1, 28, 28),

@@ -1,0 +1,22 @@
+"""R-CNN ILSVRC13 detector net (reference:
+caffe/models/bvlc_reference_rcnn_ilsvrc13/deploy.prototxt, readme.md).
+
+CaffeNet's trunk with the classifier replaced by `fc-rcnn` — 200 ILSVRC13
+detection classes whose weights were transplanted from the R-CNN SVMs, so
+the deploy net ends at the RAW scores with no Softmax (the reference
+deploy.prototxt has no prob layer; scores are margins, not logits).
+Deploy-only: the reference ships no train_val for this model.  Scored
+windows come from the window-data pipeline (`data/window_data.py`) and the
+detect CLI (`tools.cmd_detect`), mirroring examples/detection.ipynb."""
+
+from __future__ import annotations
+
+from .alexnet import _alexnet_family
+
+
+def rcnn_ilsvrc13(batch: int = 10, n_classes: int = 200, crop: int = 227):
+    """R-CNN-ilsvrc13 deploy form: input (batch, 3, 227, 227) —
+    deploy.prototxt's 10-window default — ending at fc-rcnn."""
+    return _alexnet_family("R-CNN-ilsvrc13", batch, n_classes, crop,
+                           norm_after_pool=True, deploy=True,
+                           classifier="fc-rcnn", deploy_softmax=False)

@@ -94,3 +94,142 @@ def test_autoencoder_training_learns():
     for _ in range(60):
         last = solver.step(1)
     assert np.isfinite(last) and last < first * 0.8, (first, last)
+
+
+def test_finetuning_workflow_name_matched_warm_start():
+    """The fine-tuning recipe (reference: examples/03-fine-tuning.ipynb,
+    models/finetune_flickr_style — train CaffeNet, then `caffe train
+    -weights source.caffemodel` on a net whose head is renamed): layers
+    that name-match the saved .caffemodel warm-start, the renamed head
+    keeps its fresh init with 10x lr_mult, and training proceeds."""
+    import tempfile
+
+    from sparknet_tpu.core.layers_dsl import (convolution_layer,
+                                              inner_product_layer,
+                                              memory_data_layer, net_param,
+                                              pooling_layer, relu_layer,
+                                              softmax_with_loss_layer)
+    from sparknet_tpu.models import get_model
+
+    rng = np.random.RandomState(0)
+    centers = rng.rand(10, 1, 28, 28).astype(np.float32)
+
+    def batch(n_cls):
+        y = rng.randint(0, n_cls, (16,))
+        x = centers[y] + rng.randn(16, 1, 28, 28).astype(np.float32) * 0.05
+        return {"data": x, "label": y.astype(np.int32)}
+
+    # 1. train the source model briefly and snapshot it as a .caffemodel
+    src = Solver(_solver(get_model("lenet", batch=16),
+                         'base_lr: 0.01\nlr_policy: "fixed"\n'
+                         'momentum: 0.9\nrandom_seed: 2\n'))
+    src.set_train_data(lambda: batch(10))
+    src.step(5)
+    tmp = tempfile.mkdtemp()
+    weights_path = os.path.join(tmp, "source.caffemodel")
+    src.save_caffemodel(weights_path)
+
+    # 2. the fine-tune net: same trunk NAMES, head renamed + resized
+    # (ip2 -> ip2_style, 10 -> 5 classes) with the flickr-style 10x lrs
+    ft_net = net_param(
+        "LeNetStyle",
+        memory_data_layer("mnist", ["data", "label"], batch=16,
+                          channels=1, height=28, width=28),
+        convolution_layer("conv1", "data", num_output=20, kernel_size=5,
+                          lr_mult=(1.0, 2.0)),
+        pooling_layer("pool1", "conv1", pool="MAX", kernel_size=2, stride=2),
+        convolution_layer("conv2", "pool1", num_output=50, kernel_size=5,
+                          lr_mult=(1.0, 2.0)),
+        pooling_layer("pool2", "conv2", pool="MAX", kernel_size=2, stride=2),
+        inner_product_layer("ip1", "pool2", num_output=500,
+                            lr_mult=(1.0, 2.0)),
+        relu_layer("relu1", "ip1"),
+        inner_product_layer("ip2_style", "ip1", num_output=5,
+                            lr_mult=(10.0, 20.0)),
+        softmax_with_loss_layer("loss", ["ip2_style", "label"]),
+    )
+    ft = Solver(_solver(ft_net, 'base_lr: 0.01\nlr_policy: "fixed"\n'
+                                'momentum: 0.9\nrandom_seed: 7\n'))
+    fresh_head = np.asarray(ft.params["ip2_style/0"]).copy()
+
+    ft.copy_trained_layers_from(weights_path)
+
+    # trunk warm-started from the source's TRAINED values...
+    for key in ["conv1/0", "conv1/1", "conv2/0", "ip1/0"]:
+        np.testing.assert_array_equal(np.asarray(ft.params[key]),
+                                      np.asarray(src.params[key]))
+    # ...head untouched (absent from the caffemodel by name)
+    np.testing.assert_array_equal(np.asarray(ft.params["ip2_style/0"]),
+                                  fresh_head)
+    # and the 10x head multiplier is live in the update pipeline
+    assert ft.net.lr_multipliers()["ip2_style/0"] == 10.0
+
+    # 3. fine-tuning trains
+    ft.set_train_data(lambda: batch(5))
+    first = ft.step(1)
+    for _ in range(10):
+        last = ft.step(1)
+    assert np.isfinite(last) and last < first, (first, last)
+
+
+def test_net_surgery_fc_to_conv_cast():
+    """The net-surgery example (reference: examples/net_surgery.ipynb
+    "Casting a Classifier into a Fully Convolutional Network"): reshape
+    trained InnerProduct weights into equivalent convolutions, get
+    identical scores at the aligned position, and score a LARGER image
+    densely in one forward pass."""
+    from sparknet_tpu.core.layers_dsl import (convolution_layer, net_param,
+                                              pooling_layer, relu_layer)
+    from sparknet_tpu.core.net import Net
+    from sparknet_tpu.models import get_model
+
+    lenet = Net(get_model("lenet", batch=1, deploy=True), "TEST")
+    params = lenet.init_params(3)
+    rng = np.random.RandomState(1)
+    img = rng.rand(1, 1, 28, 28).astype(np.float32)
+    logits = np.asarray(lenet.forward(params, {"data": img})["ip2"])
+
+    # the conv-ized twin: ip1 (500 x 50*4*4) becomes a 4x4 conv over
+    # pool2's 50x4x4 output, ip2 (10 x 500) a 1x1 conv
+    def convized(h, w):
+        return Net(net_param(
+            "LeNetConv",
+            convolution_layer("conv1", "data", num_output=20, kernel_size=5),
+            pooling_layer("pool1", "conv1", pool="MAX", kernel_size=2,
+                          stride=2),
+            convolution_layer("conv2", "pool1", num_output=50,
+                              kernel_size=5),
+            pooling_layer("pool2", "conv2", pool="MAX", kernel_size=2,
+                          stride=2),
+            convolution_layer("ip1conv", "pool2", num_output=500,
+                              kernel_size=4),
+            relu_layer("relu1", "ip1conv"),
+            convolution_layer("ip2conv", "ip1conv", num_output=10,
+                              kernel_size=1),
+            inputs={"data": (1, 1, h, w)}), "TEST")
+
+    # the surgery: params are a dict, casting is a reshape (the ipynb's
+    # flat[...] copy) — IP weights are (out, C*H*W) over C,H,W order
+    surgery = convized(28, 28)
+    cast = dict(surgery.init_params(0))
+    for key in ["conv1/0", "conv1/1", "conv2/0", "conv2/1"]:
+        cast[key] = params[key]
+    cast["ip1conv/0"] = params["ip1/0"].reshape(500, 50, 4, 4)
+    cast["ip1conv/1"] = params["ip1/1"]
+    cast["ip2conv/0"] = params["ip2/0"].reshape(10, 500, 1, 1)
+    cast["ip2conv/1"] = params["ip2/1"]
+
+    out = np.asarray(surgery.forward(cast, {"data": img})["ip2conv"])
+    assert out.shape == (1, 10, 1, 1)
+    np.testing.assert_allclose(out[:, :, 0, 0], logits, rtol=1e-5,
+                               atol=1e-5)
+
+    # dense application: a 40x40 canvas yields a 4x4 score map in ONE
+    # forward; position (0,0)'s receptive field is exactly input[0:28,0:28]
+    big = rng.rand(1, 1, 40, 40).astype(np.float32)
+    big[:, :, :28, :28] = img
+    dense = convized(40, 40)
+    heat = np.asarray(dense.forward(cast, {"data": big})["ip2conv"])
+    assert heat.shape == (1, 10, 4, 4)
+    np.testing.assert_allclose(heat[:, :, 0, 0], logits, rtol=1e-5,
+                               atol=1e-5)

@@ -25,6 +25,8 @@ REF = {
     "caffenet": ("caffe/models/bvlc_reference_caffenet/train_val.prototxt",
                  None),
     "googlenet": ("caffe/models/bvlc_googlenet/train_val.prototxt", None),
+    "flickr_style": ("caffe/models/finetune_flickr_style/train_val.prototxt",
+                     None),
 }
 
 
@@ -58,12 +60,56 @@ def test_model_matches_reference_shapes(name):
     ours_acc = acc(get_model(name, batch=4))
     ref_acc = acc(caffe_pb.load_net_prototxt(path))
     assert ours_acc == ref_acc, (ours_acc, ref_acc)
+    # per-blob lr_mult/decay_mult must match too (fine-tuning semantics —
+    # e.g. fc8_flickr's 10/20 vs the trunk's 1/2, cifar10_full ip1's
+    # decay_mult 250/0)
+    assert ours.lr_multipliers() == ref.lr_multipliers(), (
+        {k: (ours.lr_multipliers().get(k), ref.lr_multipliers().get(k))
+         for k in set(ours.lr_multipliers()) | set(ref.lr_multipliers())
+         if ours.lr_multipliers().get(k) != ref.lr_multipliers().get(k)})
+    assert ours.decay_multipliers() == ref.decay_multipliers()
+
+
+def test_rcnn_matches_reference_deploy():
+    """bvlc_reference_rcnn_ilsvrc13 is deploy-only: CaffeNet trunk ending
+    at the raw 200-way fc-rcnn scores (transplanted SVM weights), with NO
+    Softmax — scores are margins, not logits (deploy.prototxt, readme.md)."""
+    rel = "caffe/models/bvlc_reference_rcnn_ilsvrc13/deploy.prototxt"
+    path = reference_path(rel)
+    if not os.path.exists(path):
+        pytest.skip(f"{rel} not in reference checkout")
+    ours = Net(get_model("rcnn_ilsvrc13", batch=4), "TEST")
+    ref = Net(caffe_pb.load_net_prototxt(path), "TEST", batch_override=4)
+    assert _param_shapes(ours) == _param_shapes(ref)
+    np_ = get_model("rcnn_ilsvrc13", batch=4)
+    assert not any(str(l.type) == "Softmax" for l in np_.layers)
+    assert ours.blob_shapes["fc-rcnn"] == (4, 200)
+
+
+def test_flickr_style_is_a_finetune_of_caffenet():
+    """The fine-tuning contract (examples/03-fine-tuning.ipynb flow): every
+    flickr layer except the fresh head name-matches a caffenet layer, so
+    `copy_trained_layers_from` a caffenet .caffemodel warm-starts the whole
+    trunk and leaves fc8_flickr at its random init
+    (Net::CopyTrainedLayersFrom name matching, net.cpp:805-830)."""
+    flickr = Net(get_model("flickr_style", batch=2), "TRAIN")
+    caffenet = Net(get_model("caffenet", batch=2), "TRAIN")
+
+    def learnable(net):
+        return {k.rsplit("/", 1)[0] for k in net.param_inits}
+
+    assert learnable(flickr) - learnable(caffenet) == {"fc8_flickr"}
+    # and the fresh head trains 10x hotter than the warm trunk
+    lrs = flickr.lr_multipliers()
+    assert lrs["fc8_flickr/0"] == 10.0 and lrs["fc8_flickr/1"] == 20.0
+    assert lrs["conv1/0"] == 1.0 and lrs["conv1/1"] == 2.0
 
 
 def test_registry_and_training():
     assert model_names() == sorted(["lenet", "cifar10_quick",
                                     "cifar10_full", "alexnet", "caffenet",
-                                    "googlenet"])
+                                    "googlenet", "flickr_style",
+                                    "rcnn_ilsvrc13"])
     with pytest.raises(ValueError, match="unknown model"):
         get_model("resnet50")
 
@@ -98,6 +144,7 @@ DEPLOY_REF = {
     "alexnet": "caffe/models/bvlc_alexnet/deploy.prototxt",
     "caffenet": "caffe/models/bvlc_reference_caffenet/deploy.prototxt",
     "googlenet": "caffe/models/bvlc_googlenet/deploy.prototxt",
+    "flickr_style": "caffe/models/finetune_flickr_style/deploy.prototxt",
 }
 
 
