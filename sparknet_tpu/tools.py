@@ -197,7 +197,8 @@ def cmd_classify(args) -> int:
         raw_scale=args.raw_scale,
         input_scale=args.input_scale,
         channel_swap=[int(v) for v in args.channel_swap.split(",")]
-        if args.channel_swap else None)
+        if args.channel_swap else None,
+        fuse_1x1=args.fuse_1x1)
     imgs = [load_image(p) for p in args.inputs]
     probs = clf.predict(imgs, oversample_crops=not args.center_only)
     np.save(args.output, probs)
@@ -377,6 +378,8 @@ def register(sub) -> None:
     cl.add_argument("--input_scale", type=float)
     cl.add_argument("--channel_swap")
     cl.add_argument("--center_only", action="store_true")
+    # serving-path 1x1 sibling-conv fusion (GOOGLENET_PROFILE.md)
+    cl.add_argument("--fuse_1x1", action="store_true")
     cl.set_defaults(fn=cmd_classify)
 
     de = sub.add_parser("detect")

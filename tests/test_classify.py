@@ -217,3 +217,72 @@ def test_classify_and_draw_cli(tmp_path, deploy_file):
     dot_out = tmp_path / "net.dot"
     assert main(["draw_net", deploy_file, str(dot_out)]) == 0
     assert dot_out.read_text().startswith("digraph")
+
+
+INCEPTION_DEPLOY = """
+name: "tiny_inception_deploy"
+input: "data"
+input_shape { dim: 2 dim: 6 dim: 8 dim: 8 }
+layer { name: "b1x1" type: "Convolution" bottom: "data" top: "b1x1"
+  convolution_param { num_output: 3 kernel_size: 1
+    weight_filler { type: "xavier" } } }
+layer { name: "b3x3_reduce" type: "Convolution" bottom: "data"
+  top: "b3x3_reduce" convolution_param { num_output: 2 kernel_size: 1
+    weight_filler { type: "xavier" } } }
+layer { name: "b3x3" type: "Convolution" bottom: "b3x3_reduce" top: "b3x3"
+  convolution_param { num_output: 4 kernel_size: 3 pad: 1
+    weight_filler { type: "xavier" } } }
+layer { name: "cat" type: "Concat" bottom: "b1x1" bottom: "b3x3"
+  top: "cat" }
+layer { name: "ip" type: "InnerProduct" bottom: "cat" top: "ip"
+  inner_product_param { num_output: 5 weight_filler { type: "xavier" } } }
+layer { name: "prob" type: "Softmax" bottom: "ip" top: "prob" }
+"""
+
+
+def test_classifier_fuse_1x1_serving_exactness(tmp_path):
+    """`Classifier(fuse_1x1=True)` rewrites sibling 1x1 convs into one
+    GEMM AFTER loading weights under their original names, so serving a
+    trained net fused is a constructor flag with bit-identical setup
+    semantics (core/fuse.py; measured serving win in
+    GOOGLENET_PROFILE.md round-3 continuation)."""
+    p = tmp_path / "deploy.prototxt"
+    p.write_text(INCEPTION_DEPLOY)
+
+    # train-free "pretrained" weights: save the plain classifier's init
+    plain = Classifier(str(p))
+    wpath = str(tmp_path / "w.caffemodel")
+    from sparknet_tpu.proto.binaryproto import write_caffemodel
+
+    write_caffemodel(wpath, plain.net.get_weights(plain.params))
+
+    fused = Classifier(str(p), wpath, fuse_1x1=True)
+    # the sibling 1x1s are gone from the live net, fused replacement in
+    names = set(fused.net.layer_names())
+    assert "b1x1" not in names and "b3x3_reduce" not in names
+    assert any("fused" in n for n in names), names
+
+    rng = np.random.RandomState(0)
+    imgs = [rng.rand(8, 8, 6).astype(np.float32) for _ in range(2)]
+    plain_with_w = Classifier(str(p), wpath)
+    a = plain_with_w.predict(imgs, oversample_crops=False)
+    b = fused.predict(imgs, oversample_crops=False)
+    np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-6)
+
+
+def test_classify_cli_fuse_flag(tmp_path):
+    """--fuse_1x1 rides through the classify verb (tools.cmd_classify)."""
+    from PIL import Image
+
+    from sparknet_tpu.cli import main
+
+    p = tmp_path / "deploy.prototxt"
+    p.write_text(INCEPTION_DEPLOY.replace("dim: 6", "dim: 3"))
+    img = tmp_path / "x.png"
+    Image.fromarray((np.random.RandomState(0).rand(8, 8, 3) * 255)
+                    .astype(np.uint8)).save(img)
+    out = tmp_path / "probs.npy"
+    rc = main(["classify", str(img), "--model", str(p), "--output",
+               str(out), "--center_only", "--fuse_1x1"])
+    assert rc == 0
+    assert np.load(out).shape == (1, 5)
