@@ -82,13 +82,10 @@ def measure_chain(step, params, state, batch_fn, batch):
         float(loss)
         return time.perf_counter() - t0
 
-    run_chain(WARMUP_STEPS)
-    rates = []
-    for _ in range(3):
-        short = run_chain(2)
-        long = run_chain(2 + MEASURE_STEPS)
-        rates.append(MEASURE_STEPS * batch / (long - short))
-    return float(np.median(rates))
+    from sparknet_tpu.utils.timers import differenced_chain_s
+
+    return batch / differenced_chain_s(run_chain, MEASURE_STEPS,
+                                       warmup=WARMUP_STEPS)
 
 
 def bench_model(name, model_dir, batch, crop, n_classes=1000):
@@ -251,10 +248,17 @@ def bench_inference(name, model_dir, batch, fuse_1x1=False):
     fwd_flops = 2.0 * sum(forward_macs(net).values())
     peak = peak_flops(jax.devices()[0])
 
-    def forward(params, data, salt):
-        p = {k: (v.astype(jnp.bfloat16)
-                 if jnp.issubdtype(v.dtype, jnp.floating) else v)
-             for k, v in params.items()}
+    # one-time load-time cast, OUTSIDE the timed step — a real bf16
+    # serving deployment converts weights once, so the per-step program
+    # must not re-cast ~100s of MB each call (stat blobs stay fp32, as
+    # in make_loss_fn)
+    stat_keys = set(net.stat_keys())
+    params = {k: (v.astype(jnp.bfloat16)
+                  if (k not in stat_keys
+                      and jnp.issubdtype(v.dtype, jnp.floating)) else v)
+              for k, v in params.items()}
+
+    def forward(p, data, salt):
         blobs = net.forward(p, {in_blob: (data + salt)
                                 .astype(jnp.bfloat16)})
         out = blobs[out_blob]
@@ -286,13 +290,10 @@ def bench_inference(name, model_dir, batch, fuse_1x1=False):
         float(out.reshape(-1)[0])
         return time.perf_counter() - t0
 
-    run_chain(WARMUP_STEPS)
-    rates = []
-    for _ in range(3):
-        short = run_chain(2)
-        long = run_chain(2 + MEASURE_STEPS)
-        rates.append(MEASURE_STEPS * batch / (long - short))
-    infer = float(np.median(rates))
+    from sparknet_tpu.utils.timers import differenced_chain_s
+
+    infer = batch / differenced_chain_s(run_chain, MEASURE_STEPS,
+                                        warmup=WARMUP_STEPS)
     out = {"model": name, "batch": batch, "fused_1x1": bool(fuse_1x1),
            "infer_imgs_per_sec": round(infer, 1),
            "infer_mfu": round(fwd_flops * infer / batch / peak, 4)}

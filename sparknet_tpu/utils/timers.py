@@ -44,3 +44,27 @@ class DeviceTimer(CPUTimer):
             o.block_until_ready()
         self._outputs = []
         return super().stop()
+
+
+def differenced_chain_s(run_chain, n: int, *, windows: int = 3,
+                        warmup: int = 2) -> float:
+    """Median per-call seconds from differenced dependency chains.
+
+    `run_chain(m)` must execute a chain of m calls where call k+1's
+    arguments depend on call k's outputs with bitwise-distinct values,
+    and must end by FETCHING a value (float()/np.asarray) — NOT
+    block_until_ready, which returns before deferred execution completes
+    on tunneled platforms.  Differencing a short window against a long
+    one cancels the fixed fetch latency.  This is the one shared timing
+    protocol (bench.py measure_chain/bench_inference, `cli time` totals);
+    see BENCH_NOTES.md round-3 "measurement trap" for why every clause
+    matters.
+    """
+    run_chain(warmup)
+    per_call = []
+    for _ in range(windows):
+        short = run_chain(2)
+        long = run_chain(2 + n)
+        per_call.append((long - short) / n)
+    per_call.sort()
+    return per_call[len(per_call) // 2]
