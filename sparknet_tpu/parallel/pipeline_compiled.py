@@ -79,6 +79,14 @@ class CompiledPipeline:
     stacked_params: dict[str, Array] with leading stage axis S.
     head_params: dict[str, Array], replicated (may be empty).
 
+    `dp > 1` turns the run into the standard DPxPP hybrid over a
+    (data, pipe) mesh of dp*S devices: each of the dp replica groups runs
+    the full pipeline on its shard of every microbatch, and the loss (and
+    by transposition every gradient) is the replica mean — one
+    compiler-inserted psum over `data` riding ICI, exactly the averaging
+    contract of the reference's intra-node P2PSync
+    (parallel.cpp:325-381) layered onto the pipeline.
+
     The optimizer is the framework's shared update pipeline driven by
     `solver_param` (type/LR policy/momentum/weight decay/clip), so a
     CompiledPipeline round updates exactly like every other trainer."""
@@ -89,6 +97,7 @@ class CompiledPipeline:
                  head_params: Optional[Dict[str, Any]] = None,
                  n_micro: int, mesh: Optional[Mesh] = None,
                  axis: str = "pipe",
+                 dp: int = 1, data_axis: str = "data",
                  devices: Optional[Sequence[Any]] = None,
                  remat: bool = True,
                  precision: Optional[str] = None) -> None:
@@ -97,22 +106,36 @@ class CompiledPipeline:
         self.loss_fn = loss_fn
         self.n_micro = int(n_micro)
         self.axis = axis
+        self.dp = int(dp)
+        if self.dp < 1:
+            raise ValueError(f"dp must be >= 1, got {dp}")
+        self.data_axis = data_axis
         sizes = {int(v.shape[0]) for v in stacked_params.values()}
         if len(sizes) != 1:
             raise ValueError(f"stacked_params leading (stage) dims differ: "
                              f"{sorted(sizes)}")
         self.n_stages = sizes.pop()
         if mesh is None:
+            need = self.n_stages * self.dp
             devs = list(devices if devices is not None
-                        else jax.devices()[:self.n_stages])
-            if len(devs) < self.n_stages:
-                raise ValueError(f"need {self.n_stages} devices, have "
+                        else jax.devices()[:need])
+            if len(devs) < need:
+                raise ValueError(f"need {need} devices, have "
                                  f"{len(devs)}")
-            mesh = Mesh(np.array(devs), (axis,))
+            # DPxPP hybrid: replica groups over `data`, stage chain over
+            # `pipe` — the standard large-model mesh (data outermost so
+            # each replica's ppermute hops stay between mesh neighbors)
+            mesh = (Mesh(np.array(devs).reshape(self.dp, self.n_stages),
+                         (data_axis, axis)) if self.dp > 1
+                    else Mesh(np.array(devs), (axis,)))
         if mesh.shape[axis] != self.n_stages:
             raise ValueError(
                 f"mesh axis {axis!r} has {mesh.shape[axis]} devices but "
                 f"params stack {self.n_stages} stages")
+        if self.dp > 1 and mesh.shape.get(data_axis) != self.dp:
+            raise ValueError(
+                f"mesh axis {data_axis!r} has "
+                f"{mesh.shape.get(data_axis)} devices but dp={self.dp}")
         self.mesh = mesh
         self.remat = bool(remat)
         self.precision = resolve_precision(solver_param, precision)
@@ -153,6 +176,7 @@ class CompiledPipeline:
     # ---------------------------------------------------------- the round
     def _make_pipe_loss(self):
         S, M, axis = self.n_stages, self.n_micro, self.axis
+        dp, data_axis = self.dp, self.data_axis
         T = M + S - 1
         block = (jax.checkpoint(self.block_fn) if self.remat
                  else self.block_fn)
@@ -200,11 +224,21 @@ class CompiledPipeline:
             (_, loss_acc), _ = lax.scan(
                 tick, (act0, jnp.float32(0.0)), jnp.arange(T))
             # only the last stage accumulated; psum replicates the total
-            return lax.psum(loss_acc, axis) / M
+            total = lax.psum(loss_acc, axis) / M
+            if dp > 1:
+                # each data replica saw its shard of every microbatch;
+                # the round loss (and through its transpose, every
+                # gradient) is the replica MEAN — the P2PSync
+                # root-scales-by-1/n contract (parallel.cpp:325-381)
+                total = lax.pmean(total, data_axis)
+            return total
 
+        # microbatch stacks are [M, mb, ...]: M stays whole, the
+        # within-micro batch dim shards over `data` replicas
+        xs_spec = P(None, data_axis) if dp > 1 else P()
         return _shard_map(
             pipe_loss_sharded, self.mesh,
-            in_specs=(P(axis), P(), P(), P()), out_specs=P())
+            in_specs=(P(axis), P(), xs_spec, xs_spec), out_specs=P())
 
     def _make_step(self):
         from ..solver.solver import make_update_fn
@@ -229,10 +263,7 @@ class CompiledPipeline:
 
         return step
 
-    def step(self, xs, ys) -> float:
-        """One training round: xs/ys are [M, micro_batch, ...] stacks of
-        the round's microbatches (M = n_micro)."""
-        xs, ys = jnp.asarray(xs), jnp.asarray(ys)
+    def _validate_round(self, xs, ys):
         if xs.shape[0] != self.n_micro or ys.shape[0] != self.n_micro:
             raise ValueError(
                 f"xs/ys leading dims {xs.shape[0]}/{ys.shape[0]} != "
@@ -241,6 +272,16 @@ class CompiledPipeline:
             raise ValueError(
                 f"ys shape {ys.shape} does not pair with xs {xs.shape}: "
                 f"expected [n_micro, micro_batch, ...] targets")
+        if self.dp > 1 and xs.shape[1] % self.dp:
+            raise ValueError(
+                f"micro batch {xs.shape[1]} does not divide over "
+                f"dp={self.dp} data replicas")
+
+    def step(self, xs, ys) -> float:
+        """One training round: xs/ys are [M, micro_batch, ...] stacks of
+        the round's microbatches (M = n_micro)."""
+        xs, ys = jnp.asarray(xs), jnp.asarray(ys)
+        self._validate_round(xs, ys)
         flat = self._flatten(self.stacked, self.head)
         new_p, new_s, loss = self._step(
             flat, self.state, jnp.int32(self.iter),
@@ -252,8 +293,9 @@ class CompiledPipeline:
 
     def loss(self, xs, ys) -> float:
         """Forward-only round loss (no update) — for equivalence tests."""
-        return float(self._loss_jit(self.stacked, self.head,
-                                    jnp.asarray(xs), jnp.asarray(ys)))
+        xs, ys = jnp.asarray(xs), jnp.asarray(ys)
+        self._validate_round(xs, ys)
+        return float(self._loss_jit(self.stacked, self.head, xs, ys))
 
     # ------------------------------------------------------- checkpointing
     def snapshot(self, path: str) -> str:

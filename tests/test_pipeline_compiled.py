@@ -210,3 +210,46 @@ def test_bfloat16_path_trains_with_fp32_master_weights():
     for k, hs in pipe.state.items():
         for h in hs:
             assert h.dtype == jnp.float32, (k, h.dtype)
+
+
+def test_dp_pp_hybrid_matches_pipe_only_trajectory():
+    """dp=2 over a (data, pipe) mesh: each replica group runs the full
+    pipeline on half of every microbatch, gradients replica-mean over the
+    `data` axis — three training rounds must match the pipe-only trainer
+    (and through it, the plain single-device step) exactly."""
+    _need_devices(2 * S)
+    stacked, head, xs0, ys0 = _init()
+    solo = CompiledPipeline(_solver_param(), block_fn=block_fn,
+                            loss_fn=loss_fn, stacked_params=stacked,
+                            head_params=head, n_micro=M,
+                            devices=jax.devices()[:S])
+    hybrid = CompiledPipeline(_solver_param(), block_fn=block_fn,
+                              loss_fn=loss_fn, stacked_params=stacked,
+                              head_params=head, n_micro=M, dp=2)
+    assert dict(hybrid.mesh.shape) == {"data": 2, "pipe": S}
+
+    rng = np.random.RandomState(7)
+    for _ in range(3):
+        xs = rng.randn(M, MB, F).astype(np.float32)
+        ys = rng.randint(0, C, (M, MB)).astype(np.int32)
+        l_solo = solo.step(xs, ys)
+        l_hyb = hybrid.step(xs, ys)
+        np.testing.assert_allclose(l_hyb, l_solo, rtol=2e-5)
+    for k in stacked:
+        np.testing.assert_allclose(np.asarray(hybrid.stacked[k]),
+                                   np.asarray(solo.stacked[k]),
+                                   rtol=3e-5, atol=1e-6)
+    for k in head:
+        np.testing.assert_allclose(np.asarray(hybrid.head[k]),
+                                   np.asarray(solo.head[k]),
+                                   rtol=3e-5, atol=1e-6)
+
+
+def test_dp_pp_rejects_bad_shapes():
+    _need_devices(2 * S)
+    stacked, head, xs, ys = _init()
+    hybrid = CompiledPipeline(_solver_param(), block_fn=block_fn,
+                              loss_fn=loss_fn, stacked_params=stacked,
+                              head_params=head, n_micro=M, dp=2)
+    with pytest.raises(ValueError, match="does not divide"):
+        hybrid.step(xs[:, :3], ys[:, :3])  # mb=3 not divisible by dp=2
