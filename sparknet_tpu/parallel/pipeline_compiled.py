@@ -65,6 +65,29 @@ def _shard_map(fn, mesh, in_specs, out_specs):
                      check_vma=False)
 
 
+def megatron_mlp_block(model_axis: str = "model",
+                       activation: Callable = jax.nn.relu):
+    """The canonical TP stage for CompiledPipeline(tp>1): a two-matmul
+    MLP with the Megatron sharding (arXiv:1909.08053 fig. 3) — the
+    up-projection `w1` column-sharded so each model shard computes its
+    slice of the hidden layer locally, the down-projection `w2`
+    row-sharded so the partial products need exactly ONE psum per block.
+
+    Params (per stage; leading stage dim added by the stack):
+        w1 [F, H]  -> tp_specs (None, model)   b1 [H] -> (model,)
+        w2 [H, F]  -> tp_specs (model, None)   b2 [F] -> ()
+    Returns (block_fn, tp_specs) ready to hand to CompiledPipeline."""
+
+    def block(params, x):
+        h = activation(x @ params["w1"] + params["b1"])
+        y = lax.psum(h @ params["w2"], model_axis)
+        return y + params["b2"]
+
+    specs = {"w1": (None, model_axis), "b1": (model_axis,),
+             "w2": (model_axis, None), "b2": ()}
+    return block, specs
+
+
 class CompiledPipeline:
     """GPipe over S identical blocks, one XLA program per training round.
 
@@ -87,6 +110,16 @@ class CompiledPipeline:
     contract of the reference's intra-node P2PSync
     (parallel.cpp:325-381) layered onto the pipeline.
 
+    `tp > 1` adds Megatron-style tensor parallelism INSIDE each stage
+    (full 3-D DPxPPxTP on a (data, pipe, model) mesh, still one XLA
+    program).  `tp_specs` declares which post-stage dims of each stacked
+    param shard over `model` (e.g. the MLP pattern: up-projection
+    column-sharded `(None, "model")`, down-projection row-sharded
+    `("model", None)`), and block_fn closes the block with
+    `lax.psum(y, "model")` so activations leave every stage
+    model-replicated — `megatron_mlp_block()` below is the canonical
+    block.  Labels/inputs and head params stay replicated over `model`.
+
     The optimizer is the framework's shared update pipeline driven by
     `solver_param` (type/LR policy/momentum/weight decay/clip), so a
     CompiledPipeline round updates exactly like every other trainer."""
@@ -98,6 +131,9 @@ class CompiledPipeline:
                  n_micro: int, mesh: Optional[Mesh] = None,
                  axis: str = "pipe",
                  dp: int = 1, data_axis: str = "data",
+                 tp: int = 1, model_axis: str = "model",
+                 tp_specs: Optional[Dict[str, Sequence[Optional[str]]]]
+                 = None,
                  devices: Optional[Sequence[Any]] = None,
                  remat: bool = True,
                  precision: Optional[str] = None) -> None:
@@ -107,27 +143,64 @@ class CompiledPipeline:
         self.n_micro = int(n_micro)
         self.axis = axis
         self.dp = int(dp)
+        self.tp = int(tp)
         if self.dp < 1:
             raise ValueError(f"dp must be >= 1, got {dp}")
+        if self.tp < 1:
+            raise ValueError(f"tp must be >= 1, got {tp}")
         self.data_axis = data_axis
+        self.model_axis = model_axis
+        self.tp_specs = dict(tp_specs or {})
+        if self.tp > 1:
+            unknown = set(self.tp_specs) - set(stacked_params)
+            if unknown:
+                raise ValueError(
+                    f"tp_specs name unknown stacked params: "
+                    f"{sorted(unknown)}")
+            for k, spec in self.tp_specs.items():
+                bad = [a for a in spec if a not in (None, model_axis)]
+                if bad:
+                    raise ValueError(
+                        f"tp_specs[{k!r}] uses axes {bad}; only None or "
+                        f"{model_axis!r} are allowed")
+                arr = np.asarray(stacked_params[k])
+                if len(spec) > arr.ndim - 1:
+                    raise ValueError(
+                        f"tp_specs[{k!r}] has {len(spec)} entries but the "
+                        f"param has only {arr.ndim - 1} post-stage dims")
+                for d, a in enumerate(spec):
+                    if a == model_axis and arr.shape[1 + d] % self.tp:
+                        raise ValueError(
+                            f"tp_specs[{k!r}] shards dim {d} (size "
+                            f"{arr.shape[1 + d]}) over {model_axis!r} "
+                            f"but it does not divide tp={self.tp}")
+        elif self.tp_specs:
+            raise ValueError("tp_specs given but tp == 1")
         sizes = {int(v.shape[0]) for v in stacked_params.values()}
         if len(sizes) != 1:
             raise ValueError(f"stacked_params leading (stage) dims differ: "
                              f"{sorted(sizes)}")
         self.n_stages = sizes.pop()
         if mesh is None:
-            need = self.n_stages * self.dp
+            need = self.n_stages * self.dp * self.tp
             devs = list(devices if devices is not None
                         else jax.devices()[:need])
             if len(devs) < need:
                 raise ValueError(f"need {need} devices, have "
                                  f"{len(devs)}")
-            # DPxPP hybrid: replica groups over `data`, stage chain over
-            # `pipe` — the standard large-model mesh (data outermost so
-            # each replica's ppermute hops stay between mesh neighbors)
-            mesh = (Mesh(np.array(devs).reshape(self.dp, self.n_stages),
-                         (data_axis, axis)) if self.dp > 1
-                    else Mesh(np.array(devs), (axis,)))
+            # the standard large-model mesh: replica groups over `data`
+            # (outermost — cross-replica psums are the rarest), stage
+            # chain over `pipe`, tensor shards over `model` (innermost —
+            # the per-block psum is the hottest collective, so it rides
+            # mesh neighbors)
+            shape, names = [], []
+            for size, name in ((self.dp, data_axis),
+                               (self.n_stages, axis),
+                               (self.tp, model_axis)):
+                if size > 1 or name == axis:
+                    shape.append(size)
+                    names.append(name)
+            mesh = Mesh(np.array(devs).reshape(shape), tuple(names))
         if mesh.shape[axis] != self.n_stages:
             raise ValueError(
                 f"mesh axis {axis!r} has {mesh.shape[axis]} devices but "
@@ -136,27 +209,41 @@ class CompiledPipeline:
             raise ValueError(
                 f"mesh axis {data_axis!r} has "
                 f"{mesh.shape.get(data_axis)} devices but dp={self.dp}")
+        if self.tp > 1 and mesh.shape.get(model_axis) != self.tp:
+            raise ValueError(
+                f"mesh axis {model_axis!r} has "
+                f"{mesh.shape.get(model_axis)} devices but tp={self.tp}")
         self.mesh = mesh
         self.remat = bool(remat)
         self.precision = resolve_precision(solver_param, precision)
 
-        stage_sh = NamedSharding(mesh, P(axis))
-        repl_sh = NamedSharding(mesh, P())
-        self.stacked = {k: jax.device_put(jnp.asarray(v), stage_sh)
+        self.stacked = {k: jax.device_put(jnp.asarray(v),
+                                          self._sharding(f"stage:{k}"))
                         for k, v in stacked_params.items()}
-        self.head = {k: jax.device_put(jnp.asarray(v), repl_sh)
+        self.head = {k: jax.device_put(jnp.asarray(v),
+                                       self._sharding(f"head:{k}"))
                      for k, v in (head_params or {}).items()}
         solver_type = solver_param.resolved_type()
         flat = self._flatten(self.stacked, self.head)
         self.state = {k: tuple(
-            jax.device_put(h, stage_sh if k.startswith("stage:")
-                           else repl_sh)
-            for h in hs)
+            jax.device_put(h, self._sharding(k)) for h in hs)
             for k, hs in updates.init_state(flat, solver_type).items()}
         self.iter = 0
         self._pipe_loss = self._make_pipe_loss()
         self._step = self._make_step()
         self._loss_jit = jax.jit(self._pipe_loss)
+
+    def _pspec(self, flat_key: str) -> P:
+        """PartitionSpec for a flat param/state key: stage stacks shard
+        their leading dim over `pipe` plus any declared model-axis dims
+        (tp_specs); head params are replicated."""
+        if not flat_key.startswith("stage:"):
+            return P()
+        name = flat_key[len("stage:"):]
+        return P(self.axis, *self.tp_specs.get(name, ()))
+
+    def _sharding(self, flat_key: str) -> NamedSharding:
+        return NamedSharding(self.mesh, self._pspec(flat_key))
 
     # ------------------------------------------------------------- helpers
     @staticmethod
@@ -177,6 +264,7 @@ class CompiledPipeline:
     def _make_pipe_loss(self):
         S, M, axis = self.n_stages, self.n_micro, self.axis
         dp, data_axis = self.dp, self.data_axis
+        tp, model_axis = self.tp, self.model_axis
         T = M + S - 1
         block = (jax.checkpoint(self.block_fn) if self.remat
                  else self.block_fn)
@@ -225,6 +313,18 @@ class CompiledPipeline:
                 tick, (act0, jnp.float32(0.0)), jnp.arange(T))
             # only the last stage accumulated; psum replicates the total
             total = lax.psum(loss_acc, axis) / M
+            if tp > 1:
+                # every model shard computed the SAME loss (the block's
+                # trailing psum makes activations model-replicated) —
+                # count it once and psum it back.  This is what makes the
+                # check_vma=False transpose exact: replicated inputs
+                # (head, xs) get their cotangents psum'd over `model`
+                # without the tp-fold overcount, while model-SHARDED
+                # params keep their full local cotangent through
+                # transpose(psum)=psum inside the block.
+                midx = lax.axis_index(model_axis)
+                total = lax.psum(
+                    jnp.where(midx == 0, total, 0.0), model_axis)
             if dp > 1:
                 # each data replica saw its shard of every microbatch;
                 # the round loss (and through its transpose, every
@@ -234,11 +334,15 @@ class CompiledPipeline:
             return total
 
         # microbatch stacks are [M, mb, ...]: M stays whole, the
-        # within-micro batch dim shards over `data` replicas
+        # within-micro batch dim shards over `data` replicas, and every
+        # model shard sees the full activation (Megatron-style TP)
         xs_spec = P(None, data_axis) if dp > 1 else P()
+        stacked_specs = {k: self._pspec(f"stage:{k}")
+                         for k in self.stacked}
         return _shard_map(
             pipe_loss_sharded, self.mesh,
-            in_specs=(P(axis), P(), xs_spec, xs_spec), out_specs=P())
+            in_specs=(stacked_specs, P(), xs_spec, xs_spec),
+            out_specs=P())
 
     def _make_step(self):
         from ..solver.solver import make_update_fn
@@ -314,12 +418,7 @@ class CompiledPipeline:
         uninterrupted run (reference: Solver::Restore)."""
         from ..utils import orbax_ckpt
 
-        stage_sh = NamedSharding(self.mesh, P(self.axis))
-        repl_sh = NamedSharding(self.mesh, P())
-
-        def sharding_for(k):
-            return stage_sh if k.startswith("stage:") else repl_sh
-
+        sharding_for = self._sharding
         known = self._flatten(self.stacked, self.head)
         it, params, state = orbax_ckpt.restore_auto(
             path, known_params=known, sharding_for=sharding_for)

@@ -253,3 +253,113 @@ def test_dp_pp_rejects_bad_shapes():
                               head_params=head, n_micro=M, dp=2)
     with pytest.raises(ValueError, match="does not divide"):
         hybrid.step(xs[:, :3], ys[:, :3])  # mb=3 not divisible by dp=2
+
+
+def _mega_init(S_, F_, H_, C_, seed=0):
+    rng = np.random.RandomState(seed)
+    stacked = {
+        "w1": (rng.randn(S_, F_, H_) * 0.2).astype(np.float32),
+        "b1": np.zeros((S_, H_), np.float32),
+        "w2": (rng.randn(S_, H_, F_) * 0.2).astype(np.float32),
+        "b2": np.zeros((S_, F_), np.float32),
+    }
+    head = {"w": (rng.randn(F_, C_) * 0.3).astype(np.float32),
+            "b": np.zeros((C_,), np.float32)}
+    return stacked, head
+
+
+def _mega_dense_loss(stacked, head, xs, ys):
+    """Single-device dense math of the Megatron block stack: the model
+    psum of sharded partial products equals the full matmul."""
+    S_ = stacked["w1"].shape[0]
+
+    def one(x, y):
+        for s in range(S_):
+            h = jax.nn.relu(x @ stacked["w1"][s] + stacked["b1"][s])
+            x = h @ stacked["w2"][s] + stacked["b2"][s]
+        return loss_fn(head, x, y)
+
+    M_ = xs.shape[0]
+    return jnp.mean(jnp.stack([one(xs[m], ys[m]) for m in range(M_)]))
+
+
+@pytest.mark.parametrize("dp", [1, 2])
+def test_tp_pipeline_matches_dense_trajectory(dp):
+    """Full 3-D parallelism (DPxPPxTP, one XLA program): three training
+    rounds of the Megatron-block pipeline at tp=2 must match plain dense
+    single-device math exactly — loss AND parameter trajectory, i.e. the
+    sharded psum/transpose dance introduces no scaling errors."""
+    from sparknet_tpu.parallel.pipeline_compiled import megatron_mlp_block
+
+    S_, F_, H_, C_ = 2, 8, 12, 10
+    _need_devices(dp * S_ * 2)
+    block, tp_specs = megatron_mlp_block()
+    stacked, head = _mega_init(S_, F_, H_, C_)
+    pipe = CompiledPipeline(_solver_param(), block_fn=block,
+                            loss_fn=loss_fn, stacked_params=stacked,
+                            head_params=head, n_micro=M, dp=dp, tp=2,
+                            tp_specs=tp_specs)
+    shape = dict(pipe.mesh.shape)
+    assert shape["pipe"] == S_ and shape["model"] == 2
+    if dp > 1:
+        assert shape["data"] == dp
+
+    ref = {("s", k): jnp.asarray(v) for k, v in stacked.items()}
+    ref.update({("h", k): jnp.asarray(v) for k, v in head.items()})
+    vel = {k: jnp.zeros_like(v) for k, v in ref.items()}
+    lr, mu, wd = 0.05, 0.9, 0.0005
+
+    rng = np.random.RandomState(42)
+    for _ in range(3):
+        xs = rng.randn(M, MB, F_).astype(np.float32)
+        ys = rng.randint(0, C_, (M, MB)).astype(np.int32)
+
+        def lfn(flat):
+            st = {k[1]: v for k, v in flat.items() if k[0] == "s"}
+            hd = {k[1]: v for k, v in flat.items() if k[0] == "h"}
+            return _mega_dense_loss(st, hd, xs, ys)
+
+        ref_loss, g = jax.value_and_grad(lfn)(ref)
+        got_loss = pipe.step(xs, ys)
+        np.testing.assert_allclose(got_loss, float(ref_loss), rtol=2e-5)
+        for k in ref:
+            vel[k] = mu * vel[k] + lr * (g[k] + wd * ref[k])
+            ref[k] = ref[k] - vel[k]
+
+    for k in stacked:
+        np.testing.assert_allclose(np.asarray(pipe.stacked[k]),
+                                   np.asarray(ref[("s", k)]),
+                                   rtol=3e-5, atol=1e-6)
+    for k in head:
+        np.testing.assert_allclose(np.asarray(pipe.head[k]),
+                                   np.asarray(ref[("h", k)]),
+                                   rtol=3e-5, atol=1e-6)
+
+
+def test_tp_specs_validation():
+    stacked, head = _mega_init(2, 8, 12, 10)
+    with pytest.raises(ValueError, match="unknown stacked params"):
+        CompiledPipeline(_solver_param(), block_fn=block_fn,
+                         loss_fn=loss_fn, stacked_params=stacked,
+                         head_params=head, n_micro=M, tp=2,
+                         tp_specs={"nope": (None, "model")})
+    with pytest.raises(ValueError, match="tp_specs given but tp == 1"):
+        CompiledPipeline(_solver_param(), block_fn=block_fn,
+                         loss_fn=loss_fn, stacked_params=stacked,
+                         head_params=head, n_micro=M,
+                         tp_specs={"w1": (None, "model")})
+
+
+def test_tp_specs_rank_and_divisibility_validation():
+    stacked, head = _mega_init(2, 8, 12, 10)
+    with pytest.raises(ValueError, match="post-stage dims"):
+        CompiledPipeline(_solver_param(), block_fn=block_fn,
+                         loss_fn=loss_fn, stacked_params=stacked,
+                         head_params=head, n_micro=M, tp=2,
+                         tp_specs={"b1": (None, "model")})
+    stacked["w1"] = stacked["w1"][:, :, :9]  # H=9 not divisible by tp=2
+    with pytest.raises(ValueError, match="does not divide tp"):
+        CompiledPipeline(_solver_param(), block_fn=block_fn,
+                         loss_fn=loss_fn, stacked_params=stacked,
+                         head_params=head, n_micro=M, tp=2,
+                         tp_specs={"w1": (None, "model")})
