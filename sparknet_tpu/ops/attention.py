@@ -12,6 +12,7 @@ Shapes: (batch, heads, seq, head_dim) throughout.
 
 from __future__ import annotations
 
+import functools
 from typing import Optional
 
 import jax
@@ -147,7 +148,18 @@ def blockwise_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
 
     qpos = jnp.arange(s)
 
+    # prevent_cse=False: scan's lowering already blocks the CSE hazard,
+    # so the default setting would only add unfusable optimization
+    # barriers per block (jax.checkpoint docs)
+    @functools.partial(jax.checkpoint, prevent_cse=False)
     def body(carry, xs):
+        # rematerialized: without checkpoint the backward saves each
+        # block's (S x block) score/probability residuals, which across
+        # n_blocks totals the O(S^2) dense footprint — recomputing them
+        # in the backward is what actually delivers the O(S*block)
+        # memory bound (the flash-attention trade, arXiv:2205.14135;
+        # measured: un-remat'd S=32k fwd+bwd OOMs this chip's HBM,
+        # remat'd runs — BENCH_NOTES.md round-3 long-context table)
         kblk, vblk, blk_idx = xs
         if causal:
             kpos = blk_idx * block_size + jnp.arange(block_size)

@@ -187,3 +187,29 @@ def test_model_prototxt_roundtrip(name):
     assert _param_shapes(n1) == _param_shapes(n2)
     assert n1.layer_names() == n2.layer_names()
     assert sorted(n1.loss_terms) == sorted(n2.loss_terms)
+
+
+def test_rcnn_zoo_model_drives_the_detector(tmp_path):
+    """The detection.ipynb flow with OUR builder: serialize the
+    rcnn_ilsvrc13 zoo model back to prototxt, load it into the Detector,
+    and score image windows — raw 200-way fc-rcnn margins out (readme.md:
+    'transplanted R-CNN SVM classifiers', no softmax applied)."""
+    from sparknet_tpu.classify import Detector
+    from sparknet_tpu.proto.textformat import serialize
+
+    np_param = get_model("rcnn_ilsvrc13", batch=2)
+    path = str(tmp_path / "rcnn_deploy.prototxt")
+    with open(path, "w") as f:
+        f.write(serialize(np_param.msg))
+
+    det = Detector(path, batch_override=2)
+    rng = np.random.RandomState(0)
+    image = rng.rand(300, 300, 3).astype(np.float32)
+    dets = det.detect_windows(
+        [(image, [(0, 0, 250, 250), (20, 20, 290, 290)])])
+    assert len(dets) == 2
+    for d in dets:
+        assert d["prediction"].shape == (200,)
+        assert np.isfinite(d["prediction"]).all()
+    # margins, not probabilities: no softmax normalization happened
+    assert not np.allclose(dets[0]["prediction"].sum(), 1.0)
