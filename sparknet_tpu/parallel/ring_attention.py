@@ -52,16 +52,31 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
     qpos = idx * s_local + jnp.arange(s_local)
     perm = [(i, (i + 1) % n) for i in range(n)]
 
+    # rematerialized accumulation: differentiating the ring loop would
+    # otherwise save every hop's (S_local x S_local) score residuals —
+    # n hops x that is the full S_local x S row of the dense footprint,
+    # growing with ring size.  Recomputing them in the backward keeps the
+    # per-device bound at O(S_local^2) scratch, the same trade
+    # blockwise_attention makes (BENCH_NOTES.md round-3 long-context
+    # note).  The causal mask is derived INSIDE the remat region from the
+    # hop's scalar src index — passed in, the saved bool mask would
+    # itself be an (S_local x S_local) residual per hop.  The ppermute
+    # hops stay OUTSIDE so the backward replays arithmetic, not
+    # communication.
+    @functools.partial(jax.checkpoint, prevent_cse=False)
+    def hop_update(carry, k_cur, v_cur, src):
+        if causal:
+            kpos = src * s_local + jnp.arange(s_local)
+            mask = (qpos[:, None] >= kpos[None, :])[None, None]
+        else:
+            mask = None
+        return _block_update(carry, q, k_cur, v_cur, scale, mask)
+
     def body(r, state):
         o, m, l, k_cur, v_cur = state
         # the block now on this device originated on device (idx - r) mod n
         src = (idx - r) % n
-        kpos = src * s_local + jnp.arange(s_local)
-        if causal:
-            mask = (qpos[:, None] >= kpos[None, :])[None, None]
-        else:
-            mask = None
-        o, m, l = _block_update((o, m, l), q, k_cur, v_cur, scale, mask)
+        o, m, l = hop_update((o, m, l), k_cur, v_cur, src)
         k_nxt = jax.lax.ppermute(k_cur, axis_name, perm)
         v_nxt = jax.lax.ppermute(v_cur, axis_name, perm)
         return (o, m, l, k_nxt, v_nxt)
