@@ -1,0 +1,252 @@
+"""Sequence-parallel TRAINING: long-context models over a `seq` mesh axis.
+
+`ring_attention.py` provides the collective attention kernels; this module
+makes them a first-class training path — the analogue of what
+`parallel/dist.py` is to data parallelism.  Activations stay sharded on
+the sequence dimension end to end: token/position embedding, LayerNorm and
+MLPs are per-token (local to a shard), attention crosses shards via the
+ring (or Ulysses all-to-all), and the loss is the global per-token mean
+via one `pmean`.  Gradients fall out of differentiating the shard_map'd
+loss; the update is the framework's shared Caffe-exact pipeline
+(solver/updates.py), so a SeqParallelTrainer step updates exactly like
+every other trainer (reference update contract:
+caffe/src/caffe/solvers/sgd_solver.cpp:102-240).
+
+The reference has no sequence dimension anywhere (SURVEY.md §5.7) — this
+is beyond-parity capability, built because long-context is first-class in
+the TPU build.  Numerical contract: a SeqParallelTrainer trajectory is
+EXACTLY the single-device dense trajectory (tests/test_seq_parallel.py),
+the same standard every other parallel mode in this framework meets.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax import shard_map
+from jax.sharding import Mesh, NamedSharding
+from jax.sharding import PartitionSpec as P
+
+from ..proto.caffe_pb import SolverParameter
+from ..solver import updates
+from ..solver.solver import resolve_precision
+from .ring_attention import SEQ_AXIS, ring_attention, ulysses_attention
+
+
+# --------------------------------------------------------- canonical model
+def tiny_transformer(n_layers: int, vocab: int, d_model: int,
+                     n_heads: int, max_seq: int, *, mlp_mult: int = 4):
+    """A minimal causal transformer LM built for sequence parallelism:
+    everything except attention is per-token, so under SP only the
+    attention crosses shards.  Returns (init_params, apply).
+
+    apply(params, tokens, axis_name=None, method="ring"):
+        tokens (B, S_local) int32 -> logits (B, S_local, vocab).
+        axis_name=None runs dense single-device attention (the reference
+        trajectory); an axis name runs ring/Ulysses attention INSIDE
+        shard_map with global positions derived from the shard index.
+    """
+    head_dim = d_model // n_heads
+    if head_dim * n_heads != d_model:
+        raise ValueError(f"d_model {d_model} not divisible by "
+                         f"n_heads {n_heads}")
+
+    def init_params(seed: int = 0) -> Dict[str, np.ndarray]:
+        rng = np.random.RandomState(seed)
+
+        def g(*shape, scale=0.02):
+            return (rng.randn(*shape) * scale).astype(np.float32)
+
+        p: Dict[str, np.ndarray] = {
+            "embed": g(vocab, d_model),
+            "pos": g(max_seq, d_model),
+            "head": g(d_model, vocab),
+        }
+        for i in range(n_layers):
+            p.update({
+                f"l{i}/ln1": np.ones((d_model,), np.float32),
+                f"l{i}/wq": g(d_model, d_model),
+                f"l{i}/wk": g(d_model, d_model),
+                f"l{i}/wv": g(d_model, d_model),
+                f"l{i}/wo": g(d_model, d_model),
+                f"l{i}/ln2": np.ones((d_model,), np.float32),
+                f"l{i}/w1": g(d_model, mlp_mult * d_model),
+                f"l{i}/w2": g(mlp_mult * d_model, d_model),
+            })
+        return p
+
+    def _ln(x, scale):
+        mu = x.mean(-1, keepdims=True)
+        var = ((x - mu) ** 2).mean(-1, keepdims=True)
+        return (x - mu) * jax.lax.rsqrt(var + 1e-6) * scale
+
+    def apply(params, tokens, *, axis_name: Optional[str] = None,
+              method: str = "ring"):
+        b, s_local = tokens.shape
+        if axis_name is None:
+            s_global = s_local
+            pos = jnp.arange(s_local)
+        else:
+            # global positions for this sequence shard; the axis size is
+            # static so the max_seq guard stays a trace-time check
+            s_global = jax.lax.axis_size(axis_name) * s_local
+            pos = (lax.axis_index(axis_name) * s_local
+                   + jnp.arange(s_local))
+        if s_global > max_seq:
+            # without this, the position gather CLAMPS rows >= max_seq
+            # and overlong inputs silently train with wrong embeddings
+            raise ValueError(f"sequence length {s_global} exceeds "
+                             f"max_seq {max_seq}")
+        x = params["embed"][tokens] + params["pos"][pos][None]
+        for i in range(n_layers):
+            h = _ln(x, params[f"l{i}/ln1"])
+            q = (h @ params[f"l{i}/wq"]).reshape(b, s_local, n_heads,
+                                                 head_dim)
+            k = (h @ params[f"l{i}/wk"]).reshape(b, s_local, n_heads,
+                                                 head_dim)
+            v = (h @ params[f"l{i}/wv"]).reshape(b, s_local, n_heads,
+                                                 head_dim)
+            q, k, v = (jnp.moveaxis(t, 2, 1) for t in (q, k, v))
+            if axis_name is None:
+                from ..ops.attention import attention
+
+                o = attention(q, k, v, causal=True)
+            elif method == "ring":
+                o = ring_attention(q, k, v, axis_name=axis_name,
+                                   causal=True)
+            else:
+                o = ulysses_attention(q, k, v, axis_name=axis_name,
+                                      causal=True)
+            o = jnp.moveaxis(o, 1, 2).reshape(b, s_local, d_model)
+            x = x + o @ params[f"l{i}/wo"]
+            h2 = _ln(x, params[f"l{i}/ln2"])
+            x = x + jax.nn.relu(h2 @ params[f"l{i}/w1"]) @ params[
+                f"l{i}/w2"]
+        return x @ params["head"]
+
+    return init_params, apply
+
+
+# ---------------------------------------------------------------- trainer
+class SeqParallelTrainer:
+    """Next-token training with sequence-sharded activations.
+
+    apply_fn(params, tokens, axis_name=None, method=...) -> logits, the
+    `tiny_transformer` contract: per-token everywhere, attention via the
+    ring when axis_name is given.  Tokens/targets arrive (B, S) and are
+    sharded over `seq`; params are replicated (they are small relative to
+    the S-long activations this mode exists for — the memory win is the
+    O(S_local) activation footprint, composing with the remat'd ring
+    accumulation).  Loss = global per-token mean cross-entropy via pmean;
+    gradients = transpose through the shard_map; update = shared pipeline.
+    """
+
+    def __init__(self, solver_param: SolverParameter, *,
+                 apply_fn: Callable, params: Dict[str, Any],
+                 mesh: Optional[Mesh] = None,
+                 n_devices: Optional[int] = None,
+                 method: str = "ring",
+                 precision: Optional[str] = None) -> None:
+        if method not in ("ring", "ulysses"):
+            raise ValueError(f"unknown method {method!r}")
+        self.param = solver_param
+        self.apply_fn = apply_fn
+        self.method = method
+        if mesh is None:
+            devs = jax.devices()
+            n = n_devices or len(devs)
+            if len(devs) < n:
+                raise ValueError(f"need {n} devices, have {len(devs)}")
+            mesh = Mesh(np.array(devs[:n]), (SEQ_AXIS,))
+        if SEQ_AXIS not in mesh.shape:
+            raise ValueError(f"mesh has no {SEQ_AXIS!r} axis: "
+                             f"{dict(mesh.shape)}")
+        self.mesh = mesh
+        self.n_shards = mesh.shape[SEQ_AXIS]
+        self.precision = resolve_precision(solver_param, precision)
+
+        repl = NamedSharding(mesh, P())
+        self.params = {k: jax.device_put(jnp.asarray(v), repl)
+                       for k, v in params.items()}
+        self.state = {k: tuple(jax.device_put(h, repl) for h in hs)
+                      for k, hs in updates.init_state(
+                          self.params,
+                          solver_param.resolved_type()).items()}
+        self.iter = 0
+        self._loss = self._make_loss()
+        self._step = self._make_step()
+        self._loss_jit = jax.jit(self._loss)
+
+    def _make_loss(self):
+        apply_fn, method = self.apply_fn, self.method
+        half = self.precision == "bfloat16"
+
+        def sp_loss_sharded(params, tokens, targets):
+            if half:
+                params = {k: v.astype(jnp.bfloat16)
+                          if jnp.issubdtype(v.dtype, jnp.floating) else v
+                          for k, v in params.items()}
+            logits = apply_fn(params, tokens, axis_name=SEQ_AXIS,
+                              method=method).astype(jnp.float32)
+            logp = jax.nn.log_softmax(logits)
+            nll = -jnp.take_along_axis(
+                logp, targets[..., None], axis=-1)[..., 0]
+            # equal shards: pmean of local means == global per-token mean
+            return lax.pmean(nll.mean(), SEQ_AXIS)
+
+        tok_spec = P(None, SEQ_AXIS)
+        return shard_map(
+            sp_loss_sharded, mesh=self.mesh,
+            in_specs=(P(), tok_spec, tok_spec), out_specs=P(),
+            check_vma=False)
+
+    def _make_step(self):
+        from ..solver.solver import make_update_fn
+
+        sp_loss = self._loss
+        ones = {k: 1.0 for k in self.params}
+        update = make_update_fn(None, self.param, lr_mults=ones,
+                                decay_mults=ones)
+
+        @functools.partial(jax.jit, donate_argnums=(0, 1))
+        def step(params, state, it, tokens, targets):
+            loss, grads = jax.value_and_grad(sp_loss)(params, tokens,
+                                                      targets)
+            new_p, new_s = update(params, state, grads, it)
+            return new_p, new_s, loss
+
+        return step
+
+    def _validate(self, tokens, targets):
+        if tokens.shape != targets.shape or tokens.ndim != 2:
+            raise ValueError(
+                f"tokens/targets must both be (B, S); got "
+                f"{tokens.shape} / {targets.shape}")
+        if tokens.shape[1] % self.n_shards:
+            raise ValueError(
+                f"sequence length {tokens.shape[1]} does not divide over "
+                f"{self.n_shards} sequence shards")
+
+    def step(self, tokens, targets) -> float:
+        """One update on a (B, S) token batch with (B, S) next-token
+        targets; S shards over the mesh's `seq` axis."""
+        tokens = jnp.asarray(tokens, jnp.int32)
+        targets = jnp.asarray(targets, jnp.int32)
+        self._validate(tokens, targets)
+        self.params, self.state, loss = self._step(
+            self.params, self.state, jnp.int32(self.iter), tokens,
+            targets)
+        self.iter += 1
+        return float(loss)
+
+    def loss(self, tokens, targets) -> float:
+        """Forward-only global mean NLL (no update)."""
+        tokens = jnp.asarray(tokens, jnp.int32)
+        targets = jnp.asarray(targets, jnp.int32)
+        self._validate(tokens, targets)
+        return float(self._loss_jit(self.params, tokens, targets))

@@ -1,0 +1,126 @@
+"""SeqParallelTrainer: long-context training over a `seq` mesh axis must
+be EXACTLY the single-device dense computation — loss and parameter
+trajectory — for both ring and Ulysses attention, the equivalence
+standard every parallel mode in this framework meets."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from sparknet_tpu.parallel.seq_parallel import (SeqParallelTrainer,
+                                                tiny_transformer)
+from sparknet_tpu.proto.caffe_pb import SolverParameter
+
+V, D, HEADS, LAYERS, B, S = 17, 16, 8, 2, 2, 32
+
+
+def _need_devices(n):
+    if len(jax.devices()) < n:
+        pytest.skip(f"needs {n} devices (virtual CPU mesh)")
+
+
+def _solver_param():
+    sp = SolverParameter()
+    sp.msg.set("base_lr", 0.1)
+    sp.msg.set("lr_policy", "fixed")
+    sp.msg.set("momentum", 0.9)
+    sp.msg.set("weight_decay", 0.0005)
+    return sp
+
+
+def _data(rng):
+    tokens = rng.randint(0, V, (B, S)).astype(np.int32)
+    targets = np.roll(tokens, -1, axis=1).astype(np.int32)
+    return tokens, targets
+
+
+def _dense_loss(apply_fn, params, tokens, targets):
+    logits = apply_fn(params, jnp.asarray(tokens)).astype(jnp.float32)
+    logp = jax.nn.log_softmax(logits)
+    nll = -jnp.take_along_axis(
+        logp, jnp.asarray(targets)[..., None], axis=-1)[..., 0]
+    return nll.mean()
+
+
+@pytest.mark.parametrize("method", ["ring", "ulysses"])
+def test_sp_trajectory_matches_dense(method):
+    """Three training steps sharded over 8 sequence shards == three plain
+    single-device steps with hand-rolled Caffe update math."""
+    _need_devices(8)
+    init, apply_fn = tiny_transformer(LAYERS, V, D, HEADS, max_seq=S)
+    params0 = init(0)
+    tr = SeqParallelTrainer(_solver_param(), apply_fn=apply_fn,
+                            params=params0, n_devices=8, method=method)
+
+    ref = {k: jnp.asarray(v) for k, v in params0.items()}
+    vel = {k: jnp.zeros_like(v) for k, v in ref.items()}
+    lr, mu, wd = 0.1, 0.9, 0.0005
+
+    rng = np.random.RandomState(5)
+    for _ in range(3):
+        tokens, targets = _data(rng)
+        ref_loss, g = jax.value_and_grad(
+            lambda p: _dense_loss(apply_fn, p, tokens, targets))(ref)
+        got = tr.step(tokens, targets)
+        np.testing.assert_allclose(got, float(ref_loss), rtol=2e-5)
+        for k in ref:
+            vel[k] = mu * vel[k] + lr * (g[k] + wd * ref[k])
+            ref[k] = ref[k] - vel[k]
+
+    for k in ref:
+        np.testing.assert_allclose(np.asarray(tr.params[k]),
+                                   np.asarray(ref[k]),
+                                   rtol=3e-5, atol=1e-6)
+
+
+def test_sp_training_learns():
+    """A learnable task through the sharded path: next-token prediction
+    of a fixed repeating sequence must drive the NLL well below chance."""
+    _need_devices(8)
+    init, apply_fn = tiny_transformer(LAYERS, V, D, HEADS, max_seq=S)
+    tr = SeqParallelTrainer(_solver_param(), apply_fn=apply_fn,
+                            params=init(1), n_devices=8)
+    base = np.arange(S) % 7
+    tokens = np.stack([base, (base + 3) % 7]).astype(np.int32)
+    targets = np.roll(tokens, -1, axis=1).astype(np.int32)
+    first = tr.step(tokens, targets)
+    for _ in range(40):
+        last = tr.step(tokens, targets)
+    assert np.isfinite(last) and last < first * 0.5, (first, last)
+    assert last < np.log(V) * 0.5  # well below uniform chance
+
+
+def test_sp_validation_errors():
+    _need_devices(8)
+    init, apply_fn = tiny_transformer(1, V, D, HEADS, max_seq=S)
+    tr = SeqParallelTrainer(_solver_param(), apply_fn=apply_fn,
+                            params=init(0), n_devices=8)
+    bad = np.zeros((B, 12), np.int32)  # 12 not divisible by 8
+    with pytest.raises(ValueError, match="does not divide"):
+        tr.step(bad, bad)
+    with pytest.raises(ValueError, match="must both be"):
+        tr.step(np.zeros((B, S), np.int32), np.zeros((B, S, 1), np.int32))
+    with pytest.raises(ValueError, match="unknown method"):
+        SeqParallelTrainer(_solver_param(), apply_fn=apply_fn,
+                           params=init(0), n_devices=8, method="mesh??")
+
+
+def test_tiny_transformer_rejects_bad_dims():
+    with pytest.raises(ValueError, match="not divisible"):
+        tiny_transformer(1, V, 15, 4, max_seq=S)
+
+
+def test_overlong_sequence_rejected_not_clamped():
+    """A model built for max_seq must refuse longer inputs — JAX's gather
+    clamps out-of-range position rows, which would silently train with
+    wrong embeddings."""
+    _need_devices(8)
+    init, apply_fn = tiny_transformer(1, V, D, HEADS, max_seq=8)
+    tr = SeqParallelTrainer(_solver_param(), apply_fn=apply_fn,
+                            params=init(0), n_devices=8)
+    toks = np.zeros((B, 16), np.int32)  # divisible by 8, but > max_seq
+    with pytest.raises(ValueError, match="exceeds max_seq"):
+        tr.step(toks, toks)
+    with pytest.raises(ValueError, match="exceeds max_seq"):
+        apply_fn(init(0), jnp.zeros((B, 16), jnp.int32))
