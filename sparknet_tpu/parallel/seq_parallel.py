@@ -40,16 +40,31 @@ from .ring_attention import SEQ_AXIS, ring_attention, ulysses_attention
 
 # --------------------------------------------------------- canonical model
 def tiny_transformer(n_layers: int, vocab: int, d_model: int,
-                     n_heads: int, max_seq: int, *, mlp_mult: int = 4):
+                     n_heads: int, max_seq: int, *, mlp_mult: int = 4,
+                     attn_block: Optional[int] = None,
+                     remat_layers: bool = False):
     """A minimal causal transformer LM built for sequence parallelism:
     everything except attention is per-token, so under SP only the
     attention crosses shards.  Returns (init_params, apply).
 
     apply(params, tokens, axis_name=None, method="ring"):
         tokens (B, S_local) int32 -> logits (B, S_local, vocab).
-        axis_name=None runs dense single-device attention (the reference
+        axis_name=None runs single-device attention (the reference
         trajectory); an axis name runs ring/Ulysses attention INSIDE
         shard_map with global positions derived from the shard index.
+
+    `attn_block` switches the single-device path from dense to the
+    remat'd blockwise kernel (O(S*block) memory), which is what lets ONE
+    chip train at contexts whose dense scores would overflow HBM
+    (BENCH_NOTES.md round-3 long-context table; S=65k measured).
+
+    `remat_layers` is a SINGLE-CHIP memory knob: it checkpoints each
+    whole layer (save only its input, recompute internals in the
+    backward).  Under sequence parallelism that recompute would include
+    the ring's ppermute hops — replaying communication, which
+    ring_attention's own internal remat deliberately avoids — so leave
+    it off when axis_name is set unless HBM, not ICI, is the binding
+    constraint.
     """
     head_dim = d_model // n_heads
     if head_dim * n_heads != d_model:
@@ -102,20 +117,28 @@ def tiny_transformer(n_layers: int, vocab: int, d_model: int,
             # and overlong inputs silently train with wrong embeddings
             raise ValueError(f"sequence length {s_global} exceeds "
                              f"max_seq {max_seq}")
-        x = params["embed"][tokens] + params["pos"][pos][None]
-        for i in range(n_layers):
-            h = _ln(x, params[f"l{i}/ln1"])
-            q = (h @ params[f"l{i}/wq"]).reshape(b, s_local, n_heads,
-                                                 head_dim)
-            k = (h @ params[f"l{i}/wk"]).reshape(b, s_local, n_heads,
-                                                 head_dim)
-            v = (h @ params[f"l{i}/wv"]).reshape(b, s_local, n_heads,
-                                                 head_dim)
+        if (attn_block is not None and axis_name is None
+                and s_local % attn_block):
+            raise ValueError(
+                f"sequence length {s_local} not divisible by "
+                f"attn_block {attn_block}")
+        def layer(x, lp):
+            h = _ln(x, lp["ln1"])
+            q = (h @ lp["wq"]).reshape(b, s_local, n_heads, head_dim)
+            k = (h @ lp["wk"]).reshape(b, s_local, n_heads, head_dim)
+            v = (h @ lp["wv"]).reshape(b, s_local, n_heads, head_dim)
             q, k, v = (jnp.moveaxis(t, 2, 1) for t in (q, k, v))
             if axis_name is None:
-                from ..ops.attention import attention
+                if attn_block is not None:
+                    from ..ops.attention import blockwise_attention
 
-                o = attention(q, k, v, causal=True)
+                    o = blockwise_attention(q, k, v,
+                                            block_size=attn_block,
+                                            causal=True)
+                else:
+                    from ..ops.attention import attention
+
+                    o = attention(q, k, v, causal=True)
             elif method == "ring":
                 o = ring_attention(q, k, v, axis_name=axis_name,
                                    causal=True)
@@ -123,10 +146,21 @@ def tiny_transformer(n_layers: int, vocab: int, d_model: int,
                 o = ulysses_attention(q, k, v, axis_name=axis_name,
                                       causal=True)
             o = jnp.moveaxis(o, 1, 2).reshape(b, s_local, d_model)
-            x = x + o @ params[f"l{i}/wo"]
-            h2 = _ln(x, params[f"l{i}/ln2"])
-            x = x + jax.nn.relu(h2 @ params[f"l{i}/w1"]) @ params[
-                f"l{i}/w2"]
+            x = x + o @ lp["wo"]
+            h2 = _ln(x, lp["ln2"])
+            return x + jax.nn.relu(h2 @ lp["w1"]) @ lp["w2"]
+
+        if remat_layers:
+            # save only each layer's INPUT; recompute its internals in
+            # the backward — the standard long-context residual-stream
+            # trade, composing with the remat'd attention kernels
+            layer = jax.checkpoint(layer)
+
+        x = params["embed"][tokens] + params["pos"][pos][None]
+        for i in range(n_layers):
+            x = layer(x, {n: params[f"l{i}/{n}"]
+                          for n in ("ln1", "wq", "wk", "wv", "wo",
+                                    "ln2", "w1", "w2")})
         return x @ params["head"]
 
     return init_params, apply
@@ -154,6 +188,12 @@ class SeqParallelTrainer:
                  precision: Optional[str] = None) -> None:
         if method not in ("ring", "ulysses"):
             raise ValueError(f"unknown method {method!r}")
+        if int(solver_param.iter_size) > 1:
+            # no gradient accumulation here; silently skipping it would
+            # diverge from the single-chip Solver's folded iter_size
+            # (solver.cpp:221-224) — reject like PipelineTrainer does
+            raise ValueError("SeqParallelTrainer does not support "
+                             "iter_size > 1")
         self.param = solver_param
         self.apply_fn = apply_fn
         self.method = method
