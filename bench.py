@@ -301,6 +301,71 @@ def bench_inference(name, model_dir, batch, fuse_1x1=False):
     return out
 
 
+def bench_longctx_lm(seq_len: int = 16384, n_layers: int = 4,
+                     d_model: int = 512, heads: int = 8,
+                     block: int = 1024):
+    """Long-context LM training throughput on one chip: full update steps
+    (fwd+bwd+momentum, bf16 compute) of the canonical causal transformer
+    with remat'd blockwise attention — the driver-tracked proof that the
+    long-context path stays healthy.  No reference counterpart (SURVEY.md
+    §5.7: the reference has no sequence dimension); scaling table to
+    S=65k in BENCH_NOTES.md."""
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+
+    from sparknet_tpu.parallel.seq_parallel import tiny_transformer
+    from sparknet_tpu.proto.caffe_pb import SolverParameter
+    from sparknet_tpu.solver import updates as U
+    from sparknet_tpu.solver.solver import make_update_fn
+    from sparknet_tpu.utils.timers import differenced_chain_s
+
+    sp = SolverParameter()
+    sp.msg.set("base_lr", 0.01)
+    sp.msg.set("lr_policy", "fixed")
+    sp.msg.set("momentum", 0.9)
+    init, apply_fn = tiny_transformer(n_layers, 256, d_model, heads,
+                                      max_seq=seq_len, attn_block=block)
+    params = {k: jnp.asarray(v) for k, v in init(0).items()}
+    state = U.init_state(params, sp.resolved_type())
+    ones = {k: 1.0 for k in params}
+    upd = make_update_fn(None, sp, lr_mults=ones, decay_mults=ones)
+    rng = np.random.RandomState(0)
+    toks = jnp.asarray(rng.randint(0, 256, (1, seq_len)).astype(np.int32))
+    tgts = jnp.roll(toks, -1, axis=1)
+
+    def loss_fn(p, toks):
+        p = {k: v.astype(jnp.bfloat16) for k, v in p.items()}
+        logits = apply_fn(p, toks).astype(jnp.float32)
+        logp = jax.nn.log_softmax(logits)
+        return -jnp.take_along_axis(logp, tgts[..., None], -1).mean()
+
+    @functools.partial(jax.jit, donate_argnums=(0, 1))
+    def step(p, st, it, toks):
+        l, g = jax.value_and_grad(loss_fn)(p, toks)
+        p2, st2 = upd(p, st, g, it)
+        return p2, st2, l
+
+    ps = [params, state]
+    it = [0]
+
+    def run(m):
+        t0 = time.perf_counter()
+        l = None
+        for _ in range(m):
+            ps[0], ps[1], l = step(ps[0], ps[1], jnp.int32(it[0]), toks)
+            it[0] += 1
+        float(l)
+        return time.perf_counter() - t0
+
+    s = differenced_chain_s(run, 8)
+    out = {"longctx_seq_len": seq_len,
+           "longctx_lm_tok_per_sec": round(seq_len / s, 1)}
+    log(json.dumps(out))
+    return out
+
+
 def bench_cifar_e2e(rounds: int = 6, tau: int = 100,
                     prefetch: bool = True) -> float:
     """Sustained HOST-FED CIFAR training throughput, prefetch on — the
@@ -423,6 +488,7 @@ def main() -> None:
         "alexnet", "/root/reference/caffe/models/bvlc_alexnet", 256)
     goog_inf = bench_inference(
         "googlenet", "/root/reference/caffe/models/bvlc_googlenet", 128)
+    longctx = bench_longctx_lm()
     cifar_e2e = bench_cifar_e2e()
     log(json.dumps({"cifar_e2e_imgs_per_sec": round(cifar_e2e, 1)}))
 
@@ -446,6 +512,7 @@ def main() -> None:
         "googlenet_b128_mfu": goog128["mfu"],
         "alexnet_infer_imgs_per_sec": alex_inf["infer_imgs_per_sec"],
         "googlenet_infer_imgs_per_sec": goog_inf["infer_imgs_per_sec"],
+        "longctx_lm_tok_per_sec": longctx["longctx_lm_tok_per_sec"],
         "cifar_e2e_imgs_per_sec": round(cifar_e2e, 1),
     }
     print(json.dumps(result))

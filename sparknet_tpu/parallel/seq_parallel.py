@@ -185,6 +185,7 @@ class SeqParallelTrainer:
                  mesh: Optional[Mesh] = None,
                  n_devices: Optional[int] = None,
                  method: str = "ring",
+                 dp: int = 1, data_axis: str = "data",
                  precision: Optional[str] = None) -> None:
         if method not in ("ring", "ulysses"):
             raise ValueError(f"unknown method {method!r}")
@@ -197,15 +198,33 @@ class SeqParallelTrainer:
         self.param = solver_param
         self.apply_fn = apply_fn
         self.method = method
+        self.dp = int(dp)
+        if self.dp < 1:
+            raise ValueError(f"dp must be >= 1, got {dp}")
+        self.data_axis = data_axis
         if mesh is None:
             devs = jax.devices()
-            n = n_devices or len(devs)
-            if len(devs) < n:
-                raise ValueError(f"need {n} devices, have {len(devs)}")
-            mesh = Mesh(np.array(devs[:n]), (SEQ_AXIS,))
+            n = n_devices or (len(devs) // self.dp)
+            need = n * self.dp
+            if n < 1 or len(devs) < need:
+                # n < 1 means dp alone exceeds the device count — the
+                # floored default would otherwise build a 0-wide mesh
+                # and die with a bare numpy IndexError
+                raise ValueError(
+                    f"need {max(need, self.dp)} devices, have "
+                    f"{len(devs)}")
+            # DPxSP: replica groups over `data` (outermost), sequence
+            # shards over `seq` so each replica's ring rides neighbors
+            mesh = (Mesh(np.array(devs[:need]).reshape(self.dp, n),
+                         (data_axis, SEQ_AXIS)) if self.dp > 1
+                    else Mesh(np.array(devs[:n]), (SEQ_AXIS,)))
         if SEQ_AXIS not in mesh.shape:
             raise ValueError(f"mesh has no {SEQ_AXIS!r} axis: "
                              f"{dict(mesh.shape)}")
+        if self.dp > 1 and mesh.shape.get(data_axis) != self.dp:
+            raise ValueError(
+                f"mesh axis {data_axis!r} has "
+                f"{mesh.shape.get(data_axis)} devices but dp={self.dp}")
         self.mesh = mesh
         self.n_shards = mesh.shape[SEQ_AXIS]
         self.precision = resolve_precision(solver_param, precision)
@@ -237,9 +256,16 @@ class SeqParallelTrainer:
             nll = -jnp.take_along_axis(
                 logp, targets[..., None], axis=-1)[..., 0]
             # equal shards: pmean of local means == global per-token mean
-            return lax.pmean(nll.mean(), SEQ_AXIS)
+            total = lax.pmean(nll.mean(), SEQ_AXIS)
+            if dp > 1:
+                # batch rows shard over `data`: replica-mean completes the
+                # global mean (and, transposed, the gradient average)
+                total = lax.pmean(total, data_axis)
+            return total
 
-        tok_spec = P(None, SEQ_AXIS)
+        dp, data_axis = self.dp, self.data_axis
+        tok_spec = (P(data_axis, SEQ_AXIS) if dp > 1
+                    else P(None, SEQ_AXIS))
         return shard_map(
             sp_loss_sharded, mesh=self.mesh,
             in_specs=(P(), tok_spec, tok_spec), out_specs=P(),
@@ -271,6 +297,10 @@ class SeqParallelTrainer:
             raise ValueError(
                 f"sequence length {tokens.shape[1]} does not divide over "
                 f"{self.n_shards} sequence shards")
+        if self.dp > 1 and tokens.shape[0] % self.dp:
+            raise ValueError(
+                f"batch {tokens.shape[0]} does not divide over "
+                f"dp={self.dp} data replicas")
 
     def step(self, tokens, targets) -> float:
         """One update on a (B, S) token batch with (B, S) next-token

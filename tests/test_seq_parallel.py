@@ -168,3 +168,44 @@ def test_attn_block_divisibility_and_iter_size_rejected():
     with pytest.raises(ValueError, match="iter_size"):
         SeqParallelTrainer(sp, apply_fn=apply_fn, params=init(0),
                            n_devices=8)
+
+
+def test_dp_sp_hybrid_matches_dense_trajectory():
+    """DPxSP on a (data, seq) = (2, 4) mesh: batch rows shard over
+    replicas, sequence over the ring — three steps must equal the plain
+    dense single-device trajectory, like every other composition."""
+    _need_devices(8)
+    init, apply_fn = tiny_transformer(LAYERS, V, D, HEADS, max_seq=S)
+    params0 = init(0)
+    tr = SeqParallelTrainer(_solver_param(), apply_fn=apply_fn,
+                            params=params0, n_devices=4, dp=2)
+    assert dict(tr.mesh.shape) == {"data": 2, "seq": 4}
+
+    ref = {k: jnp.asarray(v) for k, v in params0.items()}
+    vel = {k: jnp.zeros_like(v) for k, v in ref.items()}
+    lr, mu, wd = 0.1, 0.9, 0.0005
+    rng = np.random.RandomState(11)
+    for _ in range(3):
+        tokens, targets = _data(rng)
+        ref_loss, g = jax.value_and_grad(
+            lambda p: _dense_loss(apply_fn, p, tokens, targets))(ref)
+        got = tr.step(tokens, targets)
+        np.testing.assert_allclose(got, float(ref_loss), rtol=2e-5)
+        for k in ref:
+            vel[k] = mu * vel[k] + lr * (g[k] + wd * ref[k])
+            ref[k] = ref[k] - vel[k]
+    for k in ref:
+        np.testing.assert_allclose(np.asarray(tr.params[k]),
+                                   np.asarray(ref[k]),
+                                   rtol=3e-5, atol=1e-6)
+
+    with pytest.raises(ValueError, match="does not divide over"):
+        tr.step(np.zeros((3, S), np.int32), np.zeros((3, S), np.int32))
+
+
+def test_dp_exceeding_devices_rejected_cleanly():
+    _need_devices(1)
+    init, apply_fn = tiny_transformer(1, V, D, HEADS, max_seq=S)
+    with pytest.raises(ValueError, match="devices"):
+        SeqParallelTrainer(_solver_param(), apply_fn=apply_fn,
+                           params=init(0), dp=1024)
