@@ -27,30 +27,82 @@ SHAPES = [
     ("goog_4a_1x1", 64, 480, 14, 14, 192, 1, 1, 0),
 ]
 
-ITERS = 30
+ITERS = 100
 
 
-def chain_time(make_loss, x, wt):
-    """One jitted scan of ITERS dependent grad steps; returns s/step."""
+def _fetch_floor():
+    """Median seconds to dispatch + VALUE-fetch a trivial program — the
+    fixed per-measurement cost (tunnel RTT) subtracted from every
+    window.  Measured, not assumed: on the tunneled dev platform it is
+    ~100 ms; on a local backend ~0.3 ms."""
+    @jax.jit
+    def tiny(s):
+        return s + 1.0
+
+    s = jnp.float32(0.0)
+    s = tiny(s)
+    float(s)  # warm/compile
+    ts = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        s = tiny(s)
+        float(s)
+        ts.append(time.perf_counter() - t0)
+    ts.sort()
+    return ts[1]
+
+
+def chain_time(make_loss, x, wt, floor):
+    """Per-step seconds: ONE dispatch scanning `iters` dependent grad
+    steps (no cross-dispatch chain for the tunnel to dedup; the salt
+    keeps repeat dispatches bitwise-distinct anyway), synced by VALUE
+    fetch, with the separately measured fetch floor subtracted.
+
+    `iters` escalates until the net work window dominates the floor, so
+    sub-ms shapes don't drown in the tunnel RTT's run-to-run jitter
+    (which would make their ratios meaningless and the naive
+    floor-subtraction go <= 0).  Differenced multi-dispatch windows
+    (utils/timers) break down here for the same reason — one long
+    amortized window is the stable form (BENCH_NOTES.md round-3
+    measurement trap)."""
     grad = jax.grad(lambda w_, x_: make_loss(x_, w_))
 
-    @jax.jit
-    def run(w0):
-        def body(w_, _):
-            g = grad(w_, x)
-            return (w_ - 1e-12 * g).astype(w_.dtype), ()
-        wN, _ = lax.scan(body, w0, None, length=ITERS)
-        return jnp.sum(wN.astype(jnp.float32))
+    def measure(iters):
+        @jax.jit
+        def run(w0, salt):
+            def body(w_, _):
+                g = grad(w_, x)
+                return (w_ - 1e-12 * g).astype(w_.dtype), ()
+            wN, _ = lax.scan(body, w0 + salt.astype(w0.dtype), None,
+                             length=iters)
+            s = jnp.sum(wN.astype(jnp.float32))
+            return s, salt + s * 1e-9 + 1e-3
 
-    jax.block_until_ready(run(wt))  # compile
-    t0 = time.perf_counter()
-    jax.block_until_ready(run(wt))
-    return (time.perf_counter() - t0) / ITERS
+        salt = jnp.float32(0.0)
+        s, salt = run(wt, salt)
+        float(s)  # warm/compile
+        ts = []
+        for _ in range(3):
+            t0 = time.perf_counter()
+            s, salt = run(wt, salt)
+            float(s)
+            ts.append(time.perf_counter() - t0)
+        ts.sort()
+        return ts[1] - floor
+
+    iters = ITERS
+    net = measure(iters)
+    while net < 2.0 * floor and iters < 32 * ITERS:
+        iters *= 4
+        net = measure(iters)
+    return max(net, 1e-9) / iters
 
 
 def main():
     rng = np.random.RandomState(0)
     print("device:", jax.devices()[0])
+    floor = _fetch_floor()
+    print(f"fetch floor: {floor*1e3:.1f} ms (subtracted per window)")
     tot = {"NCHW": 0.0, "NHWC": 0.0}
     for name, n, c, h, w, k, kh, st, pd in SHAPES:
         oh = (h + 2 * pd - kh) // st + 1
@@ -63,20 +115,25 @@ def main():
         x_nhwc = jnp.transpose(x_nchw, (0, 2, 3, 1))
         w_hwio = jnp.transpose(w_oihw, (2, 3, 1, 0))
 
+        # loss must be NON-LINEAR in y: sum(conv(x, w)) is algebraically
+        # collapsible (XLA folds the linear reduction through the conv,
+        # and the all-ones cotangent degenerates the weight-grad kernel),
+        # which was measured as impossible >=peak TF/s and ~zero-time
+        # shapes — sum(y^2) forces the real fwd conv and a real cotangent
         def loss_nchw(x, wt):
             y = lax.conv_general_dilated(
                 x, wt, (st, st), [(pd, pd), (pd, pd)],
                 dimension_numbers=("NCHW", "OIHW", "NCHW"))
-            return jnp.sum(y.astype(jnp.float32))
+            return jnp.sum(jnp.square(y.astype(jnp.float32)))
 
         def loss_nhwc(x, wt):
             y = lax.conv_general_dilated(
                 x, wt, (st, st), [(pd, pd), (pd, pd)],
                 dimension_numbers=("NHWC", "HWIO", "NHWC"))
-            return jnp.sum(y.astype(jnp.float32))
+            return jnp.sum(jnp.square(y.astype(jnp.float32)))
 
-        t1 = chain_time(loss_nchw, x_nchw, w_oihw)
-        t2 = chain_time(loss_nhwc, x_nhwc, w_hwio)
+        t1 = chain_time(loss_nchw, x_nchw, w_oihw, floor)
+        t2 = chain_time(loss_nhwc, x_nhwc, w_hwio, floor)
         tot["NCHW"] += t1
         tot["NHWC"] += t2
         print(f"{name:14s} NCHW {t1*1e3:7.2f} ms ({flops/t1/1e12:6.1f} TF/s)"
