@@ -148,27 +148,10 @@ class GspmdTrainer:
         array straight into its mesh sharding."""
         from ..utils import orbax_ckpt
 
-        it, params, state = orbax_ckpt.restore_auto(
-            path, known_params=self.params,
+        self.iter, self.params, self.state = orbax_ckpt.restore_validated(
+            path, known_params=self.params, known_state=self.state,
             sharding_for=lambda k: NamedSharding(self.mesh,
                                                  self.param_specs[k]))
-        missing = set(self.params) - set(params)
-        if missing:
-            raise ValueError(f"snapshot lacks params: {sorted(missing)}")
-        missing_state = set(self.state) - set(state)
-        if missing_state:
-            raise ValueError(
-                f"snapshot lacks solver state for: {sorted(missing_state)}")
-
-        def shard(k):
-            return NamedSharding(self.mesh, self.param_specs[k])
-
-        self.params = {k: jax.device_put(jnp.asarray(params[k]), shard(k))
-                       for k in self.params}
-        self.state = {k: tuple(jax.device_put(jnp.asarray(h), shard(k))
-                               for h in state[k])
-                      for k in self.state}
-        self.iter = int(it)
 
     def step(self, n: int = 1) -> float:
         assert self.train_source is not None, "set_train_data first"

@@ -305,27 +305,10 @@ class PipelineTrainer:
 
         # orbax arrays restore directly onto their home-stage device (no
         # default-device detour, no topology warning)
-        it, params, state = orbax_ckpt.restore_auto(
-            path, known_params=self.params,
+        self.iter, self.params, self.state = orbax_ckpt.restore_validated(
+            path, known_params=self.params, known_state=self.state,
             sharding_for=lambda k: SingleDeviceSharding(
                 self.devices[self._key_stage[k]]))
-        missing = set(self.params) - set(params)
-        if missing:
-            raise ValueError(f"snapshot lacks params: {sorted(missing)}")
-        missing_state = set(self.state) - set(state)
-        if missing_state:
-            raise ValueError(
-                f"snapshot lacks solver state for: {sorted(missing_state)}")
-        self.params = {
-            k: jax.device_put(jnp.asarray(params[k]),
-                              self.devices[self._key_stage[k]])
-            for k in self.params}
-        self.state = {
-            k: tuple(jax.device_put(jnp.asarray(h),
-                                    self.devices[self._key_stage[k]])
-                     for h in state[k])
-            for k in self.state}
-        self.iter = int(it)
 
     def step(self, n: int = 1) -> float:
         """n full-batch iterations, each = GPipe forward stream + VJP

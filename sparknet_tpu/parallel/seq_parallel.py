@@ -320,3 +320,24 @@ class SeqParallelTrainer:
         targets = jnp.asarray(targets, jnp.int32)
         self._validate(tokens, targets)
         return float(self._loss_jit(self.params, tokens, targets))
+
+    # ------------------------------------------------------- checkpointing
+    def snapshot(self, path: str) -> str:
+        """Snapshot triple (iter + params + solver state), same backends
+        as every other trainer (reference role: Solver::Snapshot,
+        solver.cpp:446-466)."""
+        from ..utils import orbax_ckpt
+
+        return orbax_ckpt.save_auto(path, self.iter, self.params,
+                                    self.state)
+
+    def restore(self, path: str) -> None:
+        """Exact resume: params/state return mesh-replicated, so the
+        post-restore trajectory equals the uninterrupted run (reference:
+        Solver::Restore)."""
+        from ..utils import orbax_ckpt
+
+        repl = NamedSharding(self.mesh, P())
+        self.iter, self.params, self.state = orbax_ckpt.restore_validated(
+            path, known_params=self.params, known_state=self.state,
+            sharding_for=lambda k: repl)

@@ -114,3 +114,36 @@ def restore(path: str, *, known_params=None,
     params = dict(payload["params"])
     state = {k: tuple(v) for k, v in payload["state"].items()}
     return it, params, state
+
+
+def restore_validated(path: str, *, known_params, known_state,
+                      sharding_for):
+    """The shared trainer-restore sequence: restore_auto, validate that
+    the snapshot covers every known param AND solver-state key (a partial
+    checkpoint must fail HERE with a named error, not later as an opaque
+    KeyError inside the jitted update), then device_put everything back
+    through `sharding_for`.  Returns (iter, params, state) keyed by the
+    CALLER's keys — orphan snapshot entries are dropped, so a restore
+    never smuggles foreign keys into the update pipeline.  Used by
+    GspmdTrainer, PipelineTrainer and SeqParallelTrainer so the three
+    checkpoint contracts cannot drift (reference role: Solver::Restore,
+    solver.cpp:467+)."""
+    import jax
+    import jax.numpy as jnp
+
+    it, params, state = restore_auto(path, known_params=known_params,
+                                     sharding_for=sharding_for)
+    missing = set(known_params) - set(params)
+    if missing:
+        raise ValueError(f"snapshot lacks params: {sorted(missing)}")
+    missing_state = set(known_state) - set(state)
+    if missing_state:
+        raise ValueError(
+            f"snapshot lacks solver state for: {sorted(missing_state)}")
+    new_params = {k: jax.device_put(jnp.asarray(params[k]),
+                                    sharding_for(k))
+                  for k in known_params}
+    new_state = {k: tuple(jax.device_put(jnp.asarray(h), sharding_for(k))
+                          for h in state[k])
+                 for k in known_state}
+    return int(it), new_params, new_state

@@ -209,3 +209,53 @@ def test_dp_exceeding_devices_rejected_cleanly():
     with pytest.raises(ValueError, match="devices"):
         SeqParallelTrainer(_solver_param(), apply_fn=apply_fn,
                            params=init(0), dp=1024)
+
+
+def test_snapshot_restore_exact_resume(tmp_path):
+    """Kill-and-resume reproduces the uninterrupted trajectory exactly —
+    the same contract every other trainer's snapshot meets (Solver::
+    Snapshot/Restore role)."""
+    _need_devices(8)
+    init, apply_fn = tiny_transformer(LAYERS, V, D, HEADS, max_seq=S)
+    rng = np.random.RandomState(9)
+    batches = [_data(rng) for _ in range(6)]
+
+    straight = SeqParallelTrainer(_solver_param(), apply_fn=apply_fn,
+                                  params=init(0), n_devices=8)
+    for toks, tgts in batches:
+        straight.step(toks, tgts)
+
+    resumed = SeqParallelTrainer(_solver_param(), apply_fn=apply_fn,
+                                 params=init(0), n_devices=8)
+    for toks, tgts in batches[:3]:
+        resumed.step(toks, tgts)
+    path = str(tmp_path / "sp_snap")
+    resumed.snapshot(path)
+
+    fresh = SeqParallelTrainer(_solver_param(), apply_fn=apply_fn,
+                               params=init(42), n_devices=8)
+    fresh.restore(path)
+    assert fresh.iter == 3
+    for toks, tgts in batches[3:]:
+        fresh.step(toks, tgts)
+
+    for k in straight.params:
+        np.testing.assert_array_equal(np.asarray(fresh.params[k]),
+                                      np.asarray(straight.params[k]))
+
+
+def test_restore_rejects_partial_snapshot(tmp_path):
+    """A params-only snapshot (no solver state) must fail at restore time
+    with a named error, not later as an opaque KeyError inside the jitted
+    update — the shared restore_validated contract all trainers use."""
+    _need_devices(8)
+    init, apply_fn = tiny_transformer(1, V, D, HEADS, max_seq=S)
+    tr = SeqParallelTrainer(_solver_param(), apply_fn=apply_fn,
+                            params=init(0), n_devices=8)
+    path = str(tmp_path / "partial.npz")
+    arrays = {"__iter__": np.asarray(2)}
+    for k, v in tr.params.items():
+        arrays[f"param:{k}"] = np.asarray(v)
+    np.savez(path, **arrays)  # state slots deliberately omitted
+    with pytest.raises(ValueError, match="lacks solver state"):
+        tr.restore(path)
