@@ -413,22 +413,15 @@ class CompiledPipeline:
             self.state)
 
     def restore(self, path: str) -> None:
-        """Exact resume: stage params return pipe-sharded, head/state
-        replicated, so the post-restore trajectory equals the
-        uninterrupted run (reference: Solver::Restore)."""
+        """Exact resume: stage params return pipe/model-sharded,
+        head/state per their param shardings, so the post-restore
+        trajectory equals the uninterrupted run (reference:
+        Solver::Restore).  Shares restore_validated with the other
+        trainers: partial snapshots fail here with named errors."""
         from ..utils import orbax_ckpt
 
-        sharding_for = self._sharding
         known = self._flatten(self.stacked, self.head)
-        it, params, state = orbax_ckpt.restore_auto(
-            path, known_params=known, sharding_for=sharding_for)
-        missing = set(known) - set(params)
-        if missing:
-            raise ValueError(f"snapshot lacks params: {sorted(missing)}")
-        flat = {k: jax.device_put(jnp.asarray(params[k]), sharding_for(k))
-                for k in known}
+        self.iter, flat, self.state = orbax_ckpt.restore_validated(
+            path, known_params=known, known_state=self.state,
+            sharding_for=self._sharding)
         self.stacked, self.head = self._split(flat)
-        self.state = {k: tuple(jax.device_put(jnp.asarray(h),
-                                              sharding_for(k))
-                               for h in state[k]) for k in state}
-        self.iter = int(it)

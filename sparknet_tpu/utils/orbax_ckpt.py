@@ -125,8 +125,9 @@ def restore_validated(path: str, *, known_params, known_state,
     through `sharding_for`.  Returns (iter, params, state) keyed by the
     CALLER's keys — orphan snapshot entries are dropped, so a restore
     never smuggles foreign keys into the update pipeline.  Used by
-    GspmdTrainer, PipelineTrainer and SeqParallelTrainer so the three
-    checkpoint contracts cannot drift (reference role: Solver::Restore,
+    GspmdTrainer, PipelineTrainer, CompiledPipeline and
+    SeqParallelTrainer so the trainers' checkpoint contracts cannot
+    drift (reference role: Solver::Restore,
     solver.cpp:467+)."""
     import jax
     import jax.numpy as jnp
