@@ -137,7 +137,11 @@ def blockwise_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
     if scale is None:
         scale = q.shape[-1] ** -0.5
     b, h, s, d = q.shape
-    assert k.shape[2] % block_size == 0
+    if block_size < 1:
+        raise ValueError(f"block_size must be >= 1, got {block_size}")
+    if k.shape[2] % block_size:
+        raise ValueError(f"key length {k.shape[2]} not divisible by "
+                         f"block_size {block_size}")
     n_blocks = k.shape[2] // block_size
     kb = k.reshape(b, h, n_blocks, block_size, d)
     vb = v.reshape(b, h, n_blocks, block_size, d)

@@ -36,9 +36,16 @@ SEQ_AXIS = "seq"
 
 def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
                    axis_name: str, causal: bool = False,
-                   scale: Optional[float] = None) -> jax.Array:
+                   scale: Optional[float] = None,
+                   block_size: Optional[int] = None) -> jax.Array:
     """Call INSIDE shard_map.  q/k/v: this device's sequence shard
-    (B, H, S_local, D); returns the local shard of the attention output."""
+    (B, H, S_local, D); returns the local shard of the attention output.
+
+    `block_size` subdivides each hop's KV shard through the same
+    online-softmax carry: without it a hop transiently materializes the
+    full (S_local x S_local) score block (~1 GB at S_local=8k, 8 heads,
+    bf16) even though the remat keeps it out of the saved residuals —
+    sub-blocking caps the live scratch at (S_local x block)."""
     if scale is None:
         scale = q.shape[-1] ** -0.5
     n = jax.lax.axis_size(axis_name)
@@ -63,14 +70,34 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
     # itself be an (S_local x S_local) residual per hop.  The ppermute
     # hops stay OUTSIDE so the backward replays arithmetic, not
     # communication.
+    if block_size is not None and block_size < 1:
+        raise ValueError(f"block_size must be >= 1, got {block_size}")
+    blk = s_local if block_size is None else block_size
+    if s_local % blk:
+        raise ValueError(f"S_local {s_local} not divisible by "
+                         f"block_size {blk}")
+    n_sub = s_local // blk
+
     @functools.partial(jax.checkpoint, prevent_cse=False)
-    def hop_update(carry, k_cur, v_cur, src):
+    def sub_update(carry, kblk, vblk, kpos0):
         if causal:
-            kpos = src * s_local + jnp.arange(s_local)
+            kpos = kpos0 + jnp.arange(blk)
             mask = (qpos[:, None] >= kpos[None, :])[None, None]
         else:
             mask = None
-        return _block_update(carry, q, k_cur, v_cur, scale, mask)
+        return _block_update(carry, q, kblk, vblk, scale, mask)
+
+    def hop_update(carry, k_cur, v_cur, src):
+        kb = jnp.moveaxis(k_cur.reshape(b, h, n_sub, blk, d), 2, 0)
+        vb = jnp.moveaxis(v_cur.reshape(b, h, n_sub, blk, d), 2, 0)
+
+        def sub_body(c, xs):
+            kx, vx, j = xs
+            return sub_update(c, kx, vx, src * s_local + j * blk), None
+
+        carry, _ = jax.lax.scan(sub_body, carry,
+                                (kb, vb, jnp.arange(n_sub)))
+        return carry
 
     def body(r, state):
         o, m, l, k_cur, v_cur = state
@@ -91,11 +118,14 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
 
 def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
                       axis_name: str, causal: bool = False,
-                      scale: Optional[float] = None) -> jax.Array:
+                      scale: Optional[float] = None,
+                      block_size: Optional[int] = None) -> jax.Array:
     """Call INSIDE shard_map.  all_to_all: (B, H, S/n, D) -> (B, H/n, S, D),
-    dense attention on full sequences for this device's head group, inverse
-    all_to_all back to sequence sharding."""
-    from ..ops.attention import attention
+    attention on full sequences for this device's head group (dense, or
+    the remat'd blockwise kernel when `block_size` is given — the full-S
+    score matrix is the memory hazard here), inverse all_to_all back to
+    sequence sharding."""
+    from ..ops.attention import attention, blockwise_attention
 
     def to_heads(x):
         # split heads across devices, gather sequence
@@ -107,7 +137,11 @@ def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
                                   tiled=True)
 
     qh, kh, vh = to_heads(q), to_heads(k), to_heads(v)
-    oh = attention(qh, kh, vh, causal=causal, scale=scale)
+    if block_size is not None:
+        oh = blockwise_attention(qh, kh, vh, block_size=block_size,
+                                 causal=causal, scale=scale)
+    else:
+        oh = attention(qh, kh, vh, causal=causal, scale=scale)
     return to_seq(oh)
 
 
@@ -116,9 +150,13 @@ def sequence_parallel_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
                                 n_devices: Optional[int] = None,
                                 causal: bool = False,
                                 scale: Optional[float] = None,
-                                method: str = "ring") -> jax.Array:
+                                method: str = "ring",
+                                block_size: Optional[int] = None
+                                ) -> jax.Array:
     """User-facing wrapper: shards (B, H, S, D) inputs over a sequence mesh
-    axis and runs ring or ulysses attention as one compiled program."""
+    axis and runs ring or ulysses attention as one compiled program.
+    `block_size` bounds each device's live score scratch (ring: per-hop
+    sub-blocks; ulysses: the blockwise kernel over the gathered S)."""
     if mesh is None:
         devs = jax.devices()
         n = n_devices or len(devs)
@@ -137,6 +175,7 @@ def sequence_parallel_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
         shard_map, mesh=mesh, in_specs=(spec, spec, spec),
         out_specs=spec, check_vma=False)
     def run(q, k, v):
-        return fn(q, k, v, axis_name=SEQ_AXIS, causal=causal, scale=scale)
+        return fn(q, k, v, axis_name=SEQ_AXIS, causal=causal, scale=scale,
+                  block_size=block_size)
 
     return run(q, k, v)

@@ -53,10 +53,12 @@ def tiny_transformer(n_layers: int, vocab: int, d_model: int,
         trajectory); an axis name runs ring/Ulysses attention INSIDE
         shard_map with global positions derived from the shard index.
 
-    `attn_block` switches the single-device path from dense to the
-    remat'd blockwise kernel (O(S*block) memory), which is what lets ONE
-    chip train at contexts whose dense scores would overflow HBM
-    (BENCH_NOTES.md round-3 long-context table; S=65k measured).
+    `attn_block` bounds the live attention-score scratch in EVERY mode:
+    single-device it selects the remat'd blockwise kernel (O(S*block)
+    memory — what lets ONE chip train at contexts whose dense scores
+    would overflow HBM; S=65k measured, BENCH_NOTES.md), under SP it
+    sub-blocks each ring hop / the Ulysses gathered sequence the same
+    way.
 
     `remat_layers` is a SINGLE-CHIP memory knob: it checkpoints each
     whole layer (save only its input, recompute internals in the
@@ -141,10 +143,10 @@ def tiny_transformer(n_layers: int, vocab: int, d_model: int,
                     o = attention(q, k, v, causal=True)
             elif method == "ring":
                 o = ring_attention(q, k, v, axis_name=axis_name,
-                                   causal=True)
+                                   causal=True, block_size=attn_block)
             else:
                 o = ulysses_attention(q, k, v, axis_name=axis_name,
-                                      causal=True)
+                                      causal=True, block_size=attn_block)
             o = jnp.moveaxis(o, 1, 2).reshape(b, s_local, d_model)
             x = x + o @ lp["wo"]
             h2 = _ln(x, lp["ln2"])

@@ -86,3 +86,30 @@ def test_flash_attention_wrapper_matches_dense():
         ref = attention(q, k, v, causal=causal)
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                    rtol=2e-4, atol=2e-4)
+
+
+@pytest.mark.parametrize("method,block", [("ring", 2), ("ring", 4),
+                                          ("ulysses", 16)])
+def test_sequence_parallel_block_size_plumbing(rng, method, block):
+    """The public wrapper's block_size must reach the collective kernels
+    (sub-blocked results stay exact vs dense) and bad values fail with
+    named errors — a dropped kwarg would silently revert users to
+    full-shard score scratch."""
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 devices")
+    q = jnp.asarray(rng.randn(2, 8, 64, 16).astype(np.float32))
+    k = jnp.asarray(rng.randn(2, 8, 64, 16).astype(np.float32))
+    v = jnp.asarray(rng.randn(2, 8, 64, 16).astype(np.float32))
+    from sparknet_tpu.ops.attention import attention
+
+    dense = attention(q, k, v, causal=True)
+    out = sequence_parallel_attention(q, k, v, n_devices=8, causal=True,
+                                      method=method, block_size=block)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(dense),
+                               rtol=1e-5, atol=1e-5)
+    with pytest.raises(ValueError, match="not divisible"):
+        sequence_parallel_attention(q, k, v, n_devices=8, causal=True,
+                                    method=method, block_size=3)
+    with pytest.raises(ValueError, match=">= 1"):
+        sequence_parallel_attention(q, k, v, n_devices=8, causal=True,
+                                    method=method, block_size=0)

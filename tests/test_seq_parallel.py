@@ -43,12 +43,17 @@ def _dense_loss(apply_fn, params, tokens, targets):
     return nll.mean()
 
 
-@pytest.mark.parametrize("method", ["ring", "ulysses"])
-def test_sp_trajectory_matches_dense(method):
+@pytest.mark.parametrize("method,attn_block", [
+    ("ring", None), ("ulysses", None),
+    # sub-blocked collectives (ring: per-hop; ulysses: gathered-S
+    # blockwise) must stay trajectory-exact too
+    ("ring", 2), ("ulysses", 8)])
+def test_sp_trajectory_matches_dense(method, attn_block):
     """Three training steps sharded over 8 sequence shards == three plain
     single-device steps with hand-rolled Caffe update math."""
     _need_devices(8)
-    init, apply_fn = tiny_transformer(LAYERS, V, D, HEADS, max_seq=S)
+    init, apply_fn = tiny_transformer(LAYERS, V, D, HEADS, max_seq=S,
+                                      attn_block=attn_block)
     params0 = init(0)
     tr = SeqParallelTrainer(_solver_param(), apply_fn=apply_fn,
                             params=params0, n_devices=8, method=method)
