@@ -47,3 +47,15 @@ layer { name: "prob" type: "Softmax" bottom: "ip" top: "prob" }
     assert r["batch"] == 7
     with pytest.raises(RuntimeError, match="fusion pass changed nothing"):
         bench.bench_inference("t", str(deploy), 7, fuse_1x1=True)
+
+
+def test_bench_longctx_lm_cpu():
+    """The driver runs this leg on real hardware at round end; CI pins
+    that it stays constructible and emits its field contract (a broken
+    leg would take the whole driver bench down with it)."""
+    import bench
+
+    r = bench.bench_longctx_lm(seq_len=128, n_layers=1, d_model=32,
+                               heads=4, block=32)
+    assert r["longctx_seq_len"] == 128
+    assert r["longctx_lm_tok_per_sec"] > 0
