@@ -44,10 +44,12 @@ import numpy as np
 
 def run_point(nw: int, tau, iters: int, xtr, ytr, test_batches,
               mean, emit, *, test_interval: int, num_test_batches: int,
-              lr1_iters: int = 0) -> float:
+              lr1_iters: int = 0, sync_history: str = "local") -> float:
     """Train one (n_workers, τ) configuration; returns final accuracy.
     tau="sync" selects per-step gradient pmean (mode="sync", the
-    P2PSync analogue) instead of τ-step weight averaging."""
+    P2PSync analogue) instead of τ-step weight averaging.
+    sync_history="average"/"reset" pmeans/zeroes the momentum history at
+    each weight average (dist.py docstring — the τ=1 interference fix)."""
     from sparknet_tpu.apps.cifar_app import WorkerFeed, build_solver
     from sparknet_tpu.data import partition as part
 
@@ -56,7 +58,8 @@ def run_point(nw: int, tau, iters: int, xtr, ytr, test_batches,
         tau = 1
     # scan_unroll=True: XLA:CPU loses its fast conv kernels inside scan
     # bodies (dist.py docstring); unrolling the τ loop is ~10x here
-    solver = build_solver("quick", nw, tau, scan_unroll=True, mode=mode)
+    solver = build_solver("quick", nw, tau, scan_unroll=True, mode=mode,
+                          sync_history=sync_history)
     shards = part.partition(xtr, ytr, nw)
     feeds = [WorkerFeed(x, y, mean, 100, tau, seed=100 + w)
              for w, (x, y) in enumerate(shards)]
@@ -84,7 +87,8 @@ def run_point(nw: int, tau, iters: int, xtr, ytr, test_batches,
                 scores = solver.test()
                 acc = float(scores.get("accuracy", 0.0))
                 emit(dict(event="test", n_workers=nw,
-                  tau=("sync" if mode == "sync" else tau), stage=stage,
+                  tau=("sync" if mode == "sync" else tau),
+                  sync_history=sync_history, stage=stage,
                           round=solver.round, iter=solver.iter,
                           images=solver.iter * 100 * nw,
                           loss=round(float(loss), 4),
@@ -154,29 +158,42 @@ def main() -> None:
               data_gen_s=round(time.time() - t0, 1), bayes_ceiling=0.91))
 
     def parse_spec(spec):
+        """nw:tau, tau one of: int, 'sync', or int+'m'/'r' — 'm' averages
+        the momentum history at each sync (sync_history='average'),
+        'r' resets it (sync_history='reset')."""
         nw_s, tau_s = spec.split(":")
-        return int(nw_s), ("sync" if tau_s == "sync" else int(tau_s))
+        if tau_s == "sync":
+            return int(nw_s), "sync", "local"
+        hist = "local"
+        if tau_s.endswith("m"):
+            tau_s, hist = tau_s[:-1], "average"
+        elif tau_s.endswith("r"):
+            tau_s, hist = tau_s[:-1], "reset"
+        return int(nw_s), int(tau_s), hist
 
     finals = {}
     for spec in [s for s in a.points.split(",") if s]:
-        nw, tau = parse_spec(spec)
+        nw, tau, hist = parse_spec(spec)
         t0 = time.time()
         acc = run_point(nw, tau, a.iters, xtr, ytr, test_batches, mean,
                         emit, test_interval=a.test_interval,
-                        num_test_batches=a.test_batches)
+                        num_test_batches=a.test_batches,
+                        sync_history=hist)
         finals[spec] = acc
         emit(dict(event="point_done", n_workers=nw, tau=tau,
+                  sync_history=hist,
                   iters=a.iters, final_accuracy=round(acc, 4),
                   wall_s=round(time.time() - t0, 1)))
 
     if a.full_point:
-        nw, tau = parse_spec(a.full_point)
+        nw, tau, hist = parse_spec(a.full_point)
         t0 = time.time()
         acc = run_point(nw, tau, a.full_iters, xtr, ytr, test_batches,
                         mean, emit, test_interval=500,
                         num_test_batches=len(test_batches),
-                        lr1_iters=a.full_lr1_iters)
+                        lr1_iters=a.full_lr1_iters, sync_history=hist)
         emit(dict(event="full_done", n_workers=nw, tau=tau,
+                  sync_history=hist,
                   iters=a.full_iters + a.full_lr1_iters,
                   final_accuracy=round(acc, 4),
                   bayes_ceiling=0.91,

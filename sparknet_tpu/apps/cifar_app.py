@@ -74,11 +74,13 @@ def build_solver(model: str, n_workers: int, tau: int, mesh=None,
                  proto_dir: str = REFERENCE_PROTO_DIR,
                  batch_size: int = TRAIN_BATCH_SIZE,
                  dcn_interval: int = 1,
-                 scan_unroll=1, mode: str = "average") -> DistributedSolver:
+                 scan_unroll=1, mode: str = "average",
+                 sync_history: str = "local") -> DistributedSolver:
     """ProtoLoader flow (CifarApp.scala:81-89): net prototxt ->
     replaceDataLayers -> solver-with-inline-net -> instantiate.
     mode="sync" selects per-step gradient pmean (the P2PSync analogue)
-    instead of τ-averaging."""
+    instead of τ-averaging; sync_history averages/resets the momentum
+    slots at each weight average (dist.py docstring)."""
     net = caffe_pb.load_net_prototxt(
         os.path.join(proto_dir, f"cifar10_{model}_train_test.prototxt"))
     net = caffe_pb.replace_data_layers(net, batch_size, batch_size,
@@ -87,7 +89,8 @@ def build_solver(model: str, n_workers: int, tau: int, mesh=None,
         os.path.join(proto_dir, f"cifar10_{model}_solver.prototxt"), net)
     return DistributedSolver(sp, n_workers=n_workers, tau=tau, mesh=mesh,
                              dcn_interval=dcn_interval, mode=mode,
-                             scan_unroll=scan_unroll)
+                             scan_unroll=scan_unroll,
+                             sync_history=sync_history)
 
 
 class WorkerFeed:

@@ -272,8 +272,11 @@ def cmd_time(args) -> int:
     for _ in range(n):
         fetch([probe])
     floor_ms = t.stop() / n
-    print(f"(per-fetch sync overhead ~{floor_ms:.3f} ms, included in "
-          f"per-layer rows)")
+    # the floor is PER FETCHED ARRAY; a row fetches every top (forward)
+    # or every gradient leaf (backward), so its included overhead is
+    # floor x that row's array count (ADVICE r3) — state it that way
+    print(f"(sync overhead ~{floor_ms:.3f} ms PER FETCHED ARRAY; each "
+          f"row includes it once per top/gradient fetched)")
 
     # per-layer eager forward + backward timing (reference: caffe.cpp
     # :331-356 prints "<layer> forward:"/"backward:" averages)

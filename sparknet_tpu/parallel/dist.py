@@ -70,11 +70,25 @@ class DistributedSolver:
                  batch_override: Optional[int] = None,
                  mesh=None, precision: Optional[str] = None,
                  dcn_interval: int = 1, device_transform=None,
-                 device_transform_eval=None, scan_unroll=1) -> None:
+                 device_transform_eval=None, scan_unroll=1,
+                 sync_history: str = "local") -> None:
         """device_transform(_eval): optional jittable augmentation fns
         (ops/device_transform.py) fused in front of the train step / test
         forward — feeds then ship raw uint8 and the crop/mirror/mean
         arithmetic runs on device inside the compiled round.
+
+        sync_history: what happens to the per-worker solver history
+        (momentum slots, sgd_solver.cpp:207-240 semantics) at each weight
+        average.  "local" keeps it worker-local across rounds (the
+        reference's WorkerStore behavior — each executor's solver history
+        persists untouched).  At small τ that measurably degrades
+        convergence: every worker's momentum keeps pushing its own
+        pre-average direction against the freshly-averaged weights
+        (DISTACC.md, 8w τ=1 collapse).  "average" pmeans the history
+        together with the weights — the natural fix, equivalent to the
+        literal algorithm "N solo solvers, then average weights AND
+        history" — and "reset" zeroes it at each sync (momentum restart).
+        Only meaningful for mode="average"; sync mode never diverges.
 
         scan_unroll: unroll factor for the τ-step lax.scan (True = fully).
         Keep the default (rolled) on TPU — compile time scales with the
@@ -85,6 +99,16 @@ class DistributedSolver:
         them — the knob scripts/distacc_run.py runs the convergence study
         through."""
         assert mode in ("average", "sync")
+        if sync_history not in ("local", "average", "reset"):
+            raise ValueError(
+                f"sync_history must be 'local', 'average' or 'reset', "
+                f"got {sync_history!r}")
+        if mode == "sync" and sync_history != "local":
+            raise ValueError(
+                "sync_history only applies to mode='average': sync mode "
+                "pmeans gradients every step, so per-worker history never "
+                "diverges and there is nothing to average or reset")
+        self.sync_history = sync_history
         self.device_transform = device_transform
         self.device_transform_eval = device_transform_eval
         self.scan_unroll = scan_unroll
@@ -149,6 +173,7 @@ class DistributedSolver:
     def _build_round_fn(self, avg_dcn: bool = True):
         tau = self.tau
         mode = self.mode
+        sync_history = self.sync_history
         axis = WORKER_AXIS
         has_dcn = self.has_dcn
         # sync mode always syncs globally; average mode crosses DCN only on
@@ -202,10 +227,19 @@ class DistributedSolver:
                 # the τ-interval weight average (WeightCollection mean,
                 # Net.scala:14-47) as one ICI collective...
                 params = jax.lax.pmean(params, axis)
+                if sync_history == "average":
+                    # momentum travels with the weights it was built
+                    # against — fixes the small-τ interference where each
+                    # worker's local history fights the averaged weights
+                    state = jax.lax.pmean(state, axis)
+                elif sync_history == "reset":
+                    state = jax.tree.map(jnp.zeros_like, state)
                 if has_dcn and avg_dcn:
                     # ...plus the cross-slice average over DCN on
                     # dcn_interval rounds
                     params = jax.lax.pmean(params, DCN_AXIS)
+                    if sync_history == "average":
+                        state = jax.lax.pmean(state, DCN_AXIS)
             # report the GLOBAL mean round loss, replicated — without this
             # the P() out-spec hands back one shard's local loss, and
             # multi-process runs would disagree on the value

@@ -93,11 +93,10 @@ class PipelineTrainer:
                  precision: Optional[str] = None) -> None:
         self.param = solver_param
         self.n_micro = int(n_micro)
-        if int(solver_param.iter_size) > 1:
-            raise NotImplementedError(
-                "PipelineTrainer does not implement iter_size accumulation"
-                " — raise n_micro (microbatching already accumulates) or"
-                " use the single-chip Solver")
+        self.iter_size = int(solver_param.iter_size)
+        if self.iter_size < 1:
+            raise ValueError(f"iter_size must be >= 1, "
+                             f"got {self.iter_size}")
         if net_param is None:
             net_param = (solver_param.net_param
                          or solver_param.train_net_param)
@@ -312,17 +311,81 @@ class PipelineTrainer:
 
     def step(self, n: int = 1) -> float:
         """n full-batch iterations, each = GPipe forward stream + VJP
-        replay + one shared-pipeline update."""
+        replay + one shared-pipeline update.  With iter_size > 1 each
+        iteration pulls iter_size batches from the source and accumulates
+        their gradients into ONE update, exactly like the single-chip
+        Solver (solver.cpp:219-224)."""
         assert self.train_source is not None, "set_train_data first"
         loss_val = 0.0
         for _ in range(n):
-            batch = {k: np.asarray(v)
-                     for k, v in self.train_source().items()}
-            loss_val = self._one_iteration(batch)
+            batches = [{k: np.asarray(v)
+                        for k, v in self.train_source().items()}
+                       for _ in range(self.iter_size)]
+            loss_val = self._one_iteration(batches)
             self.iter += 1
         return loss_val
 
-    def _one_iteration(self, batch: Dict[str, np.ndarray]) -> float:
+    def _one_iteration(self, batches: List[Dict[str, np.ndarray]]) -> float:
+        """One update from `batches` (len == iter_size): forward/backward
+        each batch, sum merged gradients across them, then clip-the-sum /
+        divide / update in the reference's Normalize order
+        (sgd_solver.cpp:102-117)."""
+        rng0 = jax.random.fold_in(self._rng, self.iter)
+        total_loss = 0.0
+        merged_acc: Dict[str, Any] = {}
+        for i, batch in enumerate(batches):
+            # sub-iteration rng mirrors the single-chip fold
+            # (solver.py step: fold_in(rng, i)); iter_size == 1 keeps the
+            # historical derivation so pinned trajectories stand
+            rng = (rng0 if len(batches) == 1
+                   else jax.random.fold_in(rng0, i))
+            merged, loss = self._fwd_bwd(batch, rng)
+            total_loss += loss
+            for k, g in merged.items():
+                merged_acc[k] = (g if k not in merged_acc
+                                 else merged_acc[k] + g)
+        iter_size = len(batches)
+        merged = merged_acc
+        if self._clip > 0 and merged:
+            # global-L2-norm clip across every stage's gradients ON THE
+            # ACCUMULATED SUM (the reference clips before Normalize,
+            # sgd_solver.cpp:81-117); square-sums accumulate device-side
+            # per home device, then ONE host sync per device
+            per_dev: Dict[int, Any] = {}
+            for k, g in merged.items():
+                s = self._key_stage[k]
+                sq = jnp.sum(jnp.square(g))
+                per_dev[s] = sq if s not in per_dev else per_dev[s] + sq
+            l2 = float(np.sqrt(sum(float(v) for v in per_dev.values())))
+            if l2 > self._clip:
+                scale = self._clip / max(l2, 1e-12)
+                merged = {k: g * scale for k, g in merged.items()}
+        if iter_size > 1:
+            merged = {k: g / iter_size for k, g in merged.items()}
+        # one update per home stage with the shared Caffe pipeline.  Stat
+        # params stay OUT of the (buffer-donating) update — they are
+        # forward-refreshed, not gradient-trained, and passing them
+        # through donation would leave dead buffers in self.params
+        for s in range(self.n_stages):
+            learn = {k: self.params[k] for k in self._home_keys[s]
+                     if k not in self._stat_keys}
+            if not learn:
+                continue
+            sub_state = {k: self.state[k] for k in learn}
+            grads = {k: merged[k] for k in learn}
+            new_p, new_s = self._update_fn(learn, sub_state, grads,
+                                           jnp.int32(self.iter))
+            for k in new_p:
+                self.params[k] = new_p[k]
+                self.state[k] = new_s[k]
+        return total_loss / iter_size
+
+    def _fwd_bwd(self, batch: Dict[str, np.ndarray], rng):
+        """GPipe forward stream + rematerializing backward for ONE batch:
+        returns (home-merged UNCLIPPED gradients of the batch-mean loss,
+        float loss).  BatchNorm running stats write back to self.params
+        (they chain across iter_size sub-iterations the way the
+        reference's sequential forwards do)."""
         M, S = self.n_micro, self.n_stages
         n = next(iter(batch.values())).shape[0]
         if n % M:
@@ -330,7 +393,6 @@ class PipelineTrainer:
                 f"batch size {n} must be divisible by n_micro={M}: unequal "
                 f"microbatches would skew the per-micro loss "
                 f"normalization away from the full-batch step")
-        rng = jax.random.fold_in(self._rng, self.iter)
         micro = [{k: v[m::M] for k, v in batch.items()} for m in range(M)]
         # every key a stage USES; shared params homed elsewhere are copied
         # to the stage's device for this iteration
@@ -393,20 +455,6 @@ class PipelineTrainer:
                     continue
                 g = jax.device_put(g, self.devices[self._key_stage[k]])
                 merged[k] = g if k not in merged else merged[k] + g
-        if self._clip > 0 and merged:
-            # global-L2-norm clip across every stage's gradients (the
-            # reference computes ONE norm over all learnable params,
-            # sgd_solver.cpp:81-100); square-sums accumulate device-side
-            # per home device, then ONE host sync per device
-            per_dev: Dict[int, Any] = {}
-            for k, g in merged.items():
-                s = self._key_stage[k]
-                sq = jnp.sum(jnp.square(g))
-                per_dev[s] = sq if s not in per_dev else per_dev[s] + sq
-            l2 = float(np.sqrt(sum(float(v) for v in per_dev.values())))
-            if l2 > self._clip:
-                scale = self._clip / max(l2, 1e-12)
-                merged = {k: g * scale for k, g in merged.items()}
         # refreshed BN running stats write straight back from each param's
         # HOME stage copy (it lives on the home device; a non-home copy of
         # a cross-stage-shared stat would strand the param elsewhere)
@@ -414,20 +462,4 @@ class PipelineTrainer:
             for k in self._home_keys[s]:
                 if k in self._stat_keys:
                     self.params[k] = stage_params[s][k]
-        # one update per home stage with the shared Caffe pipeline.  Stat
-        # params stay OUT of the (buffer-donating) update — they are
-        # forward-refreshed, not gradient-trained, and passing them
-        # through donation would leave dead buffers in self.params
-        for s in range(S):
-            learn = {k: self.params[k] for k in self._home_keys[s]
-                     if k not in self._stat_keys}
-            if not learn:
-                continue
-            sub_state = {k: self.state[k] for k in learn}
-            grads = {k: merged[k] for k in learn}
-            new_p, new_s = self._update_fn(learn, sub_state, grads,
-                                           jnp.int32(self.iter))
-            for k in new_p:
-                self.params[k] = new_p[k]
-                self.state[k] = new_s[k]
-        return total_loss
+        return merged, total_loss

@@ -141,6 +141,10 @@ class CompiledPipeline:
         self.block_fn = block_fn
         self.loss_fn = loss_fn
         self.n_micro = int(n_micro)
+        self.iter_size = int(solver_param.iter_size)
+        if self.iter_size < 1:
+            raise ValueError(f"iter_size must be >= 1, "
+                             f"got {self.iter_size}")
         self.axis = axis
         self.dp = int(dp)
         self.tp = int(tp)
@@ -188,6 +192,9 @@ class CompiledPipeline:
             if len(devs) < need:
                 raise ValueError(f"need {need} devices, have "
                                  f"{len(devs)}")
+            # an explicit over-long devices list would otherwise surface
+            # as an opaque numpy reshape error below (ADVICE r3)
+            devs = devs[:need]
             # the standard large-model mesh: replica groups over `data`
             # (outermost — cross-replica psums are the rarest), stage
             # chain over `pipe`, tensor shards over `model` (innermost —
@@ -353,21 +360,59 @@ class CompiledPipeline:
         # aren't Net params and carry no ParamSpec
         ones = {k: 1.0
                 for k in self._flatten(self.stacked, self.head)}
-        update = make_update_fn(None, self.param,
-                                lr_mults=ones, decay_mults=ones)
+        iter_size = self.iter_size
+        if iter_size == 1:
+            update = make_update_fn(None, self.param,
+                                    lr_mults=ones, decay_mults=ones)
+
+            @functools.partial(jax.jit, donate_argnums=(0, 1))
+            def step(flat, state, it, xs, ys):
+                stacked, head = self._split(flat)
+                loss, (g_stacked, g_head) = jax.value_and_grad(
+                    pipe_loss, argnums=(0, 1))(stacked, head, xs, ys)
+                grads = self._flatten(g_stacked, g_head)
+                new_p, new_s = update(flat, state, grads, it)
+                return new_p, new_s, loss
+
+            return step
+
+        # iter_size gradient accumulation in the SAME one-XLA-program
+        # round: xs/ys carry a leading [iter_size] dim, each sub-round
+        # streams its own GPipe schedule, gradients sum, then Caffe-exact
+        # normalize-after-clip and ONE update (solver.cpp:219-224,
+        # sgd_solver.cpp:102-117 — the single-chip Solver's folding)
+        clip = float(self.param.clip_gradients)
+        update = make_update_fn(None, self.param, lr_mults=ones,
+                                decay_mults=ones, clip_override=0.0)
 
         @functools.partial(jax.jit, donate_argnums=(0, 1))
-        def step(flat, state, it, xs, ys):
+        def step_acc(flat, state, it, xs, ys):
             stacked, head = self._split(flat)
-            loss, (g_stacked, g_head) = jax.value_and_grad(
-                pipe_loss, argnums=(0, 1))(stacked, head, xs, ys)
-            grads = self._flatten(g_stacked, g_head)
+            grads_sum = {k: jnp.zeros_like(v) for k, v in flat.items()}
+            loss_sum = jnp.float32(0.0)
+            for i in range(iter_size):
+                loss, (g_stacked, g_head) = jax.value_and_grad(
+                    pipe_loss, argnums=(0, 1))(stacked, head,
+                                               xs[i], ys[i])
+                g = self._flatten(g_stacked, g_head)
+                grads_sum = {k: grads_sum[k] + g[k] for k in grads_sum}
+                loss_sum = loss_sum + loss
+            grads, loss = updates.normalize_accumulated(
+                grads_sum, loss_sum, clip, iter_size)
             new_p, new_s = update(flat, state, grads, it)
             return new_p, new_s, loss
 
-        return step
+        return step_acc
 
-    def _validate_round(self, xs, ys):
+    def _validate_round(self, xs, ys, stacked: bool = False):
+        if stacked:
+            if xs.shape[0] != self.iter_size or ys.shape[0] != self.iter_size:
+                raise ValueError(
+                    f"iter_size={self.iter_size}: xs/ys need a leading "
+                    f"accumulation dim of {self.iter_size}, got "
+                    f"{xs.shape[0]}/{ys.shape[0]} "
+                    f"(full shape [iter_size, n_micro, micro_batch, ...])")
+            xs, ys = xs[0], ys[0]
         if xs.shape[0] != self.n_micro or ys.shape[0] != self.n_micro:
             raise ValueError(
                 f"xs/ys leading dims {xs.shape[0]}/{ys.shape[0]} != "
@@ -383,9 +428,12 @@ class CompiledPipeline:
 
     def step(self, xs, ys) -> float:
         """One training round: xs/ys are [M, micro_batch, ...] stacks of
-        the round's microbatches (M = n_micro)."""
+        the round's microbatches (M = n_micro).  With iter_size > 1 the
+        round accumulates gradients over stacked sub-rounds — pass
+        [iter_size, M, micro_batch, ...] and ONE update is applied
+        (solver.cpp:219-224 semantics)."""
         xs, ys = jnp.asarray(xs), jnp.asarray(ys)
-        self._validate_round(xs, ys)
+        self._validate_round(xs, ys, stacked=self.iter_size > 1)
         flat = self._flatten(self.stacked, self.head)
         new_p, new_s, loss = self._step(
             flat, self.state, jnp.int32(self.iter),

@@ -191,12 +191,10 @@ class SeqParallelTrainer:
                  precision: Optional[str] = None) -> None:
         if method not in ("ring", "ulysses"):
             raise ValueError(f"unknown method {method!r}")
-        if int(solver_param.iter_size) > 1:
-            # no gradient accumulation here; silently skipping it would
-            # diverge from the single-chip Solver's folded iter_size
-            # (solver.cpp:221-224) — reject like PipelineTrainer does
-            raise ValueError("SeqParallelTrainer does not support "
-                             "iter_size > 1")
+        self.iter_size = int(solver_param.iter_size)
+        if self.iter_size < 1:
+            raise ValueError(f"iter_size must be >= 1, "
+                             f"got {self.iter_size}")
         self.param = solver_param
         self.apply_fn = apply_fn
         self.method = method
@@ -278,38 +276,80 @@ class SeqParallelTrainer:
 
         sp_loss = self._loss
         ones = {k: 1.0 for k in self.params}
+        iter_size = self.iter_size
+        if iter_size == 1:
+            update = make_update_fn(None, self.param, lr_mults=ones,
+                                    decay_mults=ones)
+
+            @functools.partial(jax.jit, donate_argnums=(0, 1))
+            def step(params, state, it, tokens, targets):
+                loss, grads = jax.value_and_grad(sp_loss)(params, tokens,
+                                                          targets)
+                new_p, new_s = update(params, state, grads, it)
+                return new_p, new_s, loss
+
+            return step
+
+        # iter_size gradient accumulation, Caffe-exact order: sum grads
+        # over the sub-batches, clip the SUM, divide by iter_size, then
+        # regularize/update (solver.cpp:219-224 + sgd_solver.cpp:102-117
+        # Normalize — same folding as the single-chip Solver's step)
+        clip = float(self.param.clip_gradients)
         update = make_update_fn(None, self.param, lr_mults=ones,
-                                decay_mults=ones)
+                                decay_mults=ones, clip_override=0.0)
 
         @functools.partial(jax.jit, donate_argnums=(0, 1))
-        def step(params, state, it, tokens, targets):
-            loss, grads = jax.value_and_grad(sp_loss)(params, tokens,
-                                                      targets)
+        def step_acc(params, state, it, tokens, targets):
+            # tokens/targets: [iter_size, B, S]; static unroll — iter_size
+            # is small and a scan node would hit XLA:CPU's loop-body
+            # kernel cliff on the simulation mesh
+            grads_sum = {k: jnp.zeros_like(v) for k, v in params.items()}
+            loss_sum = jnp.float32(0.0)
+            for i in range(iter_size):
+                loss, grads = jax.value_and_grad(sp_loss)(
+                    params, tokens[i], targets[i])
+                grads_sum = {k: grads_sum[k] + grads[k]
+                             for k in grads_sum}
+                loss_sum = loss_sum + loss
+            grads, loss = updates.normalize_accumulated(
+                grads_sum, loss_sum, clip, iter_size)
             new_p, new_s = update(params, state, grads, it)
             return new_p, new_s, loss
 
-        return step
+        return step_acc
 
-    def _validate(self, tokens, targets):
-        if tokens.shape != targets.shape or tokens.ndim != 2:
+    def _validate(self, tokens, targets, stacked: bool = False):
+        want = 3 if stacked else 2
+        if tokens.shape != targets.shape or tokens.ndim != want:
+            shape = (f"(iter_size={self.iter_size}, B, S)" if stacked
+                     else "(B, S)")
             raise ValueError(
-                f"tokens/targets must both be (B, S); got "
+                f"tokens/targets must both be {shape}; got "
                 f"{tokens.shape} / {targets.shape}")
-        if tokens.shape[1] % self.n_shards:
+        if stacked and tokens.shape[0] != self.iter_size:
             raise ValueError(
-                f"sequence length {tokens.shape[1]} does not divide over "
+                f"leading accumulation dim {tokens.shape[0]} != "
+                f"iter_size {self.iter_size}")
+        b, s = tokens.shape[-2], tokens.shape[-1]
+        if s % self.n_shards:
+            raise ValueError(
+                f"sequence length {s} does not divide over "
                 f"{self.n_shards} sequence shards")
-        if self.dp > 1 and tokens.shape[0] % self.dp:
+        if self.dp > 1 and b % self.dp:
             raise ValueError(
-                f"batch {tokens.shape[0]} does not divide over "
+                f"batch {b} does not divide over "
                 f"dp={self.dp} data replicas")
 
     def step(self, tokens, targets) -> float:
         """One update on a (B, S) token batch with (B, S) next-token
-        targets; S shards over the mesh's `seq` axis."""
+        targets; S shards over the mesh's `seq` axis.  With iter_size > 1
+        the solver accumulates gradients over stacked sub-batches: pass
+        (iter_size, B, S) and ONE update is applied (solver.cpp:219-224
+        semantics, same shape contract as the single-chip Solver's
+        stacked pulls)."""
         tokens = jnp.asarray(tokens, jnp.int32)
         targets = jnp.asarray(targets, jnp.int32)
-        self._validate(tokens, targets)
+        self._validate(tokens, targets, stacked=self.iter_size > 1)
         self.params, self.state, loss = self._step(
             self.params, self.state, jnp.int32(self.iter), tokens,
             targets)

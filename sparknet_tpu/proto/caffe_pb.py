@@ -678,10 +678,12 @@ def load_solver_prototxt_with_net(solver_path: str, net: NetParameter,
 
 def replace_data_layers(net: NetParameter, train_batch_size: int,
                         test_batch_size: int, channels: int, height: int,
-                        width: int) -> NetParameter:
+                        width: int, tops=("data", "label")) -> NetParameter:
     """Swap the first two (data) layers for train+test in-memory feed layers
     with the given batch/shape (reference: ProtoLoader.scala:50-57,
-    Layers.scala:18-40 `RDDLayer`)."""
+    Layers.scala:18-40 `RDDLayer`).  `tops` overrides the fed blob names
+    for nets whose data layer feeds differently-named tops (the bundled
+    siamese workflow's pair_data/sim, mnist_siamese_train_test.prototxt)."""
     out = NetParameter(net.msg.copy())
     layers = out.msg.getlist("layer")
     # Drop every leading data-source layer (the reference drops exactly the
@@ -693,10 +695,11 @@ def replace_data_layers(net: NetParameter, train_batch_size: int,
             LayerParameter(layers[n_data]).type) in data_types:
         n_data += 1
     rest = layers[max(n_data, 1):]
+    top_lines = "\n".join(f'top: "{t}"' for t in tops)
 
     def make(phase: str, batch: int) -> Message:
         m = parse(
-            'name: "data" type: "MemoryData" top: "data" top: "label"\n'
+            f'name: "data" type: "MemoryData"\n{top_lines}\n'
             f'include {{ phase: {phase} }}\n'
             f'memory_data_param {{ batch_size: {batch} channels: {channels} '
             f'height: {height} width: {width} }}\n'

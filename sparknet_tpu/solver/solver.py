@@ -293,8 +293,8 @@ class Solver:
             if not isinstance(stats, dict):
                 stats = {}
             grads_sum, loss_sum = acc
-            grads = updates.clip_gradients(grads_sum, clip)
-            grads = {k: g / iter_size for k, g in grads.items()}
+            grads, loss_avg = updates.normalize_accumulated(
+                grads_sum, loss_sum, clip, iter_size)
             grads = updates.regularize(params, grads, weight_decay,
                                        decay_mults, reg_type)
             rate = learning_rate(sp, it)
@@ -305,7 +305,7 @@ class Solver:
             # gradient-trained (lr_mult 0; net.cpp param contract)
             for k, v in stats.items():
                 new_p[k] = v
-            return new_p, new_s, loss_sum / iter_size
+            return new_p, new_s, loss_avg
 
         # stats flow breaks lax.scan when non-empty (dict carry shape);
         # fall back to a Python-unrolled accumulation in that case.
@@ -322,8 +322,8 @@ class Solver:
                     grads_sum = {k: grads_sum[k] + grads[k]
                                  for k in grads_sum}
                     loss_sum = loss_sum + loss
-                grads = updates.clip_gradients(grads_sum, clip)
-                grads = {k: g / iter_size for k, g in grads.items()}
+                grads, loss_avg = updates.normalize_accumulated(
+                    grads_sum, loss_sum, clip, iter_size)
                 grads = updates.regularize(params, grads, weight_decay,
                                            decay_mults, reg_type)
                 rate = learning_rate(sp, it)
@@ -332,7 +332,7 @@ class Solver:
                     lr_mults=lr_mults, **hyper)
                 for k, v in stats.items():
                     new_p[k] = v
-                return new_p, new_s, loss_sum / iter_size
+                return new_p, new_s, loss_avg
             return step_unrolled
         return step
 

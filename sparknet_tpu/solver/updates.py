@@ -31,6 +31,20 @@ def init_state(params: Params, solver_type: str) -> State:
             for k, v in params.items()}
 
 
+def normalize_accumulated(grads_sum: Grads, loss_sum, clip: float,
+                          iter_size: int):
+    """Fold an iter_size gradient accumulation the reference's way: clip
+    the SUM by global L2 norm, THEN divide grads and loss by iter_size
+    (Solver::Step sums diffs solver.cpp:219-224; ApplyUpdate clips before
+    Normalize, sgd_solver.cpp:102-117).  Every accumulating trainer
+    (single-chip Solver, CompiledPipeline, SeqParallelTrainer) folds
+    through here so the ordering is defined once."""
+    grads = clip_gradients(grads_sum, clip)
+    if iter_size != 1:
+        grads = {k: g / iter_size for k, g in grads.items()}
+    return grads, loss_sum / iter_size
+
+
 def clip_gradients(grads: Grads, clip: float) -> Grads:
     """Global-L2-norm clipping (reference: sgd_solver.cpp:81-100)."""
     if clip <= 0:

@@ -94,6 +94,71 @@ def test_average_mode_matches_reference_algorithm(n_workers, tau):
             np.testing.assert_allclose(a, b, rtol=2e-4, atol=1e-5)
 
 
+@pytest.mark.parametrize("n_workers,tau,sync_history",
+                         [(4, 3, "average"), (2, 1, "average"),
+                          (2, 2, "reset")])
+def test_sync_history_matches_reference_variant(n_workers, tau,
+                                                sync_history):
+    """sync_history="average"/"reset" == N solo solvers + explicit weight
+    averaging + the same explicit treatment of each solver's momentum
+    history (the literal algorithm of the variant — history semantics
+    from sgd_solver.cpp:207-240, one history blob per param)."""
+    mesh = make_mesh(n_workers)
+    ds = DistributedSolver(make_solver_param(SP_TEXT), net_param=toy_net(),
+                           n_workers=n_workers, tau=tau, mesh=mesh,
+                           sync_history=sync_history)
+    ds.set_train_data([fixed_stream(100 + w) for w in range(n_workers)])
+
+    solos = []
+    for w in range(n_workers):
+        s = Solver(make_solver_param(SP_TEXT), net_param=toy_net())
+        s.set_train_data(fixed_stream(100 + w))
+        solos.append(s)
+
+    for _ in range(3):
+        ds.run_round()
+        for s in solos:
+            s.step(tau)
+        avg = {k: np.mean([np.asarray(s.params[k]) for s in solos], axis=0)
+               for k in solos[0].params}
+        if sync_history == "average":
+            savg = {k: tuple(
+                np.mean([np.asarray(s.state[k][j]) for s in solos], axis=0)
+                for j in range(len(solos[0].state[k])))
+                for k in solos[0].state}
+        else:
+            savg = {k: tuple(np.zeros_like(np.asarray(h)) for h in hs)
+                    for k, hs in solos[0].state.items()}
+        for s in solos:
+            s.params = {k: jax.numpy.asarray(v) for k, v in avg.items()}
+            s.state = {k: tuple(jax.numpy.asarray(h) for h in hs)
+                       for k, hs in savg.items()}
+
+    dw = ds.get_weights()
+    sw = solos[0].get_weights()
+    for layer in sw:
+        for a, b in zip(dw[layer], sw[layer]):
+            np.testing.assert_allclose(a, b, rtol=2e-4, atol=1e-5)
+    # the distributed per-worker momentum must equal the solo history too
+    st = {k: tuple(np.asarray(h[0]) for h in hs)
+          for k, hs in ds.state_w.items()}
+    for k, hs in solos[0].state.items():
+        for a, b in zip(st[k], hs):
+            np.testing.assert_allclose(a, np.asarray(b),
+                                       rtol=2e-4, atol=1e-5)
+
+
+def test_sync_history_rejects_sync_mode_and_bad_value():
+    with pytest.raises(ValueError, match="sync_history"):
+        DistributedSolver(make_solver_param(SP_TEXT), net_param=toy_net(),
+                          n_workers=2, mesh=make_mesh(2),
+                          sync_history="bogus")
+    with pytest.raises(ValueError, match="mode='average'"):
+        DistributedSolver(make_solver_param(SP_TEXT), net_param=toy_net(),
+                          n_workers=2, mesh=make_mesh(2), mode="sync",
+                          sync_history="average")
+
+
 def test_sync_mode_matches_big_batch():
     """Per-step gradient pmean over W workers each with batch B ==
     single solver with batch W*B (the P2PSync-subsumption claim)."""

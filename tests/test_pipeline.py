@@ -82,6 +82,34 @@ def test_pipeline_matches_single_device(n_stages, n_micro):
                                    rtol=2e-4, atol=1e-6, err_msg=k)
 
 
+def test_pipeline_iter_size_matches_big_batch():
+    """iter_size=2 accumulation over two 8-row batches == one 16-row
+    batch through the single-chip Solver (solver.cpp:219-224: summed
+    grads, clip-the-sum, normalize by iter_size) — trajectory-exact."""
+    batches = _stream(n=4, seed=21)
+    sp_acc = _sp()
+    sp_acc.msg.set("iter_size", 2)
+    tr = PipelineTrainer(sp_acc, n_stages=2, n_micro=2)
+    it = iter(batches)
+    tr.set_train_data(lambda: next(it))
+
+    solo = Solver(_sp(), batch_override=16)
+    pairs = [{k: np.concatenate([batches[2 * i][k], batches[2 * i + 1][k]])
+              for k in batches[0]} for i in range(2)]
+    pit = iter(pairs)
+    solo.set_train_data(lambda: next(pit))
+
+    for _ in range(2):
+        lp = tr.step(1)
+        ls = solo.step(1)
+        np.testing.assert_allclose(lp, ls, rtol=2e-4, atol=1e-5)
+    assert tr.iter == solo.iter == 2
+    for k in solo.params:
+        np.testing.assert_allclose(np.asarray(tr.params[k]),
+                                   np.asarray(solo.params[k]),
+                                   rtol=2e-4, atol=1e-5)
+
+
 def test_pipeline_params_live_on_stage_devices():
     pt = PipelineTrainer(_sp(), n_stages=4, n_micro=2)
     devs = {pt.stage_of(k): list(pt.params[k].devices())[0]

@@ -119,6 +119,52 @@ def test_training_trajectory_matches_single_device_step():
                                    rtol=3e-5, atol=1e-6)
 
 
+def test_iter_size_matches_big_batch():
+    """iter_size=2 accumulation over two sub-rounds == one round whose
+    microbatches are the rowwise concat of the sub-rounds' (per-micro
+    mean losses make the normalized summed gradient equal the big-batch
+    gradient; solver.cpp:219-224)."""
+    _need_devices(S)
+    stacked, head, _, _ = _init(0)
+    rng = np.random.RandomState(31)
+    xs = rng.randn(2, M, MB, F).astype(np.float32)
+    ys = rng.randint(0, C, (2, M, MB)).astype(np.int32)
+
+    sp_acc = _solver_param()
+    sp_acc.msg.set("iter_size", 2)
+    acc = CompiledPipeline(sp_acc, block_fn=block_fn, loss_fn=loss_fn,
+                           stacked_params=stacked, head_params=head,
+                           n_micro=M)
+    big = CompiledPipeline(_solver_param(), block_fn=block_fn,
+                           loss_fn=loss_fn, stacked_params=stacked,
+                           head_params=head, n_micro=M)
+    for _ in range(3):
+        la = acc.step(xs, ys)
+        lb = big.step(np.concatenate([xs[0], xs[1]], axis=1),
+                      np.concatenate([ys[0], ys[1]], axis=1))
+        np.testing.assert_allclose(la, lb, rtol=2e-5, atol=1e-6)
+    for k in acc.stacked:
+        np.testing.assert_allclose(np.asarray(acc.stacked[k]),
+                                   np.asarray(big.stacked[k]),
+                                   rtol=3e-5, atol=1e-6)
+    for k in acc.head:
+        np.testing.assert_allclose(np.asarray(acc.head[k]),
+                                   np.asarray(big.head[k]),
+                                   rtol=3e-5, atol=1e-6)
+
+
+def test_iter_size_round_shape_validated():
+    _need_devices(S)
+    stacked, head, xs, ys = _init(0)
+    sp_acc = _solver_param()
+    sp_acc.msg.set("iter_size", 2)
+    acc = CompiledPipeline(sp_acc, block_fn=block_fn, loss_fn=loss_fn,
+                           stacked_params=stacked, head_params=head,
+                           n_micro=M)
+    with pytest.raises(ValueError, match="iter_size"):
+        acc.step(xs, ys)  # missing the leading accumulation dim
+
+
 def test_global_norm_clip_spans_stages_and_head():
     """clip_gradients must use ONE norm across every stage's and the
     head's gradients (sgd_solver.cpp:81-100), not per-shard norms."""
@@ -139,6 +185,19 @@ def test_global_norm_clip_spans_stages_and_head():
     sq += sum(float(np.sum((np.asarray(v) - h0[k]) ** 2))
               for k, v in pipe.head.items())
     np.testing.assert_allclose(np.sqrt(sq), 1e-3, rtol=1e-4)
+
+
+def test_overlong_device_list_sliced_not_reshape_error():
+    """An explicit devices list longer than n_stages*dp*tp is sliced to
+    the needed prefix (ADVICE r3: it used to die in an opaque numpy
+    reshape instead of behaving like seq_parallel's devs[:need])."""
+    _need_devices(S + 1)
+    stacked, head, xs, ys = _init(0)
+    cp = CompiledPipeline(_solver_param(), block_fn=block_fn,
+                          loss_fn=loss_fn, stacked_params=stacked,
+                          head_params=head, n_micro=M,
+                          devices=jax.devices()[:S + 1])
+    assert np.isfinite(cp.step(xs, ys))
 
 
 def test_rejects_mismatched_stage_dims():

@@ -170,9 +170,40 @@ def test_attn_block_divisibility_and_iter_size_rejected():
     sp = _solver_param()
     sp.msg.set("iter_size", 4)
     init, apply_fn = tiny_transformer(1, V, D, HEADS, max_seq=S)
+    tr = SeqParallelTrainer(sp, apply_fn=apply_fn, params=init(0),
+                            n_devices=8)
+    rng = np.random.RandomState(3)
     with pytest.raises(ValueError, match="iter_size"):
-        SeqParallelTrainer(sp, apply_fn=apply_fn, params=init(0),
-                           n_devices=8)
+        tr.step(*_data(rng))  # un-stacked batch with iter_size=4
+
+
+def test_sp_iter_size_matches_big_batch():
+    """iter_size=2 accumulation over two B-row sub-batches trains
+    identically to one 2B-row batch (solver.cpp:219-224: the summed,
+    normalized gradient equals the big-batch mean gradient when the loss
+    is a per-example mean)."""
+    _need_devices(8)
+    init, apply_fn = tiny_transformer(LAYERS, V, D, HEADS, max_seq=S)
+    params0 = init(0)
+    sp_acc = _solver_param()
+    sp_acc.msg.set("iter_size", 2)
+    acc = SeqParallelTrainer(sp_acc, apply_fn=apply_fn, params=params0,
+                             n_devices=8)
+    big = SeqParallelTrainer(_solver_param(), apply_fn=apply_fn,
+                             params=params0, n_devices=8)
+
+    rng = np.random.RandomState(9)
+    for _ in range(3):
+        t1, g1 = _data(rng)
+        t2, g2 = _data(rng)
+        la = acc.step(np.stack([t1, t2]), np.stack([g1, g2]))
+        lb = big.step(np.concatenate([t1, t2]), np.concatenate([g1, g2]))
+        np.testing.assert_allclose(la, lb, rtol=2e-5)
+    assert acc.iter == big.iter == 3
+    for k in acc.params:
+        np.testing.assert_allclose(np.asarray(acc.params[k]),
+                                   np.asarray(big.params[k]),
+                                   rtol=3e-5, atol=1e-6)
 
 
 def test_dp_sp_hybrid_matches_dense_trajectory():

@@ -1,0 +1,116 @@
+"""The bundled siamese workflow end to end: two weight-sharing towers +
+ContrastiveLoss, imported from the reference's own prototxt
+(reference: caffe/examples/siamese/mnist_siamese_train_test.prototxt —
+shared `param { name: "conv1_w" ... }` specs across the conv1/conv1_p
+towers; loss contrastive_loss_layer.cpp:28-59; workflow
+examples/siamese/readme.md).  This was the one bundled reference
+workflow never exercised end to end (VERDICT r3 item 7)."""
+
+import os
+
+import numpy as np
+import pytest
+
+from sparknet_tpu.proto import caffe_pb
+from sparknet_tpu.solver.solver import Solver
+from tests.conftest import reference_path
+
+PROTO = "caffe/examples/siamese/mnist_siamese_train_test.prototxt"
+BATCH = 16
+
+
+def _load_net():
+    path = reference_path(PROTO)
+    if not os.path.exists(path):
+        pytest.skip(f"{PROTO} not in reference checkout")
+    net = caffe_pb.load_net_prototxt(path)
+    # the reference feeds LMDB pair data (2-channel stacked digit pairs,
+    # tops pair_data/sim); swap for an in-memory feed of the same shape
+    return caffe_pb.replace_data_layers(net, BATCH, BATCH, 2, 28, 28,
+                                        tops=("pair_data", "sim"))
+
+
+def _solver_param():
+    from sparknet_tpu.proto.textformat import parse
+
+    return caffe_pb.SolverParameter(parse(
+        "base_lr: 0.01 lr_policy: 'fixed' momentum: 0.9 "
+        "weight_decay: 0.0 random_seed: 7"))
+
+
+def _pair_source(seed=0):
+    """Synthetic pair stream: two fixed 28x28 prototypes + noise; sim=1
+    pairs draw both channels from the SAME prototype, sim=0 from
+    different ones — learnable by pulling same-prototype embeddings
+    together (margin semantics, contrastive_loss_layer.cpp:28-59)."""
+    rng = np.random.RandomState(seed)
+    protos = rng.rand(2, 28, 28).astype(np.float32)
+
+    def source():
+        a = rng.randint(0, 2, size=BATCH)
+        sim = rng.randint(0, 2, size=BATCH)
+        b = np.where(sim == 1, a, 1 - a)
+        x = np.stack([protos[a], protos[b]], axis=1)  # (B, 2, 28, 28)
+        x = x + 0.1 * rng.randn(BATCH, 2, 28, 28).astype(np.float32)
+        return {"pair_data": x.astype(np.float32),
+                "sim": sim.astype(np.int32)}
+
+    return source
+
+
+def test_siamese_towers_share_parameters():
+    """Caffe param-name sharing (net.cpp AppendParam): conv1 and conv1_p
+    must resolve to the SAME underlying parameters."""
+    from sparknet_tpu.core.net import Net
+
+    net = Net(_load_net(), "TRAIN")
+    by_name = {str(bl.name): bl for bl in net.layers}
+    for a, b in [("conv1", "conv1_p"), ("conv2", "conv2_p"),
+                 ("ip1", "ip1_p"), ("ip2", "ip2_p"), ("feat", "feat_p")]:
+        assert by_name[a].param_keys == by_name[b].param_keys, (a, b)
+    # one storage slot per shared pair: the params dict holds exactly the
+    # primary tower's keys
+    params = net.init_params(seed=0)
+    assert len([k for k in params if k.startswith("conv1")]) == 2
+
+
+def test_siamese_trains_and_stays_shared():
+    """Training the imported two-tower net decreases the contrastive
+    loss, and both towers' weights remain bit-identical throughout."""
+    solver = Solver(_solver_param(), net_param=_load_net())
+    solver.set_train_data(_pair_source())
+
+    first = solver.step(1)
+    for _ in range(60):
+        last = solver.step(1)
+    assert np.isfinite(last)
+    assert last < first * 0.5, (first, last)
+
+    w = solver.get_weights()
+    for a, b in [("conv1", "conv1_p"), ("conv2", "conv2_p"),
+                 ("ip1", "ip1_p"), ("ip2", "ip2_p"), ("feat", "feat_p")]:
+        assert len(w[a]) == len(w[b]) == 2
+        for wa, wb in zip(w[a], w[b]):
+            # bit-identical, not merely close: one shared storage slot
+            np.testing.assert_array_equal(np.asarray(wa), np.asarray(wb))
+
+
+def test_siamese_embeddings_separate_classes():
+    """After training, same-prototype pairs embed closer than
+    cross-prototype pairs (the property the workflow exists to teach)."""
+    import jax.numpy as jnp
+
+    solver = Solver(_solver_param(), net_param=_load_net())
+    src = _pair_source(seed=3)
+    solver.set_train_data(src)
+    solver.step(80)
+
+    batch = src()
+    blobs, _ = solver.net.apply(
+        solver.params,
+        {k: jnp.asarray(v) for k, v in batch.items()}, train=False)
+    d = np.linalg.norm(np.asarray(blobs["feat"])
+                       - np.asarray(blobs["feat_p"]), axis=1)
+    sim = batch["sim"]
+    assert d[sim == 1].mean() < d[sim == 0].mean() * 0.5, (
+        d[sim == 1].mean(), d[sim == 0].mean())
