@@ -23,7 +23,8 @@ import argparse
 import shlex
 import subprocess
 import sys
-from typing import List, Optional
+import time
+from typing import Callable, List, Optional, Tuple
 
 # Commands run on every host after creation (the analogue of the AMI
 # setup + deploy rsync in spark_ec2.py setup_cluster).
@@ -99,11 +100,140 @@ class TpuCluster:
         return [self._base("describe") +
                 ["--format=value(networkEndpoints[].ipAddress)"]]
 
+    def describe_state(self) -> List[str]:
+        """argv printing just the slice state (the is_cluster_ssh_available
+        / instance-state poll target, spark_ec2.py:774-868)."""
+        return self._base("describe") + ["--format=value(state)"]
+
     def stop(self) -> List[List[str]]:
         return [self._base("stop")]
 
     def start(self) -> List[List[str]]:
         return [self._base("start"), self.setup()]
+
+
+class TpuClusterError(RuntimeError):
+    """A lifecycle step failed (launch/poll/setup); the message says
+    which step and how to resume — the role of spark_ec2.py's sys.exit
+    paths plus its --resume affordance (spark_ec2.py:1256-1349)."""
+
+
+# states from the TPU API; FAILED-class states end the wait immediately
+# instead of burning the whole timeout
+_BAD_STATES = {"PREEMPTED", "TERMINATED", "FAILED", "SUSPENDED"}
+
+Runner = Callable[[List[str]], Tuple[int, str]]
+
+
+def run_capture(cmd: List[str]) -> Tuple[int, str]:
+    """Default runner: prints the argv line (operator visibility, like
+    _execute), captures stdout for verbs whose output the flow parses
+    (describe state polls), and STREAMS everything else — the per-host
+    setup takes minutes and silence would look like a hang.  Tests
+    inject fakes; --dry-run never calls it."""
+    print(" ".join(shlex.quote(c) for c in cmd), flush=True)
+    if len(cmd) > 4 and cmd[4] == "describe":
+        r = subprocess.run(cmd, capture_output=True, text=True)
+        if r.returncode != 0 and r.stderr:
+            sys.stderr.write(r.stderr[-2000:])
+        return r.returncode, r.stdout.strip()
+    return subprocess.call(cmd), ""
+
+
+# tolerate this many CONSECUTIVE describe failures before concluding
+# anything: one gcloud 503 mid-poll must not abort a 15-minute wait on a
+# billable resource
+_DESCRIBE_RETRIES = 3
+
+
+def _describe_retrying(cluster: TpuCluster, runner: Runner,
+                       sleep: Callable[[float], None],
+                       poll_s: float) -> Tuple[int, str]:
+    rc, out = runner(cluster.describe_state())
+    for _ in range(_DESCRIBE_RETRIES - 1):
+        if rc == 0:
+            return rc, out
+        sleep(poll_s)
+        rc, out = runner(cluster.describe_state())
+    return rc, out
+
+
+def wait_for_state(cluster: TpuCluster, target: str, *,
+                   runner: Runner = run_capture, timeout_s: float = 900,
+                   poll_s: float = 15,
+                   sleep: Callable[[float], None] = time.sleep) -> str:
+    """Poll `describe` until the slice reaches `target` (usually READY)
+    — the wait_for_cluster_state loop (spark_ec2.py:774-868).  Raises
+    TpuClusterError on a FAILED-class state, on persistent describe
+    errors, or on timeout, naming the last observed state so the
+    operator can resume with `launch --resume`."""
+    deadline = time.monotonic() + timeout_s
+    state = "UNKNOWN"
+    while True:
+        rc, out = _describe_retrying(cluster, runner, sleep, poll_s)
+        if rc != 0:
+            raise TpuClusterError(
+                f"describe {cluster.name} kept failing (rc={rc}, "
+                f"{_DESCRIBE_RETRIES} attempts) while waiting for "
+                f"{target}; check gcloud auth/network, then re-run "
+                f"`launch --resume` — it will keep waiting without "
+                f"re-creating")
+        state = out.splitlines()[0].strip() if out else "UNKNOWN"
+        if state == target:
+            return state
+        if state in _BAD_STATES:
+            raise TpuClusterError(
+                f"{cluster.name} entered {state} while waiting for "
+                f"{target}; destroy and relaunch (spot slices can be "
+                f"preempted mid-create)")
+        if time.monotonic() >= deadline:
+            raise TpuClusterError(
+                f"timed out after {timeout_s:g}s waiting for "
+                f"{cluster.name} to reach {target} (last state: {state}); "
+                f"re-run with `launch --resume` to keep waiting without "
+                f"re-creating")
+        sleep(poll_s)
+
+
+def launch_flow(cluster: TpuCluster, *, runner: Runner = run_capture,
+                resume: bool = False, timeout_s: float = 900,
+                poll_s: float = 15,
+                sleep: Callable[[float], None] = time.sleep) -> None:
+    """Create -> poll-until-READY -> per-host setup, resumable at every
+    step (the reference's launch_cluster + --resume semantics,
+    spark_ec2.py:1256-1349): with resume=True an existing slice skips
+    create, a mid-CREATING slice is just waited on, and a setup failure
+    leaves the (billable) slice up with explicit resume instructions
+    rather than silently reporting success."""
+    exists = False
+    if resume:
+        # retried: a transient describe blip must not trigger a spurious
+        # create against an existing slice (gcloud reports NOT_FOUND and
+        # transient errors alike as rc!=0, so persistent failure falls
+        # through to create — whose error message covers both cases)
+        rc, out = _describe_retrying(cluster, runner, sleep, poll_s)
+        exists = rc == 0 and bool(out.strip())
+    if not exists:
+        create = cluster.launch()[0]
+        rc, _ = runner(create)
+        if rc != 0:
+            hint = ("describe could not confirm the slice before create; "
+                    "if it already exists, wait for gcloud to be "
+                    "reachable and re-run `launch --resume`, or destroy "
+                    "it first" if resume else
+                    "if the slice partially exists, re-run with "
+                    "--resume (or destroy it first)")
+            raise TpuClusterError(
+                f"create {cluster.name} failed (rc={rc}); {hint}")
+    wait_for_state(cluster, "READY", runner=runner, timeout_s=timeout_s,
+                   poll_s=poll_s, sleep=sleep)
+    rc, _ = runner(cluster.setup())
+    if rc != 0:
+        raise TpuClusterError(
+            f"slice {cluster.name} is READY but per-host setup failed "
+            f"(rc={rc}); it is still running (and billing) — re-run "
+            f"`launch --resume` to retry setup, or `destroy` to tear it "
+            f"down")
 
 
 def _execute(cmds: List[List[str]], dry_run: bool) -> int:
@@ -134,6 +264,12 @@ def main(argv=None) -> int:
                    help="worker index; default 0 for login, all for run")
     p.add_argument("--command", help="shell command for `run`")
     p.add_argument("--local-dir", default=".", help="source dir for `deploy`")
+    p.add_argument("--resume", action="store_true",
+                   help="launch: don't re-create an existing slice; wait "
+                        "for READY and retry setup (spark_ec2.py --resume)")
+    p.add_argument("--wait-timeout", type=float, default=900,
+                   help="seconds to poll for READY after create/start")
+    p.add_argument("--poll-interval", type=float, default=15)
     p.add_argument("--dry-run", action="store_true")
     args = p.parse_args(argv)
 
@@ -142,7 +278,18 @@ def main(argv=None) -> int:
                          runtime_version=args.runtime_version,
                          project=args.project, spot=args.spot)
     if args.action == "launch":
-        cmds = cluster.launch()
+        if args.dry_run:
+            cmds = cluster.launch()
+        else:
+            try:
+                launch_flow(cluster, resume=args.resume,
+                            timeout_s=args.wait_timeout,
+                            poll_s=args.poll_interval)
+            except TpuClusterError as e:
+                print(f"launch failed: {e}", file=sys.stderr)
+                return 1
+            print(f"{args.name} READY and set up")
+            return 0
     elif args.action == "destroy":
         cmds = cluster.destroy()
     elif args.action == "login":
@@ -157,7 +304,22 @@ def main(argv=None) -> int:
     elif args.action == "stop":
         cmds = cluster.stop()
     elif args.action == "start":
-        cmds = cluster.start()
+        if args.dry_run:
+            cmds = cluster.start()
+        else:
+            # start, then poll READY before the per-host setup — a
+            # just-started slice rejects ssh until it reaches READY
+            rc = _execute([cluster.start()[0]], False)
+            if rc != 0:
+                return rc
+            try:
+                wait_for_state(cluster, "READY",
+                               timeout_s=args.wait_timeout,
+                               poll_s=args.poll_interval)
+            except TpuClusterError as e:
+                print(f"start failed: {e}", file=sys.stderr)
+                return 1
+            cmds = [cluster.setup()]
     else:  # deploy
         cmds = [cluster.deploy(args.local_dir)]
     return _execute(cmds, args.dry_run)

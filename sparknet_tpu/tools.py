@@ -261,17 +261,32 @@ def cmd_detect(args) -> int:
     return 0
 
 
-def cmd_parse_log(args) -> int:
-    """Parse a training log into train/test CSV tables (reference:
-    tools/extra/parse_log.py writes <log>.train / <log>.test with
-    NumIters,Seconds,… columns).  Understands both log formats this
-    framework emits: the CLI's "Iteration N, loss = X" lines and the apps'
-    PhaseLogger lines "<elapsed>: iteration N: round loss = X" /
-    "… %-age of test set correct: X" (CifarApp.scala:36-46 format)."""
-    import csv
+def _parse_log_rows(logfile: str):
+    """Shared log scanner for parse_log/plot_log: returns
+    (train_rows, test_rows) of (iter, seconds, value).  Understands both
+    log formats this framework emits: the CLI's "Iteration N, loss = X"
+    lines and the apps' PhaseLogger lines "<elapsed>: iteration N: round
+    loss = X" / "… %-age of test set correct: X"
+    (CifarApp.scala:36-46 format)."""
     import re
 
-    text = open(args.logfile).read().splitlines()
+    try:
+        text = open(logfile).read().splitlines()
+    except UnicodeDecodeError as e:
+        # same file-naming ValueError contract as every parser here
+        raise ValueError(f"{logfile}: not a text log ({e})") from None
+
+    def num(tok, lineno, line):
+        # the permissive token patterns can match non-numbers ('eee');
+        # convert under the parser contract instead of leaking a bare
+        # could-not-convert ValueError with no filename
+        try:
+            return float(tok)
+        except ValueError:
+            raise ValueError(
+                f"{logfile}:{lineno}: unparsable number {tok!r} in "
+                f"log line {line!r}") from None
+
     pl = re.compile(r"^(?P<sec>\d+(?:\.\d+)?): (?:iteration (?P<it>\d+): )?"
                     r"(?P<msg>.*)$")
     cli_train = re.compile(r"^Iteration (?P<it>\d+), loss = "
@@ -280,29 +295,40 @@ def cmd_parse_log(args) -> int:
     test_rows = []
     last_it = 0
     last_sec = 0.0
-    for line in text:
+    for lineno, line in enumerate(text, 1):
         m = cli_train.match(line)
         if m:
             # numeric columns throughout (loadtxt-compatible, like the
             # reference parse_log.py): CLI lines carry no elapsed time,
             # reuse the last seen
             last_it = int(m["it"])
-            train_rows.append((last_it, last_sec, float(m["loss"])))
+            train_rows.append((last_it, last_sec,
+                               num(m["loss"], lineno, line)))
             continue
         m = pl.match(line)
         if not m:
             continue
-        sec = last_sec = float(m["sec"])
+        sec = last_sec = num(m["sec"], lineno, line)
         it = last_it = int(m["it"]) if m["it"] else last_it
         msg = m["msg"]
         lm = re.match(r"round loss = ([-+.\deE]+)", msg)
         if lm:
-            train_rows.append((it, sec, float(lm.group(1))))
+            train_rows.append((it, sec, num(lm.group(1), lineno, line)))
             continue
         am = re.match(r"(?:final )?%-age of test set correct: "
                       r"([-+.\deE]+)", msg)
         if am:
-            test_rows.append((it, sec, float(am.group(1))))
+            test_rows.append((it, sec, num(am.group(1), lineno, line)))
+    return train_rows, test_rows
+
+
+def cmd_parse_log(args) -> int:
+    """Parse a training log into train/test CSV tables (reference:
+    tools/extra/parse_log.py writes <log>.train / <log>.test with
+    NumIters,Seconds,… columns)."""
+    import csv
+
+    train_rows, test_rows = _parse_log_rows(args.logfile)
     base = args.output_dir.rstrip("/") + "/" + \
         args.logfile.rsplit("/", 1)[-1]
     for suffix, rows, cols in ((".train", train_rows,
@@ -315,6 +341,87 @@ def cmd_parse_log(args) -> int:
             w.writerows(rows)
     print(f"Wrote {base}.train ({len(train_rows)} rows) and "
           f"{base}.test ({len(test_rows)} rows)")
+    return 0
+
+
+# chart types, numbered exactly like the reference's
+# plot_training_log.py.example:15-24 so migration keeps muscle memory;
+# the types whose data this framework's logs don't record raise a named
+# error instead of plotting an empty chart
+_PLOT_TYPES = {
+    0: ("Test accuracy", "Iters", "test", 0),
+    1: ("Test accuracy", "Seconds", "test", 1),
+    6: ("Train loss", "Iters", "train", 0),
+    7: ("Train loss", "Seconds", "train", 1),
+}
+_PLOT_UNSUPPORTED = {
+    2: "test loss", 3: "test loss",
+    4: "train learning rate", 5: "train learning rate",
+}
+# fixed-order categorical series colors (Okabe-Ito, CVD-validated);
+# never cycled or generated — one per log file in argv order
+_SERIES_COLORS = ["#0072B2", "#E69F00", "#009E73", "#CC79A7",
+                  "#56B4E9", "#D55E00", "#F0E442"]
+
+
+def cmd_plot_log(args) -> int:
+    """Chart a parsed metric over iterations/seconds, one line per log
+    file (reference: tools/extra/plot_training_log.py.example — same
+    chart-type numbering, same one-metric-per-chart shape)."""
+    try:
+        import matplotlib
+    except ImportError:
+        raise SystemExit(
+            "plot_log needs matplotlib (optional dependency — "
+            "`pip install matplotlib`); parse_log still works without "
+            "it and its CSVs load into any plotting tool")
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+
+    if args.chart_type in _PLOT_UNSUPPORTED:
+        raise SystemExit(
+            f"chart type {args.chart_type} plots "
+            f"{_PLOT_UNSUPPORTED[args.chart_type]}, which this "
+            f"framework's logs do not record; supported types: "
+            f"{sorted(_PLOT_TYPES)} (same numbering as the reference's "
+            f"plot_training_log.py.example)")
+    if args.chart_type not in _PLOT_TYPES:
+        raise SystemExit(f"unknown chart type {args.chart_type}; "
+                         f"supported: {sorted(_PLOT_TYPES)}")
+    metric, xlabel, table, xcol = _PLOT_TYPES[args.chart_type]
+    if len(args.logfile) > len(_SERIES_COLORS):
+        raise SystemExit(
+            f"{len(args.logfile)} logs exceed the {len(_SERIES_COLORS)} "
+            f"distinguishable series; split into several charts")
+
+    fig, ax = plt.subplots(figsize=(8, 5))
+    plotted = 0
+    for i, lf in enumerate(args.logfile):
+        train_rows, test_rows = _parse_log_rows(lf)
+        rows = train_rows if table == "train" else test_rows
+        if not rows:
+            print(f"warning: {lf} has no {table} rows; skipped")
+            continue
+        xs = [r[xcol] for r in rows]
+        ys = [r[2] for r in rows]
+        name = lf.rsplit("/", 1)[-1]
+        ax.plot(xs, ys, linewidth=2, marker="o", markersize=4,
+                color=_SERIES_COLORS[i], label=name)
+        plotted += 1
+    if not plotted:
+        raise SystemExit("no plottable rows in any log file")
+    ax.set_xlabel(xlabel)
+    ax.set_ylabel(metric)
+    ax.set_title(f"{metric} vs. {xlabel}")
+    # recessive scaffolding: the data is the figure, not the grid
+    ax.grid(True, alpha=0.25, linewidth=0.5)
+    for side in ("top", "right"):
+        ax.spines[side].set_visible(False)
+    ax.legend(frameon=False)
+    fig.tight_layout()
+    fig.savefig(args.output, dpi=120)
+    plt.close(fig)
+    print(f"Wrote {args.output} ({plotted} series)")
     return 0
 
 
@@ -397,6 +504,15 @@ def register(sub) -> None:
     p.add_argument("logfile")
     p.add_argument("output_dir", nargs="?", default=".")
     p.set_defaults(fn=cmd_parse_log)
+
+    pm = sub.add_parser("plot_log")
+    pm.add_argument("chart_type", type=int,
+                    help="0/1 test accuracy vs iters/seconds, 6/7 train "
+                         "loss vs iters/seconds (reference "
+                         "plot_training_log.py.example numbering)")
+    pm.add_argument("output", help="image path (.png/.svg)")
+    pm.add_argument("logfile", nargs="+")
+    pm.set_defaults(fn=cmd_plot_log)
 
     from . import draw_net
     draw_net.register(sub)

@@ -50,6 +50,95 @@ def test_launch_spot_flag_and_main_dry_run(capsys):
     assert "describe" in capsys.readouterr().out
 
 
+class FakeRunner:
+    """Scripted gcloud: maps verb -> queued (rc, stdout) responses, so
+    the lifecycle flows are testable without GCP (the reference's own
+    EC2 lifecycle was similarly untested-by-machine; spark_ec2.py)."""
+
+    def __init__(self, script):
+        self.script = {k: list(v) for k, v in script.items()}
+        self.calls = []
+
+    def __call__(self, cmd):
+        verb = cmd[4]
+        self.calls.append(cmd)
+        q = self.script.get(verb, [])
+        return q.pop(0) if len(q) > 1 else (q[0] if q else (0, ""))
+
+
+def _cluster():
+    return TpuCluster("pod1", "z1")
+
+
+def test_launch_flow_polls_until_ready_then_setup():
+    from sparknet_tpu.infra.launch_tpu import launch_flow
+
+    r = FakeRunner({"create": [(0, "")],
+                    "describe": [(0, "CREATING"), (0, "CREATING"),
+                                 (0, "READY")],
+                    "ssh": [(0, "")]})
+    naps = []
+    launch_flow(_cluster(), runner=r, sleep=naps.append, poll_s=5)
+    verbs = [c[4] for c in r.calls]
+    assert verbs == ["create", "describe", "describe", "describe", "ssh"]
+    assert naps == [5, 5]  # slept between polls, not after READY
+
+
+def test_launch_flow_create_failure_names_resume():
+    from sparknet_tpu.infra.launch_tpu import TpuClusterError, launch_flow
+
+    r = FakeRunner({"create": [(1, "")]})
+    with pytest.raises(TpuClusterError, match="--resume"):
+        launch_flow(_cluster(), runner=r, sleep=lambda s: None)
+
+
+def test_launch_flow_resume_skips_create():
+    from sparknet_tpu.infra.launch_tpu import launch_flow
+
+    r = FakeRunner({"describe": [(0, "READY")], "ssh": [(0, "")]})
+    launch_flow(_cluster(), runner=r, resume=True, sleep=lambda s: None)
+    assert [c[4] for c in r.calls] == ["describe", "describe", "ssh"]
+
+
+def test_launch_flow_setup_failure_says_slice_still_up():
+    from sparknet_tpu.infra.launch_tpu import TpuClusterError, launch_flow
+
+    r = FakeRunner({"create": [(0, "")], "describe": [(0, "READY")],
+                    "ssh": [(1, "")]})
+    with pytest.raises(TpuClusterError, match="still running"):
+        launch_flow(_cluster(), runner=r, sleep=lambda s: None)
+
+
+def test_transient_describe_failure_tolerated():
+    """One gcloud blip mid-poll must not abort the wait on a billable
+    resource: describe retries before concluding anything."""
+    from sparknet_tpu.infra.launch_tpu import launch_flow, wait_for_state
+
+    r = FakeRunner({"describe": [(1, ""), (0, "READY")], "ssh": [(0, "")]})
+    assert wait_for_state(_cluster(), "READY", runner=r,
+                          sleep=lambda s: None) == "READY"
+
+    # resume path: a blip must not trigger a spurious create
+    r = FakeRunner({"describe": [(1, ""), (0, "READY")], "ssh": [(0, "")]})
+    launch_flow(_cluster(), runner=r, resume=True, sleep=lambda s: None)
+    assert "create" not in [c[4] for c in r.calls]
+
+
+def test_wait_for_state_bad_state_and_timeout():
+    from sparknet_tpu.infra.launch_tpu import (TpuClusterError,
+                                               wait_for_state)
+
+    r = FakeRunner({"describe": [(0, "PREEMPTED")]})
+    with pytest.raises(TpuClusterError, match="PREEMPTED"):
+        wait_for_state(_cluster(), "READY", runner=r,
+                       sleep=lambda s: None)
+
+    r = FakeRunner({"describe": [(0, "CREATING")]})
+    with pytest.raises(TpuClusterError, match="timed out"):
+        wait_for_state(_cluster(), "READY", runner=r, timeout_s=0,
+                       sleep=lambda s: None)
+
+
 def _make_shard(path, names):
     buf = io.BytesIO()
     with tarfile.open(mode="w", fileobj=buf) as tar:

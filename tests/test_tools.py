@@ -120,3 +120,48 @@ def test_parse_log(tmp_path, capsys):
     assert [r[2] for r in train[1:]] == ["2.301", "1.95", "1.801"]
     assert test[0] == ["NumIters", "Seconds", "accuracy"]
     assert [r[2] for r in test[1:]] == ["0.42", "0.61"]
+
+
+def test_plot_log(tmp_path):
+    """plot_log charts a parsed metric with the reference's chart-type
+    numbering (plot_training_log.py.example); unsupported types name the
+    missing metric instead of drawing an empty chart."""
+    import pytest
+
+    pytest.importorskip("matplotlib")
+    from sparknet_tpu import cli
+
+    log = tmp_path / "training_log_7.txt"
+    log.write_text(
+        "5.25: iteration 0: round loss = 2.301\n"
+        "9.75: iteration 1: %-age of test set correct: 0.42\n"
+        "12.00: iteration 1: round loss = 1.95\n"
+        "30.10: final %-age of test set correct: 0.61\n")
+    out = tmp_path / "loss.png"
+    assert cli.main(["plot_log", "6", str(out), str(log)]) == 0
+    assert out.stat().st_size > 1000  # a real rendered image
+    out2 = tmp_path / "acc.png"
+    assert cli.main(["plot_log", "0", str(out2), str(log), str(log)]) == 0
+    with pytest.raises(SystemExit, match="learning rate"):
+        cli.main(["plot_log", "4", str(out), str(log)])
+    with pytest.raises(SystemExit, match="unknown chart type"):
+        cli.main(["plot_log", "9", str(out), str(log)])
+
+
+def test_parse_log_malformed_numbers_die_with_filename(tmp_path):
+    """The log scanner honors the repo-wide parser contract: malformed
+    input dies with a file-naming ValueError, never a bare conversion
+    error (CLAUDE.md invariant)."""
+    import pytest
+
+    from sparknet_tpu.tools import _parse_log_rows
+
+    bad = tmp_path / "training_log_bad.txt"
+    bad.write_text("5.0: iteration 1: round loss = eee\n")
+    with pytest.raises(ValueError, match="training_log_bad.txt:1"):
+        _parse_log_rows(str(bad))
+
+    binary = tmp_path / "training_log_bin.txt"
+    binary.write_bytes(b"\xff\xfe\x00\x01binary")
+    with pytest.raises(ValueError, match="training_log_bin.txt"):
+        _parse_log_rows(str(binary))
