@@ -366,6 +366,94 @@ def bench_longctx_lm(seq_len: int = 16384, n_layers: int = 4,
     return out
 
 
+def bench_imagenet_native(rounds: int = 3, tau: int = 5, batch: int = 64,
+                          size: int = 256, crop: int = 227,
+                          n_imgs: int = 512, n_shards: int = 2,
+                          model: str = "alexnet") -> dict:
+    """Sustained ImageNet-SHAPE training throughput through the NATIVE
+    data tier: synthetic-JPEG tar shards -> ImageNetLoader ->
+    native/jpeg_decoder.cpp thread pool (data/scale_convert.convert_stream
+    picks it up when built) -> raw uint8 feed -> crop/mirror/mean fused
+    into the compiled round (device_transform) with one-round-ahead
+    prefetch.  This is the C++ tier measured in the driver record, not
+    only claimed in tests (VERDICT r3 item 8; reference analogue:
+    preprocessing/ScaleAndConvert.scala:16-27 + base_data_layer.cpp
+    prefetch feeding the solver loop)."""
+    import shutil
+    import subprocess
+    import tempfile
+
+    import numpy as np
+
+    # make sure the libjpeg pool is built — silently falling back to the
+    # PIL path would measure the wrong tier.  Build/toolchain failures
+    # surface as the same "native jpeg" RuntimeError so callers (main's
+    # guard, the CI skip) handle one error shape.
+    native_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                              "native")
+    try:
+        subprocess.run(["make", "-s", "all"], cwd=native_dir, check=True)
+    except (subprocess.CalledProcessError, FileNotFoundError, OSError) as e:
+        raise RuntimeError(f"native jpeg tier build failed: {e}") from e
+    from sparknet_tpu.data import native_jpeg
+
+    if not native_jpeg.available():
+        raise RuntimeError("native jpeg decoder unavailable after build — "
+                           "refusing to bench the fallback path as native")
+
+    from sparknet_tpu.apps.imagenet_app import build_solver
+    from sparknet_tpu.data.imagenet import (ImageNetLoader,
+                                            write_synthetic_jpeg_shards)
+
+    tmp = tempfile.mkdtemp(prefix="sparknet_bench_imgnet_")
+    try:
+        shard_paths, label_file = write_synthetic_jpeg_shards(
+            tmp, n_imgs=n_imgs, n_shards=n_shards, size=size, seed=0)
+
+        mean = np.full((3, size, size), 128.0, np.float32)
+        solver = build_solver(model, 1, tau, batch, batch, crop=crop,
+                              mean_image=mean, device_transform=True)
+        loader = ImageNetLoader(tmp)
+
+        class JpegStream:
+            # cycling raw-uint8 stream off the tar shards; stream_safe by
+            # construction, so prefetch staging one round ahead is exact
+            stream_safe = True
+
+            def __init__(self):
+                self._it = None
+
+            def _fresh(self):
+                return loader.batches(label_file, batch_size=batch,
+                                      height=size, width=size,
+                                      shards=shard_paths)
+
+            def __call__(self):
+                if self._it is None:
+                    self._it = self._fresh()
+                try:
+                    imgs, labels = next(self._it)
+                except StopIteration:
+                    self._it = self._fresh()
+                    imgs, labels = next(self._it)
+                return {"data": imgs, "label": labels}
+
+        solver.set_train_data([JpegStream()])
+        solver.set_prefetch(True)
+        solver.run_round()  # compile + warm
+        t0 = time.perf_counter()
+        for r in range(rounds):
+            solver.run_round(prefetch_next=r < rounds - 1)
+        dt = time.perf_counter() - t0
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+    out = {"imagenet_native_fed_imgs_per_sec":
+           round(rounds * tau * batch / dt, 1),
+           "imagenet_native_batch": batch, "imagenet_native_tau": tau}
+    log(json.dumps(out))
+    return out
+
+
 def bench_cifar_e2e(rounds: int = 6, tau: int = 100,
                     prefetch: bool = True) -> float:
     """Sustained HOST-FED CIFAR training throughput, prefetch on — the
@@ -591,6 +679,13 @@ def main() -> None:
     longctx = bench_longctx_lm()
     cifar_e2e = bench_cifar_e2e()
     log(json.dumps({"cifar_e2e_imgs_per_sec": round(cifar_e2e, 1)}))
+    try:
+        imgnet_native = bench_imagenet_native()
+    except Exception as e:
+        # one leg must degrade, not destroy, the record: every other
+        # number above is already measured at this point
+        log(f"imagenet_native leg failed, omitting its field: {e!r}")
+        imgnet_native = None
 
     result = {
         "metric": "alexnet_train_imgs_per_sec",
@@ -615,6 +710,9 @@ def main() -> None:
         "longctx_lm_tok_per_sec": longctx["longctx_lm_tok_per_sec"],
         "cifar_e2e_imgs_per_sec": round(cifar_e2e, 1),
     }
+    if imgnet_native is not None:
+        result["imagenet_native_fed_imgs_per_sec"] = \
+            imgnet_native["imagenet_native_fed_imgs_per_sec"]
     _emit_json_line(result)
     try:
         tmp = LAST_GOOD + ".tmp"

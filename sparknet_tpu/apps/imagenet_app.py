@@ -43,11 +43,14 @@ MODEL_PROTO = {
 def build_solver(model: str, n_workers: int, tau: int, batch_size: int,
                  test_batch: int, mesh=None, crop: int = CROPPED,
                  dcn_interval: int = 1, mean_image=None,
-                 device_transform: bool = False) -> DistributedSolver:
+                 device_transform: bool = False, scan_unroll=1,
+                 sync_history: str = "local") -> DistributedSolver:
     """device_transform: fuse the crop/mirror/mean pipeline into the
     compiled round (ops/device_transform.py) — feeds then ship raw uint8
     256x256 images, 4x less host->device traffic and no host transform
-    loop (the TPU-native data-path split, BENCH_NOTES.md)."""
+    loop (the TPU-native data-path split, BENCH_NOTES.md).
+    scan_unroll/sync_history pass through to DistributedSolver (CPU-mesh
+    studies and the momentum-at-sync option, dist.py docstring)."""
     d = MODEL_PROTO[model]
     net = caffe_pb.load_net_prototxt(os.path.join(d, "train_val.prototxt"))
     net = caffe_pb.replace_data_layers(net, batch_size, test_batch, 3, crop,
@@ -64,7 +67,9 @@ def build_solver(model: str, n_workers: int, tau: int, batch_size: int,
                                       phase="TEST")
     return DistributedSolver(sp, n_workers=n_workers, tau=tau, mesh=mesh,
                              dcn_interval=dcn_interval, device_transform=dt,
-                             device_transform_eval=dte)
+                             device_transform_eval=dte,
+                             scan_unroll=scan_unroll,
+                             sync_history=sync_history)
 
 
 class ShardFeed:

@@ -73,6 +73,45 @@ class ImageNetLoader:
             convert_stream(stream(), height, width), batch_size)
 
 
+def write_synthetic_jpeg_shards(out_dir: str, *, n_imgs: int,
+                                n_shards: int = 2, size: int = 256,
+                                n_classes: int = 1000, seed: int = 0,
+                                quality: int = 85, ext: str = "jpeg"):
+    """Random-JPEG tar shards + label file in the loader's layout — the
+    one synthetic-shard writer shared by benches and tests (the format
+    ImageNetLoader.read_tar consumes; reference layout
+    ImageNetLoader.scala:56-79).  Returns (shard_paths, label_file)."""
+    import io
+    import tarfile
+
+    from PIL import Image
+
+    rng = np.random.RandomState(seed)
+    per = n_imgs // n_shards
+    label_lines = []
+    shard_paths = []
+    for s in range(n_shards):
+        path = os.path.join(out_dir, f"shard_{s:02d}.tar")
+        shard_paths.append(path)
+        with tarfile.open(path, "w") as tf:
+            for i in range(per):
+                name = f"img_{s:02d}_{i:04d}.{ext}"
+                arr = rng.randint(0, 256, size=(size, size, 3),
+                                  dtype=np.uint8)
+                buf = io.BytesIO()
+                Image.fromarray(arr).save(buf, format="JPEG",
+                                          quality=quality)
+                info = tarfile.TarInfo(name)
+                info.size = buf.getbuffer().nbytes
+                buf.seek(0)
+                tf.addfile(info, buf)
+                label_lines.append(f"{name} {rng.randint(0, n_classes)}")
+    label_file = os.path.join(out_dir, "labels.txt")
+    with open(label_file, "w") as f:
+        f.write("\n".join(label_lines) + "\n")
+    return shard_paths, label_file
+
+
 def shard_paths_for_worker(paths: List[str], worker: int, n_workers: int,
                            ) -> List[str]:
     """Round-robin shard assignment (the coalesce-partitioning analogue,

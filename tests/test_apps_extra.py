@@ -132,28 +132,11 @@ def test_imagenet_app_snapshot_resume(tmp_path):
 
 
 def _tiny_imagenet_shards(tmp_path, n_imgs=16, size=40):
-    """Two tar shards of JPEGs + a label file."""
-    import io
-    import tarfile
+    """Two tar shards of JPEGs + a label file (shared writer)."""
+    from sparknet_tpu.data.imagenet import write_synthetic_jpeg_shards
 
-    from PIL import Image
-
-    rng = np.random.RandomState(0)
-    names = []
-    for s in range(2):
-        with tarfile.open(tmp_path / f"shard{s}.tar", "w") as tf:
-            for i in range(n_imgs // 2):
-                name = f"img_{s}_{i}.jpg"
-                buf = io.BytesIO()
-                Image.fromarray(rng.randint(0, 255, (size, size, 3))
-                                .astype(np.uint8)).save(buf, format="JPEG")
-                data = buf.getvalue()
-                info = tarfile.TarInfo(name)
-                info.size = len(data)
-                tf.addfile(info, io.BytesIO(data))
-                names.append(name)
-    (tmp_path / "labels.txt").write_text(
-        "\n".join(f"{n} {i % 7}" for i, n in enumerate(names)))
+    write_synthetic_jpeg_shards(str(tmp_path), n_imgs=n_imgs, n_shards=2,
+                                size=size, n_classes=7, ext="jpg")
     return str(tmp_path), str(tmp_path / "labels.txt")
 
 

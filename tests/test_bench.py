@@ -128,6 +128,27 @@ def test_bench_sigterm_mid_wait_emits_stale_line(tmp_path):
     assert rec["stale_reason"].startswith("killed_by_signal_")
 
 
+def test_bench_imagenet_native_cpu():
+    """The native-tier ImageNet-shape leg must stay runnable off-TPU: it
+    builds synthetic-JPEG tar shards and streams them through the C++
+    libjpeg pool into the fused-transform round (the driver measures the
+    same construction on hardware; a broken leg would take the whole
+    driver bench down)."""
+    import pytest
+
+    import bench
+
+    try:
+        r = bench.bench_imagenet_native(rounds=1, tau=1, batch=4,
+                                        size=64, crop=56, n_imgs=16,
+                                        n_shards=2)
+    except RuntimeError as e:
+        if "native jpeg" in str(e):
+            pytest.skip("libjpeg toolchain unavailable on this box")
+        raise
+    assert r["imagenet_native_fed_imgs_per_sec"] > 0
+
+
 def test_bench_longctx_lm_cpu():
     """The driver runs this leg on real hardware at round end; CI pins
     that it stays constructible and emits its field contract (a broken
