@@ -1,0 +1,74 @@
+"""Classification with a deploy-form net: the forward pass, top-k.
+
+The reference's examples/00-classification.ipynb loads a deploy
+prototxt + .caffemodel and reads softmax probabilities off the top blob.
+Same flow: the zoo's deploy-form LeNet, weights warm-started from a
+briefly-trained model saved as a .caffemodel, probabilities from one
+jitted forward.
+
+    JAX_PLATFORMS=cpu python examples/00_classification.py
+"""
+
+import argparse
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+from sparknet_tpu.utils.compile_cache import apply_platform_env
+
+apply_platform_env()  # sitecustomize pre-imports jax; honor JAX_PLATFORMS=cpu
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--iters", type=int, default=60)
+    a = p.parse_args()
+
+    from sparknet_tpu.core.net import Net
+    from sparknet_tpu.models import get_model
+    from sparknet_tpu.proto import caffe_pb
+    from sparknet_tpu.proto.textformat import parse
+    from sparknet_tpu.solver.solver import Solver, load_params_file
+
+    # 1. train briefly on synthetic prototypes and save a .caffemodel
+    #    (the reference ships caffemodels; zero egress means we brew one)
+    rng = np.random.RandomState(0)
+    protos = rng.rand(10, 1, 28, 28).astype(np.float32)
+
+    def batch():
+        y = rng.randint(0, 10, (32,))
+        x = protos[y] + 0.1 * rng.randn(32, 1, 28, 28).astype(np.float32)
+        return {"data": x, "label": y.astype(np.int32)}
+
+    sp = caffe_pb.SolverParameter(parse(
+        'base_lr: 0.01 lr_policy: "fixed" momentum: 0.9 random_seed: 1'))
+    sp.msg.set("net_param", get_model("lenet", batch=32).msg)
+    solver = Solver(sp)
+    solver.set_train_data(batch)
+    solver.step(a.iters)
+    tmp = tempfile.mkdtemp(prefix="classify_example_")
+    weights = os.path.join(tmp, "lenet.caffemodel")
+    solver.save_caffemodel(weights)
+
+    # 2. the deploy net (input declared, no data/loss layers) + the
+    #    saved weights, name-matched like `Classifier` does
+    deploy = Net(get_model("lenet", batch=1, deploy=True), "TEST")
+    params = load_params_file(weights, deploy.init_params(0), deploy)
+
+    # 3. classify one image; prob is the softmax top blob
+    img = protos[7:8] + 0.1 * rng.randn(1, 1, 28, 28).astype(np.float32)
+    prob = np.asarray(deploy.forward(params, {"data": img})["prob"])[0]
+    top3 = np.argsort(prob)[::-1][:3]
+    print("top-3:", [(int(k), round(float(prob[k]), 3)) for k in top3])
+    assert abs(prob.sum() - 1.0) < 1e-4
+    print(f"predicted class {int(top3[0])} (true 7) "
+          f"p={float(prob[top3[0]]):.3f}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

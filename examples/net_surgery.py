@@ -1,0 +1,87 @@
+"""Net surgery: casting a classifier into a fully-convolutional net.
+
+The reference's examples/net_surgery.ipynb reshapes trained
+InnerProduct weights into equivalent convolutions so the classifier
+scores a LARGER image densely in one forward.  Params here are a plain
+dict, so the surgery is a reshape.
+
+    JAX_PLATFORMS=cpu python examples/net_surgery.py
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+from sparknet_tpu.utils.compile_cache import apply_platform_env
+
+apply_platform_env()  # sitecustomize pre-imports jax; honor JAX_PLATFORMS=cpu
+
+
+def main():
+    argparse.ArgumentParser().parse_args()
+
+    from sparknet_tpu.core import layers_dsl as dsl
+    from sparknet_tpu.core.net import Net
+    from sparknet_tpu.models import get_model
+
+    # the trained classifier (deploy LeNet: ip1 consumes pool2's 50x4x4)
+    lenet = Net(get_model("lenet", batch=1, deploy=True), "TEST")
+    params = lenet.init_params(3)
+    rng = np.random.RandomState(1)
+    img = rng.rand(1, 1, 28, 28).astype(np.float32)
+    logits = np.asarray(lenet.forward(params, {"data": img})["ip2"])
+
+    # its conv-ized twin: ip1 (500 x 50*4*4) becomes a 4x4 conv, ip2
+    # (10 x 500) a 1x1 conv; input size is now free
+    def convized(h, w):
+        return Net(dsl.net_param(
+            "LeNetConv",
+            dsl.convolution_layer("conv1", "data", num_output=20,
+                                  kernel_size=5),
+            dsl.pooling_layer("pool1", "conv1", pool="MAX", kernel_size=2,
+                              stride=2),
+            dsl.convolution_layer("conv2", "pool1", num_output=50,
+                                  kernel_size=5),
+            dsl.pooling_layer("pool2", "conv2", pool="MAX", kernel_size=2,
+                              stride=2),
+            dsl.convolution_layer("ip1conv", "pool2", num_output=500,
+                                  kernel_size=4),
+            dsl.relu_layer("relu1", "ip1conv"),
+            dsl.convolution_layer("ip2conv", "ip1conv", num_output=10,
+                                  kernel_size=1),
+            inputs={"data": (1, 1, h, w)}), "TEST")
+
+    # THE SURGERY: copy conv weights through, reshape IP weights into
+    # conv kernels (out, C*H*W) -> (out, C, H, W) — the ipynb's
+    # params['fc6'][0].reshape(...) move
+    surgery = convized(28, 28)
+    cast = dict(surgery.init_params(0))
+    for k in ("conv1/0", "conv1/1", "conv2/0", "conv2/1"):
+        cast[k] = params[k]
+    cast["ip1conv/0"] = np.asarray(params["ip1/0"]).reshape(500, 50, 4, 4)
+    cast["ip1conv/1"] = params["ip1/1"]
+    cast["ip2conv/0"] = np.asarray(params["ip2/0"]).reshape(10, 500, 1, 1)
+    cast["ip2conv/1"] = params["ip2/1"]
+
+    out = np.asarray(surgery.forward(cast, {"data": img})["ip2conv"])
+    np.testing.assert_allclose(out[0, :, 0, 0], logits[0], rtol=1e-4,
+                               atol=1e-5)
+    print("28x28: conv-ized scores == classifier logits (1x1 map)")
+
+    # dense application: a 40x40 image yields a 4x4 grid of scores in
+    # ONE forward — the point of the cast
+    big = convized(40, 40)
+    wide = rng.rand(1, 1, 40, 40).astype(np.float32)
+    dense = np.asarray(big.forward(cast, {"data": wide})["ip2conv"])
+    print(f"40x40: dense score map shape {dense.shape[2:]} "
+          f"(10 classes x {dense.shape[2]}x{dense.shape[3]} positions)")
+    assert dense.shape[1:] == (10, 4, 4)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
