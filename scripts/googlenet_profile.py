@@ -25,7 +25,8 @@ D = "/root/reference/caffe/models/bvlc_googlenet"
 
 
 def build_step(batch, drop_aux=False, lrn_impl=None, no_lrn=False,
-               pool_to_ave=False, no_dropout=False, fuse_1x1=False):
+               pool_to_ave=False, no_dropout=False, fuse_1x1=False,
+               pad_thin=None):
     if lrn_impl:
         os.environ["SPARKNET_LRN_IMPL"] = lrn_impl
     else:
@@ -55,6 +56,15 @@ def build_step(batch, drop_aux=False, lrn_impl=None, no_lrn=False,
 
         npm, _map, groups = fuse_sibling_1x1_convs(npm)
         assert groups, "expected inception 1x1 groups to fuse"
+    if pad_thin:
+        # round 4: explicit channel padding of the thin reduce branches
+        # (core/fuse.py pad_thin_conv_outputs; VERDICT r3 item 2) — tile
+        # math predicts null, this measures whether XLA's tiny-N lowering
+        # changes
+        from sparknet_tpu.core.fuse import pad_thin_conv_outputs
+
+        npm, _map, padded = pad_thin_conv_outputs(npm, multiple=pad_thin)
+        assert padded, "expected thin convs to pad"
     net = Net(npm, "TRAIN", batch_override=batch)
     sp = caffe_pb.load_solver_prototxt(D + "/solver.prototxt")
     params = net.init_params(0)
@@ -109,10 +119,19 @@ def main():
         ("fused_1x1_b64", 64, dict(fuse_1x1=True)),
         ("fused_1x1_b128", 128, dict(fuse_1x1=True)),
         ("fused_1x1_no_aux_b64", 64, dict(fuse_1x1=True, drop_aux=True)),
+        # round 4: explicit channel padding of thin conv outputs
+        ("pad32_b128", 128, dict(pad_thin=32)),
+        ("pad128_b128", 128, dict(pad_thin=128)),
     ]
-    only = set(sys.argv[1:])
-    if only:
-        variants = [v for v in variants if v[0] in only]
+    # argv names select AND order the run list; repeats run repeatedly
+    # (interleaved A/B is `baseline_b128 pad32_b128 baseline_b128 ...`)
+    if sys.argv[1:]:
+        by_name = {v[0]: v for v in variants}
+        unknown = [n for n in sys.argv[1:] if n not in by_name]
+        if unknown:
+            raise SystemExit(f"unknown variant(s) {unknown}; choose from "
+                             f"{sorted(by_name)}")
+        variants = [by_name[n] for n in sys.argv[1:]]
     for name, batch, kw in variants:
         try:
             r = measure(batch, **kw)

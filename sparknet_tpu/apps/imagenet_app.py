@@ -44,19 +44,24 @@ def build_solver(model: str, n_workers: int, tau: int, batch_size: int,
                  test_batch: int, mesh=None, crop: int = CROPPED,
                  dcn_interval: int = 1, mean_image=None,
                  device_transform: bool = False, scan_unroll=1,
-                 sync_history: str = "local") -> DistributedSolver:
+                 sync_history: str = "local",
+                 base_lr: Optional[float] = None) -> DistributedSolver:
     """device_transform: fuse the crop/mirror/mean pipeline into the
     compiled round (ops/device_transform.py) — feeds then ship raw uint8
     256x256 images, 4x less host->device traffic and no host transform
     loop (the TPU-native data-path split, BENCH_NOTES.md).
     scan_unroll/sync_history pass through to DistributedSolver (CPU-mesh
-    studies and the momentum-at-sync option, dist.py docstring)."""
+    studies and the momentum-at-sync option, dist.py docstring);
+    base_lr overrides the solver prototxt's lr BEFORE construction
+    (downscaled-batch studies applying the linear scaling rule)."""
     d = MODEL_PROTO[model]
     net = caffe_pb.load_net_prototxt(os.path.join(d, "train_val.prototxt"))
     net = caffe_pb.replace_data_layers(net, batch_size, test_batch, 3, crop,
                                        crop)
     sp = caffe_pb.load_solver_prototxt_with_net(
         os.path.join(d, "solver.prototxt"), net)
+    if base_lr is not None:
+        sp.msg.set("base_lr", float(base_lr))
     dt = dte = None
     if device_transform:
         from ..ops.device_transform import make_device_transformer

@@ -101,3 +101,52 @@ def test_googlenet_fuses_nine_inception_groups():
     n0 = sum(int(np.prod(np.shape(v))) for v in p0.values())
     n1 = sum(int(np.prod(np.shape(v))) for v in p1.values())
     assert n0 == n1
+
+
+def test_pad_thin_conv_outputs_exact():
+    """pad_thin_conv_outputs (the channel-padding countermeasure,
+    VERDICT r3 item 2): thin convs round up to the tile multiple, extra
+    channels slice away, mapped params produce identical activations —
+    and gradients to the real filters are unchanged (padded filters get
+    zero gradient through the discarded slice)."""
+    import jax
+    import jax.numpy as jnp
+
+    from sparknet_tpu.core.fuse import pad_thin_conv_outputs
+
+    net_p = caffe_pb.parse_net_text(MINI)
+    pad_p, map_params, padded = pad_thin_conv_outputs(net_p, multiple=8)
+    assert padded == ["b1", "b2", "b3", "c2"]
+    types = [str(l.type) for l in pad_p.layers]
+    assert types.count("Slice") == 4 and types.count("Silence") == 4
+    pads = [l for l in pad_p.layers if str(l.type) == "Convolution"]
+    assert all(int(l.convolution_param.num_output) == 8 for l in pads)
+
+    net0 = Net(net_p, "TEST")
+    net1 = Net(pad_p, "TEST")
+    p0 = net0.init_params(0)
+    p1 = {k: jnp.asarray(v) for k, v in map_params(
+        {k: np.asarray(v) for k, v in p0.items()}).items()}
+    assert set(p1) == set(net1.init_params(0))
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.rand(2, 8, 6, 6).astype(np.float32))
+    out0 = net0.forward(p0, {"data": x})["cat"]
+    out1 = net1.forward(p1, {"data": x})["cat"]
+    np.testing.assert_allclose(np.asarray(out0), np.asarray(out1),
+                               rtol=1e-5, atol=1e-6)
+
+    # gradient equivalence on the REAL filters
+    def loss0(p):
+        return jnp.sum(net0.forward(p, {"data": x})["cat"] ** 2)
+
+    def loss1(p):
+        return jnp.sum(net1.forward(p, {"data": x})["cat"] ** 2)
+
+    g0 = jax.grad(loss0)(p0)
+    g1 = jax.grad(loss1)(p1)
+    for k, g in g0.items():
+        np.testing.assert_allclose(np.asarray(g1[k])[:np.asarray(g).shape[0]]
+                                   if np.asarray(g1[k]).shape
+                                   != np.asarray(g).shape
+                                   else np.asarray(g1[k]),
+                                   np.asarray(g), rtol=1e-4, atol=1e-5)
