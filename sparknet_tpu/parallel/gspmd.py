@@ -68,16 +68,39 @@ def infer_tp_specs(net, mesh: Mesh, *, min_tp_elems: int = 1 << 16
     return specs
 
 
+def zero1_state_spec(shape: Tuple[int, ...], n_workers: int) -> P:
+    """ZeRO-1 slot sharding for a REPLICATED parameter: shard the first
+    dim that divides evenly over the `workers` axis; slots with no such
+    dim stay replicated (tiny biases — the memory they cost is nil).
+    The update math is unchanged: XLA computes each momentum shard
+    locally and all-gathers the weight delta, which is exactly the
+    ZeRO-1 partition-the-optimizer-states recipe (arXiv:1910.02054 §5.1)
+    expressed as sharding annotations."""
+    for d, n in enumerate(shape):
+        if n >= n_workers and n % n_workers == 0:
+            return P(*([None] * d), WORKER_AXIS,
+                     *([None] * (len(shape) - d - 1)))
+    return P()
+
+
 class GspmdTrainer:
     """Per-step synchronous DP(+TP) trainer: one jitted step, shardings
     annotated, collectives compiler-inserted.  API mirrors the single-chip
-    Solver's step loop so apps can swap it in."""
+    Solver's step loop so apps can swap it in.
+
+    zero1=True additionally shards the optimizer slots of REPLICATED
+    parameters over the `workers` (data) axis — ZeRO stage 1.  Params
+    keep their DP replication (TP-sharded params' slots already shard
+    with them); per-device optimizer memory for the replicated set drops
+    ~n_workers x, at the cost of compiler-inserted gathers in the
+    update."""
 
     def __init__(self, solver_param: SolverParameter, *, mesh: Mesh,
                  net_param=None, precision: Optional[str] = None,
                  min_tp_elems: int = 1 << 16,
                  data_shapes: Optional[Dict[str, Any]] = None,
-                 batch_override: Optional[int] = None) -> None:
+                 batch_override: Optional[int] = None,
+                 zero1: bool = False) -> None:
         self.param = solver_param
         self.mesh = mesh
         if net_param is None:
@@ -91,6 +114,15 @@ class GspmdTrainer:
 
         pspecs = infer_tp_specs(self.net, mesh, min_tp_elems=min_tp_elems)
         self.param_specs = pspecs
+        self.zero1 = bool(zero1)
+        w = mesh.shape.get(WORKER_AXIS, 1)
+        # optimizer slots mirror their parameter's sharding (sharded-
+        # optimizer for TP dims); with zero1, replicated params' slots
+        # shard over the data axis instead (ZeRO stage 1)
+        self.state_specs = {
+            k: (zero1_state_spec(tuple(self.net.param_inits[k].shape), w)
+                if self.zero1 and w > 1 and s == P() else s)
+            for k, s in pspecs.items()}
         seed = int(solver_param.random_seed)
         params0 = self.net.init_params(seed if seed >= 0 else 0)
         shard = lambda spec: NamedSharding(mesh, spec)  # noqa: E731
@@ -98,9 +130,8 @@ class GspmdTrainer:
                        for k, v in params0.items()}
         state0 = updates.init_state(params0,
                                     solver_param.resolved_type())
-        # optimizer slots mirror their parameter's sharding (sharded-
-        # optimizer for TP dims)
-        self.state = {k: tuple(jax.device_put(h, shard(pspecs[k]))
+        self.state = {k: tuple(jax.device_put(h,
+                                              shard(self.state_specs[k]))
                                for h in hs)
                       for k, hs in state0.items()}
         self._data_sharding = shard(P(WORKER_AXIS))
@@ -109,7 +140,7 @@ class GspmdTrainer:
         single = make_single_step(self.net, solver_param,
                                   precision=self.precision)
         param_sh = {k: shard(s) for k, s in pspecs.items()}
-        state_sh = {k: tuple(shard(pspecs[k]) for _ in hs)
+        state_sh = {k: tuple(shard(self.state_specs[k]) for _ in hs)
                     for k, hs in state0.items()}
         in_sh = (param_sh, state_sh, self._repl, None, self._repl)
         out_sh = (param_sh, state_sh, self._repl)
@@ -129,6 +160,13 @@ class GspmdTrainer:
         return {k: tuple(self.net.param_inits[k].shape)
                 for k, s in self.param_specs.items()
                 if s != P() and MODEL_AXIS in s}
+
+    def zero1_sharded_state(self) -> Dict[str, Tuple[int, ...]]:
+        """Which REPLICATED params' optimizer slots shard over the data
+        axis under zero1 (introspection/tests)."""
+        return {k: tuple(self.net.param_inits[k].shape)
+                for k, s in self.state_specs.items()
+                if self.param_specs[k] == P() and WORKER_AXIS in s}
 
     def snapshot(self, path: str) -> str:
         """Write the snapshot triple (iter + params + solver state).
@@ -151,7 +189,9 @@ class GspmdTrainer:
         self.iter, self.params, self.state = orbax_ckpt.restore_validated(
             path, known_params=self.params, known_state=self.state,
             sharding_for=lambda k: NamedSharding(self.mesh,
-                                                 self.param_specs[k]))
+                                                 self.param_specs[k]),
+            state_sharding_for=lambda k: NamedSharding(
+                self.mesh, self.state_specs[k]))
 
     def step(self, n: int = 1) -> float:
         assert self.train_source is not None, "set_train_data first"

@@ -54,12 +54,14 @@ def save_auto(path: str, it: int, params, state) -> str:
 
 def restore_auto(path: str, *, known_params=None,
                  sharding_for: Optional[Callable[[str], Any]] = None,
+                 state_sharding_for: Optional[Callable[[str], Any]] = None,
                  ) -> Tuple[int, Dict[str, Any], Dict[str, Tuple[Any, ...]]]:
     """Counterpart of save_auto: orbax directory when present, else the
     legacy extension-less `.npz` the native writer produces."""
     if is_orbax_path(path) and os.path.isdir(path):
         return restore(path, known_params=known_params,
-                       sharding_for=sharding_for)
+                       sharding_for=sharding_for,
+                       state_sharding_for=state_sharding_for)
     from ..solver.solver import parse_native_snapshot
 
     return parse_native_snapshot(path)
@@ -75,12 +77,17 @@ def save(path: str, it: int, params: Dict[str, jax.Array],
 
 def restore(path: str, *, known_params=None,
             sharding_for: Optional[Callable[[str], Any]] = None,
+            state_sharding_for: Optional[Callable[[str], Any]] = None,
             ) -> Tuple[int, Dict[str, Any], Dict[str, Tuple[Any, ...]]]:
     """Returns (iter, params, state).  `sharding_for(key)` supplies the
     target sharding per param key so arrays restore directly into their
-    mesh placement (no host-gathered intermediate).  `known_params`
-    pre-validates the checkpoint's param keys against the caller's net
-    using the metadata already in hand (one metadata read)."""
+    mesh placement (no host-gathered intermediate);
+    `state_sharding_for` overrides it for optimizer slots (ZeRO-1:
+    slots shard where params replicate — restoring them into the param
+    sharding would materialize the full replicated slot on every
+    process before resharding).  `known_params` pre-validates the
+    checkpoint's param keys against the caller's net using the metadata
+    already in hand (one metadata read)."""
     import orbax.checkpoint as ocp
 
     path = os.path.abspath(path)
@@ -101,11 +108,12 @@ def restore(path: str, *, known_params=None,
     if sharding_for is None:
         payload = ckpt.restore(path)
     else:
+        ssf = state_sharding_for or sharding_for
         restore_args = {
             "iter": ocp.RestoreArgs(),
             "params": {k: ocp.ArrayRestoreArgs(sharding=sharding_for(k))
                        for k in tree["params"]},
-            "state": {k: [ocp.ArrayRestoreArgs(sharding=sharding_for(k))
+            "state": {k: [ocp.ArrayRestoreArgs(sharding=ssf(k))
                           for _ in v]
                       for k, v in tree["state"].items()},
         }
@@ -117,7 +125,7 @@ def restore(path: str, *, known_params=None,
 
 
 def restore_validated(path: str, *, known_params, known_state,
-                      sharding_for):
+                      sharding_for, state_sharding_for=None):
     """The shared trainer-restore sequence: restore_auto, validate that
     the snapshot covers every known param AND solver-state key (a partial
     checkpoint must fail HERE with a named error, not later as an opaque
@@ -133,7 +141,8 @@ def restore_validated(path: str, *, known_params, known_state,
     import jax.numpy as jnp
 
     it, params, state = restore_auto(path, known_params=known_params,
-                                     sharding_for=sharding_for)
+                                     sharding_for=sharding_for,
+                                     state_sharding_for=state_sharding_for)
     missing = set(known_params) - set(params)
     if missing:
         raise ValueError(f"snapshot lacks params: {sorted(missing)}")
@@ -141,10 +150,15 @@ def restore_validated(path: str, *, known_params, known_state,
     if missing_state:
         raise ValueError(
             f"snapshot lacks solver state for: {sorted(missing_state)}")
+    if state_sharding_for is None:
+        # solver slots usually mirror their parameter's sharding; a
+        # ZeRO-1 trainer overrides (slots shard where params replicate)
+        state_sharding_for = sharding_for
     new_params = {k: jax.device_put(jnp.asarray(params[k]),
                                     sharding_for(k))
                   for k in known_params}
-    new_state = {k: tuple(jax.device_put(jnp.asarray(h), sharding_for(k))
+    new_state = {k: tuple(jax.device_put(jnp.asarray(h),
+                                         state_sharding_for(k))
                           for h in state[k])
                  for k in known_state}
     return int(it), new_params, new_state

@@ -137,3 +137,74 @@ def test_gspmd_snapshot_resume_exact(tmp_path, fname):
     for k, v in expect.items():
         np.testing.assert_allclose(np.asarray(t2.params[k]), v,
                                    rtol=1e-6, atol=1e-7, err_msg=k)
+
+
+def test_zero1_shards_replicated_slots_and_matches_trajectory(tmp_path):
+    """ZeRO stage 1 (zero1=True): optimizer slots of replicated params
+    shard over the data axis (arXiv:1910.02054 §5.1 as sharding
+    annotations); the trajectory is IDENTICAL to the unsharded trainer,
+    and snapshot/restore round-trips the distinct state shardings."""
+    from jax.sharding import PartitionSpec as P
+    from sparknet_tpu.parallel.mesh import WORKER_AXIS
+
+    batches = _stream(8)
+
+    def run(zero1):
+        it = iter(list(batches))
+        tr = GspmdTrainer(_sp(), mesh=make_mesh(4), zero1=zero1)
+        tr.set_train_data(lambda: next(it))
+        losses = [tr.step(1) for _ in range(4)]
+        return tr, losses
+
+    base, l0 = run(False)
+    z, l1 = run(True)
+    np.testing.assert_allclose(l0, l1, rtol=2e-5)
+    for k in base.params:
+        np.testing.assert_allclose(np.asarray(base.params[k]),
+                                   np.asarray(z.params[k]),
+                                   rtol=2e-5, atol=1e-6)
+    # the big replicated blobs' slots really shard over `workers`
+    sharded = z.zero1_sharded_state()
+    assert "conv1/0" in sharded and "ip1/0" in sharded, sharded
+    assert all(WORKER_AXIS in z.state_specs[k] for k in sharded)
+    # and a slot's committed sharding matches the spec (not replicated)
+    sl = z.state["conv1/0"][0]
+    assert sl.sharding.spec == z.state_specs["conv1/0"]
+    # params stay replicated (stage 1 shards STATE only)
+    assert z.param_specs["conv1/0"] == P()
+
+    # exact resume with the distinct state shardings
+    snap = z.snapshot(str(tmp_path / "z1ck"))
+    it2 = iter(list(batches))
+    z2 = GspmdTrainer(_sp(), mesh=make_mesh(4), zero1=True)
+    z2.restore(snap)
+    for _ in range(4):
+        next(it2)
+    z2.set_train_data(lambda: next(it2))
+    za = z.step(1)
+    zb = z2.step(1)
+    np.testing.assert_allclose(za, zb, rtol=2e-5)
+    assert z2.state["conv1/0"][0].sharding.spec == \
+        z2.state_specs["conv1/0"]
+
+
+def test_zero1_composes_with_tp():
+    """zero1 + model axis: TP-sharded params keep their (model) slot
+    sharding; only replicated params' slots move to the data axis."""
+    from jax.sharding import PartitionSpec as P
+
+    tr = GspmdTrainer(_sp(), mesh=make_mesh(2, model_parallel=2),
+                      min_tp_elems=1024, zero1=True)
+    tp = tr.tp_sharded_params()
+    assert tp, "expected TP-sharded blobs in this config"
+    for k in tp:
+        assert tr.state_specs[k] == tr.param_specs[k] != P()
+    z = tr.zero1_sharded_state()
+    assert z and all(k not in tp for k in z)
+    assert np.isfinite(tr_step_once(tr))
+
+
+def tr_step_once(tr):
+    it = iter(_stream(1))
+    tr.set_train_data(lambda: next(it))
+    return tr.step(1)
