@@ -344,6 +344,68 @@ def cmd_parse_log(args) -> int:
     return 0
 
 
+def cmd_resize_and_crop_images(args) -> int:
+    """Aspect-preserving resize to short side `--side`, then center
+    square crop, over a whole directory tree in parallel (reference:
+    tools/extra/resize_and_crop_images.py — its mincepie map-reduce
+    becomes a thread pool; the PILResizeCrop math is the same
+    short-side-resize + center-crop).  Output mirrors the input tree
+    (the synset layout the reference assumes)."""
+    import concurrent.futures as cf
+
+    try:
+        from PIL import Image
+    except ImportError:
+        raise SystemExit("resize_and_crop_images needs pillow "
+                         "(the `data` extra)")
+
+    exts = (".jpg", ".jpeg", ".png", ".bmp")
+    jobs = []
+    for root, _dirs, files in os.walk(args.input_folder):
+        rel = os.path.relpath(root, args.input_folder)
+        for f in files:
+            if f.lower().endswith(exts):
+                jobs.append((os.path.join(root, f),
+                             os.path.join(args.output_folder, rel, f)))
+    if not jobs:
+        raise SystemExit(
+            f"no images ({'/'.join(exts)}) under {args.input_folder}")
+    side = int(args.side)
+
+    def one(pair):
+        # the whole per-image pipeline is guarded: one unwritable
+        # subdir or full disk must skip-and-count, not abort the tree
+        # mid-run with the pool's re-raised traceback
+        src, dst = pair
+        try:
+            img = Image.open(src)
+            img.load()
+            w, h = img.size
+            if w <= h:
+                nw, nh = side, max(side, round(h * side / w))
+            else:
+                nw, nh = max(side, round(w * side / h)), side
+            img = img.resize((nw, nh), Image.BILINEAR)
+            left, top = (nw - side) // 2, (nh - side) // 2
+            img = img.crop((left, top, left + side, top + side))
+            os.makedirs(os.path.dirname(dst), exist_ok=True)
+            img.save(dst)
+        except OSError as e:
+            return f"skipped {src}: {e}"
+        return None
+
+    errors = 0
+    with cf.ThreadPoolExecutor(max_workers=max(1, int(args.workers))) as ex:
+        for msg in ex.map(one, jobs):
+            if msg:
+                errors += 1
+                print(msg, file=sys.stderr)
+    print(f"Resized {len(jobs) - errors}/{len(jobs)} images to "
+          f"{side}x{side} under {args.output_folder}")
+    # scripted callers must see failures: nonzero when anything skipped
+    return 1 if errors else 0
+
+
 # chart types, numbered exactly like the reference's
 # plot_training_log.py.example:15-24 so migration keeps muscle memory;
 # the types whose data this framework's logs don't record raise a named
@@ -504,6 +566,16 @@ def register(sub) -> None:
     p.add_argument("logfile")
     p.add_argument("output_dir", nargs="?", default=".")
     p.set_defaults(fn=cmd_parse_log)
+
+    rc = sub.add_parser("resize_and_crop_images")
+    rc.add_argument("input_folder")
+    rc.add_argument("output_folder")
+    rc.add_argument("--side", type=int, default=256,
+                    help="output square side (reference "
+                         "output_side_length)")
+    rc.add_argument("--workers", type=int, default=8,
+                    help="decode/encode thread pool size")
+    rc.set_defaults(fn=cmd_resize_and_crop_images)
 
     pm = sub.add_parser("plot_log")
     pm.add_argument("chart_type", type=int,

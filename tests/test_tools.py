@@ -148,6 +148,41 @@ def test_plot_log(tmp_path):
         cli.main(["plot_log", "9", str(out), str(log)])
 
 
+def test_resize_and_crop_images(tmp_path):
+    """resize_and_crop_images: short-side resize + center square crop
+    over a tree, mirroring the layout; corrupt files skipped with a
+    count (reference: tools/extra/resize_and_crop_images.py)."""
+    import numpy as np
+    import pytest
+    from PIL import Image
+
+    from sparknet_tpu import cli
+
+    src = tmp_path / "in" / "synset_a"
+    src.mkdir(parents=True)
+    rng = np.random.RandomState(0)
+    Image.fromarray(rng.randint(0, 255, (40, 60, 3), dtype=np.uint8)
+                    ).save(src / "wide.jpg")
+    Image.fromarray(rng.randint(0, 255, (64, 20, 3), dtype=np.uint8)
+                    ).save(src / "tall.png")
+    (src / "corrupt.jpg").write_bytes(b"not a jpeg")
+    out = tmp_path / "out"
+    # corrupt file present: good files convert, rc is NONZERO so
+    # scripted pipelines see the partial failure
+    assert cli.main(["resize_and_crop_images", str(tmp_path / "in"),
+                     str(out), "--side", "32"]) == 1
+    for name in ("wide.jpg", "tall.png"):
+        img = Image.open(out / "synset_a" / name)
+        assert img.size == (32, 32), name
+    assert not (out / "synset_a" / "corrupt.jpg").exists()
+    (src / "corrupt.jpg").unlink()
+    assert cli.main(["resize_and_crop_images", str(tmp_path / "in"),
+                     str(out), "--side", "32"]) == 0
+    with pytest.raises(SystemExit, match="no images"):
+        cli.main(["resize_and_crop_images", str(tmp_path / "empty"),
+                  str(out)])
+
+
 def test_parse_log_malformed_numbers_die_with_filename(tmp_path):
     """The log scanner honors the repo-wide parser contract: malformed
     input dies with a file-naming ValueError, never a bare conversion
