@@ -141,8 +141,15 @@ def _train_distributed(args, sp, net, batches=None) -> int:
 
     n = args.workers
     tau = args.tau or 10
+    if args.mode == "sync" and args.sync_history != "local":
+        # clean usage error, not the solver's ValueError traceback
+        raise SystemExit(
+            "--sync_history only applies to --mode average: sync mode "
+            "pmeans gradients every step, so per-worker history never "
+            "diverges")
     solver = DistributedSolver(sp, net_param=net, mesh=make_mesh(n),
-                               tau=tau, mode=args.mode)
+                               tau=tau, mode=args.mode,
+                               sync_history=args.sync_history)
     if args.weights:
         solver.load_weights(args.weights)
     if args.snapshot:
@@ -399,6 +406,12 @@ def main(argv=None) -> int:
                    help="local SGD steps between weight averages")
     t.add_argument("--mode", default="average",
                    choices=["average", "sync"])
+    t.add_argument("--sync_history", default="local",
+                   choices=["local", "average", "reset"],
+                   help="momentum history at each weight average: "
+                        "worker-local (reference semantics), averaged "
+                        "with the weights (fixes small-tau "
+                        "interference, DISTACC.md round 4), or reset")
     t.add_argument("--profile",
                    help="write a jax profiler trace to this directory")
     t.set_defaults(fn=cmd_train)

@@ -74,6 +74,7 @@ def test_train_distributed_verb(tmp_path, toy_npz, capsys):
     rc = cli.main(["train", "--solver", str(sp), "--data", toy_npz,
                    "--iterations", "4", "--batch", "8", "--workers", "4",
                    "--tau", "2", "--out", out,
+                   "--sync_history", "average",
                    "--profile", str(tmp_path / "trace")])
     assert rc == 0
     assert os.path.exists(out)
