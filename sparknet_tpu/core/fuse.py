@@ -55,6 +55,14 @@ def _copy_net_header(src: Message) -> Message:
     return out
 
 
+def _has_named_params(layer) -> bool:
+    """Layers sharing weights by `param { name: ... }` (e.g. the siamese
+    prototxts) key their params by that NAME, not `layer/slot` — a rewrite
+    that resizes or re-keys such a layer would desync every co-owner of
+    the shared blob, so both passes leave them untouched."""
+    return any(bool(p.name) for p in layer.params)
+
+
 def _copy_phase_rules(src_layer_msg: Message, dst: Message) -> None:
     """Carry include/exclude rules so TRAIN/TEST filtering stays
     aligned on rewrite-introduced layers."""
@@ -79,6 +87,8 @@ def fuse_sibling_1x1_convs(net_param: NetParameter
             continue
         cp = layer.convolution_param
         if tuple(cp.kernel) != (1, 1) or int(cp.group) != 1:
+            continue
+        if _has_named_params(layer):
             continue
         sig = (tuple(layer.bottoms), _geom_key(layer), _phase_key(layer),
                _mults_key(layer))
@@ -140,6 +150,9 @@ def fuse_sibling_1x1_convs(net_param: NetParameter
         new: Dict = {}
         pending: Dict[str, Dict[int, Tuple]] = {}
         for key, val in old_params.items():
+            if "/" not in key:  # name-shared blob: never a fused member
+                new[key] = val
+                continue
             lname, slot = key.rsplit("/", 1)
             if lname not in name_map:
                 new[key] = val
@@ -186,7 +199,8 @@ def pad_thin_conv_outputs(net_param: NetParameter, multiple: int = 128,
         o = int(layer.convolution_param.num_output)
         target = -(-o // multiple) * multiple
         if o % multiple == 0 or o > max_output or int(
-                layer.convolution_param.group) != 1:
+                layer.convolution_param.group) != 1 or \
+                _has_named_params(layer):
             out.add("layer", layer.msg)
             continue
         name = str(layer.name)
@@ -224,6 +238,9 @@ def pad_thin_conv_outputs(net_param: NetParameter, multiple: int = 128,
     def map_params(old_params: Dict) -> Dict:
         new: Dict = {}
         for key, val in old_params.items():
+            if "/" not in key:  # name-shared blob: never a padded member
+                new[key] = val
+                continue
             lname, slot = key.rsplit("/", 1)
             if lname not in pad_of:
                 new[key] = val

@@ -87,14 +87,18 @@ def write_synthetic_jpeg_shards(out_dir: str, *, n_imgs: int,
     from PIL import Image
 
     rng = np.random.RandomState(seed)
-    per = n_imgs // n_shards
+    # exactly n_imgs total: the remainder spreads one-per-shard from the
+    # front (17 over 2 -> 9+8), so callers computing batch counts from
+    # n_imgs are never short
+    per_shard = [n_imgs // n_shards + (1 if s < n_imgs % n_shards else 0)
+                 for s in range(n_shards)]
     label_lines = []
     shard_paths = []
     for s in range(n_shards):
         path = os.path.join(out_dir, f"shard_{s:02d}.tar")
         shard_paths.append(path)
         with tarfile.open(path, "w") as tf:
-            for i in range(per):
+            for i in range(per_shard[s]):
                 name = f"img_{s:02d}_{i:04d}.{ext}"
                 arr = rng.randint(0, 256, size=(size, size, 3),
                                   dtype=np.uint8)

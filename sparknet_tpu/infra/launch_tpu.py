@@ -132,7 +132,24 @@ def run_capture(cmd: List[str]) -> Tuple[int, str]:
     setup takes minutes and silence would look like a hang.  Tests
     inject fakes; --dry-run never calls it."""
     print(" ".join(shlex.quote(c) for c in cmd), flush=True)
-    if len(cmd) > 4 and cmd[4] == "describe":
+    # detect the describe verb structurally: the verb slot is the token
+    # right after "tpu-vm" in _base's layout, so a growing prefix
+    # ("gcloud alpha ...") keeps working and an OPERAND spelled
+    # "describe" (e.g. a cluster named that) cannot flip a streaming
+    # verb to captured.  Fallback when the anchor is gone: cmd[1] is
+    # the only candidate considered (a surface with its verb elsewhere
+    # must extend the anchor list, not rely on scanning).
+    if "tpu-vm" in cmd:
+        i = cmd.index("tpu-vm")
+        verb = cmd[i + 1] if i + 1 < len(cmd) else ""
+    else:
+        # the token right after the program name is the only candidate
+        # verb (a flag there means no verb): never scan further, so
+        # neither an operand nor a flag VALUE spelled "describe" can
+        # flip a streaming command to captured
+        verb = (cmd[1] if len(cmd) > 1
+                and not cmd[1].startswith("-") else "")
+    if verb == "describe":
         r = subprocess.run(cmd, capture_output=True, text=True)
         if r.returncode != 0 and r.stderr:
             sys.stderr.write(r.stderr[-2000:])

@@ -159,3 +159,67 @@ def test_bench_longctx_lm_cpu():
                                heads=4, block=32)
     assert r["longctx_seq_len"] == 128
     assert r["longctx_lm_tok_per_sec"] > 0
+
+
+def test_persist_leg_incremental_contract(tmp_path, monkeypatch):
+    """Per-leg last-good persistence (VERDICT r4 item 1): each completed
+    leg merges immediately; a partial record still carries the contract
+    keys; unknown (renamed-away) keys are pruned; stale flags never
+    survive a fresh merge."""
+    import json as _json
+
+    import bench
+
+    lg = tmp_path / "lastgood.json"
+    monkeypatch.setattr(bench, "LAST_GOOD", str(lg))
+
+    # partial run on a fresh checkout: first leg only
+    bench._persist_leg("longctx_lm", {"longctx_lm_tok_per_sec": 9.0})
+    rec = _json.loads(lg.read_text())
+    assert rec["metric"] == "alexnet_train_imgs_per_sec"
+    assert rec["unit"] == "img/s" and rec["value"] is None
+    assert rec["longctx_lm_tok_per_sec"] == 9.0
+    assert "longctx_lm" in rec["leg_utc"]
+
+    # a legacy record with a renamed-away key and a stale flag: the
+    # ghost key and the flag are dropped, other legs' numbers survive
+    lg.write_text(_json.dumps({
+        "metric": "alexnet_train_imgs_per_sec", "unit": "img/s",
+        "value": 111.0, "vs_baseline": 0.4, "mfu": 0.37,
+        "renamed_away_metric": 1.0,
+        "stale_due_to_unreachable_tpu": True, "stale_reason": "x"}))
+    bench._persist_leg("cifar_e2e", {"cifar_e2e_imgs_per_sec": 5.0})
+    rec = _json.loads(lg.read_text())
+    assert rec["value"] == 111.0 and rec["mfu"] == 0.37  # retained
+    assert rec["cifar_e2e_imgs_per_sec"] == 5.0          # fresh leg
+    assert "renamed_away_metric" not in rec
+    assert "stale_due_to_unreachable_tpu" not in rec
+
+
+def test_persist_leg_never_raises_on_malformed_record(tmp_path,
+                                                      monkeypatch):
+    """A well-formed-JSON-but-wrong-shape record (list, or non-dict
+    leg_utc) must not break persistence — and can never break the
+    ONE-JSON-line contract (persistence runs before the emit now)."""
+    import json as _json
+
+    import bench
+
+    lg = tmp_path / "lastgood.json"
+    monkeypatch.setattr(bench, "LAST_GOOD", str(lg))
+    lg.write_text("[1, 2, 3]")  # valid JSON, wrong shape
+    bench._persist_leg("cifar_e2e", {"cifar_e2e_imgs_per_sec": 5.0})
+    rec = _json.loads(lg.read_text())
+    assert rec["cifar_e2e_imgs_per_sec"] == 5.0 and rec["unit"] == "img/s"
+
+    lg.write_text(_json.dumps({"metric": "alexnet_train_imgs_per_sec",
+                               "unit": "img/s", "value": 1.0,
+                               "vs_baseline": 0.1, "leg_utc": "bogus"}))
+    bench._persist_leg("longctx_lm", {"longctx_lm_tok_per_sec": 2.0})
+    rec = _json.loads(lg.read_text())
+    assert rec["leg_utc"].keys() == {"longctx_lm"}
+
+    # unknown emitted fields self-register (and warn) instead of dying
+    bench._persist_leg("future", {"future_metric": 7.0})
+    rec = _json.loads(lg.read_text())
+    assert rec["future_metric"] == 7.0

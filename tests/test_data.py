@@ -274,3 +274,19 @@ def test_truncated_gz_idx_raises_value_error(tmp_path):
         read_idx(str(tmp_path / "cut.idx.gz"))
     # the intact twin still reads
     assert read_idx(str(ok)).tolist() == [0xAA, 0xBB]
+
+
+def test_synthetic_jpeg_shards_exact_count(tmp_path):
+    """n_imgs not divisible by n_shards must still write EXACTLY n_imgs
+    (remainder spread across leading shards), never silently round down
+    (ADVICE r4: 17 over 2 used to produce 16)."""
+    import tarfile
+
+    from sparknet_tpu.data.imagenet import write_synthetic_jpeg_shards
+
+    shard_paths, label_file = write_synthetic_jpeg_shards(
+        str(tmp_path), n_imgs=17, n_shards=2, size=16, n_classes=3)
+    counts = [len(tarfile.open(p).getmembers()) for p in shard_paths]
+    assert sum(counts) == 17 and counts == [9, 8]
+    with open(label_file) as f:
+        assert len([ln for ln in f if ln.strip()]) == 17

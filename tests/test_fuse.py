@@ -150,3 +150,70 @@ def test_pad_thin_conv_outputs_exact():
                                    != np.asarray(g).shape
                                    else np.asarray(g1[k]),
                                    np.asarray(g), rtol=1e-4, atol=1e-5)
+
+
+SHARED = """
+name: "shared_params"
+input: "data"
+input_shape { dim: 2 dim: 8 dim: 6 dim: 6 }
+layer { name: "sa" type: "Convolution" bottom: "data" top: "sa"
+  param { name: "shared_w" } param { name: "shared_b" }
+  convolution_param { num_output: 4 kernel_size: 1
+    weight_filler { type: "gaussian" std: 0.1 } } }
+layer { name: "sb" type: "Convolution" bottom: "data" top: "sb"
+  param { name: "shared_w" } param { name: "shared_b" }
+  convolution_param { num_output: 4 kernel_size: 1
+    weight_filler { type: "gaussian" std: 0.1 } } }
+layer { name: "free" type: "Convolution" bottom: "data" top: "free"
+  convolution_param { num_output: 3 kernel_size: 1
+    weight_filler { type: "gaussian" std: 0.1 } } }
+layer { name: "cat" type: "Concat" bottom: "sa" bottom: "sb" bottom: "free"
+  top: "cat" }
+"""
+
+
+def test_rewrites_skip_name_shared_params():
+    """Layers sharing weights via `param { name: ... }` (the siamese
+    pattern, caffe/examples/siamese/mnist_siamese_train_test.prototxt)
+    key params by the shared NAME — both rewrite passes must leave them
+    untouched, and both map_params must pass the '/‑less' keys through
+    (ADVICE r4: the pad pass crashed on exactly this input)."""
+    from sparknet_tpu.core.fuse import pad_thin_conv_outputs
+
+    net_p = caffe_pb.parse_net_text(SHARED)
+    # fusion: sa/sb are 1x1 siblings but name-shared => ineligible;
+    # 'free' alone is not a group
+    fused_p, fmap, groups = fuse_sibling_1x1_convs(net_p)
+    assert groups == []
+
+    net_p = caffe_pb.parse_net_text(SHARED)
+    pad_p, pmap, padded = pad_thin_conv_outputs(net_p, multiple=8)
+    assert padded == ["free"]  # sa/sb skipped, free still padded
+    net0 = Net(caffe_pb.parse_net_text(SHARED), "TEST")
+    p0 = {k: np.asarray(v) for k, v in net0.init_params(0).items()}
+    assert "shared_w" in p0  # name-keyed, no '/'
+    mapped = pmap(p0)
+    np.testing.assert_array_equal(mapped["shared_w"], p0["shared_w"])
+    # the padded net builds and its params line up
+    net1 = Net(pad_p, "TEST")
+    assert set(mapped) == set(net1.init_params(0))
+
+
+def test_pad_pass_handles_reference_siamese_prototxt():
+    """The exact ADVICE repro: the pass must run (not crash) on the
+    reference siamese net and leave its name-shared convs alone."""
+    import os
+
+    from tests.conftest import reference_path
+
+    rel = "caffe/examples/siamese/mnist_siamese_train_test.prototxt"
+    path = reference_path(rel)
+    if not os.path.exists(path):
+        pytest.skip(f"{rel} not in reference checkout")
+    from sparknet_tpu.core.fuse import pad_thin_conv_outputs
+
+    net_p = caffe_pb.load_net_prototxt(path)
+    pad_p, pmap, padded = pad_thin_conv_outputs(net_p, multiple=128)
+    shared = {str(l.name) for l in net_p.layers
+              if any(bool(p.name) for p in l.params)}
+    assert shared and not (set(padded) & shared)

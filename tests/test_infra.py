@@ -201,3 +201,21 @@ def test_compile_cache_env(tmp_path, monkeypatch):
         jax.config.update("jax_compilation_cache_dir", prev_dir)
         jax.config.update("jax_persistent_cache_min_compile_time_secs",
                           prev_min)
+
+
+def test_run_capture_detects_describe_structurally():
+    """ADVICE r4: capture-vs-stream must key on the verb SLOT (the token
+    after 'tpu-vm'), not a fixed argv index — a longer command prefix
+    must still capture describe output for wait_for_state to parse, and
+    an OPERAND spelled 'describe' (e.g. a cluster named that) must not
+    flip a streaming verb to captured."""
+    import sys
+
+    from sparknet_tpu.infra.launch_tpu import run_capture
+
+    rc, out = run_capture([sys.executable, "-c", "print('READY')",
+                           "tpu-vm", "describe", "--zone=z"])
+    assert (rc, out) == (0, "READY")
+    rc, out = run_capture([sys.executable, "-c", "print('HI')",
+                           "tpu-vm", "ssh", "describe"])
+    assert (rc, out) == (0, "")
