@@ -1,0 +1,141 @@
+"""Generate sparknet_tpu/proto/binary_schema.py from the reference
+caffe.proto.
+
+The binary wire format needs what the self-describing text format does
+not: the field NUMBER and scalar kind of every field
+(caffe/src/caffe/proto/caffe.proto).  Those numbers are the public
+serialization contract of .caffemodel / binaryproto files — interface
+parity, the binary sibling of the field-name knowledge already encoded
+in proto/caffe_pb.py's typed views.  This script transcribes them
+mechanically with a tiny proto2-subset parser so the table provably
+matches the .proto instead of being hand-copied.
+
+Run:  python scripts/gen_binary_schema.py \
+          [/root/reference/caffe/src/caffe/proto/caffe.proto] \
+          [sparknet_tpu/proto/binary_schema.py]
+
+The output module is committed; regenerating it is only needed if the
+schema subset ever has to grow.
+"""
+
+import re
+import sys
+
+SCALARS = {"int32", "int64", "uint32", "uint64", "sint32", "sint64",
+           "bool", "float", "double", "string", "bytes",
+           "fixed32", "fixed64", "sfixed32", "sfixed64"}
+
+FIELD_RE = re.compile(
+    r"^\s*(optional|repeated|required)\s+([\w.]+)\s+(\w+)\s*=\s*(\d+)"
+    r"\s*(\[[^\]]*\])?\s*;")
+ENUM_VAL_RE = re.compile(r"^\s*(\w+)\s*=\s*(\d+)\s*;")
+
+
+def strip_comments(text: str) -> str:
+    return re.sub(r"//[^\n]*", "", text)
+
+
+def parse(path: str):
+    """Returns (messages, enums):
+    messages: {msg: [(name, number, type, repeated, packed)]}
+    enums:    {qualified_enum: {NAME: value}}"""
+    text = strip_comments(open(path).read())
+    lines = text.splitlines()
+    messages, enums = {}, {}
+    stack = []  # (kind, name) for message/enum scopes
+    i = 0
+    while i < len(lines):
+        line = lines[i].strip()
+        m = re.match(r"^(message|enum)\s+(\w+)\s*\{?", line)
+        if m:
+            kind, name = m.group(1), m.group(2)
+            qual = ".".join([n for _, n in stack] + [name])
+            stack.append((kind, name))
+            if kind == "message":
+                messages.setdefault(qual, [])
+            else:
+                enums.setdefault(qual, {})
+            i += 1
+            continue
+        if line.startswith("}"):
+            if stack:
+                stack.pop()
+            i += 1
+            continue
+        if stack:
+            scope_kind = stack[-1][0]
+            qual = ".".join(n for _, n in stack)
+            if scope_kind == "enum":
+                em = ENUM_VAL_RE.match(line)
+                if em:
+                    enums[qual][em.group(1)] = int(em.group(2))
+            else:
+                fm = FIELD_RE.match(line)
+                if fm:
+                    label, ftype, fname, num, opts = fm.groups()
+                    packed = bool(opts and "packed" in opts)
+                    messages[qual].append(
+                        (fname, int(num), ftype, label == "repeated",
+                         packed))
+        i += 1
+    return messages, enums
+
+
+def resolve(ftype: str, scope: str, messages, enums) -> str:
+    """Field type -> kind tag: scalar name, 'enum:Qual' or 'msg:Qual'.
+    Proto scoping: innermost scope outward, then global."""
+    if ftype in SCALARS:
+        return ftype
+    parts = scope.split(".")
+    for depth in range(len(parts), -1, -1):
+        qual = ".".join(parts[:depth] + [ftype])
+        if qual in enums:
+            return f"enum:{qual}"
+        if qual in messages:
+            return f"msg:{qual}"
+    raise SystemExit(f"cannot resolve type {ftype!r} in scope {scope!r}")
+
+
+def main() -> None:
+    src = sys.argv[1] if len(sys.argv) > 1 else \
+        "/root/reference/caffe/src/caffe/proto/caffe.proto"
+    dst = sys.argv[2] if len(sys.argv) > 2 else \
+        "sparknet_tpu/proto/binary_schema.py"
+    messages, enums = parse(src)
+    out = []
+    out.append('"""Binary wire schema for the caffe.proto message set — '
+               'GENERATED\nby scripts/gen_binary_schema.py from the '
+               'reference caffe.proto\n(caffe/src/caffe/proto/caffe.proto); '
+               'do not edit by hand.\n\nMESSAGES: message -> field name -> '
+               '(number, kind, repeated, packed)\nwhere kind is a proto2 '
+               'scalar name, "enum:<Qualified>" or "msg:<Qualified>".\n'
+               'ENUMS: qualified enum -> {NAME: value}.\n"""\n')
+    out.append("MESSAGES = {")
+    for msg in sorted(messages):
+        fields = messages[msg]
+        if not fields:
+            out.append(f"    {msg!r}: {{}},")
+            continue
+        out.append(f"    {msg!r}: {{")
+        for fname, num, ftype, rep, packed in fields:
+            kind = resolve(ftype, msg, messages, enums)
+            out.append(f"        {fname!r}: ({num}, {kind!r}, {rep}, "
+                       f"{packed}),")
+        out.append("    },")
+    out.append("}\n")
+    out.append("ENUMS = {")
+    for en in sorted(enums):
+        out.append(f"    {en!r}: {{")
+        for name, val in enums[en].items():
+            out.append(f"        {name!r}: {val},")
+        out.append("    },")
+    out.append("}\n")
+    with open(dst, "w") as f:
+        f.write("\n".join(out))
+    n_fields = sum(len(v) for v in messages.values())
+    print(f"wrote {dst}: {len(messages)} messages / {n_fields} fields, "
+          f"{len(enums)} enums")
+
+
+if __name__ == "__main__":
+    main()

@@ -80,7 +80,11 @@ def build_solver(model: str, n_workers: int, tau: int, mesh=None,
     replaceDataLayers -> solver-with-inline-net -> instantiate.
     mode="sync" selects per-step gradient pmean (the P2PSync analogue)
     instead of τ-averaging; sync_history averages/resets the momentum
-    slots at each weight average (dist.py docstring)."""
+    slots at each weight average.  The default "local" is the
+    reference's WorkerStore behavior and right for this app's τ=10/50
+    operating points; pass "average" when running τ ≲ 10 (measured 8w
+    τ=1: 0.634 averaged vs 0.445 local — dist.py docstring /
+    DISTACC.md)."""
     net = caffe_pb.load_net_prototxt(
         os.path.join(proto_dir, f"cifar10_{model}_train_test.prototxt"))
     net = caffe_pb.replace_data_layers(net, batch_size, batch_size,
@@ -219,17 +223,23 @@ def run(num_workers: int, *, model: str = "quick", rounds: int = 100,
                 log("starting testing", i=r)
                 scores = solver.test()
                 accuracy = scores.get("accuracy", scores.get("acc", 0.0))
+                if "loss" in scores:  # test-net loss, for plot types 2/3
+                    log(f"test loss = {scores['loss']}", i=r)
                 log(f"%-age of test set correct: {accuracy}", i=r)
                 if target_accuracy and accuracy >= target_accuracy:
                     log(f"target accuracy {target_accuracy} reached", i=r)
                     return accuracy
             log("starting training", i=r)
             loss = solver.run_round(prefetch_next=r < rounds - 1)
+            log(f"round lr = "
+                f"{solver.current_lr():.8g}", i=r)
             log(f"round loss = {loss}", i=r)
             maybe_snapshot_round(solver, log, r, snapshot_every_rounds,
                                  snapshot_prefix)
         scores = solver.test()
         accuracy = scores.get("accuracy", scores.get("acc", 0.0))
+        if "loss" in scores:
+            log(f"test loss = {scores['loss']}")
         log(f"final %-age of test set correct: {accuracy}")
         return accuracy
     finally:

@@ -115,6 +115,8 @@ def run_from_store(num_workers: int, store: str, *, model: str = "quick",
     try:
         for r in range(rounds):
             loss = solver.run_round(prefetch_next=r < rounds - 1)
+            log(f"round lr = "
+                f"{solver.current_lr():.8g}", i=r)
             log(f"round loss = {loss}", i=r)
     finally:
         for f in feeds:

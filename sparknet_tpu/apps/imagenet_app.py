@@ -51,7 +51,9 @@ def build_solver(model: str, n_workers: int, tau: int, batch_size: int,
     256x256 images, 4x less host->device traffic and no host transform
     loop (the TPU-native data-path split, BENCH_NOTES.md).
     scan_unroll/sync_history pass through to DistributedSolver (CPU-mesh
-    studies and the momentum-at-sync option, dist.py docstring);
+    studies and the momentum-at-sync option, dist.py docstring — keep
+    the "local" default at this app's τ=50; switch to "average" only
+    for small-τ experiments, where local momentum measurably interferes);
     base_lr overrides the solver prototxt's lr BEFORE construction
     (downscaled-batch studies applying the linear scaling rule)."""
     d = MODEL_PROTO[model]
@@ -203,14 +205,20 @@ def run(num_workers: int, *, shards_dir: str = "", label_file: str = "",
         if r % test_every == 0:
             scores = solver.test()
             accuracy = scores.get("accuracy", 0.0)
+            if "loss" in scores:  # test-net loss, for plot types 2/3
+                log(f"test loss = {scores['loss']}", i=r)
             log(f"%-age of test set correct: {accuracy}", i=r)
         log("starting training", i=r)
         loss = solver.run_round(prefetch_next=r < rounds - 1)
+        log(f"round lr = "
+            f"{solver.current_lr():.8g}", i=r)
         log(f"round loss = {loss}", i=r)
         maybe_snapshot_round(solver, log, r, snapshot_every_rounds,
                              snapshot_prefix)
     scores = solver.test()
     accuracy = scores.get("accuracy", 0.0)
+    if "loss" in scores:
+        log(f"test loss = {scores['loss']}")
     log(f"final %-age of test set correct: {accuracy}")
     return accuracy
 

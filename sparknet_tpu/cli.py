@@ -107,6 +107,11 @@ def cmd_train(args) -> int:
             chunk = min(display, n - done)
             loss = solver.step(chunk)
             done = solver.iter
+            # lr of the last APPLIED update, logged each display
+            # interval like the reference solver (sgd_solver.cpp:
+            # 102-110) so parse_log/plot_log can chart it
+            print(f"Iteration {solver.iter}, lr = "
+                  f"{solver.current_lr():.8g}")
             print(f"Iteration {solver.iter}, loss = {loss:.6f}")
             if handler.get_requested_action().name == "STOP":
                 break
@@ -180,6 +185,8 @@ def _train_distributed(args, sp, net, batches=None) -> int:
     with _maybe_profile(args):
         while solver.iter < n_iters:
             loss = solver.run_round()
+            print(f"Iteration {solver.iter}, lr = "
+                  f"{solver.current_lr():.8g}")
             print(f"Iteration {solver.iter}, loss = {loss:.6f} "
                   f"(round {solver.round}, {n} workers, tau={solver.tau})")
             action = handler.get_requested_action()
@@ -408,10 +415,17 @@ def main(argv=None) -> int:
                    choices=["average", "sync"])
     t.add_argument("--sync_history", default="local",
                    choices=["local", "average", "reset"],
-                   help="momentum history at each weight average: "
-                        "worker-local (reference semantics), averaged "
-                        "with the weights (fixes small-tau "
-                        "interference, DISTACC.md round 4), or reset")
+                   help="momentum history at each weight average. Rule "
+                        "of thumb (DISTACC.md): tau<=10 -> 'average' "
+                        "(worker-local momentum fights the averaged "
+                        "weights at small tau: 8w tau=1 collapsed to "
+                        "0.445 local vs 0.634 averaged, and even tau=10 "
+                        "trailed at 0.581); tau>=50 or exact reference "
+                        "parity -> 'local' (the reference's WorkerStore "
+                        "behavior, harmless at its tau=10/50 operating "
+                        "points). 'reset' degenerates to momentum-free "
+                        "SGD at small tau; only for discarding stale "
+                        "history at very large tau")
     t.add_argument("--profile",
                    help="write a jax profiler trace to this directory")
     t.set_defaults(fn=cmd_train)

@@ -90,6 +90,14 @@ class DistributedSolver:
         history" — and "reset" zeroes it at each sync (momentum restart).
         Only meaningful for mode="average"; sync mode never diverges.
 
+        Picking: use "average" whenever τ is small (≲10) — measured 8w
+        τ=1: 0.634 averaged vs 0.445 local, and it even beats τ=10's
+        0.581 at matched iterations; keep the default "local" for
+        reference-exact parity or the reference's own τ=10/50 regimes,
+        where the interference is negligible.  "reset" degenerates to
+        momentum-free SGD at small τ (0.388) — reserve it for
+        discarding stale history at very large τ.
+
         scan_unroll: unroll factor for the τ-step lax.scan (True = fully).
         Keep the default (rolled) on TPU — compile time scales with the
         unroll and the rolled loop is already fast.  Set True when
@@ -377,6 +385,17 @@ class DistributedSolver:
         CifarApp windowed sampler) raises — see _check_prefetch_safe."""
         self._check_prefetch_safe(prefetch=bool(on))
         self._prefetch = bool(on)
+
+    def current_lr(self, it: Optional[int] = None) -> float:
+        """LR of the LAST APPLIED per-worker update (default it =
+        iter-1), the value the reference logs each display interval
+        (sgd_solver.cpp:102-110; parse_log.py:31).  Pass `it` to query
+        the schedule elsewhere."""
+        from ..solver.lr_policies import learning_rate
+
+        if it is None:
+            it = max(0, self.iter - 1)
+        return float(learning_rate(self.param, it))
 
     def run_round(self, prefetch_next: Optional[bool] = None) -> float:
         """One outer round: τ local steps per worker + weight average

@@ -351,6 +351,15 @@ class Solver:
         batch = source()
         return {k: jnp.asarray(v) for k, v in batch.items()}
 
+    def current_lr(self, it: Optional[int] = None) -> float:
+        """LR of the LAST APPLIED update (default it = iter-1), the value
+        the reference logs each display interval (sgd_solver.cpp:102-110;
+        parse_log.py:31 extracts it).  Pass `it` to query the schedule at
+        any other iteration."""
+        if it is None:
+            it = max(0, self.iter - 1)
+        return float(learning_rate(self.param, it))
+
     def step(self, n: int) -> float:
         """Run n iterations (reference: Solver::Step, solver.cpp:193-288;
         bridge: ccaffe.cpp:230-233 solver_step).  Returns last smoothed loss.

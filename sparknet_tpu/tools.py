@@ -39,6 +39,29 @@ def cmd_upgrade_solver_proto_text(args) -> int:
     return 0
 
 
+def cmd_upgrade_net_proto_binary(args) -> int:
+    """Upgrade a V0/V1 BINARY net proto to the modern schema, binary in
+    / binary out (reference: tools/upgrade_net_proto_binary.cpp)."""
+    from .proto import caffe_pb
+
+    net = caffe_pb.load_net_binaryproto(args.input)
+    caffe_pb.save_net_binaryproto(args.output, net)
+    print(f"Wrote upgraded NetParameter binary proto to {args.output}")
+    return 0
+
+
+def cmd_upgrade_solver_proto_binary(args) -> int:
+    """Binary sibling of upgrade_solver_proto_text (the reference ships
+    only the text tool; the binary verb completes the matrix over the
+    same upgrade path, upgrade_proto.cpp UpgradeSolverAsNeeded)."""
+    from .proto import caffe_pb
+
+    sp = caffe_pb.load_solver_binaryproto(args.input)
+    caffe_pb.save_solver_binaryproto(args.output, sp)
+    print(f"Wrote upgraded SolverParameter binary proto to {args.output}")
+    return 0
+
+
 def cmd_compute_image_mean(args) -> int:
     """Per-pixel mean of every image in an ArrayStore, written as
     mean.binaryproto (reference: tools/compute_image_mean.cpp; the
@@ -263,11 +286,16 @@ def cmd_detect(args) -> int:
 
 def _parse_log_rows(logfile: str):
     """Shared log scanner for parse_log/plot_log: returns
-    (train_rows, test_rows) of (iter, seconds, value).  Understands both
-    log formats this framework emits: the CLI's "Iteration N, loss = X"
-    lines and the apps' PhaseLogger lines "<elapsed>: iteration N: round
-    loss = X" / "… %-age of test set correct: X"
-    (CifarApp.scala:36-46 format)."""
+    (train_rows, test_rows) with reference-shaped columns —
+    train (iter, seconds, lr, loss), test (iter, seconds, lr, accuracy,
+    test_loss) — mirroring parse_log.py's NumIters/Seconds/LearningRate
+    + per-output layout (caffe/tools/extra/parse_log.py:27-31,96-101).
+    Understands both log formats this framework emits: the CLI's
+    "Iteration N, lr = X" / "Iteration N, loss = X" lines and the apps'
+    PhaseLogger lines "<elapsed>: iteration N: round lr = X" / "round
+    loss = X" / "test loss = X" / "… %-age of test set correct: X"
+    (CifarApp.scala:36-46 format).  Logs predating the lr/test-loss
+    lines parse fine: those columns read NaN."""
     import re
 
     try:
@@ -291,18 +319,28 @@ def _parse_log_rows(logfile: str):
                     r"(?P<msg>.*)$")
     cli_train = re.compile(r"^Iteration (?P<it>\d+), loss = "
                            r"(?P<loss>[-+.\deE]+)")
+    cli_lr = re.compile(r"^Iteration (?P<it>\d+), lr = "
+                        r"(?P<lr>[-+.\deE]+)")
+    nan = float("nan")
     train_rows = []
     test_rows = []
     last_it = 0
     last_sec = 0.0
+    last_lr = nan        # sticky, like the reference's learning_rate var
+    pending_test_loss = nan  # consumed by the next accuracy mark
     for lineno, line in enumerate(text, 1):
+        m = cli_lr.match(line)
+        if m:
+            last_it = int(m["it"])
+            last_lr = num(m["lr"], lineno, line)
+            continue
         m = cli_train.match(line)
         if m:
             # numeric columns throughout (loadtxt-compatible, like the
             # reference parse_log.py): CLI lines carry no elapsed time,
             # reuse the last seen
             last_it = int(m["it"])
-            train_rows.append((last_it, last_sec,
+            train_rows.append((last_it, last_sec, last_lr,
                                num(m["loss"], lineno, line)))
             continue
         m = pl.match(line)
@@ -311,15 +349,42 @@ def _parse_log_rows(logfile: str):
         sec = last_sec = num(m["sec"], lineno, line)
         it = last_it = int(m["it"]) if m["it"] else last_it
         msg = m["msg"]
+        lrm = re.match(r"round lr = ([-+.\deE]+)", msg)
+        if lrm:
+            last_lr = num(lrm.group(1), lineno, line)
+            continue
         lm = re.match(r"round loss = ([-+.\deE]+)", msg)
         if lm:
-            train_rows.append((it, sec, num(lm.group(1), lineno, line)))
+            train_rows.append((it, sec, last_lr,
+                               num(lm.group(1), lineno, line)))
+            # a test loss whose accuracy mark never arrived (run died
+            # mid-test, log resumed) must not attach to a LATER test:
+            # training resuming bounds the pairing
+            pending_test_loss = nan
+            continue
+        tlm = re.match(r"test loss = ([-+.\deE]+)", msg)
+        if tlm:
+            pending_test_loss = num(tlm.group(1), lineno, line)
             continue
         am = re.match(r"(?:final )?%-age of test set correct: "
                       r"([-+.\deE]+)", msg)
         if am:
-            test_rows.append((it, sec, num(am.group(1), lineno, line)))
-    return train_rows, test_rows
+            test_rows.append((it, sec, last_lr,
+                              num(am.group(1), lineno, line),
+                              pending_test_loss))
+            pending_test_loss = nan
+
+    def backfill_lr(rows, col=2):
+        # reference fix_initial_nan_learning_rate semantics
+        # (parse_log.py:113-124): rows before the first lr line inherit
+        # the first real value
+        first = next((r[col] for r in rows if r[col] == r[col]), None)
+        if first is None:
+            return rows
+        return [r[:col] + (first,) + r[col + 1:]
+                if r[col] != r[col] else r for r in rows]
+
+    return backfill_lr(train_rows), backfill_lr(test_rows)
 
 
 def cmd_parse_log(args) -> int:
@@ -331,10 +396,12 @@ def cmd_parse_log(args) -> int:
     train_rows, test_rows = _parse_log_rows(args.logfile)
     base = args.output_dir.rstrip("/") + "/" + \
         args.logfile.rsplit("/", 1)[-1]
-    for suffix, rows, cols in ((".train", train_rows,
-                                ["NumIters", "Seconds", "loss"]),
-                               (".test", test_rows,
-                                ["NumIters", "Seconds", "accuracy"])):
+    for suffix, rows, cols in (
+            (".train", train_rows,
+             ["NumIters", "Seconds", "LearningRate", "loss"]),
+            (".test", test_rows,
+             ["NumIters", "Seconds", "LearningRate", "accuracy",
+              "loss"])):
         with open(base + suffix, "w", newline="") as f:
             w = csv.writer(f)
             w.writerow(cols)
@@ -407,18 +474,19 @@ def cmd_resize_and_crop_images(args) -> int:
 
 
 # chart types, numbered exactly like the reference's
-# plot_training_log.py.example:15-24 so migration keeps muscle memory;
-# the types whose data this framework's logs don't record raise a named
-# error instead of plotting an empty chart
+# plot_training_log.py.example:15-24 so migration keeps muscle memory —
+# all 8 render now that the logs record lr ("round lr"/"Iteration N,
+# lr") and test loss ("test loss") per VERDICT r4 item 5.
+# (metric, x label, table, x column, y column)
 _PLOT_TYPES = {
-    0: ("Test accuracy", "Iters", "test", 0),
-    1: ("Test accuracy", "Seconds", "test", 1),
-    6: ("Train loss", "Iters", "train", 0),
-    7: ("Train loss", "Seconds", "train", 1),
-}
-_PLOT_UNSUPPORTED = {
-    2: "test loss", 3: "test loss",
-    4: "train learning rate", 5: "train learning rate",
+    0: ("Test accuracy", "Iters", "test", 0, 3),
+    1: ("Test accuracy", "Seconds", "test", 1, 3),
+    2: ("Test loss", "Iters", "test", 0, 4),
+    3: ("Test loss", "Seconds", "test", 1, 4),
+    4: ("Train learning rate", "Iters", "train", 0, 2),
+    5: ("Train learning rate", "Seconds", "train", 1, 2),
+    6: ("Train loss", "Iters", "train", 0, 3),
+    7: ("Train loss", "Seconds", "train", 1, 3),
 }
 # fixed-order categorical series colors (Okabe-Ito, CVD-validated);
 # never cycled or generated — one per log file in argv order
@@ -440,17 +508,12 @@ def cmd_plot_log(args) -> int:
     matplotlib.use("Agg")
     import matplotlib.pyplot as plt
 
-    if args.chart_type in _PLOT_UNSUPPORTED:
-        raise SystemExit(
-            f"chart type {args.chart_type} plots "
-            f"{_PLOT_UNSUPPORTED[args.chart_type]}, which this "
-            f"framework's logs do not record; supported types: "
-            f"{sorted(_PLOT_TYPES)} (same numbering as the reference's "
-            f"plot_training_log.py.example)")
     if args.chart_type not in _PLOT_TYPES:
         raise SystemExit(f"unknown chart type {args.chart_type}; "
-                         f"supported: {sorted(_PLOT_TYPES)}")
-    metric, xlabel, table, xcol = _PLOT_TYPES[args.chart_type]
+                         f"supported: {sorted(_PLOT_TYPES)} (same "
+                         f"numbering as the reference's "
+                         f"plot_training_log.py.example)")
+    metric, xlabel, table, xcol, ycol = _PLOT_TYPES[args.chart_type]
     if len(args.logfile) > len(_SERIES_COLORS):
         raise SystemExit(
             f"{len(args.logfile)} logs exceed the {len(_SERIES_COLORS)} "
@@ -461,11 +524,15 @@ def cmd_plot_log(args) -> int:
     for i, lf in enumerate(args.logfile):
         train_rows, test_rows = _parse_log_rows(lf)
         rows = train_rows if table == "train" else test_rows
+        # logs predating the lr/test-loss lines carry NaN in those
+        # columns; drop such rows so an old log skips with a warning
+        # instead of plotting an empty-looking series
+        rows = [r for r in rows if r[ycol] == r[ycol]]
         if not rows:
-            print(f"warning: {lf} has no {table} rows; skipped")
+            print(f"warning: {lf} has no {metric!r} rows; skipped")
             continue
         xs = [r[xcol] for r in rows]
-        ys = [r[2] for r in rows]
+        ys = [r[ycol] for r in rows]
         name = lf.rsplit("/", 1)[-1]
         ax.plot(xs, ys, linewidth=2, marker="o", markersize=4,
                 color=_SERIES_COLORS[i], label=name)
@@ -497,6 +564,16 @@ def register(sub) -> None:
     us.add_argument("input")
     us.add_argument("output")
     us.set_defaults(fn=cmd_upgrade_solver_proto_text)
+
+    ub = sub.add_parser("upgrade_net_proto_binary")
+    ub.add_argument("input")
+    ub.add_argument("output")
+    ub.set_defaults(fn=cmd_upgrade_net_proto_binary)
+
+    usb = sub.add_parser("upgrade_solver_proto_binary")
+    usb.add_argument("input")
+    usb.add_argument("output")
+    usb.set_defaults(fn=cmd_upgrade_solver_proto_binary)
 
     cm = sub.add_parser("compute_image_mean")
     cm.add_argument("db")

@@ -108,18 +108,46 @@ def test_parse_log(tmp_path, capsys):
     log.write_text(
         "0.52: rounds = 4, workers = 2, model = cifar10_quick\n"
         "1.10: iteration 0: starting training\n"
+        "4.90: iteration 0: round lr = 0.001\n"
         "5.25: iteration 0: round loss = 2.301\n"
+        "9.30: iteration 1: test loss = 2.05\n"
         "9.75: iteration 1: %-age of test set correct: 0.42\n"
+        "11.80: iteration 1: round lr = 0.0005\n"
         "12.00: iteration 1: round loss = 1.95\n"
         "30.10: final %-age of test set correct: 0.61\n"
+        "Iteration 50, lr = 0.00025\n"
         "Iteration 50, loss = 1.801\n")
     assert cli.main(["parse_log", str(log), str(tmp_path)]) == 0
     train = list(csv.reader(open(str(log) + ".train")))
     test = list(csv.reader(open(str(log) + ".test")))
-    assert train[0] == ["NumIters", "Seconds", "loss"]
-    assert [r[2] for r in train[1:]] == ["2.301", "1.95", "1.801"]
-    assert test[0] == ["NumIters", "Seconds", "accuracy"]
-    assert [r[2] for r in test[1:]] == ["0.42", "0.61"]
+    assert train[0] == ["NumIters", "Seconds", "LearningRate", "loss"]
+    assert [r[3] for r in train[1:]] == ["2.301", "1.95", "1.801"]
+    assert [r[2] for r in train[1:]] == ["0.001", "0.0005", "0.00025"]
+    assert test[0] == ["NumIters", "Seconds", "LearningRate",
+                       "accuracy", "loss"]
+    assert [r[3] for r in test[1:]] == ["0.42", "0.61"]
+    # first test mark carries its test loss; the final one has none
+    assert test[1][4] == "2.05" and test[2][4] == "nan"
+
+
+def test_parse_log_backfills_initial_lr(tmp_path):
+    """Rows logged before the first lr line inherit the first real lr
+    (reference fix_initial_nan_learning_rate, parse_log.py:113-124);
+    logs with no lr lines at all keep NaN columns and still parse."""
+    from sparknet_tpu.tools import _parse_log_rows
+
+    log = tmp_path / "training_log_1.txt"
+    log.write_text(
+        "5.25: iteration 0: round loss = 2.301\n"
+        "6.00: iteration 1: round lr = 0.01\n"
+        "7.25: iteration 1: round loss = 1.95\n")
+    train, _ = _parse_log_rows(str(log))
+    assert [r[2] for r in train] == [0.01, 0.01]
+
+    old = tmp_path / "training_log_2.txt"
+    old.write_text("5.25: iteration 0: round loss = 2.301\n")
+    train, _ = _parse_log_rows(str(old))
+    assert len(train) == 1 and train[0][2] != train[0][2]  # NaN
 
 
 def test_plot_log(tmp_path):
@@ -133,19 +161,28 @@ def test_plot_log(tmp_path):
 
     log = tmp_path / "training_log_7.txt"
     log.write_text(
+        "4.90: iteration 0: round lr = 0.001\n"
         "5.25: iteration 0: round loss = 2.301\n"
+        "9.30: iteration 1: test loss = 2.05\n"
         "9.75: iteration 1: %-age of test set correct: 0.42\n"
         "12.00: iteration 1: round loss = 1.95\n"
+        "29.80: test loss = 1.80\n"
         "30.10: final %-age of test set correct: 0.61\n")
-    out = tmp_path / "loss.png"
-    assert cli.main(["plot_log", "6", str(out), str(log)]) == 0
-    assert out.stat().st_size > 1000  # a real rendered image
+    # all 8 reference chart types render (VERDICT r4 item 5)
+    for ct in range(8):
+        out = tmp_path / f"chart_{ct}.png"
+        assert cli.main(["plot_log", str(ct), str(out), str(log)]) == 0
+        assert out.stat().st_size > 1000, ct  # a real rendered image
     out2 = tmp_path / "acc.png"
     assert cli.main(["plot_log", "0", str(out2), str(log), str(log)]) == 0
-    with pytest.raises(SystemExit, match="learning rate"):
-        cli.main(["plot_log", "4", str(out), str(log)])
     with pytest.raises(SystemExit, match="unknown chart type"):
-        cli.main(["plot_log", "9", str(out), str(log)])
+        cli.main(["plot_log", "9", str(out2), str(log)])
+    # an OLD log (no lr lines) asked for an lr chart: every file skips,
+    # and the no-rows path exits loudly instead of writing an empty png
+    old = tmp_path / "training_log_old.txt"
+    old.write_text("5.25: iteration 0: round loss = 2.301\n")
+    with pytest.raises(SystemExit, match="no plottable rows"):
+        cli.main(["plot_log", "4", str(tmp_path / "x.png"), str(old)])
 
 
 def test_resize_and_crop_images(tmp_path):
