@@ -112,6 +112,11 @@ def main():
         ("lrn_pallas_b64", 64, dict(lrn_impl="pallas")),
         ("lrn_matmul_b64", 64, dict(lrn_impl="matmul")),
         ("baseline_b128", 128, dict()),
+        # round 5: the measured b128 (0.2536 MFU) and b256 (0.2057)
+        # bracket a possible sweet spot — fill the gap (VERDICT r4
+        # item 3)
+        ("baseline_b160", 160, dict()),
+        ("baseline_b192", 192, dict()),
         ("baseline_b256", 256, dict()),
         ("maxpool_to_ave_b64", 64, dict(pool_to_ave=True)),
         ("no_dropout_b64", 64, dict(no_dropout=True)),

@@ -1,0 +1,200 @@
+"""Per-stage decomposition of the ImageNet ingest path (VERDICT r4
+item 2): where does throughput go between the native-JPEG feed and the
+device-resident compute rate?
+
+Stages, each emitted as one JSON line:
+
+  decode   — native libjpeg pool throughput, tar shards -> uint8 batches
+             (pure host; runs without a TPU, flagged if the box is
+             contended)
+  wire     — host->device transfer rate for uint8 256x256 batches, as an
+             amortized dependent chain with the separately measured
+             fetch floor subtracted (the layout_probe.py discipline:
+             sub-ms work would be swamped by the ~65-100 ms tunnel RTT)
+  compute  — the fused-transform device-resident step rate (crop/mirror/
+             mean + fwd/bwd/update in ONE program; bench.bench_model's
+             fused leg re-used at the ingest batch size)
+  e2e      — bench.bench_imagenet_native: the integrated tier with
+             one-round-ahead prefetch
+
+The bottleneck is then argmin over stages; reference analogue:
+preprocessing/ScaleAndConvert.scala:16-27 feeding base_data_layer.cpp's
+prefetch thread.
+
+Run (TPU window):   python scripts/ingest_probe.py
+Host-only stages:   python scripts/ingest_probe.py --stages decode
+"""
+
+import argparse
+import json
+import os
+import shutil
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+SIZE, CROP, BATCH = 256, 227, 64
+
+
+def emit(obj):
+    print(json.dumps(obj), flush=True)
+
+
+def stage_decode(n_imgs=512, n_shards=2):
+    """Native decode tier alone: shards -> resized uint8 batches."""
+    from sparknet_tpu.data import native_jpeg
+    from sparknet_tpu.data.imagenet import (ImageNetLoader,
+                                            write_synthetic_jpeg_shards)
+
+    if not native_jpeg.available():
+        import subprocess
+        subprocess.run(["make", "-s", "all"], cwd=os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "native"), check=True)
+    if not native_jpeg.available():
+        raise RuntimeError("native jpeg tier unavailable")
+    tmp = tempfile.mkdtemp(prefix="sparknet_ingest_probe_")
+    try:
+        shards, labels = write_synthetic_jpeg_shards(
+            tmp, n_imgs=n_imgs, n_shards=n_shards, size=SIZE, seed=0)
+        loader = ImageNetLoader(tmp)
+        # warm pass (page cache, pool spin-up), then timed epochs
+        for _ in loader.batches(labels, batch_size=BATCH, height=SIZE,
+                                width=SIZE, shards=shards):
+            pass
+        t0 = time.perf_counter()
+        n = 0
+        for imgs, _lab in loader.batches(labels, batch_size=BATCH,
+                                         height=SIZE, width=SIZE,
+                                         shards=shards):
+            n += imgs.shape[0]
+        dt = time.perf_counter() - t0
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+    emit({"stage": "decode", "imgs_per_sec": round(n / dt, 1),
+          "imgs": n, "batch": BATCH,
+          "note": "host-only; single-core contention deflates this on "
+                  "the dev box"})
+    return n / dt
+
+
+def stage_wire(reps=8):
+    """device_put rate for one uint8 ingest batch, fetch-floor
+    subtracted, escalating reps until work >> floor jitter.  Every
+    shipped buffer is bitwise-distinct (CLAUDE.md measurement
+    discipline: a tunnel that dedupes identical payloads would
+    otherwise inflate the rate)."""
+    import jax
+    import jax.numpy as jnp
+
+    from sparknet_tpu.utils.timers import fetch_floor
+
+    floor = fetch_floor()
+    rng = np.random.RandomState(0)
+    batches = [rng.randint(0, 256, size=(BATCH, 3, SIZE, SIZE)
+                           ).astype(np.uint8) for _ in range(4)]
+    # force materialization + a first transfer (allocator warm-up)
+    jax.device_put(batches[0]).block_until_ready()
+
+    @jax.jit
+    def touch(x, s):
+        # one byte of real dependency per batch so the transfer cannot
+        # be elided; sum would read every byte and bill compute
+        return s + x.reshape(-1)[0].astype(jnp.float32)
+
+    salt = 0
+
+    def run(reps):
+        nonlocal salt
+        t0 = time.perf_counter()
+        s = jnp.float32(0.0)
+        for i in range(reps):
+            b = batches[i % 4]
+            salt = (salt + 1) % 251
+            b[0, 0, 0, 0] = salt  # bitwise-distinct payload per rep
+            s = touch(jax.device_put(b), s)
+        float(s)
+        return time.perf_counter() - t0
+
+    while True:
+        dt = run(reps)
+        if dt > max(20 * floor, 0.5) or reps >= 512:
+            break
+        reps *= 2
+    per_batch = (dt - floor) / reps
+    mb = batches[0].nbytes / 1e6
+    emit({"stage": "wire", "mbytes_per_sec": round(mb / per_batch, 1),
+          "imgs_per_sec": round(BATCH / per_batch, 1),
+          "batch_mbytes": round(mb, 1), "reps": reps,
+          "fetch_floor_ms": round(floor * 1e3, 1)})
+    return BATCH / per_batch
+
+
+def stage_compute():
+    """Fused-transform device-resident training rate at the ingest
+    batch size (uint8 in, crop/mirror/mean inside the jit) — ONLY that
+    leg, not all four of bench_model's (tunnel windows are bounded;
+    don't spend them on legs this probe doesn't read)."""
+    import jax
+
+    import bench
+    from sparknet_tpu.ops.device_transform import make_device_transformer
+
+    rng = np.random.RandomState(0)
+    pool_np = rng.randint(0, 256, size=(BATCH, 3, SIZE, SIZE)
+                          ).astype(np.uint8)
+    tf = make_device_transformer(
+        crop_size=CROP, mirror=True,
+        mean_image=pool_np.mean(axis=0, dtype=np.float32), phase="TRAIN")
+    _net, step, params, state = bench.build(
+        "/root/reference/caffe/models/bvlc_alexnet", BATCH, transform=tf)
+    pool = {"data": jax.device_put(pool_np),
+            "label": jax.device_put(rng.randint(0, 1000, size=(BATCH,))
+                                    .astype(np.int32))}
+    rate = bench.measure_chain(step, params, state, lambda: pool, BATCH)
+    emit({"stage": "compute", "imgs_per_sec": round(rate, 1),
+          "batch": BATCH})
+    return rate
+
+
+def stage_e2e():
+    import bench
+
+    r = bench.bench_imagenet_native(batch=BATCH)
+    emit({"stage": "e2e",
+          "imgs_per_sec": r["imagenet_native_fed_imgs_per_sec"],
+          "batch": BATCH})
+    return r["imagenet_native_fed_imgs_per_sec"]
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--stages", default="decode,wire,compute,e2e")
+    a = p.parse_args()
+    from sparknet_tpu.utils.compile_cache import (apply_platform_env,
+                                                  maybe_enable_compile_cache)
+
+    apply_platform_env()
+    maybe_enable_compile_cache()
+    stages = {"decode": stage_decode, "wire": stage_wire,
+              "compute": stage_compute, "e2e": stage_e2e}
+    wanted = [s for s in a.stages.split(",") if s]
+    bad = [s for s in wanted if s not in stages]
+    if bad:
+        raise SystemExit(f"unknown stage(s) {bad}; choose from "
+                         f"{sorted(stages)}")
+    rates = {}
+    for st in wanted:
+        rates[st] = stages[st]()
+    if len(rates) > 1:
+        emit({"stage": "verdict",
+              "bottleneck": min(rates, key=rates.get),
+              "rates": {k: round(v, 1) for k, v in rates.items()}})
+
+
+if __name__ == "__main__":
+    main()

@@ -7,8 +7,11 @@ dev platform) cancels.  Decides whether an internal-NHWC layout pass is
 worth building.
 """
 
+import os
 import sys
 import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import numpy as np
 
@@ -25,31 +28,23 @@ SHAPES = [
     ("goog_conv2", 64, 64, 56, 56, 192, 3, 1, 1),
     ("goog_3a_3x3", 64, 96, 28, 28, 128, 3, 1, 1),
     ("goog_4a_1x1", 64, 480, 14, 14, 192, 1, 1, 0),
+    # round 5: the b128 bench config (VERDICT r4 item 3 NHWC re-check
+    # at the batch the MFU number is quoted at)
+    ("goog_conv1_b128", 128, 3, 224, 224, 64, 7, 2, 3),
+    ("goog_conv2_b128", 128, 64, 56, 56, 192, 3, 1, 1),
+    ("goog_3a_3x3_b128", 128, 96, 28, 28, 128, 3, 1, 1),
+    ("goog_5x5_red_b128", 128, 480, 14, 14, 24, 1, 1, 0),
 ]
 
 ITERS = 100
 
 
 def _fetch_floor():
-    """Median seconds to dispatch + VALUE-fetch a trivial program — the
-    fixed per-measurement cost (tunnel RTT) subtracted from every
-    window.  Measured, not assumed: on the tunneled dev platform it is
-    ~100 ms; on a local backend ~0.3 ms."""
-    @jax.jit
-    def tiny(s):
-        return s + 1.0
+    """One shared implementation (utils/timers.fetch_floor) so every
+    probe's RTT calibration stays in lockstep."""
+    from sparknet_tpu.utils.timers import fetch_floor
 
-    s = jnp.float32(0.0)
-    s = tiny(s)
-    float(s)  # warm/compile
-    ts = []
-    for _ in range(3):
-        t0 = time.perf_counter()
-        s = tiny(s)
-        float(s)
-        ts.append(time.perf_counter() - t0)
-    ts.sort()
-    return ts[1]
+    return fetch_floor()
 
 
 def chain_time(make_loss, x, wt, floor):

@@ -68,3 +68,28 @@ def differenced_chain_s(run_chain, n: int, *, windows: int = 3,
         per_call.append((long - short) / n)
     per_call.sort()
     return per_call[len(per_call) // 2]
+
+
+def fetch_floor(samples: int = 3) -> float:
+    """Median seconds to dispatch + VALUE-fetch a trivial jitted program
+    — the fixed per-measurement cost (tunnel RTT on the dev platform,
+    ~100 ms; ~0.3 ms local) that sub-ms measurements subtract
+    (BENCH_NOTES.md round-3 continuation; the scripts/layout_probe.py
+    calibration, hoisted here so every probe shares one copy)."""
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def tiny(s):
+        return s + 1.0
+
+    s = jnp.float32(0.0)
+    float(tiny(s))  # warm/compile
+    ts = []
+    for _ in range(max(3, samples)):
+        t0 = time.perf_counter()
+        s = tiny(s)
+        float(s)
+        ts.append(time.perf_counter() - t0)
+    ts.sort()
+    return ts[len(ts) // 2]
