@@ -366,6 +366,27 @@ def bench_longctx_lm(seq_len: int = 16384, n_layers: int = 4,
     return out
 
 
+def ensure_native_jpeg() -> None:
+    """Build + verify the libjpeg pool — silently falling back to the
+    PIL path would measure the wrong tier.  Build/toolchain failures
+    surface as ONE "native jpeg" RuntimeError shape so every caller
+    (main's guard, the CI skip, scripts/ingest_probe.py) handles the
+    same error."""
+    import subprocess
+
+    native_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                              "native")
+    try:
+        subprocess.run(["make", "-s", "all"], cwd=native_dir, check=True)
+    except (subprocess.CalledProcessError, FileNotFoundError, OSError) as e:
+        raise RuntimeError(f"native jpeg tier build failed: {e}") from e
+    from sparknet_tpu.data import native_jpeg
+
+    if not native_jpeg.available():
+        raise RuntimeError("native jpeg decoder unavailable after build — "
+                           "refusing to bench the fallback path as native")
+
+
 def bench_imagenet_native(rounds: int = 3, tau: int = 5, batch: int = 64,
                           size: int = 256, crop: int = 227,
                           n_imgs: int = 512, n_shards: int = 2,
@@ -380,26 +401,11 @@ def bench_imagenet_native(rounds: int = 3, tau: int = 5, batch: int = 64,
     preprocessing/ScaleAndConvert.scala:16-27 + base_data_layer.cpp
     prefetch feeding the solver loop)."""
     import shutil
-    import subprocess
     import tempfile
 
     import numpy as np
 
-    # make sure the libjpeg pool is built — silently falling back to the
-    # PIL path would measure the wrong tier.  Build/toolchain failures
-    # surface as the same "native jpeg" RuntimeError so callers (main's
-    # guard, the CI skip) handle one error shape.
-    native_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                              "native")
-    try:
-        subprocess.run(["make", "-s", "all"], cwd=native_dir, check=True)
-    except (subprocess.CalledProcessError, FileNotFoundError, OSError) as e:
-        raise RuntimeError(f"native jpeg tier build failed: {e}") from e
-    from sparknet_tpu.data import native_jpeg
-
-    if not native_jpeg.available():
-        raise RuntimeError("native jpeg decoder unavailable after build — "
-                           "refusing to bench the fallback path as native")
+    ensure_native_jpeg()
 
     from sparknet_tpu.apps.imagenet_app import build_solver
     from sparknet_tpu.data.imagenet import (ImageNetLoader,

@@ -44,22 +44,32 @@ import numpy as np
 
 def run_point(nw: int, tau, iters: int, xtr, ytr, test_batches,
               mean, emit, *, test_interval: int, num_test_batches: int,
-              lr1_iters: int = 0, sync_history: str = "local") -> float:
+              lr1_iters: int = 0, sync_history: str = "local",
+              dcn_interval: int = 1) -> float:
     """Train one (n_workers, τ) configuration; returns final accuracy.
     tau="sync" selects per-step gradient pmean (mode="sync", the
     P2PSync analogue) instead of τ-step weight averaging.
     sync_history="average"/"reset" pmeans/zeroes the momentum history at
-    each weight average (dist.py docstring — the τ=1 interference fix)."""
+    each weight average (dist.py docstring — the τ=1 interference fix).
+    dcn_interval>1 runs the two-tier (dcn, workers) mesh: 2 slices of
+    nw/2, ICI-averaging every round and crossing the dcn axis only
+    every dcn_interval-th round (dist.py two-level averaging)."""
     from sparknet_tpu.apps.cifar_app import WorkerFeed, build_solver
     from sparknet_tpu.data import partition as part
 
     mode = "sync" if tau == "sync" else "average"
     if mode == "sync":
         tau = 1
+    mesh = None
+    if dcn_interval > 1:
+        from sparknet_tpu.parallel.mesh import make_hierarchical_mesh
+
+        mesh = make_hierarchical_mesh(2, nw // 2)
     # scan_unroll=True: XLA:CPU loses its fast conv kernels inside scan
     # bodies (dist.py docstring); unrolling the τ loop is ~10x here
     solver = build_solver("quick", nw, tau, scan_unroll=True, mode=mode,
-                          sync_history=sync_history)
+                          sync_history=sync_history, mesh=mesh,
+                          dcn_interval=dcn_interval)
     shards = part.partition(xtr, ytr, nw)
     feeds = [WorkerFeed(x, y, mean, 100, tau, seed=100 + w)
              for w, (x, y) in enumerate(shards)]
@@ -87,8 +97,9 @@ def run_point(nw: int, tau, iters: int, xtr, ytr, test_batches,
                 scores = solver.test()
                 acc = float(scores.get("accuracy", 0.0))
                 emit(dict(event="test", n_workers=nw,
-                  tau=("sync" if mode == "sync" else tau),
-                  sync_history=sync_history, stage=stage,
+                          tau=("sync" if mode == "sync" else tau),
+                          sync_history=sync_history, stage=stage,
+                          dcn_interval=dcn_interval,
                           round=solver.round, iter=solver.iter,
                           images=solver.iter * 100 * nw,
                           loss=round(float(loss), 4),
@@ -158,42 +169,61 @@ def main() -> None:
               data_gen_s=round(time.time() - t0, 1), bayes_ceiling=0.91))
 
     def parse_spec(spec):
-        """nw:tau, tau one of: int, 'sync', or int+'m'/'r' — 'm' averages
-        the momentum history at each sync (sync_history='average'),
-        'r' resets it (sync_history='reset')."""
-        nw_s, tau_s = spec.split(":")
+        """nw:tau[:dK] — tau one of: int, 'sync', or int+'m'/'r' ('m'
+        averages the momentum history at each sync, 'r' resets it);
+        an optional ':dK' runs the two-tier (dcn, workers) mesh with
+        dcn_interval=K (2 slices of nw/2), e.g. 8:1m:d2."""
+        parts = spec.split(":")
+        if not 2 <= len(parts) <= 3:
+            raise SystemExit(f"bad point spec {spec!r}: want "
+                             f"nw:tau[m|r][:dK]")
+        nw_s, tau_s = parts[0], parts[1]
+        dcn = 1
+        if len(parts) > 2:
+            if not (parts[2].startswith("d") and parts[2][1:].isdigit()):
+                raise SystemExit(f"bad point spec {spec!r}: third field "
+                                 f"must be dK (dcn_interval)")
+            dcn = int(parts[2][1:])
+        if dcn > 1 and (int(nw_s) < 4 or int(nw_s) % 2):
+            raise SystemExit(f"bad point spec {spec!r}: dK needs an even "
+                             f"nw >= 4 (mesh is 2 slices of nw/2)")
         if tau_s == "sync":
-            return int(nw_s), "sync", "local"
+            if dcn > 1:
+                raise SystemExit(f"bad point spec {spec!r}: sync mode "
+                                 f"pmeans globally every step — "
+                                 f"dcn_interval has no effect there")
+            return int(nw_s), "sync", "local", dcn
         hist = "local"
         if tau_s.endswith("m"):
             tau_s, hist = tau_s[:-1], "average"
         elif tau_s.endswith("r"):
             tau_s, hist = tau_s[:-1], "reset"
-        return int(nw_s), int(tau_s), hist
+        return int(nw_s), int(tau_s), hist, dcn
 
     finals = {}
     for spec in [s for s in a.points.split(",") if s]:
-        nw, tau, hist = parse_spec(spec)
+        nw, tau, hist, dcn = parse_spec(spec)
         t0 = time.time()
         acc = run_point(nw, tau, a.iters, xtr, ytr, test_batches, mean,
                         emit, test_interval=a.test_interval,
                         num_test_batches=a.test_batches,
-                        sync_history=hist)
+                        sync_history=hist, dcn_interval=dcn)
         finals[spec] = acc
         emit(dict(event="point_done", n_workers=nw, tau=tau,
-                  sync_history=hist,
+                  sync_history=hist, dcn_interval=dcn,
                   iters=a.iters, final_accuracy=round(acc, 4),
                   wall_s=round(time.time() - t0, 1)))
 
     if a.full_point:
-        nw, tau, hist = parse_spec(a.full_point)
+        nw, tau, hist, dcn = parse_spec(a.full_point)
         t0 = time.time()
         acc = run_point(nw, tau, a.full_iters, xtr, ytr, test_batches,
                         mean, emit, test_interval=500,
                         num_test_batches=len(test_batches),
-                        lr1_iters=a.full_lr1_iters, sync_history=hist)
+                        lr1_iters=a.full_lr1_iters, sync_history=hist,
+                        dcn_interval=dcn)
         emit(dict(event="full_done", n_workers=nw, tau=tau,
-                  sync_history=hist,
+                  sync_history=hist, dcn_interval=dcn,
                   iters=a.full_iters + a.full_lr1_iters,
                   final_accuracy=round(acc, 4),
                   bayes_ceiling=0.91,

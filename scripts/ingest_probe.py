@@ -46,17 +46,11 @@ def emit(obj):
 
 def stage_decode(n_imgs=512, n_shards=2):
     """Native decode tier alone: shards -> resized uint8 batches."""
-    from sparknet_tpu.data import native_jpeg
+    import bench
     from sparknet_tpu.data.imagenet import (ImageNetLoader,
                                             write_synthetic_jpeg_shards)
 
-    if not native_jpeg.available():
-        import subprocess
-        subprocess.run(["make", "-s", "all"], cwd=os.path.join(
-            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
-            "native"), check=True)
-    if not native_jpeg.available():
-        raise RuntimeError("native jpeg tier unavailable")
+    bench.ensure_native_jpeg()
     tmp = tempfile.mkdtemp(prefix="sparknet_ingest_probe_")
     try:
         shards, labels = write_synthetic_jpeg_shards(

@@ -83,10 +83,13 @@ def fetch_floor(samples: int = 3) -> float:
     def tiny(s):
         return s + 1.0
 
-    s = jnp.float32(0.0)
-    float(tiny(s))  # warm/compile
+    # warm/compile, THREADING s so every later dispatch has bitwise-
+    # distinct args (CLAUDE.md: a dedup-capable tunnel must never see a
+    # repeat of the exact call just executed)
+    s = tiny(jnp.float32(0.0))
+    float(s)
     ts = []
-    for _ in range(max(3, samples)):
+    for _ in range(samples):
         t0 = time.perf_counter()
         s = tiny(s)
         float(s)
