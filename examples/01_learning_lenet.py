@@ -67,11 +67,19 @@ def main():
     with open(log_path, "w") as log:
         for it in range(0, a.iters, 10):
             loss = solver.step(10)
+            # lr first, loss second — the order parse_log attributes
+            # the sticky lr to the row (sgd_solver.cpp-style display)
+            log.write(f"{time.time() - t0:.2f}: iteration {solver.iter}: "
+                      f"round lr = {solver.current_lr():.6g}\n")
             line = (f"{time.time() - t0:.2f}: iteration {solver.iter}: "
                     f"round loss = {loss:.4f}")
             print(line)
             log.write(line + "\n")
             scores = solver.test()
+            if "loss" in scores:
+                log.write(f"{time.time() - t0:.2f}: iteration "
+                          f"{solver.iter}: test loss = "
+                          f"{scores['loss']:.4f}\n")
             log.write(f"{time.time() - t0:.2f}: iteration {solver.iter}: "
                       f"%-age of test set correct: "
                       f"{scores.get('acc', scores.get('accuracy', 0)):.4f}"
@@ -90,6 +98,8 @@ def main():
     print(f"training log for plot_log/parse_log: {log_path}")
     print("chart it:  python -m sparknet_tpu.cli plot_log 6 loss.png "
           + log_path)
+    print("lr decay (the inv policy curve):  "
+          "python -m sparknet_tpu.cli plot_log 4 lr.png " + log_path)
     return 0
 
 

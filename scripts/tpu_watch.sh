@@ -1,9 +1,14 @@
 #!/usr/bin/env bash
 # Round-5 measurement watcher (VERDICT r4 item 1): probe the axon tunnel
-# on a fixed period and, on the FIRST healthy window, run the priority
-# chain unattended, in order:
+# on a fixed period and, on healthy windows, run the priority chain
+# unattended, in order:
 #   1. full bench chain  -> fresh per-leg BENCH_LAST_GOOD.json + stdout line
 #   2. GoogLeNet pad A/B -> googlenet_pad_ab.jsonl (interleaved baseline/pad)
+#   3. ingest decomposition -> ingest_probe.jsonl (VERDICT r4 item 2)
+#   4. XLA lever scan    -> googlenet_levers.jsonl (VERDICT r4 item 3)
+# Each stage re-probes before starting and records its OWN done flag
+# only on success, so a wedge mid-chain leaves the remaining stages
+# armed for the next window instead of silently skipping them.
 # All output appends to $LOG with "WATCH <utc> <event>" state lines so a
 # supervising session can poll with tail/grep.  The probe is a subprocess
 # with a hard timeout because a wedged tunnel HANGS jax.devices() rather
@@ -26,29 +31,57 @@ print("probe value:", float(jax.jit(lambda a: (a @ a).sum())(x)), flush=True)
 EOF
 }
 
-DONE="${TPU_WATCH_DONE_FLAG:-$REPO/.tpu_watch_chain_done}"
+FLAGDIR="${TPU_WATCH_FLAG_DIR:-$REPO/.tpu_watch_flags}"
+mkdir -p "$FLAGDIR"
+
+# stage NAME CMD... — runs CMD unless NAME already succeeded; re-probes
+# first (the prior stage may have consumed the window); flags success
+# only on rc==0 so a wedged/partial stage re-arms for the next window
+stage() {
+  local name="$1"; shift
+  [ -e "$FLAGDIR/$name" ] && return 0
+  if ! probe; then
+    say "$name skipped: window closed"
+    return 1
+  fi
+  say "$name start"
+  "$@"
+  local rc=$?
+  say "$name done rc=$rc"
+  if [ "$rc" -eq 0 ]; then touch "$FLAGDIR/$name"; fi
+  return $rc
+}
+
+run_bench() {
+  ( cd "$REPO" && SPARKNET_BENCH_WAIT_S=120 timeout 5400 \
+      python bench.py >"$REPO/bench_r05_stdout.json" 2>>"$LOG" )
+  local rc=$?
+  say "bench record: $(head -c 2000 "$REPO/bench_r05_stdout.json" 2>/dev/null)"
+  # bench exits 0 even when it emits a stale fallback record — a stale
+  # line must NOT mark the stage done
+  if [ "$rc" -eq 0 ] && \
+     ! grep -q stale_due_to "$REPO/bench_r05_stdout.json" 2>/dev/null; then
+    return 0
+  fi
+  return 1
+}
+
 say "watcher start period=${PERIOD}s probe_timeout=${PROBE_TIMEOUT}s"
 while :; do
   if probe; then
     say "HEALTHY window open"
-    if [ ! -e "$DONE" ]; then
-      # the chain runs ONCE per watcher lifetime (rm the flag to rearm):
-      # bounded windows are scarce — don't burn a later window repeating
-      # measurements the session already has
-      say "bench chain start"
-      ( cd "$REPO" && SPARKNET_BENCH_WAIT_S=120 timeout 5400 \
-          python bench.py >"$REPO/bench_r05_stdout.json" 2>>"$LOG" )
-      rc=$?
-      say "bench chain done rc=$rc $(cat "$REPO/bench_r05_stdout.json" 2>/dev/null | head -c 2000)"
-      say "pad A/B start"
-      ( cd "$REPO" && timeout 5400 python scripts/googlenet_profile.py \
-          baseline_b128 pad32_b128 baseline_b128 pad128_b128 \
-          baseline_b128 pad32_b128 pad128_b128 \
-          >>"$REPO/googlenet_pad_ab.jsonl" 2>>"$LOG" )
-      say "pad A/B done rc=$?"
-      touch "$DONE"
-      say "priority chain complete; continuing to monitor window state"
-    fi
+    stage bench run_bench &&
+    stage pad_ab bash -c "cd '$REPO' && timeout 5400 \
+        python scripts/googlenet_profile.py \
+        baseline_b128 pad32_b128 baseline_b128 pad128_b128 \
+        baseline_b128 pad32_b128 pad128_b128 \
+        >>'$REPO/googlenet_pad_ab.jsonl' 2>>'$LOG'" &&
+    stage ingest bash -c "cd '$REPO' && timeout 2400 \
+        python scripts/ingest_probe.py \
+        >>'$REPO/ingest_probe.jsonl' 2>>'$LOG'" &&
+    stage levers bash -c "cd '$REPO' && timeout 20000 \
+        bash scripts/googlenet_lever_scan.sh >>'$LOG' 2>&1" &&
+    say "priority chain complete; continuing to monitor window state"
     # after the chain, keep recording window health at the same cadence so
     # the session knows whether follow-up studies (lever scan, ingest
     # decomposition) have a live window to use
