@@ -132,10 +132,19 @@ class WorkerStream:
         return {"data": self.tf(self.images[sel]),
                 "label": self.labels[sel]}
 
+    def fast_forward(self, n_pulls):
+        """Advance the index RNG past `n_pulls` batches so a resumed run
+        draws the same remaining sequence the unkilled run would have
+        (accuracy_run.py WorkerFeed.fast_forward pattern).  The transform's
+        crop/mirror RNG is not replayed — batch CONTENT matches, per-image
+        augmentation does not; good enough for an accuracy study."""
+        for _ in range(n_pulls):
+            self.rng.randint(0, len(self.labels), size=self.batch)
+
 
 def run_point(nw, tau, sync_history, iters, xtr, ytr, test_batches, mean,
               emit, *, test_interval, num_test_batches, batch=BATCH,
-              base_lr=None):
+              base_lr=None, snapshot_path="", resume=False):
     from sparknet_tpu.apps.imagenet_app import build_solver
     from sparknet_tpu.data import partition as part
     from sparknet_tpu.data.transform import DataTransformer
@@ -154,6 +163,20 @@ def run_point(nw, tau, sync_history, iters, xtr, ytr, test_batches, mean,
     shards = part.partition(xtr, ytr, nw)
     feeds = [WorkerStream(x, y, train_tf, batch, seed=100 + w)
              for w, (x, y) in enumerate(shards)]
+
+    if resume and snapshot_path and os.path.exists(snapshot_path):
+        # per-worker params + momentum come back exactly (dist.py
+        # snapshot/restore); each feed fast-forwards past the batches the
+        # completed rounds consumed (one pull per worker per iteration).
+        # Test marks between the snapshot and the kill are re-run and
+        # re-emitted — for a given (point, iter) the LAST record in
+        # --out supersedes earlier ones.
+        solver.restore(snapshot_path)
+        for f in feeds:
+            f.fast_forward(solver.iter)
+        emit(dict(event="resume", n_workers=nw, tau=tau,
+                  sync_history=sync_history, iter=solver.iter,
+                  snapshot=snapshot_path))
     solver.set_train_data(feeds)
 
     state = {"i": 0}
@@ -165,6 +188,16 @@ def run_point(nw, tau, sync_history, iters, xtr, ytr, test_batches, mean,
 
     solver.set_test_data(test_source, num_test_batches)
 
+    def save_snapshot():
+        if not snapshot_path:
+            return
+        # pid-unique tmp: two processes sharing a snapshot dir (e.g. a
+        # stray orphan + its relaunch) must not consume each other's
+        # half-written file (verified failure mode: os.replace
+        # FileNotFoundError killed the sibling run)
+        tmp = solver.snapshot(f"{snapshot_path}.tmp{os.getpid()}")
+        os.replace(tmp, snapshot_path)  # atomic: mid-write kill keeps old
+
     acc = 0.0
     rounds = iters // tau
     if rounds < 1:
@@ -173,7 +206,13 @@ def run_point(nw, tau, sync_history, iters, xtr, ytr, test_batches, mean,
             f"rounds — raise --iters (a 0.0-accuracy record here would "
             f"be indistinguishable from a measured chance result)")
     t0 = time.time()
-    for r in range(rounds):
+    if solver.round >= rounds:
+        # the kill landed between the final-round snapshot and the
+        # point_done emit: nothing left to train, but final_accuracy
+        # must be MEASURED, not the 0.0 default
+        state["i"] = 0
+        return float(solver.test().get("accuracy", 0.0))
+    for r in range(solver.round, rounds):
         loss = solver.run_round()
         if solver.iter % test_interval == 0 or r == rounds - 1:
             state["i"] = 0
@@ -185,6 +224,7 @@ def run_point(nw, tau, sync_history, iters, xtr, ytr, test_batches, mean,
                       loss=round(float(loss), 4),
                       accuracy=round(acc, 4),
                       elapsed_s=round(time.time() - t0, 1)))
+            save_snapshot()
     return acc
 
 
@@ -229,9 +269,18 @@ def main():
                         "fewer classes separate faster on short budgets. "
                         "Default: 21 for stripes/bands, 100 for blocks")
     p.add_argument("--out", default="")
+    p.add_argument("--snapshot-dir", default="",
+                   help="write a per-point solver snapshot at every test "
+                        "mark (exact per-worker params+momentum resume)")
+    p.add_argument("--resume", action="store_true",
+                   help="with --snapshot-dir and --out: skip points whose "
+                        "point_done is already in --out (matching config), "
+                        "and restore an incomplete point's snapshot")
     a = p.parse_args()
     if a.classes is None:
         a.classes = 21 if a.signal in ("stripes", "bands") else N_CLASSES
+    if a.resume and not (a.snapshot_dir and a.out):
+        p.error("--resume needs --snapshot-dir and --out")
 
     from sparknet_tpu.utils.compile_cache import (apply_platform_env,
                                                   maybe_enable_compile_cache)
@@ -264,17 +313,85 @@ def main():
               data_gen_s=round(time.time() - t0, 1),
               bayes_ceiling=ceiling))
 
+    cfg = dict(classes=a.classes, amplitude=a.amplitude,
+               signal=a.signal, batch=a.batch, base_lr=a.base_lr,
+               iters=a.iters, n_train=a.n_train,
+               # test-measurement params too: n_test changes the drawn
+               # test-set CONTENT (train and test come off one RNG
+               # stream), so a skipped point's accuracy must have been
+               # measured on the identical test protocol
+               n_test=a.n_test, test_batches=a.test_batches)
+
+    def prior_final(nw, tau, hist):
+        """final_accuracy of an identical completed point already in
+        --out — identical means the point spec AND the full grid config
+        (point_done records carry cfg; ones without it never match, so a
+        pre-cfg record can't be inherited across a config change)."""
+        if not (a.resume and os.path.exists(a.out)):
+            return None
+        for line in open(a.out):
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue
+            if (rec.get("event") == "point_done"
+                    and rec.get("n_workers") == nw
+                    and rec.get("tau") == tau
+                    and rec.get("sync_history") == hist
+                    and rec.get("cfg") == cfg):
+                return rec["final_accuracy"]
+        return None
+
+    if a.snapshot_dir:
+        os.makedirs(a.snapshot_dir, exist_ok=True)
+        # config guard: a snapshot from a different grid config must not
+        # silently seed this one (accuracy_run.py meta pattern).  A fresh
+        # (non-resume) run also clears stale point snapshots — otherwise
+        # rewriting the meta here would launder an old-config snapshot
+        # past a later --resume's check.
+        meta_path = os.path.join(a.snapshot_dir, "grid_meta.json")
+        if a.resume:
+            if not os.path.exists(meta_path):
+                raise SystemExit(f"--resume: {meta_path} missing — cannot "
+                                 f"prove the snapshots match this config")
+            prev = json.load(open(meta_path))
+            if prev != cfg:
+                raise SystemExit(f"--resume config mismatch: snapshots "
+                                 f"were taken with {prev}, now {cfg}")
+        else:
+            # fresh run: stale point snapshots must not survive a config
+            # change, and the meta write is atomic so a kill mid-write
+            # can't leave truncated JSON for the next --resume to choke on
+            import glob as _glob
+            for f in _glob.glob(os.path.join(a.snapshot_dir,
+                                             "point_*.npz")):
+                os.remove(f)
+            tmp = f"{meta_path}.tmp{os.getpid()}"
+            with open(tmp, "w") as f:
+                json.dump(cfg, f)
+            os.replace(tmp, meta_path)
+
     finals = {}
     for spec in [s for s in a.points.split(",") if s]:
         nw, tau, hist = parse_spec(spec)
+        done = prior_final(nw, tau, hist)
+        if done is not None:
+            emit(dict(event="point_skipped", n_workers=nw, tau=tau,
+                      sync_history=hist, final_accuracy=done))
+            finals[spec] = done
+            continue
+        snap = (os.path.join(a.snapshot_dir,
+                             f"point_{nw}_{tau}_{hist}.npz")
+                if a.snapshot_dir else "")
         t0 = time.time()
         acc = run_point(nw, tau, hist, a.iters, xtr, ytr, test_batches,
                         mean, emit, test_interval=a.test_interval,
                         num_test_batches=a.test_batches, batch=a.batch,
-                        base_lr=a.base_lr)
+                        base_lr=a.base_lr, snapshot_path=snap,
+                        resume=a.resume)
         finals[spec] = acc
         emit(dict(event="point_done", n_workers=nw, tau=tau,
-                  sync_history=hist, iters=a.iters,
+                  sync_history=hist, iters=a.iters, cfg=cfg,
                   final_accuracy=round(acc, 4),
                   wall_s=round(time.time() - t0, 1)))
     emit(dict(event="summary", grid_finals=finals))

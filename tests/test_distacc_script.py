@@ -1,0 +1,44 @@
+"""Unit coverage for the resumable pieces of scripts/imagenet_distacc.py
+(the ImageNet-path distributed-accuracy study): the per-worker feed must
+fast-forward deterministically so a killed-and-resumed grid point draws
+the same remaining batch sequence the unkilled run would have (the
+accuracy_run.py WorkerFeed.fast_forward contract)."""
+
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "scripts"))
+
+from imagenet_distacc import WorkerStream, parse_spec  # noqa: E402
+
+
+def _stream(seed=7, n=50, batch=4):
+    imgs = np.arange(n, dtype=np.uint8)[:, None, None, None] * np.ones(
+        (1, 3, 8, 8), dtype=np.uint8)
+    labels = np.arange(n, dtype=np.int32)
+    return WorkerStream(imgs, labels, lambda x: x, batch, seed)
+
+
+def test_fast_forward_matches_unkilled_sequence():
+    a, b = _stream(), _stream()
+    full = [a() for _ in range(6)]
+    b.fast_forward(3)
+    resumed = [b() for _ in range(3)]
+    for want, got in zip(full[3:], resumed):
+        np.testing.assert_array_equal(want["label"], got["label"])
+        np.testing.assert_array_equal(want["data"], got["data"])
+
+
+def test_fast_forward_zero_is_identity():
+    a, b = _stream(seed=11), _stream(seed=11)
+    b.fast_forward(0)
+    np.testing.assert_array_equal(a()["label"], b()["label"])
+
+
+def test_parse_spec_momentum_suffixes():
+    assert parse_spec("8:50") == (8, 50, "local")
+    assert parse_spec("8:50m") == (8, 50, "average")
+    assert parse_spec("4:1r") == (4, 1, "reset")
