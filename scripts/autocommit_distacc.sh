@@ -1,0 +1,21 @@
+#!/usr/bin/env bash
+# Round-5 lesson: box reboots (tunnel-wedge recovery) wipe every
+# UNTRACKED file in the repo — two in-flight distacc grids were lost
+# that way.  This loop checkpoints the grid's raw JSONL into git every
+# 10 min so completed points survive the next reboot; the grid's
+# --resume path then skips them instead of re-training.
+set -u
+REPO="$(cd "$(dirname "$0")/.." && pwd)"
+FILE="${1:-imagenet_distacc_r5.jsonl}"
+cd "$REPO"
+while :; do
+  sleep 600
+  [ -s "$FILE" ] || continue
+  if [ -n "$(git status --porcelain -- "$FILE")" ]; then
+    git add -- "$FILE" &&
+    git commit -q -m "distacc grid: checkpoint raw results ($(wc -l <"$FILE") records)
+
+No-Verification-Needed: raw measurement data checkpoint" -- "$FILE" \
+      2>/dev/null || true
+  fi
+done

@@ -350,26 +350,41 @@ def main():
         # rewriting the meta here would launder an old-config snapshot
         # past a later --resume's check.
         meta_path = os.path.join(a.snapshot_dir, "grid_meta.json")
-        if a.resume:
-            if not os.path.exists(meta_path):
-                raise SystemExit(f"--resume: {meta_path} missing — cannot "
-                                 f"prove the snapshots match this config")
-            prev = json.load(open(meta_path))
-            if prev != cfg:
-                raise SystemExit(f"--resume config mismatch: snapshots "
-                                 f"were taken with {prev}, now {cfg}")
-        else:
-            # fresh run: stale point snapshots must not survive a config
-            # change, and the meta write is atomic so a kill mid-write
-            # can't leave truncated JSON for the next --resume to choke on
+
+        def reset_snapshots():
+            """Drop stale point snapshots and (re)write the config meta.
+            The meta write is atomic so a kill mid-write can't leave
+            truncated JSON for the next --resume to choke on."""
             import glob as _glob
-            for f in _glob.glob(os.path.join(a.snapshot_dir,
-                                             "point_*.npz")):
+            stale = _glob.glob(os.path.join(a.snapshot_dir, "point_*.npz"))
+            for f in stale:
                 os.remove(f)
             tmp = f"{meta_path}.tmp{os.getpid()}"
             with open(tmp, "w") as f:
                 json.dump(cfg, f)
             os.replace(tmp, meta_path)
+            return len(stale)
+
+        if a.resume:
+            # Missing meta is NOT fatal: box reboots wipe the (untracked)
+            # snapshot dir while completed points survive in the committed
+            # --out, and the point-skip path below validates those records
+            # by their own embedded cfg — only the SNAPSHOTS are
+            # unprovable.  Drop them and restart incomplete points from
+            # scratch rather than refusing the whole grid.
+            if not os.path.exists(meta_path):
+                emit(dict(event="resume_meta_missing",
+                          dropped_snapshots=reset_snapshots()))
+            else:
+                prev = json.load(open(meta_path))
+                if prev != cfg:
+                    raise SystemExit(f"--resume config mismatch: snapshots "
+                                     f"were taken with {prev}, now {cfg}")
+        else:
+            # fresh run: stale point snapshots must not survive a config
+            # change — otherwise rewriting the meta here would launder an
+            # old-config snapshot past a later --resume's check
+            reset_snapshots()
 
     finals = {}
     for spec in [s for s in a.points.split(",") if s]:

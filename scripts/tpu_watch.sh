@@ -53,9 +53,18 @@ stage() {
 }
 
 run_bench() {
+  # one physical core: a concurrently running CPU-mesh study would
+  # depress the host-sensitive legs (host_fed, cifar_e2e,
+  # imagenet_native) — freeze it for the duration of the chain.  The
+  # EXIT trap guarantees the CONT even if the watcher itself is killed
+  # mid-bench; without it the frozen grid would stay in state T forever.
+  trap 'pkill -CONT -f imagenet_distacc.py 2>/dev/null' EXIT
+  pkill -STOP -f imagenet_distacc.py 2>/dev/null
   ( cd "$REPO" && SPARKNET_BENCH_WAIT_S=120 timeout 5400 \
       python bench.py >"$REPO/bench_r05_stdout.json" 2>>"$LOG" )
   local rc=$?
+  pkill -CONT -f imagenet_distacc.py 2>/dev/null
+  trap - EXIT
   say "bench record: $(head -c 2000 "$REPO/bench_r05_stdout.json" 2>/dev/null)"
   # bench exits 0 even when it emits a stale fallback record — a stale
   # line must NOT mark the stage done
