@@ -601,8 +601,10 @@ def _stale_record(reason: str) -> dict:
     (BENCH_LAST_GOOD_SEED.json — box reboots wipe the gitignored
     last-good file, round-5 lesson) and only then a minimal-but-parseable
     placeholder so the ONE-JSON-line contract survives a fresh checkout."""
-    seed = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                        "BENCH_LAST_GOOD_SEED.json")
+    seed = os.environ.get(
+        "SPARKNET_BENCH_SEED",
+        os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     "BENCH_LAST_GOOD_SEED.json"))
     stale = None
     for path in (LAST_GOOD, seed):
         try:

@@ -61,6 +61,10 @@ def _bench_env(tmp_path, wait_s, last_good=None):
         "SPARKNET_BENCH_LAST_GOOD": str(
             last_good if last_good is not None
             else tmp_path / "missing.json"),
+        # keep the committed seed reconstruction out of these scenarios:
+        # the no-last-good contract (placeholder line) must stay testable
+        # on a checkout that ships BENCH_LAST_GOOD_SEED.json
+        "SPARKNET_BENCH_SEED": str(tmp_path / "missing_seed.json"),
         "JAX_PLATFORMS": "cpu",
     })
     return env
@@ -92,6 +96,42 @@ def test_bench_wedged_tunnel_emits_stale_line_on_budget(tmp_path):
     rec = _assert_one_stale_json_line(r.stdout)
     assert rec["value"] == 12345.0
     assert rec["stale_reason"] == "wait_budget_exhausted"
+
+
+def test_bench_seed_fallback_when_last_good_missing(tmp_path):
+    """Box reboots wipe the gitignored BENCH_LAST_GOOD.json (round-5
+    lesson, twice); the stale path must then fall back to the COMMITTED
+    seed reconstruction instead of nulling the scoreboard."""
+    import json as _json
+    import subprocess
+
+    seed = tmp_path / "seed.json"
+    seed.write_text(_json.dumps({"metric": "alexnet_train_imgs_per_sec",
+                                 "value": 777.0, "unit": "img/s",
+                                 "vs_baseline": 2.9,
+                                 "seed_reconstructed": True}))
+    env = _bench_env(tmp_path, wait_s=0.5)  # last_good -> missing path
+    env["SPARKNET_BENCH_SEED"] = str(seed)
+    r = subprocess.run(
+        [os.sys.executable, os.path.join(REPO, "bench.py")],
+        env=env, capture_output=True, text=True, timeout=120)
+    assert r.returncode == 0, r.stderr[-2000:]
+    rec = _assert_one_stale_json_line(r.stdout)
+    assert rec["value"] == 777.0
+    assert rec["seed_reconstructed"] is True
+    assert rec["stale_reason"] == "wait_budget_exhausted"
+
+
+def test_bench_committed_seed_is_readable_and_sane():
+    """The real BENCH_LAST_GOOD_SEED.json must stay parseable and carry
+    the headline fields the driver contract needs."""
+    import json as _json
+
+    rec = _json.load(open(os.path.join(REPO, "BENCH_LAST_GOOD_SEED.json")))
+    assert rec["metric"] == "alexnet_train_imgs_per_sec"
+    assert rec["value"] and rec["value"] > 0
+    assert rec["unit"] == "img/s"
+    assert rec["seed_reconstructed"] is True
 
 
 def test_bench_sigterm_mid_wait_emits_stale_line(tmp_path):
