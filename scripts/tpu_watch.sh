@@ -70,9 +70,35 @@ run_bench() {
   # line must NOT mark the stage done
   if [ "$rc" -eq 0 ] && \
      ! grep -q stale_due_to "$REPO/bench_r05_stdout.json" 2>/dev/null; then
+    refresh_seed
     return 0
   fi
   return 1
+}
+
+refresh_seed() {
+  # a fresh chain just landed: snapshot it into the COMMITTED seed so
+  # the next box reboot (which wipes the gitignored last-good file)
+  # falls back to THESE numbers, not an older reconstruction
+  ( cd "$REPO" && python - <<'EOF' >>"$LOG" 2>&1
+import json, os, time
+rec = json.load(open("BENCH_LAST_GOOD.json"))
+rec["seed_reconstructed"] = True
+rec["seed_note"] = ("verbatim snapshot of BENCH_LAST_GOOD.json after the "
+                    "fresh chain at "
+                    + time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()))
+tmp = f"BENCH_LAST_GOOD_SEED.json.tmp{os.getpid()}"
+json.dump(rec, open(tmp, "w"), indent=2)
+os.replace(tmp, "BENCH_LAST_GOOD_SEED.json")
+print("seed refreshed from fresh chain")
+EOF
+  )
+  ( cd "$REPO" &&
+    git add BENCH_LAST_GOOD_SEED.json &&
+    git commit -q -m "Refresh committed bench seed from fresh chain
+
+No-Verification-Needed: raw measurement data checkpoint" \
+      -- BENCH_LAST_GOOD_SEED.json 2>/dev/null || true )
 }
 
 say "watcher start period=${PERIOD}s probe_timeout=${PROBE_TIMEOUT}s"
