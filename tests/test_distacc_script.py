@@ -15,6 +15,97 @@ sys.path.insert(0, os.path.join(
 from imagenet_distacc import WorkerStream, parse_spec  # noqa: E402
 
 
+def _run_main(monkeypatch, tmp_path, argv_extra, accs):
+    """Drive imagenet_distacc.main() with run_point stubbed out (each
+    call pops the next value from `accs`), a tiny synthetic set, and
+    --out/--snapshot-dir under tmp_path.  Returns the parsed --out
+    records.  This pins the grid ORCHESTRATION contract — meta guard,
+    reboot-resume, point skipping — without training AlexNet."""
+    import json
+
+    import imagenet_distacc as mod
+
+    calls = []
+
+    def fake_run_point(nw, tau, hist, iters, *args, **kwargs):
+        calls.append((nw, tau, hist))
+        return accs.pop(0)
+
+    monkeypatch.setattr(mod, "run_point", fake_run_point)
+    out = tmp_path / "grid.jsonl"
+    snap = tmp_path / "snap"
+    argv = ["imagenet_distacc.py", "--n-train", "60", "--n-test", "40",
+            "--iters", "100", "--classes", "3", "--out", str(out),
+            "--snapshot-dir", str(snap)] + argv_extra
+    monkeypatch.setattr(sys, "argv", argv)
+    mod.main()
+    recs = [json.loads(ln) for ln in out.read_text().splitlines()]
+    return recs, calls, snap
+
+
+def test_grid_fresh_run_writes_meta_and_trains_all_points(
+        monkeypatch, tmp_path, capsys):
+    recs, calls, snap = _run_main(
+        monkeypatch, tmp_path, ["--points", "1:50,8:50"], [0.5, 0.8])
+    assert calls == [(1, 50, "local"), (8, 50, "local")]
+    assert os.path.exists(os.path.join(str(snap), "grid_meta.json"))
+    finals = [r for r in recs if r["event"] == "point_done"]
+    assert [f["final_accuracy"] for f in finals] == [0.5, 0.8]
+    # point_done must carry cfg: the resume skip-check validates by it
+    assert all("cfg" in f for f in finals)
+
+
+def test_grid_resume_skips_completed_points_after_wiped_snapshots(
+        monkeypatch, tmp_path, capsys):
+    """Box-reboot recovery: snapshots+meta wiped, --out survived (it is
+    git-checkpointed).  --resume must skip the completed point by its
+    cfg-carrying point_done record and train only the missing one."""
+    import shutil
+
+    recs, calls, snap = _run_main(
+        monkeypatch, tmp_path, ["--points", "1:50"], [0.5])
+    shutil.rmtree(str(snap))  # the reboot wipes the untracked dir
+
+    recs, calls, _ = _run_main(
+        monkeypatch, tmp_path, ["--points", "1:50,8:50", "--resume"],
+        [0.8])
+    assert calls == [(8, 50, "local")], "completed 1:50 must be skipped"
+    assert any(r["event"] == "resume_meta_missing" for r in recs)
+    skipped = [r for r in recs if r["event"] == "point_skipped"]
+    assert skipped and skipped[0]["final_accuracy"] == 0.5
+
+
+def test_grid_resume_rejects_config_mismatch(monkeypatch, tmp_path,
+                                             capsys):
+    """A surviving meta from a DIFFERENT grid config must still be
+    fatal — snapshots may not be laundered across configs."""
+    import pytest
+
+    _run_main(monkeypatch, tmp_path, ["--points", "1:50"], [0.5])
+    with pytest.raises(SystemExit, match="config mismatch"):
+        _run_main(monkeypatch, tmp_path,
+                  ["--points", "1:50", "--resume", "--amplitude", "9"],
+                  [0.9])
+
+
+def test_grid_resume_does_not_inherit_other_config_results(
+        monkeypatch, tmp_path, capsys):
+    """point_done records from a different cfg in the same --out must
+    NOT satisfy the skip check (fresh snapshot dir, so no meta clash:
+    the records alone carry the proof)."""
+    import shutil
+
+    _run_main(monkeypatch, tmp_path, ["--points", "1:50"], [0.5])
+    shutil.rmtree(str(tmp_path / "snap"))
+    recs, calls, _ = _run_main(
+        monkeypatch, tmp_path,
+        ["--points", "1:50", "--resume", "--amplitude", "9"], [0.9])
+    assert calls == [(1, 50, "local")], \
+        "other-config point_done must not be inherited"
+    finals = [r for r in recs if r["event"] == "point_done"]
+    assert finals[-1]["final_accuracy"] == 0.9
+
+
 def _stream(seed=7, n=50, batch=4):
     imgs = np.arange(n, dtype=np.uint8)[:, None, None, None] * np.ones(
         (1, 3, 8, 8), dtype=np.uint8)
