@@ -447,21 +447,24 @@ def bench_imagenet_native(rounds: int = 3, tau: int = 5, batch: int = 64,
         solver.set_train_data([JpegStream()])
         solver.set_prefetch(True)
         solver.run_round()  # compile + warm
+        solver.reset_ingest_stats()  # count only the measured window
         t0 = time.perf_counter()
         for r in range(rounds):
             solver.run_round(prefetch_next=r < rounds - 1)
         dt = time.perf_counter() - t0
+        ingest = solver.ingest_stats()
     finally:
         shutil.rmtree(tmp, ignore_errors=True)
     out = {"imagenet_native_fed_imgs_per_sec":
            round(rounds * tau * batch / dt, 1),
-           "imagenet_native_batch": batch, "imagenet_native_tau": tau}
+           "imagenet_native_batch": batch, "imagenet_native_tau": tau,
+           "imagenet_native_ingest": ingest}
     log(json.dumps(out))
     return out
 
 
 def bench_cifar_e2e(rounds: int = 6, tau: int = 100,
-                    prefetch: bool = True) -> float:
+                    prefetch: bool = True) -> dict:
     """Sustained HOST-FED CIFAR training throughput, prefetch on — the
     one honest end-to-end figure this box resolves (small batches
     amortize the tunnel's per-RPC floor; ACCURACY.md measured 1,214 img/s
@@ -470,8 +473,11 @@ def bench_cifar_e2e(rounds: int = 6, tau: int = 100,
 
     Shape of the run: the reference cifar10_quick recipe (batch 100) as
     one τ-step compiled round per device call, fed by a round-agnostic
-    host stream (so set_prefetch's one-round-look-ahead is safe), fresh
-    batches pulled and shipped every round."""
+    host stream (so set_prefetch's depth-k look-ahead is safe), fresh
+    batches pulled and shipped every round.  Returns
+    {"imgs_per_sec": ..., "ingest": solver.ingest_stats()} so the
+    per-stage pull/stack/device_put/stall split rides the driver record
+    (data/counters.py semantics)."""
     import numpy as np
 
     from sparknet_tpu.apps.cifar_app import build_solver
@@ -500,11 +506,13 @@ def bench_cifar_e2e(rounds: int = 6, tau: int = 100,
     solver.set_train_data([StreamFeed()])
     solver.set_prefetch(prefetch)  # scripts/prefetch_delta.py flips this
     solver.run_round()  # compile + warm
+    solver.reset_ingest_stats()  # count only the measured window
     t0 = time.perf_counter()
     for r in range(rounds):
         solver.run_round(prefetch_next=r < rounds - 1)
     dt = time.perf_counter() - t0
-    return rounds * tau * batch / dt
+    return {"imgs_per_sec": rounds * tau * batch / dt,
+            "ingest": solver.ingest_stats()}
 
 
 LAST_GOOD = os.environ.get(
@@ -528,8 +536,9 @@ _KNOWN_FIELDS = {
     "googlenet_mfu", "googlenet_b128_imgs_per_sec", "googlenet_b128_mfu",
     "alexnet_infer_imgs_per_sec", "googlenet_infer_imgs_per_sec",
     "longctx_lm_tok_per_sec", "cifar_e2e_imgs_per_sec",
+    "cifar_e2e_ingest",
     "imagenet_native_fed_imgs_per_sec", "imagenet_native_batch",
-    "imagenet_native_tau",
+    "imagenet_native_tau", "imagenet_native_ingest",
 }
 
 # every leg name main() lands; leg_utc stamps outside this set (renamed
@@ -828,8 +837,12 @@ def _run_legs(land) -> None:
     land("longctx_lm",
          {"longctx_lm_tok_per_sec": longctx["longctx_lm_tok_per_sec"]})
     cifar_e2e = bench_cifar_e2e()
-    log(json.dumps({"cifar_e2e_imgs_per_sec": round(cifar_e2e, 1)}))
-    land("cifar_e2e", {"cifar_e2e_imgs_per_sec": round(cifar_e2e, 1)})
+    log(json.dumps({"cifar_e2e_imgs_per_sec":
+                    round(cifar_e2e["imgs_per_sec"], 1),
+                    "cifar_e2e_ingest": cifar_e2e["ingest"]}))
+    land("cifar_e2e", {"cifar_e2e_imgs_per_sec":
+                       round(cifar_e2e["imgs_per_sec"], 1),
+                       "cifar_e2e_ingest": cifar_e2e["ingest"]})
     try:
         imgnet_native = bench_imagenet_native()
     except Exception as e:
@@ -842,7 +855,9 @@ def _run_legs(land) -> None:
               imgnet_native["imagenet_native_fed_imgs_per_sec"],
               "imagenet_native_batch":
               imgnet_native["imagenet_native_batch"],
-              "imagenet_native_tau": imgnet_native["imagenet_native_tau"]})
+              "imagenet_native_tau": imgnet_native["imagenet_native_tau"],
+              "imagenet_native_ingest":
+              imgnet_native["imagenet_native_ingest"]})
 
 
 if __name__ == "__main__":

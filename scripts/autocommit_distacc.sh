@@ -12,10 +12,15 @@ while :; do
   sleep 600
   [ -s "$FILE" ] || continue
   if [ -n "$(git status --porcelain -- "$FILE")" ]; then
-    git add -- "$FILE" &&
-    git commit -q -m "distacc grid: checkpoint raw results ($(wc -l <"$FILE") records)
+    if ! { git add -- "$FILE" &&
+           git commit -q -m "distacc grid: checkpoint raw results ($(wc -l <"$FILE") records)
 
 No-Verification-Needed: raw measurement data checkpoint" -- "$FILE" \
-      2>/dev/null || true
+             2>/dev/null; }; then
+      # a failed checkpoint must not leave the JSONL staged: the next
+      # unrelated `git commit` (no pathspec) would silently sweep the
+      # half-checkpointed data into a foreign commit
+      git reset -q -- "$FILE" 2>/dev/null || true
+    fi
   fi
 done

@@ -7,6 +7,14 @@ Stages, each emitted as one JSON line:
   decode   — native libjpeg pool throughput, tar shards -> uint8 batches
              (pure host; runs without a TPU, flagged if the box is
              contended)
+  pooled   — the PURE-PYTHON pooled decode path (scale_convert fallback
+             over data/pipeline.pooled_map), swept at pool widths
+             1/2/4/8: the scaling record for the shared ingest pool on
+             multi-core hosts.  One JSON record per run; under
+             scripts/tpu_watch.sh it lands in ingest_probe.jsonl, which
+             scripts/autocommit_distacc.sh checkpoints into git
+             (--append writes the record to a JSONL directly for runs
+             outside the watcher)
   wire     — host->device transfer rate for uint8 256x256 batches, as an
              amortized dependent chain with the separately measured
              fetch floor subtracted (the layout_probe.py discipline:
@@ -22,7 +30,7 @@ preprocessing/ScaleAndConvert.scala:16-27 feeding base_data_layer.cpp's
 prefetch thread.
 
 Run (TPU window):   python scripts/ingest_probe.py
-Host-only stages:   python scripts/ingest_probe.py --stages decode
+Host-only stages:   python scripts/ingest_probe.py --stages decode,pooled
 """
 
 import argparse
@@ -74,6 +82,66 @@ def stage_decode(n_imgs=512, n_shards=2):
           "note": "host-only; single-core contention deflates this on "
                   "the dev box"})
     return n / dt
+
+
+def _synth_jpegs(n, size, seed=0):
+    """n in-memory synthetic JPEGs (PIL encode; no dataset download)."""
+    import io
+
+    from PIL import Image
+
+    rng = np.random.RandomState(seed)
+    out = []
+    for _ in range(n):
+        arr = rng.randint(0, 256, size=(size, size, 3)).astype(np.uint8)
+        b = io.BytesIO()
+        Image.fromarray(arr).save(b, format="JPEG", quality=85)
+        out.append(b.getvalue())
+    return out
+
+
+def stage_pooled(n_imgs=256, workers=(1, 2, 4, 8), append=""):
+    """Pure-Python pooled decode (data/pipeline.pooled_map, the
+    scale_convert fallback when the native pool isn't built) swept over
+    pool widths: where the shared ingest pool's thread scaling actually
+    lands on this host.  width=1 runs pooled_map's serial path, so the
+    sweep includes the pool's own overhead, not just its speedup."""
+    from sparknet_tpu.data import pipeline
+    from sparknet_tpu.data.scale_convert import _decode_entry
+
+    entries = [(raw, SIZE, SIZE) for raw in _synth_jpegs(n_imgs, SIZE)]
+    rates = {}
+    old = os.environ.get("SPARKNET_INGEST_WORKERS")
+    try:
+        for w in workers:
+            # explicit env wins over the core-count heuristic
+            # (pipeline.shared_pool_size), so the sweep measures widths
+            # the heuristic would clamp away on small boxes
+            os.environ["SPARKNET_INGEST_WORKERS"] = str(w)
+            pipeline.pooled_map(_decode_entry, entries[:16])  # pool warm-up
+            t0 = time.perf_counter()
+            arrs = pipeline.pooled_map(_decode_entry, entries)
+            dt = time.perf_counter() - t0
+            ok = sum(a is not None for a in arrs)
+            if ok != n_imgs:
+                raise SystemExit(f"pooled decode dropped {n_imgs - ok} of "
+                                 f"{n_imgs} synthetic images at width {w}"
+                                 f" — synthetic JPEGs must all decode")
+            rates[str(w)] = round(ok / dt, 1)
+    finally:
+        if old is None:
+            os.environ.pop("SPARKNET_INGEST_WORKERS", None)
+        else:
+            os.environ["SPARKNET_INGEST_WORKERS"] = old
+    rec = {"stage": "pooled",
+           "utc": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+           "imgs": n_imgs, "size": SIZE, "cores": os.cpu_count() or 1,
+           "imgs_per_sec_by_workers": rates}
+    emit(rec)
+    if append:
+        with open(append, "a") as f:
+            f.write(json.dumps(rec) + "\n")
+    return max(rates.values())
 
 
 def stage_wire(reps=8):
@@ -167,14 +235,22 @@ def stage_e2e():
 
 def main():
     p = argparse.ArgumentParser()
-    p.add_argument("--stages", default="decode,wire,compute,e2e")
+    p.add_argument("--stages", default="decode,pooled,wire,compute,e2e")
+    p.add_argument("--append", default="",
+                   help="also append the pooled record to this JSONL "
+                        "(durable outside the watcher's stdout redirect; "
+                        "checkpoint it with scripts/autocommit_distacc.sh)")
     a = p.parse_args()
     from sparknet_tpu.utils.compile_cache import (apply_platform_env,
                                                   maybe_enable_compile_cache)
 
     apply_platform_env()
     maybe_enable_compile_cache()
-    stages = {"decode": stage_decode, "wire": stage_wire,
+    import functools
+
+    stages = {"decode": stage_decode,
+              "pooled": functools.partial(stage_pooled, append=a.append),
+              "wire": stage_wire,
               "compute": stage_compute, "e2e": stage_e2e}
     wanted = [s for s in a.stages.split(",") if s]
     bad = [s for s in wanted if s not in stages]

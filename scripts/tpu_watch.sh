@@ -34,6 +34,53 @@ EOF
 FLAGDIR="${TPU_WATCH_FLAG_DIR:-$REPO/.tpu_watch_flags}"
 mkdir -p "$FLAGDIR"
 
+# Grid-freeze coordination: only ONE watcher may STOP/CONT the distacc
+# grid at a time.  Without the lock, watcher A's bench finishing would
+# CONT the grid that watcher B had just STOPped for ITS bench — the
+# freeze would silently evaporate mid-measurement.  mkdir is the atomic
+# primitive; the lock dir records its owner pid for post-mortems.
+FREEZE_LOCK="${TPU_WATCH_FREEZE_LOCK:-$FLAGDIR/grid_freeze.lock}"
+# stop/cont markers: one JSON line per transition, so distacc
+# `elapsed_s` analysis can subtract the frozen intervals (DISTACC.md
+# "Wall-clock semantics").  Appended, never truncated.
+FREEZE_MARKERS="${TPU_WATCH_FREEZE_MARKERS:-$REPO/distacc_freeze_markers.jsonl}"
+FREEZE_HELD=0
+
+freeze_grid() {
+  # reap a stale lock (owner SIGKILLed mid-bench: its EXIT trap never
+  # ran, so the dir survives and the grid may be parked in state T)
+  local owner
+  owner=$(cat "$FREEZE_LOCK/owner_pid" 2>/dev/null || true)
+  if [ -n "$owner" ] && ! kill -0 "$owner" 2>/dev/null; then
+    say "reaping stale freeze lock of dead pid $owner"
+    rm -rf "$FREEZE_LOCK"
+    pkill -CONT -f imagenet_distacc.py 2>/dev/null
+    echo "{\"event\": \"cont\", \"utc\": \"$(stamp)\", \"unix\": $(date +%s)," \
+         "\"by_pid\": $$, \"reaped_stale_lock_of\": $owner}" >>"$FREEZE_MARKERS"
+  fi
+  if mkdir "$FREEZE_LOCK" 2>/dev/null; then
+    FREEZE_HELD=1
+    echo "$$" >"$FREEZE_LOCK/owner_pid"
+    echo "{\"event\": \"stop\", \"utc\": \"$(stamp)\", \"unix\": $(date +%s)," \
+         "\"by_pid\": $$}" >>"$FREEZE_MARKERS"
+    pkill -STOP -f imagenet_distacc.py 2>/dev/null
+    say "grid frozen (freeze lock acquired)"
+  else
+    say "freeze lock busy (held by pid $(cat "$FREEZE_LOCK/owner_pid" \
+        2>/dev/null || echo '?')): leaving the grid to its owner"
+  fi
+}
+
+unfreeze_grid() {
+  [ "$FREEZE_HELD" -eq 1 ] || return 0
+  pkill -CONT -f imagenet_distacc.py 2>/dev/null
+  echo "{\"event\": \"cont\", \"utc\": \"$(stamp)\", \"unix\": $(date +%s)," \
+       "\"by_pid\": $$}" >>"$FREEZE_MARKERS"
+  rm -rf "$FREEZE_LOCK"
+  FREEZE_HELD=0
+  say "grid thawed (freeze lock released)"
+}
+
 # stage NAME CMD... — runs CMD unless NAME already succeeded; re-probes
 # first (the prior stage may have consumed the window); flags success
 # only on rc==0 so a wedged/partial stage re-arms for the next window
@@ -58,13 +105,21 @@ run_bench() {
   # imagenet_native) — freeze it for the duration of the chain.  The
   # EXIT trap guarantees the CONT even if the watcher itself is killed
   # mid-bench; without it the frozen grid would stay in state T forever.
-  trap 'pkill -CONT -f imagenet_distacc.py 2>/dev/null' EXIT
-  pkill -STOP -f imagenet_distacc.py 2>/dev/null
+  # The PRIOR trap is saved and restored (not discarded): a caller's own
+  # EXIT cleanup must survive this function.
+  local prev_exit_trap
+  prev_exit_trap=$(trap -p EXIT)
+  trap 'unfreeze_grid' EXIT
+  freeze_grid
   ( cd "$REPO" && SPARKNET_BENCH_WAIT_S=120 timeout 5400 \
       python bench.py >"$REPO/bench_r05_stdout.json" 2>>"$LOG" )
   local rc=$?
-  pkill -CONT -f imagenet_distacc.py 2>/dev/null
-  trap - EXIT
+  unfreeze_grid
+  if [ -n "$prev_exit_trap" ]; then
+    eval "$prev_exit_trap"
+  else
+    trap - EXIT
+  fi
   say "bench record: $(head -c 2000 "$REPO/bench_r05_stdout.json" 2>/dev/null)"
   # bench exits 0 even when it emits a stale fallback record — a stale
   # line must NOT mark the stage done
@@ -82,7 +137,11 @@ refresh_seed() {
   # falls back to THESE numbers, not an older reconstruction
   ( cd "$REPO" && python - <<'EOF' >>"$LOG" 2>&1
 import json, os, time
-rec = json.load(open("BENCH_LAST_GOOD.json"))
+# same resolution as bench.py's LAST_GOOD: the env override must point
+# both the writer (bench) and this snapshotter at the SAME file, or the
+# seed would be refreshed from a record the bench never updated
+rec = json.load(open(os.environ.get("SPARKNET_BENCH_LAST_GOOD",
+                                    "BENCH_LAST_GOOD.json")))
 rec["seed_reconstructed"] = True
 rec["seed_note"] = ("verbatim snapshot of BENCH_LAST_GOOD.json after the "
                     "fresh chain at "

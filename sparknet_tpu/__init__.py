@@ -1,0 +1,134 @@
+"""Package init: graft forward-compat aliases onto the installed jax.
+
+The codebase targets the current jax API (`jax.shard_map` with its
+`check_vma` flag, `jax.lax.axis_size`).  Older jax builds (<= 0.4.x)
+expose shard_map under jax.experimental with the flag named
+`check_rep` and have no `lax.axis_size`; backfill the new spellings
+here — the package __init__ runs before any submodule's
+`from jax import shard_map` — so the same source imports on either
+version.  No-op on a current jax.
+
+On those same old builds the experimental shard_map's transpose rule
+mis-zips cotangents whenever the inside-transpose partial-eval re-split
+produces a different residual list than the forward split did (any
+shard_map body with an inner `lax.scan` trips it): `backward_pass`
+returns cotangents for (*new_residuals, *undefined_primals) but the
+rule zips them against the names of (*old_residuals, *env, *tangents),
+raising `_SpecError` on rank-0 residuals and silently mis-psumming on
+aligned-by-luck ones.  `_fix_old_shard_map_transpose` below re-registers
+a corrected rule: keep only the undefined-primal cotangents, return
+symbolic zeros for known args (their cotangents are never consumed),
+so positions always line up.  Verified against a dense single-device
+reference of the pipelined loss (gradients bit-match) and by the
+trajectory-exactness tests in tests/test_pipeline_compiled.py and
+tests/test_seq_parallel.py.
+"""
+
+import jax as _jax
+
+if not hasattr(_jax, "shard_map"):  # pragma: no cover (version-dependent)
+    from jax.experimental.shard_map import shard_map as _shard_map_old
+
+    def _shard_map(f, mesh=None, in_specs=None, out_specs=None,
+                   check_vma=None, **kw):
+        if check_vma is not None and "check_rep" not in kw:
+            kw["check_rep"] = check_vma
+        return _shard_map_old(f, mesh=mesh, in_specs=in_specs,
+                              out_specs=out_specs, **kw)
+
+    _jax.shard_map = _shard_map
+
+    if not hasattr(_jax.lax, "axis_size"):
+        def _axis_size(axis_name):
+            # psum of a literal 1 constant-folds to the (static, int)
+            # size of the named mesh axis on every trace path old jax
+            # supports; new jax exposes this directly as lax.axis_size
+            return _jax.lax.psum(1, axis_name)
+
+        _jax.lax.axis_size = _axis_size
+
+    def _fix_old_shard_map_transpose():
+        from math import prod
+
+        from jax._src import core, dtypes
+        from jax._src import linear_util as lu
+        from jax._src.api_util import flatten_fun_nokwargs
+        from jax._src.interpreters import ad
+        from jax._src.interpreters import partial_eval as pe
+        from jax._src.tree_util import tree_flatten, tree_unflatten
+        from jax._src.util import partition_list, split_list
+        from jax.experimental import shard_map as _smod
+
+        _shard_aval = _smod._shard_aval
+        _unshard_aval = _smod._unshard_aval
+        _unmentioned2 = _smod._unmentioned2
+        shard_map_p = _smod.shard_map_p
+
+        def transpose(out_cts, *args, jaxpr, mesh, in_names, out_names,
+                      check_rep, rewrite, auto):
+            mb_div = lambda x, y: x / y if y != 1 else x
+            out_cts = [
+                ad.Zero(_shard_aval(mesh, ns, x.aval))
+                if type(x) is ad.Zero
+                else x if rewrite or dtypes.dtype(x) == dtypes.float0
+                else mb_div(x, prod(mesh.shape[n] for n in
+                                    _unmentioned2(mesh, ns, auto)))
+                for ns, x in zip(out_names, out_cts)]
+            args = [x if type(x) is not ad.UndefinedPrimal else
+                    ad.UndefinedPrimal(_shard_aval(mesh, ns, x.aval))
+                    for ns, x in zip(in_names, args)]
+            all_args, in_tree = tree_flatten((out_cts, args))
+
+            @lu.wrap_init
+            def fun_trans(out_cts, args):
+                undef_mask = [ad.is_undefined_primal(x) for x in args]
+                res, undefs = partition_list(undef_mask, args)
+                jaxpr_known, jaxpr_unknown, _, _ = \
+                    pe.partial_eval_jaxpr_nounits(
+                        pe.close_jaxpr(jaxpr), undef_mask, False)
+                res_new = core.jaxpr_as_fun(jaxpr_known)(*res)
+                all_bar = ad.backward_pass(
+                    jaxpr_unknown.jaxpr, False, (),
+                    (*res_new, *undefs), out_cts)
+                # all_bar pairs with (*res_new, *undefs) — NOT with this
+                # eqn's invars.  Drop the recomputed-residual cotangents
+                # and re-align the undef ones to the original arg order.
+                _, undef_bar = split_list(all_bar, [len(res_new)])
+                undef_bar = iter(undef_bar)
+                out = [next(undef_bar) if u else ad.Zero(core.get_aval(a))
+                       for u, a in zip(undef_mask, args)]
+                assert next(undef_bar, None) is None
+                out = [
+                    ad.Zero(_unshard_aval(mesh, ns, x.aval))
+                    if type(x) is ad.Zero
+                    else x if rewrite
+                    else _jax.lax.psum(
+                        x, tuple(_unmentioned2(mesh, ns, auto)))
+                    for ns, x in zip(in_names, out)]
+                return out
+
+            fun_trans, nz_arg_cts = ad.nonzero_outputs(fun_trans)
+            fun_trans_flat, out_tree = flatten_fun_nokwargs(
+                fun_trans, in_tree)
+
+            new_in_names = \
+                [n for n, x in zip(out_names, out_cts)
+                 if type(x) is not ad.Zero] + \
+                [n for n, x in zip(in_names, args)
+                 if type(x) is not ad.UndefinedPrimal]
+
+            def new_out_names_thunk():
+                return tuple(names for names, nz
+                             in zip(in_names, nz_arg_cts()) if nz)
+
+            out_flat = shard_map_p.bind(
+                fun_trans_flat, *all_args, mesh=mesh,
+                in_names=tuple(new_in_names),
+                out_names_thunk=new_out_names_thunk,
+                check_rep=check_rep, rewrite=rewrite, auto=auto)
+            return tree_unflatten(out_tree(), out_flat)
+
+        ad.primitive_transposes[shard_map_p] = transpose
+
+    _fix_old_shard_map_transpose()
+    del _fix_old_shard_map_transpose

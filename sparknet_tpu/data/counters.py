@@ -1,0 +1,106 @@
+"""Per-stage ingest instrumentation.
+
+The reference's data path exposes per-stage timing through per-layer
+benchmarks (reference: base_data_layer.cpp:70-98 prefetch thread +
+benchmark.cpp timers around read/transform); this module is the equivalent
+for the pipelined ingest executor (data/pipeline.py): every staging stage —
+source pulls, τ-stacking, device_put dispatch, consumer stall — accumulates
+wall seconds into one thread-safe counter object that the solvers surface
+through `ingest_stats()` and bench.py lands in its one-line JSON record.
+
+Reading the numbers (BENCH_NOTES.md "Ingest pipeline"):
+
+- ``pull_s`` / ``stack_s`` / ``device_put_s`` are CORE-seconds: summed
+  across pull workers, so with 4 workers pulling concurrently they can
+  exceed wall time.  ``device_put_s`` measures dispatch only — jax
+  transfers are asynchronous and land while compute runs.
+- ``stall_s`` is wall time the CONSUMER (run_round/step) spent blocked
+  waiting for a staged round — the number the whole pipeline exists to
+  drive to zero; when it is ~0 the ingest path is off the critical path.
+- ``ring_occ_mean``/``ring_occ_max`` sample the staged-round ring at each
+  producer insert and consumer take; a ring pinned at its depth means the
+  producers outrun the consumer (compute-bound), pinned at 0 means
+  ingest-bound.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict
+
+
+class IngestCounters:
+    """Thread-safe per-stage accumulator for the ingest pipeline."""
+
+    STAGES = ("pull", "stack", "device_put", "stall")
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self.reset()
+
+    def reset(self) -> None:
+        with self._lock:
+            self._seconds = {s: 0.0 for s in self.STAGES}
+            self._items = {s: 0 for s in self.STAGES}
+            self._counts: Dict[str, int] = {}
+            self._ring_sum = 0
+            self._ring_max = 0
+            self._ring_samples = 0
+
+    def add(self, stage: str, seconds: float, items: int = 0) -> None:
+        """Accumulate `seconds` of work (and optionally `items` processed)
+        against one stage.  Unknown stages raise — a typo would otherwise
+        silently drop instrumentation."""
+        if stage not in self._seconds:
+            raise ValueError(f"unknown ingest stage {stage!r}; "
+                             f"one of {self.STAGES}")
+        with self._lock:
+            self._seconds[stage] += float(seconds)
+            self._items[stage] += int(items)
+
+    def bump(self, name: str, n: int = 1) -> None:
+        """Increment a named event counter (rounds_staged, rounds_consumed,
+        serial_rounds, ...)."""
+        with self._lock:
+            self._counts[name] = self._counts.get(name, 0) + int(n)
+
+    def observe_ring(self, occupancy: int) -> None:
+        """Sample the staged-round ring occupancy (called by the executor
+        at each producer insert and consumer take)."""
+        with self._lock:
+            occ = int(occupancy)
+            self._ring_sum += occ
+            self._ring_max = max(self._ring_max, occ)
+            self._ring_samples += 1
+
+    def timed(self, stage: str, items: int = 0) -> "_Timed":
+        """Context manager: `with counters.timed("pull", items=tau): ...`"""
+        return _Timed(self, stage, items)
+
+    def snapshot(self) -> Dict[str, float]:
+        """JSON-ready copy of every counter (seconds rounded to 10 µs)."""
+        with self._lock:
+            out: Dict[str, float] = {}
+            for s in self.STAGES:
+                out[f"{s}_s"] = round(self._seconds[s], 5)
+            out["pull_items"] = self._items["pull"]
+            out.update(self._counts)
+            if self._ring_samples:
+                out["ring_occ_mean"] = round(
+                    self._ring_sum / self._ring_samples, 3)
+                out["ring_occ_max"] = self._ring_max
+            return out
+
+
+class _Timed:
+    def __init__(self, counters: IngestCounters, stage: str,
+                 items: int) -> None:
+        self._c, self._stage, self._items = counters, stage, items
+
+    def __enter__(self) -> "_Timed":
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self._c.add(self._stage, time.perf_counter() - self._t0, self._items)

@@ -25,6 +25,13 @@ from typing import Callable, Dict, List, Optional, Tuple
 import numpy as np
 
 
+def _read_file_entry(entry: Tuple[str, int]) -> Tuple[bytes, int]:
+    # module-level so a SPARKNET_INGEST_PROCS=1 process pool can pickle it
+    path, label = entry
+    with open(path, "rb") as f:
+        return f.read(), label
+
+
 def _transformer_from_layer(layer, phase: str, seed: Optional[int]):
     from ..proto.binaryproto import read_mean_binaryproto
     from .transform import DataTransformer
@@ -52,8 +59,10 @@ def _data_feed(layer, phase: str, seed: Optional[int]):
         from .lmdb_io import read_datum_db
 
         def record_stream():
+            # read_datum_db pools encoded-datum decode `batch` at a time
+            # over the shared ingest pool (data/pipeline.py)
             while True:
-                yield from read_datum_db(src)
+                yield from read_datum_db(src, chunk=max(batch, 16))
     else:
         from .store import ArrayStoreCursor
 
@@ -107,21 +116,22 @@ def _image_data_feed(layer, phase: str, seed: Optional[int]):
     state = {"i": int(ip.rand_skip)}
 
     def feed() -> Dict[str, np.ndarray]:
-        # whole-batch decode through convert_stream: the native libjpeg
-        # pool when built (resize path), per-image PIL otherwise —
+        # whole-batch reads over the shared ingest pool, then whole-batch
+        # decode through convert_stream: the native libjpeg pool when
+        # built (resize path), the pooled pure-Python fallback otherwise —
         # convert_stream handles both and skips corrupt images
         # (image_data_layer caveat)
+        from .pipeline import pooled_map
         from .scale_convert import convert_stream
 
         imgs, labels = [], []
         while len(imgs) < batch:
             want = batch - len(imgs)
-            raws = []
+            chunk_entries = []
             for _ in range(want):
-                path, label = entries[state["i"] % len(entries)]
+                chunk_entries.append(entries[state["i"] % len(entries)])
                 state["i"] += 1
-                with open(path, "rb") as f:
-                    raws.append((f.read(), label))
+            raws = pooled_map(_read_file_entry, chunk_entries)
             for arr, label in convert_stream(iter(raws), nh, nw,
                                              chunk=want):
                 imgs.append(arr)

@@ -39,6 +39,7 @@ reference's db.cpp:9-22 backend dispatch.
 
 from __future__ import annotations
 
+import functools
 import os
 import struct
 from typing import Dict, Iterator, List, Optional, Tuple
@@ -418,26 +419,44 @@ def serialize_datum(image: np.ndarray, label: int) -> bytes:
 
 # ------------------------------------------------------------ integrations
 
+def _decoded_datums(datums, height, width):
+    """Yield (image, label) from parsed datums in order, pooling the
+    decode of `encoded` records over the shared ingest pool
+    (data/pipeline.py); corrupt encoded images are dropped (the reference
+    drops them too, ScaleAndConvert.scala:17-26)."""
+    from .pipeline import pooled_map
+    from .scale_convert import decode_and_resize
+
+    enc = [d["encoded_bytes"] for d in datums if d.get("encoded")]
+    dec = iter(pooled_map(
+        functools.partial(decode_and_resize, height=height, width=width),
+        enc))
+    for d in datums:
+        if d.get("encoded"):
+            img = next(dec)
+            if img is not None:
+                yield img, int(d["label"])  # type: ignore[arg-type]
+        elif "image" in d:
+            yield d["image"], int(d["label"])  # type: ignore
+
+
 def read_datum_db(path: str, height: Optional[int] = None,
-                  width: Optional[int] = None
+                  width: Optional[int] = None, *, chunk: int = 64,
                   ) -> Iterator[Tuple[np.ndarray, int]]:
     """Stream (image CHW, label) from a reference-made Datum database —
     LMDB or LevelDB, dispatched by directory layout (db.cpp:9-22) —
-    decoding `encoded` datums (compressed JPEG/PNG) on the fly;
-    height/width resize encoded images (convert_imageset --resize_*
-    semantics — without them encoded datums keep their native sizes)."""
-    from .scale_convert import decode_and_resize
-
+    decoding `encoded` datums (compressed JPEG/PNG) `chunk` records at a
+    time over the shared ingest pool; height/width resize encoded images
+    (convert_imageset --resize_* semantics — without them encoded datums
+    keep their native sizes)."""
+    buf: List[Dict[str, object]] = []
     for _key, value in open_datum_db(path).items():
-        d = parse_datum(value)
-        if d.get("encoded"):
-            img = decode_and_resize(d["encoded_bytes"],  # type: ignore
-                                    height, width)
-            if img is None:
-                continue
-            yield img, int(d["label"])  # type: ignore[arg-type]
-        elif "image" in d:
-            yield d["image"], int(d["label"])  # type: ignore
+        buf.append(parse_datum(value))
+        if len(buf) >= chunk:
+            yield from _decoded_datums(buf, height, width)
+            buf = []
+    if buf:
+        yield from _decoded_datums(buf, height, width)
 
 
 def convert_lmdb_to_store(lmdb_path: str, store_path: str,

@@ -1,0 +1,291 @@
+"""Pipelined multi-core ingest executor: depth-k staged-round ring + a
+shared decode/pull pool.
+
+The reference hides I/O behind compute with ONE triple-buffered prefetch
+thread per data layer (reference: base_data_layer.cpp:70-98,
+PREFETCH_COUNT=3).  This module generalizes that to the driver-loop world of
+this framework: a background coordinator stages whole τ-rounds — per-worker
+source pulls fanned out over a pull pool, per-worker stacking, device_put
+dispatched as each worker's stack is ready — into a bounded ring of
+`depth` completed rounds, and the training loop consumes them in strict
+round order.  `depth=1` is the old binary set_prefetch double buffer;
+`depth>=2` keeps staging while the consumer is busy elsewhere (test(),
+snapshot(), logging), converting the measured one-core staging ceiling
+(ingest_probe.jsonl: ~205 img/s/core decode vs 17k img/s device-resident)
+into a cores-wide scale-out on multi-core hosts.
+
+Invariants the executor guarantees (pinned by tests/test_ingest_pipeline.py):
+
+- ordered delivery: rounds come out in exactly the order they were staged,
+  regardless of how long each took to stage;
+- bounded lookahead: at most `depth` staged-but-unconsumed rounds exist at
+  any time (the coordinator blocks before PULLING, not after — a veto or a
+  slow consumer can never over-pull more than the ring holds);
+- loud failure: an exception in any pull worker surfaces to the consumer
+  on the `get()` that reaches the failed round — never a silently offset
+  stream (the same contract run_round's old staging thread had).
+
+Every stage is instrumented through data/counters.IngestCounters; the
+solvers surface the numbers via `ingest_stats()` and bench.py lands them in
+its one-line JSON record.
+"""
+
+from __future__ import annotations
+
+import atexit
+import collections
+import os
+import threading
+import time
+import weakref
+from typing import Any, Callable, List, Optional, Sequence
+
+__all__ = ["PipelinedIngestExecutor", "pooled_map", "shared_pool_size",
+           "default_prefetch_depth", "default_pull_workers"]
+
+
+def default_prefetch_depth() -> int:
+    """Ring depth used by set_prefetch(True): SPARKNET_PREFETCH_DEPTH env,
+    default 2 — one round in flight to the device plus one being staged,
+    the driver-loop analogue of the reference's PREFETCH_COUNT=3 (which
+    counts the buffer being FILLED as well)."""
+    return max(1, int(os.environ.get("SPARKNET_PREFETCH_DEPTH", "2")))
+
+
+def default_pull_workers(n_sources: int) -> int:
+    """Pull-pool width: min(sources, cores, SPARKNET_PULL_WORKERS cap).
+    One worker per local source saturates the fan-out; more would idle."""
+    cap = int(os.environ.get("SPARKNET_PULL_WORKERS", "8"))
+    return max(1, min(int(n_sources), os.cpu_count() or 1, cap))
+
+
+# --------------------------------------------------------------- shared pool
+# One process-wide decode/read pool shared by the self-feeding sources
+# (data/feeds.py) and scale_convert's pure-Python fallback, so N feeds don't
+# spawn N pools.  Threads by default: the native libjpeg pool releases the
+# GIL, and so do file reads and most of PIL's decode.  Pure-Python decode
+# paths can opt into a process pool with SPARKNET_INGEST_PROCS=1 (spawn
+# context — forking a process that holds jax/TPU-tunnel state is unsafe);
+# mapped functions must then be module-level picklables.
+
+_shared_lock = threading.Lock()
+_shared_pool = None
+_shared_size = 0
+
+
+def shared_pool_size() -> int:
+    """Decode/read pool width: min(cores, 8) by default; an EXPLICIT
+    SPARKNET_INGEST_WORKERS wins over the core-count heuristic (the
+    ingest_probe pooled sweep sets it to measure scaling, and oversizing
+    a GIL-releasing pool past the core count is harmless)."""
+    env = os.environ.get("SPARKNET_INGEST_WORKERS")
+    if env is not None:
+        return max(1, int(env))
+    return max(1, min(os.cpu_count() or 1, 8))
+
+
+def _get_shared_pool():
+    global _shared_pool, _shared_size
+    size = shared_pool_size()
+    if size <= 1 and not os.environ.get("SPARKNET_INGEST_PROCS"):
+        return None  # single-core host: pooling is pure overhead
+    with _shared_lock:
+        if _shared_pool is None or _shared_size != size:
+            if _shared_pool is not None:
+                _shared_pool.shutdown(wait=False)
+            import concurrent.futures as cf
+
+            if os.environ.get("SPARKNET_INGEST_PROCS"):
+                import multiprocessing as mp
+
+                _shared_pool = cf.ProcessPoolExecutor(
+                    max_workers=size, mp_context=mp.get_context("spawn"))
+            else:
+                _shared_pool = cf.ThreadPoolExecutor(
+                    max_workers=size,
+                    thread_name_prefix="sparknet-ingest")
+            _shared_size = size
+        return _shared_pool
+
+
+def pooled_map(fn: Callable[[Any], Any], items: Sequence[Any],
+               ) -> List[Any]:
+    """Order-preserving map over the shared ingest pool; falls back to a
+    plain loop on single-core hosts or single-item batches.  Exceptions
+    propagate to the caller exactly as a serial loop's would — a failed
+    decode/read must kill the feed loudly, not offset the stream."""
+    items = list(items)
+    if len(items) <= 1:
+        return [fn(x) for x in items]
+    pool = _get_shared_pool()
+    if pool is None:
+        return [fn(x) for x in items]
+    return list(pool.map(fn, items))
+
+
+# A coordinator thread caught inside a jax call while the interpreter tears
+# the XLA runtime down aborts the whole process ("terminate called without
+# an active exception") — stop every live executor BEFORE teardown.
+_live_executors: "weakref.WeakSet" = weakref.WeakSet()
+
+
+@atexit.register
+def _close_live_executors() -> None:
+    for ex in list(_live_executors):
+        try:
+            ex.close()
+        except Exception:
+            pass
+
+
+# ------------------------------------------------------------- the executor
+class PipelinedIngestExecutor:
+    """Bounded depth-k ring of staged rounds fed by a coordinator thread.
+
+    `stage_fn(round_idx)` does the actual staging (pulls, stacking,
+    device_put dispatch — the solvers pass their _stage_round) and runs on
+    the coordinator thread; intra-round fan-out across pull workers lives
+    inside stage_fn.  Rounds are staged strictly sequentially — round r+1's
+    pulls start only after round r's finished — so each source keeps its
+    serial pull order and prefetch_depth=0 vs k stay bit-exact; the
+    device transfers of staged rounds still overlap the pulls of later
+    ones because device_put only dispatches."""
+
+    def __init__(self, stage_fn: Callable[[int], Any], *, depth: int,
+                 counters=None, start_round: int = 0,
+                 name: str = "sparknet-ingest-ring") -> None:
+        if depth < 1:
+            raise ValueError(f"depth must be >= 1, got {depth}")
+        from .counters import IngestCounters
+
+        self.depth = int(depth)
+        self._stage_fn = stage_fn
+        self.counters = counters if counters is not None else IngestCounters()
+        self._ring: collections.deque = collections.deque()
+        self._cv = threading.Condition()
+        self._next = int(start_round)   # next round index to stage
+        self._staging = False           # coordinator mid-stage_fn
+        self._limit: Optional[int] = None
+        self._stop = False
+        self._done = False
+        self._err: Optional[tuple] = None
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name=name)
+        _live_executors.add(self)
+        self._thread.start()
+
+    # ------------------------------------------------------------ producer
+    def _run(self) -> None:
+        while True:
+            with self._cv:
+                # block BEFORE pulling: staged-but-unconsumed rounds
+                # (ring + the one being staged) never exceed depth
+                while (not self._stop
+                       and len(self._ring) >= self.depth):
+                    self._cv.wait(0.2)
+                if self._stop:
+                    return
+                if self._limit is not None and self._next >= self._limit:
+                    self._done = True
+                    self._cv.notify_all()
+                    return
+                r = self._next
+                self._next = r + 1
+                self._staging = True
+            try:
+                payload = self._stage_fn(r)
+            except BaseException as e:  # surfaced on the consumer's get()
+                with self._cv:
+                    self._err = (r, e)
+                    self._staging = False
+                    self._done = True
+                    self._cv.notify_all()
+                return
+            with self._cv:
+                self._ring.append((r, payload))
+                self._staging = False
+                self.counters.observe_ring(len(self._ring))
+                self.counters.bump("rounds_staged")
+                self._cv.notify_all()
+
+    # ------------------------------------------------------------ consumer
+    def get(self, expected_round: Optional[int] = None) -> Optional[Any]:
+        """Next staged round, in order; blocks (counted as stall) while the
+        ring is empty and staging is still possible.  Returns None once the
+        executor is exhausted (stop_staging()/limit reached and the ring
+        drained) — the caller then stages serially.  Raises the original
+        pull-worker exception when the consumer reaches the failed round;
+        rounds staged successfully before the failure are served first."""
+        t0 = time.perf_counter()
+        with self._cv:
+            while (not self._ring and self._err is None
+                   and not self._done and not self._stop):
+                self._cv.wait(0.2)
+            stall = time.perf_counter() - t0
+            self.counters.add("stall", stall)
+            if self._ring:
+                r, payload = self._ring.popleft()
+                self.counters.observe_ring(len(self._ring))
+                self.counters.bump("rounds_consumed")
+                self._cv.notify_all()
+                if expected_round is not None and r != expected_round:
+                    raise RuntimeError(
+                        f"staged-round order violated: got round {r}, "
+                        f"consumer expected {expected_round} — was the "
+                        f"solver's round counter mutated without closing "
+                        f"the ingest executor?")
+                return payload
+            if self._err is not None:
+                r, e = self._err
+                raise e
+            return None
+
+    # ------------------------------------------------------------- control
+    def stop_staging(self) -> None:
+        """No NEW rounds get staged beyond the one (if any) already being
+        pulled; already-staged rounds stay consumable.  This is the
+        run_round(prefetch_next=False) veto: with depth-k lookahead it can
+        only restrict future staging — up to one in-flight round may still
+        complete (documented over-pull; the old single-thread prefetch had
+        the same property for its one staged round)."""
+        with self._cv:
+            if self._limit is None or self._limit > self._next:
+                self._limit = self._next
+            self._cv.notify_all()
+
+    def close(self) -> None:
+        """Stop the coordinator and discard any staged rounds."""
+        with self._cv:
+            self._stop = True
+            self._cv.notify_all()
+        self._thread.join(timeout=30)
+        with self._cv:
+            self._ring.clear()
+
+    # ----------------------------------------------------------- introspect
+    @property
+    def staged(self) -> int:
+        with self._cv:
+            return len(self._ring)
+
+    @property
+    def exhausted(self) -> bool:
+        with self._cv:
+            return self._done and not self._ring and self._err is None
+
+    def wait_idle(self, timeout: float = 60.0) -> bool:
+        """Block until the coordinator can make no further progress without
+        the consumer: ring full, limit reached, failed, or stopped.  Test
+        hook (and a deterministic point to read pull counts)."""
+        deadline = time.monotonic() + timeout
+        with self._cv:
+            while True:
+                idle = (not self._staging
+                        and (self._done or self._stop or self._err is not None
+                             or len(self._ring) >= self.depth))
+                if idle:
+                    return True
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return False
+                self._cv.wait(min(0.2, remaining))

@@ -74,6 +74,13 @@ def decode_and_resize(jpeg_bytes: bytes, height: Optional[int] = None,
         return None
 
 
+def _decode_entry(args: Tuple[bytes, Optional[int], Optional[int]],
+                  ) -> Optional[np.ndarray]:
+    # module-level so a SPARKNET_INGEST_PROCS=1 process pool can pickle it
+    raw, height, width = args
+    return decode_and_resize(raw, height, width)
+
+
 def convert_stream(pairs: Iterable[Tuple[bytes, int]], height: int,
                    width: int, *, chunk: int = 64,
                    ) -> Iterator[Tuple[np.ndarray, int]]:
@@ -84,14 +91,31 @@ def convert_stream(pairs: Iterable[Tuple[bytes, int]], height: int,
     the TPU-VM stand-in for the reference's Spark-executor decode
     parallelism (ScaleAndConvert.scala:16-27).  Images the native decoder
     rejects get one PIL second chance (it also reads PNG); only then are
-    they dropped."""
+    they dropped.  Without the native pool, the pure-Python decode runs
+    the same `chunk`-at-a-time batches over the shared ingest pool
+    (data/pipeline.py) — threads help where PIL releases the GIL, and
+    SPARKNET_INGEST_PROCS=1 swaps in a process pool for fully serial
+    decode paths."""
     from . import native_jpeg
 
     if not (height and width) or not native_jpeg.available():
-        for raw, label in pairs:
-            arr = decode_and_resize(raw, height, width)
-            if arr is not None:
-                yield arr, label
+        from .pipeline import pooled_map
+
+        def flush_py(buf):
+            arrs = pooled_map(_decode_entry,
+                              [(raw, height, width) for raw, _ in buf])
+            for arr, (_, label) in zip(arrs, buf):
+                if arr is not None:
+                    yield arr, label
+
+        buf: List[Tuple[bytes, int]] = []
+        for item in pairs:
+            buf.append(item)
+            if len(buf) >= chunk:
+                yield from flush_py(buf)
+                buf = []
+        if buf:
+            yield from flush_py(buf)
         return
 
     def flush(buf):

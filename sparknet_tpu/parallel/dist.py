@@ -29,6 +29,9 @@ import numpy as np
 from jax import shard_map
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from ..data.counters import IngestCounters
+from ..data.pipeline import (PipelinedIngestExecutor, default_prefetch_depth,
+                             default_pull_workers)
 from ..proto.caffe_pb import NetParameter, SolverParameter
 from ..solver import updates
 from ..solver.solver import (DataSource, accumulate_test_outputs,
@@ -158,8 +161,13 @@ class DistributedSolver:
         self._rng = jax.random.PRNGKey(seed if seed >= 0 else 0)
         self.train_sources: Optional[List[DataSource]] = None
         self.test_source: Optional[DataSource] = None
-        self._staged = None      # (batches, rngs) staged for the next round
         self._prefetch = False   # set_prefetch: overlap staging with compute
+        self._prefetch_depth = default_prefetch_depth()
+        self._pull_workers: Optional[int] = None  # None = auto (cores/srcs)
+        self._pull_pool = None
+        self._pull_pool_size = 0
+        self._ingest_exec = None  # PipelinedIngestExecutor while prefetching
+        self._ingest_counters = IngestCounters()
         self._num_test_batches = 0
         self._round_fns: Dict[bool, Any] = {}
         self._test_step = jax.jit(self._build_test_step())
@@ -290,14 +298,16 @@ class DistributedSolver:
         # must not be left with the unsafe composition armed
         self._check_prefetch_safe(prefetch=self._prefetch, sources=sources)
         self.train_sources = sources
-        self._staged = None  # staged batches came from the old sources
+        self._close_ingest()  # staged rounds came from the old sources
 
     def _check_prefetch_safe(self, *, prefetch: Optional[bool] = None,
                              sources=None) -> None:
         """Refuse the prefetch × per-round-reset-feed composition: a feed
         that must be re-windowed each round (it defines `new_round`, like
-        the CifarApp MinibatchSampler WorkerFeed) would be pulled one round
-        EARLY by the look-ahead staging and silently train on offset data.
+        the CifarApp MinibatchSampler WorkerFeed) would be pulled up to
+        `prefetch_depth` rounds EARLY by the look-ahead staging and
+        silently train on offset data — the hazard grows with depth, so
+        the guard applies at ANY depth >= 1.
         A feed whose __call__ is a genuinely round-agnostic stream can
         declare `stream_safe = True` to compose with prefetch anyway.
 
@@ -312,10 +322,11 @@ class DistributedSolver:
                   and not getattr(s, "stream_safe", False)]
         if unsafe:
             raise ValueError(
-                f"set_prefetch(True) stages round N+1's batches while "
-                f"round N computes, but train source(s) {unsafe} define "
-                f"new_round() — a per-round-reset feed would be pulled one "
-                f"round early and silently train on misaligned data. "
+                f"set_prefetch(True) stages up to prefetch_depth rounds of "
+                f"batches while earlier rounds compute, but train "
+                f"source(s) {unsafe} define "
+                f"new_round() — a per-round-reset feed would be pulled "
+                f"rounds early and silently train on misaligned data. "
                 f"Disable prefetch for these sources, or set "
                 f"`stream_safe = True` on a source whose __call__ really "
                 f"is round-agnostic.")
@@ -347,12 +358,43 @@ class DistributedSolver:
             return jax.device_put(jnp.asarray(arr), self._wsh)
         return jax.make_array_from_process_local_data(self._wsh, arr)
 
+    def _map_workers(self, fn, workers: List[int]) -> List[Any]:
+        """Order-preserving per-worker fan-out over the pull pool.  Serial
+        when pooling cannot help (one worker, one core, explicit
+        pull_workers=1) or when the same source OBJECT backs several
+        workers — concurrent pulls on one shared stream would interleave
+        nondeterministically, and serial keeps the pull order bit-exact
+        with the unpooled path."""
+        n_pull = (self._pull_workers if self._pull_workers is not None
+                  else default_pull_workers(len(workers)))
+        distinct = len({id(self.train_sources[w]) for w in workers})
+        if n_pull <= 1 or len(workers) <= 1 or distinct < len(workers):
+            return [fn(w) for w in workers]
+        if self._pull_pool is None or self._pull_pool_size != n_pull:
+            import concurrent.futures as cf
+
+            if self._pull_pool is not None:
+                self._pull_pool.shutdown(wait=False)
+            self._pull_pool = cf.ThreadPoolExecutor(
+                max_workers=n_pull, thread_name_prefix="sparknet-pull")
+            self._pull_pool_size = n_pull
+        return list(self._pull_pool.map(fn, workers))
+
     def _stage_round(self, round_idx: int):
         """Pull τ host batches per local worker and start their device
         transfer — the host half of a round, separable from the compute so
         it can overlap the PREVIOUS round's device execution (the role of
         the reference's triple-buffered prefetch,
-        base_data_layer.cpp:70-98 PREFETCH_COUNT=3)."""
+        base_data_layer.cpp:70-98 PREFETCH_COUNT=3).
+
+        Per-worker pulls fan out over the pull pool (_map_workers), and in
+        the single-process case each worker's shard is device_put as soon
+        as ITS τ-stack is ready — the transfer of worker 0's block overlaps
+        the pulls of worker 1..N — then the shards are assembled into the
+        worker-major global array without another host copy.  Multi-host
+        keeps the stack-then-put path (make_array_from_process_local_data
+        wants the full local block).  Runs on the ingest coordinator thread
+        when prefetch is armed (data/pipeline.py)."""
         assert self.train_sources is not None, "set_train_data first"
         local = self.local_worker_ids()
         if not local:
@@ -361,30 +403,94 @@ class DistributedSolver:
                 f"n_workers={self.n_workers} does not cover every host — "
                 f"use at least one worker per host "
                 f"({jax.process_count()} processes)")
-        per_worker = []
-        for w in local:
+        c = self._ingest_counters
+        single = jax.process_count() == 1
+        rows = (np.asarray(self.mesh.devices).reshape(self.n_workers, -1)
+                if single else None)
+
+        def stage_worker(w: int):
             src = self.train_sources[w]
-            pulls = [src() for _ in range(self.tau)]
-            per_worker.append({k: np.stack([p[k] for p in pulls])
-                               for k in pulls[0]})
-        stacked = {k: np.stack([pw[k] for pw in per_worker])
-                   for k in per_worker[0]}
-        # device_put dispatches the copy asynchronously; it lands while the
-        # in-flight round computes
-        batches = {k: self._put_worker_major(v) for k, v in stacked.items()}
+            with c.timed("pull", items=self.tau):
+                pulls = [src() for _ in range(self.tau)]
+            with c.timed("stack"):
+                stacked = {k: np.stack([p[k] for p in pulls])
+                           for k in pulls[0]}
+            if not single:
+                return stacked
+            # eager dispatch: this worker's block starts its copy now
+            # (model-parallel rows get the same host block on every device
+            # in the row, matching the replicated trailing axes of _wsh)
+            with c.timed("device_put"):
+                return {k: [jax.device_put(v[None], d) for d in rows[w]]
+                        for k, v in stacked.items()}
+
+        per_worker = self._map_workers(stage_worker, local)
+        if single:
+            batches = {}
+            for k in per_worker[0]:
+                shards = [s for pw in per_worker for s in pw[k]]
+                batches[k] = jax.make_array_from_single_device_arrays(
+                    (self.n_workers,) + shards[0].shape[1:], self._wsh,
+                    shards)
+        else:
+            with c.timed("stack"):
+                stacked = {k: np.stack([pw[k] for pw in per_worker])
+                           for k in per_worker[0]}
+            with c.timed("device_put"):
+                batches = {k: self._put_worker_major(v)
+                           for k, v in stacked.items()}
         all_rngs = np.asarray(jax.random.split(
             jax.random.fold_in(self._rng, round_idx), self.n_workers))
         rngs = self._put_worker_major(all_rngs[np.asarray(local)])
         return batches, rngs
 
-    def set_prefetch(self, on: bool = True) -> None:
-        """Enable one-round-ahead staging: while round N computes on
-        device, round N+1's batches are pulled and transferred on a host
-        thread.  Only valid when the data sources are round-agnostic
-        streams; composing it with a per-round-reset feed (e.g. the
-        CifarApp windowed sampler) raises — see _check_prefetch_safe."""
+    def set_prefetch(self, on: bool = True, *, depth: Optional[int] = None,
+                     pull_workers: Optional[int] = None) -> None:
+        """Enable depth-k look-ahead staging: a background coordinator
+        (data/pipeline.py) keeps up to `depth` rounds pulled, stacked and
+        device-transferred ahead of the consumer, so test()/snapshot()/
+        logging gaps no longer drain the lookahead the way the old binary
+        one-round prefetch did.
+
+        depth: staged-round ring size (default: SPARKNET_PREFETCH_DEPTH
+        env, 2); depth=1 reproduces the old double buffer.  pull_workers:
+        per-worker fan-out width inside each round (default: one per local
+        source, capped at the core count).  Only valid when the data
+        sources are round-agnostic streams; composing with a per-round-
+        reset feed (e.g. the CifarApp windowed sampler) raises at ANY
+        depth — see _check_prefetch_safe.  Disarming mid-run drains the
+        already-staged rounds rather than discarding them (a discard would
+        silently offset the streams)."""
+        if depth is not None and int(depth) < 1:
+            raise ValueError(f"prefetch depth must be >= 1, got {depth}")
         self._check_prefetch_safe(prefetch=bool(on))
         self._prefetch = bool(on)
+        if depth is not None:
+            self._prefetch_depth = int(depth)
+        if pull_workers is not None:
+            self._pull_workers = max(1, int(pull_workers))
+        if not on and self._ingest_exec is not None:
+            self._ingest_exec.stop_staging()
+
+    def ingest_stats(self) -> Dict[str, Any]:
+        """Per-stage ingest counters (data/counters.py semantics: pull_s/
+        stack_s/device_put_s are CORE-seconds summed across pull workers;
+        stall_s is consumer wall-time blocked on staging; ring_occ_*
+        sample the staged-round ring), plus the live ring fill and the
+        armed depth.  bench.py lands this dict in its one-line JSON."""
+        snap = self._ingest_counters.snapshot()
+        snap["prefetch_depth"] = self._prefetch_depth if self._prefetch else 0
+        if self._ingest_exec is not None:
+            snap["staged"] = self._ingest_exec.staged
+        return snap
+
+    def reset_ingest_stats(self) -> None:
+        self._ingest_counters.reset()
+
+    def _close_ingest(self) -> None:
+        if self._ingest_exec is not None:
+            self._ingest_exec.close()
+            self._ingest_exec = None
 
     def current_lr(self, it: Optional[int] = None) -> float:
         """LR of the LAST APPLIED per-worker update (default it =
@@ -402,50 +508,47 @@ class DistributedSolver:
         (reference: one iteration of the while(true) driver loop,
         CifarApp.scala:95-136).  Returns mean loss over the round.
 
-        With set_prefetch(True), round N+1's host pulls and device
-        transfers overlap round N's device execution (double buffering —
-        the driver-loop analogue of the reference's prefetch thread).
-        `prefetch_next=False` VETOES the look-ahead for this round (pass
-        it on the final round so the run doesn't pull a batch set nobody
-        will consume); it can only restrict, never force — prefetch stays
-        off unless set_prefetch(True) armed it (which is where the
-        per-round-reset-feed guard lives)."""
-        staged = self._staged
+        With set_prefetch(True), a background coordinator
+        (data/pipeline.py) keeps up to `prefetch_depth` rounds of host
+        pulls and device transfers staged ahead of the in-flight round —
+        the depth-k generalization of the reference's prefetch thread.
+        `prefetch_next=False` VETOES further look-ahead (pass it on the
+        final round so the run doesn't pull batch sets nobody will
+        consume); it can only restrict, never force — prefetch stays off
+        unless set_prefetch(True) armed it (which is where the
+        per-round-reset-feed guard lives).  With depth-k lookahead the
+        veto stops NEW staging; up to one in-flight round may still
+        complete its pulls (documented over-pull), and already-staged
+        rounds drain in order on subsequent calls rather than being
+        discarded (a discard would silently offset the streams).  A pull
+        failure raises on the run_round that reaches the failed round —
+        never a silently offset stream."""
+        veto = prefetch_next is False
+        if veto and self._ingest_exec is not None:
+            self._ingest_exec.stop_staging()
+        if self._prefetch and not veto and self._ingest_exec is None:
+            self._ingest_exec = PipelinedIngestExecutor(
+                self._stage_round, depth=self._prefetch_depth,
+                counters=self._ingest_counters, start_round=self.round)
+        staged = None
+        if self._ingest_exec is not None:
+            staged = self._ingest_exec.get(expected_round=self.round)
+            if staged is None:  # drained after a veto/disarm: retire it
+                self._close_ingest()
         if staged is None:
+            self._ingest_counters.bump("serial_rounds")
             staged = self._stage_round(self.round)
-        self._staged = None
         batches, rngs = staged
         avg_dcn = (not self.has_dcn
                    or self.round % self.dcn_interval == self.dcn_interval - 1)
-        # async dispatch: the jitted round returns immediately
+        # async dispatch: the jitted round returns immediately, so the
+        # float(loss) fetch below is what overlaps the coordinator's
+        # staging of the next rounds
         self.params_w, self.state_w, loss = self._round_fn(avg_dcn)(
             self.params_w, self.state_w, jnp.int32(self.iter), batches, rngs)
         self.iter += self.tau
         self.round += 1
-        prefetch_next = (self._prefetch if prefetch_next is None
-                         else self._prefetch and prefetch_next)
-        if prefetch_next:
-            import threading
-
-            err: List[BaseException] = []
-
-            def stage_next():
-                try:
-                    self._staged = self._stage_round(self.round)
-                except BaseException as e:  # re-raised on the caller below
-                    err.append(e)
-
-            t = threading.Thread(target=stage_next, daemon=True)
-            t.start()
-            val = float(loss)  # blocks on the device; staging overlaps
-            t.join()
-            if err:
-                # a swallowed staging failure would surface a round late
-                # with the stream silently offset — fail loudly now
-                raise err[0]
-        else:
-            val = float(loss)
-        return val
+        return float(loss)
 
     def test(self, num_batches: Optional[int] = None) -> Dict[str, float]:
         """Evaluate the averaged model (reference: CifarApp.scala:101-116).
@@ -511,7 +614,7 @@ class DistributedSolver:
                                      state0, extra=extra)
 
     def restore(self, path: str) -> None:
-        self._staged = None  # staged batches belong to the pre-restore round
+        self._close_ingest()  # staged rounds belong to the pre-restore round
         path = resolve_solverstate_path(path)
         if path.endswith(".solverstate") or path.endswith(".h5"):
             # reference-format pair written by snapshot_caffe_style: weights

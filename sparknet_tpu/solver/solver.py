@@ -25,6 +25,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..core.net import Net
+from ..data.counters import IngestCounters
+from ..data.pipeline import PipelinedIngestExecutor, default_prefetch_depth
 from ..proto import caffe_pb
 from ..proto.caffe_pb import NetParameter, SolverParameter
 from . import updates
@@ -238,6 +240,10 @@ class Solver:
         self.test_source: Optional[DataSource] = None
         self._num_test_batches = 0
         self.action_source = None  # optional utils.signals.SignalHandler
+        self._prefetch = False
+        self._prefetch_depth = default_prefetch_depth()
+        self._ingest_exec = None  # PipelinedIngestExecutor while prefetching
+        self._ingest_counters = IngestCounters()
 
         self._lr_mults = self.net.lr_multipliers()
         self._decay_mults = self.net.decay_multipliers()
@@ -249,7 +255,62 @@ class Solver:
     # ----------------------------------------------------------------- data
     def set_train_data(self, source: DataSource) -> None:
         """(reference: Net.scala:83-88 setTrainData)"""
+        self._check_prefetch_safe(prefetch=self._prefetch, source=source)
         self.train_source = source
+        self._close_ingest()  # staged iterations came from the old source
+
+    def _check_prefetch_safe(self, *, prefetch: Optional[bool] = None,
+                             source=None) -> None:
+        """Same contract as DistributedSolver._check_prefetch_safe: a feed
+        that defines `new_round` (per-round reset) would be pulled up to
+        `prefetch_depth` iterations EARLY by look-ahead staging — refuse
+        the composition at any depth unless the feed declares
+        `stream_safe = True`."""
+        prefetch = self._prefetch if prefetch is None else prefetch
+        source = self.train_source if source is None else source
+        if not (prefetch and source is not None):
+            return
+        if (hasattr(source, "new_round")
+                and not getattr(source, "stream_safe", False)):
+            raise ValueError(
+                "set_prefetch(True) stages future iterations' batches "
+                "while earlier ones compute, but the train source defines "
+                "new_round() — a per-round-reset feed would be pulled "
+                "early and silently train on misaligned data.  Disable "
+                "prefetch for this source, or set `stream_safe = True` on "
+                "a source whose __call__ really is round-agnostic.")
+
+    def set_prefetch(self, on: bool = True, *,
+                     depth: Optional[int] = None) -> None:
+        """Depth-k look-ahead staging of whole iterations (iter_size pulls
+        + stack + device transfer) on a background coordinator
+        (data/pipeline.py) — the single-chip analogue of
+        DistributedSolver.set_prefetch.  Disarming drains already-staged
+        iterations rather than discarding them."""
+        if depth is not None and int(depth) < 1:
+            raise ValueError(f"prefetch depth must be >= 1, got {depth}")
+        self._check_prefetch_safe(prefetch=bool(on))
+        self._prefetch = bool(on)
+        if depth is not None:
+            self._prefetch_depth = int(depth)
+        if not on and self._ingest_exec is not None:
+            self._ingest_exec.stop_staging()
+
+    def ingest_stats(self) -> Dict[str, Any]:
+        """Per-stage ingest counters (data/counters.py semantics)."""
+        snap = self._ingest_counters.snapshot()
+        snap["prefetch_depth"] = self._prefetch_depth if self._prefetch else 0
+        if self._ingest_exec is not None:
+            snap["staged"] = self._ingest_exec.staged
+        return snap
+
+    def reset_ingest_stats(self) -> None:
+        self._ingest_counters.reset()
+
+    def _close_ingest(self) -> None:
+        if self._ingest_exec is not None:
+            self._ingest_exec.close()
+            self._ingest_exec = None
 
     def set_test_data(self, source: DataSource, num_batches: int) -> None:
         self.test_source = source
@@ -351,6 +412,21 @@ class Solver:
         batch = source()
         return {k: jnp.asarray(v) for k, v in batch.items()}
 
+    def _stage_iter(self, it: int) -> Dict[str, jnp.ndarray]:
+        """Host half of one iteration: iter_size pulls + device transfer +
+        stack.  Runs on the ingest coordinator thread when prefetch is
+        armed (the iteration index is only used for order checking — the
+        consume-time rng fold_in in step() keeps trajectories bit-exact
+        with the serial path)."""
+        c = self._ingest_counters
+        iter_size = int(self.param.iter_size)
+        with c.timed("pull", items=iter_size):
+            raw = [self.train_source() for _ in range(iter_size)]
+        with c.timed("device_put"):
+            pulls = [{k: jnp.asarray(v) for k, v in b.items()} for b in raw]
+        with c.timed("stack"):
+            return {k: jnp.stack([p[k] for p in pulls]) for k in pulls[0]}
+
     def current_lr(self, it: Optional[int] = None) -> float:
         """LR of the LAST APPLIED update (default it = iter-1), the value
         the reference logs each display interval (sgd_solver.cpp:102-110;
@@ -378,9 +454,19 @@ class Solver:
                     break
                 if action is SolverAction.SNAPSHOT:
                     self.snapshot_caffe_style()
-            pulls = [self._pull(self.train_source) for _ in range(iter_size)]
-            stacked = {k: jnp.stack([p[k] for p in pulls])
-                       for k in pulls[0]}
+            stacked = None
+            if self._prefetch and self._ingest_exec is None:
+                self._ingest_exec = PipelinedIngestExecutor(
+                    self._stage_iter, depth=self._prefetch_depth,
+                    counters=self._ingest_counters, start_round=self.iter,
+                    name="sparknet-solver-ingest")
+            if self._ingest_exec is not None:
+                stacked = self._ingest_exec.get(expected_round=self.iter)
+                if stacked is None:  # drained after a disarm: retire it
+                    self._close_ingest()
+            if stacked is None:
+                self._ingest_counters.bump("serial_rounds")
+                stacked = self._stage_iter(self.iter)
             rng = jax.random.fold_in(self._rng, self.iter)
             self.params, self.state, loss = self._train_step(
                 self.params, self.state, jnp.int32(self.iter), stacked, rng)
@@ -478,6 +564,7 @@ class Solver:
         Accepts the native .npz or either reference .solverstate format; a
         bare `x.h5` resolves to `x.solverstate.h5` if that exists (the pair
         snapshot(x.h5) wrote)."""
+        self._close_ingest()  # staged iterations predate the restore point
         path = resolve_solverstate_path(path)
         if path.endswith(".solverstate") or path.endswith(".h5"):
             self._restore_caffe_state(path)

@@ -92,7 +92,10 @@ def restore(path: str, *, known_params=None,
 
     path = os.path.abspath(path)
     ckpt = _checkpointer()
-    tree = ckpt.metadata(path).item_metadata.tree
+    # current orbax wraps the tree (metadata().item_metadata.tree);
+    # 0.7.x PyTreeCheckpointer.metadata() returns the tree dict itself
+    meta = ckpt.metadata(path)
+    tree = meta if isinstance(meta, dict) else meta.item_metadata.tree
     if known_params is not None:
         unknown = set(tree["params"]) - set(known_params)
         if unknown:

@@ -1,0 +1,253 @@
+"""Depth-k ingest pipeline invariants (data/pipeline.py, data/counters.py)
+on the CPU mesh: ring occupancy stays bounded, delivery stays ordered
+under slow/fast producers, pull failures surface loudly (never a silent
+stream offset), the new_round guard fires at ANY depth, and the
+pipelined training path is bit-exact against serial staging.
+
+The reference analogue of the whole module is the data-layer prefetch
+thread (reference: base_data_layer.cpp:70-98, PREFETCH_COUNT=3); its
+contract here is generalized to whole τ-rounds and depth-k lookahead.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+from sparknet_tpu.core import layers_dsl as dsl
+from sparknet_tpu.data.counters import IngestCounters
+from sparknet_tpu.data.pipeline import (PipelinedIngestExecutor,
+                                        default_prefetch_depth,
+                                        default_pull_workers, pooled_map)
+from sparknet_tpu.parallel.dist import DistributedSolver
+from sparknet_tpu.parallel.mesh import make_mesh
+from sparknet_tpu.proto import caffe_pb
+from sparknet_tpu.proto.textformat import parse
+
+
+# --------------------------------------------------------------- executor
+def test_ring_occupancy_never_exceeds_depth():
+    """The coordinator blocks BEFORE pulling: staged-but-unconsumed rounds
+    never exceed `depth`, no matter how slow the consumer is."""
+    counters = IngestCounters()
+
+    def stage(r):
+        return r * 10
+
+    ex = PipelinedIngestExecutor(stage, depth=3, counters=counters)
+    try:
+        assert ex.wait_idle(10)
+        # consume a few rounds with a deliberately lagging consumer; the
+        # ring must refill to depth but never beyond it
+        for expect in range(5):
+            assert ex.staged <= 3
+            got = ex.get(expected_round=expect)
+            assert got == expect * 10
+            time.sleep(0.01)
+            assert ex.staged <= 3
+        assert ex.wait_idle(10)
+        assert ex.staged == 3
+        snap = counters.snapshot()
+        assert snap["ring_occ_max"] <= 3
+        assert snap["rounds_staged"] - snap["rounds_consumed"] == ex.staged
+    finally:
+        ex.close()
+
+
+def test_depth_must_be_positive():
+    with pytest.raises(ValueError, match="depth"):
+        PipelinedIngestExecutor(lambda r: r, depth=0)
+
+
+def test_ordered_delivery_under_variable_stage_latency():
+    """Rounds come out 0,1,2,... even when staging latency varies wildly
+    (slow producer round, then fast ones): order is by ROUND, never by
+    completion luck."""
+    def stage(r):
+        # round 1 is slow, the rest are instant
+        if r == 1:
+            time.sleep(0.15)
+        return ("round", r)
+
+    ex = PipelinedIngestExecutor(stage, depth=2)
+    try:
+        for expect in range(6):
+            assert ex.get(expected_round=expect) == ("round", expect)
+    finally:
+        ex.close()
+
+
+def test_pull_failure_surfaces_on_the_failed_round():
+    """A pull-worker exception reaches the consumer on the get() of the
+    FAILED round; earlier successfully staged rounds are served first —
+    the loud-failure contract that forbids silent stream offsets."""
+    boom = RuntimeError("decode exploded")
+
+    def stage(r):
+        if r == 2:
+            raise boom
+        return r
+
+    ex = PipelinedIngestExecutor(stage, depth=4)
+    try:
+        assert ex.get(expected_round=0) == 0
+        assert ex.get(expected_round=1) == 1
+        with pytest.raises(RuntimeError, match="decode exploded"):
+            ex.get(expected_round=2)
+        # the executor is dead, not offset: round 3 never appears, the
+        # error re-raises on every further get()
+        with pytest.raises(RuntimeError, match="decode exploded"):
+            ex.get()
+    finally:
+        ex.close()
+
+
+def test_stop_staging_drains_in_order_then_exhausts():
+    """The veto path: stop_staging() restricts FUTURE staging only;
+    already-staged rounds drain in order, then get() returns None (the
+    serial-fallback signal), never discarding staged pulls."""
+    def stage(r):
+        return r
+
+    ex = PipelinedIngestExecutor(stage, depth=2)
+    try:
+        assert ex.wait_idle(10)
+        ex.stop_staging()
+        got = []
+        while True:
+            v = ex.get()
+            if v is None:
+                break
+            got.append(v)
+        # depth=2 staged + at most one in-flight over-pull
+        assert got in ([0, 1], [0, 1, 2])
+        assert ex.exhausted
+    finally:
+        ex.close()
+
+
+def test_pooled_map_preserves_order_and_propagates():
+    assert pooled_map(lambda x: x * 2, [3, 1, 2]) == [6, 2, 4]
+    with pytest.raises(ZeroDivisionError):
+        pooled_map(lambda x: 1 // x, [1, 0, 2])
+
+
+def test_default_knobs(monkeypatch):
+    monkeypatch.delenv("SPARKNET_PREFETCH_DEPTH", raising=False)
+    assert default_prefetch_depth() == 2
+    monkeypatch.setenv("SPARKNET_PREFETCH_DEPTH", "5")
+    assert default_prefetch_depth() == 5
+    assert default_pull_workers(1) == 1
+    assert default_pull_workers(100) <= 8
+
+
+# ------------------------------------------------------------ solver wiring
+SP_TEXT = ('base_lr: 0.05 lr_policy: "fixed" momentum: 0.9 '
+           'weight_decay: 0.004 random_seed: 11')
+
+
+def lenet_net(batch=8):
+    """Small LeNet-shaped conv net (conv-pool-conv-pool-ip-ip), the
+    parity workload ISSUE'd for the depth-0 vs depth-2 bit-exactness
+    check."""
+    return dsl.net_param(
+        "lenet_tiny",
+        dsl.memory_data_layer("data", ["data", "label"], batch=batch,
+                              channels=1, height=12, width=12),
+        dsl.convolution_layer("conv1", "data", num_output=4, kernel_size=3,
+                              weight_filler={"type": "gaussian",
+                                             "std": 0.1}),
+        dsl.pooling_layer("pool1", "conv1", pool="MAX", kernel_size=2,
+                          stride=2),
+        dsl.convolution_layer("conv2", "pool1", num_output=6,
+                              kernel_size=3,
+                              weight_filler={"type": "gaussian",
+                                             "std": 0.1}),
+        dsl.pooling_layer("pool2", "conv2", pool="MAX", kernel_size=2,
+                          stride=2),
+        dsl.inner_product_layer("ip1", "pool2", num_output=10,
+                                weight_filler={"type": "gaussian",
+                                               "std": 0.1}),
+        dsl.relu_layer("relu1", "ip1"),
+        dsl.inner_product_layer("ip2", "ip1", num_output=4,
+                                weight_filler={"type": "gaussian",
+                                               "std": 0.1}),
+        dsl.softmax_with_loss_layer("loss", ["ip2", "label"]),
+    )
+
+
+def lenet_stream(seed, batch=8):
+    rng = np.random.RandomState(seed)
+
+    def source():
+        x = rng.randn(batch, 1, 12, 12).astype(np.float32)
+        y = rng.randint(0, 4, size=(batch,)).astype(np.int32)
+        return {"data": x, "label": y}
+
+    return source
+
+
+def make_ds(n_workers=4, tau=2, batch=8):
+    sp = caffe_pb.SolverParameter(parse(SP_TEXT))
+    return DistributedSolver(sp, net_param=lenet_net(batch),
+                             n_workers=n_workers, tau=tau,
+                             mesh=make_mesh(n_workers))
+
+
+def test_new_round_guard_fires_at_any_depth():
+    """A per-round-reset feed (new_round, no stream_safe) must be refused
+    at EVERY lookahead depth >= 1, not just the old binary prefetch."""
+    class WindowedFeed:
+        def __call__(self):
+            return {"data": np.zeros((8, 1, 12, 12), np.float32),
+                    "label": np.zeros((8,), np.int32)}
+
+        def new_round(self):
+            pass
+
+    for depth in (1, 2, 5):
+        ds = make_ds(n_workers=2)
+        ds.set_train_data([WindowedFeed() for _ in range(2)])
+        with pytest.raises(ValueError, match="new_round"):
+            ds.set_prefetch(True, depth=depth)
+        assert ds._prefetch is False
+
+
+def test_lenet_loss_trajectory_bit_exact_depth0_vs_depth2():
+    """4-round LeNet run: the pipelined path (depth=2, pooled pulls) and
+    the serial path produce IDENTICAL loss trajectories and final params
+    — staging ahead must change scheduling only, never data order or
+    math (ISSUE acceptance criterion)."""
+    rounds = 4
+
+    a = make_ds()
+    a.set_train_data([lenet_stream(50 + w) for w in range(4)])
+    losses_a = [a.run_round() for _ in range(rounds)]
+
+    b = make_ds()
+    b.set_train_data([lenet_stream(50 + w) for w in range(4)])
+    b.set_prefetch(True, depth=2, pull_workers=4)
+    losses_b = [b.run_round() for _ in range(rounds)]
+    b._close_ingest()
+
+    np.testing.assert_array_equal(np.asarray(losses_a),
+                                  np.asarray(losses_b))
+    for k, v in a.params_w.items():
+        np.testing.assert_array_equal(np.asarray(v),
+                                      np.asarray(b.params_w[k]), err_msg=k)
+
+
+def test_ingest_stats_shape_and_reset():
+    ds = make_ds(n_workers=2)
+    ds.set_train_data([lenet_stream(7 + w) for w in range(2)])
+    ds.set_prefetch(True, depth=2, pull_workers=1)
+    ds.run_round()
+    stats = ds.ingest_stats()
+    for key in ("pull_s", "stack_s", "device_put_s", "stall_s",
+                "pull_items", "prefetch_depth"):
+        assert key in stats, key
+    assert stats["prefetch_depth"] == 2
+    assert stats["pull_items"] >= 2 * 2  # >= tau pulls x 2 workers
+    ds.reset_ingest_stats()
+    assert ds.ingest_stats()["pull_items"] == 0
+    ds._close_ingest()

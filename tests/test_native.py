@@ -196,13 +196,16 @@ layer { name: "loss" type: "SoftmaxWithLoss" bottom: "ip" bottom: "label"
             out.append(src)
         return out
 
-    # prefetch on: after round 0 returns, round 1 is staged => 2 rounds of
-    # pulls consumed after ONE run_round
+    # prefetch on (depth=1 = the historical double buffer): once the
+    # ingest executor goes idle after round 0, round 1 is staged => 2
+    # rounds of pulls consumed after ONE run_round
     s = DistributedSolver(sp, mesh=make_mesh(4), tau=2)
     s.set_train_data(make_sources(4))
-    s.set_prefetch(True)
+    s.set_prefetch(True, depth=1, pull_workers=1)
     s.run_round()
-    assert s._staged is not None
+    assert s._ingest_exec is not None
+    assert s._ingest_exec.wait_idle(30)
+    assert s._ingest_exec.staged == 1
     assert pulls["n"] == 2 * 4 * 2  # two rounds x 4 workers x tau=2
 
     # numerical equivalence with the unprefetched path

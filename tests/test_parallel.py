@@ -278,7 +278,7 @@ def test_prefetch_refuses_per_round_reset_feeds():
     for f in ds.train_sources:
         f.new_round()
     ds.run_round(prefetch_next=True)
-    assert ds._staged is None, \
+    assert ds._ingest_exec is None, \
         "prefetch_next must not force staging when prefetch is unarmed"
     # ...and an explicitly stream-safe feed opts back in
     safe = WorkerFeed(imgs, labels, mean, 16, 2, seed=3)
