@@ -301,6 +301,68 @@ def bench_inference(name, model_dir, batch, fuse_1x1=False):
     return out
 
 
+def bench_serving(model: str = "lenet", offered_qps: float = 200.0,
+                  n_requests: int = 400, max_batch: int = 8,
+                  max_wait_ms: float = 4.0, seed: int = 0) -> dict:
+    """Online-serving latency + throughput at a fixed offered load: the
+    serving engine (sparknet_tpu/serving/) fronting LeNet on the CPU
+    backend, driven open-loop with Poisson arrivals — p50/p99 response
+    latency and achieved QPS under micro-batching.
+
+    CPU on purpose: the serving numbers must stay comparable across
+    driver runs whether or not the axon tunnel has a window open, and
+    the tunnel's 65-100 ms fetch RTT would swamp millisecond-scale
+    online latencies anyway (BENCH_NOTES.md) — model-level TPU serving
+    throughput is already covered by the bench_inference legs."""
+    import jax
+
+    from sparknet_tpu.serving import (InferenceServer, ServerConfig,
+                                      ServerOverloaded)
+
+    try:
+        cpu = jax.devices("cpu")[0]
+    except RuntimeError:
+        cpu = None  # CPU backend unavailable: serve on the default device
+    server = InferenceServer(ServerConfig(max_batch=max_batch,
+                                          max_wait_ms=max_wait_ms,
+                                          queue_depth=16 * max_batch))
+    try:
+        lm = server.load(model, device=cpu)
+        shape = lm.runner.sample_shape
+        rng = np.random.RandomState(seed)
+        pool = rng.rand(32, *shape).astype(np.float32)
+        gaps = rng.exponential(1.0 / offered_qps, size=n_requests)
+        futs = []
+        rejected = 0
+        t0 = time.perf_counter()
+        next_t = t0
+        for i in range(n_requests):
+            next_t += gaps[i]
+            now = time.perf_counter()
+            if next_t > now:
+                time.sleep(next_t - now)
+            try:
+                futs.append(server.submit(model, pool[i % len(pool)]))
+            except ServerOverloaded:
+                rejected += 1
+        for f in futs:
+            f.result(timeout=120)
+        elapsed = time.perf_counter() - t0
+        st = server.stats()["models"][model]
+    finally:
+        server.close(drain=True)
+    out = {"serving_model": model,
+           "serving_offered_qps": round(offered_qps, 1),
+           "serving_qps": round(st["completed"] / elapsed, 1),
+           "serving_p50_ms": st["total_ms"]["p50_ms"],
+           "serving_p99_ms": st["total_ms"]["p99_ms"],
+           "serving_batch_occupancy": st["batch_occupancy_mean"],
+           "serving_rejected": rejected,
+           "serving_compiles": st["engine_compiles"]}
+    log(json.dumps(out))
+    return out
+
+
 def bench_longctx_lm(seq_len: int = 16384, n_layers: int = 4,
                      d_model: int = 512, heads: int = 8,
                      block: int = 1024):
@@ -539,6 +601,9 @@ _KNOWN_FIELDS = {
     "cifar_e2e_ingest",
     "imagenet_native_fed_imgs_per_sec", "imagenet_native_batch",
     "imagenet_native_tau", "imagenet_native_ingest",
+    "serving_model", "serving_offered_qps", "serving_qps",
+    "serving_p50_ms", "serving_p99_ms", "serving_batch_occupancy",
+    "serving_rejected", "serving_compiles",
 }
 
 # every leg name main() lands; leg_utc stamps outside this set (renamed
@@ -547,7 +612,7 @@ _KNOWN_FIELDS = {
 _KNOWN_LEGS = {
     "alexnet_train", "googlenet_train_b64", "googlenet_train_b128",
     "alexnet_infer", "googlenet_infer", "longctx_lm", "cifar_e2e",
-    "imagenet_native",
+    "imagenet_native", "serving",
 }
 
 
@@ -843,6 +908,19 @@ def _run_legs(land) -> None:
     land("cifar_e2e", {"cifar_e2e_imgs_per_sec":
                        round(cifar_e2e["imgs_per_sec"], 1),
                        "cifar_e2e_ingest": cifar_e2e["ingest"]})
+    # online-serving leg (CPU backend by design — see bench_serving
+    # docstring); guarded so a serving regression degrades one leg
+    # rather than staling every device number already landed above
+    try:
+        serving = bench_serving()
+    except Exception as e:
+        log(f"serving leg failed, omitting its fields: {e!r}")
+    else:
+        land("serving", {k: serving[k] for k in (
+            "serving_model", "serving_offered_qps", "serving_qps",
+            "serving_p50_ms", "serving_p99_ms",
+            "serving_batch_occupancy", "serving_rejected",
+            "serving_compiles")})
     try:
         imgnet_native = bench_imagenet_native()
     except Exception as e:

@@ -1,0 +1,182 @@
+"""Load generator for the online serving engine (sparknet_tpu/serving/).
+
+Drives an in-process InferenceServer with either a CLOSED loop (`--mode
+closed`: N worker threads, each submits, waits for the response, submits
+again — measures best-case latency at full pipelining) or a Poisson OPEN
+loop (`--mode open`: arrivals drawn from an exponential inter-arrival
+distribution at `--qps`, submitted on schedule regardless of completions
+— the honest tail-latency protocol: a closed loop self-throttles when
+the server slows down and hides queueing delay).
+
+Prints per-phase progress on stderr and ONE summary JSON line on stdout;
+with `--jsonl out.jsonl` it also appends one record per request (id,
+bucket, queue_wait/assembly/device/total ms, or the rejection error) —
+commit those incrementally (scripts/autocommit_distacc.sh pattern) so a
+box reboot cannot eat an in-flight study.
+
+Examples:
+    python scripts/serve_loadgen.py --model lenet --mode open --qps 200
+    python scripts/serve_loadgen.py --model lenet --mode closed \
+        --concurrency 16 --requests 2000 --jsonl serve_study.jsonl
+"""
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> None:
+    p = argparse.ArgumentParser(
+        description="closed/open-loop load generator for sparknet serve")
+    p.add_argument("--model", default="lenet",
+                   help="zoo name or deploy prototxt path")
+    p.add_argument("--weights", default=None)
+    p.add_argument("--mode", choices=("closed", "open"), default="open")
+    p.add_argument("--qps", type=float, default=200.0,
+                   help="offered load (open loop only)")
+    p.add_argument("--concurrency", type=int, default=8,
+                   help="worker threads (closed loop only)")
+    p.add_argument("--requests", type=int, default=500)
+    p.add_argument("--max_batch", type=int, default=8)
+    p.add_argument("--max_wait_ms", type=float, default=4.0)
+    p.add_argument("--queue_depth", type=int, default=128)
+    p.add_argument("--deadline_ms", type=float, default=None)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--jsonl", default=None,
+                   help="append one record per request to this file")
+    a = p.parse_args()
+
+    from sparknet_tpu.utils.compile_cache import (apply_platform_env,
+                                                  maybe_enable_compile_cache)
+
+    apply_platform_env()
+    maybe_enable_compile_cache()
+    import numpy as np
+
+    from sparknet_tpu.serving import (InferenceServer, ServerConfig,
+                                      ServingError)
+
+    def log(msg):
+        print(msg, file=sys.stderr, flush=True)
+
+    sink = open(a.jsonl, "a") if a.jsonl else None
+    sink_lock = threading.Lock()
+
+    def record(rec):
+        if sink is None:
+            return
+        with sink_lock:
+            sink.write(json.dumps(rec) + "\n")
+            sink.flush()
+
+    server = InferenceServer(ServerConfig(
+        max_batch=a.max_batch, max_wait_ms=a.max_wait_ms,
+        queue_depth=a.queue_depth, default_deadline_ms=a.deadline_ms))
+    rejects = {"n": 0}
+    rejects_lock = threading.Lock()
+
+    def settle(rid, fut, t_submit):
+        """Wait one future; record its disposition."""
+        try:
+            r = fut.result(timeout=120)
+        except ServingError as e:
+            with rejects_lock:
+                rejects["n"] += 1
+            record({"id": rid, "error": type(e).__name__,
+                    "status": e.status})
+            return None
+        record({"id": rid, "bucket": r.bucket,
+                "queue_wait_ms": r.queue_wait_ms,
+                "assembly_ms": r.assembly_ms,
+                "device_ms": r.device_ms, "total_ms": r.total_ms,
+                "client_ms": round((time.perf_counter() - t_submit) * 1e3,
+                                   4)})
+        return r
+
+    try:
+        lm = server.load(a.model, weights=a.weights, seed=a.seed)
+        shape = lm.runner.sample_shape
+        rng = np.random.RandomState(a.seed)
+        pool = rng.rand(64, *shape).astype(np.float32)
+        log(f"loaded {a.model}: input {shape}, buckets "
+            f"{lm.runner.buckets}, {lm.runner.compile_count()} compiles")
+
+        t0 = time.perf_counter()
+        if a.mode == "open":
+            gaps = rng.exponential(1.0 / a.qps, size=a.requests)
+            futs, next_t = [], t0
+            for i in range(a.requests):
+                next_t += gaps[i]
+                now = time.perf_counter()
+                if next_t > now:
+                    time.sleep(next_t - now)
+                try:
+                    futs.append((i, server.submit(a.model,
+                                                  pool[i % len(pool)]),
+                                 time.perf_counter()))
+                except ServingError as e:
+                    with rejects_lock:
+                        rejects["n"] += 1
+                    record({"id": i, "error": type(e).__name__,
+                            "status": e.status})
+            for rid, fut, ts in futs:
+                settle(rid, fut, ts)
+        else:
+            counter = {"next": 0}
+            counter_lock = threading.Lock()
+
+            def worker():
+                while True:
+                    with counter_lock:
+                        rid = counter["next"]
+                        if rid >= a.requests:
+                            return
+                        counter["next"] = rid + 1
+                    ts = time.perf_counter()
+                    try:
+                        fut = server.submit(a.model, pool[rid % len(pool)],
+                                            wait=True)
+                    except ServingError as e:
+                        with rejects_lock:
+                            rejects["n"] += 1
+                        record({"id": rid, "error": type(e).__name__,
+                                "status": e.status})
+                        continue
+                    settle(rid, fut, ts)
+
+            threads = [threading.Thread(target=worker, daemon=True)
+                       for _ in range(a.concurrency)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+        elapsed = time.perf_counter() - t0
+        st = server.stats()["models"][a.model]
+    finally:
+        server.close(drain=True)
+        if sink is not None:
+            sink.close()
+
+    out = {"mode": a.mode, "model": a.model, "requests": a.requests,
+           "completed": st["completed"], "rejected": rejects["n"],
+           "elapsed_s": round(elapsed, 3),
+           "achieved_qps": round(st["completed"] / elapsed, 1),
+           "batch_occupancy_mean": st["batch_occupancy_mean"],
+           "bucket_counts": st["bucket_counts"],
+           "compiles": st["engine_compiles"],
+           "p50_ms": st["total_ms"]["p50_ms"],
+           "p95_ms": st["total_ms"]["p95_ms"],
+           "p99_ms": st["total_ms"]["p99_ms"],
+           "queue_wait_p99_ms": st["queue_wait_ms"]["p99_ms"]}
+    if a.mode == "open":
+        out["offered_qps"] = a.qps
+    print(json.dumps(out), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -82,6 +82,102 @@ def load_image(path: str, color: bool = True) -> np.ndarray:
     return arr
 
 
+class Preprocessor:
+    """The reference Transformer's preprocessing, factored apart from the
+    forward pass (reference: io.py Transformer:123-153 + the crop policy
+    of classifier.py:47-98) so request-level callers — the serving
+    micro-batcher (serving/server.py) scores one sample at a time — can
+    produce net-ready arrays without re-jitting or owning a net.
+
+    Order: resize to `image_dims` -> crop(s) to `crop_dims` ->
+    raw_scale -> channel_swap -> HWC->CHW -> mean subtract -> input_scale.
+    """
+
+    def __init__(self, image_dims: Sequence[int], crop_dims: Sequence[int],
+                 *, mean: Optional[np.ndarray] = None,
+                 input_scale: Optional[float] = None,
+                 raw_scale: Optional[float] = None,
+                 channel_swap: Optional[Sequence[int]] = None) -> None:
+        self.image_dims = np.asarray(image_dims)
+        self.crop_dims = np.asarray(crop_dims)
+        self.mean = mean
+        self.input_scale = input_scale
+        self.raw_scale = raw_scale
+        self.channel_swap = channel_swap
+
+    def transform(self, crops_hwc: np.ndarray) -> np.ndarray:
+        """HWC crop batch -> net-ready NCHW (the Transformer arithmetic,
+        io.py:123-153)."""
+        x = crops_hwc
+        if self.raw_scale is not None:
+            x = x * self.raw_scale
+        if self.channel_swap is not None:
+            x = x[..., list(self.channel_swap)]
+        x = np.transpose(x, (0, 3, 1, 2)).astype(np.float32)
+        if self.mean is not None:
+            m = self.mean
+            if m.ndim == 1:
+                m = m[:, None, None]
+            x = x - m
+        if self.input_scale is not None:
+            x = x * self.input_scale
+        return x
+
+    def batch(self, inputs: Sequence[np.ndarray],
+              oversample_crops: bool = True) -> Tuple[np.ndarray, int]:
+        """Images -> (net-ready NCHW stack, crops-per-image).  The
+        classifier's predict() path: resize all, then 10-crop or center
+        crop."""
+        imgs = [resize_image(im, self.image_dims) for im in inputs]
+        if oversample_crops:
+            crops = oversample(imgs, self.crop_dims)
+            n_per = 10
+        else:
+            crops = center_crop(imgs, self.crop_dims)
+            n_per = 1
+        return self.transform(crops), n_per
+
+    def one(self, image_hwc: np.ndarray) -> np.ndarray:
+        """One HWC image -> ONE net-ready CHW sample (resize + center
+        crop) — the per-request serving path, where oversampling would
+        multiply device work 10x per call."""
+        x, _ = self.batch([image_hwc], oversample_crops=False)
+        return x[0]
+
+
+def probability_blob(net) -> str:
+    """The blob `predict`-style callers read: last softmax-ish output,
+    else the last top blob (reference: classify.py reads 'prob')."""
+    for layer in reversed(net.layers):
+        if layer.type in ("Softmax",):
+            return layer.tops[0]
+    return net.output_blobs[-1]
+
+
+def load_pretrained(net, params, path: str):
+    """Warm-start `params` from .npz weight files or .caffemodel/.h5
+    blobs; returns the updated params dict (reference:
+    Net::CopyTrainedLayersFrom, net.cpp:805-860).  Shared by Classifier
+    and the serving model registry (serving/engine.py)."""
+    import jax.numpy as jnp
+
+    if path.endswith(".caffemodel"):
+        from .proto.binaryproto import read_caffemodel
+
+        weights = read_caffemodel(path)
+    elif path.endswith(".h5"):
+        from .proto.hdf5_format import read_weights_hdf5
+
+        weights = read_weights_hdf5(path)
+    else:
+        z = np.load(path)
+        return {k: jnp.asarray(z[k]) if k in z.files else v
+                for k, v in params.items()}
+    names = {bl.name for bl in net.layers}
+    return net.set_weights(
+        params, {k: v for k, v in weights.items() if k in names})
+
+
 class Classifier:
     """TEST-phase forward classification with reference-compatible
     preprocessing (reference: classifier.py:11-98).
@@ -138,59 +234,24 @@ class Classifier:
         self.input_scale = input_scale
         self.raw_scale = raw_scale
         self.channel_swap = channel_swap
+        self.preprocessor = Preprocessor(
+            self.image_dims, self.crop_dims, mean=mean,
+            input_scale=input_scale, raw_scale=raw_scale,
+            channel_swap=channel_swap)
 
     def _load_pretrained(self, path: str) -> None:
-        """Accepts .npz weight files or .caffemodel binaryprotos
-        (reference: Net::CopyTrainedLayersFrom, net.cpp:805-860)."""
-        import jax.numpy as jnp
-
-        if path.endswith(".caffemodel"):
-            from .proto.binaryproto import read_caffemodel
-
-            weights = read_caffemodel(path)
-        elif path.endswith(".h5"):
-            from .proto.hdf5_format import read_weights_hdf5
-
-            weights = read_weights_hdf5(path)
-        else:
-            z = np.load(path)
-            self.params = {k: jnp.asarray(z[k]) if k in z.files else v
-                           for k, v in self.params.items()}
-            return
-        names = {bl.name for bl in self.net.layers}
-        self.params = self.net.set_weights(
-            self.params, {k: v for k, v in weights.items() if k in names})
+        self.params = load_pretrained(self.net, self.params, path)
 
     def _preprocess(self, crops: np.ndarray) -> np.ndarray:
         """HWC crop batch -> net-ready NCHW (reference: io.py
         Transformer.preprocess:123-153)."""
-        x = crops
-        if self.raw_scale is not None:
-            x = x * self.raw_scale
-        if self.channel_swap is not None:
-            x = x[..., list(self.channel_swap)]
-        x = np.transpose(x, (0, 3, 1, 2)).astype(np.float32)
-        if self.mean is not None:
-            m = self.mean
-            if m.ndim == 1:
-                m = m[:, None, None]
-            x = x - m
-        if self.input_scale is not None:
-            x = x * self.input_scale
-        return x
+        return self.preprocessor.transform(crops)
 
     def predict(self, inputs: Sequence[np.ndarray],
                 oversample_crops: bool = True) -> np.ndarray:
         """(N_images, n_classes) probabilities; 10-crop averaged when
         `oversample_crops` (reference: classifier.py:47-98)."""
-        imgs = [resize_image(im, self.image_dims) for im in inputs]
-        if oversample_crops:
-            crops = oversample(imgs, self.crop_dims)
-            n_per = 10
-        else:
-            crops = center_crop(imgs, self.crop_dims)
-            n_per = 1
-        x = self._preprocess(crops)
+        x, n_per = self.preprocessor.batch(inputs, oversample_crops)
         probs = self._forward_probs(x)
         probs = probs.reshape(len(inputs), n_per, -1).mean(axis=1)
         return probs
@@ -220,10 +281,7 @@ class Classifier:
 
     def _prob_blob(self) -> str:
         """Last softmax-ish output, else the last top blob."""
-        for layer in reversed(self.net.layers):
-            if layer.type in ("Softmax",):
-                return layer.tops[0]
-        return self.net.output_blobs[-1]
+        return probability_blob(self.net)
 
 
 class Detector(Classifier):

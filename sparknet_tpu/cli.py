@@ -8,6 +8,11 @@ time :290-376, device_query :139-151; brew-verb registry :55-70).
         --data D [--iterations N]
     python -m sparknet_tpu.cli time --model M.prototxt [--iterations N]
     python -m sparknet_tpu.cli device_query
+    python -m sparknet_tpu.cli serve --model lenet [< requests.jsonl]
+
+`serve` (no reference counterpart) fronts a net with the online
+micro-batching engine (serving/) — JSONL requests in, JSONL responses
+out.
 
 Data sources (`--data`): a directory of CIFAR-10 binary batches, or an .npz
 with `data`/`label` arrays.  Nets with in-graph data layers are fed through
@@ -451,6 +456,9 @@ def main(argv=None) -> int:
 
     from . import tools
     tools.register(sub)
+
+    from .serving import cli as serving_cli
+    serving_cli.register(sub)
 
     args = p.parse_args(argv)
     return args.fn(args)

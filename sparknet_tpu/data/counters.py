@@ -79,17 +79,30 @@ class IngestCounters:
         return _Timed(self, stage, items)
 
     def snapshot(self) -> Dict[str, float]:
-        """JSON-ready copy of every counter (seconds rounded to 10 µs)."""
+        """JSON-ready copy of every counter (seconds rounded to 10 µs).
+
+        Every documented key exists from birth with a zero value: a
+        solver whose prefetch never staged a round (armed but the run
+        ended first, or stats read before the first round) must report
+        zeros — consumers index `rounds_staged`/`ring_occ_*` directly
+        (tests/test_ingest_pipeline.py, scripts/prefetch_delta.py) and a
+        KeyError / divide-by-zero here would crash the reporting path,
+        not the pipeline."""
         with self._lock:
             out: Dict[str, float] = {}
             for s in self.STAGES:
                 out[f"{s}_s"] = round(self._seconds[s], 5)
             out["pull_items"] = self._items["pull"]
+            out["rounds_staged"] = 0
+            out["rounds_consumed"] = 0
             out.update(self._counts)
             if self._ring_samples:
                 out["ring_occ_mean"] = round(
                     self._ring_sum / self._ring_samples, 3)
                 out["ring_occ_max"] = self._ring_max
+            else:
+                out["ring_occ_mean"] = 0.0
+                out["ring_occ_max"] = 0
             return out
 
 

@@ -1,0 +1,35 @@
+"""Online inference serving: dynamic micro-batching over bucketed batch
+shapes, a multi-model registry, admission control/backpressure, and
+per-request observability.
+
+The layer the ROADMAP's "serves heavy traffic" north star needs between
+independent requests and efficient TPU dispatch — the role the serving/
+batching layer plays in front of TensorFlow's dataflow core (PAPERS.md);
+the reference Caffe stack stops at offline batch scoring.
+
+    from sparknet_tpu.serving import InferenceServer, ServerConfig
+
+    with InferenceServer(ServerConfig(max_batch=8, max_wait_ms=4)) as s:
+        s.load("lenet")                        # zoo name or prototxt
+        resp = s.submit("lenet", sample).result(timeout=5)
+
+CLI: `python -m sparknet_tpu.cli serve --model lenet` (JSONL in/out);
+load generation: `scripts/serve_loadgen.py`.
+"""
+
+from .buckets import bucket_sizes, pad_to_bucket, pick_bucket
+from .engine import ModelRunner, resolve_net_param
+from .errors import (DeadlineExceeded, ModelNotLoaded, ServerClosed,
+                     ServerOverloaded, ServingError)
+from .registry import LoadedModel, ModelRegistry
+from .server import InferenceServer, Response, ServerConfig
+from .stats import LatencySeries, ModelStats
+
+__all__ = [
+    "InferenceServer", "ServerConfig", "Response",
+    "ModelRegistry", "LoadedModel", "ModelRunner", "resolve_net_param",
+    "ServingError", "ServerOverloaded", "ServerClosed",
+    "DeadlineExceeded", "ModelNotLoaded",
+    "bucket_sizes", "pick_bucket", "pad_to_bucket",
+    "LatencySeries", "ModelStats",
+]

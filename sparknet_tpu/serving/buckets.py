@@ -1,0 +1,68 @@
+"""Bucketed batch shapes: the fixed set of batch sizes a served model
+compiles for.
+
+jit specializes per input shape, so serving raw coalesced batch sizes
+(1..max_batch, whatever arrival timing produced) would compile up to
+max_batch programs on demand, each a multi-second stall mid-traffic.
+Instead every assembled micro-batch is zero-padded up to the smallest
+bucket that holds it; the bucket set is warmed (pre-compiled) at model
+load, so steady-state traffic never compiles.  Padding rows are sliced
+off before responses are resolved; the padding is arithmetically exact —
+per-sample rows of conv/pool/dense/softmax nets do not see their batch
+neighbors (pinned bitwise by tests/test_serving.py).
+"""
+
+from __future__ import annotations
+
+from typing import Sequence, Tuple
+
+import numpy as np
+
+
+def bucket_sizes(max_batch: int) -> Tuple[int, ...]:
+    """Default bucket ladder: powers of two up to `max_batch`, plus
+    `max_batch` itself — log2(max_batch) programs bound the compile
+    count while keeping padding waste under 2x at every size."""
+    mb = int(max_batch)
+    if mb < 1:
+        raise ValueError(f"max_batch must be >= 1, got {max_batch}")
+    sizes = []
+    b = 1
+    while b < mb:
+        sizes.append(b)
+        b *= 2
+    sizes.append(mb)
+    return tuple(sizes)
+
+
+def validate_buckets(buckets: Sequence[int]) -> Tuple[int, ...]:
+    """Sorted, deduplicated, all >= 1; the smallest bucket must be able
+    to hold a single request (any positive smallest bucket can — padding
+    fills the rest)."""
+    bs = sorted({int(b) for b in buckets})
+    if not bs or bs[0] < 1:
+        raise ValueError(f"buckets must be positive ints, got {buckets!r}")
+    return tuple(bs)
+
+
+def pick_bucket(n: int, buckets: Sequence[int]) -> int:
+    """Smallest bucket holding `n` requests."""
+    for b in buckets:
+        if b >= n:
+            return int(b)
+    raise ValueError(
+        f"batch of {n} exceeds the largest bucket {max(buckets)}; the "
+        f"batcher must cap assembly at max(buckets)")
+
+
+def pad_to_bucket(x: np.ndarray, bucket: int) -> np.ndarray:
+    """Zero-pad a (k, ...) stack up to (bucket, ...).  Zeros, not row
+    repeats: repeated rows would be live data if a slicing bug ever
+    leaked a padding row, while zero rows fail loudly in parity tests."""
+    k = len(x)
+    if k > bucket:
+        raise ValueError(f"batch of {k} does not fit bucket {bucket}")
+    if k == bucket:
+        return x
+    pad = np.zeros((bucket - k,) + x.shape[1:], dtype=x.dtype)
+    return np.concatenate([x, pad])

@@ -1,0 +1,200 @@
+"""The `serve` CLI verb: JSONL-in, JSONL-out online scoring — the
+no-egress stand-in for a network front-end (requests arrive on stdin or
+a file instead of a socket; everything behind admission is the real
+serving engine).
+
+    python -m sparknet_tpu.cli serve --model lenet < requests.jsonl
+
+Request lines:  {"id": 7, "data": [[...]]}   # CHW (or flat) sample
+Response lines: {"id": 7, "argmax": 3, "probs": [...], "bucket": 4,
+                 "total_ms": 1.9}            # input order preserved
+Rejections:     {"id": 7, "error": "DeadlineExceeded", "status": 504}
+
+SIGINT triggers a graceful drain via utils/signals.py (the solver's
+signal contract, reapplied to serving): stop admitting, deliver every
+admitted request, exit 0.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+from collections import deque
+from typing import Optional
+
+import numpy as np
+
+
+def _parse_buckets(text: Optional[str]):
+    if not text:
+        return None
+    try:
+        return [int(t) for t in text.replace(" ", "").split(",") if t]
+    except ValueError:
+        raise SystemExit(f"--buckets must be comma-separated ints, "
+                         f"got {text!r}")
+
+
+def _open(path: str, mode: str):
+    if path == "-":
+        return (sys.stdin if "r" in mode else sys.stdout), False
+    return open(path, mode), True
+
+
+def _error_line(rid, exc) -> dict:
+    from .errors import ServingError
+
+    if isinstance(exc, ServingError):
+        return {"id": rid, "error": type(exc).__name__,
+                "status": exc.status, "detail": str(exc)}
+    return {"id": rid, "error": type(exc).__name__, "status": 500,
+            "detail": str(exc)}
+
+
+def cmd_serve(args) -> int:
+    from ..utils.signals import SignalHandler, SolverAction
+    from .server import InferenceServer, ServerConfig
+
+    cfg = ServerConfig(max_batch=args.max_batch,
+                       max_wait_ms=args.max_wait_ms,
+                       queue_depth=args.queue_depth,
+                       default_deadline_ms=args.deadline_ms)
+    server = InferenceServer(cfg)
+    name = args.name or "default"
+    lm = server.load(name, args.model, weights=args.weights,
+                     buckets=_parse_buckets(args.buckets), seed=args.seed)
+    print(f"serving {args.model!r} as {name!r}: input "
+          f"{lm.runner.sample_shape}, buckets {lm.runner.buckets}, "
+          f"{lm.runner.compile_count()} programs warmed",
+          file=sys.stderr, flush=True)
+
+    pre = None
+    if args.preprocess:
+        from ..classify import Preprocessor
+
+        crop = lm.runner.sample_shape[1:]
+        image_dims = ([int(d) for d in args.image_dims.split(",")]
+                      if args.image_dims else crop)
+        pre = Preprocessor(image_dims, crop)
+
+    handler = SignalHandler(SolverAction.STOP, SolverAction.NONE).install()
+    fin, close_in = _open(args.input, "r")
+    fout, close_out = _open(args.output, "w")
+    pending: deque = deque()  # (id, Future | ready error dict), input order
+    n_in = 0
+
+    def flush(block: bool) -> None:
+        while pending:
+            rid, item = pending[0]
+            if isinstance(item, dict):
+                line = item
+            elif item.done() or block:
+                try:
+                    r = item.result()
+                    line = {"id": rid, "argmax": r.argmax,
+                            "probs": np.asarray(r.probs, np.float64)
+                            .tolist(),
+                            "bucket": r.bucket,
+                            "total_ms": r.total_ms}
+                except Exception as e:
+                    line = _error_line(rid, e)
+            else:
+                return
+            pending.popleft()
+            fout.write(json.dumps(line) + "\n")
+            fout.flush()
+
+    drained_early = False
+    try:
+        for raw in fin:
+            if handler.get_requested_action() is SolverAction.STOP:
+                drained_early = True
+                break
+            raw = raw.strip()
+            if not raw:
+                continue
+            n_in += 1
+            rid = None
+            try:
+                obj = json.loads(raw)
+                rid = obj.get("id", n_in)
+                data = np.asarray(obj["data"], dtype=np.float32)
+                if pre is not None:
+                    data = pre.one(data)
+                fut = server.submit(
+                    name, data,
+                    wait=(args.overload == "wait"))
+                pending.append((rid, fut))
+            except Exception as e:
+                # a malformed or rejected REQUEST gets an error response
+                # line; only the server itself dying should kill the
+                # stream
+                pending.append((rid if rid is not None else n_in,
+                                _error_line(rid, e)))
+            # keep memory bounded: resolve the head once the window of
+            # outstanding work exceeds a few queues' worth
+            if len(pending) > 4 * args.queue_depth:
+                flush(block=True)
+            else:
+                flush(block=False)
+        flush(block=True)  # graceful drain: every admitted request lands
+    finally:
+        server.close(drain=True)
+        stats = server.stats()
+        if args.stats_out:
+            with open(args.stats_out, "w") as f:
+                json.dump(stats, f, indent=2)
+        m = stats["models"][name]
+        print(f"served {m['completed']}/{n_in} requests "
+              f"({m['rejected_overload']} overloaded, "
+              f"{m['rejected_deadline']} past deadline; "
+              f"p50 {m['total_ms']['p50_ms']} ms, "
+              f"p99 {m['total_ms']['p99_ms']} ms, "
+              f"occupancy {m['batch_occupancy_mean']}, "
+              f"{m['engine_compiles']} compiles"
+              + (", drained on signal" if drained_early else ""),
+              file=sys.stderr, flush=True)
+        if close_in:
+            fin.close()
+        if close_out:
+            fout.close()
+        handler.uninstall()
+    return 0
+
+
+def register(sub) -> None:
+    s = sub.add_parser(
+        "serve", help="online JSONL scoring via the micro-batching "
+                      "inference server (serving/)")
+    s.add_argument("--model", required=True,
+                   help="model-zoo name (e.g. lenet) or deploy .prototxt")
+    s.add_argument("--weights", help=".npz / .caffemodel / .h5 warm start")
+    s.add_argument("--name", help="registry name (default: 'default')")
+    s.add_argument("--input", default="-",
+                   help="JSONL request file, '-' for stdin")
+    s.add_argument("--output", default="-",
+                   help="JSONL response file, '-' for stdout")
+    s.add_argument("--max_batch", type=int, default=8)
+    s.add_argument("--max_wait_ms", type=float, default=5.0)
+    s.add_argument("--queue_depth", type=int, default=64)
+    s.add_argument("--deadline_ms", type=float,
+                   help="per-request deadline; expired requests get a "
+                        "504-style error line")
+    s.add_argument("--buckets",
+                   help="comma-separated batch buckets (default: powers "
+                        "of two up to max_batch)")
+    s.add_argument("--overload", default="wait",
+                   choices=["wait", "reject"],
+                   help="full queue: block the reader (wait) or emit "
+                        "503-style error lines (reject)")
+    s.add_argument("--preprocess", action="store_true",
+                   help="treat 'data' as an HWC image: resize + center "
+                        "crop to the model input (classify.Preprocessor)")
+    s.add_argument("--image_dims",
+                   help="H,W to resize to before the crop "
+                        "(with --preprocess)")
+    s.add_argument("--seed", type=int, default=0,
+                   help="param init seed when no --weights")
+    s.add_argument("--stats_out",
+                   help="write server.stats() JSON here on exit")
+    s.set_defaults(fn=cmd_serve)

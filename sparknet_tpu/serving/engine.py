@@ -1,0 +1,149 @@
+"""Per-model execution engine: one deploy-form net, one jitted forward,
+one compile-cache entry per warmed bucket shape.
+
+A ModelRunner owns everything device-side for a registered model: the
+Net, its params (randomly initialized or warm-started via
+classify.load_pretrained), and a single jit-compiled forward whose
+per-shape specializations ARE the bucket set.  `warmup()` runs every
+bucket once at load so steady traffic never compiles;
+`compile_count()` reads the jit cache size, which is how the
+bounded-compile guarantee is asserted (tests/test_serving.py soak) —
+on top of SPARKNET_COMPILE_CACHE persistence (utils/compile_cache.py),
+which makes even the warmup compiles cross-process warm starts.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..classify import load_pretrained, probability_blob
+from .buckets import bucket_sizes, validate_buckets
+
+
+def resolve_net_param(spec: str, *, max_batch: int = 8):
+    """`spec` -> deploy-form NetParameter: a model-zoo name (models/
+    __init__.py registry, deploy=True) or a deploy .prototxt path.
+    A zoo name whose builder family has no deploy form dies with a
+    ValueError naming the model, not a TypeError from the builder."""
+    from ..models import get_model, model_names
+
+    if spec in model_names():
+        try:
+            return get_model(spec, batch=int(max_batch), deploy=True)
+        except TypeError as e:
+            raise ValueError(
+                f"model-zoo entry {spec!r} has no deploy form: {e}") from e
+    if os.path.exists(spec):
+        from ..proto import caffe_pb
+
+        return caffe_pb.load_net_prototxt(spec)
+    raise ValueError(
+        f"model spec {spec!r} is neither a model-zoo name "
+        f"({sorted(model_names())}) nor an existing prototxt path")
+
+
+class ModelRunner:
+    """Jitted TEST-phase forward over a fixed bucket ladder.
+
+    Single-threaded by design: exactly one batcher thread per model calls
+    `forward_padded` (serving/server.py), so no lock is taken here."""
+
+    def __init__(self, net_param, *, weights: Optional[str] = None,
+                 buckets: Optional[Sequence[int]] = None,
+                 max_batch: int = 8, seed: int = 0,
+                 device=None) -> None:
+        import jax
+        import jax.numpy as jnp
+
+        from ..core.net import Net
+
+        self.buckets: Tuple[int, ...] = (
+            validate_buckets(buckets) if buckets is not None
+            else bucket_sizes(max_batch))
+        self.net = Net(net_param, "TEST")
+        self.params = self.net.init_params(seed)
+        if weights:
+            self.params = load_pretrained(self.net, self.params, weights)
+        self.device = device
+        if device is not None:
+            # pin params to the target device; jit then executes there
+            # (bench.py's serving leg forces the CPU backend this way
+            # even when the process default platform is the TPU tunnel)
+            self.params = jax.device_put(self.params, device)
+        self.input_blob = self.net.input_blobs[0]
+        self.sample_shape: Tuple[int, ...] = tuple(
+            self.net.blob_shapes[self.input_blob][1:])
+        self.output_blob = probability_blob(self.net)
+        self.n_outputs = int(self.net.blob_shapes[self.output_blob][-1])
+
+        net = self.net
+        aux_blobs = list(net.input_blobs[1:])
+
+        def fwd(params, x):
+            feed = {self.input_blob: x}
+            # auxiliary declared inputs ride along zero-filled at their
+            # declared shapes, exactly as Classifier._forward_probs does
+            for b in aux_blobs:
+                shape = net.blob_shapes[b]
+                feed[b] = jnp.zeros(shape, jnp.int32 if len(shape) == 1
+                                    else jnp.float32)
+            return net.forward(params, feed)[self.output_blob]
+
+        self._jfwd = jax.jit(fwd)
+        self._shapes_seen: set = set()
+
+    # ------------------------------------------------------------- execution
+    def forward_padded(self, x: np.ndarray) -> np.ndarray:
+        """(bucket, *sample_shape) float32 -> (bucket, n_outputs) float32
+        on the host.  The bucket-shape contract is the caller's (server
+        pads before calling); an off-ladder batch still computes but
+        costs a fresh compile, so it is rejected loudly instead."""
+        if tuple(x.shape[1:]) != self.sample_shape:
+            raise ValueError(
+                f"sample shape {tuple(x.shape[1:])} != model input "
+                f"{self.sample_shape}")
+        if len(x) not in self.buckets:
+            raise ValueError(
+                f"batch {len(x)} is not a warmed bucket {self.buckets}; "
+                f"pad with buckets.pad_to_bucket first")
+        import jax
+        import jax.numpy as jnp
+
+        xj = (jax.device_put(x, self.device) if self.device is not None
+              else jnp.asarray(x))
+        self._shapes_seen.add(tuple(x.shape))
+        # np.asarray is a VALUE fetch: on the tunneled platform
+        # block_until_ready returns before deferred execution completes
+        # (BENCH_NOTES.md round-3 trap), and a response is host data
+        # anyway
+        return np.asarray(self._jfwd(self.params, xj))
+
+    def warmup(self) -> int:
+        """Pre-compile every bucket (zeros in, value-fetched out);
+        returns the compile count afterwards, which steady-state traffic
+        must never grow past."""
+        for b in self.buckets:
+            self.forward_padded(
+                np.zeros((b,) + self.sample_shape, np.float32))
+        return self.compile_count()
+
+    def compile_count(self) -> int:
+        """Distinct compiled programs behind the jitted forward.  Reads
+        the jit cache size (counts recompiles our own bookkeeping could
+        miss); falls back to the shapes-seen set on jax versions without
+        the introspection hook."""
+        try:
+            return int(self._jfwd._cache_size())
+        except Exception:
+            return len(self._shapes_seen)
+
+    def describe(self) -> Dict[str, object]:
+        return {"input_blob": self.input_blob,
+                "sample_shape": list(self.sample_shape),
+                "output_blob": self.output_blob,
+                "n_outputs": self.n_outputs,
+                "buckets": list(self.buckets),
+                "compiles": self.compile_count()}

@@ -1,0 +1,47 @@
+"""Serving-layer error taxonomy.
+
+The reference stack has no online-serving analogue (Caffe's Classifier
+stops at offline batch scoring); the shape here follows the HTTP serving
+convention TensorFlow-Serving popularized: admission failures and
+deadline misses are REJECTIONS with a status code the caller can map to
+503/504, distinct from programming errors (which stay ValueError/
+TypeError) and from model lookup misses (404-style).
+"""
+
+from __future__ import annotations
+
+
+class ServingError(RuntimeError):
+    """Base of every rejection the server issues; `status` carries the
+    HTTP-style code a network front-end would map it to."""
+
+    status = 500
+
+
+class ServerOverloaded(ServingError):
+    """Admission control: the model's request queue is at `queue_depth`.
+    Raised synchronously by submit() — the 503 path.  Callers either
+    back off or resubmit with `wait=True` for blocking admission."""
+
+    status = 503
+
+
+class ServerClosed(ServingError):
+    """Submitted after shutdown began, or the request was still queued
+    when a non-draining close() flushed it."""
+
+    status = 503
+
+
+class DeadlineExceeded(ServingError):
+    """The request's deadline passed before its batch launched — the 504
+    path.  Checked at batch assembly, so an expired request never spends
+    device time."""
+
+    status = 504
+
+
+class ModelNotLoaded(ServingError):
+    """No model under that name in the registry (404 path)."""
+
+    status = 404

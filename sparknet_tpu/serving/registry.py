@@ -1,0 +1,121 @@
+"""Model registry: multiple named nets resident at once, with
+load/unload/reload and per-model stats.
+
+The registry owns model lifecycle only — queues and batcher threads are
+the server's (serving/server.py).  `reload` rebuilds the runner from its
+recorded spec (fresh Net + params + warmed buckets) and bumps the
+generation stamp; responses carry the generation they were computed
+under, so a caller can tell a pre-reload answer from a post-reload one.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence
+
+from .engine import ModelRunner, resolve_net_param
+from .errors import ModelNotLoaded
+from .stats import ModelStats
+
+
+@dataclass
+class LoadedModel:
+    """One resident model: runner + stats + the load-spec needed to
+    rebuild it on reload()."""
+
+    name: str
+    spec: str
+    runner: ModelRunner
+    stats: ModelStats
+    generation: int = 0
+    weights: Optional[str] = None
+    load_kwargs: dict = field(default_factory=dict)
+
+
+class ModelRegistry:
+    """Thread-safe name -> LoadedModel map."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._models: Dict[str, LoadedModel] = {}
+
+    def load(self, name: str, spec: Optional[str] = None, *,
+             weights: Optional[str] = None,
+             buckets: Optional[Sequence[int]] = None,
+             max_batch: int = 8, seed: int = 0, device=None,
+             warmup: bool = True) -> LoadedModel:
+        """Build, (optionally) warm, and register a model under `name`.
+        `spec` defaults to `name` (zoo entry or prototxt path).
+        Loading over an existing name replaces it (generation restarts);
+        use reload() to rebuild in place with a bumped generation."""
+        spec = spec if spec is not None else name
+        kwargs = {"buckets": buckets, "max_batch": max_batch,
+                  "seed": seed, "device": device}
+        runner = ModelRunner(
+            resolve_net_param(spec, max_batch=max_batch),
+            weights=weights, **kwargs)
+        if warmup:
+            runner.warmup()
+        lm = LoadedModel(name=name, spec=spec, runner=runner,
+                         stats=ModelStats(), weights=weights,
+                         load_kwargs=dict(kwargs, warmup=warmup))
+        with self._lock:
+            self._models[name] = lm
+        return lm
+
+    def reload(self, name: str) -> LoadedModel:
+        """Rebuild `name` from its recorded spec: fresh params (picking
+        up a rewritten weights file), freshly warmed buckets, stats
+        reset, generation + 1.  The swap is atomic under the lock — an
+        in-flight batch on the old runner completes against the old
+        params and its responses carry the old generation."""
+        lm = self.get(name)
+        kwargs = dict(lm.load_kwargs)
+        warm = kwargs.pop("warmup", True)
+        runner = ModelRunner(
+            resolve_net_param(lm.spec,
+                              max_batch=kwargs.get("max_batch", 8)),
+            weights=lm.weights, **kwargs)
+        if warm:
+            runner.warmup()
+        with self._lock:
+            cur = self._models.get(name)
+            if cur is not lm:
+                raise ModelNotLoaded(
+                    f"model {name!r} was unloaded/replaced mid-reload")
+            lm.runner = runner
+            lm.stats = ModelStats()
+            lm.generation += 1
+        return lm
+
+    def unload(self, name: str) -> None:
+        with self._lock:
+            if self._models.pop(name, None) is None:
+                raise ModelNotLoaded(f"model {name!r} is not loaded")
+
+    def get(self, name: str) -> LoadedModel:
+        with self._lock:
+            lm = self._models.get(name)
+        if lm is None:
+            raise ModelNotLoaded(f"model {name!r} is not loaded; have "
+                                 f"{self.names()}")
+        return lm
+
+    def names(self) -> List[str]:
+        with self._lock:
+            return sorted(self._models)
+
+    def stats(self) -> Dict[str, dict]:
+        """Per-model serving stats + engine description."""
+        with self._lock:
+            models = list(self._models.values())
+        out: Dict[str, dict] = {}
+        for lm in models:
+            snap = lm.stats.snapshot()
+            snap["generation"] = lm.generation
+            snap["spec"] = lm.spec
+            snap.update({f"engine_{k}": v
+                         for k, v in lm.runner.describe().items()})
+            out[lm.name] = snap
+        return out

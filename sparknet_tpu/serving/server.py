@@ -1,0 +1,377 @@
+"""Online inference server: thread-safe request queue + dynamic
+micro-batcher over bucketed shapes, with admission control and graceful
+drain.
+
+The dataflow core (core/net.py) stays untouched — this layer turns a
+stream of independent single-sample requests into efficient padded-batch
+dispatches, the same separation TensorFlow drew between its dataflow
+runtime and the serving/batching layer in front of it (PAPERS.md:
+"TensorFlow: A system for large-scale machine learning"; the reference
+Caffe stack stops at offline batch scoring, classifier.py).
+
+Per model there is ONE bounded queue and ONE batcher thread:
+
+  submit() --admission--> queue --coalesce <= max_batch/max_wait_ms-->
+    pad to bucket --> jitted forward (warmed shapes only) --> slice -->
+      resolve futures
+
+Rejections are exceptions on the returned future or raised at submit
+(errors.py: ServerOverloaded at admission, DeadlineExceeded at batch
+assembly, ServerClosed at shutdown).  close(drain=True) delivers every
+admitted request before returning; stats() snapshots per-model latency
+histograms, occupancy, and reject counts (stats.py).
+"""
+
+from __future__ import annotations
+
+import queue as _queue
+import threading
+import time
+from concurrent.futures import Future
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from .buckets import pad_to_bucket, pick_bucket
+from .errors import (DeadlineExceeded, ServerClosed, ServerOverloaded,
+                     ServingError)
+from .registry import LoadedModel, ModelRegistry
+
+
+@dataclass
+class ServerConfig:
+    """Knobs of the batching/admission policy (engine-side knobs —
+    buckets, weights — ride through load())."""
+
+    max_batch: int = 8          # coalesce at most this many requests
+    max_wait_ms: float = 5.0    # ... or stop waiting after this long
+    queue_depth: int = 64       # admission bound; beyond -> ServerOverloaded
+    default_deadline_ms: Optional[float] = None  # per-request override wins
+    poll_s: float = 0.05        # batcher idle poll (shutdown latency bound)
+
+
+@dataclass
+class Response:
+    """What a resolved future carries.  `bucket` records the padded batch
+    shape the request was computed in, which makes every response exactly
+    replayable: a direct net.forward at that bucket is bitwise-identical
+    (XLA specializes programs per shape, so replaying at a DIFFERENT
+    batch size can differ in final-ulp rounding — tests pin both facts)."""
+
+    probs: np.ndarray
+    model: str
+    generation: int
+    bucket: int
+    batch_live: int             # real rows in the dispatched bucket
+    queue_wait_ms: float
+    assembly_ms: float
+    device_ms: float
+    total_ms: float
+
+    @property
+    def argmax(self) -> int:
+        return int(np.argmax(self.probs))
+
+
+@dataclass
+class _Request:
+    sample: np.ndarray
+    future: Future
+    t_submit: float
+    deadline: Optional[float]   # absolute perf_counter seconds
+    t_pop: float = 0.0
+
+
+@dataclass
+class _Lane:
+    """Per-model queue + batcher thread."""
+
+    model: LoadedModel
+    queue: _queue.Queue = field(default_factory=_queue.Queue)
+    thread: Optional[threading.Thread] = None
+    stopping: bool = False
+    draining: bool = True
+    busy: bool = False          # a popped batch is being assembled/run
+
+
+class InferenceServer:
+    """Multi-model online scoring front-end over a ModelRegistry.
+
+    Usage (programmatic):
+
+        server = InferenceServer(ServerConfig(max_batch=8, max_wait_ms=4))
+        server.load("lenet")                      # zoo name or prototxt
+        fut = server.submit("lenet", sample)      # (C,H,W) float32
+        resp = fut.result(timeout=5)              # Response
+        server.close(drain=True)
+
+    Or as a context manager (close(drain=True) on exit).
+    """
+
+    def __init__(self, config: Optional[ServerConfig] = None,
+                 registry: Optional[ModelRegistry] = None) -> None:
+        self.config = config or ServerConfig()
+        if self.config.max_batch < 1:
+            raise ValueError("max_batch must be >= 1")
+        if self.config.queue_depth < 1:
+            raise ValueError("queue_depth must be >= 1")
+        self.registry = registry or ModelRegistry()
+        self._lanes: Dict[str, _Lane] = {}
+        self._lock = threading.Lock()
+        self._accepting = True
+        self._closed = False
+
+    # ------------------------------------------------------------ lifecycle
+    def load(self, name: str, spec: Optional[str] = None, *,
+             weights: Optional[str] = None,
+             buckets: Optional[Sequence[int]] = None,
+             seed: int = 0, device=None, warmup: bool = True
+             ) -> LoadedModel:
+        """Load + warm a model and start its batcher lane.  The bucket
+        ladder defaults to powers of two up to config.max_batch."""
+        if not self._accepting:
+            raise ServerClosed("server is shutting down")
+        lm = self.registry.load(name, spec, weights=weights,
+                                buckets=buckets,
+                                max_batch=self.config.max_batch,
+                                seed=seed, device=device, warmup=warmup)
+        if self.config.max_batch > max(lm.runner.buckets):
+            raise ValueError(
+                f"max_batch {self.config.max_batch} exceeds the largest "
+                f"bucket {max(lm.runner.buckets)}")
+        lane = _Lane(model=lm,
+                     queue=_queue.Queue(maxsize=self.config.queue_depth))
+        lane.thread = threading.Thread(
+            target=self._batcher, args=(name, lane),
+            name=f"sparknet-serve-{name}", daemon=True)
+        with self._lock:
+            old = self._lanes.get(name)
+            self._lanes[name] = lane
+        if old is not None:
+            self._stop_lane(old, drain=True)
+        lane.thread.start()
+        return lm
+
+    def unload(self, name: str, *, drain: bool = True) -> None:
+        """Stop the lane (draining admitted work by default) and drop the
+        model from the registry."""
+        with self._lock:
+            lane = self._lanes.pop(name, None)
+        if lane is not None:
+            self._stop_lane(lane, drain=drain)
+        self.registry.unload(name)
+
+    def reload(self, name: str) -> LoadedModel:
+        """Rebuild the model in place (fresh weights file pickup, stats
+        reset, generation bump).  The lane keeps running: queued requests
+        before the swap complete on the old runner."""
+        return self.registry.reload(name)
+
+    def drain(self) -> None:
+        """Block until every admitted request has been delivered, keeping
+        the server open for more work afterwards."""
+        with self._lock:
+            lanes = list(self._lanes.values())
+        for lane in lanes:
+            while not lane.queue.empty() or lane.busy:
+                time.sleep(self.config.poll_s / 2)
+
+    def close(self, *, drain: bool = True) -> None:
+        """Stop accepting; deliver (drain=True) or reject with
+        ServerClosed (drain=False) everything still queued; stop lanes.
+        Idempotent."""
+        self._accepting = False
+        if self._closed:
+            return
+        self._closed = True
+        with self._lock:
+            lanes = list(self._lanes.values())
+        for lane in lanes:
+            self._stop_lane(lane, drain=drain)
+
+    def _stop_lane(self, lane: _Lane, *, drain: bool) -> None:
+        lane.draining = drain
+        lane.stopping = True
+        if not drain:
+            self._flush_reject(lane)
+        if lane.thread is not None:
+            lane.thread.join()
+            lane.thread = None
+
+    def _flush_reject(self, lane: _Lane) -> None:
+        while True:
+            try:
+                req = lane.queue.get_nowait()
+            except _queue.Empty:
+                return
+            lane.model.stats.bump("rejected_closed")
+            req.future.set_exception(
+                ServerClosed("server closed before this request ran"))
+
+    def __enter__(self) -> "InferenceServer":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close(drain=True)
+
+    # ------------------------------------------------------------ admission
+    def submit(self, model: str, sample, *,
+               deadline_ms: Optional[float] = None,
+               wait: bool = False,
+               wait_timeout_s: Optional[float] = None) -> Future:
+        """Admit one sample for scoring; returns a Future resolving to a
+        Response (or raising the rejection).
+
+        Admission is non-blocking by default: a full queue raises
+        ServerOverloaded immediately (the 503 path).  wait=True turns
+        overload into backpressure — block until space or
+        `wait_timeout_s` (then ServerOverloaded anyway)."""
+        lane = self._lane(model)
+        lm = lane.model
+        x = np.asarray(sample, dtype=np.float32)
+        if x.shape == (int(np.prod(lm.runner.sample_shape)),):
+            x = x.reshape(lm.runner.sample_shape)
+        if tuple(x.shape) != lm.runner.sample_shape:
+            raise ValueError(
+                f"sample shape {tuple(x.shape)} != model input "
+                f"{lm.runner.sample_shape} for {model!r}")
+        if not self._accepting or lane.stopping:
+            raise ServerClosed("server is shutting down")
+        if deadline_ms is None:
+            deadline_ms = self.config.default_deadline_ms
+        t0 = time.perf_counter()
+        req = _Request(
+            sample=x, future=Future(), t_submit=t0,
+            deadline=None if deadline_ms is None
+            else t0 + float(deadline_ms) / 1e3)
+        lm.stats.bump("submitted")
+        try:
+            if wait:
+                lane.queue.put(req, timeout=wait_timeout_s)
+            else:
+                lane.queue.put_nowait(req)
+        except _queue.Full:
+            lm.stats.bump("rejected_overload")
+            raise ServerOverloaded(
+                f"{model!r} queue at depth {self.config.queue_depth}"
+            ) from None
+        return req.future
+
+    def submit_many(self, model: str, samples, **kw) -> List[Future]:
+        """Burst admission; per-sample rejections surface on the
+        corresponding future instead of aborting the rest of the burst
+        (submit()'s synchronous raise is per-call, so a loop would stop
+        at the first overload)."""
+        futs: List[Future] = []
+        for s in samples:
+            try:
+                futs.append(self.submit(model, s, **kw))
+            except ServingError as e:
+                f: Future = Future()
+                f.set_exception(e)
+                futs.append(f)
+        return futs
+
+    def _lane(self, model: str) -> _Lane:
+        with self._lock:
+            lane = self._lanes.get(model)
+        if lane is None:
+            # registry lookup raises ModelNotLoaded with the loaded names
+            self.registry.get(model)
+            raise ServerClosed(f"model {model!r} has no serving lane")
+        return lane
+
+    # ------------------------------------------------------------- batching
+    def _batcher(self, name: str, lane: _Lane) -> None:
+        """The per-model micro-batch loop: block for a first request,
+        coalesce up to max_batch/max_wait_ms more, dispatch."""
+        cfg = self.config
+        q = lane.queue
+        while True:
+            try:
+                first = q.get(timeout=cfg.poll_s)
+            except _queue.Empty:
+                if lane.stopping:
+                    return
+                continue
+            lane.busy = True
+            try:
+                first.t_pop = time.perf_counter()
+                batch = [first]
+                window_end = first.t_pop + cfg.max_wait_ms / 1e3
+                while len(batch) < cfg.max_batch:
+                    remaining = window_end - time.perf_counter()
+                    if remaining <= 0 or (lane.stopping and q.empty()):
+                        break
+                    try:
+                        nxt = q.get(timeout=remaining)
+                    except _queue.Empty:
+                        break
+                    nxt.t_pop = time.perf_counter()
+                    batch.append(nxt)
+                self._run_batch(lane, batch)
+            finally:
+                lane.busy = False
+
+    def _run_batch(self, lane: _Lane, batch: List[_Request]) -> None:
+        lm = lane.model
+        runner, generation = lm.runner, lm.generation
+        now = time.perf_counter()
+        live: List[_Request] = []
+        for r in batch:
+            if r.deadline is not None and now > r.deadline:
+                lm.stats.bump("rejected_deadline")
+                r.future.set_exception(DeadlineExceeded(
+                    f"deadline passed {round((now - r.deadline) * 1e3, 2)}"
+                    f" ms before batch launch"))
+            else:
+                live.append(r)
+        if not live:
+            return
+        bucket = pick_bucket(len(live), runner.buckets)
+        x = pad_to_bucket(
+            np.stack([r.sample for r in live]).astype(np.float32), bucket)
+        t_launch = time.perf_counter()
+        try:
+            out = runner.forward_padded(x)
+        except Exception as e:
+            lm.stats.bump("failed", len(live))
+            for r in live:
+                r.future.set_exception(
+                    ServingError(f"model {lm.name!r} forward failed: {e}"))
+            return
+        t_done = time.perf_counter()
+        device_ms = (t_done - t_launch) * 1e3
+        lm.stats.observe_batch(len(live), bucket)
+        for i, r in enumerate(live):
+            total_ms = (t_done - r.t_submit) * 1e3
+            queue_wait_ms = (r.t_pop - r.t_submit) * 1e3
+            assembly_ms = (t_launch - r.t_pop) * 1e3
+            lm.stats.observe_request(queue_wait_ms, assembly_ms,
+                                     device_ms, total_ms)
+            r.future.set_result(Response(
+                probs=out[i], model=lm.name, generation=generation,
+                bucket=bucket, batch_live=len(live),
+                queue_wait_ms=round(queue_wait_ms, 4),
+                assembly_ms=round(assembly_ms, 4),
+                device_ms=round(device_ms, 4),
+                total_ms=round(total_ms, 4)))
+
+    # -------------------------------------------------------------- observe
+    def stats(self) -> Dict[str, object]:
+        """JSON-ready snapshot: per-model serving counters/latency
+        histograms (stats.py) + live queue depths + the batching
+        config."""
+        per_model = self.registry.stats()
+        with self._lock:
+            for name, lane in self._lanes.items():
+                if name in per_model:
+                    per_model[name]["queued_now"] = lane.queue.qsize()
+        return {"models": per_model,
+                "config": {"max_batch": self.config.max_batch,
+                           "max_wait_ms": self.config.max_wait_ms,
+                           "queue_depth": self.config.queue_depth,
+                           "default_deadline_ms":
+                               self.config.default_deadline_ms},
+                "accepting": self._accepting}

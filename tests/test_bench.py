@@ -201,6 +201,25 @@ def test_bench_longctx_lm_cpu():
     assert r["longctx_lm_tok_per_sec"] > 0
 
 
+def test_bench_serving_leg_cpu():
+    """The serving leg (micro-batched LeNet under Poisson offered load on
+    the CPU backend) must stay runnable and emit its exact field
+    contract: a renamed field here desyncs the _KNOWN_FIELDS allowlist
+    and gets silently pruned from stale replays."""
+    import bench
+
+    r = bench.bench_serving(n_requests=80, offered_qps=400.0)
+    assert r["serving_model"] == "lenet"
+    assert r["serving_qps"] > 0 and r["serving_p50_ms"] > 0
+    assert r["serving_p99_ms"] >= r["serving_p50_ms"]
+    assert 0 < r["serving_batch_occupancy"] <= 1.0
+    # the bounded-compile contract holds under bench traffic too: the 4
+    # warmed buckets (1/2/4/8) are the only programs ever compiled
+    assert r["serving_compiles"] == 4
+    assert set(r) <= bench._KNOWN_FIELDS
+    assert "serving" in bench._KNOWN_LEGS
+
+
 def test_persist_leg_incremental_contract(tmp_path, monkeypatch):
     """Per-leg last-good persistence (VERDICT r4 item 1): each completed
     leg merges immediately; a partial record still carries the contract

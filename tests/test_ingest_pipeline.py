@@ -25,6 +25,40 @@ from sparknet_tpu.proto import caffe_pb
 from sparknet_tpu.proto.textformat import parse
 
 
+# --------------------------------------------------------------- counters
+def test_counters_zero_round_path_reports_zeros():
+    """A solver whose prefetch never staged a round must report zeros —
+    every documented snapshot key exists from birth, so consumers that
+    index rounds_staged/ring_occ_* (this file, prefetch_delta.py) never
+    KeyError and derived ratios never divide by zero."""
+    snap = IngestCounters().snapshot()
+    assert snap["rounds_staged"] == 0
+    assert snap["rounds_consumed"] == 0
+    assert snap["ring_occ_mean"] == 0.0
+    assert snap["ring_occ_max"] == 0
+    assert snap["pull_items"] == 0
+    for stage in IngestCounters.STAGES:
+        assert snap[f"{stage}_s"] == 0.0
+    # the staged-minus-consumed backlog expression used below is legal
+    # on the empty snapshot too
+    assert snap["rounds_staged"] - snap["rounds_consumed"] == 0
+
+
+def test_solver_ingest_stats_before_any_round():
+    """ingest_stats() on a solver that armed prefetch but never ran a
+    round: zeros, not KeyError (the zero-round path of the satellite
+    fix)."""
+    solver = make_ds(n_workers=2)
+    solver.set_train_data([lenet_stream(s) for s in (0, 1)])
+    solver.set_prefetch(True, depth=2)
+    stats = solver.ingest_stats()
+    assert stats["rounds_staged"] == 0
+    assert stats["rounds_consumed"] == 0
+    assert stats["ring_occ_mean"] == 0.0
+    assert stats["stall_s"] == 0.0
+    assert stats["prefetch_depth"] == 2
+
+
 # --------------------------------------------------------------- executor
 def test_ring_occupancy_never_exceeds_depth():
     """The coordinator blocks BEFORE pulling: staged-but-unconsumed rounds

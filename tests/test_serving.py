@@ -1,0 +1,371 @@
+"""Online serving engine invariants (sparknet_tpu/serving/): bucketed
+micro-batching is arithmetically EXACT (served probs are bitwise equal to
+a direct forward at the recorded bucket, for every mix of burst sizes and
+under overload), admission control rejects loudly (503/504 taxonomy,
+never silent drops), graceful drain delivers every admitted request, and
+the warmed bucket ladder bounds jit compiles for the life of the server
+(soak-pinned with a compile-counter assertion).
+
+The reference stack stops at offline batch scoring (reference:
+python/caffe/classifier.py:66-95 oversampled predict); everything here is
+new surface, so these tests are the contract.
+"""
+
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from sparknet_tpu.serving import (DeadlineExceeded, InferenceServer,
+                                  LatencySeries, ModelNotLoaded,
+                                  ModelStats, ServerClosed, ServerConfig,
+                                  ServerOverloaded, bucket_sizes,
+                                  pad_to_bucket, pick_bucket)
+from sparknet_tpu.serving.buckets import validate_buckets
+
+LENET_SHAPE = (1, 28, 28)
+
+
+def _samples(n, seed=0, shape=LENET_SHAPE):
+    return np.random.RandomState(seed).rand(n, *shape).astype(np.float32)
+
+
+# -------------------------------------------------------------- buckets
+def test_bucket_ladder():
+    assert bucket_sizes(8) == (1, 2, 4, 8)
+    assert bucket_sizes(6) == (1, 2, 4, 6)   # max_batch itself always in
+    assert bucket_sizes(1) == (1,)
+    with pytest.raises(ValueError, match="max_batch"):
+        bucket_sizes(0)
+
+
+def test_pick_bucket_boundaries():
+    ladder = bucket_sizes(8)
+    assert [pick_bucket(n, ladder) for n in range(1, 9)] == \
+        [1, 2, 4, 4, 8, 8, 8, 8]
+    with pytest.raises(ValueError, match="exceeds the largest bucket"):
+        pick_bucket(9, ladder)
+
+
+def test_pad_to_bucket_rows_bitwise_and_zero_fill():
+    x = _samples(3, seed=7)
+    padded = pad_to_bucket(x, 4)
+    assert padded.shape == (4,) + LENET_SHAPE
+    np.testing.assert_array_equal(padded[:3], x)   # real rows untouched
+    assert not padded[3].any()                     # padding is zeros
+    assert pad_to_bucket(x, 3) is x                # exact fit: no copy
+    with pytest.raises(ValueError, match="does not fit"):
+        pad_to_bucket(x, 2)
+
+
+def test_validate_buckets():
+    assert validate_buckets([4, 1, 4, 2]) == (1, 2, 4)
+    with pytest.raises(ValueError, match="positive"):
+        validate_buckets([0, 2])
+    with pytest.raises(ValueError, match="positive"):
+        validate_buckets([])
+
+
+# ---------------------------------------------------------------- stats
+def test_latency_series_zero_and_percentiles():
+    s = LatencySeries()
+    assert s.summary() == {"count": 0, "mean_ms": 0.0, "max_ms": 0.0,
+                           "p50_ms": 0.0, "p95_ms": 0.0, "p99_ms": 0.0}
+    for v in range(1, 101):
+        s.add(float(v))
+    out = s.summary()
+    assert out["count"] == 100 and out["max_ms"] == 100.0
+    assert out["p50_ms"] == 50.0 and out["p99_ms"] == 99.0  # nearest rank
+
+
+def test_model_stats_zero_request_snapshot():
+    snap = ModelStats().snapshot()
+    assert snap["submitted"] == 0 and snap["completed"] == 0
+    assert snap["batch_occupancy_mean"] == 0.0
+    assert snap["total_ms"]["p99_ms"] == 0.0
+    for r in ModelStats.REJECTS:
+        assert snap[r] == 0
+    with pytest.raises(ValueError, match="unknown serving counter"):
+        ModelStats().bump("typo_counter")
+
+
+# ------------------------------------------------------------ the server
+@pytest.fixture(scope="module")
+def lenet_server():
+    server = InferenceServer(ServerConfig(max_batch=8, max_wait_ms=3.0,
+                                          queue_depth=64))
+    lm = server.load("lenet")
+    yield server, lm
+    server.close(drain=True)
+
+
+def _direct(lm, sample, bucket):
+    """The parity oracle: a direct forward of this one sample padded to
+    the response's recorded bucket."""
+    return lm.runner.forward_padded(
+        pad_to_bucket(sample[None].astype(np.float32), bucket))[0]
+
+
+def test_parity_mixed_bursts_bitwise(lenet_server):
+    """Every response across mixed-size bursts is BITWISE equal to a
+    direct forward at its recorded bucket: padding rows and batch
+    neighbors never perturb a sample's math (the ISSUE's core acceptance
+    criterion)."""
+    server, lm = lenet_server
+    xs = _samples(32, seed=3)
+    futs = []
+    for burst in (1, 2, 3, 5, 8, 13):        # spans every bucket boundary
+        start = len(futs)
+        futs += server.submit_many("lenet", xs[start:start + burst])
+        time.sleep(0.005)                    # let bursts batch separately
+    assert len(futs) == 32
+    buckets_seen = set()
+    for i, f in enumerate(futs):
+        r = f.result(timeout=30)
+        assert r.bucket in lm.runner.buckets
+        assert 1 <= r.batch_live <= r.bucket
+        buckets_seen.add(r.bucket)
+        np.testing.assert_array_equal(
+            np.asarray(r.probs), _direct(lm, xs[i], r.bucket),
+            err_msg=f"request {i} (bucket {r.bucket})")
+        assert abs(float(np.sum(r.probs)) - 1.0) < 1e-5  # it's a softmax
+    assert len(buckets_seen) > 1  # the mix really exercised >1 bucket
+
+
+def _gated_forward(lm):
+    """Wrap the runner's forward so the test can hold a batch in flight:
+    `entered` fires when the batcher is INSIDE the forward (its coalesce
+    window is over), `release` lets it finish."""
+    entered, release = threading.Event(), threading.Event()
+    orig = lm.runner.forward_padded
+
+    def gated(x):
+        entered.set()
+        assert release.wait(30), "test forgot to release the gate"
+        return orig(x)
+
+    lm.runner.forward_padded = gated
+    return entered, release
+
+
+def test_overload_rejects_then_admitted_work_completes_bitwise():
+    """Admission control: with the batcher pinned in flight and the queue
+    full, submit() raises ServerOverloaded (and wait=True turns it into a
+    bounded block); every ADMITTED request still completes with bitwise
+    parity — overload sheds load, it never corrupts accepted work."""
+    server = InferenceServer(ServerConfig(max_batch=1, max_wait_ms=1.0,
+                                          queue_depth=2))
+    try:
+        lm = server.load("lenet")
+        entered, release = _gated_forward(lm)
+        xs = _samples(4, seed=11)
+        futs = [server.submit("lenet", xs[0])]
+        assert entered.wait(10)              # batch 1 is now in flight
+        futs.append(server.submit("lenet", xs[1]))
+        futs.append(server.submit("lenet", xs[2]))   # queue at depth 2
+        with pytest.raises(ServerOverloaded, match="queue at depth 2"):
+            server.submit("lenet", xs[3])
+        # blocking admission times out into the same rejection
+        t0 = time.perf_counter()
+        with pytest.raises(ServerOverloaded):
+            server.submit("lenet", xs[3], wait=True, wait_timeout_s=0.05)
+        assert time.perf_counter() - t0 >= 0.04
+        release.set()
+        for i, f in enumerate(futs):
+            r = f.result(timeout=30)
+            np.testing.assert_array_equal(
+                np.asarray(r.probs), _direct(lm, xs[i], r.bucket))
+        snap = server.stats()["models"]["lenet"]
+        assert snap["rejected_overload"] == 2
+        assert snap["completed"] == 3
+    finally:
+        release.set()
+        server.close(drain=True)
+
+
+def test_deadline_exceeded_at_batch_assembly():
+    """A request whose deadline passes while it waits behind a slow batch
+    is rejected with DeadlineExceeded at ITS batch's assembly — it never
+    spends device time; requests without deadlines are unaffected."""
+    server = InferenceServer(ServerConfig(max_batch=1, max_wait_ms=1.0,
+                                          queue_depth=8))
+    try:
+        lm = server.load("lenet")
+        entered, release = _gated_forward(lm)
+        xs = _samples(3, seed=13)
+        f0 = server.submit("lenet", xs[0])
+        assert entered.wait(10)
+        f1 = server.submit("lenet", xs[1], deadline_ms=0.5)  # will expire
+        f2 = server.submit("lenet", xs[2])                   # no deadline
+        time.sleep(0.05)                     # let f1's deadline lapse
+        release.set()
+        assert f0.result(timeout=30) is not None
+        with pytest.raises(DeadlineExceeded, match="before batch launch"):
+            f1.result(timeout=30)
+        assert f2.result(timeout=30).argmax in range(10)
+        snap = server.stats()["models"]["lenet"]
+        assert snap["rejected_deadline"] == 1
+        assert snap["completed"] == 2
+    finally:
+        release.set()
+        server.close(drain=True)
+
+
+def test_graceful_drain_delivers_every_admitted_request():
+    """close(drain=True) mid-burst: every admitted future resolves with a
+    real Response — a drain never drops accepted work."""
+    server = InferenceServer(ServerConfig(max_batch=8, max_wait_ms=2.0,
+                                          queue_depth=64))
+    lm = server.load("lenet")
+    xs = _samples(30, seed=17)
+    futs = server.submit_many("lenet", xs)
+    server.close(drain=True)                 # returns only when delivered
+    for i, f in enumerate(futs):
+        r = f.result(timeout=1)              # must already be resolved
+        np.testing.assert_array_equal(
+            np.asarray(r.probs), _direct(lm, xs[i], r.bucket))
+    assert server.stats()["models"]["lenet"]["completed"] == 30
+
+
+def test_close_without_drain_rejects_queued_finishes_inflight():
+    """close(drain=False): the in-flight batch still completes (its math
+    is already launched), everything still QUEUED gets ServerClosed."""
+    server = InferenceServer(ServerConfig(max_batch=1, max_wait_ms=1.0,
+                                          queue_depth=8))
+    lm = server.load("lenet")
+    entered, release = _gated_forward(lm)
+    xs = _samples(4, seed=19)
+    f0 = server.submit("lenet", xs[0])
+    assert entered.wait(10)
+    queued = [server.submit("lenet", x) for x in xs[1:]]
+    threading.Timer(0.05, release.set).start()
+    server.close(drain=False)
+    assert f0.result(timeout=30).bucket == 1
+    for f in queued:
+        with pytest.raises(ServerClosed, match="closed before"):
+            f.result(timeout=1)
+    snap = server.stats()["models"]["lenet"]
+    assert snap["rejected_closed"] == 3
+    with pytest.raises(ServerClosed):
+        server.submit("lenet", xs[0])        # post-close admission
+
+
+def test_unknown_model_and_bad_shape(lenet_server):
+    server, lm = lenet_server
+    with pytest.raises(ModelNotLoaded, match="nope"):
+        server.submit("nope", _samples(1)[0])
+    with pytest.raises(ValueError, match="sample shape"):
+        server.submit("lenet", np.zeros((3, 9, 9), np.float32))
+    # flat vectors of the right size are reshaped (the JSONL path)
+    flat = _samples(1, seed=23)[0].ravel()
+    r = server.submit("lenet", flat).result(timeout=30)
+    assert r.probs.shape == (10,)
+
+
+def test_reload_bumps_generation_and_resets_stats(lenet_server):
+    server, _ = lenet_server
+    lm = server.load("reloadable", "lenet")
+    g0 = lm.generation
+    r0 = server.submit("reloadable", _samples(1, seed=29)[0]).result(
+        timeout=30)
+    assert r0.generation == g0
+    lm2 = server.reload("reloadable")
+    assert lm2 is lm and lm.generation == g0 + 1
+    snap = server.stats()["models"]["reloadable"]
+    assert snap["completed"] == 0            # stats reset on reload
+    assert snap["generation"] == g0 + 1
+    r1 = server.submit("reloadable", _samples(1, seed=29)[0]).result(
+        timeout=30)
+    assert r1.generation == g0 + 1
+    server.unload("reloadable")
+    with pytest.raises(ModelNotLoaded):
+        server.submit("reloadable", _samples(1)[0])
+
+
+def test_stats_snapshot_shape(lenet_server):
+    server, _ = lenet_server
+    st = server.stats()
+    assert st["accepting"] is True
+    assert st["config"]["max_batch"] == 8
+    m = st["models"]["lenet"]
+    for key in ("completed", "submitted", "queued_now", "generation",
+                "batch_occupancy_mean", "bucket_counts",
+                "engine_compiles", "engine_buckets"):
+        assert key in m, key
+    for leg in ("queue_wait_ms", "assembly_ms", "device_ms", "total_ms"):
+        assert set(m[leg]) == {"count", "mean_ms", "max_ms", "p50_ms",
+                               "p95_ms", "p99_ms"}
+
+
+def test_warmup_compiles_every_bucket(lenet_server):
+    _, lm = lenet_server
+    assert tuple(lm.runner.buckets) == (1, 2, 4, 8)
+    assert lm.runner.compile_count() == 4    # one program per bucket
+
+
+@pytest.mark.slow
+def test_soak_compile_count_stays_bounded(lenet_server):
+    """>= 1000 requests in mixed-size bursts: jit compile count never
+    moves off the 4 warmed buckets (the bounded-compile acceptance
+    criterion — steady-state traffic must never stall on a compile)."""
+    server, lm = lenet_server
+    warmed = lm.runner.compile_count()
+    rng = np.random.RandomState(31)
+    xs = _samples(64, seed=31)
+    done = 0
+    while done < 1000:
+        burst = int(rng.randint(1, 14))
+        futs = server.submit_many(
+            "lenet", [xs[(done + j) % 64] for j in range(burst)],
+            wait=True)
+        for f in futs:
+            assert f.result(timeout=60) is not None
+        done += burst
+    assert done >= 1000
+    assert lm.runner.compile_count() == warmed, \
+        "traffic forced a recompile: a batch escaped the bucket ladder"
+    snap = server.stats()["models"]["lenet"]
+    assert snap["failed"] == 0
+    assert 0 < snap["batch_occupancy_mean"] <= 1.0
+
+
+# ------------------------------------------------------------------- CLI
+def test_cli_serve_jsonl_end_to_end(tmp_path, capsys):
+    """`serve` scores a JSONL stream end-to-end: responses come back in
+    input order with matching ids, malformed and wrong-shape lines get
+    per-request error lines (the stream survives), and --stats_out lands
+    the observability snapshot."""
+    from sparknet_tpu import cli
+
+    rng = np.random.RandomState(37)
+    req = tmp_path / "req.jsonl"
+    out = tmp_path / "resp.jsonl"
+    stats_out = tmp_path / "stats.json"
+    lines = []
+    for i in range(9):
+        lines.append(json.dumps(
+            {"id": i, "data": rng.rand(*LENET_SHAPE).round(4).tolist()}))
+    lines.insert(4, "this is not json")                      # malformed
+    lines.insert(7, json.dumps({"id": 99, "data": [1.0, 2.0]}))  # bad shape
+    req.write_text("\n".join(lines) + "\n")
+
+    rc = cli.main(["serve", "--model", "lenet", "--input", str(req),
+                   "--output", str(out), "--max_wait_ms", "2",
+                   "--stats_out", str(stats_out)])
+    assert rc == 0
+    got = [json.loads(ln) for ln in out.read_text().splitlines()]
+    assert len(got) == 11                    # every input line answered
+    ok = [g for g in got if "argmax" in g]
+    errs = [g for g in got if "error" in g]
+    assert [g["id"] for g in ok] == list(range(9))  # input order held
+    for g in ok:
+        assert len(g["probs"]) == 10 and g["bucket"] >= 1
+        assert abs(sum(g["probs"]) - 1.0) < 1e-5
+    assert len(errs) == 2
+    assert {e["status"] for e in errs} == {500}
+    st = json.loads(stats_out.read_text())
+    assert st["models"]["default"]["completed"] == 9
+    err = capsys.readouterr().err
+    assert "served 9/11 requests" in err
