@@ -510,17 +510,21 @@ def bench_imagenet_native(rounds: int = 3, tau: int = 5, batch: int = 64,
         solver.set_prefetch(True)
         solver.run_round()  # compile + warm
         solver.reset_ingest_stats()  # count only the measured window
+        solver.reset_round_stats()
         t0 = time.perf_counter()
         for r in range(rounds):
             solver.run_round(prefetch_next=r < rounds - 1)
         dt = time.perf_counter() - t0
         ingest = solver.ingest_stats()
+        telemetry = {k: v for k, v in solver.round_stats().items()
+                     if k != "per_round"}
     finally:
         shutil.rmtree(tmp, ignore_errors=True)
     out = {"imagenet_native_fed_imgs_per_sec":
            round(rounds * tau * batch / dt, 1),
            "imagenet_native_batch": batch, "imagenet_native_tau": tau,
-           "imagenet_native_ingest": ingest}
+           "imagenet_native_ingest": ingest,
+           "imagenet_native_round_telemetry": telemetry}
     log(json.dumps(out))
     return out
 
@@ -537,9 +541,11 @@ def bench_cifar_e2e(rounds: int = 6, tau: int = 100,
     one τ-step compiled round per device call, fed by a round-agnostic
     host stream (so set_prefetch's depth-k look-ahead is safe), fresh
     batches pulled and shipped every round.  Returns
-    {"imgs_per_sec": ..., "ingest": solver.ingest_stats()} so the
-    per-stage pull/stack/device_put/stall split rides the driver record
-    (data/counters.py semantics)."""
+    {"imgs_per_sec": ..., "ingest": solver.ingest_stats(),
+    "round_telemetry": solver.round_stats() sans per_round} so the
+    per-stage pull/stack/device_put/stall split AND the per-round phase
+    means ride the driver record (data/counters.py + parallel/dist.py
+    round telemetry semantics)."""
     import numpy as np
 
     from sparknet_tpu.apps.cifar_app import build_solver
@@ -569,12 +575,16 @@ def bench_cifar_e2e(rounds: int = 6, tau: int = 100,
     solver.set_prefetch(prefetch)  # scripts/prefetch_delta.py flips this
     solver.run_round()  # compile + warm
     solver.reset_ingest_stats()  # count only the measured window
+    solver.reset_round_stats()
     t0 = time.perf_counter()
     for r in range(rounds):
         solver.run_round(prefetch_next=r < rounds - 1)
     dt = time.perf_counter() - t0
     return {"imgs_per_sec": rounds * tau * batch / dt,
-            "ingest": solver.ingest_stats()}
+            "ingest": solver.ingest_stats(),
+            "round_telemetry": {k: v for k, v
+                                in solver.round_stats().items()
+                                if k != "per_round"}}
 
 
 LAST_GOOD = os.environ.get(
@@ -598,9 +608,14 @@ _KNOWN_FIELDS = {
     "googlenet_mfu", "googlenet_b128_imgs_per_sec", "googlenet_b128_mfu",
     "alexnet_infer_imgs_per_sec", "googlenet_infer_imgs_per_sec",
     "longctx_lm_tok_per_sec", "cifar_e2e_imgs_per_sec",
-    "cifar_e2e_ingest",
+    "cifar_e2e_ingest", "cifar_e2e_round_telemetry",
     "imagenet_native_fed_imgs_per_sec", "imagenet_native_batch",
     "imagenet_native_tau", "imagenet_native_ingest",
+    "imagenet_native_round_telemetry",
+    # emit-time provenance stamps (_stamp); never persisted by
+    # _persist_leg, listed so a hand-edited record carrying them is
+    # not flagged as drift
+    "schema_version", "git_sha", "env",
     "serving_model", "serving_offered_qps", "serving_qps",
     "serving_p50_ms", "serving_p99_ms", "serving_batch_occupancy",
     "serving_rejected", "serving_compiles",
@@ -695,6 +710,42 @@ def _stale_record(reason: str) -> dict:
     return stale
 
 
+BENCH_SCHEMA_VERSION = 2
+
+# git SHA memo, resolved lazily on the NORMAL emit path only: the signal
+# bail handler must never reach a subprocess call, so it writes its
+# fallback line directly and stays unstamped by design
+_git_sha_memo: list = []
+
+
+def _stamp(payload: dict) -> dict:
+    """Provenance stamp applied at emit time: schema_version, the repo's
+    short git SHA, and every active SPARKNET_* env knob, so a record line
+    can be tied to the exact build + configuration that produced it.
+    Stamps are NOT persisted by _persist_leg — a stale replay carries the
+    replaying process's provenance, which is the honest reading (the env
+    shown is the one that decided to replay)."""
+    if not _git_sha_memo:
+        sha = None
+        try:
+            import subprocess
+            r = subprocess.run(
+                ["git", "rev-parse", "--short", "HEAD"],
+                cwd=os.path.dirname(os.path.abspath(__file__)),
+                capture_output=True, timeout=10)
+            if r.returncode == 0:
+                sha = r.stdout.decode().strip() or None
+        except Exception:
+            sha = None
+        _git_sha_memo.append(sha)
+    out = dict(payload)
+    out["schema_version"] = BENCH_SCHEMA_VERSION
+    out["git_sha"] = _git_sha_memo[0]
+    out["env"] = {k: os.environ[k] for k in sorted(os.environ)
+                  if k.startswith("SPARKNET_")}
+    return out
+
+
 def _emit_json_line(payload: dict) -> None:
     """Write the ONE contract line with SIGTERM/SIGINT blocked across the
     check-write-flag critical section, so the bail handler can neither
@@ -703,6 +754,10 @@ def _emit_json_line(payload: dict) -> None:
     immediately after (print()'s buffer would be lost by os._exit)."""
     global _json_line_emitted
     import signal
+
+    # stamp BEFORE masking: _stamp may spawn a subprocess (git), which
+    # has no business inside the signal-masked critical section
+    payload = _stamp(payload)
 
     mask = {signal.SIGTERM, signal.SIGINT}
     try:
@@ -907,7 +962,9 @@ def _run_legs(land) -> None:
                     "cifar_e2e_ingest": cifar_e2e["ingest"]}))
     land("cifar_e2e", {"cifar_e2e_imgs_per_sec":
                        round(cifar_e2e["imgs_per_sec"], 1),
-                       "cifar_e2e_ingest": cifar_e2e["ingest"]})
+                       "cifar_e2e_ingest": cifar_e2e["ingest"],
+                       "cifar_e2e_round_telemetry":
+                       cifar_e2e["round_telemetry"]})
     # online-serving leg (CPU backend by design — see bench_serving
     # docstring); guarded so a serving regression degrades one leg
     # rather than staling every device number already landed above
@@ -935,7 +992,9 @@ def _run_legs(land) -> None:
               imgnet_native["imagenet_native_batch"],
               "imagenet_native_tau": imgnet_native["imagenet_native_tau"],
               "imagenet_native_ingest":
-              imgnet_native["imagenet_native_ingest"]})
+              imgnet_native["imagenet_native_ingest"],
+              "imagenet_native_round_telemetry":
+              imgnet_native["imagenet_native_round_telemetry"]})
 
 
 if __name__ == "__main__":

@@ -1,0 +1,64 @@
+#!/usr/bin/env python
+"""Print the top-k spans of a saved Chrome trace-event file (the
+sparknet_tpu.obs tracer's export, or any trace with ph:"X" complete
+events — ts/dur in microseconds).
+
+    python scripts/trace_summary.py /tmp/sparknet_trace.json --top 15
+    python scripts/trace_summary.py t.json --by count
+
+Pure stdlib: runnable anywhere a trace file lands (including boxes
+without the repo's environment set up).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def summarize(doc: dict, top: int, by: str) -> str:
+    events = doc.get("traceEvents", doc if isinstance(doc, list) else [])
+    agg: dict = {}
+    for ev in events:
+        if ev.get("ph") != "X" or "dur" not in ev:
+            continue
+        row = agg.setdefault(ev["name"], [0, 0.0, 0.0])
+        row[0] += 1
+        row[1] += float(ev["dur"])
+        row[2] = max(row[2], float(ev["dur"]))
+    lines = [f"{'span':32s} {'count':>7s} {'total_ms':>10s} "
+             f"{'mean_ms':>9s} {'max_ms':>9s}"]
+    key = ((lambda kv: -kv[1][0]) if by == "count"
+           else (lambda kv: -kv[1][1]))
+    for name, (cnt, tot, mx) in sorted(agg.items(), key=key)[:top]:
+        lines.append(f"{name:32s} {cnt:7d} {tot / 1e3:10.3f} "
+                     f"{tot / cnt / 1e3:9.3f} {mx / 1e3:9.3f}")
+    if not agg:
+        lines.append("(no complete spans in trace)")
+    dropped = (doc.get("otherData", {}).get("dropped_events", 0)
+               if isinstance(doc, dict) else 0)
+    if dropped:
+        lines.append(f"[ring full: {dropped} oldest events dropped]")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("trace", help="Chrome trace-event JSON file")
+    p.add_argument("--top", type=int, default=20)
+    p.add_argument("--by", default="total", choices=["total", "count"],
+                   help="rank spans by total time or call count")
+    args = p.parse_args(argv)
+    try:
+        with open(args.trace) as f:
+            doc = json.load(f)
+    except (OSError, json.JSONDecodeError) as e:
+        print(f"cannot read trace {args.trace!r}: {e}", file=sys.stderr)
+        return 1
+    print(summarize(doc, args.top, args.by))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

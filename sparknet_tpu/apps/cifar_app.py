@@ -243,6 +243,7 @@ def run(num_workers: int, *, model: str = "quick", rounds: int = 100,
         log(f"final %-age of test set correct: {accuracy}")
         return accuracy
     finally:
+        log.close()
         if native_feed:
             for f in feeds:
                 if hasattr(f, "close"):

@@ -119,6 +119,7 @@ def run_from_store(num_workers: int, store: str, *, model: str = "quick",
                 f"{solver.current_lr():.8g}", i=r)
             log(f"round loss = {loss}", i=r)
     finally:
+        log.close()
         for f in feeds:
             if hasattr(f, "close"):
                 f.close()

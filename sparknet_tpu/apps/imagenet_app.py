@@ -140,87 +140,90 @@ def run(num_workers: int, *, shards_dir: str = "", label_file: str = "",
     host-side DataTransformer."""
     log = PhaseLogger(log_path or
                       f"/tmp/training_log_{int(time.time())}.txt")
-    log(f"workers = {num_workers}, model = {model}, tau = {tau}")
-    if device_transform is None:
-        device_transform = not (synthetic or not shards_dir)
+    try:
+        log(f"workers = {num_workers}, model = {model}, tau = {tau}")
+        if device_transform is None:
+            device_transform = not (synthetic or not shards_dir)
 
-    if synthetic or not shards_dir:
-        if device_transform:
-            # the synthetic feed produces pre-transformed crops, so there
-            # is nothing for a device transform to do — don't pretend
-            raise SystemExit(
-                "--device-transform needs real shard data "
-                "(the synthetic feed is already crop-sized floats)")
-        solver = build_solver(model, num_workers, tau, batch_size,
-                              test_batch, mesh=mesh, crop=crop,
-                              dcn_interval=dcn_interval)
-        log("built solver")
-        feeds = [synthetic_feed(batch_size, crop, seed=w)
-                 for w in range(num_workers)]
-        test_source = synthetic_feed(test_batch, crop, seed=999)
-        num_test = 2
-    else:
-        loader = ImageNetLoader(shards_dir)
-        paths = loader.get_file_paths()
-        # mean image over a sample (reference computes the full distributed
-        # mean, ImageNetApp.scala:95-105 / ComputeMean.scala)
-        from ..data.transform import compute_mean_image
-        sample = loader.batches(label_file, batch_size=batch_size,
-                                shards=paths[:1])
-        mean = compute_mean_image(b for b, _ in [next(sample)])
-        log("computed mean image")
-        solver = build_solver(model, num_workers, tau, batch_size,
-                              test_batch, mesh=mesh, crop=crop,
-                              dcn_interval=dcn_interval, mean_image=mean,
-                              device_transform=device_transform)
-        log("built solver")
-        if device_transform:
-            train_tf = test_tf = None  # raw uint8; transform on device
-            log("device-side transform enabled (uint8 feed)")
+        if synthetic or not shards_dir:
+            if device_transform:
+                # the synthetic feed produces pre-transformed crops, so there
+                # is nothing for a device transform to do — don't pretend
+                raise SystemExit(
+                    "--device-transform needs real shard data "
+                    "(the synthetic feed is already crop-sized floats)")
+            solver = build_solver(model, num_workers, tau, batch_size,
+                                  test_batch, mesh=mesh, crop=crop,
+                                  dcn_interval=dcn_interval)
+            log("built solver")
+            feeds = [synthetic_feed(batch_size, crop, seed=w)
+                     for w in range(num_workers)]
+            test_source = synthetic_feed(test_batch, crop, seed=999)
+            num_test = 2
         else:
-            train_tf = DataTransformer(crop_size=crop, mirror=True,
-                                       mean_image=mean, phase="TRAIN")
-            test_tf = DataTransformer(crop_size=crop, mean_image=mean,
-                                      phase="TEST")
-        feeds = [ShardFeed(loader, shard_paths_for_worker(paths, w,
-                                                          num_workers),
-                           label_file, batch_size, train_tf)
-                 for w in range(num_workers)]
-        test_source = ShardFeed(loader, paths, label_file, test_batch,
-                                test_tf)
-        num_test = 10
-        solver.set_prefetch(True)  # stream feeds: stage N+1 during N
-    solver.set_train_data(feeds)
-    solver.set_test_data(test_source, num_test)
+            loader = ImageNetLoader(shards_dir)
+            paths = loader.get_file_paths()
+            # mean image over a sample (reference computes the full distributed
+            # mean, ImageNetApp.scala:95-105 / ComputeMean.scala)
+            from ..data.transform import compute_mean_image
+            sample = loader.batches(label_file, batch_size=batch_size,
+                                    shards=paths[:1])
+            mean = compute_mean_image(b for b, _ in [next(sample)])
+            log("computed mean image")
+            solver = build_solver(model, num_workers, tau, batch_size,
+                                  test_batch, mesh=mesh, crop=crop,
+                                  dcn_interval=dcn_interval, mean_image=mean,
+                                  device_transform=device_transform)
+            log("built solver")
+            if device_transform:
+                train_tf = test_tf = None  # raw uint8; transform on device
+                log("device-side transform enabled (uint8 feed)")
+            else:
+                train_tf = DataTransformer(crop_size=crop, mirror=True,
+                                           mean_image=mean, phase="TRAIN")
+                test_tf = DataTransformer(crop_size=crop, mean_image=mean,
+                                          phase="TEST")
+            feeds = [ShardFeed(loader, shard_paths_for_worker(paths, w,
+                                                              num_workers),
+                               label_file, batch_size, train_tf)
+                     for w in range(num_workers)]
+            test_source = ShardFeed(loader, paths, label_file, test_batch,
+                                    test_tf)
+            num_test = 10
+            solver.set_prefetch(True)  # stream feeds: stage N+1 during N
+        solver.set_train_data(feeds)
+        solver.set_test_data(test_source, num_test)
 
-    from .common import (check_snapshot_args, maybe_snapshot_round,
-                         resume_and_replay)
-    check_snapshot_args(snapshot_every_rounds, snapshot_prefix)
-    start_round = 0
-    if resume:
-        start_round = resume_and_replay(solver, resume, feeds, log)
+        from .common import (check_snapshot_args, maybe_snapshot_round,
+                             resume_and_replay)
+        check_snapshot_args(snapshot_every_rounds, snapshot_prefix)
+        start_round = 0
+        if resume:
+            start_round = resume_and_replay(solver, resume, feeds, log)
 
-    accuracy = 0.0
-    for r in range(start_round, rounds):
-        if r % test_every == 0:
-            scores = solver.test()
-            accuracy = scores.get("accuracy", 0.0)
-            if "loss" in scores:  # test-net loss, for plot types 2/3
-                log(f"test loss = {scores['loss']}", i=r)
-            log(f"%-age of test set correct: {accuracy}", i=r)
-        log("starting training", i=r)
-        loss = solver.run_round(prefetch_next=r < rounds - 1)
-        log(f"round lr = "
-            f"{solver.current_lr():.8g}", i=r)
-        log(f"round loss = {loss}", i=r)
-        maybe_snapshot_round(solver, log, r, snapshot_every_rounds,
-                             snapshot_prefix)
-    scores = solver.test()
-    accuracy = scores.get("accuracy", 0.0)
-    if "loss" in scores:
-        log(f"test loss = {scores['loss']}")
-    log(f"final %-age of test set correct: {accuracy}")
-    return accuracy
+        accuracy = 0.0
+        for r in range(start_round, rounds):
+            if r % test_every == 0:
+                scores = solver.test()
+                accuracy = scores.get("accuracy", 0.0)
+                if "loss" in scores:  # test-net loss, for plot types 2/3
+                    log(f"test loss = {scores['loss']}", i=r)
+                log(f"%-age of test set correct: {accuracy}", i=r)
+            log("starting training", i=r)
+            loss = solver.run_round(prefetch_next=r < rounds - 1)
+            log(f"round lr = "
+                f"{solver.current_lr():.8g}", i=r)
+            log(f"round loss = {loss}", i=r)
+            maybe_snapshot_round(solver, log, r, snapshot_every_rounds,
+                                 snapshot_prefix)
+        scores = solver.test()
+        accuracy = scores.get("accuracy", 0.0)
+        if "loss" in scores:
+            log(f"test loss = {scores['loss']}")
+        log(f"final %-age of test set correct: {accuracy}")
+        return accuracy
+    finally:
+        log.close()
 
 
 def main() -> None:

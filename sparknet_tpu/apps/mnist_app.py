@@ -69,6 +69,14 @@ def synthetic_mnist(n=2000, seed=0):
 def run(*, data_dir: str = "", iterations: int = 1000, batch: int = BATCH,
         synthetic: bool = False, log_path: Optional[str] = None) -> float:
     log = PhaseLogger(log_path)
+    try:
+        return _run(log, data_dir=data_dir, iterations=iterations,
+                    batch=batch, synthetic=synthetic)
+    finally:
+        log.close()
+
+
+def _run(log, *, data_dir, iterations, batch, synthetic) -> float:
     if synthetic or not data_dir:
         xtr, ytr = synthetic_mnist()
         xte, yte = synthetic_mnist(500, seed=9)

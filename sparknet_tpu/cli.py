@@ -24,10 +24,11 @@ from __future__ import annotations
 import argparse
 import json
 import sys
-import time
 from typing import Dict, Optional
 
 import numpy as np
+
+from .obs.trace import now_s
 
 
 def _load_batch_list(path: str, batch: int):
@@ -147,6 +148,7 @@ def _train_distributed(args, sp, net, batches=None) -> int:
     data (CifarApp.scala:120-130 zipPartitions)."""
     from .parallel.dist import DistributedSolver
     from .parallel.mesh import make_mesh
+    from .utils.logging import PhaseLogger
     from .utils.signals import SignalHandler, parse_effect
 
     n = args.workers
@@ -186,21 +188,29 @@ def _train_distributed(args, sp, net, batches=None) -> int:
             raise SystemExit(
                 "net has no self-feeding data layer; pass --data")
         solver.set_train_data([shared] * n)
+    if getattr(args, "round_log", None):
+        solver.set_round_log(args.round_log)
     n_iters = args.iterations or int(sp.max_iter) or 100
-    with _maybe_profile(args):
+    # round logging rides through PhaseLogger (context-managed: the
+    # --train_log file closes even when a round raises), echoing to
+    # stdout where the reference-style "Iteration N, ..." lines are
+    # pinned by tests/test_cli.py
+    with _maybe_profile(args), \
+            PhaseLogger(path=getattr(args, "train_log", None),
+                        stream=sys.stdout) as plog:
         while solver.iter < n_iters:
             loss = solver.run_round()
-            print(f"Iteration {solver.iter}, lr = "
-                  f"{solver.current_lr():.8g}")
-            print(f"Iteration {solver.iter}, loss = {loss:.6f} "
-                  f"(round {solver.round}, {n} workers, tau={solver.tau})")
+            plog(f"Iteration {solver.iter}, lr = "
+                 f"{solver.current_lr():.8g}")
+            plog(f"Iteration {solver.iter}, loss = {loss:.6f} "
+                 f"(round {solver.round}, {n} workers, tau={solver.tau})")
             action = handler.get_requested_action()
             if action.name == "STOP":
                 break
             if action.name == "SNAPSHOT":
                 state_path = solver.snapshot(
                     (args.out or "trained.npz") + ".solverstate")
-                print(f"Snapshotted state to {state_path}")
+                plog(f"Snapshotted state to {state_path}")
     out = args.out or "trained.npz"
     solver.save_weights(out)
     print(f"Optimization Done. Snapshot written to {out}")
@@ -357,12 +367,12 @@ def cmd_time(args) -> int:
         salt = [jnp.float32(0.0)]
 
         def run(m):
-            t0 = time.perf_counter()
+            t0 = now_s()
             out = None
             for _ in range(m):
                 out, salt[0] = jfn(params, inputs, key, salt[0])
             float(out.ravel()[0] if hasattr(out, "ravel") else out)
-            return time.perf_counter() - t0
+            return now_s() - t0
 
         return differenced_chain_s(run, n) * 1e3
 
@@ -433,6 +443,13 @@ def main(argv=None) -> int:
                         "history at very large tau")
     t.add_argument("--profile",
                    help="write a jax profiler trace to this directory")
+    t.add_argument("--train_log",
+                   help="also append the round log lines to this file "
+                        "(PhaseLogger dialect)")
+    t.add_argument("--round_log",
+                   help="append one JSON line of per-round telemetry per "
+                        "round to this file (workers > 1; see DISTACC.md; "
+                        "SPARKNET_ROUND_LOG env is the API-level knob)")
     t.set_defaults(fn=cmd_train)
 
     te = sub.add_parser("test")
@@ -459,6 +476,9 @@ def main(argv=None) -> int:
 
     from .serving import cli as serving_cli
     serving_cli.register(sub)
+
+    from .obs import cli as obs_cli
+    obs_cli.register(sub)
 
     args = p.parse_args(argv)
     return args.fn(args)

@@ -8,6 +8,14 @@ source pulls, τ-stacking, device_put dispatch, consumer stall — accumulates
 wall seconds into one thread-safe counter object that the solvers surface
 through `ingest_stats()` and bench.py lands in its one-line JSON record.
 
+Since the obs/ unification, IngestCounters is a facade over a private
+`obs.metrics.MetricsRegistry` (labeled `ingest_stage_seconds{stage=...}`
+counters, lazily created event counters, one ring-occupancy histogram);
+the public `snapshot()` dict is reconstructed key-for-key from the
+registry, so the legacy contract (pinned by tests/test_ingest_pipeline.py
+and landed verbatim in bench records) is unchanged while the same numbers
+are now also available as Prometheus text via `counters.registry`.
+
 Reading the numbers (BENCH_NOTES.md "Ingest pipeline"):
 
 - ``pull_s`` / ``stack_s`` / ``device_put_s`` are CORE-seconds: summed
@@ -26,8 +34,10 @@ Reading the numbers (BENCH_NOTES.md "Ingest pipeline"):
 from __future__ import annotations
 
 import threading
-import time
 from typing import Dict
+
+from ..obs.metrics import Counter, MetricsRegistry
+from ..obs.trace import now_s
 
 
 class IngestCounters:
@@ -41,12 +51,28 @@ class IngestCounters:
 
     def reset(self) -> None:
         with self._lock:
-            self._seconds = {s: 0.0 for s in self.STAGES}
-            self._items = {s: 0 for s in self.STAGES}
-            self._counts: Dict[str, int] = {}
-            self._ring_sum = 0
-            self._ring_max = 0
-            self._ring_samples = 0
+            # A fresh registry per reset: registrations carry no history
+            # across resets, and lazily-bumped event counters keep their
+            # first-bump insertion order (the snapshot key order the old
+            # dict-based implementation had).
+            self._registry = MetricsRegistry()
+            self._seconds = {
+                s: self._registry.counter("ingest_stage_seconds",
+                                          labels={"stage": s})
+                for s in self.STAGES}
+            self._items = {
+                s: self._registry.counter("ingest_stage_items",
+                                          labels={"stage": s})
+                for s in self.STAGES}
+            self._counts: Dict[str, Counter] = {}
+            self._ring = self._registry.histogram("ingest_ring_occupancy",
+                                                  window=4096)
+
+    @property
+    def registry(self) -> MetricsRegistry:
+        """The backing metrics registry (for Prometheus-text export)."""
+        with self._lock:
+            return self._registry
 
     def add(self, stage: str, seconds: float, items: int = 0) -> None:
         """Accumulate `seconds` of work (and optionally `items` processed)
@@ -55,24 +81,33 @@ class IngestCounters:
         if stage not in self._seconds:
             raise ValueError(f"unknown ingest stage {stage!r}; "
                              f"one of {self.STAGES}")
-        with self._lock:
-            self._seconds[stage] += float(seconds)
-            self._items[stage] += int(items)
+        self._seconds[stage].inc(float(seconds))
+        if items:
+            self._items[stage].inc(int(items))
+
+    def seconds(self, stage: str) -> float:
+        """Current accumulated wall seconds of one stage (cheap read —
+        the dist round loop differences `stall` across a round)."""
+        if stage not in self._seconds:
+            raise ValueError(f"unknown ingest stage {stage!r}; "
+                             f"one of {self.STAGES}")
+        return self._seconds[stage].value
 
     def bump(self, name: str, n: int = 1) -> None:
         """Increment a named event counter (rounds_staged, rounds_consumed,
         serial_rounds, ...)."""
         with self._lock:
-            self._counts[name] = self._counts.get(name, 0) + int(n)
+            c = self._counts.get(name)
+            if c is None:
+                c = self._registry.counter("ingest_events",
+                                           labels={"event": name})
+                self._counts[name] = c
+        c.inc(int(n))
 
     def observe_ring(self, occupancy: int) -> None:
         """Sample the staged-round ring occupancy (called by the executor
         at each producer insert and consumer take)."""
-        with self._lock:
-            occ = int(occupancy)
-            self._ring_sum += occ
-            self._ring_max = max(self._ring_max, occ)
-            self._ring_samples += 1
+        self._ring.observe(int(occupancy))
 
     def timed(self, stage: str, items: int = 0) -> "_Timed":
         """Context manager: `with counters.timed("pull", items=tau): ...`"""
@@ -91,15 +126,16 @@ class IngestCounters:
         with self._lock:
             out: Dict[str, float] = {}
             for s in self.STAGES:
-                out[f"{s}_s"] = round(self._seconds[s], 5)
-            out["pull_items"] = self._items["pull"]
+                out[f"{s}_s"] = round(self._seconds[s].value, 5)
+            out["pull_items"] = int(self._items["pull"].value)
             out["rounds_staged"] = 0
             out["rounds_consumed"] = 0
-            out.update(self._counts)
-            if self._ring_samples:
+            out.update({name: int(c.value)
+                        for name, c in self._counts.items()})
+            if self._ring.count:
                 out["ring_occ_mean"] = round(
-                    self._ring_sum / self._ring_samples, 3)
-                out["ring_occ_max"] = self._ring_max
+                    self._ring.sum / self._ring.count, 3)
+                out["ring_occ_max"] = int(self._ring.max)
             else:
                 out["ring_occ_mean"] = 0.0
                 out["ring_occ_max"] = 0
@@ -112,8 +148,8 @@ class _Timed:
         self._c, self._stage, self._items = counters, stage, items
 
     def __enter__(self) -> "_Timed":
-        self._t0 = time.perf_counter()
+        self._t0 = now_s()
         return self
 
     def __exit__(self, *exc) -> None:
-        self._c.add(self._stage, time.perf_counter() - self._t0, self._items)
+        self._c.add(self._stage, now_s() - self._t0, self._items)

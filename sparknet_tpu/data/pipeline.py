@@ -40,6 +40,8 @@ import time
 import weakref
 from typing import Any, Callable, List, Optional, Sequence
 
+from ..obs.trace import now_s, span
+
 __all__ = ["PipelinedIngestExecutor", "pooled_map", "shared_pool_size",
            "default_prefetch_depth", "default_pull_workers"]
 
@@ -193,7 +195,9 @@ class PipelinedIngestExecutor:
                 self._next = r + 1
                 self._staging = True
             try:
-                payload = self._stage_fn(r)
+                with span("ingest.stage_round", round=r) as sp:
+                    payload = self._stage_fn(r)
+                    sp.set(ring=len(self._ring))
             except BaseException as e:  # surfaced on the consumer's get()
                 with self._cv:
                     self._err = (r, e)
@@ -216,29 +220,32 @@ class PipelinedIngestExecutor:
         drained) — the caller then stages serially.  Raises the original
         pull-worker exception when the consumer reaches the failed round;
         rounds staged successfully before the failure are served first."""
-        t0 = time.perf_counter()
-        with self._cv:
-            while (not self._ring and self._err is None
-                   and not self._done and not self._stop):
-                self._cv.wait(0.2)
-            stall = time.perf_counter() - t0
-            self.counters.add("stall", stall)
-            if self._ring:
-                r, payload = self._ring.popleft()
-                self.counters.observe_ring(len(self._ring))
-                self.counters.bump("rounds_consumed")
-                self._cv.notify_all()
-                if expected_round is not None and r != expected_round:
-                    raise RuntimeError(
-                        f"staged-round order violated: got round {r}, "
-                        f"consumer expected {expected_round} — was the "
-                        f"solver's round counter mutated without closing "
-                        f"the ingest executor?")
-                return payload
-            if self._err is not None:
-                r, e = self._err
-                raise e
-            return None
+        with span("ingest.get") as sp:
+            t0 = now_s()
+            with self._cv:
+                while (not self._ring and self._err is None
+                       and not self._done and not self._stop):
+                    self._cv.wait(0.2)
+                stall = now_s() - t0
+                self.counters.add("stall", stall)
+                if self._ring:
+                    r, payload = self._ring.popleft()
+                    self.counters.observe_ring(len(self._ring))
+                    self.counters.bump("rounds_consumed")
+                    sp.set(round=r, stall_s=round(stall, 6),
+                           ring=len(self._ring))
+                    self._cv.notify_all()
+                    if expected_round is not None and r != expected_round:
+                        raise RuntimeError(
+                            f"staged-round order violated: got round {r}, "
+                            f"consumer expected {expected_round} — was the "
+                            f"solver's round counter mutated without "
+                            f"closing the ingest executor?")
+                    return payload
+                if self._err is not None:
+                    r, e = self._err
+                    raise e
+                return None
 
     # ------------------------------------------------------------- control
     def stop_staging(self) -> None:

@@ -1,0 +1,14 @@
+"""Observability substrate: span tracing (Chrome-trace export) and the
+unified metrics registry that ingest/training/serving counters are
+built on.  See trace.py and metrics.py module docstrings."""
+
+from .trace import (DEFAULT_CAPACITY, Tracer, device_annotation, disable,
+                    enable, enabled, instant, now_s, span, timed_span,
+                    tracer)
+from .metrics import Counter, Gauge, Histogram, MetricsRegistry
+
+__all__ = [
+    "DEFAULT_CAPACITY", "Tracer", "device_annotation", "disable", "enable",
+    "enabled", "instant", "now_s", "span", "timed_span", "tracer",
+    "Counter", "Gauge", "Histogram", "MetricsRegistry",
+]

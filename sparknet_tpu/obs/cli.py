@@ -1,0 +1,156 @@
+"""The `trace` CLI verb: run a short built-in workload with the span
+tracer armed and write a Chrome-trace JSON + plain-text summary.
+
+    SPARKNET_TRACE=/tmp/t.json python -m sparknet_tpu.cli trace \\
+        --workload serve
+    python -m sparknet_tpu.cli trace --workload train-round --out /tmp/t.json
+
+Workloads:
+
+- ``time``:        a salted jitted-matmul dependency chain (the bench.py
+                   measure_chain protocol in miniature) — the smallest
+                   end-to-end span/export smoke.
+- ``serve``:       load lenet into the micro-batching InferenceServer,
+                   score a burst of random samples — exercises the
+                   serve.submit/assemble/device/respond lifecycle spans.
+- ``train-round``: a tiny DistributedSolver on synthetic data for a few
+                   rounds — exercises dist.round/stage/dispatch/sync and
+                   the ingest spans, then prints solver.round_stats().
+
+Output path: --out wins, else SPARKNET_TRACE, else /tmp/sparknet_trace.json.
+The trace loads in https://ui.perfetto.dev or chrome://tracing; the
+``.txt`` sibling is the top-spans table (scripts/trace_summary.py prints
+the same table from any saved trace file).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+import numpy as np
+
+from . import trace
+
+
+def _workload_time() -> None:
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def step(x, salt):
+        y = x @ x + salt
+        return y / (1.0 + jnp.abs(jnp.mean(y))), salt + 1e-3
+
+    x = jnp.asarray(np.random.RandomState(0).rand(256, 256)
+                    .astype(np.float32))
+    salt = jnp.float32(0.0)
+    with trace.span("time.warmup"):
+        x, salt = step(x, salt)
+        float(x[0, 0])  # VALUE fetch: the only honest sync on the tunnel
+    for i in range(10):
+        with trace.span("time.step", i=i) as sp:
+            x, salt = step(x, salt)
+            sp.set(probe=float(x[0, 0]))
+
+
+def _workload_serve(n_requests: int = 32) -> None:
+    import jax
+
+    from ..serving.server import InferenceServer, ServerConfig
+
+    # CPU device: the workload must not depend on (or wedge) the tunnel
+    cpu = jax.devices("cpu")[0]
+    rng = np.random.RandomState(0)
+    with InferenceServer(ServerConfig(max_batch=4, max_wait_ms=2.0)) as srv:
+        lm = srv.load("lenet", device=cpu)
+        futs = [srv.submit("lenet",
+                           rng.rand(*lm.runner.sample_shape)
+                           .astype(np.float32), wait=True)
+                for _ in range(n_requests)]
+        for f in futs:
+            f.result(timeout=60)
+        snap = srv.stats()["models"]["lenet"]
+        print(f"served {snap['completed']}/{n_requests} requests in "
+              f"{snap['batches']} batches "
+              f"(p50 {snap['total_ms']['p50_ms']} ms)")
+
+
+def _workload_train_round(rounds: int = 2, workers: int = 1) -> None:
+    import json
+
+    from ..parallel.dist import DistributedSolver
+    from ..proto import caffe_pb
+
+    net_text = """
+        name: 'trace_toy'
+        layer { name: 'data' type: 'MemoryData' top: 'data' top: 'label'
+                memory_data_param { batch_size: 16 channels: 1
+                                    height: 8 width: 8 } }
+        layer { name: 'ip1' type: 'InnerProduct' bottom: 'data' top: 'ip1'
+                inner_product_param { num_output: 16 } }
+        layer { name: 'relu1' type: 'ReLU' bottom: 'ip1' top: 'ip1' }
+        layer { name: 'ip2' type: 'InnerProduct' bottom: 'ip1' top: 'ip2'
+                inner_product_param { num_output: 4 } }
+        layer { name: 'loss' type: 'SoftmaxWithLoss' bottom: 'ip2'
+                bottom: 'label' top: 'loss' }
+    """
+    sp_text = ("base_lr: 0.05 lr_policy: 'fixed' momentum: 0.9 "
+               "random_seed: 7")
+    net = caffe_pb.parse_net_text(net_text)
+    sparam = caffe_pb.SolverParameter(caffe_pb.parse(sp_text))
+    solver = DistributedSolver(sparam, net_param=net, n_workers=workers,
+                               tau=3)
+
+    def stream(seed):
+        rng = np.random.RandomState(seed)
+
+        def src():
+            return {"data": rng.rand(16, 1, 8, 8).astype(np.float32),
+                    "label": rng.randint(0, 4, 16).astype(np.int32)}
+        return src
+
+    solver.set_train_data([stream(w) for w in range(workers)])
+    for _ in range(rounds):
+        loss = solver.run_round()
+    print(f"final round loss = {loss:.6f}")
+    stats = solver.round_stats()
+    print(json.dumps({k: v for k, v in stats.items() if k != "per_round"}))
+
+
+def cmd_trace(args) -> int:
+    out = (args.out or os.environ.get("SPARKNET_TRACE")
+           or "/tmp/sparknet_trace.json")
+    t = trace.enable(out)
+    with trace.span(f"trace.{args.workload}"):
+        if args.workload == "time":
+            _workload_time()
+        elif args.workload == "serve":
+            _workload_serve(n_requests=args.requests)
+        else:
+            _workload_train_round(rounds=args.rounds,
+                                  workers=args.workers)
+    t.export_chrome_trace(out)
+    t.write_summary(out + ".txt")
+    print(f"trace written to {out} (+ {out}.txt) — open in "
+          f"https://ui.perfetto.dev or chrome://tracing", file=sys.stderr)
+    print(t.summary())
+    return 0
+
+
+def register(sub) -> None:
+    s = sub.add_parser(
+        "trace", help="run a short workload with the span tracer armed; "
+                      "write Chrome-trace JSON + text summary (obs/)")
+    s.add_argument("--workload", default="time",
+                   choices=["time", "serve", "train-round"])
+    s.add_argument("--out",
+                   help="trace path (default: SPARKNET_TRACE env, then "
+                        "/tmp/sparknet_trace.json)")
+    s.add_argument("--requests", type=int, default=32,
+                   help="serve workload: request burst size")
+    s.add_argument("--rounds", type=int, default=2,
+                   help="train-round workload: rounds to run")
+    s.add_argument("--workers", type=int, default=1,
+                   help="train-round workload: mesh workers")
+    s.set_defaults(fn=cmd_trace)

@@ -1,0 +1,349 @@
+"""Unified metrics registry: counters, gauges, and bounded-reservoir
+histograms with one JSON snapshot and one Prometheus-text export.
+
+Before this module the repo had three disconnected telemetry surfaces —
+data/counters.py (ingest), serving/stats.py (per-model serving), and the
+ad-hoc prints of the training loop.  Both counter classes are now
+reimplemented ON TOP of this registry (their public `snapshot()` key
+contracts preserved byte-for-byte — pinned by tests), and the
+distributed round loop records its per-round telemetry through the same
+histogram primitive, so every subsystem's numbers share one metric
+model and one export path.
+
+Design notes:
+
+- Histograms are bounded last-N reservoirs (ring overwrite once full)
+  reporting nearest-rank p50/p95/p99 over the retained window and
+  count/mean/max over EVERYTHING observed — the exact semantics the old
+  serving LatencySeries had, hoisted here so ingest/training reuse them.
+- Metric names are validated against the Prometheus grammar at creation
+  (a bad name raises ValueError at the registration site, not deep in a
+  scrape); labels render as `name{k="v"}`.
+- Each metric carries its own small lock; `snapshot()` is therefore a
+  near-consistent view, not a global atomic one — fine for telemetry,
+  and it keeps hot-path `inc()`/`observe()` contention per-metric.
+"""
+
+from __future__ import annotations
+
+import math
+import re
+import threading
+from typing import Dict, List, Optional, Tuple, Union
+
+from .trace import now_s
+
+__all__ = ["Counter", "Gauge", "Histogram", "MetricsRegistry"]
+
+_NAME_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
+_LABEL_RE = re.compile(r"^[a-zA-Z_][a-zA-Z0-9_]*$")
+
+
+def _check_name(name: str) -> str:
+    if not _NAME_RE.match(name or ""):
+        raise ValueError(f"invalid metric name {name!r}: must match "
+                         f"{_NAME_RE.pattern}")
+    return name
+
+
+def _label_key(labels: Optional[Dict[str, str]]
+               ) -> Tuple[Tuple[str, str], ...]:
+    if not labels:
+        return ()
+    for k in labels:
+        if not _LABEL_RE.match(k):
+            raise ValueError(f"invalid label name {k!r}")
+    return tuple(sorted((k, str(v)) for k, v in labels.items()))
+
+
+def _render(name: str, labels: Tuple[Tuple[str, str], ...]) -> str:
+    if not labels:
+        return name
+    inner = ",".join(f'{k}="{v}"' for k, v in labels)
+    return f"{name}{{{inner}}}"
+
+
+class Counter:
+    """Monotonically increasing value (float; ingest accumulates seconds
+    through these too)."""
+
+    kind = "counter"
+
+    def __init__(self, name: str, labels=(), help: str = "") -> None:
+        self.name, self.labels, self.help = name, labels, help
+        self._lock = threading.Lock()
+        self._value = 0.0
+
+    def inc(self, n: Union[int, float] = 1) -> None:
+        if n < 0:
+            raise ValueError(f"counter {self.name} cannot decrease "
+                             f"(inc {n})")
+        with self._lock:
+            self._value += n
+
+    @property
+    def value(self) -> float:
+        with self._lock:
+            return self._value
+
+    def reset(self) -> None:
+        with self._lock:
+            self._value = 0.0
+
+    def snapshot_value(self):
+        v = self.value
+        return int(v) if float(v).is_integer() else v
+
+
+class Gauge:
+    """Instantaneous value; also tracks the max it has ever held (ring
+    occupancy style readings want both)."""
+
+    kind = "gauge"
+
+    def __init__(self, name: str, labels=(), help: str = "") -> None:
+        self.name, self.labels, self.help = name, labels, help
+        self._lock = threading.Lock()
+        self._value = 0.0
+        self._max = 0.0
+
+    def set(self, v: Union[int, float]) -> None:
+        with self._lock:
+            self._value = float(v)
+            self._max = max(self._max, float(v))
+
+    def inc(self, n: Union[int, float] = 1) -> None:
+        with self._lock:
+            self._value += n
+            self._max = max(self._max, self._value)
+
+    @property
+    def value(self) -> float:
+        with self._lock:
+            return self._value
+
+    @property
+    def max(self) -> float:
+        with self._lock:
+            return self._max
+
+    def reset(self) -> None:
+        with self._lock:
+            self._value = 0.0
+            self._max = 0.0
+
+    def snapshot_value(self):
+        v = self.value
+        return int(v) if float(v).is_integer() else v
+
+
+class Histogram:
+    """Bounded last-N reservoir with nearest-rank percentiles.
+
+    count/sum/mean/max cover ALL observations; percentiles cover the
+    retained window (ring overwrite once `window` is full).  All-zero
+    summary when nothing was observed — the zero-traffic path must
+    report zeros, never KeyError (the IngestCounters / ModelStats
+    contract this generalizes)."""
+
+    kind = "histogram"
+
+    def __init__(self, name: str, labels=(), window: int = 65536,
+                 help: str = "") -> None:
+        if window < 1:
+            raise ValueError(f"histogram window must be >= 1, got {window}")
+        self.name, self.labels, self.help = name, labels, help
+        self.window = int(window)
+        self._lock = threading.Lock()
+        self._samples: List[float] = []
+        self._next = 0          # ring write cursor once the window is full
+        self._count = 0
+        self._sum = 0.0
+        self._max = 0.0
+
+    def observe(self, v: Union[int, float]) -> None:
+        v = float(v)
+        with self._lock:
+            if len(self._samples) < self.window:
+                self._samples.append(v)
+            else:
+                self._samples[self._next] = v
+                self._next = (self._next + 1) % self.window
+            self._count += 1
+            self._sum += v
+            self._max = max(self._max, v)
+
+    # alias so the old LatencySeries call sites read unchanged
+    add = observe
+
+    def time(self) -> "_HistTimer":
+        """Context manager observing elapsed seconds."""
+        return _HistTimer(self)
+
+    @property
+    def count(self) -> int:
+        with self._lock:
+            return self._count
+
+    @property
+    def sum(self) -> float:
+        with self._lock:
+            return self._sum
+
+    @property
+    def max(self) -> float:
+        with self._lock:
+            return self._max
+
+    @property
+    def mean(self) -> float:
+        with self._lock:
+            return self._sum / self._count if self._count else 0.0
+
+    def percentile(self, q: float) -> float:
+        with self._lock:
+            s = sorted(self._samples)
+        if not s:
+            return 0.0
+        return s[min(len(s) - 1, max(0, math.ceil(q * len(s)) - 1))]
+
+    def summary(self, key_suffix: str = "", round_to: int = 4
+                ) -> Dict[str, float]:
+        with self._lock:
+            count, total, mx = self._count, self._sum, self._max
+            s = sorted(self._samples)
+
+        def rank(q: float) -> float:
+            if not s:
+                return 0.0
+            return s[min(len(s) - 1, max(0, math.ceil(q * len(s)) - 1))]
+
+        k = key_suffix
+        if not count:
+            return {"count": 0, f"mean{k}": 0.0, f"max{k}": 0.0,
+                    f"p50{k}": 0.0, f"p95{k}": 0.0, f"p99{k}": 0.0}
+        return {"count": count,
+                f"mean{k}": round(total / count, round_to),
+                f"max{k}": round(mx, round_to),
+                f"p50{k}": round(rank(0.50), round_to),
+                f"p95{k}": round(rank(0.95), round_to),
+                f"p99{k}": round(rank(0.99), round_to)}
+
+    def reset(self) -> None:
+        with self._lock:
+            self._samples = []
+            self._next = 0
+            self._count = 0
+            self._sum = 0.0
+            self._max = 0.0
+
+    def snapshot_value(self):
+        return self.summary()
+
+
+class _HistTimer:
+    __slots__ = ("_h", "_t0", "elapsed_s")
+
+    def __init__(self, h: Histogram) -> None:
+        self._h = h
+        self.elapsed_s = 0.0
+
+    def __enter__(self) -> "_HistTimer":
+        self._t0 = now_s()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.elapsed_s = now_s() - self._t0
+        self._h.observe(self.elapsed_s)
+
+
+class MetricsRegistry:
+    """Name -> metric map with get-or-create semantics and two exports
+    (JSON snapshot, Prometheus text).  Creation order is preserved, so
+    snapshot/export key order is deterministic."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._metrics: Dict[Tuple[str, Tuple], object] = {}
+
+    def _get_or_create(self, cls, name: str, labels, **kw):
+        _check_name(name)
+        key = (name, _label_key(labels))
+        with self._lock:
+            m = self._metrics.get(key)
+            if m is None:
+                m = cls(name, labels=key[1], **kw)
+                self._metrics[key] = m
+            elif not isinstance(m, cls):
+                raise ValueError(
+                    f"metric {name!r} already registered as {m.kind}, "
+                    f"not {cls.kind}")
+            return m
+
+    def counter(self, name: str, labels: Optional[Dict[str, str]] = None,
+                help: str = "") -> Counter:
+        return self._get_or_create(Counter, name, labels, help=help)
+
+    def gauge(self, name: str, labels: Optional[Dict[str, str]] = None,
+              help: str = "") -> Gauge:
+        return self._get_or_create(Gauge, name, labels, help=help)
+
+    def histogram(self, name: str,
+                  labels: Optional[Dict[str, str]] = None,
+                  window: int = 65536, help: str = "") -> Histogram:
+        return self._get_or_create(Histogram, name, labels, window=window,
+                                   help=help)
+
+    def metrics(self) -> List[object]:
+        with self._lock:
+            return list(self._metrics.values())
+
+    def reset(self) -> None:
+        """Zero every registered metric (registrations survive)."""
+        for m in self.metrics():
+            m.reset()
+
+    # ---------------------------------------------------------------- export
+    def snapshot(self) -> Dict[str, object]:
+        """JSON-ready dict: `name` or `name{k="v"}` -> value (counters/
+        gauges) or summary dict (histograms)."""
+        out: Dict[str, object] = {}
+        for m in self.metrics():
+            out[_render(m.name, m.labels)] = m.snapshot_value()
+        return out
+
+    def prometheus_text(self) -> str:
+        """Prometheus exposition text.  Histograms render as summaries
+        (quantile series + _sum/_count), which is what bounded-reservoir
+        percentiles honestly are."""
+        lines: List[str] = []
+        typed: set = set()
+        for m in self.metrics():
+            if m.kind == "histogram":
+                if m.name not in typed:
+                    typed.add(m.name)
+                    if m.help:
+                        lines.append(f"# HELP {m.name} {m.help}")
+                    lines.append(f"# TYPE {m.name} summary")
+                base = dict(m.labels)
+                for q in (0.5, 0.95, 0.99):
+                    lbl = _label_key({**base, "quantile": str(q)})
+                    lines.append(f"{_render(m.name, lbl)} "
+                                 f"{_fmt(m.percentile(q))}")
+                lines.append(f"{_render(m.name + '_sum', m.labels)} "
+                             f"{_fmt(m.sum)}")
+                lines.append(f"{_render(m.name + '_count', m.labels)} "
+                             f"{m.count}")
+            else:
+                if m.name not in typed:
+                    typed.add(m.name)
+                    if m.help:
+                        lines.append(f"# HELP {m.name} {m.help}")
+                    lines.append(f"# TYPE {m.name} {m.kind}")
+                lines.append(f"{_render(m.name, m.labels)} "
+                             f"{_fmt(m.value)}")
+        return "\n".join(lines) + ("\n" if lines else "")
+
+
+def _fmt(v: float) -> str:
+    return repr(int(v)) if float(v).is_integer() else repr(float(v))

@@ -1,0 +1,342 @@
+"""Span tracer with Chrome-trace export — the timing substrate every hot
+path (ingest, the distributed round loop, serving) instruments itself
+through.
+
+The reference gets per-phase visibility from ad-hoc timers scattered
+through the code (reference: benchmark.cpp Timer around forward/backward,
+base_data_layer.cpp prefetch timing); Spark gets it from its event log.
+This module replaces both with ONE process-wide span tracer:
+
+    from sparknet_tpu.obs.trace import span
+
+    with span("ingest.stage_round", round=r) as sp:
+        ...
+        sp.set(ring=occupancy)          # attach attributes mid-span
+
+Enabled by `SPARKNET_TRACE=<path>` (exports on process exit) or
+`trace.enable(path)`.  When DISABLED — the default — `span()` returns a
+shared no-op context manager without reading the clock or allocating,
+so instrumented hot paths pay only a module-global load and an attribute
+check (pinned near-zero by tests/test_obs.py).
+
+Export is the Chrome trace-event JSON format (`{"traceEvents": [...]}`
+with `ph: "X"` complete events, microsecond `ts`/`dur`), loadable in
+Perfetto (https://ui.perfetto.dev) or chrome://tracing; `summary()`
+renders a plain-text top-spans table.  The event store is a bounded ring
+(default 65536 events) — a runaway span producer drops the OLDEST events
+and counts them in `dropped_events`, it never grows without bound.
+
+`now_s` is the shared monotonic-timestamp primitive: hot-path modules
+take timestamps through it (CI greps for raw time.time()/perf_counter()
+calls outside this substrate — tests/test_obs.py allowlist).
+
+`device_annotation()` wraps jitted round/forward fns in
+jax.named_scope / jax.profiler.TraceAnnotation, gated behind
+SPARKNET_JAX_ANNOTATE=1 so it is inert by default: profiler RPCs can
+wedge the axon tunnel (CLAUDE.md), so device-side annotation is strictly
+opt-in.
+"""
+
+from __future__ import annotations
+
+import atexit
+import collections
+import contextlib
+import json
+import os
+import sys
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+__all__ = ["span", "timed_span", "instant", "enable", "disable", "enabled",
+           "tracer", "now_s", "device_annotation", "Tracer",
+           "DEFAULT_CAPACITY"]
+
+# THE shared monotonic timestamp primitive (seconds, arbitrary epoch).
+now_s = time.perf_counter
+
+DEFAULT_CAPACITY = 65536
+
+_PID = os.getpid()
+_global_lock = threading.Lock()
+_tracer: Optional["Tracer"] = None
+
+
+class _NoopSpan:
+    """Shared do-nothing span: what `span()` hands out while tracing is
+    disabled.  No clock read, no allocation per call."""
+
+    __slots__ = ()
+
+    def __enter__(self) -> "_NoopSpan":
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        return False
+
+    def set(self, **attrs) -> "_NoopSpan":
+        return self
+
+
+_NOOP = _NoopSpan()
+
+
+class _Span:
+    """One live span.  `elapsed_s` is always measured on exit (so callers
+    can use the span itself as a stopwatch — see timed_span); the event
+    is recorded only when a tracer is attached."""
+
+    __slots__ = ("_tracer", "name", "attrs", "t0", "elapsed_s")
+
+    def __init__(self, tracer: Optional["Tracer"], name: str,
+                 attrs: Optional[Dict[str, Any]]) -> None:
+        self._tracer = tracer
+        self.name = name
+        self.attrs = attrs
+        self.t0 = 0.0
+        self.elapsed_s = 0.0
+
+    def set(self, **attrs) -> "_Span":
+        """Attach/overwrite attributes mid-span (e.g. a counter value
+        known only once the work completed)."""
+        if self.attrs is None:
+            self.attrs = attrs
+        else:
+            self.attrs.update(attrs)
+        return self
+
+    def __enter__(self) -> "_Span":
+        self.t0 = now_s()
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        self.elapsed_s = now_s() - self.t0
+        t = self._tracer
+        if t is not None:
+            if exc_type is not None:
+                self.set(error=exc_type.__name__)
+            t._record(self.name, self.t0, self.elapsed_s, self.attrs)
+        return False
+
+
+class Tracer:
+    """Thread-safe ring-buffered span store with Chrome-trace export."""
+
+    def __init__(self, path: Optional[str] = None,
+                 capacity: int = DEFAULT_CAPACITY) -> None:
+        if capacity < 1:
+            raise ValueError(f"tracer capacity must be >= 1, got {capacity}")
+        self._lock = threading.Lock()
+        self._events: collections.deque = collections.deque(maxlen=capacity)
+        self._thread_names: Dict[int, str] = {}
+        self.capacity = int(capacity)
+        self.epoch = now_s()
+        self.path = path
+        self.dropped_events = 0
+        self._dirty = False
+
+    # ------------------------------------------------------------- recording
+    def _record(self, name: str, t0: float, dur_s: float,
+                attrs: Optional[Dict[str, Any]]) -> None:
+        tid = threading.get_ident()
+        ev = {"name": name, "ph": "X", "pid": _PID, "tid": tid,
+              "ts": round((t0 - self.epoch) * 1e6, 3),
+              "dur": round(dur_s * 1e6, 3)}
+        if attrs:
+            ev["args"] = {k: _jsonable(v) for k, v in attrs.items()}
+        with self._lock:
+            if len(self._events) == self.capacity:
+                self.dropped_events += 1
+            self._events.append(ev)
+            if tid not in self._thread_names:
+                self._thread_names[tid] = threading.current_thread().name
+            self._dirty = True
+
+    def instant(self, name: str, **attrs) -> None:
+        """A zero-duration marker event (ph: 'i')."""
+        tid = threading.get_ident()
+        ev = {"name": name, "ph": "i", "pid": _PID, "tid": tid, "s": "t",
+              "ts": round((now_s() - self.epoch) * 1e6, 3)}
+        if attrs:
+            ev["args"] = {k: _jsonable(v) for k, v in attrs.items()}
+        with self._lock:
+            if len(self._events) == self.capacity:
+                self.dropped_events += 1
+            self._events.append(ev)
+            if tid not in self._thread_names:
+                self._thread_names[tid] = threading.current_thread().name
+            self._dirty = True
+
+    # --------------------------------------------------------------- reading
+    def events(self) -> List[dict]:
+        with self._lock:
+            return list(self._events)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._events.clear()
+            self.dropped_events = 0
+            self._dirty = False
+
+    # ---------------------------------------------------------------- export
+    def export_chrome_trace(self, path: Optional[str] = None) -> str:
+        """Write the Chrome trace-event JSON (Perfetto / chrome://tracing
+        loadable) and return the path written."""
+        path = path or self.path
+        if not path:
+            raise ValueError("no export path: pass one or enable(path=...)")
+        with self._lock:
+            events = list(self._events)
+            names = dict(self._thread_names)
+            dropped = self.dropped_events
+        meta = [{"name": "process_name", "ph": "M", "pid": _PID,
+                 "args": {"name": "sparknet_tpu"}}]
+        for tid, tname in sorted(names.items()):
+            meta.append({"name": "thread_name", "ph": "M", "pid": _PID,
+                         "tid": tid, "args": {"name": tname}})
+        doc = {"traceEvents": meta + events, "displayTimeUnit": "ms",
+               "otherData": {"dropped_events": dropped,
+                             "capacity": self.capacity}}
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(doc, f)
+        os.replace(tmp, path)
+        with self._lock:
+            self._dirty = False
+        return path
+
+    def summary(self, top: int = 20) -> str:
+        """Plain-text per-span-name aggregate: count, total/mean/max ms,
+        sorted by total time."""
+        agg: Dict[str, List[float]] = {}
+        for ev in self.events():
+            if ev.get("ph") != "X":
+                continue
+            row = agg.setdefault(ev["name"], [0, 0.0, 0.0])
+            row[0] += 1
+            row[1] += ev["dur"]
+            row[2] = max(row[2], ev["dur"])
+        lines = [f"{'span':32s} {'count':>7s} {'total_ms':>10s} "
+                 f"{'mean_ms':>9s} {'max_ms':>9s}"]
+        ranked = sorted(agg.items(), key=lambda kv: -kv[1][1])[:top]
+        for name, (cnt, tot, mx) in ranked:
+            lines.append(f"{name:32s} {cnt:7d} {tot / 1e3:10.3f} "
+                         f"{tot / cnt / 1e3:9.3f} {mx / 1e3:9.3f}")
+        if not agg:
+            lines.append("(no spans recorded)")
+        if self.dropped_events:
+            lines.append(f"[ring full: {self.dropped_events} oldest "
+                         f"events dropped; capacity {self.capacity}]")
+        return "\n".join(lines)
+
+    def write_summary(self, path: str, top: int = 20) -> str:
+        with open(path, "w") as f:
+            f.write(self.summary(top=top) + "\n")
+        return path
+
+
+def _jsonable(v: Any):
+    """Chrome trace args must be JSON; coerce the common non-JSON types
+    (numpy scalars, arbitrary objects) instead of dying mid-span."""
+    if isinstance(v, (str, int, float, bool)) or v is None:
+        return v
+    try:
+        return float(v)
+    except (TypeError, ValueError):
+        return str(v)
+
+
+# ----------------------------------------------------------------- module API
+def enabled() -> bool:
+    return _tracer is not None
+
+
+def tracer() -> Optional[Tracer]:
+    return _tracer
+
+
+def enable(path: Optional[str] = None,
+           capacity: int = DEFAULT_CAPACITY) -> Tracer:
+    """Turn tracing on (idempotent; a new path/capacity replaces the live
+    tracer).  With `path`, the trace + summary are also exported at
+    process exit."""
+    global _tracer
+    with _global_lock:
+        if (_tracer is None or _tracer.capacity != capacity
+                or (path is not None and _tracer.path != path)):
+            _tracer = Tracer(path=path, capacity=capacity)
+        return _tracer
+
+
+def disable() -> None:
+    """Turn tracing off and drop the event store; `span()` returns to the
+    shared no-op."""
+    global _tracer
+    with _global_lock:
+        _tracer = None
+
+
+def span(name: str, **attrs) -> Any:
+    """Context manager recording one complete span.  A true no-op (shared
+    object, no clock read) while tracing is disabled."""
+    t = _tracer
+    if t is None:
+        return _NOOP
+    return _Span(t, name, attrs or None)
+
+
+def timed_span(name: str, **attrs) -> _Span:
+    """Like span(), but ALWAYS measures: `elapsed_s` is set on exit even
+    with tracing disabled — the shared stopwatch primitive for hot paths
+    that feed telemetry (dist.py round records) regardless of tracing."""
+    return _Span(_tracer, name, attrs or None)
+
+
+def instant(name: str, **attrs) -> None:
+    t = _tracer
+    if t is not None:
+        t.instant(name, **attrs)
+
+
+# ----------------------------------------------------- device-side annotation
+def annotations_enabled() -> bool:
+    """Device-side annotation opt-in: profiler RPCs can wedge the axon
+    tunnel, so jax named_scope/TraceAnnotation stay off unless
+    SPARKNET_JAX_ANNOTATE is set to a truthy value."""
+    return os.environ.get("SPARKNET_JAX_ANNOTATE", "") not in ("", "0")
+
+
+def device_annotation(name: str, *, runtime: bool = False):
+    """jax.named_scope (trace-time: labels the XLA ops of a jitted fn) or
+    jax.profiler.TraceAnnotation (runtime=True: brackets a dispatch on
+    the profiler timeline) around round/forward fns.  Inert nullcontext
+    unless SPARKNET_JAX_ANNOTATE=1 — see annotations_enabled()."""
+    if not annotations_enabled():
+        return contextlib.nullcontext()
+    import jax
+
+    if runtime:
+        return jax.profiler.TraceAnnotation(name)
+    return jax.named_scope(name)
+
+
+# ------------------------------------------------------------ env + exit hook
+_env_path = os.environ.get("SPARKNET_TRACE")
+if _env_path:
+    enable(_env_path)
+
+
+@atexit.register
+def _export_at_exit() -> None:
+    t = _tracer
+    if t is None or not t.path or not t._dirty:
+        return
+    try:
+        out = t.export_chrome_trace()
+        t.write_summary(out + ".txt")
+        print(f"sparknet trace: {out} (+ .txt summary) — open in "
+              f"https://ui.perfetto.dev or chrome://tracing",
+              file=sys.stderr)
+    except Exception as e:  # never let telemetry break process exit
+        print(f"sparknet trace export failed: {e!r}", file=sys.stderr)

@@ -20,7 +20,10 @@ broadcast/collect machinery of the reference collapses into one collective.
 
 from __future__ import annotations
 
+import collections
+import json
 import os
+import sys
 from typing import Any, Dict, List, Optional
 
 import jax
@@ -30,6 +33,8 @@ from jax import shard_map
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..data.counters import IngestCounters
+from ..obs.metrics import MetricsRegistry
+from ..obs.trace import device_annotation, span, timed_span
 from ..data.pipeline import (PipelinedIngestExecutor, default_prefetch_depth,
                              default_pull_workers)
 from ..proto.caffe_pb import NetParameter, SolverParameter
@@ -177,6 +182,26 @@ class DistributedSolver:
         # have diverged mid-schedule under dcn_interval > 1
         self._avg_params_fn = jax.jit(
             lambda pw: jax.tree.map(lambda a: jnp.mean(a, axis=0), pw))
+        # ---------------------------------------------- per-round telemetry
+        # One replica's footprint — the unit the τ-interval pmean moves.
+        self._param_bytes = sum(
+            int(np.prod(a.shape)) * a.dtype.itemsize
+            for a in jax.tree.leaves(params0))
+        self._state_bytes = sum(
+            int(np.prod(a.shape)) * a.dtype.itemsize
+            for a in jax.tree.leaves(state0))
+        self._telemetry = MetricsRegistry()
+        self._round_hists = {
+            ph: self._telemetry.histogram(f"dist_round_{ph}_seconds",
+                                          window=4096)
+            for ph in ("broadcast", "dispatch", "collect", "tau_steps",
+                       "stall")}
+        self._round_records: collections.deque = collections.deque(
+            maxlen=4096)
+        self._round_log_path: Optional[str] = (
+            os.environ.get("SPARKNET_ROUND_LOG") or None)
+        self._round_log_file = None
+        self._round_log_warned = False
 
     # ----------------------------------------------------------------- build
     def _round_fn(self, avg_dcn: bool = True):
@@ -214,6 +239,13 @@ class DistributedSolver:
                                                stepper)
 
         def round_shard(params, state, it0, batches, rng):
+            # labels this round's XLA ops when SPARKNET_JAX_ANNOTATE=1;
+            # inert nullcontext otherwise (profiler RPCs can wedge the
+            # axon tunnel)
+            with device_annotation("sparknet.dist_round"):
+                return _round_shard(params, state, it0, batches, rng)
+
+        def _round_shard(params, state, it0, batches, rng):
             # shard_map hands us the leading worker-block of size 1: strip it.
             params = jax.tree.map(lambda a: a[0], params)
             state = jax.tree.map(lambda a: a[0], state)
@@ -410,19 +442,23 @@ class DistributedSolver:
 
         def stage_worker(w: int):
             src = self.train_sources[w]
-            with c.timed("pull", items=self.tau):
-                pulls = [src() for _ in range(self.tau)]
-            with c.timed("stack"):
-                stacked = {k: np.stack([p[k] for p in pulls])
-                           for k in pulls[0]}
-            if not single:
-                return stacked
-            # eager dispatch: this worker's block starts its copy now
-            # (model-parallel rows get the same host block on every device
-            # in the row, matching the replicated trailing axes of _wsh)
-            with c.timed("device_put"):
-                return {k: [jax.device_put(v[None], d) for d in rows[w]]
-                        for k, v in stacked.items()}
+            with span("ingest.stage_worker", worker=w, round=round_idx,
+                      tau=self.tau):
+                with c.timed("pull", items=self.tau):
+                    pulls = [src() for _ in range(self.tau)]
+                with c.timed("stack"):
+                    stacked = {k: np.stack([p[k] for p in pulls])
+                               for k in pulls[0]}
+                if not single:
+                    return stacked
+                # eager dispatch: this worker's block starts its copy now
+                # (model-parallel rows get the same host block on every
+                # device in the row, matching the replicated trailing axes
+                # of _wsh)
+                with c.timed("device_put"):
+                    return {k: [jax.device_put(v[None], d)
+                                for d in rows[w]]
+                            for k, v in stacked.items()}
 
         per_worker = self._map_workers(stage_worker, local)
         if single:
@@ -487,6 +523,95 @@ class DistributedSolver:
     def reset_ingest_stats(self) -> None:
         self._ingest_counters.reset()
 
+    # -------------------------------------------------- per-round telemetry
+    def set_round_log(self, path: Optional[str]) -> None:
+        """Arm (or disarm with None) the per-round JSONL run log: one
+        flushed append per round — the autocommit-able raw-measurement
+        pattern (CLAUDE.md: box reboots wipe untracked files, so the log
+        must be durable line-by-line, never buffered to process exit).
+        Also armed at construction by SPARKNET_ROUND_LOG=<path>."""
+        if self._round_log_file is not None:
+            try:
+                self._round_log_file.close()
+            except OSError:
+                pass
+            self._round_log_file = None
+        self._round_log_path = path or None
+        self._round_log_warned = False
+
+    def _append_round_log(self, rec: Dict[str, Any]) -> None:
+        if self._round_log_path is None:
+            return
+        try:
+            if self._round_log_file is None:
+                self._round_log_file = open(self._round_log_path, "a")
+            self._round_log_file.write(json.dumps(rec) + "\n")
+            self._round_log_file.flush()
+        except OSError as e:
+            # telemetry must never kill training: warn once and disarm
+            if not self._round_log_warned:
+                self._round_log_warned = True
+                print(f"sparknet: round log {self._round_log_path!r} "
+                      f"disabled: {e}", file=sys.stderr)
+            self._round_log_path = None
+            self._round_log_file = None
+
+    def _record_round(self, round_idx: int, iter_start: int, loss: float,
+                      avg_dcn: bool, broadcast_s: float, dispatch_s: float,
+                      collect_s: float, stall_s: float) -> None:
+        h = self._round_hists
+        h["broadcast"].observe(broadcast_s)
+        h["dispatch"].observe(dispatch_s)
+        h["collect"].observe(collect_s)
+        h["tau_steps"].observe(dispatch_s + collect_s)
+        h["stall"].observe(stall_s)
+        # bytes one τ-interval average moves per replica: a ring
+        # all-reduce is 2*(n-1)/n * bytes in and out of each member —
+        # ~2*(n-1)*param_bytes total per pmean (sync mode pmeans
+        # gradients, same footprint; sync_history="average" pmeans the
+        # momentum slots too).  τ rides in the record so bytes/step is
+        # derivable.
+        n = self.n_workers
+        moved = 2 * (n - 1) * self._param_bytes
+        if self.mode == "average" and self.sync_history == "average":
+            moved += 2 * (n - 1) * self._state_bytes
+        rec = {"round": round_idx, "iter_start": iter_start,
+               "tau": self.tau, "workers": n,
+               "loss": round(loss, 6),
+               "lr": round(self.current_lr(), 8),
+               "broadcast_s": round(broadcast_s, 6),
+               "dispatch_s": round(dispatch_s, 6),
+               "collect_s": round(collect_s, 6),
+               "tau_steps_s": round(dispatch_s + collect_s, 6),
+               "stall_s": round(stall_s, 6),
+               "param_bytes": self._param_bytes,
+               "param_bytes_moved": moved,
+               "avg_dcn": bool(avg_dcn)}
+        self._round_records.append(rec)
+        self._append_round_log(rec)
+
+    def round_stats(self) -> Dict[str, Any]:
+        """Per-round training telemetry: phase means over every round run
+        (histograms — bounded memory) plus the raw last-N records.  The
+        phase names map the SparkNet driver loop onto this design's ONE
+        fused program (see DISTACC.md "Per-round telemetry"):
+        broadcast_s = staging wall, tau_steps_s = dispatch + loss fetch,
+        collect_s = the loss VALUE fetch alone."""
+        h = self._round_hists
+        return {"rounds_run": self.round,
+                "rounds_recorded": len(self._round_records),
+                "mean_broadcast_s": round(h["broadcast"].mean, 6),
+                "mean_dispatch_s": round(h["dispatch"].mean, 6),
+                "mean_collect_s": round(h["collect"].mean, 6),
+                "mean_tau_steps_s": round(h["tau_steps"].mean, 6),
+                "mean_stall_s": round(h["stall"].mean, 6),
+                "param_bytes": self._param_bytes,
+                "per_round": list(self._round_records)}
+
+    def reset_round_stats(self) -> None:
+        self._round_records.clear()
+        self._telemetry.reset()
+
     def _close_ingest(self) -> None:
         if self._ingest_exec is not None:
             self._ingest_exec.close()
@@ -523,32 +648,60 @@ class DistributedSolver:
         discarded (a discard would silently offset the streams).  A pull
         failure raises on the run_round that reaches the failed round —
         never a silently offset stream."""
-        veto = prefetch_next is False
-        if veto and self._ingest_exec is not None:
-            self._ingest_exec.stop_staging()
-        if self._prefetch and not veto and self._ingest_exec is None:
-            self._ingest_exec = PipelinedIngestExecutor(
-                self._stage_round, depth=self._prefetch_depth,
-                counters=self._ingest_counters, start_round=self.round)
-        staged = None
-        if self._ingest_exec is not None:
-            staged = self._ingest_exec.get(expected_round=self.round)
-            if staged is None:  # drained after a veto/disarm: retire it
-                self._close_ingest()
-        if staged is None:
-            self._ingest_counters.bump("serial_rounds")
-            staged = self._stage_round(self.round)
-        batches, rngs = staged
-        avg_dcn = (not self.has_dcn
-                   or self.round % self.dcn_interval == self.dcn_interval - 1)
-        # async dispatch: the jitted round returns immediately, so the
-        # float(loss) fetch below is what overlaps the coordinator's
-        # staging of the next rounds
-        self.params_w, self.state_w, loss = self._round_fn(avg_dcn)(
-            self.params_w, self.state_w, jnp.int32(self.iter), batches, rngs)
-        self.iter += self.tau
-        self.round += 1
-        return float(loss)
+        round_idx, iter_start = self.round, self.iter
+        with span("dist.round", round=round_idx, tau=self.tau,
+                  workers=self.n_workers) as rsp:
+            stall0 = self._ingest_counters.seconds("stall")
+            veto = prefetch_next is False
+            if veto and self._ingest_exec is not None:
+                self._ingest_exec.stop_staging()
+            if self._prefetch and not veto and self._ingest_exec is None:
+                self._ingest_exec = PipelinedIngestExecutor(
+                    self._stage_round, depth=self._prefetch_depth,
+                    counters=self._ingest_counters, start_round=self.round)
+            # "broadcast" leg: wall time until this round's sharded batch
+            # arrays exist — pulls/stack/device_put when staging serially,
+            # prefetch-ring stall when the pipelined executor is armed
+            # (the initial weight broadcast itself happened at init;
+            # weights never revisit the driver, SURVEY.md §2.3)
+            with timed_span("dist.stage", round=round_idx) as t_stage:
+                staged = None
+                if self._ingest_exec is not None:
+                    staged = self._ingest_exec.get(expected_round=self.round)
+                    if staged is None:  # drained after veto/disarm: retire
+                        self._close_ingest()
+                if staged is None:
+                    self._ingest_counters.bump("serial_rounds")
+                    staged = self._stage_round(self.round)
+                batches, rngs = staged
+            avg_dcn = (not self.has_dcn
+                       or self.round % self.dcn_interval
+                       == self.dcn_interval - 1)
+            # async dispatch: the jitted round returns immediately, so the
+            # float(loss) fetch below is what overlaps the coordinator's
+            # staging of the next rounds
+            with timed_span("dist.dispatch", round=round_idx) as t_disp:
+                self.params_w, self.state_w, loss = self._round_fn(avg_dcn)(
+                    self.params_w, self.state_w, jnp.int32(self.iter),
+                    batches, rngs)
+            self.iter += self.tau
+            self.round += 1
+            # "collect" leg: the VALUE fetch of the round loss is the only
+            # honest completion sync on the axon tunnel —
+            # block_until_ready() returns before deferred execution
+            # completes (CLAUDE.md / BENCH_NOTES.md round 3)
+            with timed_span("dist.sync", round=round_idx) as t_sync:
+                loss_f = float(loss)
+            self._record_round(round_idx, iter_start, loss_f, avg_dcn,
+                               t_stage.elapsed_s, t_disp.elapsed_s,
+                               t_sync.elapsed_s,
+                               self._ingest_counters.seconds("stall")
+                               - stall0)
+            rsp.set(loss=round(loss_f, 6),
+                    broadcast_s=round(t_stage.elapsed_s, 6),
+                    tau_steps_s=round(t_disp.elapsed_s + t_sync.elapsed_s,
+                                      6))
+            return loss_f
 
     def test(self, num_batches: Optional[int] = None) -> Dict[str, float]:
         """Evaluate the averaged model (reference: CifarApp.scala:101-116).

@@ -20,6 +20,7 @@ from typing import Dict, Optional, Sequence, Tuple
 import numpy as np
 
 from ..classify import load_pretrained, probability_blob
+from ..obs.trace import device_annotation
 from .buckets import bucket_sizes, validate_buckets
 
 
@@ -83,14 +84,20 @@ class ModelRunner:
         aux_blobs = list(net.input_blobs[1:])
 
         def fwd(params, x):
-            feed = {self.input_blob: x}
-            # auxiliary declared inputs ride along zero-filled at their
-            # declared shapes, exactly as Classifier._forward_probs does
-            for b in aux_blobs:
-                shape = net.blob_shapes[b]
-                feed[b] = jnp.zeros(shape, jnp.int32 if len(shape) == 1
-                                    else jnp.float32)
-            return net.forward(params, feed)[self.output_blob]
+            # labels the serving forward's XLA ops when
+            # SPARKNET_JAX_ANNOTATE=1 (inert nullcontext otherwise —
+            # profiler RPCs can wedge the axon tunnel)
+            with device_annotation("sparknet.serve_forward"):
+                feed = {self.input_blob: x}
+                # auxiliary declared inputs ride along zero-filled at
+                # their declared shapes, exactly as
+                # Classifier._forward_probs does
+                for b in aux_blobs:
+                    feed[b] = jnp.zeros(
+                        net.blob_shapes[b],
+                        jnp.int32 if len(net.blob_shapes[b]) == 1
+                        else jnp.float32)
+                return net.forward(params, feed)[self.output_blob]
 
         self._jfwd = jax.jit(fwd)
         self._shapes_seen: set = set()

@@ -33,6 +33,7 @@ from typing import Dict, List, Optional, Sequence
 
 import numpy as np
 
+from ..obs.trace import now_s, span
 from .buckets import pad_to_bucket, pick_bucket
 from .errors import (DeadlineExceeded, ServerClosed, ServerOverloaded,
                      ServingError)
@@ -240,17 +241,20 @@ class InferenceServer:
             raise ServerClosed("server is shutting down")
         if deadline_ms is None:
             deadline_ms = self.config.default_deadline_ms
-        t0 = time.perf_counter()
+        t0 = now_s()
         req = _Request(
             sample=x, future=Future(), t_submit=t0,
             deadline=None if deadline_ms is None
             else t0 + float(deadline_ms) / 1e3)
         lm.stats.bump("submitted")
         try:
-            if wait:
-                lane.queue.put(req, timeout=wait_timeout_s)
-            else:
-                lane.queue.put_nowait(req)
+            with span("serve.submit", model=model) as sp:
+                if wait:
+                    lane.queue.put(req, timeout=wait_timeout_s)
+                else:
+                    lane.queue.put_nowait(req)
+                sp.set(queued=lane.queue.qsize(),
+                       submitted=lm.stats.value("submitted"))
         except _queue.Full:
             lm.stats.bump("rejected_overload")
             raise ServerOverloaded(
@@ -297,19 +301,21 @@ class InferenceServer:
                 continue
             lane.busy = True
             try:
-                first.t_pop = time.perf_counter()
-                batch = [first]
-                window_end = first.t_pop + cfg.max_wait_ms / 1e3
-                while len(batch) < cfg.max_batch:
-                    remaining = window_end - time.perf_counter()
-                    if remaining <= 0 or (lane.stopping and q.empty()):
-                        break
-                    try:
-                        nxt = q.get(timeout=remaining)
-                    except _queue.Empty:
-                        break
-                    nxt.t_pop = time.perf_counter()
-                    batch.append(nxt)
+                with span("serve.assemble", model=name) as sp:
+                    first.t_pop = now_s()
+                    batch = [first]
+                    window_end = first.t_pop + cfg.max_wait_ms / 1e3
+                    while len(batch) < cfg.max_batch:
+                        remaining = window_end - now_s()
+                        if remaining <= 0 or (lane.stopping and q.empty()):
+                            break
+                        try:
+                            nxt = q.get(timeout=remaining)
+                        except _queue.Empty:
+                            break
+                        nxt.t_pop = now_s()
+                        batch.append(nxt)
+                    sp.set(batch=len(batch), queued=q.qsize())
                 self._run_batch(lane, batch)
             finally:
                 lane.busy = False
@@ -317,7 +323,7 @@ class InferenceServer:
     def _run_batch(self, lane: _Lane, batch: List[_Request]) -> None:
         lm = lane.model
         runner, generation = lm.runner, lm.generation
-        now = time.perf_counter()
+        now = now_s()
         live: List[_Request] = []
         for r in batch:
             if r.deadline is not None and now > r.deadline:
@@ -332,31 +338,37 @@ class InferenceServer:
         bucket = pick_bucket(len(live), runner.buckets)
         x = pad_to_bucket(
             np.stack([r.sample for r in live]).astype(np.float32), bucket)
-        t_launch = time.perf_counter()
+        t_launch = now_s()
         try:
-            out = runner.forward_padded(x)
+            with span("serve.device", model=lm.name, bucket=bucket,
+                      live=len(live)):
+                out = runner.forward_padded(x)
         except Exception as e:
             lm.stats.bump("failed", len(live))
             for r in live:
                 r.future.set_exception(
                     ServingError(f"model {lm.name!r} forward failed: {e}"))
             return
-        t_done = time.perf_counter()
+        t_done = now_s()
         device_ms = (t_done - t_launch) * 1e3
         lm.stats.observe_batch(len(live), bucket)
-        for i, r in enumerate(live):
-            total_ms = (t_done - r.t_submit) * 1e3
-            queue_wait_ms = (r.t_pop - r.t_submit) * 1e3
-            assembly_ms = (t_launch - r.t_pop) * 1e3
-            lm.stats.observe_request(queue_wait_ms, assembly_ms,
-                                     device_ms, total_ms)
-            r.future.set_result(Response(
-                probs=out[i], model=lm.name, generation=generation,
-                bucket=bucket, batch_live=len(live),
-                queue_wait_ms=round(queue_wait_ms, 4),
-                assembly_ms=round(assembly_ms, 4),
-                device_ms=round(device_ms, 4),
-                total_ms=round(total_ms, 4)))
+        with span("serve.respond", model=lm.name, bucket=bucket,
+                  live=len(live)) as sp:
+            for i, r in enumerate(live):
+                total_ms = (t_done - r.t_submit) * 1e3
+                queue_wait_ms = (r.t_pop - r.t_submit) * 1e3
+                assembly_ms = (t_launch - r.t_pop) * 1e3
+                lm.stats.observe_request(queue_wait_ms, assembly_ms,
+                                         device_ms, total_ms)
+                r.future.set_result(Response(
+                    probs=out[i], model=lm.name, generation=generation,
+                    bucket=bucket, batch_live=len(live),
+                    queue_wait_ms=round(queue_wait_ms, 4),
+                    assembly_ms=round(assembly_ms, 4),
+                    device_ms=round(device_ms, 4),
+                    total_ms=round(total_ms, 4)))
+            sp.set(completed=lm.stats.value("completed"),
+                   batches=lm.stats.value("batches"))
 
     # -------------------------------------------------------------- observe
     def stats(self) -> Dict[str, object]:

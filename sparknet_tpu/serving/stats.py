@@ -3,60 +3,40 @@ one thread-safe accumulator per model, snapshot()-able into a JSON-ready
 dict that server.stats() exposes and bench.py lands in its one-line
 record.
 
-Latency series keep a bounded ring of samples (last-N window) and report
-nearest-rank percentiles; like IngestCounters after the zero-round fix,
-every documented key exists from birth with a zero value, so a model
-that never served a request still snapshots cleanly.
+Since the obs/ unification this is a facade over a private
+`obs.metrics.MetricsRegistry`: request dispositions are labeled
+`serving_requests{disposition=...}` counters and the four latency legs
+are `serving_latency_ms{leg=...}` bounded-reservoir histograms (the
+`LatencySeries` semantics — count/mean/max over everything, nearest-rank
+percentiles over the retained last-N window — now live in
+obs.metrics.Histogram and are shared with ingest/training telemetry).
+The public `snapshot()` key contract is reconstructed byte-for-byte
+(pinned by tests/test_serving.py), and the same numbers export as
+Prometheus text via `stats.registry`.
 """
 
 from __future__ import annotations
 
-import math
 import threading
-from typing import Dict, List
+from typing import Dict
+
+from ..obs.metrics import Histogram, MetricsRegistry
 
 
-class LatencySeries:
+class LatencySeries(Histogram):
     """Bounded last-N sample window with nearest-rank percentiles.
-    NOT internally locked — the owning ModelStats serializes access."""
+    Back-compat alias: a `_ms`-keyed view over obs.metrics.Histogram
+    (`add()` and the `{count, mean_ms, ..., p99_ms}` summary keys are the
+    original public surface)."""
 
     def __init__(self, cap: int = 65536) -> None:
-        self._cap = int(cap)
-        self._samples: List[float] = []
-        self._next = 0          # ring write cursor once the window is full
-        self._count = 0
-        self._max = 0.0
-        self._sum = 0.0         # over ALL observations, not just the window
+        super().__init__("latency_ms", window=cap)
 
-    def add(self, ms: float) -> None:
-        v = float(ms)
-        if len(self._samples) < self._cap:
-            self._samples.append(v)
-        else:
-            self._samples[self._next] = v
-            self._next = (self._next + 1) % self._cap
-        self._count += 1
-        self._sum += v
-        self._max = max(self._max, v)
-
-    def summary(self) -> Dict[str, float]:
+    def summary(self) -> Dict[str, float]:  # type: ignore[override]
         """count/mean/max over everything observed; percentiles over the
         retained window.  All-zero when nothing was observed — the
         zero-request path must report zeros, never KeyError."""
-        if not self._count:
-            return {"count": 0, "mean_ms": 0.0, "max_ms": 0.0,
-                    "p50_ms": 0.0, "p95_ms": 0.0, "p99_ms": 0.0}
-        s = sorted(self._samples)
-
-        def rank(q: float) -> float:
-            return s[min(len(s) - 1, max(0, math.ceil(q * len(s)) - 1))]
-
-        return {"count": self._count,
-                "mean_ms": round(self._sum / self._count, 4),
-                "max_ms": round(self._max, 4),
-                "p50_ms": round(rank(0.50), 4),
-                "p95_ms": round(rank(0.95), 4),
-                "p99_ms": round(rank(0.99), 4)}
+        return super().summary(key_suffix="_ms")
 
 
 class ModelStats:
@@ -75,48 +55,79 @@ class ModelStats:
 
     def reset(self) -> None:
         with self._lock:
-            self._counts = {"submitted": 0, "completed": 0, "failed": 0,
-                            "batches": 0}
-            for r in self.REJECTS:
-                self._counts[r] = 0
-            self._series = {s: LatencySeries(self._window)
-                            for s in self.SERIES}
-            self._occupancy_sum = 0.0
-            self._bucket_counts: Dict[int, int] = {}
+            self._registry = MetricsRegistry()
+            self._counts = {
+                name: self._registry.counter("serving_requests",
+                                             labels={"disposition": name})
+                for name in ("submitted", "completed", "failed", "batches")
+                + self.REJECTS}
+            self._series = {
+                s: self._registry.histogram("serving_latency_ms",
+                                            labels={"leg": s},
+                                            window=self._window)
+                for s in self.SERIES}
+            self._occupancy_sum = self._registry.counter(
+                "serving_batch_occupancy_sum")
+            self._bucket_counts: Dict[int, object] = {}
+
+    @property
+    def registry(self) -> MetricsRegistry:
+        """The backing metrics registry (for Prometheus-text export)."""
+        with self._lock:
+            return self._registry
 
     def bump(self, name: str, n: int = 1) -> None:
         with self._lock:
             if name not in self._counts:
                 raise ValueError(f"unknown serving counter {name!r}; one "
                                  f"of {sorted(self._counts)}")
-            self._counts[name] += int(n)
+            c = self._counts[name]
+        c.inc(int(n))
+
+    def value(self, name: str) -> int:
+        """Current value of one disposition counter (span attributes
+        carry these at record time)."""
+        with self._lock:
+            if name not in self._counts:
+                raise ValueError(f"unknown serving counter {name!r}; one "
+                                 f"of {sorted(self._counts)}")
+            c = self._counts[name]
+        return int(c.value)
 
     def observe_batch(self, n_live: int, bucket: int) -> None:
         """One dispatched micro-batch: occupancy = live rows / bucket
         rows (padding waste is 1 - occupancy)."""
         with self._lock:
-            self._counts["batches"] += 1
-            self._occupancy_sum += n_live / float(bucket)
-            self._bucket_counts[int(bucket)] = \
-                self._bucket_counts.get(int(bucket), 0) + 1
+            b = self._bucket_counts.get(int(bucket))
+            if b is None:
+                b = self._registry.counter("serving_bucket_dispatches",
+                                           labels={"bucket": str(bucket)})
+                self._bucket_counts[int(bucket)] = b
+            batches = self._counts["batches"]
+        batches.inc(1)
+        self._occupancy_sum.inc(n_live / float(bucket))
+        b.inc(1)
 
     def observe_request(self, queue_wait_ms: float, assembly_ms: float,
                         device_ms: float, total_ms: float) -> None:
         with self._lock:
-            self._counts["completed"] += 1
-            self._series["queue_wait"].add(queue_wait_ms)
-            self._series["assembly"].add(assembly_ms)
-            self._series["device"].add(device_ms)
-            self._series["total"].add(total_ms)
+            completed = self._counts["completed"]
+            series = self._series
+        completed.inc(1)
+        series["queue_wait"].observe(queue_wait_ms)
+        series["assembly"].observe(assembly_ms)
+        series["device"].observe(device_ms)
+        series["total"].observe(total_ms)
 
     def snapshot(self) -> Dict[str, object]:
         with self._lock:
-            out: Dict[str, object] = dict(self._counts)
-            batches = self._counts["batches"]
+            out: Dict[str, object] = {name: int(c.value)
+                                      for name, c in self._counts.items()}
+            batches = out["batches"]
             out["batch_occupancy_mean"] = round(
-                self._occupancy_sum / batches, 4) if batches else 0.0
-            out["bucket_counts"] = {str(k): v for k, v in
+                self._occupancy_sum.value / batches, 4) if batches else 0.0
+            out["bucket_counts"] = {str(k): int(c.value) for k, c in
                                     sorted(self._bucket_counts.items())}
             for s in self.SERIES:
-                out[f"{s}_ms"] = self._series[s].summary()
+                out[f"{s}_ms"] = self._series[s].summary(key_suffix="_ms")
             return out

@@ -7,26 +7,45 @@ run-to-run comparability (SURVEY.md §5.1).
 from __future__ import annotations
 
 import sys
-import time
 from typing import Optional, TextIO
+
+from ..obs.trace import now_s
 
 
 class PhaseLogger:
-    def __init__(self, path: Optional[str] = None, echo: bool = True) -> None:
-        self.start = time.time()
+    """Elapsed-stamped line logger; context manager so the log file is
+    closed on exit OR exception (a bare instance used to leak its handle
+    when the training loop raised).
+
+    echo: also print each line (default to stderr; pass `stream` to
+    redirect — cli.py's train verb echoes to stdout, where its output
+    contract is pinned by tests/test_cli.py)."""
+
+    def __init__(self, path: Optional[str] = None, echo: bool = True,
+                 stream: Optional[TextIO] = None) -> None:
+        self.start = now_s()
         self.echo = echo
+        self.stream = stream
         self._f: Optional[TextIO] = open(path, "a") if path else None
 
     def __call__(self, message: str, i: int = -1) -> None:
-        elapsed = time.time() - self.start
+        elapsed = now_s() - self.start
         prefix = f"iteration {i}: " if i >= 0 else ""
         line = f"{elapsed:.2f}: {prefix}{message}"
         if self._f:
             self._f.write(line + "\n")
             self._f.flush()
         if self.echo:
-            print(line, file=sys.stderr)
+            print(line, file=self.stream if self.stream is not None
+                  else sys.stderr)
+
+    def __enter__(self) -> "PhaseLogger":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
 
     def close(self) -> None:
-        if self._f:
-            self._f.close()
+        f, self._f = self._f, None
+        if f:
+            f.close()

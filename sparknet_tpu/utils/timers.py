@@ -5,10 +5,11 @@ block-synchronizes on exit — the cudaEvent analogue."""
 
 from __future__ import annotations
 
-import time
 from typing import List, Optional
 
 import jax
+
+from ..obs.trace import now_s
 
 
 class CPUTimer:
@@ -17,12 +18,12 @@ class CPUTimer:
         self.millis = 0.0
 
     def start(self) -> "CPUTimer":
-        self._t0 = time.perf_counter()
+        self._t0 = now_s()
         return self
 
     def stop(self) -> float:
         assert self._t0 is not None
-        self.millis = (time.perf_counter() - self._t0) * 1e3
+        self.millis = (now_s() - self._t0) * 1e3
         self._t0 = None
         return self.millis
 
@@ -90,9 +91,9 @@ def fetch_floor(samples: int = 3) -> float:
     float(s)
     ts = []
     for _ in range(samples):
-        t0 = time.perf_counter()
+        t0 = now_s()
         s = tiny(s)
         float(s)
-        ts.append(time.perf_counter() - t0)
+        ts.append(now_s() - t0)
     ts.sort()
     return ts[len(ts) // 2]

@@ -1,0 +1,451 @@
+"""Observability layer tests: span tracer (Chrome-trace export, no-op
+discipline when disabled), the unified metrics registry, the byte-for-byte
+snapshot() back-compat of the rebuilt IngestCounters/ModelStats, per-round
+training telemetry, the `trace` CLI verb, and the static-analysis pin that
+keeps every hot-path timestamp flowing through obs.trace.now_s."""
+
+import json
+import os
+import re
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from sparknet_tpu.obs import metrics as obs_metrics
+from sparknet_tpu.obs import trace as obs_trace
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _tracing_off():
+    """Every test starts and ends with tracing disabled, whatever the
+    environment (SPARKNET_TRACE auto-arms at import)."""
+    obs_trace.disable()
+    yield
+    obs_trace.disable()
+
+
+# --------------------------------------------------------------- span tracer
+
+def test_chrome_trace_export_balanced_nested_spans_under_threads(tmp_path):
+    """N threads each record nested spans; the exported Chrome trace must
+    be loadable JSON whose complete events nest properly per thread
+    (child interval inside parent interval — what Perfetto renders as a
+    stack, and what an unbalanced __exit__ would corrupt)."""
+    t = obs_trace.enable()
+    gate = threading.Barrier(4)  # overlap all workers: thread idents are
+    # only unique among LIVE threads, and distinct tids are the point here
+
+    def work(k):
+        gate.wait()
+        for i in range(20):
+            with obs_trace.span("outer", worker=k, i=i):
+                with obs_trace.span("inner", worker=k) as sp:
+                    sp.set(val=i)
+
+    threads = [threading.Thread(target=work, args=(k,), name=f"w{k}")
+               for k in range(4)]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join()
+
+    out = tmp_path / "trace.json"
+    t.export_chrome_trace(str(out))
+    doc = json.loads(out.read_text())
+    assert doc["displayTimeUnit"] == "ms"
+    evs = [e for e in doc["traceEvents"] if e.get("ph") == "X"]
+    assert len(evs) == 4 * 20 * 2
+    # metadata: process + one thread_name per worker thread
+    meta = [e for e in doc["traceEvents"] if e.get("ph") == "M"]
+    names = {e["args"]["name"] for e in meta}
+    assert "sparknet_tpu" in names and {"w0", "w1", "w2", "w3"} <= names
+    # per-thread nesting balance: intervals either nest or are disjoint
+    eps = 0.01  # µs; ts/dur are rounded to 3 decimals
+    by_tid = {}
+    for e in evs:
+        by_tid.setdefault(e["tid"], []).append(e)
+    assert len(by_tid) == 4
+    for tid, tevs in by_tid.items():
+        tevs.sort(key=lambda e: (e["ts"], -e["dur"]))
+        stack = []  # open interval end-times
+        for e in tevs:
+            while stack and stack[-1] <= e["ts"] + eps:
+                stack.pop()
+            end = e["ts"] + e["dur"]
+            if stack:
+                assert end <= stack[-1] + eps, (tid, e, stack)
+            stack.append(end)
+    # span attrs survive as Chrome args
+    inner = [e for e in evs if e["name"] == "inner"]
+    assert all("val" in e["args"] and "worker" in e["args"] for e in inner)
+
+
+def test_disabled_tracing_is_a_true_noop():
+    """Disabled mode: span() hands out ONE shared object (no per-call
+    allocation), records nothing, and a hot loop through it stays cheap
+    (loose bound — this is a smoke pin, not a benchmark)."""
+    assert not obs_trace.enabled()
+    s1, s2 = obs_trace.span("a", x=1), obs_trace.span("b")
+    assert s1 is s2  # the shared no-op singleton
+    with obs_trace.span("nothing") as sp:
+        sp.set(k=1)
+    obs_trace.instant("also nothing")
+    t0 = time.perf_counter()
+    for _ in range(100_000):
+        with obs_trace.span("hot"):
+            pass
+    dt = time.perf_counter() - t0
+    assert dt < 2.0, f"100k disabled spans took {dt:.2f}s"
+    # nothing leaked into a later-enabled tracer
+    t = obs_trace.enable()
+    assert t.events() == []
+
+
+def test_timed_span_measures_even_when_disabled():
+    assert not obs_trace.enabled()
+    with obs_trace.timed_span("stopwatch") as sp:
+        time.sleep(0.01)
+    assert sp.elapsed_s >= 0.009
+
+
+def test_ring_drops_oldest_and_reports_it(tmp_path):
+    t = obs_trace.Tracer(capacity=10)
+    for i in range(15):
+        t._record(f"s{i}", 0.0, 0.001, None)
+    evs = t.events()
+    assert len(evs) == 10 and evs[0]["name"] == "s5"
+    assert t.dropped_events == 5
+    assert "5 oldest" in t.summary()
+    t.path = str(tmp_path / "t.json")
+    t.export_chrome_trace()
+    doc = json.loads(open(t.path).read())
+    assert doc["otherData"]["dropped_events"] == 5
+
+
+def test_span_records_error_attr_on_exception():
+    t = obs_trace.enable()
+    with pytest.raises(RuntimeError):
+        with obs_trace.span("boom"):
+            raise RuntimeError("x")
+    (ev,) = t.events()
+    assert ev["args"]["error"] == "RuntimeError"
+
+
+def test_device_annotation_inert_by_default(monkeypatch):
+    monkeypatch.delenv("SPARKNET_JAX_ANNOTATE", raising=False)
+    assert not obs_trace.annotations_enabled()
+    import contextlib
+    assert isinstance(obs_trace.device_annotation("x"),
+                      contextlib.nullcontext)
+    monkeypatch.setenv("SPARKNET_JAX_ANNOTATE", "1")
+    assert obs_trace.annotations_enabled()
+    with obs_trace.device_annotation("sparknet.test"):
+        pass  # named_scope outside a trace is a harmless no-op
+
+
+# ---------------------------------------------------------- metrics registry
+
+def test_histogram_nearest_rank_percentiles():
+    h = obs_metrics.Histogram("t_ms", window=1000)
+    for v in range(1, 101):
+        h.observe(float(v))
+    assert h.percentile(0.5) == 50.0
+    assert h.percentile(0.95) == 95.0
+    assert h.percentile(0.99) == 99.0
+    s = h.summary(key_suffix="_ms")
+    assert s["count"] == 100 and s["max_ms"] == 100.0
+    assert s["p50_ms"] == 50.0
+
+
+def test_histogram_bounded_reservoir_keeps_totals():
+    h = obs_metrics.Histogram("t", window=10)
+    for v in range(100):
+        h.observe(float(v))
+    # count/sum/max cover ALL observations; percentiles the last window
+    assert h.count == 100 and h.max == 99.0
+    assert h.percentile(0.0) == 90.0  # oldest retained
+
+
+def test_registry_type_conflict_raises():
+    r = obs_metrics.MetricsRegistry()
+    r.counter("x")
+    with pytest.raises(ValueError, match="x"):
+        r.gauge("x")
+
+
+def test_prometheus_text_well_formed():
+    r = obs_metrics.MetricsRegistry()
+    r.counter("ingest_items", labels={"stage": "pull"}).inc(3)
+    r.gauge("ring_depth").set(2.5)
+    h = r.histogram("req_ms")
+    h.observe(1.0)
+    h.observe(9.0)
+    text = r.prometheus_text()
+    line_re = re.compile(
+        r'^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[a-zA-Z_][a-zA-Z0-9_]*="[^"]*"'
+        r'(,[a-zA-Z_][a-zA-Z0-9_]*="[^"]*")*\})? -?[0-9.eE+-]+$')
+    for line in text.splitlines():
+        if not line or line.startswith("#"):
+            continue
+        assert line_re.match(line), f"malformed exposition line: {line!r}"
+    assert "# TYPE ingest_items counter" in text
+    assert 'ingest_items{stage="pull"} 3' in text
+    assert "# TYPE req_ms summary" in text
+    assert 'req_ms{quantile="0.5"}' in text
+    assert "req_ms_count 2" in text and "req_ms_sum 10" in text
+
+
+def test_metric_name_validation():
+    r = obs_metrics.MetricsRegistry()
+    with pytest.raises(ValueError):
+        r.counter("bad name")
+    with pytest.raises(ValueError):
+        r.counter("ok", labels={"bad key": "v"})
+
+
+# ----------------------------------------- snapshot back-compat (pinned keys)
+
+def test_ingest_counters_snapshot_byte_for_byte_zero_state():
+    from sparknet_tpu.data.counters import IngestCounters
+
+    pinned = ('{"pull_s": 0.0, "stack_s": 0.0, "device_put_s": 0.0, '
+              '"stall_s": 0.0, "pull_items": 0, "rounds_staged": 0, '
+              '"rounds_consumed": 0, "ring_occ_mean": 0.0, '
+              '"ring_occ_max": 0}')
+    assert json.dumps(IngestCounters().snapshot()) == pinned
+
+
+def test_ingest_counters_snapshot_populated_semantics():
+    from sparknet_tpu.data.counters import IngestCounters
+
+    c = IngestCounters()
+    with c.timed("pull", items=32):
+        pass
+    c.bump("rounds_staged")
+    c.bump("rounds_consumed")
+    c.observe_ring(1)
+    c.observe_ring(3)
+    snap = c.snapshot()
+    assert list(snap)[:5] == ["pull_s", "stack_s", "device_put_s",
+                              "stall_s", "pull_items"]
+    assert snap["pull_items"] == 32 and isinstance(snap["pull_items"], int)
+    assert snap["rounds_staged"] == 1 and snap["rounds_consumed"] == 1
+    assert snap["ring_occ_mean"] == 2.0 and snap["ring_occ_max"] == 3
+    # snapshot rounds stage seconds to 5 places; seconds() is the raw sum
+    assert c.seconds("pull") == pytest.approx(snap["pull_s"], abs=1e-5)
+    with pytest.raises(ValueError):
+        c.seconds("bogus")
+    c.reset()
+    assert c.snapshot()["pull_items"] == 0
+
+
+def test_model_stats_snapshot_byte_for_byte_zero_state():
+    from sparknet_tpu.serving.stats import ModelStats
+
+    zero_ms = ('{"count": 0, "mean_ms": 0.0, "max_ms": 0.0, '
+               '"p50_ms": 0.0, "p95_ms": 0.0, "p99_ms": 0.0}')
+    pinned = ('{"submitted": 0, "completed": 0, "failed": 0, '
+              '"batches": 0, "rejected_overload": 0, '
+              '"rejected_deadline": 0, "rejected_closed": 0, '
+              '"batch_occupancy_mean": 0.0, "bucket_counts": {}, '
+              f'"queue_wait_ms": {zero_ms}, "assembly_ms": {zero_ms}, '
+              f'"device_ms": {zero_ms}, "total_ms": {zero_ms}}}')
+    assert json.dumps(ModelStats().snapshot()) == pinned
+
+
+def test_model_stats_snapshot_populated_semantics():
+    from sparknet_tpu.serving.stats import ModelStats
+
+    s = ModelStats()
+    s.bump("submitted", 4)
+    s.observe_batch(3, bucket=4)  # also bumps "batches"
+    s.observe_request(1.0, 1.0, 1.0, 5.0)  # also bumps "completed"
+    s.observe_request(1.0, 1.0, 1.0, 7.0)
+    s.bump("completed")
+    snap = s.snapshot()
+    assert snap["submitted"] == 4 and snap["completed"] == 3
+    assert snap["batches"] == 1
+    assert snap["batch_occupancy_mean"] == 0.75
+    assert snap["bucket_counts"] == {"4": 1}
+    assert snap["total_ms"]["count"] == 2
+    assert snap["total_ms"]["max_ms"] == 7.0
+    assert s.value("submitted") == 4
+    with pytest.raises(ValueError):
+        s.bump("nonsense")
+
+
+# ------------------------------------------------------- per-round telemetry
+
+def _toy_solver(workers):
+    from sparknet_tpu.core import layers_dsl as dsl
+    from sparknet_tpu.parallel.dist import DistributedSolver
+    from sparknet_tpu.proto import caffe_pb
+    from sparknet_tpu.proto.textformat import parse
+
+    net = dsl.net_param(
+        "obs_toy",
+        dsl.memory_data_layer("data", ["data", "label"], batch=16,
+                              channels=1, height=4, width=4),
+        dsl.inner_product_layer("ip1", "data", num_output=8),
+        dsl.relu_layer("relu1", "ip1"),
+        dsl.inner_product_layer("ip2", "ip1", num_output=2),
+        dsl.softmax_with_loss_layer("loss", ["ip2", "label"]),
+    )
+    sp = caffe_pb.SolverParameter(parse(
+        "base_lr: 0.05 lr_policy: 'fixed' momentum: 0.9 random_seed: 7"))
+    solver = DistributedSolver(sp, net_param=net, n_workers=workers, tau=2)
+
+    def stream(seed):
+        rng = np.random.RandomState(seed)
+
+        def src():
+            x = rng.randn(16, 1, 4, 4).astype(np.float32)
+            return {"data": x,
+                    "label": (x.mean(axis=(1, 2, 3)) > 0).astype(np.int32)}
+        return src
+
+    solver.set_train_data([stream(w) for w in range(workers)])
+    return solver
+
+
+def test_round_stats_and_jsonl_round_log(tmp_path):
+    solver = _toy_solver(workers=2)
+    log_path = tmp_path / "rounds.jsonl"
+    solver.set_round_log(str(log_path))
+    for _ in range(3):
+        loss = solver.run_round()
+    assert np.isfinite(loss)
+
+    rs = solver.round_stats()
+    assert rs["rounds_run"] == 3 and rs["rounds_recorded"] == 3
+    for k in ("mean_broadcast_s", "mean_dispatch_s", "mean_collect_s",
+              "mean_tau_steps_s", "mean_stall_s"):
+        assert rs[k] >= 0.0, k
+    assert rs["param_bytes"] > 0
+    assert len(rs["per_round"]) == 3
+
+    rec = rs["per_round"][0]
+    for k in ("round", "iter_start", "tau", "workers", "loss", "lr",
+              "broadcast_s", "dispatch_s", "collect_s", "tau_steps_s",
+              "stall_s", "param_bytes", "param_bytes_moved", "avg_dcn"):
+        assert k in rec, k
+    assert rec["round"] == 0 and rec["workers"] == 2 and rec["tau"] == 2
+    # τ-averaging moves each param tensor out and back across n-1 peers
+    assert rec["param_bytes_moved"] == 2 * (2 - 1) * rec["param_bytes"]
+    # each phase is rounded to µs independently before the record is cut
+    assert rec["tau_steps_s"] == pytest.approx(
+        rec["dispatch_s"] + rec["collect_s"], abs=2e-6)
+
+    # the JSONL log: one flushed line per round, parseable, same records
+    lines = log_path.read_text().splitlines()
+    assert len(lines) == 3
+    logged = [json.loads(ln) for ln in lines]
+    assert [r["round"] for r in logged] == [0, 1, 2]
+    assert logged[0]["loss"] == rec["loss"]
+
+    solver.reset_round_stats()
+    assert solver.round_stats()["rounds_recorded"] == 0
+
+
+def test_round_log_env_arming(tmp_path, monkeypatch):
+    monkeypatch.setenv("SPARKNET_ROUND_LOG", str(tmp_path / "env.jsonl"))
+    solver = _toy_solver(workers=1)
+    solver.run_round()
+    lines = (tmp_path / "env.jsonl").read_text().splitlines()
+    assert len(lines) == 1 and json.loads(lines[0])["round"] == 0
+
+
+# ------------------------------------------------------------ trace CLI verb
+
+def test_trace_cli_time_workload_end_to_end(tmp_path, capsys):
+    from sparknet_tpu import cli
+
+    out = tmp_path / "t.json"
+    rc = cli.main(["trace", "--workload", "time", "--out", str(out)])
+    assert rc == 0
+    doc = json.loads(out.read_text())
+    names = {e["name"] for e in doc["traceEvents"] if e.get("ph") == "X"}
+    assert "trace.time" in names and "time.step" in names
+    txt = (tmp_path / "t.json.txt").read_text()
+    assert "time.step" in txt and "total_ms" in txt
+    assert "time.step" in capsys.readouterr().out
+    obs_trace.disable()  # the verb arms the module tracer; drop it
+
+    # scripts/trace_summary.py renders the same table from the file
+    import subprocess
+    import sys
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "trace_summary.py"),
+         str(out), "--top", "5"], capture_output=True, text=True)
+    assert r.returncode == 0 and "time.step" in r.stdout
+
+
+# ----------------------------------------------------------- PhaseLogger CM
+
+def test_phase_logger_context_manager(tmp_path, capsys):
+    from sparknet_tpu.utils.logging import PhaseLogger
+
+    p = tmp_path / "log.txt"
+    with PhaseLogger(str(p), stream=__import__("sys").stdout) as log:
+        log("starting", i=3)
+        log("plain")
+    text = p.read_text()
+    assert re.search(r"^\d+\.\d\d: iteration 3: starting$", text, re.M)
+    assert re.search(r"^\d+\.\d\d: plain$", text, re.M)
+    assert "iteration 3: starting" in capsys.readouterr().out
+    assert log._f is None  # closed by __exit__
+    log.close()  # idempotent
+
+
+# -------------------------------------------------- static analysis: clocks
+
+# every module allowed to touch the raw clock, with why:
+_CLOCK_ALLOWLIST = {
+    "obs/trace.py",           # defines now_s — THE timestamp primitive
+    "apps/cifar_app.py",      # wall-clock log FILENAME (reference parity)
+    "apps/imagenet_app.py",   # wall-clock log FILENAME (reference parity)
+}
+
+
+def test_no_raw_clock_calls_outside_allowlist():
+    """Hot-path timestamps must flow through obs.trace.now_s so tracing,
+    telemetry, and timers share one clock; a raw time.time()/
+    perf_counter() call elsewhere is a drift bug waiting to happen."""
+    pat = re.compile(r"time\.(time|perf_counter)\s*\(")
+    pkg = os.path.join(REPO, "sparknet_tpu")
+    offenders = []
+    for dirpath, _dirs, files in os.walk(pkg):
+        for fn in files:
+            if not fn.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fn)
+            rel = os.path.relpath(path, pkg).replace(os.sep, "/")
+            if rel in _CLOCK_ALLOWLIST:
+                continue
+            src = open(path).read()
+            for m in pat.finditer(src):
+                line = src.count("\n", 0, m.start()) + 1
+                offenders.append(f"{rel}:{line}")
+    assert not offenders, (
+        f"raw clock calls outside allowlist (use obs.trace.now_s): "
+        f"{offenders}")
+
+
+# ------------------------------------------------------------ bench stamping
+
+def test_bench_stamp_provenance():
+    import bench
+
+    payload = {"metric": "x", "value": 1.0}
+    out = bench._stamp(payload)
+    assert out["schema_version"] == bench.BENCH_SCHEMA_VERSION == 2
+    assert "git_sha" in out and "env" in out
+    assert all(k.startswith("SPARKNET_") for k in out["env"])
+    assert out["value"] == 1.0
+    assert "schema_version" not in payload  # input not mutated
+    assert {"cifar_e2e_round_telemetry", "imagenet_native_round_telemetry",
+            "schema_version", "git_sha", "env"} <= bench._KNOWN_FIELDS
