@@ -303,7 +303,8 @@ def bench_inference(name, model_dir, batch, fuse_1x1=False):
 
 def bench_serving(model: str = "lenet", offered_qps: float = 200.0,
                   n_requests: int = 400, max_batch: int = 8,
-                  max_wait_ms: float = 4.0, seed: int = 0) -> dict:
+                  max_wait_ms: float = 4.0, seed: int = 0,
+                  quant: str = None) -> dict:
     """Online-serving latency + throughput at a fixed offered load: the
     serving engine (sparknet_tpu/serving/) fronting LeNet on the CPU
     backend, driven open-loop with Poisson arrivals — p50/p99 response
@@ -313,7 +314,13 @@ def bench_serving(model: str = "lenet", offered_qps: float = 200.0,
     driver runs whether or not the axon tunnel has a window open, and
     the tunnel's 65-100 ms fetch RTT would swamp millisecond-scale
     online latencies anyway (BENCH_NOTES.md) — model-level TPU serving
-    throughput is already covered by the bench_inference legs."""
+    throughput is already covered by the bench_inference legs.
+
+    `quant` (serving/quant.py: "bf16"/"int8") reruns the same protocol
+    through the quantized forward; its fields land under a
+    serving_<quant>_ prefix plus the calibration top-1 agreement and the
+    packed param bytes, so the driver record shows the quantized path's
+    latency AND its fidelity side by side with fp32."""
     import jax
 
     from sparknet_tpu.serving import (InferenceServer, ServerConfig,
@@ -327,7 +334,7 @@ def bench_serving(model: str = "lenet", offered_qps: float = 200.0,
                                           max_wait_ms=max_wait_ms,
                                           queue_depth=16 * max_batch))
     try:
-        lm = server.load(model, device=cpu)
+        lm = server.load(model, device=cpu, quant=quant)
         shape = lm.runner.sample_shape
         rng = np.random.RandomState(seed)
         pool = rng.rand(32, *shape).astype(np.float32)
@@ -351,14 +358,18 @@ def bench_serving(model: str = "lenet", offered_qps: float = 200.0,
         st = server.stats()["models"][model]
     finally:
         server.close(drain=True)
-    out = {"serving_model": model,
-           "serving_offered_qps": round(offered_qps, 1),
-           "serving_qps": round(st["completed"] / elapsed, 1),
-           "serving_p50_ms": st["total_ms"]["p50_ms"],
-           "serving_p99_ms": st["total_ms"]["p99_ms"],
-           "serving_batch_occupancy": st["batch_occupancy_mean"],
-           "serving_rejected": rejected,
-           "serving_compiles": st["engine_compiles"]}
+    pfx = "serving" if quant in (None, "fp32") else f"serving_{quant}"
+    out = {f"{pfx}_model": model,
+           f"{pfx}_offered_qps": round(offered_qps, 1),
+           f"{pfx}_qps": round(st["completed"] / elapsed, 1),
+           f"{pfx}_p50_ms": st["total_ms"]["p50_ms"],
+           f"{pfx}_p99_ms": st["total_ms"]["p99_ms"],
+           f"{pfx}_batch_occupancy": st["batch_occupancy_mean"],
+           f"{pfx}_rejected": rejected,
+           f"{pfx}_compiles": st["engine_compiles"]}
+    if pfx != "serving":
+        out[f"{pfx}_agreement"] = lm.runner.quant_agreement
+        out[f"{pfx}_param_bytes"] = lm.runner.param_bytes
     log(json.dumps(out))
     return out
 
@@ -619,6 +630,11 @@ _KNOWN_FIELDS = {
     "serving_model", "serving_offered_qps", "serving_qps",
     "serving_p50_ms", "serving_p99_ms", "serving_batch_occupancy",
     "serving_rejected", "serving_compiles",
+    "serving_int8_model", "serving_int8_offered_qps", "serving_int8_qps",
+    "serving_int8_p50_ms", "serving_int8_p99_ms",
+    "serving_int8_batch_occupancy", "serving_int8_rejected",
+    "serving_int8_compiles", "serving_int8_agreement",
+    "serving_int8_param_bytes",
 }
 
 # every leg name main() lands; leg_utc stamps outside this set (renamed
@@ -627,7 +643,7 @@ _KNOWN_FIELDS = {
 _KNOWN_LEGS = {
     "alexnet_train", "googlenet_train_b64", "googlenet_train_b128",
     "alexnet_infer", "googlenet_infer", "longctx_lm", "cifar_e2e",
-    "imagenet_native", "serving",
+    "imagenet_native", "serving", "serving_int8",
 }
 
 
@@ -712,20 +728,23 @@ def _stale_record(reason: str) -> dict:
 
 BENCH_SCHEMA_VERSION = 2
 
-# git SHA memo, resolved lazily on the NORMAL emit path only: the signal
-# bail handler must never reach a subprocess call, so it writes its
-# fallback line directly and stays unstamped by design
+# git SHA memo.  main() primes it up front (subprocess, once), so the
+# signal bail handler — which must never reach a subprocess call — can
+# stamp its fallback line from the memo alone (resolve=False below):
+# a stale bail record carries the same provenance as a fresh one.
 _git_sha_memo: list = []
 
 
-def _stamp(payload: dict) -> dict:
+def _stamp(payload: dict, resolve: bool = True) -> dict:
     """Provenance stamp applied at emit time: schema_version, the repo's
     short git SHA, and every active SPARKNET_* env knob, so a record line
     can be tied to the exact build + configuration that produced it.
     Stamps are NOT persisted by _persist_leg — a stale replay carries the
     replaying process's provenance, which is the honest reading (the env
-    shown is the one that decided to replay)."""
-    if not _git_sha_memo:
+    shown is the one that decided to replay).  `resolve=False` (the
+    signal-handler path) never spawns the git subprocess: it reads the
+    memo if primed and stamps git_sha null otherwise."""
+    if not _git_sha_memo and resolve:
         sha = None
         try:
             import subprocess
@@ -740,7 +759,7 @@ def _stamp(payload: dict) -> dict:
         _git_sha_memo.append(sha)
     out = dict(payload)
     out["schema_version"] = BENCH_SCHEMA_VERSION
-    out["git_sha"] = _git_sha_memo[0]
+    out["git_sha"] = _git_sha_memo[0] if _git_sha_memo else None
     out["env"] = {k: os.environ[k] for k in sorted(os.environ)
                   if k.startswith("SPARKNET_")}
     return out
@@ -802,12 +821,17 @@ def _install_bail_handler() -> None:
         if not _json_line_emitted:
             _json_line_emitted = True
             try:
-                line = json.dumps(_stale_record(
-                    f"killed_by_signal_{signum}")) + "\n"
+                # resolve=False: the memo main() primed, never a
+                # subprocess — the stale bail line still carries
+                # schema_version/git_sha/env like every other emit
+                line = json.dumps(_stamp(_stale_record(
+                    f"killed_by_signal_{signum}"), resolve=False)) + "\n"
             except Exception:
                 line = ('{"metric": "alexnet_train_imgs_per_sec", '
                         '"value": null, "unit": "img/s", '
                         '"vs_baseline": null, '
+                        f'"schema_version": {BENCH_SCHEMA_VERSION}, '
+                        '"git_sha": null, '
                         '"stale_due_to_unreachable_tpu": true, '
                         f'"stale_reason": "killed_by_signal_{signum}"}}\n')
             os.write(1, line.encode())
@@ -850,6 +874,8 @@ def main() -> None:
                                                   maybe_enable_compile_cache)
 
     _install_bail_handler()
+    _stamp({})  # prime the git-SHA memo while no signal is in flight,
+    # so a later bail() stamps the real SHA without a subprocess
     apply_platform_env()
     maybe_enable_compile_cache()
 
@@ -978,6 +1004,19 @@ def _run_legs(land) -> None:
             "serving_p50_ms", "serving_p99_ms",
             "serving_batch_occupancy", "serving_rejected",
             "serving_compiles")})
+    # quantized serving leg (int8 w8a16, serving/quant.py): same offered
+    # load through the packed-weight forward, plus the calibration top-1
+    # agreement — latency AND fidelity ride the record together
+    try:
+        serving_q = bench_serving(quant="int8")
+    except Exception as e:
+        log(f"serving_int8 leg failed, omitting its fields: {e!r}")
+    else:
+        land("serving_int8", {k: serving_q[k] for k in (
+            "serving_int8_qps", "serving_int8_p50_ms",
+            "serving_int8_p99_ms", "serving_int8_batch_occupancy",
+            "serving_int8_rejected", "serving_int8_compiles",
+            "serving_int8_agreement", "serving_int8_param_bytes")})
     try:
         imgnet_native = bench_imagenet_native()
     except Exception as e:

@@ -1,0 +1,187 @@
+"""Interleaved A/B deltas for PR 7's two performance paths:
+
+  leg fused : AlexNet fwd+bwd step time with SPARKNET_FUSED_BLOCKS
+              off vs xla (vs pallas where the backend supports it) —
+              the fused tower block (ops/fused_block.py).
+  leg quant : serving forward throughput fp32 vs bf16 vs int8 (w8a16)
+              through ModelRunner.forward_padded (serving/quant.py),
+              plus calibration agreement and packed param bytes.
+
+prefetch_delta.py pattern: variants run interleaved A/B/A/B to
+decorrelate drift (this box swings ~8% through the tunnel), medians +
+delta_pct printed per pair, one JSON line per event.  Loss probes are
+non-linear (sum(prob**2)) so XLA cannot fold the chain; sync is a VALUE
+fetch, never bare block_until_ready (BENCH_NOTES.md measurement
+discipline).
+
+On CPU the fused-pallas variant is skipped by default (interpret mode
+is an emulator, its timing is meaningless) — the xla variant is the
+same fused graph shape, so it carries the CPU A/B.
+
+Run: python scripts/fused_quant_delta.py [--runs 3] [--steps 4]
+         [--batch 4] [--crop 67] [--legs fused,quant] [--pallas]
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _median(xs):
+    import numpy as np
+    return float(np.median(xs))
+
+
+def bench_fused(runs, steps, batch, crop, with_pallas):
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from sparknet_tpu.core.net import Net
+    from sparknet_tpu.models import get_model
+
+    def build(mode):
+        if mode is None:
+            os.environ.pop("SPARKNET_FUSED_BLOCKS", None)
+        else:
+            os.environ["SPARKNET_FUSED_BLOCKS"] = mode
+        try:
+            net = Net(get_model("alexnet", batch=batch, n_classes=10,
+                                crop=crop, deploy=True), "TEST")
+        finally:
+            os.environ.pop("SPARKNET_FUSED_BLOCKS", None)
+        params = net.init_params(seed=0)
+
+        def loss(p, x):
+            blobs = net.forward(p, {"data": x})
+            return jnp.sum(jnp.square(blobs["prob"]))
+
+        step = jax.jit(jax.value_and_grad(loss))
+        return net, params, step
+
+    variants = [("off", None), ("xla", "xla")]
+    if with_pallas:
+        variants.append(("pallas", "pallas"))
+    built = {name: build(mode) for name, mode in variants}
+    for name, (net, _p, _s) in built.items():
+        print(json.dumps(dict(leg="fused", variant=name,
+                              fused_blocks=net.fused_blocks)), flush=True)
+
+    rng = np.random.RandomState(0)
+    x0 = rng.rand(batch, 3, crop, crop).astype(np.float32)
+
+    def timed(name):
+        _net, params, step = built[name]
+        # salt the input each step: a real data dependency between
+        # dispatches, and a VALUE fetch syncs the chain
+        t0 = time.perf_counter()
+        v = None
+        for i in range(steps):
+            v, _g = step(params, jnp.asarray(x0 + np.float32(1e-6 * i)))
+        float(v)
+        return (time.perf_counter() - t0) / steps
+
+    for name in built:  # one warm compile per variant before timing
+        timed(name)
+
+    series = {name: [] for name in built}
+    for r in range(runs):
+        row = dict(leg="fused", run=r)
+        for name in built:  # interleaved: every variant inside each run
+            dt = timed(name)
+            series[name].append(dt)
+            row[f"{name}_step_ms"] = round(1e3 * dt, 2)
+        print(json.dumps(row), flush=True)
+    med = {name: _median(v) for name, v in series.items()}
+    out = dict(event="summary", leg="fused", runs=runs, steps=steps,
+               batch=batch, crop=crop,
+               **{f"median_{n}_step_ms": round(1e3 * m, 2)
+                  for n, m in med.items()})
+    for name in med:
+        if name != "off":
+            out[f"delta_pct_{name}_vs_off"] = round(
+                100 * (med["off"] / med[name] - 1), 1)
+    print(json.dumps(out), flush=True)
+
+
+def bench_quant(runs, steps, max_batch=8):
+    import numpy as np
+
+    from sparknet_tpu.serving.engine import ModelRunner, resolve_net_param
+
+    runners = {}
+    for mode in ("fp32", "bf16", "int8"):
+        r = ModelRunner(resolve_net_param("lenet", max_batch=max_batch),
+                        max_batch=max_batch, seed=0, quant=mode)
+        r.warmup()
+        runners[mode] = r
+        print(json.dumps(dict(
+            leg="quant", variant=mode, param_bytes=r.param_bytes,
+            agreement=r.quant_agreement)), flush=True)
+
+    rng = np.random.RandomState(0)
+    x0 = rng.rand(max_batch, *runners["fp32"].sample_shape
+                  ).astype(np.float32)
+
+    def timed(mode):
+        r = runners[mode]
+        t0 = time.perf_counter()
+        out = None
+        for i in range(steps):
+            out = r.forward_padded(x0 + np.float32(1e-6 * i))
+        float(out[0, 0])  # value fetch
+        return max_batch * steps / (time.perf_counter() - t0)
+
+    for mode in runners:
+        timed(mode)  # warm
+
+    series = {m: [] for m in runners}
+    for r in range(runs):
+        row = dict(leg="quant", run=r)
+        for mode in runners:
+            v = timed(mode)
+            series[mode].append(v)
+            row[f"{mode}_imgs_per_sec"] = round(v, 1)
+        print(json.dumps(row), flush=True)
+    med = {m: _median(v) for m, v in series.items()}
+    out = dict(event="summary", leg="quant", runs=runs, steps=steps,
+               max_batch=max_batch,
+               **{f"median_{m}_imgs_per_sec": round(v, 1)
+                  for m, v in med.items()})
+    for mode in med:
+        if mode != "fp32":
+            out[f"delta_pct_{mode}_vs_fp32"] = round(
+                100 * (med[mode] / med["fp32"] - 1), 1)
+    print(json.dumps(out), flush=True)
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--runs", type=int, default=3)
+    p.add_argument("--steps", type=int, default=4)
+    p.add_argument("--batch", type=int, default=4)
+    p.add_argument("--crop", type=int, default=67)
+    p.add_argument("--legs", default="fused,quant")
+    p.add_argument("--pallas", action="store_true",
+                   help="also time the pallas fused variant (TPU only; "
+                        "interpret-mode CPU timing is meaningless)")
+    a = p.parse_args()
+
+    from sparknet_tpu.utils.compile_cache import (apply_platform_env,
+                                                  maybe_enable_compile_cache)
+    apply_platform_env()
+    maybe_enable_compile_cache()
+
+    legs = set(a.legs.split(","))
+    if "fused" in legs:
+        bench_fused(a.runs, a.steps, a.batch, a.crop, a.pallas)
+    if "quant" in legs:
+        bench_quant(a.runs, max(a.steps * 8, 32))
+
+
+if __name__ == "__main__":
+    main()

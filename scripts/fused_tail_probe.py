@@ -1,0 +1,99 @@
+"""Probe: fused Pallas relu->LRN->maxpool tail vs the composed XLA tail.
+
+The tower-stage tail is memory-bound (three elementwise/window passes
+over the same (B,C,H,W) activation); the fused kernel
+(ops/fused_block.py) makes one VMEM pass and recomputes in the backward
+instead of saving residuals.  This probe times fwd+bwd of JUST the tail
+on the AlexNet norm1/norm2 geometries (the stages the net-level matcher
+fuses), via the shared amortized-window loop (probe_util) — one long
+salted scan dispatch per measurement, value-fetch synced, fetch floor
+subtracted.
+
+On a TPU window the pallas leg compiles through Mosaic; on CPU it only
+runs in interpret mode (pure-python pallas emulation, not a perf
+number), so the CPU default compares composed-XLA against the fused
+path's XLA fallback and `--interpret` opts into the (slow) emulated
+kernel for correctness spot-checks only.
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+# batch, C, H, W geometry of the tensor ENTERING the tail (conv output),
+# plus the AlexNet LRN/pool hyperparameters shared by both stages
+SHAPES = [
+    ("alex_norm1_tail", 32, 96, 55, 55),
+    ("alex_norm2_tail", 32, 256, 27, 27),
+]
+LRN = dict(local_size=5, alpha=1e-4, beta=0.75, k=1.0)
+POOL = dict(pool_kernel=(3, 3), pool_stride=(2, 2), pool_pad=(0, 0))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--interpret", action="store_true",
+                    help="force the pallas kernel in interpret mode "
+                         "(CPU correctness emulation — NOT a perf path)")
+    ap.add_argument("--batch", type=int, default=32)
+    args = ap.parse_args()
+
+    from probe_util import fetch_floor_s, grad_chain_time_s
+    from sparknet_tpu.ops import fused_block as fb
+
+    on_tpu = jax.devices()[0].platform == "tpu"
+    print("device:", jax.devices()[0])
+    floor = fetch_floor_s()
+    print(f"fetch floor: {floor*1e3:.1f} ms (subtracted per window)")
+    if not on_tpu and not args.interpret:
+        print("CPU backend: 'fused' leg is the XLA fallback "
+              "(pass --interpret for the emulated pallas kernel)")
+
+    for name, b, c, h, w in SHAPES:
+        b = args.batch if args.batch else b
+        rng = np.random.RandomState(0)
+        x = jnp.asarray(rng.rand(b, c, h, w).astype(np.float32))
+        # bytes touched by the composed tail: read+write relu, read+write
+        # lrn, read pool input + write pool output (f32) — the traffic
+        # the fusion removes; a per-step time below bytes/peak-HBM-BW
+        # means elision, re-check the loss
+        g = fb._pool_geometry(h, w, POOL["pool_kernel"],
+                              POOL["pool_stride"], POOL["pool_pad"])
+        bytes_touched = 4 * b * c * (4 * h * w + h * w + g.oh * g.ow)
+
+        def loss_composed(x_):
+            y = fb._tail_xla(x_, relu_slope=0.0, **LRN, **POOL)
+            return jnp.sum(jnp.square(y))
+
+        def loss_fused(x_):
+            y = fb.fused_tail_pallas(
+                x_, LRN["local_size"], LRN["alpha"], LRN["beta"],
+                LRN["k"], 0.0, POOL["pool_kernel"], POOL["pool_stride"],
+                POOL["pool_pad"], bool(args.interpret))
+            return jnp.sum(jnp.square(y))
+
+        base = 5 if args.interpret else 100  # interpret mode is ~1000x
+        t_c = grad_chain_time_s(loss_composed, x, floor, base_iters=base)
+        use_fused = (on_tpu and fb.fused_tail_supported(x)) \
+            or args.interpret
+        t_f = grad_chain_time_s(loss_fused if use_fused
+                                else loss_composed, x, floor,
+                                base_iters=base)
+        gbps = bytes_touched / t_c / 1e9
+        print(f"{name:16s} composed {t_c*1e3:7.2f} ms "
+              f"({gbps:6.1f} GB/s)  fused {t_f*1e3:7.2f} ms  "
+              f"ratio {t_c/t_f:5.2f}x"
+              + ("" if use_fused else "  [fallback: same path]"))
+        sys.stdout.flush()
+
+
+if __name__ == "__main__":
+    main()

@@ -9,9 +9,9 @@ worth building.
 
 import os
 import sys
-import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 import numpy as np
 
@@ -39,64 +39,23 @@ SHAPES = [
 ITERS = 100
 
 
-def _fetch_floor():
-    """One shared implementation (utils/timers.fetch_floor) so every
-    probe's RTT calibration stays in lockstep."""
-    from sparknet_tpu.utils.timers import fetch_floor
-
-    return fetch_floor()
-
-
 def chain_time(make_loss, x, wt, floor):
-    """Per-step seconds: ONE dispatch scanning `iters` dependent grad
-    steps (no cross-dispatch chain for the tunnel to dedup; the salt
-    keeps repeat dispatches bitwise-distinct anyway), synced by VALUE
-    fetch, with the separately measured fetch floor subtracted.
+    """Per-step fwd+bwd seconds via the shared amortized-window loop
+    (probe_util.grad_chain_time_s): one long salted scan dispatch,
+    VALUE-fetch synced, fetch floor subtracted, iters escalated until
+    the window dominates the floor."""
+    from probe_util import grad_chain_time_s
 
-    `iters` escalates until the net work window dominates the floor, so
-    sub-ms shapes don't drown in the tunnel RTT's run-to-run jitter
-    (which would make their ratios meaningless and the naive
-    floor-subtraction go <= 0).  Differenced multi-dispatch windows
-    (utils/timers) break down here for the same reason — one long
-    amortized window is the stable form (BENCH_NOTES.md round-3
-    measurement trap)."""
-    grad = jax.grad(lambda w_, x_: make_loss(x_, w_))
-
-    def measure(iters):
-        @jax.jit
-        def run(w0, salt):
-            def body(w_, _):
-                g = grad(w_, x)
-                return (w_ - 1e-12 * g).astype(w_.dtype), ()
-            wN, _ = lax.scan(body, w0 + salt.astype(w0.dtype), None,
-                             length=iters)
-            s = jnp.sum(wN.astype(jnp.float32))
-            return s, salt + s * 1e-9 + 1e-3
-
-        salt = jnp.float32(0.0)
-        s, salt = run(wt, salt)
-        float(s)  # warm/compile
-        ts = []
-        for _ in range(3):
-            t0 = time.perf_counter()
-            s, salt = run(wt, salt)
-            float(s)
-            ts.append(time.perf_counter() - t0)
-        ts.sort()
-        return ts[1] - floor
-
-    iters = ITERS
-    net = measure(iters)
-    while net < 2.0 * floor and iters < 32 * ITERS:
-        iters *= 4
-        net = measure(iters)
-    return max(net, 1e-9) / iters
+    return grad_chain_time_s(lambda w_: make_loss(x, w_), wt, floor,
+                             base_iters=ITERS)
 
 
 def main():
     rng = np.random.RandomState(0)
     print("device:", jax.devices()[0])
-    floor = _fetch_floor()
+    from probe_util import fetch_floor_s
+
+    floor = fetch_floor_s()
     print(f"fetch floor: {floor*1e3:.1f} ms (subtracted per window)")
     tot = {"NCHW": 0.0, "NHWC": 0.0}
     for name, n, c, h, w, k, kh, st, pd in SHAPES:

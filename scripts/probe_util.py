@@ -1,0 +1,96 @@
+"""Shared probe timing: one long amortized dispatch minus the fetch
+floor.
+
+Differenced multi-dispatch windows (utils/timers.differenced_chain_s)
+break down for sub-ms work on the tunneled dev platform: window noise
+and the ~65-100 ms value-fetch RTT swamp the differences (BENCH_NOTES.md
+round-3 measurement trap).  The stable form — first built in
+layout_probe.py, factored here for every kernel probe — is ONE compiled
+program scanning `iters` dependent steps, synced by a VALUE fetch (not
+block_until_ready, which returns before deferred execution completes on
+the tunnel), with the separately measured fetch floor subtracted and
+`iters` escalated until the net work window dominates the floor.
+
+The scan carry is salted per dispatch (carry0 + salt, salt fed forward
+from the previous window's reduced output), so repeat dispatches are
+bitwise-distinct and form a true dependency chain — the tunnel can
+neither dedup nor overlap them.
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def fetch_floor_s():
+    """One shared implementation (utils/timers.fetch_floor) so every
+    probe's RTT calibration stays in lockstep."""
+    from sparknet_tpu.utils.timers import fetch_floor
+
+    return fetch_floor()
+
+
+def amortized_scan_time_s(step_fn, carry0, floor, base_iters=100,
+                          max_iters_mult=32, reps=3):
+    """Per-step seconds of `step_fn` (array carry -> same-shape array):
+    ONE jitted dispatch scanning `iters` dependent steps, median of
+    `reps` windows, fetch floor subtracted.
+
+    `iters` escalates (x4, capped at max_iters_mult * base_iters) until
+    the net window is at least twice the floor, so sub-ms steps don't
+    drown in the tunnel RTT's run-to-run jitter — which would make
+    ratios meaningless and the naive floor-subtraction go <= 0.
+
+    `step_fn` must do NON-COLLAPSIBLE work: a loss that is linear in a
+    conv output gets folded by XLA (use sum(y**2), never sum(y)), and
+    any probe whose implied rate lands at/above peak FLOPs is measuring
+    elision, not speed."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    def measure(iters):
+        @jax.jit
+        def run(c0, salt):
+            def body(c, _):
+                return step_fn(c), ()
+
+            cN, _ = lax.scan(body, c0 + salt.astype(c0.dtype), None,
+                             length=iters)
+            s = jnp.sum(cN.astype(jnp.float32))
+            return s, salt + s * 1e-9 + 1e-3
+
+        salt = jnp.float32(0.0)
+        s, salt = run(carry0, salt)
+        float(s)  # warm/compile
+        ts = []
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            s, salt = run(carry0, salt)
+            float(s)
+            ts.append(time.perf_counter() - t0)
+        ts.sort()
+        return ts[len(ts) // 2] - floor
+
+    iters = base_iters
+    net = measure(iters)
+    while net < 2.0 * floor and iters < max_iters_mult * base_iters:
+        iters *= 4
+        net = measure(iters)
+    return max(net, 1e-9) / iters
+
+
+def grad_chain_time_s(loss_fn, primal, floor, lr=1e-12, **kw):
+    """Fwd+bwd per-step seconds: each scan step takes grad(loss_fn) at
+    the carry and nudges it (tiny lr keeps the chain numerically inert
+    while forcing a real data dependency step-to-step)."""
+    import jax
+
+    grad = jax.grad(loss_fn)
+
+    def step(c):
+        return (c - lr * grad(c)).astype(c.dtype)
+
+    return amortized_scan_time_s(step, primal, floor, **kw)

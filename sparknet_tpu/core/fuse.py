@@ -18,7 +18,7 @@ param multipliers are fused; everything else passes through unchanged.
 
 from __future__ import annotations
 
-from typing import Callable, Dict, List, Tuple
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -69,6 +69,109 @@ def _copy_phase_rules(src_layer_msg: Message, dst: Message) -> None:
     for fld in ("include", "exclude"):
         for v in src_layer_msg.getlist(fld):
             dst.add(fld, v.copy())
+
+
+def match_conv_lrn_pool(built_layers: Sequence, layer_protos: Dict,
+                        protected_blobs: Sequence[str] = (),
+                        ) -> List[Dict[str, Optional[int]]]:
+    """Find Convolution → [ReLU] → LRN(ACROSS_CHANNELS) → Pooling(MAX)
+    runs eligible for the fused tower block (ops/fused_block.py) — the
+    AlexNet norm1/norm2 stages, matched from BUILT layers so models opt
+    in without prototxt changes (core/net.py's SPARKNET_FUSED_BLOCKS
+    pass consumes this).
+
+    Conservative by construction: the run must be consecutive in
+    execution order, every intermediate blob must be consumed ONLY
+    inside the run (in-place ReLU counts its shared blob's two readers),
+    written only inside the run, and must not appear in
+    `protected_blobs` (loss terms, HDF5 sinks).  The pool must be
+    non-global non-stochastic MAX; PReLU and WITHIN_CHANNEL LRN never
+    match.  Returns [{"conv": i, "relu": i|None, "lrn": i, "pool": i}].
+    """
+    consumers: Dict[str, List[int]] = {}
+    writers: Dict[str, List[int]] = {}
+    for i, bl in enumerate(built_layers):
+        for b in bl.bottoms:
+            consumers.setdefault(b, []).append(i)
+        for t in bl.tops:
+            writers.setdefault(t, []).append(i)
+    protected = set(protected_blobs)
+
+    def only_used_by(blob: str, reader_idxs: set, writer_idxs: set) -> bool:
+        if blob in protected:
+            return False
+        return (set(consumers.get(blob, [])) == reader_idxs
+                and set(writers.get(blob, [])) == writer_idxs)
+
+    matches: List[Dict[str, Optional[int]]] = []
+    i = 0
+    while i < len(built_layers):
+        bl = built_layers[i]
+        if bl.type != "Convolution" or len(bl.tops) != 1:
+            i += 1
+            continue
+        j = i + 1
+        relu_idx: Optional[int] = None
+        cur_top = bl.tops[0]
+        if (j < len(built_layers) and built_layers[j].type == "ReLU"
+                and built_layers[j].bottoms == [cur_top]):
+            relu_idx = j
+            relu_top = built_layers[j].tops[0]
+            if relu_top == cur_top:
+                # in-place relu: the shared blob is read by relu AND the
+                # next consumer, written by conv and relu
+                if not only_used_by(cur_top, {j, j + 1}, {i, j}):
+                    i += 1
+                    continue
+            else:
+                if not (only_used_by(cur_top, {j}, {i})
+                        and only_used_by(relu_top, {j + 1}, {j})):
+                    i += 1
+                    continue
+            cur_top = relu_top
+            j += 1
+        else:
+            if not only_used_by(cur_top, {j}, {i}):
+                i += 1
+                continue
+        if not (j + 1 < len(built_layers)
+                and built_layers[j].type == "LRN"
+                and built_layers[j].bottoms == [cur_top]
+                and built_layers[j + 1].type == "Pooling"
+                and built_layers[j + 1].bottoms == [built_layers[j].tops[0]]
+                and not built_layers[j + 1].needs_rng):
+            i += 1
+            continue
+        lrn_idx, pool_idx = j, j + 1
+        if relu_idx is None and not only_used_by(
+                bl.tops[0], {lrn_idx}, {i}):
+            i += 1
+            continue
+        if not only_used_by(built_layers[lrn_idx].tops[0],
+                            {pool_idx}, {lrn_idx}):
+            i += 1
+            continue
+        lrn_proto = layer_protos.get(built_layers[lrn_idx].name)
+        pool_proto = layer_protos.get(built_layers[pool_idx].name)
+        relu_proto = (layer_protos.get(built_layers[relu_idx].name)
+                      if relu_idx is not None else None)
+        if lrn_proto is None or pool_proto is None:
+            i += 1
+            continue
+        if str(lrn_proto.lrn_param.norm_region) != "ACROSS_CHANNELS":
+            i += 1
+            continue
+        pp = pool_proto.pooling_param
+        if str(pp.pool) != "MAX" or bool(pp.global_pooling):
+            i += 1
+            continue
+        if relu_idx is not None and relu_proto is None:
+            i += 1
+            continue
+        matches.append({"conv": i, "relu": relu_idx,
+                        "lrn": lrn_idx, "pool": pool_idx})
+        i = pool_idx + 1
+    return matches
 
 
 def fuse_sibling_1x1_convs(net_param: NetParameter

@@ -140,7 +140,13 @@ class Net:
         self.input_blobs: List[str] = []   # blobs the caller must feed
         self.loss_terms: List[Tuple[str, float]] = []  # (blob, weight)
         self.hdf5_outputs: List[Tuple[str, List[str]]] = []  # (file, bottoms)
+        self._layer_protos: Dict[str, LayerParameter] = {}
+        # conv→relu→LRN→pool runs rewritten into one fused layer by the
+        # SPARKNET_FUSED_BLOCKS pass (see _fuse_tower_blocks); each entry
+        # records {"name", "layers", "impl"} for introspection/tests
+        self.fused_blocks: List[Dict[str, Any]] = []
         self._build(net_param, state)
+        self._fuse_tower_blocks()
 
     # ------------------------------------------------------------------ build
     def _build(self, net_param: NetParameter, state: NetState) -> None:
@@ -163,6 +169,7 @@ class Net:
                     raise ValueError(
                         f"layer {layer.name!r} bottom {b!r} is undefined")
                 bshapes.append(self.blob_shapes[b])
+            self._layer_protos[str(layer.name)] = layer
             built, top_shapes, pinits = builder(self, layer, bshapes)
             for t, ts in zip(built.tops, top_shapes):
                 self.blob_shapes[t] = tuple(int(x) for x in ts)
@@ -213,6 +220,78 @@ class Net:
                     f"semantics", stacklevel=2)
             else:
                 tainted.update(bl.tops)
+
+    def _fuse_tower_blocks(self) -> None:
+        """SPARKNET_FUSED_BLOCKS=xla|pallas: rewrite each matched
+        Convolution→[ReLU]→LRN→Pooling(MAX) run (core/fuse.py
+        match_conv_lrn_pool) into ONE fused layer over
+        ops.fused_conv_lrn_pool.  The fused layer keeps the conv's name
+        and param_keys, so get_weights/set_weights interchange and
+        trained checkpoints are untouched; `xla` composes the stock ops
+        (bitwise-identical graph), `pallas` runs the fused tail kernel
+        on TPU with a graceful XLA fallback elsewhere."""
+        from ..ops import fused_block as _fb
+
+        mode = _fb.fused_blocks_mode()
+        if mode == "off":
+            return
+        from .fuse import match_conv_lrn_pool
+
+        protected = [t for t, _ in self.loss_terms]
+        for _, bottoms in self.hdf5_outputs:
+            protected.extend(bottoms)
+        matches = match_conv_lrn_pool(self.layers, self._layer_protos,
+                                      protected)
+        if not matches:
+            return
+
+        def make_fn(conv_kw, relu_slope, lrn_kw, pool_kw):
+            def fn(pvals, bvals, rng, train):
+                wgt = pvals[0]
+                b = pvals[1] if len(pvals) > 1 else None
+                y = _fb.fused_conv_lrn_pool(
+                    bvals[0], wgt, b, relu_slope=relu_slope, impl=mode,
+                    **conv_kw, **lrn_kw, **pool_kw)
+                return [y], {}
+            return fn
+
+        replace: Dict[int, BuiltLayer] = {}
+        drop: set = set()
+        for m in matches:
+            conv = self.layers[m["conv"]]
+            pool = self.layers[m["pool"]]
+            cp = self._layer_protos[conv.name].convolution_param
+            lp = self._layer_protos[self.layers[m["lrn"]].name].lrn_param
+            pp = self._layer_protos[pool.name].pooling_param
+            conv_kw = dict(stride=tuple(cp.stride), pad=tuple(cp.pad),
+                           dilation=tuple(cp.dilation),
+                           groups=int(cp.group))
+            lrn_kw = dict(local_size=int(lp.local_size),
+                          alpha=float(lp.alpha), beta=float(lp.beta),
+                          k=float(lp.k))
+            pool_kw = dict(pool_kernel=tuple(pp.kernel),
+                           pool_stride=tuple(pp.strides),
+                           pool_pad=tuple(pp.pads))
+            relu_slope = None
+            if m["relu"] is not None:
+                relu_proto = self._layer_protos[
+                    self.layers[m["relu"]].name]
+                relu_slope = float(relu_proto.relu_param.negative_slope)
+            member_names = [self.layers[idx].name
+                            for idx in (m["conv"], m["relu"], m["lrn"],
+                                        m["pool"]) if idx is not None]
+            replace[m["conv"]] = BuiltLayer(
+                name=conv.name, type="FusedConvLRNPool",
+                bottoms=list(conv.bottoms), tops=list(pool.tops),
+                param_keys=list(conv.param_keys),
+                fn=make_fn(conv_kw, relu_slope, lrn_kw, pool_kw))
+            drop.update(idx for idx in (m["relu"], m["lrn"], m["pool"])
+                        if idx is not None)
+            self.fused_blocks.append(
+                {"name": conv.name, "layers": member_names, "impl": mode})
+        self.layers = [replace.get(i, bl)
+                       for i, bl in enumerate(self.layers)
+                       if i in replace or i not in drop]
 
     def _layer_params(self, layer: LayerParameter,
                       specs: List[Tuple[Tuple[int, ...], FillerParameter]],

@@ -9,6 +9,8 @@ from .attention import (attention, blockwise_attention,
                         flash_attention_tpu)
 from .conv import conv2d, conv_out_dim, deconv2d, deconv_out_dim, im2col
 from .dense import embed, inner_product
+from .fused_block import (fused_blocks_mode, fused_conv_lrn_pool,
+                          fused_out_shape, fused_tail_supported)
 from .lrn import lrn, lrn_across_channels, lrn_within_channel
 from .moe import expert_capacity, moe_ffn, top_k_gating
 from .losses import (accuracy, argmax, contrastive_loss, euclidean_loss,

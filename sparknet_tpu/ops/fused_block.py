@@ -1,0 +1,361 @@
+"""Fused conv→ReLU→LRN→max-pool tower block (AlexNet norm1/norm2 stages).
+
+The PHAST Caffe-port lesson (PAPERS.md) is that kernel-by-kernel
+translation leaves fusion wins on the table: after ops/pallas_lrn.py the
+AlexNet tower stage still runs relu, LRN, and pool as three XLA ops with
+three full HBM round-trips of the (N, C, H, W) map.  This module fuses
+the memory-bound TAIL (relu → cross-channel LRN → ceil-mode MAX pool)
+into one Pallas kernel: the conv itself stays on the MXU via ops.conv2d
+(a hand-written VPU conv would forfeit the systolic array), then one
+grid cell per batch element keeps the (C, H, W) plane VMEM-resident
+(AlexNet norm1: 96·55·55·4B ≈ 1.2 MB) and writes only the pooled output.
+
+Strided pooling inside the kernel dodges Mosaic's strided-slice
+rejection (the blocker recorded in ops/pooling.py's study) with a
+reshape trick: pad H to a multiple of stride, reshape to
+(C, lh, sh, lw, sw), and window offset (i, j) becomes the UNIT-stride
+slice r[:, di:di+oh, ri, dj:dj+ow, rj] with (di, ri) = divmod(i, sh).
+
+The backward is a fused custom-VJP kernel following pallas_lrn.py's
+template: relu/scale/pool routing are recomputed from the conv output
+(one extra VPU pass beats writing f32 residuals through HBM — the
+measured lesson in pallas_lrn._bwd_kernel), pool gradients scatter with
+first-max-wins tie routing via the stride-residue class maps of
+ops.pooling._max_pool_residue_bwd (tree-min over offset indices, one
+interleaving reshape), then the LRN transpose window and the relu mask.
+
+Math (reference: caffe/src/caffe/layers/lrn_layer.cpp:88-119 forward,
+pooling_layer.cpp:155-169 max routing):
+    xr      = relu(x)                      [optional, slope s]
+    scale_i = k + alpha/n * sum_{j in win(i)} xr_j^2
+    y_i     = xr_i * scale_i^{-beta}
+    out     = maxpool(y)                   [ceil mode, -inf padding]
+
+Dispatch: SPARKNET_FUSED_BLOCKS=off|xla|pallas (mirrors SPARKNET_LRN_IMPL
+in ops/lrn.py; consumed by core/net.py's fusion pass).  `xla` composes
+the exact stock unfused ops inside one layer fn (bitwise-identical
+graph, lets XLA see the whole chain); `pallas` uses the fused kernel on
+TPU and falls back to the XLA composition gracefully elsewhere — tests
+exercise the kernel on CPU via interpret=True.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+from typing import NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from .activations import relu as _relu_op
+from .conv import conv2d
+from .lrn import _powm, lrn as _lrn_dispatch
+from .pooling import _window_geometry, max_pool, pool_out_dim
+
+
+def fused_blocks_mode() -> str:
+    """SPARKNET_FUSED_BLOCKS=off|xla|pallas (default off; empty/0 = off)."""
+    mode = os.environ.get("SPARKNET_FUSED_BLOCKS")
+    if mode in (None, "", "0", "off"):
+        return "off"
+    if mode not in ("xla", "pallas"):
+        raise ValueError(
+            f"SPARKNET_FUSED_BLOCKS={mode!r}; expected off, xla, or pallas")
+    return mode
+
+
+class _PoolGeom(NamedTuple):
+    """Host-side static geometry for the in-kernel reshape-trick pool."""
+    h: int
+    w: int
+    kh: int
+    kw: int
+    sh: int
+    sw: int
+    oh: int
+    ow: int
+    pad_h_lo: int
+    pad_w_lo: int
+    hp: int   # padded H, a multiple of sh
+    wp: int   # padded W, a multiple of sw
+    lh: int   # hp // sh
+    lw: int   # wp // sw
+
+
+def _pool_geometry(h: int, w: int, kernel: Tuple[int, int],
+                   stride: Tuple[int, int],
+                   pad: Tuple[int, int]) -> _PoolGeom:
+    kh, kw = kernel
+    sh, sw = stride
+    oh, ow, pad_h, pad_w = _window_geometry((h, w), kernel, pad, stride)
+    # every offset slice r[:, di:di+oh, ri, ...] needs di+oh <= lh with
+    # di = (kh-1)//sh at most, so lh >= oh + (kh-1)//sh; same for W
+    need_h = max((oh - 1) * sh + kh, h + pad_h[0])
+    need_w = max((ow - 1) * sw + kw, w + pad_w[0])
+    hp = -(-need_h // sh) * sh
+    wp = -(-need_w // sw) * sw
+    return _PoolGeom(h, w, kh, kw, sh, sw, oh, ow, pad_h[0], pad_w[0],
+                     hp, wp, hp // sh, wp // sw)
+
+
+def _winsum_c(v: jax.Array, pad_lo: int, pad_hi: int) -> jax.Array:
+    """Channel-window sum over axis 0 of (C, H, W) via shifted adds
+    (the pallas_lrn._window_sum idea, one extra trailing axis)."""
+    c = v.shape[0]
+    padded = jnp.pad(v, ((pad_lo, pad_hi), (0, 0), (0, 0)))
+    acc = padded[0:c]
+    for off in range(1, pad_lo + pad_hi + 1):
+        acc = acc + padded[off:off + c]
+    return acc
+
+
+def _apply_relu(x: jax.Array, relu_slope: Optional[float]) -> jax.Array:
+    if relu_slope is None:
+        return x
+    if relu_slope == 0.0:
+        return jnp.maximum(x, 0.0)
+    return jnp.where(x > 0, x, relu_slope * x)
+
+
+def _pool_patches(y: jax.Array, g: _PoolGeom):
+    """All kh*kw window-offset views of y as unit-stride (C, oh, ow)
+    slices of the stride-reshaped padded map (Mosaic-safe)."""
+    c = y.shape[0]
+    yp = jnp.pad(y, ((0, 0),
+                     (g.pad_h_lo, g.hp - g.h - g.pad_h_lo),
+                     (g.pad_w_lo, g.wp - g.w - g.pad_w_lo)),
+                 constant_values=-jnp.inf)
+    r = yp.reshape(c, g.lh, g.sh, g.lw, g.sw)
+    patches = []
+    for i in range(g.kh):
+        di, ri = divmod(i, g.sh)
+        for j in range(g.kw):
+            dj, rj = divmod(j, g.sw)
+            patches.append(r[:, di:di + g.oh, ri, dj:dj + g.ow, rj])
+    return patches
+
+
+def _fused_tail_fwd_kernel(x_ref, y_ref, *, relu_slope, pad_lo, pad_hi,
+                           alpha, beta, k, n, geom):
+    x = x_ref[0].astype(jnp.float32)
+    xr = _apply_relu(x, relu_slope)
+    scale = k + (alpha / n) * _winsum_c(xr * xr, pad_lo, pad_hi)
+    y = xr * _powm(scale, -beta)
+    out = _pool_patches(y, geom)
+    acc = out[0]
+    for p in out[1:]:
+        acc = jnp.maximum(acc, p)
+    y_ref[0] = acc.astype(y_ref.dtype)
+
+
+def _fused_tail_bwd_kernel(x_ref, dy_ref, dx_ref, *, relu_slope, pad_lo,
+                           pad_hi, alpha, beta, k, n, geom):
+    # recompute relu/scale/y rather than saving them: pallas_lrn's measured
+    # lesson — an extra VPU pass beats full-tensor f32 residuals in HBM
+    x = x_ref[0].astype(jnp.float32)
+    xr = _apply_relu(x, relu_slope)
+    scale = k + (alpha / n) * _winsum_c(xr * xr, pad_lo, pad_hi)
+    inv_pow = _powm(scale, -beta)
+    y = xr * inv_pow
+    dy = dy_ref[0].astype(jnp.float32)
+
+    g = geom
+    c = x.shape[0]
+    patches = _pool_patches(y, g)
+    m = patches[0]
+    for p in patches[1:]:
+        m = jnp.maximum(m, p)
+    # first-max-wins tie routing via a parallel tree-min over offset
+    # indices, then a stride-residue class-map scatter — the
+    # _max_pool_residue_bwd formulation, single batch element
+    big = jnp.int32(g.kh * g.kw)
+    first = None
+    for idx, p in enumerate(patches):
+        cand = jnp.where(p == m, jnp.int32(idx), big)
+        first = cand if first is None else jnp.minimum(first, cand)
+    zero = jnp.zeros((c, g.lh, g.lw), dtype=jnp.float32)
+    classes = [[zero] * g.sw for _ in range(g.sh)]
+    for i in range(g.kh):
+        di, ri = divmod(i, g.sh)
+        for j in range(g.kw):
+            dj, rj = divmod(j, g.sw)
+            idx = i * g.kw + j
+            win = (patches[idx] == m) & (first == idx)
+            contrib = jnp.where(win, dy, 0.0)
+            shifted = jnp.pad(contrib, ((0, 0),
+                                        (di, g.lh - g.oh - di),
+                                        (dj, g.lw - g.ow - dj)))
+            classes[ri][rj] = classes[ri][rj] + shifted
+    grid = jnp.stack([jnp.stack(row, axis=-1) for row in classes],
+                     axis=-3)  # (c, lh, sh, lw, sw)
+    dy_lrn = grid.reshape(c, g.hp, g.wp)[
+        :, g.pad_h_lo:g.pad_h_lo + g.h, g.pad_w_lo:g.pad_w_lo + g.w]
+
+    # LRN backward over the transpose window (lrn_layer.cpp:121-156
+    # CrossChannelBackward_cpu, fused as in pallas_lrn._bwd_kernel)
+    ratio = dy_lrn * xr * _powm(scale, -beta - 1.0)
+    acc = _winsum_c(ratio, pad_hi, pad_lo)
+    dxr = dy_lrn * inv_pow - (2.0 * alpha * beta / n) * xr * acc
+    if relu_slope is None:
+        dx = dxr
+    else:
+        dx = jnp.where(x > 0, dxr, relu_slope * dxr)
+    dx_ref[0] = dx.astype(dx_ref.dtype)
+
+
+def _tail_grid_call(kernel, inputs, out_shape, interpret: bool):
+    # deferred: keeps jax.experimental.pallas off the module-import path
+    # (the ops.lrn dispatch contract, pinned by tests/test_lrn_dispatch.py)
+    from jax.experimental import pallas as pl
+
+    b = inputs[0].shape[0]
+    # every operand is (N, C, H-ish, W-ish): one batch element per cell
+    specs = [pl.BlockSpec((1,) + tuple(arr.shape[1:]),
+                          lambda i: (i, 0, 0, 0)) for arr in inputs]
+    out_spec = pl.BlockSpec((1,) + tuple(out_shape.shape[1:]),
+                            lambda i: (i, 0, 0, 0))
+    return pl.pallas_call(
+        kernel,
+        grid=(b,),
+        in_specs=specs,
+        out_specs=out_spec,
+        out_shape=out_shape,
+        interpret=interpret,
+    )(*inputs)
+
+
+# nondiff: (local_size, alpha, beta, k, relu_slope, pool_kernel,
+#           pool_stride, pool_pad, interpret)
+@functools.partial(jax.custom_vjp,
+                   nondiff_argnums=(1, 2, 3, 4, 5, 6, 7, 8, 9))
+def fused_tail_pallas(x: jax.Array, local_size: int, alpha: float,
+                      beta: float, k: float, relu_slope: Optional[float],
+                      pool_kernel: Tuple[int, int],
+                      pool_stride: Tuple[int, int],
+                      pool_pad: Tuple[int, int],
+                      interpret: bool = False) -> jax.Array:
+    """relu→LRN(ACROSS_CHANNELS)→MAX-pool of a conv output, one kernel.
+
+    relu_slope=None skips the relu stage; pool geometry is Caffe
+    ceil-mode (ops.pooling.pool_out_dim).  x is (N, C, H, W)."""
+    y, _ = _fused_tail_fwd(x, local_size, alpha, beta, k, relu_slope,
+                           pool_kernel, pool_stride, pool_pad, interpret)
+    return y
+
+
+def _fused_tail_fwd(x, local_size, alpha, beta, k, relu_slope,
+                    pool_kernel, pool_stride, pool_pad, interpret):
+    b, c, h, w = x.shape
+    pad_lo = (local_size - 1) // 2
+    pad_hi = local_size - 1 - pad_lo
+    geom = _pool_geometry(h, w, tuple(pool_kernel), tuple(pool_stride),
+                          tuple(pool_pad))
+    kern = functools.partial(
+        _fused_tail_fwd_kernel, relu_slope=relu_slope, pad_lo=pad_lo,
+        pad_hi=pad_hi, alpha=alpha, beta=beta, k=k, n=local_size, geom=geom)
+    y = _tail_grid_call(
+        kern, [x], jax.ShapeDtypeStruct((b, c, geom.oh, geom.ow), x.dtype),
+        interpret)
+    return y, (x,)
+
+
+def _fused_tail_bwd(local_size, alpha, beta, k, relu_slope, pool_kernel,
+                    pool_stride, pool_pad, interpret, res, dy):
+    (x,) = res
+    b, c, h, w = x.shape
+    pad_lo = (local_size - 1) // 2
+    pad_hi = local_size - 1 - pad_lo
+    geom = _pool_geometry(h, w, tuple(pool_kernel), tuple(pool_stride),
+                          tuple(pool_pad))
+    kern = functools.partial(
+        _fused_tail_bwd_kernel, relu_slope=relu_slope, pad_lo=pad_lo,
+        pad_hi=pad_hi, alpha=alpha, beta=beta, k=k, n=local_size, geom=geom)
+    dx = _tail_grid_call(
+        kern, [x, dy], jax.ShapeDtypeStruct((b, c, h, w), x.dtype),
+        interpret)
+    return (dx,)
+
+
+fused_tail_pallas.defvjp(
+    lambda x, local_size, alpha, beta, k, relu_slope, pool_kernel,
+    pool_stride, pool_pad, interpret:
+        _fused_tail_fwd(x, local_size, alpha, beta, k, relu_slope,
+                        pool_kernel, pool_stride, pool_pad, interpret),
+    _fused_tail_bwd)
+
+
+def fused_tail_supported(x: jax.Array) -> bool:
+    """Same shape/dtype gate as pallas_lrn_supported: the channel axis
+    rides the sublanes of the (C, H·W-ish) tile."""
+    if x.ndim != 4:
+        return False
+    sub = 16 if x.dtype == jnp.bfloat16 else 8
+    return x.shape[1] % sub == 0 and x.dtype in (jnp.float32, jnp.bfloat16)
+
+
+def _tail_xla(x, local_size, alpha, beta, k, relu_slope, pool_kernel,
+              pool_stride, pool_pad):
+    """The exact stock unfused composition (ops.relu → ops.lrn →
+    ops.max_pool), so fused-xla nets stay bitwise identical to unfused."""
+    if relu_slope is not None:
+        x = _relu_op(x, relu_slope)
+    x = _lrn_dispatch(x, local_size, alpha, beta, k, "ACROSS_CHANNELS")
+    return max_pool(x, tuple(pool_kernel), stride=tuple(pool_stride),
+                    pad=tuple(pool_pad))
+
+
+def fused_conv_lrn_pool(x: jax.Array, w: jax.Array,
+                        b: Optional[jax.Array] = None, *,
+                        stride: Tuple[int, int] = (1, 1),
+                        pad: Tuple[int, int] = (0, 0),
+                        dilation: Tuple[int, int] = (1, 1),
+                        groups: int = 1,
+                        relu_slope: Optional[float] = 0.0,
+                        local_size: int = 5, alpha: float = 1.0,
+                        beta: float = 0.75, k: float = 1.0,
+                        pool_kernel: Tuple[int, int] = (3, 3),
+                        pool_stride: Tuple[int, int] = (2, 2),
+                        pool_pad: Tuple[int, int] = (0, 0),
+                        impl: str = "xla",
+                        interpret: Optional[bool] = None) -> jax.Array:
+    """One fused tower block: MXU conv + fused relu/LRN/max-pool tail.
+
+    impl='xla' composes the stock ops; impl='pallas' runs the fused tail
+    kernel when the backend is TPU and the shape qualifies, else falls
+    back to the XLA composition (interpret=True forces the kernel in
+    interpret mode for CPU testing)."""
+    y = conv2d(x, w, b, stride=tuple(stride), pad=tuple(pad),
+               dilation=tuple(dilation), groups=groups)
+    if impl == "pallas":
+        run_kernel = (interpret if interpret is not None
+                      else jax.default_backend() == "tpu")
+        if run_kernel and fused_tail_supported(y):
+            return fused_tail_pallas(
+                y, local_size, alpha, beta, k, relu_slope,
+                tuple(pool_kernel), tuple(pool_stride), tuple(pool_pad),
+                bool(interpret) if interpret is not None else False)
+    elif impl != "xla":
+        raise ValueError(f"fused_conv_lrn_pool impl={impl!r}; "
+                         f"expected xla or pallas")
+    return _tail_xla(y, local_size, alpha, beta, k, relu_slope,
+                     pool_kernel, pool_stride, pool_pad)
+
+
+def fused_out_shape(in_shape: Tuple[int, ...], num_output: int,
+                    conv_kernel: Tuple[int, int], conv_pad: Tuple[int, int],
+                    conv_stride: Tuple[int, int],
+                    conv_dilation: Tuple[int, int],
+                    pool_kernel: Tuple[int, int], pool_pad: Tuple[int, int],
+                    pool_stride: Tuple[int, int]) -> Tuple[int, ...]:
+    """Static (N, C, OH, OW) of the fused block (conv then ceil-mode pool)."""
+    from .conv import conv_out_dim
+
+    n, _, h, w = in_shape
+    ch = conv_out_dim(h, conv_kernel[0], conv_pad[0], conv_stride[0],
+                      conv_dilation[0])
+    cw = conv_out_dim(w, conv_kernel[1], conv_pad[1], conv_stride[1],
+                      conv_dilation[1])
+    oh = pool_out_dim(ch, pool_kernel[0], pool_pad[0], pool_stride[0])
+    ow = pool_out_dim(cw, pool_kernel[1], pool_pad[1], pool_stride[1])
+    return (n, num_output, oh, ow)

@@ -1,0 +1,57 @@
+"""Post-training quantization primitives: per-channel symmetric int8.
+
+Weight-only quantization (w8a16): weights are stored int8 with one f32
+scale per output channel (axis 0 of every Caffe-layout weight —
+conv OIHW rows and inner-product (out, in) rows are both independent
+dot products, so per-row scaling is exact per-channel), then
+dequantized to the compute dtype INSIDE the jitted forward.  Symmetric
+(zero-point-free) quantization keeps the dequant a single multiply;
+127 (not 128) bounds the grid so +/- ranges stay symmetric.
+
+The serving integration (calibration, param-tree plumbing, mode
+selection) lives in serving/quant.py; these are the pure-math pieces.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+INT8_LEVELS = 127  # symmetric: q in [-127, 127], -128 unused
+
+
+def quantize_per_channel_int8(w: jax.Array, axis: int = 0,
+                              ) -> Tuple[jax.Array, jax.Array]:
+    """w -> (q int8, scale f32) with one scale per slice along `axis`.
+
+    scale = max|w| / 127 per channel (1.0 for all-zero channels, so the
+    dequant stays finite and exact); q = round(w / scale) clipped to
+    [-127, 127].  Round-trip error is bounded by scale/2 per element.
+    """
+    w = w.astype(jnp.float32)
+    reduce_axes = tuple(d for d in range(w.ndim) if d != axis)
+    amax = jnp.max(jnp.abs(w), axis=reduce_axes)
+    scale = jnp.where(amax > 0, amax / INT8_LEVELS, 1.0)
+    bshape = tuple(w.shape[axis] if d == axis else 1 for d in range(w.ndim))
+    q = jnp.clip(jnp.round(w / scale.reshape(bshape)),
+                 -INT8_LEVELS, INT8_LEVELS).astype(jnp.int8)
+    return q, scale.astype(jnp.float32)
+
+
+def dequantize_int8(q: jax.Array, scale: jax.Array, axis: int = 0,
+                    dtype=jnp.bfloat16) -> jax.Array:
+    """(q, scale) -> w in `dtype`.  The multiply runs in f32 (int8
+    magnitudes are exact in f32; a bf16 multiply would round the scale
+    AND the product) and casts once at the end."""
+    bshape = tuple(q.shape[axis] if d == axis else 1 for d in range(q.ndim))
+    return (q.astype(jnp.float32) * scale.reshape(bshape)).astype(dtype)
+
+
+def top1_agreement(probs_a: jax.Array, probs_b: jax.Array) -> float:
+    """Fraction of rows where the two (N, K) score matrices agree on the
+    argmax — the calibration metric for post-training quantization."""
+    a = jnp.argmax(jnp.asarray(probs_a), axis=-1)
+    b = jnp.argmax(jnp.asarray(probs_b), axis=-1)
+    return float(jnp.mean((a == b).astype(jnp.float32)))

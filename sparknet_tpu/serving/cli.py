@@ -61,11 +61,24 @@ def cmd_serve(args) -> int:
                        default_deadline_ms=args.deadline_ms)
     server = InferenceServer(cfg)
     name = args.name or "default"
-    lm = server.load(name, args.model, weights=args.weights,
-                     buckets=_parse_buckets(args.buckets), seed=args.seed)
+    try:
+        lm = server.load(name, args.model, weights=args.weights,
+                         buckets=_parse_buckets(args.buckets),
+                         seed=args.seed, quant=args.quant,
+                         quant_min_agreement=(args.quant_min_agreement
+                                              if args.quant != "fp32"
+                                              else None))
+    except ValueError as e:
+        # a failed quant calibration floor (or bad spec) is a load
+        # error, not a crash
+        raise SystemExit(f"serve: {e}")
+    quant_note = ""
+    if lm.runner.quant != "fp32":
+        quant_note = (f", quant {lm.runner.quant} "
+                      f"(top-1 agreement {lm.runner.quant_agreement:.4f})")
     print(f"serving {args.model!r} as {name!r}: input "
           f"{lm.runner.sample_shape}, buckets {lm.runner.buckets}, "
-          f"{lm.runner.compile_count()} programs warmed",
+          f"{lm.runner.compile_count()} programs warmed{quant_note}",
           file=sys.stderr, flush=True)
 
     pre = None
@@ -193,6 +206,15 @@ def register(sub) -> None:
     s.add_argument("--image_dims",
                    help="H,W to resize to before the crop "
                         "(with --preprocess)")
+    s.add_argument("--quant", default="fp32",
+                   choices=["fp32", "bf16", "int8"],
+                   help="serving forward numerics (serving/quant.py): "
+                        "bf16 casts params+activations, int8 packs "
+                        "weights per-channel (w8a16)")
+    s.add_argument("--quant_min_agreement", type=float, default=0.99,
+                   help="minimum top-1 agreement vs fp32 at calibration "
+                        "(non-fp32 --quant only); below it the load "
+                        "fails")
     s.add_argument("--seed", type=int, default=0,
                    help="param init seed when no --weights")
     s.add_argument("--stats_out",

@@ -55,15 +55,22 @@ class ModelRunner:
     def __init__(self, net_param, *, weights: Optional[str] = None,
                  buckets: Optional[Sequence[int]] = None,
                  max_batch: int = 8, seed: int = 0,
-                 device=None) -> None:
+                 device=None, quant: Optional[str] = None,
+                 quant_calib_batches: int = 2,
+                 quant_min_agreement: Optional[float] = None) -> None:
         import jax
         import jax.numpy as jnp
 
         from ..core.net import Net
+        from .quant import (build_quantized_params, quantized_bytes,
+                            validate_quant_mode)
 
         self.buckets: Tuple[int, ...] = (
             validate_buckets(buckets) if buckets is not None
             else bucket_sizes(max_batch))
+        self.quant = validate_quant_mode(quant)
+        self.quant_agreement: Optional[float] = None
+        self._seed = int(seed)
         self.net = Net(net_param, "TEST")
         self.params = self.net.init_params(seed)
         if weights:
@@ -99,8 +106,28 @@ class ModelRunner:
                         else jnp.float32)
                 return net.forward(params, feed)[self.output_blob]
 
-        self._jfwd = jax.jit(fwd)
+        if self.quant == "fp32":
+            self._exec_params = self.params
+            self._jfwd = jax.jit(fwd)
+        else:
+            # fp32 stays the master copy (calibration, interchange,
+            # reload); the quantized tree is what the hot path carries
+            qtree, dequant = build_quantized_params(self.params, self.quant)
+            if device is not None:
+                qtree = jax.device_put(qtree, device)
+            self._exec_params = qtree
+
+            def qfwd(qp, x):
+                p = dequant(qp)
+                return fwd(p, x.astype(jnp.bfloat16)).astype(jnp.float32)
+
+            self._jfwd = jax.jit(qfwd)
+            self._jref = jax.jit(fwd)  # fp32 reference for calibration
+        self.param_bytes = quantized_bytes(self._exec_params)
         self._shapes_seen: set = set()
+        if self.quant != "fp32":
+            self.calibrate_quant(quant_calib_batches,
+                                 min_agreement=quant_min_agreement)
 
     # ------------------------------------------------------------- execution
     def forward_padded(self, x: np.ndarray) -> np.ndarray:
@@ -126,7 +153,47 @@ class ModelRunner:
         # block_until_ready returns before deferred execution completes
         # (BENCH_NOTES.md round-3 trap), and a response is host data
         # anyway
-        return np.asarray(self._jfwd(self.params, xj))
+        return np.asarray(self._jfwd(self._exec_params, xj))
+
+    def calibrate_quant(self, n_batches: int = 2, *,
+                        min_agreement: Optional[float] = None,
+                        ) -> Optional[float]:
+        """Measure the quantized forward's top-1 agreement against the
+        fp32 master on seeded synthetic batches at the largest bucket
+        (the serving analogue of PTQ calibration data — this box has no
+        egress, so the batches are deterministic uniform noise).  Stores
+        and returns the fraction; with `min_agreement`, a quantization
+        that broke the model fails the LOAD instead of serving garbage.
+        No-op (None) on the fp32 path."""
+        if self.quant == "fp32":
+            return None
+        import jax
+
+        from ..ops.quant import top1_agreement
+
+        import jax.numpy as jnp
+
+        rng = np.random.RandomState(self._seed ^ 0x5EED)
+        bucket = max(self.buckets)
+        agree = []
+        for _ in range(max(1, int(n_batches))):
+            x = rng.rand(bucket, *self.sample_shape).astype(np.float32)
+            # same device/conversion path as forward_padded, so the
+            # calibration compile IS the largest warmed bucket's program
+            xj = (jax.device_put(x, self.device)
+                  if self.device is not None else jnp.asarray(x))
+            ref = np.asarray(self._jref(self.params, xj))
+            got = np.asarray(self._jfwd(self._exec_params, xj))
+            agree.append(top1_agreement(ref, got))
+        self.quant_agreement = float(np.mean(agree))
+        if min_agreement is not None and \
+                self.quant_agreement < float(min_agreement):
+            raise ValueError(
+                f"quant={self.quant!r} calibration failed: top-1 "
+                f"agreement {self.quant_agreement:.4f} < required "
+                f"{float(min_agreement):.4f} over {n_batches} "
+                f"batches of {bucket}")
+        return self.quant_agreement
 
     def warmup(self) -> int:
         """Pre-compile every bucket (zeros in, value-fetched out);
@@ -153,4 +220,7 @@ class ModelRunner:
                 "output_blob": self.output_blob,
                 "n_outputs": self.n_outputs,
                 "buckets": list(self.buckets),
-                "compiles": self.compile_count()}
+                "compiles": self.compile_count(),
+                "quant": self.quant,
+                "quant_agreement": self.quant_agreement,
+                "param_bytes": self.param_bytes}

@@ -1,0 +1,89 @@
+"""Quantized serving forward: param-tree plumbing + calibration.
+
+Modes (ModelRunner(..., quant=...), registry.load, `sparknet serve
+--quant`, bench.py serving_int8 leg):
+
+- "fp32" (default): the stock path, untouched.
+- "bf16": every floating param and the activations cast to bfloat16;
+  output scores cast back to f32.  Halves param HBM and rides the TPU's
+  native bf16 compute paths.
+- "int8": weight-only w8a16 — every floating param with ndim >= 2
+  (conv OIHW, inner-product (out, in), attention mats) stored as
+  per-output-channel symmetric int8 (ops/quant.py), dequantized to
+  bf16 INSIDE the jitted forward (so HBM traffic is int8 + one f32
+  scale vector per weight; the dequant fuses into the consumer on TPU);
+  1-D floats (biases, BN stats) ride as bf16, activations bf16.
+
+The fp32 master params are kept on the runner regardless, so
+calibration, get_weights interchange, and hot-reload never touch the
+quantized copies.  Calibration = top-1 agreement vs the fp32 forward on
+seeded synthetic batches at load (ModelRunner.warmup); a
+`quant_min_agreement` floor turns a silently-broken quantization into a
+loud load failure.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Tuple
+
+QUANT_MODES = ("fp32", "bf16", "int8")
+
+
+def validate_quant_mode(mode: Optional[str]) -> str:
+    mode = mode or "fp32"
+    if mode not in QUANT_MODES:
+        raise ValueError(
+            f"quant mode {mode!r}; expected one of {QUANT_MODES}")
+    return mode
+
+
+def build_quantized_params(params: Dict, mode: str) -> Tuple[Dict, object]:
+    """params (f32 master) -> (qtree, dequant_fn).
+
+    qtree is a jit-traversable pytree: arrays, plus
+    {"q": int8, "scale": f32} leaves-of-dicts for int8-packed weights.
+    `dequant_fn(qtree)` rebuilds a {key: array} dict in the compute
+    dtype inside the jitted forward.  mode "fp32" returns the params
+    untouched with an identity dequant."""
+    import jax.numpy as jnp
+
+    from ..ops.quant import dequantize_int8, quantize_per_channel_int8
+
+    if mode == "fp32":
+        return dict(params), (lambda t: t)
+
+    compute_dtype = jnp.bfloat16
+    qtree: Dict = {}
+    packed = set()
+    for key, val in params.items():
+        if not jnp.issubdtype(val.dtype, jnp.floating):
+            qtree[key] = val  # int params (if any) pass through
+        elif mode == "int8" and val.ndim >= 2:
+            q, scale = quantize_per_channel_int8(val, axis=0)
+            qtree[key] = {"q": q, "scale": scale}
+            packed.add(key)
+        else:
+            qtree[key] = val.astype(compute_dtype)
+
+    def dequant(tree: Dict) -> Dict:
+        out = {}
+        for key, val in tree.items():
+            if key in packed:
+                out[key] = dequantize_int8(val["q"], val["scale"], axis=0,
+                                           dtype=compute_dtype)
+            else:
+                out[key] = val
+        return out
+
+    return qtree, dequant
+
+
+def quantized_bytes(qtree: Dict) -> int:
+    """Device bytes of the (possibly packed) param tree — the HBM win
+    the mode buys, surfaced in ModelRunner.describe()."""
+    import jax
+
+    total = 0
+    for leaf in jax.tree_util.tree_leaves(qtree):
+        total += int(leaf.size) * int(leaf.dtype.itemsize)
+    return total

@@ -44,14 +44,19 @@ class ModelRegistry:
              weights: Optional[str] = None,
              buckets: Optional[Sequence[int]] = None,
              max_batch: int = 8, seed: int = 0, device=None,
-             warmup: bool = True) -> LoadedModel:
+             warmup: bool = True, quant: Optional[str] = None,
+             quant_min_agreement: Optional[float] = None) -> LoadedModel:
         """Build, (optionally) warm, and register a model under `name`.
         `spec` defaults to `name` (zoo entry or prototxt path).
         Loading over an existing name replaces it (generation restarts);
-        use reload() to rebuild in place with a bumped generation."""
+        use reload() to rebuild in place with a bumped generation.
+        `quant` selects the serving forward's numeric mode
+        (serving/quant.py: fp32/bf16/int8); the kwargs are recorded, so
+        reload() rebuilds AND recalibrates the same quantized form."""
         spec = spec if spec is not None else name
         kwargs = {"buckets": buckets, "max_batch": max_batch,
-                  "seed": seed, "device": device}
+                  "seed": seed, "device": device, "quant": quant,
+                  "quant_min_agreement": quant_min_agreement}
         runner = ModelRunner(
             resolve_net_param(spec, max_batch=max_batch),
             weights=weights, **kwargs)

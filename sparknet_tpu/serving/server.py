@@ -127,8 +127,9 @@ class InferenceServer:
     def load(self, name: str, spec: Optional[str] = None, *,
              weights: Optional[str] = None,
              buckets: Optional[Sequence[int]] = None,
-             seed: int = 0, device=None, warmup: bool = True
-             ) -> LoadedModel:
+             seed: int = 0, device=None, warmup: bool = True,
+             quant: Optional[str] = None,
+             quant_min_agreement: Optional[float] = None) -> LoadedModel:
         """Load + warm a model and start its batcher lane.  The bucket
         ladder defaults to powers of two up to config.max_batch."""
         if not self._accepting:
@@ -136,7 +137,9 @@ class InferenceServer:
         lm = self.registry.load(name, spec, weights=weights,
                                 buckets=buckets,
                                 max_batch=self.config.max_batch,
-                                seed=seed, device=device, warmup=warmup)
+                                seed=seed, device=device, warmup=warmup,
+                                quant=quant,
+                                quant_min_agreement=quant_min_agreement)
         if self.config.max_batch > max(lm.runner.buckets):
             raise ValueError(
                 f"max_batch {self.config.max_batch} exceeds the largest "

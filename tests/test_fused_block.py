@@ -1,0 +1,273 @@
+"""Fused conv→relu→LRN→max-pool tower block (ops/fused_block.py +
+core/net.py's SPARKNET_FUSED_BLOCKS pass).
+
+The Pallas kernel runs in interpret mode on the CPU test platform; its
+forward AND custom-VJP backward must match the stock composed ops
+(themselves validated against the reference formulas, lrn_layer.cpp:
+88-119 and pooling_layer.cpp:155-169).  The net-level pass is pinned
+bitwise: fused-xla AlexNet must produce the exact bits of the unfused
+net, because `xla` mode composes the same stock ops inside one layer fn.
+"""
+
+import subprocess
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from sparknet_tpu.ops import fused_block as fb
+from sparknet_tpu.ops.activations import relu
+from sparknet_tpu.ops.lrn import lrn_across_channels
+from sparknet_tpu.ops.pooling import max_pool
+
+
+def _composed_tail(x, local_size, alpha, beta, k, relu_slope,
+                   pool_kernel, pool_stride, pool_pad):
+    if relu_slope is not None:
+        x = relu(x, relu_slope)
+    x = lrn_across_channels(x, local_size, alpha=alpha, beta=beta, k=k)
+    return max_pool(x, pool_kernel, stride=pool_stride, pad=pool_pad)
+
+
+# geometry sweep: AlexNet norm1 (55x55 odd, k3 s2 ceil-mode trailing
+# window), padded pool, even kernel, leaky relu, no relu, small windows
+_GEOMS = [
+    dict(shape=(2, 8, 13, 13), local_size=5, relu_slope=0.0,
+         pool_kernel=(3, 3), pool_stride=(2, 2), pool_pad=(0, 0)),
+    dict(shape=(1, 16, 55, 55), local_size=5, relu_slope=0.0,
+         pool_kernel=(3, 3), pool_stride=(2, 2), pool_pad=(0, 0)),
+    dict(shape=(2, 8, 9, 11), local_size=3, relu_slope=0.1,
+         pool_kernel=(3, 3), pool_stride=(2, 2), pool_pad=(1, 1)),
+    dict(shape=(2, 8, 8, 8), local_size=4, relu_slope=None,
+         pool_kernel=(2, 2), pool_stride=(2, 2), pool_pad=(0, 0)),
+    dict(shape=(1, 8, 7, 7), local_size=5, relu_slope=0.0,
+         pool_kernel=(3, 3), pool_stride=(1, 1), pool_pad=(0, 0)),
+]
+
+
+@pytest.mark.parametrize("g", _GEOMS)
+def test_fused_tail_forward_matches_composed(rng, g):
+    x = jnp.asarray(rng.randn(*g["shape"]).astype(np.float32))
+    want = _composed_tail(x, g["local_size"], 1e-4, 0.75, 1.0,
+                          g["relu_slope"], g["pool_kernel"],
+                          g["pool_stride"], g["pool_pad"])
+    got = fb.fused_tail_pallas(x, g["local_size"], 1e-4, 0.75, 1.0,
+                               g["relu_slope"], g["pool_kernel"],
+                               g["pool_stride"], g["pool_pad"], True)
+    assert got.shape == want.shape
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-6, atol=1e-6)
+
+
+@pytest.mark.parametrize("g", _GEOMS)
+def test_fused_tail_backward_matches_composed(rng, g):
+    x = jnp.asarray(rng.randn(*g["shape"]).astype(np.float32))
+
+    def via_fused(x):
+        return jnp.sum(jnp.square(fb.fused_tail_pallas(
+            x, g["local_size"], 2e-4, 0.75, 2.0, g["relu_slope"],
+            g["pool_kernel"], g["pool_stride"], g["pool_pad"], True)))
+
+    def via_composed(x):
+        return jnp.sum(jnp.square(_composed_tail(
+            x, g["local_size"], 2e-4, 0.75, 2.0, g["relu_slope"],
+            g["pool_kernel"], g["pool_stride"], g["pool_pad"])))
+
+    np.testing.assert_allclose(np.asarray(jax.grad(via_fused)(x)),
+                               np.asarray(jax.grad(via_composed)(x)),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_fused_tail_pallas_check_grads(rng):
+    """Numerical gradient check of the custom VJP (the contract
+    test_ops_grad_coverage enforces for every custom_vjp op).  Values
+    are well-separated so the finite-difference probe cannot cross a
+    max-pool tie or the relu kink."""
+    from jax.test_util import check_grads
+
+    base = rng.permutation(np.arange(2 * 8 * 6 * 6)).astype(np.float32)
+    x = jnp.asarray(0.2 + 0.01 * base.reshape(2, 8, 6, 6))  # all > 0
+
+    def f(x):
+        return fb.fused_tail_pallas(x, 5, 1e-2, 0.75, 1.0, 0.0,
+                                    (3, 3), (2, 2), (0, 0), True)
+
+    check_grads(f, (x,), order=1, modes=["rev"], atol=5e-2, rtol=5e-2,
+                eps=1e-3)
+
+
+def test_fused_tail_bf16_dtype(rng):
+    x = jnp.asarray(rng.randn(1, 16, 6, 6).astype(np.float32),
+                    dtype=jnp.bfloat16)
+    got = fb.fused_tail_pallas(x, 5, 1e-4, 0.75, 1.0, 0.0,
+                               (3, 3), (2, 2), (0, 0), True)
+    assert got.dtype == jnp.bfloat16
+    want = _composed_tail(x.astype(jnp.float32), 5, 1e-4, 0.75, 1.0,
+                          0.0, (3, 3), (2, 2), (0, 0))
+    np.testing.assert_allclose(np.asarray(got, np.float32),
+                               np.asarray(want), rtol=1e-2, atol=1e-2)
+
+
+def test_fused_tail_supported_gate():
+    assert fb.fused_tail_supported(jnp.zeros((1, 96, 4, 4), jnp.float32))
+    assert fb.fused_tail_supported(jnp.zeros((1, 96, 4, 4), jnp.bfloat16))
+    assert not fb.fused_tail_supported(jnp.zeros((1, 12, 4, 4),
+                                                 jnp.float32))
+    assert not fb.fused_tail_supported(jnp.zeros((1, 24, 4, 4),
+                                                 jnp.bfloat16))
+    assert not fb.fused_tail_supported(jnp.zeros((96, 4, 4), jnp.float32))
+
+
+def test_fused_blocks_mode_env(monkeypatch):
+    for unset in (None, "", "0", "off"):
+        if unset is None:
+            monkeypatch.delenv("SPARKNET_FUSED_BLOCKS", raising=False)
+        else:
+            monkeypatch.setenv("SPARKNET_FUSED_BLOCKS", unset)
+        assert fb.fused_blocks_mode() == "off"
+    for mode in ("xla", "pallas"):
+        monkeypatch.setenv("SPARKNET_FUSED_BLOCKS", mode)
+        assert fb.fused_blocks_mode() == mode
+    monkeypatch.setenv("SPARKNET_FUSED_BLOCKS", "bogus")
+    with pytest.raises(ValueError, match="SPARKNET_FUSED_BLOCKS"):
+        fb.fused_blocks_mode()
+
+
+def test_fused_conv_lrn_pool_impl_validation(rng):
+    x = jnp.asarray(rng.randn(1, 3, 8, 8).astype(np.float32))
+    w = jnp.asarray(rng.randn(8, 3, 3, 3).astype(np.float32))
+    with pytest.raises(ValueError, match="impl"):
+        fb.fused_conv_lrn_pool(x, w, impl="bogus")
+
+
+def test_fused_conv_lrn_pool_xla_bitwise_vs_stock(rng):
+    """impl='xla' composes the exact stock ops — bitwise, not allclose."""
+    from sparknet_tpu.ops.conv import conv2d
+
+    x = jnp.asarray(rng.randn(2, 3, 13, 13).astype(np.float32))
+    w = jnp.asarray(rng.randn(8, 3, 3, 3).astype(np.float32))
+    b = jnp.asarray(rng.randn(8).astype(np.float32))
+    got = fb.fused_conv_lrn_pool(
+        x, w, b, stride=(1, 1), pad=(1, 1), relu_slope=0.0,
+        local_size=5, alpha=1e-4, beta=0.75, k=1.0,
+        pool_kernel=(3, 3), pool_stride=(2, 2), impl="xla")
+    y = conv2d(x, w, b, stride=(1, 1), pad=(1, 1))
+    want = _composed_tail(y, 5, 1e-4, 0.75, 1.0, 0.0,
+                          (3, 3), (2, 2), (0, 0))
+    assert np.array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_fused_conv_lrn_pool_pallas_cpu_fallback(rng):
+    """impl='pallas' off-TPU (no interpret override) must fall back to
+    the XLA composition — same bits, no pallas import."""
+    x = jnp.asarray(rng.randn(1, 3, 9, 9).astype(np.float32))
+    w = jnp.asarray(rng.randn(8, 3, 3, 3).astype(np.float32))
+    got = fb.fused_conv_lrn_pool(x, w, impl="pallas")
+    want = fb.fused_conv_lrn_pool(x, w, impl="xla")
+    assert np.array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_fused_out_shape_matches_runtime(rng):
+    x = jnp.asarray(rng.randn(2, 3, 27, 27).astype(np.float32))
+    w = jnp.asarray(rng.randn(16, 3, 5, 5).astype(np.float32))
+    y = fb.fused_conv_lrn_pool(x, w, pad=(2, 2), pool_kernel=(3, 3),
+                               pool_stride=(2, 2), impl="xla")
+    assert y.shape == fb.fused_out_shape(
+        (2, 3, 27, 27), 16, (5, 5), (2, 2), (1, 1), (1, 1),
+        (3, 3), (0, 0), (2, 2))
+
+
+# ------------------------------------------------------- graph matcher
+
+def _alexnet_net(monkeypatch, mode):
+    from sparknet_tpu.core.net import Net
+    from sparknet_tpu.models import get_model
+
+    if mode is None:
+        monkeypatch.delenv("SPARKNET_FUSED_BLOCKS", raising=False)
+    else:
+        monkeypatch.setenv("SPARKNET_FUSED_BLOCKS", mode)
+    return Net(get_model("alexnet", batch=2, n_classes=10, crop=67,
+                         deploy=True), "TEST")
+
+
+def test_matcher_finds_both_alexnet_stages(monkeypatch):
+    net = _alexnet_net(monkeypatch, "xla")
+    assert [m["name"] for m in net.fused_blocks] == ["conv1", "conv2"]
+    assert net.fused_blocks[0]["layers"] == ["conv1", "relu1", "norm1",
+                                             "pool1"]
+    assert net.fused_blocks[0]["impl"] == "xla"
+    types = [bl.type for bl in net.layers]
+    assert types.count("FusedConvLRNPool") == 2
+    # the three tail layers of each stage are gone from the layer list
+    names = [bl.name for bl in net.layers]
+    for gone in ("relu1", "norm1", "pool1", "relu2", "norm2", "pool2"):
+        assert gone not in names
+    off = _alexnet_net(monkeypatch, None)
+    assert off.fused_blocks == []
+    assert len(net.layers) == len(off.layers) - 6
+
+
+def test_matcher_skips_caffenet_pool_before_norm(monkeypatch):
+    """CaffeNet orders conv→relu→pool→norm: no fusable tail exists, and
+    the matcher must not force one."""
+    from sparknet_tpu.core.net import Net
+    from sparknet_tpu.models import get_model
+
+    monkeypatch.setenv("SPARKNET_FUSED_BLOCKS", "xla")
+    net = Net(get_model("caffenet", batch=2, n_classes=10, crop=67,
+                        deploy=True), "TEST")
+    assert net.fused_blocks == []
+
+
+def test_fused_net_forward_bitwise_and_grads(rng, monkeypatch):
+    """Fused-xla AlexNet: same bits forward, same grads, same param
+    keys (checkpoints interchange); pallas mode on CPU falls back to
+    the identical composition."""
+    base = _alexnet_net(monkeypatch, None)
+    fused = _alexnet_net(monkeypatch, "xla")
+    pallas = _alexnet_net(monkeypatch, "pallas")
+    params = base.init_params(seed=0)
+    assert set(params) == set(fused.init_params(seed=0))
+    x = jnp.asarray(rng.randn(2, 3, 67, 67).astype(np.float32))
+    feed = {"data": x}
+    want = base.forward(params, feed)
+    got = fused.forward(params, feed)
+    got_p = pallas.forward(params, feed)
+    out = [b for b in base.blob_shapes if b.startswith("prob")][0]
+    assert np.array_equal(np.asarray(want[out]), np.asarray(got[out]))
+    assert np.array_equal(np.asarray(want[out]), np.asarray(got_p[out]))
+
+    def loss(net_):
+        def f(p):
+            return jnp.sum(jnp.square(net_.forward(p, feed)[out]))
+        return f
+
+    g_base = jax.grad(loss(base))(params)
+    g_fused = jax.grad(loss(fused))(params)
+    for k in g_base:
+        np.testing.assert_allclose(np.asarray(g_fused[k]),
+                                   np.asarray(g_base[k]),
+                                   rtol=1e-5, atol=1e-6)
+
+
+def test_default_path_keeps_pallas_unimported():
+    """Importing ops.fused_block and running the xla path must not drag
+    jax.experimental.pallas in (the ops.lrn deferred-import contract)."""
+    code = (
+        "import jax; jax.config.update('jax_platforms', 'cpu')\n"
+        "import sys, numpy as np, jax.numpy as jnp\n"
+        "from sparknet_tpu.ops import fused_block as fb\n"
+        "x = jnp.asarray(np.ones((1, 3, 8, 8), np.float32))\n"
+        "w = jnp.asarray(np.ones((8, 3, 3, 3), np.float32))\n"
+        "fb.fused_conv_lrn_pool(x, w, impl='xla')\n"
+        "fb.fused_conv_lrn_pool(x, w, impl='pallas')  # CPU fallback\n"
+        "assert not any('pallas' in m for m in sys.modules), "
+        "[m for m in sys.modules if 'pallas' in m]\n"
+        "print('clean')\n")
+    r = subprocess.run([sys.executable, "-c", code], capture_output=True,
+                       timeout=240)
+    assert r.returncode == 0, r.stderr.decode()
+    assert b"clean" in r.stdout

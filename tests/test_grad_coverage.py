@@ -1,0 +1,140 @@
+"""Gradient-coverage contract for hand-written backward passes.
+
+Every jax.custom_vjp op in sparknet_tpu/ops/ carries a hand-derived
+backward; a silent sign or transpose error there corrupts training
+while every forward-only test stays green.  The static scan pins the
+contract: each such op must be exercised by a numerical
+jax.test_util.check_grads test somewhere in tests/ (analytic-vs-
+finite-difference, the one test shape that catches a wrong backward),
+or carry an explicit documented exemption here.
+
+Same style for env knobs: every SPARKNET_* knob the package reads must
+be documented in README.md, so a new knob cannot ship invisible
+(test_obs.py's allowlist pattern).
+"""
+
+import os
+import re
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.test_util import check_grads
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# custom_vjp ops whose backward is intentionally NOT the true gradient,
+# with why — anything else found undecorated by a check_grads test fails
+_CHECK_GRADS_EXEMPT = {
+    # AVE-style uniform routing, ATTRIBUTION ONLY: deliberately wrong
+    # gradients to isolate SelectAndScatter cost (ops/pooling.py study)
+    "_max_pool_uniform_bwd",
+}
+
+
+def _custom_vjp_ops():
+    """(op_name, file) for every custom_vjp-decorated def in ops/."""
+    ops_dir = os.path.join(REPO, "sparknet_tpu", "ops")
+    found = []
+    for fn in sorted(os.listdir(ops_dir)):
+        if not fn.endswith(".py"):
+            continue
+        src = open(os.path.join(ops_dir, fn)).read()
+        # the decorator may span lines (functools.partial(...)); grab
+        # the first def after each custom_vjp mention
+        for m in re.finditer(r"custom_vjp", src):
+            d = re.search(r"\ndef\s+(\w+)", src[m.end():])
+            if d:
+                found.append((d.group(1), fn))
+    return found
+
+
+def test_every_custom_vjp_op_has_check_grads_test():
+    ops = _custom_vjp_ops()
+    assert len(ops) >= 5  # the scan itself must keep finding them
+    tests_dir = os.path.dirname(os.path.abspath(__file__))
+    sources = {}
+    for fn in os.listdir(tests_dir):
+        if fn.endswith(".py"):
+            sources[fn] = open(os.path.join(tests_dir, fn)).read()
+    missing = []
+    for name, where in ops:
+        if name in _CHECK_GRADS_EXEMPT:
+            continue
+        covered = any("check_grads" in src and name in src
+                      for src in sources.values())
+        if not covered:
+            missing.append(f"{where}:{name}")
+    assert not missing, (
+        f"custom_vjp ops without a check_grads test (add one, or add an "
+        f"explicit exemption with a reason): {missing}")
+
+
+def test_every_env_knob_documented_in_readme():
+    pkg = os.path.join(REPO, "sparknet_tpu")
+    knobs = set()
+    for dirpath, _dirs, files in os.walk(pkg):
+        for fn in files:
+            if fn.endswith(".py"):
+                src = open(os.path.join(dirpath, fn)).read()
+                knobs.update(re.findall(r"SPARKNET_[A-Z0-9_]+", src))
+    readme = open(os.path.join(REPO, "README.md")).read()
+    undocumented = sorted(k for k in knobs if k not in readme)
+    assert not undocumented, (
+        f"env knobs read by the package but missing from README.md: "
+        f"{undocumented}")
+
+
+# ------------------------- the numerical checks the static scan demands
+
+def _distinct_grid(rng, shape, step=0.01):
+    """Well-separated values: no max-pool ties, and gaps far above the
+    finite-difference eps so the probe cannot cross a tie boundary."""
+    n = int(np.prod(shape))
+    return jnp.asarray((0.1 + step * rng.permutation(n)
+                        .astype(np.float32)).reshape(shape))
+
+
+def test_max_pool_check_grads(rng):
+    from sparknet_tpu.ops.pooling import _max_pool
+
+    x = _distinct_grid(rng, (2, 3, 7, 7))
+    check_grads(lambda x: _max_pool(x, (3, 3), (2, 2), (0, 0)), (x,),
+                order=1, modes=["rev"], atol=1e-2, rtol=1e-2, eps=1e-3)
+
+
+def test_max_pool_residue_check_grads(rng):
+    from sparknet_tpu.ops.pooling import _max_pool_residue
+
+    x = _distinct_grid(rng, (2, 3, 7, 9))
+    check_grads(lambda x: _max_pool_residue(x, (3, 3), (2, 2), (1, 1)),
+                (x,), order=1, modes=["rev"], atol=1e-2, rtol=1e-2,
+                eps=1e-3)
+
+
+def test_lrn_pallas_check_grads(rng):
+    from sparknet_tpu.ops.pallas_lrn import lrn_across_channels_pallas
+
+    x = jnp.asarray(rng.randn(2, 8, 3, 5).astype(np.float32))
+    check_grads(
+        lambda x: lrn_across_channels_pallas(x, 5, 1e-2, 0.75, 1.0, True),
+        (x,), order=1, modes=["rev"], atol=5e-2, rtol=5e-2, eps=1e-3)
+
+
+def test_max_pool_impl_dispatch_gradients_agree(rng):
+    """The selectable backward formulations (SPARKNET_MAXPOOL_BWD) must
+    route gradients identically on tie-free input."""
+    from sparknet_tpu.ops.pooling import (_max_pool, _max_pool_raw,
+                                          _max_pool_residue)
+
+    x = _distinct_grid(rng, (2, 4, 9, 9))
+
+    def g(f):
+        return jax.grad(lambda x: jnp.sum(
+            jnp.square(f(x, (3, 3), (2, 2), (0, 0)))))(x)
+
+    want = np.asarray(g(_max_pool_raw))
+    np.testing.assert_allclose(np.asarray(g(_max_pool)), want,
+                               rtol=1e-6, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(g(_max_pool_residue)), want,
+                               rtol=1e-6, atol=1e-6)

@@ -1,0 +1,88 @@
+"""SPARKNET_LRN_IMPL dispatch contract (ops/lrn.py).
+
+Three pins: an invalid value dies with a ValueError naming the knob (not
+a silent fallback to the default impl); the matmul and xla formulations
+agree BITWISE on integer-valued inputs (their window sums are exact in
+f32, so any bit difference would mean the formulations diverge
+algebraically, not just in rounding); and the default/xla/matmul paths
+never import jax.experimental.pallas (the deferred-import contract that
+keeps pallas off the portable path, shared by ops/fused_block.py).
+"""
+
+import importlib
+import subprocess
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+# NOT `from sparknet_tpu.ops import lrn`: the package re-exports the
+# lrn FUNCTION under that name, shadowing the module
+lrn_mod = importlib.import_module("sparknet_tpu.ops.lrn")
+
+
+def test_invalid_impl_raises(monkeypatch):
+    monkeypatch.setenv("SPARKNET_LRN_IMPL", "cudnn")
+    x = jnp.ones((1, 8, 2, 2), jnp.float32)
+    with pytest.raises(ValueError, match="SPARKNET_LRN_IMPL"):
+        lrn_mod.lrn(x, 5, 1e-4, 0.75, 1.0)
+
+
+def test_default_impl_is_backend_dependent(monkeypatch):
+    monkeypatch.delenv("SPARKNET_LRN_IMPL", raising=False)
+    want = "matmul" if jax.default_backend() == "tpu" else "xla"
+    assert lrn_mod._pick_impl() == want
+
+
+@pytest.mark.parametrize("local_size", [5, 3, 4])
+def test_matmul_xla_bitwise_on_integer_inputs(rng, monkeypatch,
+                                              local_size):
+    """Integer x with alpha/local_size exact: every window sum is an
+    exactly-representable integer in f32 whatever the summation order,
+    and both impls share _powm — so the outputs must match to the BIT."""
+    x = jnp.asarray(rng.randint(-7, 8, size=(2, 13, 3, 5))
+                    .astype(np.float32))
+    alpha = float(local_size)  # alpha/local_size == 1.0 exactly
+    monkeypatch.setenv("SPARKNET_LRN_IMPL", "xla")
+    want = lrn_mod.lrn(x, local_size, alpha, 0.75, 1.0)
+    monkeypatch.setenv("SPARKNET_LRN_IMPL", "matmul")
+    got = lrn_mod.lrn(x, local_size, alpha, 0.75, 1.0)
+    assert np.array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_matmul_xla_close_on_real_inputs(rng, monkeypatch):
+    x = jnp.asarray(rng.randn(2, 16, 4, 6).astype(np.float32))
+    monkeypatch.setenv("SPARKNET_LRN_IMPL", "xla")
+    want = lrn_mod.lrn(x, 5, 1e-4, 0.75, 1.0)
+    monkeypatch.setenv("SPARKNET_LRN_IMPL", "matmul")
+    got = lrn_mod.lrn(x, 5, 1e-4, 0.75, 1.0)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-6, atol=1e-6)
+
+
+def test_default_and_matmul_paths_keep_pallas_unimported():
+    """lrn() under the default and explicit non-pallas impls must not
+    import jax.experimental.pallas; only SPARKNET_LRN_IMPL=pallas may
+    (and then lazily, inside the call)."""
+    code = (
+        "import jax; jax.config.update('jax_platforms', 'cpu')\n"
+        "import os, sys, numpy as np, jax.numpy as jnp\n"
+        "from sparknet_tpu.ops.lrn import lrn\n"
+        "x = jnp.asarray(np.ones((1, 8, 2, 2), np.float32))\n"
+        "lrn(x, 5, 1e-4, 0.75, 1.0)\n"
+        "os.environ['SPARKNET_LRN_IMPL'] = 'matmul'\n"
+        "lrn(x, 5, 1e-4, 0.75, 1.0)\n"
+        "os.environ['SPARKNET_LRN_IMPL'] = 'xla'\n"
+        "lrn(x, 5, 1e-4, 0.75, 1.0)\n"
+        "assert not any('pallas' in m for m in sys.modules), "
+        "[m for m in sys.modules if 'pallas' in m]\n"
+        "os.environ['SPARKNET_LRN_IMPL'] = 'pallas'\n"
+        "lrn(x, 5, 1e-4, 0.75, 1.0)\n"
+        "assert any('pallas' in m for m in sys.modules)\n"
+        "print('deferral ok')\n")
+    r = subprocess.run([sys.executable, "-c", code], capture_output=True,
+                       timeout=240)
+    assert r.returncode == 0, r.stderr.decode()
+    assert b"deferral ok" in r.stdout

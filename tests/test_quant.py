@@ -1,0 +1,206 @@
+"""Quantized serving forward (ops/quant.py + serving/quant.py +
+ModelRunner's quant modes).
+
+Pins the PR's acceptance bar: int8 (w8a16) and bf16 serving top-1
+agreement vs the fp32 master stays >= 0.99 on seeded synthetic batches
+AND on a structured class-conditional set (the accuracy_run.py
+brightness-block construction, reshaped to the model input), the packed
+param bytes actually shrink (fp32 > bf16 > int8), the compile count
+stays the bucket count (calibration reuses the largest bucket's
+program), and a failed calibration floor dies at LOAD time.
+"""
+
+import json
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from sparknet_tpu.ops.quant import (INT8_LEVELS, dequantize_int8,
+                                    quantize_per_channel_int8,
+                                    top1_agreement)
+from sparknet_tpu.serving.engine import ModelRunner, resolve_net_param
+from sparknet_tpu.serving.quant import (build_quantized_params,
+                                        quantized_bytes,
+                                        validate_quant_mode)
+
+
+# ------------------------------------------------------------- ops level
+
+def test_quantize_per_channel_roundtrip_bound(rng):
+    w = jnp.asarray(rng.randn(6, 5, 3, 3).astype(np.float32)) * 3.0
+    q, scale = quantize_per_channel_int8(w)
+    assert q.dtype == jnp.int8 and scale.shape == (6,)
+    assert int(jnp.max(jnp.abs(q.astype(jnp.int32)))) <= INT8_LEVELS
+    deq = dequantize_int8(q, scale, dtype=jnp.float32)
+    # symmetric round-to-nearest: error at most half a step per channel
+    # (plus f32 rounding of the w/scale quotient and the product)
+    err = jnp.max(jnp.abs(deq - w), axis=(1, 2, 3))
+    assert np.all(np.asarray(err)
+                  <= np.asarray(scale) * 0.501 + 1e-6)
+
+
+def test_quantize_zero_channel_is_inert(rng):
+    w = jnp.asarray(rng.randn(3, 4).astype(np.float32))
+    w = w.at[1].set(0.0)
+    q, scale = quantize_per_channel_int8(w)
+    assert float(scale[1]) == 1.0  # no divide-by-zero sentinel
+    assert np.all(np.asarray(q[1]) == 0)
+    deq = dequantize_int8(q, scale, dtype=jnp.float32)
+    assert np.all(np.asarray(deq[1]) == 0.0)
+
+
+def test_top1_agreement():
+    a = np.asarray([[0.9, 0.1], [0.2, 0.8], [0.6, 0.4]], np.float32)
+    b = np.asarray([[0.8, 0.2], [0.7, 0.3], [0.5, 0.5]], np.float32)
+    assert top1_agreement(a, a) == 1.0
+    # row 0 agrees (0 vs 0), row 1 flips (1 vs 0), row 2's b is a tie
+    # resolved first-index like np.argmax (0 vs 0): 2/3
+    assert abs(top1_agreement(a, b) - (2.0 / 3.0)) < 1e-6
+
+
+def test_validate_quant_mode():
+    assert validate_quant_mode(None) == "fp32"
+    assert validate_quant_mode("bf16") == "bf16"
+    with pytest.raises(ValueError, match="quant mode"):
+        validate_quant_mode("int4")
+
+
+def test_build_quantized_params_modes(rng):
+    params = {"conv_w": jnp.asarray(rng.randn(4, 3, 3, 3)
+                                    .astype(np.float32)),
+              "bias": jnp.asarray(rng.randn(4).astype(np.float32)),
+              "count": jnp.asarray(np.int32(7))}
+    fp, deq = build_quantized_params(params, "fp32")
+    assert fp["conv_w"].dtype == jnp.float32 and deq(fp) is fp
+
+    bf, deq_bf = build_quantized_params(params, "bf16")
+    assert bf["conv_w"].dtype == jnp.bfloat16
+    assert bf["count"].dtype == jnp.int32  # non-floats pass through
+    assert deq_bf(bf)["conv_w"].dtype == jnp.bfloat16
+
+    q8, deq8 = build_quantized_params(params, "int8")
+    assert q8["conv_w"]["q"].dtype == jnp.int8  # ndim>=2 packed
+    assert q8["bias"].dtype == jnp.bfloat16     # 1-D rides as bf16
+    out = deq8(q8)
+    assert out["conv_w"].dtype == jnp.bfloat16
+    assert out["conv_w"].shape == params["conv_w"].shape
+
+    assert quantized_bytes(fp) > quantized_bytes(bf) > quantized_bytes(q8)
+
+
+# ---------------------------------------------------------- engine level
+
+@pytest.fixture(scope="module")
+def runners():
+    net = lambda: resolve_net_param("lenet", max_batch=4)  # noqa: E731
+    out = {}
+    for mode in ("fp32", "bf16", "int8"):
+        r = ModelRunner(net(), max_batch=4, seed=0, quant=mode)
+        r.warmup()
+        out[mode] = r
+    return out
+
+
+def test_quant_agreement_floor_pinned(runners):
+    """The acceptance bar: >= 0.99 top-1 agreement at calibration."""
+    assert runners["fp32"].quant_agreement is None
+    for mode in ("bf16", "int8"):
+        assert runners[mode].quant_agreement is not None
+        assert runners[mode].quant_agreement >= 0.99
+
+
+def test_quant_agreement_on_structured_synthetic_set(runners, rng):
+    """Class-conditional brightness-block samples (the accuracy_run.py
+    synthetic construction, shaped to the model input): quantized and
+    fp32 forwards must still pick the same top-1 on >= 99% of them."""
+    shape = runners["fp32"].sample_shape
+    n = 64
+    x = rng.rand(n, *shape).astype(np.float32) * 0.1
+    flat = x.reshape(n, -1)
+    blk = flat.shape[1] // 8
+    for i in range(n):
+        c = i % 8
+        # block amplitude well above the noise floor so the random-init
+        # net's argmaxes are decisive, not coin flips a bf16 rounding
+        # could legitimately flip
+        flat[i, c * blk:(c + 1) * blk] += 1.0
+    ref = runners["fp32"].forward_padded(x[:4])
+    for mode in ("bf16", "int8"):
+        agree = []
+        for s in range(0, n, 4):
+            a = runners["fp32"].forward_padded(x[s:s + 4])
+            b = runners[mode].forward_padded(x[s:s + 4])
+            agree.append(top1_agreement(a, b))
+        assert float(np.mean(agree)) >= 0.99, (mode, agree)
+    assert ref.dtype == np.float32
+
+
+def test_quant_output_dtype_and_compiles(runners):
+    for mode in ("bf16", "int8"):
+        r = runners[mode]
+        out = r.forward_padded(
+            np.zeros((2,) + r.sample_shape, np.float32))
+        assert out.dtype == np.float32  # scores come back f32 always
+        # calibration + warmup together cost exactly one program per
+        # bucket — calibration reuses the largest bucket's compile
+        assert r.compile_count() == len(r.buckets)
+        d = r.describe()
+        assert d["quant"] == mode and d["quant_agreement"] >= 0.99
+
+
+def test_quant_param_bytes_shrink(runners):
+    assert (runners["fp32"].param_bytes > runners["bf16"].param_bytes
+            > runners["int8"].param_bytes)
+
+
+def test_quant_min_agreement_floor_fails_load():
+    with pytest.raises(ValueError, match="calibration failed"):
+        ModelRunner(resolve_net_param("lenet", max_batch=2),
+                    max_batch=2, quant="int8",
+                    quant_min_agreement=1.01)  # unattainable by design
+
+
+# -------------------------------------------------- registry + server + CLI
+
+def test_registry_load_reload_keeps_quant():
+    from sparknet_tpu.serving.registry import ModelRegistry
+
+    reg = ModelRegistry()
+    lm = reg.load("m", "lenet", max_batch=2, quant="int8",
+                  quant_min_agreement=0.99)
+    assert lm.runner.quant == "int8"
+    first_agreement = lm.runner.quant_agreement
+    assert first_agreement is not None
+    lm2 = reg.reload("m")
+    assert lm2.generation == 1
+    assert lm2.runner.quant == "int8"  # kwargs recorded, recalibrated
+    assert lm2.runner.quant_agreement is not None
+    stats = reg.stats()["m"]
+    assert stats["engine_quant"] == "int8"
+    assert stats["engine_quant_agreement"] >= 0.99
+
+
+def test_cli_serve_quant(tmp_path, capsys):
+    import argparse
+
+    from sparknet_tpu.serving import cli as serving_cli
+
+    sample = np.zeros((1, 28, 28), np.float32).tolist()
+    req = tmp_path / "req.jsonl"
+    req.write_text("".join(json.dumps({"id": i, "data": sample}) + "\n"
+                           for i in range(3)))
+    out = tmp_path / "resp.jsonl"
+    parser = argparse.ArgumentParser()
+    sub = parser.add_subparsers()
+    serving_cli.register(sub)
+    args = parser.parse_args(
+        ["serve", "--model", "lenet", "--quant", "int8", "--max_batch",
+         "2", "--input", str(req), "--output", str(out)])
+    assert args.quant_min_agreement == 0.99  # the default floor
+    assert args.fn(args) == 0
+    lines = [json.loads(ln) for ln in out.read_text().splitlines()]
+    assert [ln["id"] for ln in lines] == [0, 1, 2]
+    assert all("argmax" in ln for ln in lines)
+    banner = capsys.readouterr().err
+    assert "quant int8" in banner and "top-1 agreement" in banner
