@@ -304,7 +304,8 @@ def bench_inference(name, model_dir, batch, fuse_1x1=False):
 def bench_serving(model: str = "lenet", offered_qps: float = 200.0,
                   n_requests: int = 400, max_batch: int = 8,
                   max_wait_ms: float = 4.0, seed: int = 0,
-                  quant: str = None) -> dict:
+                  quant: str = None, min_fill: int = None,
+                  replicas: int = None) -> dict:
     """Online-serving latency + throughput at a fixed offered load: the
     serving engine (sparknet_tpu/serving/) fronting LeNet on the CPU
     backend, driven open-loop with Poisson arrivals — p50/p99 response
@@ -327,14 +328,20 @@ def bench_serving(model: str = "lenet", offered_qps: float = 200.0,
                                       ServerOverloaded)
 
     try:
-        cpu = jax.devices("cpu")[0]
+        cpus = jax.devices("cpu")
     except RuntimeError:
-        cpu = None  # CPU backend unavailable: serve on the default device
-    server = InferenceServer(ServerConfig(max_batch=max_batch,
-                                          max_wait_ms=max_wait_ms,
-                                          queue_depth=16 * max_batch))
+        cpus = None  # CPU backend unavailable: serve on the default device
+    cfg = ServerConfig(max_batch=max_batch, max_wait_ms=max_wait_ms,
+                       queue_depth=16 * max_batch)
+    if min_fill is not None:
+        cfg.min_fill = min_fill
+    server = InferenceServer(cfg, devices=cpus)
     try:
-        lm = server.load(model, device=cpu, quant=quant)
+        if replicas is not None and replicas != 1:
+            lm = server.load(model, quant=quant, replicas=replicas)
+        else:
+            lm = server.load(model, device=cpus[0] if cpus else None,
+                             quant=quant)
         shape = lm.runner.sample_shape
         rng = np.random.RandomState(seed)
         pool = rng.rand(32, *shape).astype(np.float32)
@@ -366,10 +373,104 @@ def bench_serving(model: str = "lenet", offered_qps: float = 200.0,
            f"{pfx}_p99_ms": st["total_ms"]["p99_ms"],
            f"{pfx}_batch_occupancy": st["batch_occupancy_mean"],
            f"{pfx}_rejected": rejected,
-           f"{pfx}_compiles": st["engine_compiles"]}
+           f"{pfx}_compiles": st["engine_compiles"],
+           f"{pfx}_replicas": lm.n_replicas,
+           f"{pfx}_topology": _serving_topology(cpus)}
     if pfx != "serving":
         out[f"{pfx}_agreement"] = lm.runner.quant_agreement
         out[f"{pfx}_param_bytes"] = lm.runner.param_bytes
+    log(json.dumps(out))
+    return out
+
+
+def _serving_topology(devices) -> str:
+    """'8xcpu'-style mesh stamp for serving records: device count x
+    platform of the pool serving replicas place on."""
+    if not devices:
+        return "0xnone"
+    return f"{len(devices)}x{getattr(devices[0], 'platform', 'unknown')}"
+
+
+def bench_serving_mesh(model: str = "lenet", n_requests: int = 192,
+                       max_batch: int = 8, seed: int = 0,
+                       replicas: int = 0, rounds: int = 3) -> dict:
+    """Mesh-replicated vs single-replica serving, interleaved A/B: the
+    SAME closed-loop burst (n_requests admitted with backpressure, wait
+    for every response) alternates between a one-replica server and a
+    server whose model is placed across every CPU device (replicas=0 =
+    one per device), `rounds` times A/B/A/B so tunnel-noise-style drift
+    hits both arms equally (CLAUDE.md measurement discipline; this leg
+    is CPU-only so the main noise source is host contention itself).
+
+    QPS is the median over rounds; latency percentiles pool all rounds.
+    `serving_mesh_speedup` is the honest ratio — on a single-core host
+    the N virtual devices share one core, so the mesh arm mostly
+    measures scheduler overhead there (the ≥4x ROADMAP target needs N
+    real cores/chips; BENCH_NOTES.md records what this box can show)."""
+    import jax
+
+    from sparknet_tpu.serving import InferenceServer, ServerConfig
+
+    try:
+        devs = jax.devices("cpu")
+    except RuntimeError:
+        devs = jax.devices()
+    n_rep = len(devs) if replicas == 0 else int(replicas)
+
+    def make(n):
+        srv = InferenceServer(
+            ServerConfig(max_batch=max_batch,
+                         queue_depth=max(2 * n_requests, 64)),
+            devices=devs)
+        if n == 1:
+            lm = srv.load(model, device=devs[0])
+        else:
+            lm = srv.load(model, replicas=n)
+        return srv, lm
+
+    single, lm1 = make(1)
+    mesh, lmN = make(n_rep)
+    shape = lm1.runner.sample_shape
+    pool = np.random.RandomState(seed).rand(
+        64, *shape).astype(np.float32)
+    reqs = [pool[i % len(pool)] for i in range(n_requests)]
+
+    def measure(srv):
+        t0 = time.perf_counter()
+        futs = srv.submit_many(model, reqs, wait=True)
+        lat = [f.result(timeout=600).total_ms for f in futs]
+        return n_requests / (time.perf_counter() - t0), lat
+
+    qps1, qpsN, lat1, latN = [], [], [], []
+    try:
+        for _ in range(max(1, int(rounds))):
+            q, l = measure(single)
+            qps1.append(q)
+            lat1 += l
+            q, l = measure(mesh)
+            qpsN.append(q)
+            latN += l
+        compiles = max(r.compile_count() for r in lmN.replicas)
+    finally:
+        single.close(drain=True)
+        mesh.close(drain=True)
+    q1 = float(np.median(qps1))
+    qN = float(np.median(qpsN))
+    out = {"serving_mesh_model": model,
+           "serving_mesh_replicas": lmN.n_replicas,
+           "serving_mesh_topology": _serving_topology(devs),
+           "serving_mesh_rounds": int(rounds),
+           "serving_mesh_n_requests": int(n_requests),
+           "serving_mesh_qps": round(qN, 1),
+           "serving_mesh_p50_ms": round(float(np.percentile(latN, 50)), 3),
+           "serving_mesh_p99_ms": round(float(np.percentile(latN, 99)), 3),
+           "serving_single_qps": round(q1, 1),
+           "serving_single_p50_ms": round(float(np.percentile(lat1, 50)),
+                                          3),
+           "serving_single_p99_ms": round(float(np.percentile(lat1, 99)),
+                                          3),
+           "serving_mesh_speedup": round(qN / q1, 3) if q1 else None,
+           "serving_mesh_compiles": compiles}
     log(json.dumps(out))
     return out
 
@@ -635,6 +736,17 @@ _KNOWN_FIELDS = {
     "serving_int8_batch_occupancy", "serving_int8_rejected",
     "serving_int8_compiles", "serving_int8_agreement",
     "serving_int8_param_bytes",
+    # mesh-serving stamps (schema v3): every serving record carries its
+    # replica count + device topology; the serving_mesh leg lands the
+    # interleaved single-vs-mesh A/B
+    "serving_replicas", "serving_topology",
+    "serving_int8_replicas", "serving_int8_topology",
+    "serving_mesh_model", "serving_mesh_replicas",
+    "serving_mesh_topology", "serving_mesh_rounds",
+    "serving_mesh_n_requests", "serving_mesh_qps",
+    "serving_mesh_p50_ms", "serving_mesh_p99_ms",
+    "serving_single_qps", "serving_single_p50_ms", "serving_single_p99_ms",
+    "serving_mesh_speedup", "serving_mesh_compiles",
 }
 
 # every leg name main() lands; leg_utc stamps outside this set (renamed
@@ -643,7 +755,7 @@ _KNOWN_FIELDS = {
 _KNOWN_LEGS = {
     "alexnet_train", "googlenet_train_b64", "googlenet_train_b128",
     "alexnet_infer", "googlenet_infer", "longctx_lm", "cifar_e2e",
-    "imagenet_native", "serving", "serving_int8",
+    "imagenet_native", "serving", "serving_int8", "serving_mesh",
 }
 
 
@@ -726,7 +838,8 @@ def _stale_record(reason: str) -> dict:
     return stale
 
 
-BENCH_SCHEMA_VERSION = 2
+BENCH_SCHEMA_VERSION = 3  # v3: serving replica/topology stamps + the
+#                           serving_mesh interleaved A/B leg
 
 # git SHA memo.  main() primes it up front (subprocess, once), so the
 # signal bail handler — which must never reach a subprocess call — can
@@ -1003,7 +1116,8 @@ def _run_legs(land) -> None:
             "serving_model", "serving_offered_qps", "serving_qps",
             "serving_p50_ms", "serving_p99_ms",
             "serving_batch_occupancy", "serving_rejected",
-            "serving_compiles")})
+            "serving_compiles", "serving_replicas",
+            "serving_topology")})
     # quantized serving leg (int8 w8a16, serving/quant.py): same offered
     # load through the packed-weight forward, plus the calibration top-1
     # agreement — latency AND fidelity ride the record together
@@ -1017,6 +1131,22 @@ def _run_legs(land) -> None:
             "serving_int8_p99_ms", "serving_int8_batch_occupancy",
             "serving_int8_rejected", "serving_int8_compiles",
             "serving_int8_agreement", "serving_int8_param_bytes")})
+    # mesh-serving A/B leg (CPU devices; replicas=0 -> one per device).
+    # On a 1-device pool this degenerates to 1-vs-1 and says so in its
+    # replica stamp — still landed, so the record shape is stable
+    try:
+        serving_m = bench_serving_mesh()
+    except Exception as e:
+        log(f"serving_mesh leg failed, omitting its fields: {e!r}")
+    else:
+        land("serving_mesh", {k: serving_m[k] for k in (
+            "serving_mesh_model", "serving_mesh_replicas",
+            "serving_mesh_topology", "serving_mesh_rounds",
+            "serving_mesh_n_requests", "serving_mesh_qps",
+            "serving_mesh_p50_ms", "serving_mesh_p99_ms",
+            "serving_single_qps", "serving_single_p50_ms",
+            "serving_single_p99_ms", "serving_mesh_speedup",
+            "serving_mesh_compiles")})
     try:
         imgnet_native = bench_imagenet_native()
     except Exception as e:

@@ -8,16 +8,25 @@ distribution at `--qps`, submitted on schedule regardless of completions
 — the honest tail-latency protocol: a closed loop self-throttles when
 the server slows down and hides queueing delay).
 
+Traffic can be MIXED across resident models (`--models
+lenet=3,cifar10_quick=1`: weighted selection per request), so mesh-
+placement claims are measured under realistic multi-model contention
+rather than one hot model; the summary then carries per-model p50/p99
+next to the aggregate.  `--replicas N` spreads every loaded model over
+the device mesh (0 = one replica per device).
+
 Prints per-phase progress on stderr and ONE summary JSON line on stdout;
 with `--jsonl out.jsonl` it also appends one record per request (id,
-bucket, queue_wait/assembly/device/total ms, or the rejection error) —
-commit those incrementally (scripts/autocommit_distacc.sh pattern) so a
-box reboot cannot eat an in-flight study.
+model, replica, bucket, queue_wait/assembly/device/total ms, or the
+rejection error) — commit those incrementally
+(scripts/autocommit_distacc.sh pattern) so a box reboot cannot eat an
+in-flight study.
 
 Examples:
     python scripts/serve_loadgen.py --model lenet --mode open --qps 200
-    python scripts/serve_loadgen.py --model lenet --mode closed \
-        --concurrency 16 --requests 2000 --jsonl serve_study.jsonl
+    python scripts/serve_loadgen.py --models lenet=3,cifar10_quick=1 \
+        --mode closed --concurrency 16 --replicas 0 --requests 2000 \
+        --jsonl serve_study.jsonl
 """
 
 import argparse
@@ -30,12 +39,41 @@ import time
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
+def _parse_models(spec: str):
+    """'lenet=3,cifar10_quick=1' -> [(name, weight), ...]; bare names
+    weigh 1."""
+    out = []
+    for part in spec.replace(" ", "").split(","):
+        if not part:
+            continue
+        if "=" in part:
+            name, w = part.split("=", 1)
+            try:
+                weight = float(w)
+            except ValueError:
+                raise SystemExit(f"--models weight {w!r} for {name!r} "
+                                 f"is not a number")
+            if weight <= 0:
+                raise SystemExit(f"--models weight for {name!r} must be "
+                                 f"> 0, got {weight}")
+        else:
+            name, weight = part, 1.0
+        out.append((name, weight))
+    if not out:
+        raise SystemExit("--models parsed to an empty list")
+    return out
+
+
 def main() -> None:
     p = argparse.ArgumentParser(
         description="closed/open-loop load generator for sparknet serve")
-    p.add_argument("--model", default="lenet",
-                   help="zoo name or deploy prototxt path")
-    p.add_argument("--weights", default=None)
+    p.add_argument("--model", default=None,
+                   help="zoo name or deploy prototxt path (single-model)")
+    p.add_argument("--models", default=None,
+                   help="mixed traffic: 'name=weight,name=weight' "
+                        "(weights normalize; bare names weigh 1)")
+    p.add_argument("--weights", default=None,
+                   help="warm-start file (single --model only)")
     p.add_argument("--mode", choices=("closed", "open"), default="open")
     p.add_argument("--qps", type=float, default=200.0,
                    help="offered load (open loop only)")
@@ -46,10 +84,24 @@ def main() -> None:
     p.add_argument("--max_wait_ms", type=float, default=4.0)
     p.add_argument("--queue_depth", type=int, default=128)
     p.add_argument("--deadline_ms", type=float, default=None)
+    p.add_argument("--replicas", type=int, default=None,
+                   help="replicas per model across the device mesh "
+                        "(0 = one per device; default "
+                        "SPARKNET_SERVE_REPLICAS)")
+    p.add_argument("--min_fill", type=int, default=None,
+                   help="batch rows a replica waits for before dispatch "
+                        "(default SPARKNET_SERVE_MIN_FILL, normally 1 = "
+                        "continuous batching)")
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--jsonl", default=None,
                    help="append one record per request to this file")
     a = p.parse_args()
+    if a.model and a.models:
+        raise SystemExit("pass --model OR --models, not both")
+    mix = _parse_models(a.models) if a.models else [(a.model or "lenet",
+                                                     1.0)]
+    if a.weights and len(mix) > 1:
+        raise SystemExit("--weights applies to a single --model only")
 
     from sparknet_tpu.utils.compile_cache import (apply_platform_env,
                                                   maybe_enable_compile_cache)
@@ -74,23 +126,27 @@ def main() -> None:
             sink.write(json.dumps(rec) + "\n")
             sink.flush()
 
-    server = InferenceServer(ServerConfig(
+    cfg = ServerConfig(
         max_batch=a.max_batch, max_wait_ms=a.max_wait_ms,
-        queue_depth=a.queue_depth, default_deadline_ms=a.deadline_ms))
+        queue_depth=a.queue_depth, default_deadline_ms=a.deadline_ms)
+    if a.min_fill is not None:
+        cfg.min_fill = a.min_fill
+    server = InferenceServer(cfg)
     rejects = {"n": 0}
     rejects_lock = threading.Lock()
 
-    def settle(rid, fut, t_submit):
+    def settle(rid, name, fut, t_submit):
         """Wait one future; record its disposition."""
         try:
             r = fut.result(timeout=120)
         except ServingError as e:
             with rejects_lock:
                 rejects["n"] += 1
-            record({"id": rid, "error": type(e).__name__,
-                    "status": e.status})
+            record({"id": rid, "model": name,
+                    "error": type(e).__name__, "status": e.status})
             return None
-        record({"id": rid, "bucket": r.bucket,
+        record({"id": rid, "model": name, "replica": r.replica,
+                "bucket": r.bucket,
                 "queue_wait_ms": r.queue_wait_ms,
                 "assembly_ms": r.assembly_ms,
                 "device_ms": r.device_ms, "total_ms": r.total_ms,
@@ -99,33 +155,46 @@ def main() -> None:
         return r
 
     try:
-        lm = server.load(a.model, weights=a.weights, seed=a.seed)
-        shape = lm.runner.sample_shape
+        pools = {}
         rng = np.random.RandomState(a.seed)
-        pool = rng.rand(64, *shape).astype(np.float32)
-        log(f"loaded {a.model}: input {shape}, buckets "
-            f"{lm.runner.buckets}, {lm.runner.compile_count()} compiles")
+        for name, _w in mix:
+            lm = server.load(name,
+                             weights=a.weights if len(mix) == 1 else None,
+                             seed=a.seed, replicas=a.replicas)
+            shape = lm.runner.sample_shape
+            pools[name] = rng.rand(64, *shape).astype(np.float32)
+            log(f"loaded {name}: input {shape}, buckets "
+                f"{lm.runner.buckets}, {lm.n_replicas} replica(s), "
+                f"{lm.runner.compile_count()} compiles/replica")
+        names = [n for n, _ in mix]
+        weights = np.asarray([w for _, w in mix], dtype=np.float64)
+        weights /= weights.sum()
+        # pre-draw the per-request model choice so open and closed loops
+        # offer the identical traffic mix for a given seed
+        choices = rng.choice(len(names), size=a.requests, p=weights)
 
         t0 = time.perf_counter()
         if a.mode == "open":
             gaps = rng.exponential(1.0 / a.qps, size=a.requests)
             futs, next_t = [], t0
             for i in range(a.requests):
+                name = names[choices[i]]
                 next_t += gaps[i]
                 now = time.perf_counter()
                 if next_t > now:
                     time.sleep(next_t - now)
                 try:
-                    futs.append((i, server.submit(a.model,
-                                                  pool[i % len(pool)]),
+                    futs.append((i, name,
+                                 server.submit(name,
+                                               pools[name][i % 64]),
                                  time.perf_counter()))
                 except ServingError as e:
                     with rejects_lock:
                         rejects["n"] += 1
-                    record({"id": i, "error": type(e).__name__,
-                            "status": e.status})
-            for rid, fut, ts in futs:
-                settle(rid, fut, ts)
+                    record({"id": i, "model": name,
+                            "error": type(e).__name__, "status": e.status})
+            for rid, name, fut, ts in futs:
+                settle(rid, name, fut, ts)
         else:
             counter = {"next": 0}
             counter_lock = threading.Lock()
@@ -137,17 +206,19 @@ def main() -> None:
                         if rid >= a.requests:
                             return
                         counter["next"] = rid + 1
+                    name = names[choices[rid]]
                     ts = time.perf_counter()
                     try:
-                        fut = server.submit(a.model, pool[rid % len(pool)],
+                        fut = server.submit(name, pools[name][rid % 64],
                                             wait=True)
                     except ServingError as e:
                         with rejects_lock:
                             rejects["n"] += 1
-                        record({"id": rid, "error": type(e).__name__,
+                        record({"id": rid, "model": name,
+                                "error": type(e).__name__,
                                 "status": e.status})
                         continue
-                    settle(rid, fut, ts)
+                    settle(rid, name, fut, ts)
 
             threads = [threading.Thread(target=worker, daemon=True)
                        for _ in range(a.concurrency)]
@@ -156,23 +227,52 @@ def main() -> None:
             for t in threads:
                 t.join()
         elapsed = time.perf_counter() - t0
-        st = server.stats()["models"][a.model]
+        stats = server.stats()["models"]
     finally:
         server.close(drain=True)
         if sink is not None:
             sink.close()
 
-    out = {"mode": a.mode, "model": a.model, "requests": a.requests,
-           "completed": st["completed"], "rejected": rejects["n"],
+    completed = sum(stats[n]["completed"] for n in names)
+    # aggregate percentiles: weighted by completion counts this is a
+    # merge of per-model summaries, honest only as max/count; per-model
+    # numbers are the real contract of the mixed mode
+    out = {"mode": a.mode,
+           "model": names[0] if len(names) == 1 else None,
+           "models": {n: round(float(w), 4)
+                      for n, w in zip(names, weights)},
+           "requests": a.requests,
+           "completed": completed, "rejected": rejects["n"],
            "elapsed_s": round(elapsed, 3),
-           "achieved_qps": round(st["completed"] / elapsed, 1),
-           "batch_occupancy_mean": st["batch_occupancy_mean"],
-           "bucket_counts": st["bucket_counts"],
-           "compiles": st["engine_compiles"],
-           "p50_ms": st["total_ms"]["p50_ms"],
-           "p95_ms": st["total_ms"]["p95_ms"],
-           "p99_ms": st["total_ms"]["p99_ms"],
-           "queue_wait_p99_ms": st["queue_wait_ms"]["p99_ms"]}
+           "achieved_qps": round(completed / elapsed, 1),
+           "per_model": {
+               n: {"completed": stats[n]["completed"],
+                   "achieved_qps": round(
+                       stats[n]["completed"] / elapsed, 1),
+                   "replicas": stats[n].get("n_replicas", 1),
+                   "batch_occupancy_mean":
+                       stats[n]["batch_occupancy_mean"],
+                   "bucket_counts": stats[n]["bucket_counts"],
+                   "compiles": stats[n]["engine_compiles"],
+                   "p50_ms": stats[n]["total_ms"]["p50_ms"],
+                   "p95_ms": stats[n]["total_ms"]["p95_ms"],
+                   "p99_ms": stats[n]["total_ms"]["p99_ms"],
+                   "queue_wait_p99_ms":
+                       stats[n]["queue_wait_ms"]["p99_ms"]}
+               for n in names}}
+    if len(names) == 1:
+        # single-model back-compat: keep the flat summary keys older
+        # study scripts parse
+        n = names[0]
+        out.update({"batch_occupancy_mean":
+                    stats[n]["batch_occupancy_mean"],
+                    "bucket_counts": stats[n]["bucket_counts"],
+                    "compiles": stats[n]["engine_compiles"],
+                    "p50_ms": stats[n]["total_ms"]["p50_ms"],
+                    "p95_ms": stats[n]["total_ms"]["p95_ms"],
+                    "p99_ms": stats[n]["total_ms"]["p99_ms"],
+                    "queue_wait_p99_ms":
+                    stats[n]["queue_wait_ms"]["p99_ms"]})
     if a.mode == "open":
         out["offered_qps"] = a.qps
     print(json.dumps(out), flush=True)

@@ -21,7 +21,9 @@ from .buckets import bucket_sizes, pad_to_bucket, pick_bucket
 from .engine import ModelRunner, resolve_net_param
 from .errors import (DeadlineExceeded, ModelNotLoaded, ServerClosed,
                      ServerOverloaded, ServingError)
+from .placement import DevicePlacer, resolve_replica_count, serving_mesh
 from .registry import LoadedModel, ModelRegistry
+from .scheduler import ReplicaScheduler
 from .server import InferenceServer, Response, ServerConfig
 from .stats import LatencySeries, ModelStats
 
@@ -31,5 +33,7 @@ __all__ = [
     "ServingError", "ServerOverloaded", "ServerClosed",
     "DeadlineExceeded", "ModelNotLoaded",
     "bucket_sizes", "pick_bucket", "pad_to_bucket",
+    "DevicePlacer", "serving_mesh", "resolve_replica_count",
+    "ReplicaScheduler",
     "LatencySeries", "ModelStats",
 ]

@@ -59,6 +59,8 @@ def cmd_serve(args) -> int:
                        max_wait_ms=args.max_wait_ms,
                        queue_depth=args.queue_depth,
                        default_deadline_ms=args.deadline_ms)
+    if args.min_fill is not None:
+        cfg.min_fill = args.min_fill
     server = InferenceServer(cfg)
     name = args.name or "default"
     try:
@@ -67,7 +69,8 @@ def cmd_serve(args) -> int:
                          seed=args.seed, quant=args.quant,
                          quant_min_agreement=(args.quant_min_agreement
                                               if args.quant != "fp32"
-                                              else None))
+                                              else None),
+                         replicas=args.replicas)
     except ValueError as e:
         # a failed quant calibration floor (or bad spec) is a load
         # error, not a crash
@@ -78,6 +81,7 @@ def cmd_serve(args) -> int:
                       f"(top-1 agreement {lm.runner.quant_agreement:.4f})")
     print(f"serving {args.model!r} as {name!r}: input "
           f"{lm.runner.sample_shape}, buckets {lm.runner.buckets}, "
+          f"{lm.n_replicas} replica(s), "
           f"{lm.runner.compile_count()} programs warmed{quant_note}",
           file=sys.stderr, flush=True)
 
@@ -190,6 +194,15 @@ def register(sub) -> None:
     s.add_argument("--max_batch", type=int, default=8)
     s.add_argument("--max_wait_ms", type=float, default=5.0)
     s.add_argument("--queue_depth", type=int, default=64)
+    s.add_argument("--replicas", type=int,
+                   help="model replicas spread across the device mesh "
+                        "(0 = one per device; default "
+                        "SPARKNET_SERVE_REPLICAS, normally 1)")
+    s.add_argument("--min_fill", type=int,
+                   help="rows a replica waits for (up to max_wait_ms) "
+                        "before dispatching; default "
+                        "SPARKNET_SERVE_MIN_FILL, normally 1 = "
+                        "continuous batching")
     s.add_argument("--deadline_ms", type=float,
                    help="per-request deadline; expired requests get a "
                         "504-style error line")

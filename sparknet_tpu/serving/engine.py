@@ -59,11 +59,9 @@ class ModelRunner:
                  quant_calib_batches: int = 2,
                  quant_min_agreement: Optional[float] = None) -> None:
         import jax
-        import jax.numpy as jnp
 
         from ..core.net import Net
-        from .quant import (build_quantized_params, quantized_bytes,
-                            validate_quant_mode)
+        from .quant import validate_quant_mode
 
         self.buckets: Tuple[int, ...] = (
             validate_buckets(buckets) if buckets is not None
@@ -86,16 +84,31 @@ class ModelRunner:
             self.net.blob_shapes[self.input_blob][1:])
         self.output_blob = probability_blob(self.net)
         self.n_outputs = int(self.net.blob_shapes[self.output_blob][-1])
+        self._build_exec()
+        if self.quant != "fp32":
+            self.calibrate_quant(quant_calib_batches,
+                                 min_agreement=quant_min_agreement)
+
+    def _build_exec(self) -> None:
+        """Build the device-side execution state from self.params/device:
+        the (possibly quantized) exec tree and a FRESH jitted forward —
+        so each replica owns its own jit cache and compile_count() stays
+        an honest per-device bound."""
+        import jax
+        import jax.numpy as jnp
+
+        from .quant import build_quantized_params, quantized_bytes
 
         net = self.net
         aux_blobs = list(net.input_blobs[1:])
+        input_blob, output_blob = self.input_blob, self.output_blob
 
         def fwd(params, x):
             # labels the serving forward's XLA ops when
             # SPARKNET_JAX_ANNOTATE=1 (inert nullcontext otherwise —
             # profiler RPCs can wedge the axon tunnel)
             with device_annotation("sparknet.serve_forward"):
-                feed = {self.input_blob: x}
+                feed = {input_blob: x}
                 # auxiliary declared inputs ride along zero-filled at
                 # their declared shapes, exactly as
                 # Classifier._forward_probs does
@@ -104,7 +117,7 @@ class ModelRunner:
                         net.blob_shapes[b],
                         jnp.int32 if len(net.blob_shapes[b]) == 1
                         else jnp.float32)
-                return net.forward(params, feed)[self.output_blob]
+                return net.forward(params, feed)[output_blob]
 
         if self.quant == "fp32":
             self._exec_params = self.params
@@ -113,8 +126,8 @@ class ModelRunner:
             # fp32 stays the master copy (calibration, interchange,
             # reload); the quantized tree is what the hot path carries
             qtree, dequant = build_quantized_params(self.params, self.quant)
-            if device is not None:
-                qtree = jax.device_put(qtree, device)
+            if self.device is not None:
+                qtree = jax.device_put(qtree, self.device)
             self._exec_params = qtree
 
             def qfwd(qp, x):
@@ -125,9 +138,25 @@ class ModelRunner:
             self._jref = jax.jit(fwd)  # fp32 reference for calibration
         self.param_bytes = quantized_bytes(self._exec_params)
         self._shapes_seen: set = set()
-        if self.quant != "fp32":
-            self.calibrate_quant(quant_calib_batches,
-                                 min_agreement=quant_min_agreement)
+
+    def replicate(self, device) -> "ModelRunner":
+        """A sibling runner pinned to `device`: shares the Net and the
+        host/master param values (one transfer, no re-init, no weights
+        re-read) but owns its own exec tree and jit cache, so replicas
+        compile independently and their math is bitwise-identical —
+        same params, same program, different chip.  Quantization is
+        re-derived from the same fp32 master (deterministic), so the
+        calibration agreement carries over untouched."""
+        import copy
+
+        import jax
+
+        clone = copy.copy(self)
+        clone.device = device
+        clone.params = jax.device_put(self.params, device)
+        clone._build_exec()
+        clone.quant_agreement = self.quant_agreement
+        return clone
 
     # ------------------------------------------------------------- execution
     def forward_padded(self, x: np.ndarray) -> np.ndarray:

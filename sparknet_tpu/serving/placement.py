@@ -1,0 +1,150 @@
+"""Mesh-aware replica placement for the serving engine.
+
+Training already knows how to spread work over the device mesh
+(parallel/mesh.py builds the (workers, model) grid, parallel/gspmd.py
+annotates shardings and lets the compiler insert collectives).  Serving
+reuses the same substrate from the other direction: instead of one model
+sharded across many chips, many *replicas* of resident models are placed
+across the mesh so every chip serves traffic — SparkNet's
+scale-by-replication story (PAPERS.md: "SparkNet: Training Deep Networks
+in Spark") applied to the online path, and the "same dataflow core
+serves online traffic" thesis of the TensorFlow paper taken to its
+conclusion.
+
+Two pieces:
+
+- `serving_mesh()` — a (workers, 1) `jax.sharding.Mesh` over the serving
+  device set, built with the SAME `parallel.mesh.make_mesh` the trainers
+  use; each worker row hosts one replica.  Purely descriptive for
+  placement (replicas are whole-model, so params ride plain
+  `jax.device_put` pins rather than gspmd shardings), but it keeps the
+  device grid and axis names identical to training's, so a future
+  sharded-serving mode (one BIG model over the model axis) drops in.
+- `DevicePlacer` — least-loaded assignment of replica slots to devices
+  with deterministic tie-breaking, tracking residency per device so a
+  second model's replicas land on the emptiest chips first.
+
+The replica count knob: `SPARKNET_SERVE_REPLICAS` (default 1 keeps the
+single-replica behavior every existing caller sees; 0 means "one replica
+per device" — saturate the mesh).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Dict, List, Optional, Sequence
+
+__all__ = ["serving_mesh", "serving_devices", "DevicePlacer",
+           "resolve_replica_count", "REPLICAS_ENV"]
+
+REPLICAS_ENV = "SPARKNET_SERVE_REPLICAS"
+
+
+def serving_devices(devices: Optional[Sequence] = None) -> List:
+    """The device set serving places replicas on: an explicit list wins,
+    otherwise every addressable device (the CPU test platform's 8
+    virtual devices, or the TPU slice's chips)."""
+    if devices is not None:
+        devs = list(devices)
+        if not devs:
+            raise ValueError("serving device list is empty")
+        return devs
+    import jax
+
+    return list(jax.devices())
+
+
+def serving_mesh(devices: Optional[Sequence] = None):
+    """A (workers, 1) Mesh over the serving devices — the training
+    placement machinery (parallel/mesh.py make_mesh) reused verbatim,
+    one worker row per servable replica slot."""
+    from ..parallel.mesh import make_mesh
+
+    devs = serving_devices(devices)
+    return make_mesh(n_workers=len(devs), model_parallel=1, devices=devs)
+
+
+def resolve_replica_count(replicas: Optional[int],
+                          n_devices: Optional[int] = None) -> int:
+    """`replicas` explicit wins; None reads SPARKNET_SERVE_REPLICAS
+    (default 1); 0 (either way) means one replica per device — expanded
+    here when `n_devices` is known, else returned as 0 for the caller
+    to expand once it has a placer (the server defers building one so
+    the default single-replica path never initializes a backend).
+    Counts above the device pool are allowed (devices host several
+    replicas) but negative ones are a config error."""
+    if replicas is None:
+        try:
+            replicas = int(os.environ.get(REPLICAS_ENV, "1"))
+        except ValueError:
+            raise ValueError(
+                f"{REPLICAS_ENV}={os.environ.get(REPLICAS_ENV)!r} is not "
+                f"an int")
+    replicas = int(replicas)
+    if replicas < 0:
+        raise ValueError(f"replicas must be >= 0, got {replicas}")
+    if replicas == 0 and n_devices is not None:
+        replicas = int(n_devices)
+    return replicas
+
+
+class DevicePlacer:
+    """Least-loaded replica-slot assignment over a fixed device pool.
+
+    Thread-safe; residency is tracked per device so interleaved
+    load/unload of several models keeps the mesh balanced.  Ties break
+    by pool order, so placement is deterministic for a given call
+    sequence (tests pin this — a nondeterministic spread would make the
+    mesh-vs-single parity suite flaky)."""
+
+    def __init__(self, devices: Optional[Sequence] = None) -> None:
+        self._devices = serving_devices(devices)
+        self._lock = threading.Lock()
+        self._load = [0] * len(self._devices)      # replicas resident
+        self._owners: Dict[str, List[int]] = {}    # model -> device idxs
+
+    @property
+    def devices(self) -> List:
+        return list(self._devices)
+
+    def __len__(self) -> int:
+        return len(self._devices)
+
+    def place(self, name: str, n_replicas: int) -> List:
+        """Assign `n_replicas` slots for model `name`, emptiest device
+        first, and record the residency.  Placing a name again first
+        releases its old slots (the reload/replace path)."""
+        if n_replicas < 1:
+            raise ValueError(f"n_replicas must be >= 1, got {n_replicas}")
+        with self._lock:
+            self._release_locked(name)
+            picked: List[int] = []
+            for _ in range(int(n_replicas)):
+                i = min(range(len(self._devices)),
+                        key=lambda k: (self._load[k], k))
+                self._load[i] += 1
+                picked.append(i)
+            self._owners[name] = picked
+            return [self._devices[i] for i in picked]
+
+    def release(self, name: str) -> None:
+        """Drop model `name`'s residency (unload path); unknown names are
+        a no-op — release must be safe on the error-cleanup path."""
+        with self._lock:
+            self._release_locked(name)
+
+    def _release_locked(self, name: str) -> None:
+        for i in self._owners.pop(name, ()):
+            self._load[i] -= 1
+
+    def describe(self) -> Dict[str, object]:
+        """JSON-ready placement snapshot for stats()/CLI: per-device
+        residency plus the model -> device map."""
+        with self._lock:
+            return {
+                "devices": [str(d) for d in self._devices],
+                "load": list(self._load),
+                "models": {name: [str(self._devices[i]) for i in idxs]
+                           for name, idxs in sorted(self._owners.items())},
+            }

@@ -2,17 +2,23 @@
 load/unload/reload and per-model stats.
 
 The registry owns model lifecycle only — queues and batcher threads are
-the server's (serving/server.py).  `reload` rebuilds the runner from its
-recorded spec (fresh Net + params + warmed buckets) and bumps the
-generation stamp; responses carry the generation they were computed
-under, so a caller can tell a pre-reload answer from a post-reload one.
+the server's (serving/server.py).  Since the mesh-serving PR a loaded
+model is a replica SET: one master runner plus `ModelRunner.replicate`
+siblings pinned to the placement's devices, all sharing the same param
+values so any replica answers bitwise-identically.  `reload` rebuilds
+the whole set from its recorded spec (fresh Net + params + warmed
+buckets on every device) and swaps it atomically with a generation
+bump; responses carry the generation they were computed under, so a
+caller can tell a pre-reload answer from a post-reload one, and an
+in-flight batch dispatched against the old set completes on the old
+params (never mixed, never re-answered).
 """
 
 from __future__ import annotations
 
 import threading
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional, Sequence
+from typing import Dict, List, Optional, Sequence, Tuple
 
 from .engine import ModelRunner, resolve_net_param
 from .errors import ModelNotLoaded
@@ -21,8 +27,10 @@ from .stats import ModelStats
 
 @dataclass
 class LoadedModel:
-    """One resident model: runner + stats + the load-spec needed to
-    rebuild it on reload()."""
+    """One resident model: replica runners + stats + the load-spec
+    needed to rebuild the set on reload().  `runner` stays the master
+    (replicas[0]) so single-replica callers see the PR-5 surface
+    unchanged."""
 
     name: str
     spec: str
@@ -31,6 +39,48 @@ class LoadedModel:
     generation: int = 0
     weights: Optional[str] = None
     load_kwargs: dict = field(default_factory=dict)
+    replicas: List[ModelRunner] = field(default_factory=list, repr=False)
+    devices: Optional[list] = field(default=None, repr=False)
+    _swap_lock: threading.Lock = field(default_factory=threading.Lock,
+                                       repr=False, compare=False)
+
+    def __post_init__(self) -> None:
+        if not self.replicas:
+            self.replicas = [self.runner]
+
+    @property
+    def n_replicas(self) -> int:
+        return len(self.replicas)
+
+    def replica_snapshot(self, i: int) -> Tuple[ModelRunner, int]:
+        """(runner, generation) read atomically — the dispatch-time
+        capture that keeps a reload() swap from mixing params and
+        generation stamps inside one batch."""
+        with self._swap_lock:
+            return self.replicas[i % len(self.replicas)], self.generation
+
+    def swap(self, runner: ModelRunner, replicas: List[ModelRunner],
+             stats: ModelStats) -> None:
+        with self._swap_lock:
+            self.runner = runner
+            self.replicas = replicas
+            self.stats = stats
+            self.generation += 1
+
+
+def _build_replicas(master: ModelRunner, devices: Optional[Sequence],
+                    warmup: bool) -> List[ModelRunner]:
+    """master + replicate() siblings on devices[1:] (the master is
+    already pinned to devices[0] by its constructor), each warmed so
+    every replica's compile count equals the bucket count before
+    traffic arrives."""
+    replicas = [master]
+    if devices is not None:
+        replicas += [master.replicate(d) for d in list(devices)[1:]]
+    if warmup:
+        for r in replicas:
+            r.warmup()
+    return replicas
 
 
 class ModelRegistry:
@@ -44,54 +94,68 @@ class ModelRegistry:
              weights: Optional[str] = None,
              buckets: Optional[Sequence[int]] = None,
              max_batch: int = 8, seed: int = 0, device=None,
+             devices: Optional[Sequence] = None,
              warmup: bool = True, quant: Optional[str] = None,
              quant_min_agreement: Optional[float] = None) -> LoadedModel:
         """Build, (optionally) warm, and register a model under `name`.
         `spec` defaults to `name` (zoo entry or prototxt path).
+        `devices` (a list) builds one replica per entry — the master on
+        devices[0], `replicate()` siblings on the rest; `device` keeps
+        the single-replica pin PR 5 callers use (mutually exclusive).
         Loading over an existing name replaces it (generation restarts);
         use reload() to rebuild in place with a bumped generation.
         `quant` selects the serving forward's numeric mode
         (serving/quant.py: fp32/bf16/int8); the kwargs are recorded, so
         reload() rebuilds AND recalibrates the same quantized form."""
         spec = spec if spec is not None else name
+        if device is not None and devices is not None:
+            raise ValueError("pass device= (single replica) or devices= "
+                             "(replica set), not both")
+        if devices is not None and not list(devices):
+            raise ValueError("devices= must be a non-empty list")
         kwargs = {"buckets": buckets, "max_batch": max_batch,
-                  "seed": seed, "device": device, "quant": quant,
+                  "seed": seed, "quant": quant,
                   "quant_min_agreement": quant_min_agreement}
-        runner = ModelRunner(
+        dev0 = list(devices)[0] if devices is not None else device
+        master = ModelRunner(
             resolve_net_param(spec, max_batch=max_batch),
-            weights=weights, **kwargs)
-        if warmup:
-            runner.warmup()
-        lm = LoadedModel(name=name, spec=spec, runner=runner,
+            weights=weights, device=dev0, **kwargs)
+        replicas = _build_replicas(master, devices, warmup)
+        lm = LoadedModel(name=name, spec=spec, runner=master,
                          stats=ModelStats(), weights=weights,
-                         load_kwargs=dict(kwargs, warmup=warmup))
+                         load_kwargs=dict(kwargs, warmup=warmup,
+                                          device=device),
+                         replicas=replicas,
+                         devices=list(devices) if devices is not None
+                         else None)
         with self._lock:
             self._models[name] = lm
         return lm
 
     def reload(self, name: str) -> LoadedModel:
         """Rebuild `name` from its recorded spec: fresh params (picking
-        up a rewritten weights file), freshly warmed buckets, stats
-        reset, generation + 1.  The swap is atomic under the lock — an
-        in-flight batch on the old runner completes against the old
-        params and its responses carry the old generation."""
+        up a rewritten weights file), freshly warmed buckets on every
+        replica device, stats reset, generation + 1.  The swap is atomic
+        under the model's lock — an in-flight batch that captured the
+        old (runner, generation) pair via replica_snapshot completes
+        against the old params and its responses carry the old
+        generation."""
         lm = self.get(name)
         kwargs = dict(lm.load_kwargs)
         warm = kwargs.pop("warmup", True)
-        runner = ModelRunner(
+        device = kwargs.pop("device", None)
+        dev0 = lm.devices[0] if lm.devices is not None else device
+        master = ModelRunner(
             resolve_net_param(lm.spec,
                               max_batch=kwargs.get("max_batch", 8)),
-            weights=lm.weights, **kwargs)
-        if warm:
-            runner.warmup()
+            weights=lm.weights, device=dev0, **kwargs)
+        replicas = _build_replicas(master, lm.devices, warm)
         with self._lock:
             cur = self._models.get(name)
             if cur is not lm:
                 raise ModelNotLoaded(
                     f"model {name!r} was unloaded/replaced mid-reload")
-            lm.runner = runner
-            lm.stats = ModelStats()
-            lm.generation += 1
+            lm.swap(master, replicas, ModelStats())
         return lm
 
     def unload(self, name: str) -> None:
@@ -112,7 +176,7 @@ class ModelRegistry:
             return sorted(self._models)
 
     def stats(self) -> Dict[str, dict]:
-        """Per-model serving stats + engine description."""
+        """Per-model serving stats + engine description + replica set."""
         with self._lock:
             models = list(self._models.values())
         out: Dict[str, dict] = {}
@@ -120,6 +184,9 @@ class ModelRegistry:
             snap = lm.stats.snapshot()
             snap["generation"] = lm.generation
             snap["spec"] = lm.spec
+            snap["n_replicas"] = lm.n_replicas
+            if lm.devices is not None:
+                snap["devices"] = [str(d) for d in lm.devices]
             snap.update({f"engine_{k}": v
                          for k, v in lm.runner.describe().items()})
             out[lm.name] = snap

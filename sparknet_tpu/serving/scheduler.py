@@ -1,0 +1,200 @@
+"""Replica scheduler: least-loaded routing + continuous batch refill.
+
+PR 5's batcher ran ONE thread per model pulling from ONE queue.Queue,
+blocking up to `max_wait_ms` to top a batch off before dispatch — so a
+lone request always paid the full coalesce window, and a second device
+could never help.  This module replaces that loop with the
+continuous-batching discipline the bucketed-shape + warmup machinery
+(buckets.py, engine.warmup) was built to enable:
+
+- Admission routes every request to the LEAST-LOADED replica (queued +
+  in-flight, round-robin tie-break so equally-idle replicas interleave
+  deterministically).
+- One worker per replica sleeps on a shared condition variable and is
+  woken the moment work lands — no idle polling, no fixed wait: it pops
+  whatever is pending (up to max_batch) and dispatches IMMEDIATELY.
+  Batches form naturally while a replica is busy: everything that
+  arrived during the in-flight dispatch becomes the next batch the
+  instant the replica frees.  `min_fill > 1` optionally restores a
+  bounded coalesce window (wait up to max_wait_ms for min_fill requests)
+  for throughput-over-latency deployments.
+
+The scheduler is deliberately model-agnostic: it moves opaque items and
+counts load; padding, deadlines, stats, and the jitted forward all stay
+in serving/server.py's run callback, which executes OUTSIDE the lock so
+admission/routing never stalls behind device time.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import deque
+from typing import Callable, Deque, List, Optional, Sequence, Tuple
+
+from ..obs.trace import now_s
+
+__all__ = ["ReplicaScheduler", "SchedulerFull", "SchedulerClosed"]
+
+
+class SchedulerFull(Exception):
+    """Total pending reached queue_depth (server maps to
+    ServerOverloaded — the 503)."""
+
+
+class SchedulerClosed(Exception):
+    """stop() was called (server maps to ServerClosed)."""
+
+
+class ReplicaScheduler:
+    """N per-replica pending deques + N worker threads behind one
+    condition variable.
+
+    `run(replica_idx, batch)` is the dispatch callback; it runs outside
+    the lock and must not raise (the server's callback resolves every
+    future itself, exceptions included)."""
+
+    def __init__(self, n_replicas: int, *,
+                 max_batch: int, queue_depth: int,
+                 run: Callable[[int, List], None],
+                 min_fill: int = 1, max_wait_ms: float = 0.0,
+                 name: str = "model") -> None:
+        if n_replicas < 1:
+            raise ValueError(f"n_replicas must be >= 1, got {n_replicas}")
+        if not 1 <= min_fill <= max_batch:
+            raise ValueError(
+                f"min_fill must be in [1, max_batch={max_batch}], "
+                f"got {min_fill}")
+        self.n_replicas = int(n_replicas)
+        self.max_batch = int(max_batch)
+        self.queue_depth = int(queue_depth)
+        self.min_fill = int(min_fill)
+        self.max_wait_ms = float(max_wait_ms)
+        self._run = run
+        self._cv = threading.Condition()
+        self._pending: List[Deque] = [deque() for _ in range(n_replicas)]
+        self._inflight = [0] * n_replicas
+        self._rr = 0                 # rotates the least-loaded tie-break
+        self._stopping = False
+        self._threads = [
+            threading.Thread(target=self._worker, args=(i,),
+                             name=f"sparknet-serve-{name}-r{i}",
+                             daemon=True)
+            for i in range(n_replicas)]
+        for t in self._threads:
+            t.start()
+
+    # ------------------------------------------------------------- admission
+    def submit(self, item, *, wait: bool = False,
+               timeout_s: Optional[float] = None) -> int:
+        """Route `item` to the least-loaded replica; returns its index.
+        A full scheduler (total pending == queue_depth) raises
+        SchedulerFull immediately, or after blocking up to `timeout_s`
+        when wait=True (backpressure mode)."""
+        with self._cv:
+            if self._stopping:
+                raise SchedulerClosed("scheduler is stopping")
+            if self._total_pending() >= self.queue_depth:
+                if not wait:
+                    raise SchedulerFull(self.queue_depth)
+                deadline = (None if timeout_s is None
+                            else now_s() + float(timeout_s))
+                while (self._total_pending() >= self.queue_depth
+                       and not self._stopping):
+                    remaining = (None if deadline is None
+                                 else deadline - now_s())
+                    if remaining is not None and remaining <= 0:
+                        raise SchedulerFull(self.queue_depth)
+                    self._cv.wait(remaining)
+                if self._stopping:
+                    raise SchedulerClosed("scheduler is stopping")
+            i = self._pick_replica()
+            self._pending[i].append(item)
+            self._cv.notify_all()
+            return i
+
+    def _total_pending(self) -> int:
+        return sum(len(dq) for dq in self._pending)
+
+    def _pick_replica(self) -> int:
+        """Least (queued + in-flight); ties rotate from the last pick so
+        a burst onto an idle mesh spreads one-per-replica instead of
+        piling onto replica 0."""
+        n = self.n_replicas
+        i = min(range(n),
+                key=lambda k: (len(self._pending[k]) + self._inflight[k],
+                               (k - self._rr) % n))
+        self._rr = (i + 1) % n
+        return i
+
+    # --------------------------------------------------------------- workers
+    def _worker(self, i: int) -> None:
+        cv = self._cv
+        pending = self._pending[i]
+        while True:
+            with cv:
+                while not pending and not self._stopping:
+                    cv.wait()
+                if not pending:          # stopping and nothing left
+                    return
+                if (self.min_fill > 1 and len(pending) < self.min_fill
+                        and not self._stopping):
+                    # opt-in coalesce: wait (bounded) for a fuller batch
+                    wait_end = now_s() + self.max_wait_ms / 1e3
+                    while (len(pending) < self.min_fill
+                           and not self._stopping):
+                        remaining = wait_end - now_s()
+                        if remaining <= 0:
+                            break
+                        cv.wait(remaining)
+                take = min(self.max_batch, len(pending))
+                batch = [pending.popleft() for _ in range(take)]
+                self._inflight[i] += take
+                cv.notify_all()          # queue space freed; drain waiters
+            try:
+                self._run(i, batch)
+            finally:
+                with cv:
+                    self._inflight[i] -= take
+                    cv.notify_all()
+
+    # ------------------------------------------------------------- lifecycle
+    def drain(self) -> None:
+        """Block until nothing is pending or in flight (the scheduler
+        stays open for more work)."""
+        with self._cv:
+            self._cv.wait_for(
+                lambda: self._total_pending() == 0
+                and not any(self._inflight))
+
+    def stop(self, *, drain: bool = True) -> List:
+        """Stop the workers.  drain=True lets them empty their deques
+        first; drain=False flushes everything still pending and returns
+        it for the caller to reject.  In-flight batches always complete
+        (their math is already launched).  Idempotent; joins workers."""
+        with self._cv:
+            self._stopping = True
+            flushed: List = []
+            if not drain:
+                for dq in self._pending:
+                    flushed.extend(dq)
+                    dq.clear()
+            self._cv.notify_all()
+        for t in self._threads:
+            if t is not threading.current_thread():
+                t.join()
+        return flushed
+
+    # --------------------------------------------------------------- observe
+    def depth(self, i: int) -> Tuple[int, int]:
+        """(queued, in-flight) for replica i."""
+        with self._cv:
+            return len(self._pending[i]), self._inflight[i]
+
+    def depths(self) -> List[Tuple[int, int]]:
+        with self._cv:
+            return [(len(self._pending[i]), self._inflight[i])
+                    for i in range(self.n_replicas)]
+
+    def queued_total(self) -> int:
+        with self._cv:
+            return self._total_pending()

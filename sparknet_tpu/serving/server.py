@@ -1,6 +1,6 @@
-"""Online inference server: thread-safe request queue + dynamic
-micro-batcher over bucketed shapes, with admission control and graceful
-drain.
+"""Online inference server: thread-safe admission + mesh-replicated
+continuous micro-batching over bucketed shapes, with admission control
+and graceful drain.
 
 The dataflow core (core/net.py) stays untouched — this layer turns a
 stream of independent single-sample requests into efficient padded-batch
@@ -9,24 +9,34 @@ runtime and the serving/batching layer in front of it (PAPERS.md:
 "TensorFlow: A system for large-scale machine learning"; the reference
 Caffe stack stops at offline batch scoring, classifier.py).
 
-Per model there is ONE bounded queue and ONE batcher thread:
+Per model there is ONE replica scheduler (scheduler.py) over N placed
+replicas (placement.py + registry replica sets):
 
-  submit() --admission--> queue --coalesce <= max_batch/max_wait_ms-->
-    pad to bucket --> jitted forward (warmed shapes only) --> slice -->
-      resolve futures
+  submit() --admission--> least-loaded replica deque --worker wakes
+    (condition variable, no polling)--> pop <= max_batch NOW -->
+      deadline filter --> pad to bucket --> that replica's jitted
+        forward (warmed shapes only) --> slice --> resolve futures
+
+The PR-5 batcher waited up to `max_wait_ms` to fill a batch before every
+dispatch; the continuous scheduler dispatches the moment a replica is
+free and lets batches form naturally WHILE replicas are busy, so a lone
+request pays device time only, and a loaded mesh refills each replica's
+next bucket the instant the previous one completes.  `min_fill > 1`
+restores a bounded coalesce window for throughput-over-latency
+deployments (max_wait_ms then caps that wait, as before).
 
 Rejections are exceptions on the returned future or raised at submit
 (errors.py: ServerOverloaded at admission, DeadlineExceeded at batch
-assembly, ServerClosed at shutdown).  close(drain=True) delivers every
+launch, ServerClosed at shutdown).  close(drain=True) delivers every
 admitted request before returning; stats() snapshots per-model latency
-histograms, occupancy, and reject counts (stats.py).
+histograms, occupancy, reject counts (stats.py), and the per-replica
+queue/in-flight breakdown.
 """
 
 from __future__ import annotations
 
-import queue as _queue
+import os
 import threading
-import time
 from concurrent.futures import Future
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence
@@ -37,7 +47,22 @@ from ..obs.trace import now_s, span
 from .buckets import pad_to_bucket, pick_bucket
 from .errors import (DeadlineExceeded, ServerClosed, ServerOverloaded,
                      ServingError)
+from .placement import DevicePlacer, resolve_replica_count
 from .registry import LoadedModel, ModelRegistry
+from .scheduler import ReplicaScheduler, SchedulerClosed, SchedulerFull
+
+
+def _default_min_fill() -> int:
+    """SPARKNET_SERVE_MIN_FILL: batch rows a replica waits for (up to
+    max_wait_ms) before dispatching.  1 (default) = pure continuous
+    batching — dispatch whatever is pending the moment the replica
+    frees."""
+    try:
+        return int(os.environ.get("SPARKNET_SERVE_MIN_FILL", "1"))
+    except ValueError:
+        raise ValueError(
+            f"SPARKNET_SERVE_MIN_FILL="
+            f"{os.environ.get('SPARKNET_SERVE_MIN_FILL')!r} is not an int")
 
 
 @dataclass
@@ -46,10 +71,12 @@ class ServerConfig:
     buckets, weights — ride through load())."""
 
     max_batch: int = 8          # coalesce at most this many requests
-    max_wait_ms: float = 5.0    # ... or stop waiting after this long
+    max_wait_ms: float = 5.0    # min_fill coalesce cap (moot at min_fill=1)
     queue_depth: int = 64       # admission bound; beyond -> ServerOverloaded
     default_deadline_ms: Optional[float] = None  # per-request override wins
-    poll_s: float = 0.05        # batcher idle poll (shutdown latency bound)
+    poll_s: float = 0.05        # legacy PR-5 knob; kept so existing
+    #                             ServerConfig(poll_s=...) callers construct
+    min_fill: int = field(default_factory=_default_min_fill)
 
 
 @dataclass
@@ -58,7 +85,9 @@ class Response:
     shape the request was computed in, which makes every response exactly
     replayable: a direct net.forward at that bucket is bitwise-identical
     (XLA specializes programs per shape, so replaying at a DIFFERENT
-    batch size can differ in final-ulp rounding — tests pin both facts)."""
+    batch size can differ in final-ulp rounding — tests pin both facts).
+    `replica` records which placed replica ran it; replicas share param
+    values, so the replica index never changes the math (also pinned)."""
 
     probs: np.ndarray
     model: str
@@ -69,6 +98,7 @@ class Response:
     assembly_ms: float
     device_ms: float
     total_ms: float
+    replica: int = 0
 
     @property
     def argmax(self) -> int:
@@ -80,20 +110,17 @@ class _Request:
     sample: np.ndarray
     future: Future
     t_submit: float
-    deadline: Optional[float]   # absolute perf_counter seconds
+    deadline: Optional[float]   # absolute now_s seconds
     t_pop: float = 0.0
 
 
 @dataclass
 class _Lane:
-    """Per-model queue + batcher thread."""
+    """Per-model replica scheduler."""
 
     model: LoadedModel
-    queue: _queue.Queue = field(default_factory=_queue.Queue)
-    thread: Optional[threading.Thread] = None
+    sched: ReplicaScheduler
     stopping: bool = False
-    draining: bool = True
-    busy: bool = False          # a popped batch is being assembled/run
 
 
 class InferenceServer:
@@ -101,8 +128,8 @@ class InferenceServer:
 
     Usage (programmatic):
 
-        server = InferenceServer(ServerConfig(max_batch=8, max_wait_ms=4))
-        server.load("lenet")                      # zoo name or prototxt
+        server = InferenceServer(ServerConfig(max_batch=8))
+        server.load("lenet", replicas=4)          # spread over the mesh
         fut = server.submit("lenet", sample)      # (C,H,W) float32
         resp = fut.result(timeout=5)              # Response
         server.close(drain=True)
@@ -111,17 +138,32 @@ class InferenceServer:
     """
 
     def __init__(self, config: Optional[ServerConfig] = None,
-                 registry: Optional[ModelRegistry] = None) -> None:
+                 registry: Optional[ModelRegistry] = None,
+                 devices: Optional[Sequence] = None) -> None:
         self.config = config or ServerConfig()
         if self.config.max_batch < 1:
             raise ValueError("max_batch must be >= 1")
         if self.config.queue_depth < 1:
             raise ValueError("queue_depth must be >= 1")
+        if not 1 <= self.config.min_fill <= self.config.max_batch:
+            raise ValueError(
+                f"min_fill must be in [1, max_batch="
+                f"{self.config.max_batch}], got {self.config.min_fill}")
         self.registry = registry or ModelRegistry()
+        self._devices = devices
+        self._placer: Optional[DevicePlacer] = None
         self._lanes: Dict[str, _Lane] = {}
         self._lock = threading.Lock()
         self._accepting = True
         self._closed = False
+
+    def _get_placer(self) -> DevicePlacer:
+        """Lazy so the default single-replica path never touches
+        jax.devices() (no backend init just to construct a server)."""
+        with self._lock:
+            if self._placer is None:
+                self._placer = DevicePlacer(self._devices)
+            return self._placer
 
     # ------------------------------------------------------------ lifecycle
     def load(self, name: str, spec: Optional[str] = None, *,
@@ -129,47 +171,72 @@ class InferenceServer:
              buckets: Optional[Sequence[int]] = None,
              seed: int = 0, device=None, warmup: bool = True,
              quant: Optional[str] = None,
-             quant_min_agreement: Optional[float] = None) -> LoadedModel:
-        """Load + warm a model and start its batcher lane.  The bucket
-        ladder defaults to powers of two up to config.max_batch."""
+             quant_min_agreement: Optional[float] = None,
+             replicas: Optional[int] = None) -> LoadedModel:
+        """Load + warm a model and start its scheduler.  `replicas`
+        (default SPARKNET_SERVE_REPLICAS, normally 1; 0 = one per
+        device) places that many replicas least-loaded-first across the
+        device mesh; `device` pins the single-replica case explicitly
+        (mutually exclusive with replicas > 1).  The bucket ladder
+        defaults to powers of two up to config.max_batch."""
         if not self._accepting:
             raise ServerClosed("server is shutting down")
-        lm = self.registry.load(name, spec, weights=weights,
-                                buckets=buckets,
-                                max_batch=self.config.max_batch,
-                                seed=seed, device=device, warmup=warmup,
-                                quant=quant,
-                                quant_min_agreement=quant_min_agreement)
+        n_rep = resolve_replica_count(replicas, None)
+        devices = None
+        if n_rep != 1:
+            if device is not None:
+                raise ValueError("pass device= (single replica) or "
+                                 "replicas= (mesh placement), not both")
+            placer = self._get_placer()
+            if n_rep == 0:
+                n_rep = len(placer)
+            devices = placer.place(name, n_rep)
+        try:
+            lm = self.registry.load(
+                name, spec, weights=weights, buckets=buckets,
+                max_batch=self.config.max_batch, seed=seed,
+                device=device, devices=devices, warmup=warmup,
+                quant=quant, quant_min_agreement=quant_min_agreement)
+        except Exception:
+            if devices is not None:
+                self._get_placer().release(name)
+            raise
         if self.config.max_batch > max(lm.runner.buckets):
             raise ValueError(
                 f"max_batch {self.config.max_batch} exceeds the largest "
                 f"bucket {max(lm.runner.buckets)}")
-        lane = _Lane(model=lm,
-                     queue=_queue.Queue(maxsize=self.config.queue_depth))
-        lane.thread = threading.Thread(
-            target=self._batcher, args=(name, lane),
-            name=f"sparknet-serve-{name}", daemon=True)
+        lane = _Lane(model=lm, sched=None)  # run callback needs the lane
+        lane.sched = ReplicaScheduler(
+            lm.n_replicas, max_batch=self.config.max_batch,
+            queue_depth=self.config.queue_depth,
+            min_fill=self.config.min_fill,
+            max_wait_ms=self.config.max_wait_ms,
+            run=lambda i, batch: self._run_batch(lane, i, batch),
+            name=name)
         with self._lock:
             old = self._lanes.get(name)
             self._lanes[name] = lane
         if old is not None:
             self._stop_lane(old, drain=True)
-        lane.thread.start()
-        return lm
+        return lane.model
 
     def unload(self, name: str, *, drain: bool = True) -> None:
-        """Stop the lane (draining admitted work by default) and drop the
-        model from the registry."""
+        """Stop the scheduler (draining admitted work by default), free
+        the placement slots, and drop the model from the registry."""
         with self._lock:
             lane = self._lanes.pop(name, None)
         if lane is not None:
             self._stop_lane(lane, drain=drain)
+        if self._placer is not None:
+            self._placer.release(name)
         self.registry.unload(name)
 
     def reload(self, name: str) -> LoadedModel:
         """Rebuild the model in place (fresh weights file pickup, stats
-        reset, generation bump).  The lane keeps running: queued requests
-        before the swap complete on the old runner."""
+        reset, generation bump) on the SAME replica devices.  The
+        scheduler keeps running: a batch dispatched before the swap
+        completes on the old replica set and carries the old
+        generation."""
         return self.registry.reload(name)
 
     def drain(self) -> None:
@@ -178,13 +245,12 @@ class InferenceServer:
         with self._lock:
             lanes = list(self._lanes.values())
         for lane in lanes:
-            while not lane.queue.empty() or lane.busy:
-                time.sleep(self.config.poll_s / 2)
+            lane.sched.drain()
 
     def close(self, *, drain: bool = True) -> None:
         """Stop accepting; deliver (drain=True) or reject with
-        ServerClosed (drain=False) everything still queued; stop lanes.
-        Idempotent."""
+        ServerClosed (drain=False) everything still queued; stop
+        schedulers.  Idempotent."""
         self._accepting = False
         if self._closed:
             return
@@ -195,20 +261,8 @@ class InferenceServer:
             self._stop_lane(lane, drain=drain)
 
     def _stop_lane(self, lane: _Lane, *, drain: bool) -> None:
-        lane.draining = drain
         lane.stopping = True
-        if not drain:
-            self._flush_reject(lane)
-        if lane.thread is not None:
-            lane.thread.join()
-            lane.thread = None
-
-    def _flush_reject(self, lane: _Lane) -> None:
-        while True:
-            try:
-                req = lane.queue.get_nowait()
-            except _queue.Empty:
-                return
+        for req in lane.sched.stop(drain=drain):
             lane.model.stats.bump("rejected_closed")
             req.future.set_exception(
                 ServerClosed("server closed before this request ran"))
@@ -252,17 +306,19 @@ class InferenceServer:
         lm.stats.bump("submitted")
         try:
             with span("serve.submit", model=model) as sp:
-                if wait:
-                    lane.queue.put(req, timeout=wait_timeout_s)
-                else:
-                    lane.queue.put_nowait(req)
-                sp.set(queued=lane.queue.qsize(),
+                idx = lane.sched.submit(req, wait=wait,
+                                        timeout_s=wait_timeout_s)
+                queued, inflight = lane.sched.depth(idx)
+                lm.stats.observe_replica(idx, queued, inflight)
+                sp.set(replica=idx, queued=lane.sched.queued_total(),
                        submitted=lm.stats.value("submitted"))
-        except _queue.Full:
+        except SchedulerFull:
             lm.stats.bump("rejected_overload")
             raise ServerOverloaded(
                 f"{model!r} queue at depth {self.config.queue_depth}"
             ) from None
+        except SchedulerClosed:
+            raise ServerClosed("server is shutting down") from None
         return req.future
 
     def submit_many(self, model: str, samples, **kw) -> List[Future]:
@@ -290,61 +346,43 @@ class InferenceServer:
         return lane
 
     # ------------------------------------------------------------- batching
-    def _batcher(self, name: str, lane: _Lane) -> None:
-        """The per-model micro-batch loop: block for a first request,
-        coalesce up to max_batch/max_wait_ms more, dispatch."""
-        cfg = self.config
-        q = lane.queue
-        while True:
-            try:
-                first = q.get(timeout=cfg.poll_s)
-            except _queue.Empty:
-                if lane.stopping:
-                    return
-                continue
-            lane.busy = True
-            try:
-                with span("serve.assemble", model=name) as sp:
-                    first.t_pop = now_s()
-                    batch = [first]
-                    window_end = first.t_pop + cfg.max_wait_ms / 1e3
-                    while len(batch) < cfg.max_batch:
-                        remaining = window_end - now_s()
-                        if remaining <= 0 or (lane.stopping and q.empty()):
-                            break
-                        try:
-                            nxt = q.get(timeout=remaining)
-                        except _queue.Empty:
-                            break
-                        nxt.t_pop = now_s()
-                        batch.append(nxt)
-                    sp.set(batch=len(batch), queued=q.qsize())
-                self._run_batch(lane, batch)
-            finally:
-                lane.busy = False
-
-    def _run_batch(self, lane: _Lane, batch: List[_Request]) -> None:
+    def _run_batch(self, lane: _Lane, replica_idx: int,
+                   batch: List[_Request]) -> None:
+        """Scheduler run callback: the batch a replica worker popped the
+        moment it freed.  Captures (runner, generation) atomically so a
+        concurrent reload() never mixes params inside one batch, and
+        never raises — every future is resolved here, rejections
+        included."""
         lm = lane.model
-        runner, generation = lm.runner, lm.generation
-        now = now_s()
-        live: List[_Request] = []
-        for r in batch:
-            if r.deadline is not None and now > r.deadline:
-                lm.stats.bump("rejected_deadline")
-                r.future.set_exception(DeadlineExceeded(
-                    f"deadline passed {round((now - r.deadline) * 1e3, 2)}"
-                    f" ms before batch launch"))
-            else:
-                live.append(r)
+        runner, generation = lm.replica_snapshot(replica_idx)
+        with span("serve.assemble", model=lm.name,
+                  replica=replica_idx) as sp:
+            now = now_s()
+            live: List[_Request] = []
+            for r in batch:
+                r.t_pop = now
+                if r.deadline is not None and now > r.deadline:
+                    lm.stats.bump("rejected_deadline")
+                    r.future.set_exception(DeadlineExceeded(
+                        f"deadline passed "
+                        f"{round((now - r.deadline) * 1e3, 2)}"
+                        f" ms before batch launch"))
+                else:
+                    live.append(r)
+            sp.set(batch=len(batch), live=len(live),
+                   queued=lane.sched.queued_total())
         if not live:
             return
         bucket = pick_bucket(len(live), runner.buckets)
         x = pad_to_bucket(
             np.stack([r.sample for r in live]).astype(np.float32), bucket)
+        queued, inflight = lane.sched.depth(replica_idx)
+        lm.stats.observe_replica(replica_idx, queued, inflight,
+                                 dispatched=1)
         t_launch = now_s()
         try:
             with span("serve.device", model=lm.name, bucket=bucket,
-                      live=len(live)):
+                      live=len(live), replica=replica_idx):
                 out = runner.forward_padded(x)
         except Exception as e:
             lm.stats.bump("failed", len(live))
@@ -369,24 +407,40 @@ class InferenceServer:
                     queue_wait_ms=round(queue_wait_ms, 4),
                     assembly_ms=round(assembly_ms, 4),
                     device_ms=round(device_ms, 4),
-                    total_ms=round(total_ms, 4)))
+                    total_ms=round(total_ms, 4),
+                    replica=replica_idx))
             sp.set(completed=lm.stats.value("completed"),
                    batches=lm.stats.value("batches"))
 
     # -------------------------------------------------------------- observe
     def stats(self) -> Dict[str, object]:
         """JSON-ready snapshot: per-model serving counters/latency
-        histograms (stats.py) + live queue depths + the batching
-        config."""
+        histograms (stats.py) + live queue depths + a per-replica
+        breakdown + the batching config."""
         per_model = self.registry.stats()
         with self._lock:
-            for name, lane in self._lanes.items():
-                if name in per_model:
-                    per_model[name]["queued_now"] = lane.queue.qsize()
-        return {"models": per_model,
-                "config": {"max_batch": self.config.max_batch,
-                           "max_wait_ms": self.config.max_wait_ms,
-                           "queue_depth": self.config.queue_depth,
-                           "default_deadline_ms":
-                               self.config.default_deadline_ms},
-                "accepting": self._accepting}
+            lanes = dict(self._lanes)
+        for name, lane in lanes.items():
+            if name not in per_model:
+                continue
+            per_model[name]["queued_now"] = lane.sched.queued_total()
+            breakdown = lane.model.stats.replica_breakdown()
+            for i, (queued, inflight) in enumerate(lane.sched.depths()):
+                entry = breakdown.setdefault(
+                    str(i), {"queued_max": 0, "inflight_max": 0,
+                             "dispatches": 0})
+                entry["queued_now"] = queued
+                entry["inflight_now"] = inflight
+            per_model[name]["replicas"] = breakdown
+        out: Dict[str, object] = {
+            "models": per_model,
+            "config": {"max_batch": self.config.max_batch,
+                       "max_wait_ms": self.config.max_wait_ms,
+                       "queue_depth": self.config.queue_depth,
+                       "min_fill": self.config.min_fill,
+                       "default_deadline_ms":
+                           self.config.default_deadline_ms},
+            "accepting": self._accepting}
+        if self._placer is not None:
+            out["placement"] = self._placer.describe()
+        return out

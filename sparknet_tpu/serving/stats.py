@@ -69,6 +69,13 @@ class ModelStats:
             self._occupancy_sum = self._registry.counter(
                 "serving_batch_occupancy_sum")
             self._bucket_counts: Dict[int, object] = {}
+            # per-replica mesh telemetry (created lazily on first
+            # observe_replica — single-replica models keep the exact
+            # PR-5 metric set, and snapshot() never includes these so
+            # its byte-pinned zero-state contract holds)
+            self._replica_queue: Dict[int, object] = {}
+            self._replica_inflight: Dict[int, object] = {}
+            self._replica_dispatches: Dict[int, object] = {}
 
     @property
     def registry(self) -> MetricsRegistry:
@@ -107,6 +114,51 @@ class ModelStats:
         batches.inc(1)
         self._occupancy_sum.inc(n_live / float(bucket))
         b.inc(1)
+
+    def observe_replica(self, idx: int, queued: int, inflight: int,
+                        dispatched: int = 0) -> None:
+        """Mesh-serving gauges for one replica slot: live queue depth and
+        in-flight rows (`serving_replica_queue_depth{replica=i}` /
+        `serving_replica_inflight{replica=i}`, Gauge max tracks the
+        high-water mark), plus a dispatch counter when a batch launches.
+        These ride the same private registry, so they land in the
+        Prometheus export and replica_breakdown() without widening the
+        byte-pinned snapshot()."""
+        i = int(idx)
+        with self._lock:
+            q = self._replica_queue.get(i)
+            if q is None:
+                lbl = {"replica": str(i)}
+                q = self._registry.gauge("serving_replica_queue_depth",
+                                         labels=lbl)
+                self._replica_queue[i] = q
+                self._replica_inflight[i] = self._registry.gauge(
+                    "serving_replica_inflight", labels=lbl)
+                self._replica_dispatches[i] = self._registry.counter(
+                    "serving_replica_dispatches", labels=lbl)
+            f = self._replica_inflight[i]
+            d = self._replica_dispatches[i]
+        q.set(int(queued))
+        f.set(int(inflight))
+        if dispatched:
+            d.inc(int(dispatched))
+
+    def replica_breakdown(self) -> Dict[str, Dict[str, object]]:
+        """replica index (str) -> {queued_now, queued_max, inflight_now,
+        inflight_max, dispatches}.  Empty for single-replica models that
+        never saw observe_replica — callers gate on truthiness."""
+        with self._lock:
+            out: Dict[str, Dict[str, object]] = {}
+            for i in sorted(self._replica_queue):
+                q = self._replica_queue[i]
+                f = self._replica_inflight[i]
+                d = self._replica_dispatches[i]
+                out[str(i)] = {"queued_now": int(q.value),
+                               "queued_max": int(q.max),
+                               "inflight_now": int(f.value),
+                               "inflight_max": int(f.max),
+                               "dispatches": int(d.value)}
+            return out
 
     def observe_request(self, queue_wait_ms: float, assembly_ms: float,
                         device_ms: float, total_ms: float) -> None:

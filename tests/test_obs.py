@@ -442,7 +442,8 @@ def test_bench_stamp_provenance():
 
     payload = {"metric": "x", "value": 1.0}
     out = bench._stamp(payload)
-    assert out["schema_version"] == bench.BENCH_SCHEMA_VERSION == 2
+    # v3: mesh-serving replica/topology stamps + the serving_mesh leg
+    assert out["schema_version"] == bench.BENCH_SCHEMA_VERSION == 3
     assert "git_sha" in out and "env" in out
     assert all(k.startswith("SPARKNET_") for k in out["env"])
     assert out["value"] == 1.0
