@@ -475,6 +475,50 @@ def bench_serving_mesh(model: str = "lenet", n_requests: int = 192,
     return out
 
 
+def bench_elastic(rounds: int = 6):
+    """Elastic-runtime straggler A/B via `scripts/chaos_run.py --ab` in a
+    subprocess: the same seeded fault plan (one persistent 20× straggler,
+    one crash + snapshot-catch-up join) under the full barrier vs
+    partial-quorum averaging, compared on SIMULATED stall-seconds from
+    round telemetry — deterministic, no wall-clock in the verdict.
+
+    A subprocess because the scenario needs the 8-device virtual CPU
+    mesh (`--xla_force_host_platform_device_count=8`), and this process
+    has already initialised its backend; re-raises on a non-zero exit or
+    a malformed line so the guarded leg in _run_legs omits the fields."""
+    import subprocess
+
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    flags = env.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        env["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8").strip()
+    script = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "scripts", "chaos_run.py")
+    proc = subprocess.run(
+        [sys.executable, script, "--ab", "--rounds", str(rounds)],
+        capture_output=True, text=True, env=env, timeout=600)
+    if proc.returncode != 0:
+        raise RuntimeError(
+            f"chaos_run.py exited {proc.returncode}: "
+            f"{proc.stderr.strip()[-500:]}")
+    # chaos_run prints ONE JSON line on stdout (same contract as bench)
+    rec = json.loads(proc.stdout.strip().splitlines()[-1])
+    if not rec.get("ok"):
+        raise RuntimeError(f"chaos_run.py reported not-ok: {rec}")
+    out = {"elastic_workers": rec["workers"],
+           "elastic_rounds": rec["rounds"],
+           "elastic_joins": rec["joins"],
+           "elastic_crashes": rec["crashes"],
+           "elastic_tau_final": rec["tau_final"],
+           "elastic_full_barrier_stall_s": rec["full_barrier_stall_s"],
+           "elastic_quorum_stall_s": rec["partial_quorum_stall_s"],
+           "elastic_stall_ratio": rec["stall_ratio"]}
+    log(json.dumps(out))
+    return out
+
+
 def bench_longctx_lm(seq_len: int = 16384, n_layers: int = 4,
                      d_model: int = 512, heads: int = 8,
                      block: int = 1024):
@@ -747,6 +791,12 @@ _KNOWN_FIELDS = {
     "serving_mesh_p50_ms", "serving_mesh_p99_ms",
     "serving_single_qps", "serving_single_p50_ms", "serving_single_p99_ms",
     "serving_mesh_speedup", "serving_mesh_compiles",
+    # elastic-runtime straggler A/B (simulated stall-seconds, chaos_run
+    # subprocess on the 8-device virtual CPU mesh)
+    "elastic_workers", "elastic_rounds", "elastic_joins",
+    "elastic_crashes", "elastic_tau_final",
+    "elastic_full_barrier_stall_s", "elastic_quorum_stall_s",
+    "elastic_stall_ratio",
 }
 
 # every leg name main() lands; leg_utc stamps outside this set (renamed
@@ -756,6 +806,7 @@ _KNOWN_LEGS = {
     "alexnet_train", "googlenet_train_b64", "googlenet_train_b128",
     "alexnet_infer", "googlenet_infer", "longctx_lm", "cifar_e2e",
     "imagenet_native", "serving", "serving_int8", "serving_mesh",
+    "elastic",
 }
 
 
@@ -1147,6 +1198,18 @@ def _run_legs(land) -> None:
             "serving_single_qps", "serving_single_p50_ms",
             "serving_single_p99_ms", "serving_mesh_speedup",
             "serving_mesh_compiles")})
+    # elastic straggler A/B (subprocess, virtual CPU mesh — see
+    # bench_elastic docstring); guarded like the other CPU-path legs
+    try:
+        elastic = bench_elastic()
+    except Exception as e:
+        log(f"elastic leg failed, omitting its fields: {e!r}")
+    else:
+        land("elastic", {k: elastic[k] for k in (
+            "elastic_workers", "elastic_rounds", "elastic_joins",
+            "elastic_crashes", "elastic_tau_final",
+            "elastic_full_barrier_stall_s", "elastic_quorum_stall_s",
+            "elastic_stall_ratio")})
     try:
         imgnet_native = bench_imagenet_native()
     except Exception as e:

@@ -1,0 +1,152 @@
+"""Elastic-runtime chaos smoke: 8 virtual workers, one injected straggler,
+one crash, one snapshot-catch-up join — asserts the run completes and
+prints ONE JSON line (the bench.py `elastic` leg subprocess protocol).
+
+Default (smoke) scenario on the 8-device virtual CPU mesh:
+  - worker 1 is a persistent 20× straggler (simulated time — FaultPlan),
+  - worker 2 crashes at round 2,
+  - a fresh worker re-occupies slot 2 at round 4, catching up from the
+    newest stepped snapshot (utils/orbax_ckpt.resolve_latest),
+  - partial-quorum rounds (deadline excludes the straggler) with an
+    adaptive-τ controller and per-round snapshots.
+
+--ab additionally runs the straggler A/B: the same fault plan under the
+full barrier (deadline=None — everyone waited for, reference semantics)
+vs partial quorum, comparing SIMULATED stall-seconds from round
+telemetry — deterministic on a one-core box, no wall-clock in the
+verdict.
+
+Run:  python scripts/chaos_run.py [--rounds 6] [--ab] [--seed 5]
+"""
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+
+# force the 8-device virtual CPU platform BEFORE any backend use; the
+# box's sitecustomize pre-imports jax, so the live-config update is what
+# actually takes effect (tests/conftest.py pattern)
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+
+N_WORKERS = 8
+
+
+def build_solver(tau: int = 2):
+    """Tiny MLP DistributedSolver on ShardedFeeds — small enough that the
+    whole chaos scenario compiles and runs inside the tier-1 budget."""
+    import sparknet_tpu  # noqa: F401  (jax forward-compat graft)
+    from sparknet_tpu.core import layers_dsl as dsl
+    from sparknet_tpu.elastic import ShardedFeed
+    from sparknet_tpu.parallel.dist import DistributedSolver
+    from sparknet_tpu.proto import caffe_pb
+    from sparknet_tpu.proto.textformat import parse
+
+    net = dsl.net_param(
+        "chaos_toy",
+        dsl.memory_data_layer("data", ["data", "label"], batch=16,
+                              channels=1, height=4, width=4),
+        dsl.inner_product_layer("ip1", "data", num_output=8),
+        dsl.relu_layer("relu1", "ip1"),
+        dsl.inner_product_layer("ip2", "ip1", num_output=2),
+        dsl.softmax_with_loss_layer("loss", ["ip2", "label"]),
+    )
+    sp = caffe_pb.SolverParameter(parse(
+        "base_lr: 0.05 lr_policy: 'fixed' momentum: 0.9 random_seed: 7"))
+    solver = DistributedSolver(sp, net_param=net, n_workers=N_WORKERS,
+                               tau=tau, scan_unroll=True)
+
+    def make_stream(shard):
+        rng = np.random.RandomState(1000 + shard)
+
+        def src():
+            x = rng.randn(16, 1, 4, 4).astype(np.float32)
+            return {"data": x,
+                    "label": (x.mean(axis=(1, 2, 3)) > 0).astype(np.int32)}
+        return src
+
+    # two shards per worker so rebalances have something to move
+    solver.set_train_data([ShardedFeed(make_stream, [w, w + N_WORKERS])
+                           for w in range(N_WORKERS)])
+    return solver
+
+
+def run_smoke(rounds: int, seed: int) -> dict:
+    from sparknet_tpu.elastic import (AdaptiveTau, ElasticRuntime,
+                                      FaultPlan)
+
+    with tempfile.TemporaryDirectory(prefix="chaos_snap_") as snapdir:
+        solver = build_solver(tau=2)
+        plan = FaultPlan.from_spec("straggler:1x20,crash:2@2", seed=seed)
+        rt = ElasticRuntime(
+            solver, min_quorum=4, deadline_s=0.5, chaos=plan,
+            adaptive=AdaptiveTau(2, tau_min=1, tau_max=16, patience=2),
+            snapshot_dir=snapdir, snapshot_every=1, step_time_s=0.05,
+            sleep_fn=lambda _t: None)
+        rt.schedule_join(2, 4)
+        losses = rt.run(rounds)
+        st = rt.stats()
+        assert len(losses) == rounds and all(np.isfinite(losses)), losses
+        assert st["leaves"] == 1 and st["joins"] == 1, st
+        assert 2 in rt.active, "joined slot must be active at the end"
+        return {"rounds": rounds, "losses_finite": True,
+                "final_active": len(st["active_workers"]),
+                "joins": st["joins"], "crashes": st["leaves"],
+                "snapshots": st["snapshots"],
+                "stall_sim_s": st["stall_sim_s"], "tau_final": st["tau"],
+                "events": st["events"]}
+
+
+def run_ab(rounds: int, seed: int, mult: float = 20.0) -> dict:
+    from sparknet_tpu.elastic import ElasticRuntime, FaultPlan
+
+    def arm(deadline_s):
+        solver = build_solver(tau=2)
+        plan = FaultPlan(seed=seed, stragglers={1: mult})
+        rt = ElasticRuntime(solver, min_quorum=4, deadline_s=deadline_s,
+                            chaos=plan, step_time_s=0.05,
+                            sleep_fn=lambda _t: None)
+        rt.run(rounds)
+        return rt.stats()["stall_sim_s"]
+
+    full = arm(None)    # full barrier: straggler charged every round
+    quorum = arm(0.5)   # partial quorum: straggler masked out
+    assert quorum < full, (quorum, full)
+    return {"ab_rounds": rounds, "straggler_mult": mult,
+            "full_barrier_stall_s": round(full, 6),
+            "partial_quorum_stall_s": round(quorum, 6),
+            "stall_ratio": round(quorum / full, 6) if full else 0.0}
+
+
+def main() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("--rounds", type=int, default=6)
+    p.add_argument("--seed", type=int, default=5)
+    p.add_argument("--ab", action="store_true",
+                   help="also run the full-barrier vs partial-quorum "
+                        "stall A/B (the bench.py elastic leg)")
+    a = p.parse_args()
+
+    out = {"workers": N_WORKERS, "seed": a.seed}
+    out.update(run_smoke(a.rounds, a.seed))
+    if a.ab:
+        out.update(run_ab(max(4, a.rounds), a.seed))
+    out["ok"] = True
+    print(json.dumps(out), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -45,7 +45,7 @@ import numpy as np
 def run_point(nw: int, tau, iters: int, xtr, ytr, test_batches,
               mean, emit, *, test_interval: int, num_test_batches: int,
               lr1_iters: int = 0, sync_history: str = "local",
-              dcn_interval: int = 1) -> float:
+              dcn_interval: int = 1, elastic=None) -> float:
     """Train one (n_workers, τ) configuration; returns final accuracy.
     tau="sync" selects per-step gradient pmean (mode="sync", the
     P2PSync analogue) instead of τ-step weight averaging.
@@ -53,7 +53,11 @@ def run_point(nw: int, tau, iters: int, xtr, ytr, test_batches,
     each weight average (dist.py docstring — the τ=1 interference fix).
     dcn_interval>1 runs the two-tier (dcn, workers) mesh: 2 slices of
     nw/2, ICI-averaging every round and crossing the dcn axis only
-    every dcn_interval-th round (dist.py two-level averaging)."""
+    every dcn_interval-th round (dist.py two-level averaging).
+    elastic: optional dict of ElasticRuntime knobs (main's --elastic
+    flags) — rounds then run through the partial-quorum controller, and
+    adaptive τ may move the averaging interval mid-stage (feeds' τ is
+    kept in sync by the runtime)."""
     from sparknet_tpu.apps.cifar_app import WorkerFeed, build_solver
     from sparknet_tpu.data import partition as part
 
@@ -75,6 +79,27 @@ def run_point(nw: int, tau, iters: int, xtr, ytr, test_batches,
              for w, (x, y) in enumerate(shards)]
     solver.set_train_data(feeds)
 
+    runtime = None
+    if elastic:
+        from sparknet_tpu.elastic import (AdaptiveTau, ElasticRuntime,
+                                          FaultPlan)
+
+        if mode == "sync":
+            raise SystemExit("--elastic requires an averaging point "
+                             "(tau != 'sync')")
+        chaos = (FaultPlan.from_spec(elastic["chaos"],
+                                     seed=elastic.get("seed", 0))
+                 if elastic.get("chaos") else None)
+        adaptive = (AdaptiveTau(solver.tau,
+                                tau_min=elastic.get("tau_min", 1),
+                                tau_max=elastic.get("tau_max", 64))
+                    if elastic.get("adaptive") else None)
+        runtime = ElasticRuntime(solver,
+                                 min_quorum=elastic.get("min_quorum"),
+                                 deadline_s=elastic.get("deadline_s"),
+                                 chaos=chaos, adaptive=adaptive,
+                                 sleep_fn=lambda _t: None)
+
     state = {"i": 0}
 
     def test_source():
@@ -86,13 +111,14 @@ def run_point(nw: int, tau, iters: int, xtr, ytr, test_batches,
 
     def run_stage(stage_iters: int, stage: str) -> float:
         acc = 0.0
-        rounds = stage_iters // tau
+        target = solver.iter + stage_iters
         t0 = time.time()
-        for r in range(rounds):
+        while solver.iter < target:
             for f in feeds:
                 f.new_round()
-            loss = solver.run_round()
-            if solver.iter % test_interval == 0 or r == rounds - 1:
+            loss = (runtime.run_round() if runtime is not None
+                    else solver.run_round())
+            if solver.iter % test_interval == 0 or solver.iter >= target:
                 state["i"] = 0
                 scores = solver.test()
                 acc = float(scores.get("accuracy", 0.0))
@@ -114,6 +140,9 @@ def run_point(nw: int, tau, iters: int, xtr, ytr, test_batches,
         solver.param.msg.set("base_lr", base_lr / 10)
         solver._round_fns.clear()
         acc = run_stage(lr1_iters, f"lr{base_lr / 10:g}")
+    if runtime is not None:
+        emit(dict(event="elastic_stats", n_workers=nw,
+                  **runtime.stats()))
     return acc
 
 
@@ -139,7 +168,28 @@ def main() -> None:
                         "ACCURACY.md protocol (the conv net needs the "
                         "full budget), larger saturates early")
     p.add_argument("--out", default="")
+    p.add_argument("--elastic", action="store_true",
+                   help="run every averaging point through the elastic "
+                        "runtime (partial quorum; sparknet_tpu/elastic)")
+    p.add_argument("--chaos", default="",
+                   help="fault spec for --elastic, e.g. "
+                        "'straggler:1x20,crash:2@3' (chaos.py grammar)")
+    p.add_argument("--chaos-seed", type=int, default=0)
+    p.add_argument("--deadline-s", type=float, default=None,
+                   help="simulated per-round report deadline (omit = "
+                        "full barrier)")
+    p.add_argument("--min-quorum", type=int, default=None)
+    p.add_argument("--adaptive-tau", action="store_true")
+    p.add_argument("--tau-min", type=int, default=1)
+    p.add_argument("--tau-max", type=int, default=64)
     a = p.parse_args()
+
+    elastic_cfg = None
+    if a.elastic:
+        elastic_cfg = dict(chaos=a.chaos, seed=a.chaos_seed,
+                           deadline_s=a.deadline_s, min_quorum=a.min_quorum,
+                           adaptive=a.adaptive_tau, tau_min=a.tau_min,
+                           tau_max=a.tau_max)
 
     from scripts.accuracy_run import synthetic_cifar_hard
     from sparknet_tpu.utils.compile_cache import (apply_platform_env,
@@ -207,7 +257,8 @@ def main() -> None:
         acc = run_point(nw, tau, a.iters, xtr, ytr, test_batches, mean,
                         emit, test_interval=a.test_interval,
                         num_test_batches=a.test_batches,
-                        sync_history=hist, dcn_interval=dcn)
+                        sync_history=hist, dcn_interval=dcn,
+                        elastic=elastic_cfg)
         finals[spec] = acc
         emit(dict(event="point_done", n_workers=nw, tau=tau,
                   sync_history=hist, dcn_interval=dcn,
@@ -221,7 +272,7 @@ def main() -> None:
                         mean, emit, test_interval=500,
                         num_test_batches=len(test_batches),
                         lr1_iters=a.full_lr1_iters, sync_history=hist,
-                        dcn_interval=dcn)
+                        dcn_interval=dcn, elastic=elastic_cfg)
         emit(dict(event="full_done", n_workers=nw, tau=tau,
                   sync_history=hist, dcn_interval=dcn,
                   iters=a.full_iters + a.full_lr1_iters,

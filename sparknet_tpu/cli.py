@@ -23,6 +23,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 from typing import Dict, Optional
 
@@ -190,6 +191,35 @@ def _train_distributed(args, sp, net, batches=None) -> int:
         solver.set_train_data([shared] * n)
     if getattr(args, "round_log", None):
         solver.set_round_log(args.round_log)
+    runtime = None
+    if getattr(args, "elastic", False):
+        if args.mode != "average":
+            raise SystemExit("--elastic requires --mode average: partial "
+                             "quorum masks the τ-interval weight average")
+        from .elastic import AdaptiveTau, ElasticRuntime, FaultPlan
+
+        chaos = None
+        if args.chaos:
+            seed = (args.chaos_seed if args.chaos_seed is not None
+                    else int(os.environ.get("SPARKNET_CHAOS_SEED", "0")
+                             or 0))
+            try:
+                chaos = FaultPlan.from_spec(args.chaos, seed=seed)
+            except ValueError as e:
+                raise SystemExit(str(e)) from None
+        adaptive = None
+        if args.adaptive_tau:
+            tau_min = (args.tau_min if args.tau_min is not None
+                       else int(os.environ.get("SPARKNET_TAU_MIN", "1")))
+            tau_max = (args.tau_max if args.tau_max is not None
+                       else int(os.environ.get("SPARKNET_TAU_MAX", "64")))
+            adaptive = AdaptiveTau(solver.tau, tau_min=tau_min,
+                                   tau_max=tau_max)
+        runtime = ElasticRuntime(solver, min_quorum=args.min_quorum,
+                                 deadline_s=args.deadline_s, chaos=chaos,
+                                 adaptive=adaptive,
+                                 snapshot_dir=args.snapshot_dir,
+                                 snapshot_every=args.snapshot_every)
     n_iters = args.iterations or int(sp.max_iter) or 100
     # round logging rides through PhaseLogger (context-managed: the
     # --train_log file closes even when a round raises), echoing to
@@ -199,7 +229,8 @@ def _train_distributed(args, sp, net, batches=None) -> int:
             PhaseLogger(path=getattr(args, "train_log", None),
                         stream=sys.stdout) as plog:
         while solver.iter < n_iters:
-            loss = solver.run_round()
+            loss = (runtime.run_round() if runtime is not None
+                    else solver.run_round())
             plog(f"Iteration {solver.iter}, lr = "
                  f"{solver.current_lr():.8g}")
             plog(f"Iteration {solver.iter}, loss = {loss:.6f} "
@@ -450,6 +481,40 @@ def main(argv=None) -> int:
                    help="append one JSON line of per-round telemetry per "
                         "round to this file (workers > 1; see DISTACC.md; "
                         "SPARKNET_ROUND_LOG env is the API-level knob)")
+    t.add_argument("--elastic", action="store_true",
+                   help="wrap the distributed loop in the elastic runtime "
+                        "(partial-quorum rounds, README 'Elastic "
+                        "training'); workers > 1, --mode average only")
+    t.add_argument("--min_quorum", type=int,
+                   help="fewest reporting workers a round may average "
+                        "(default workers//2, or "
+                        "SPARKNET_ELASTIC_MIN_QUORUM)")
+    t.add_argument("--deadline_s", type=float,
+                   help="per-round report deadline in simulated seconds; "
+                        "omit for the full barrier "
+                        "(SPARKNET_ELASTIC_DEADLINE_S)")
+    t.add_argument("--chaos", default="",
+                   help="fault-injection spec, e.g. "
+                        "'straggler:1x20,crash:2@3,drop:0.05' "
+                        "(elastic/chaos.py grammar)")
+    t.add_argument("--chaos_seed", type=int,
+                   help="fault-plan seed (default SPARKNET_CHAOS_SEED "
+                        "env, else 0)")
+    t.add_argument("--adaptive_tau", action="store_true",
+                   help="grow/shrink tau with the stall/communication "
+                        "balance, within [--tau_min, --tau_max]")
+    t.add_argument("--tau_min", type=int,
+                   help="adaptive-tau floor (default SPARKNET_TAU_MIN "
+                        "env, else 1)")
+    t.add_argument("--tau_max", type=int,
+                   help="adaptive-tau ceiling (default SPARKNET_TAU_MAX "
+                        "env, else 64)")
+    t.add_argument("--snapshot_dir",
+                   help="stepped-snapshot root for elastic join "
+                        "catch-up (utils/orbax_ckpt.save_step)")
+    t.add_argument("--snapshot_every", type=int,
+                   help="snapshot cadence in rounds under --snapshot_dir "
+                        "(default SPARKNET_ELASTIC_SNAPSHOT_EVERY env)")
     t.set_defaults(fn=cmd_train)
 
     te = sub.add_parser("test")

@@ -7,7 +7,7 @@ CifarApp.scala:50-68).
 
 from __future__ import annotations
 
-from typing import List, Sequence, Tuple
+from typing import Dict, List, Sequence, Tuple
 
 import numpy as np
 
@@ -28,3 +28,60 @@ def partition(images: np.ndarray, labels: np.ndarray, n_workers: int,
     per = len(labels) // n_workers
     return [(images[w * per:(w + 1) * per], labels[w * per:(w + 1) * per])
             for w in range(n_workers)]
+
+
+# --------------------------------------------------- elastic repartitioning
+# The elastic runtime (sparknet_tpu/elastic/) keeps a fixed universe of
+# dataset shards and a shard -> worker assignment; workers joining or
+# leaving mid-run trigger a REBALANCE, not a reshuffle — an unaffected
+# worker must keep its shards (its host-side caches and pull cursors stay
+# warm), which is the property tests/test_elastic.py pins.
+
+def initial_assignment(n_shards: int,
+                       workers: Sequence[int]) -> Dict[int, int]:
+    """Round-robin shard -> worker map over the sorted worker ids."""
+    ws = sorted(set(int(w) for w in workers))
+    if not ws:
+        raise ValueError("initial_assignment needs at least one worker")
+    if n_shards < 1:
+        raise ValueError(f"need at least one shard, got {n_shards}")
+    return {s: ws[s % len(ws)] for s in range(int(n_shards))}
+
+
+def rebalance(assignment: Dict[int, int],
+              active: Sequence[int]) -> Dict[int, int]:
+    """Deterministic minimal-move repartition to a new active-worker set.
+
+    Orphaned shards (owner no longer active) go to the least-loaded
+    active worker (ties: lowest worker id), in shard-id order; then loads
+    are evened to within one shard by moving the highest-numbered shard
+    off the most-loaded worker.  Consequences, pinned by the property
+    test: a LEAVE moves only the leaver's shards; a JOIN moves shards
+    only onto the joiner; every shard is always owned by exactly one
+    active worker; loads stay balanced within 1."""
+    ws = sorted(set(int(w) for w in active))
+    if not ws:
+        raise ValueError("rebalance needs at least one active worker")
+    out = {int(s): int(w) for s, w in assignment.items()}
+    loads = {w: 0 for w in ws}
+    for s in sorted(out):
+        if out[s] in loads:
+            loads[out[s]] += 1
+    for s in sorted(s for s in out if out[s] not in loads):
+        w = min(ws, key=lambda w: (loads[w], w))
+        out[s] = w
+        loads[w] += 1
+    while True:
+        lo = min(ws, key=lambda w: (loads[w], w))
+        hi = max(ws, key=lambda w: (loads[w], -w))
+        if loads[hi] - loads[lo] <= 1:
+            return out
+        s = max(s for s in out if out[s] == hi)
+        out[s] = lo
+        loads[hi] -= 1
+        loads[lo] += 1
+
+
+def shards_of(assignment: Dict[int, int], worker: int) -> List[int]:
+    """Sorted shard ids a worker owns under an assignment."""
+    return sorted(s for s, w in assignment.items() if w == int(worker))

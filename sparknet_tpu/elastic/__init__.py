@@ -1,0 +1,12 @@
+"""Elastic training runtime: partial-quorum rounds over a fixed worker
+mesh, slot-based join/leave with snapshot catch-up, deterministic fault
+injection, and an adaptive-τ controller.  See runtime.py for the design
+and the simulation/time model that makes every behavior testable on the
+8-virtual-device CPU mesh."""
+
+from .chaos import FaultPlan
+from .runtime import ElasticRuntime, QuorumError, ShardedFeed
+from .tau import AdaptiveTau
+
+__all__ = ["AdaptiveTau", "ElasticRuntime", "FaultPlan", "QuorumError",
+           "ShardedFeed"]

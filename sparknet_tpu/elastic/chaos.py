@@ -1,0 +1,134 @@
+"""Deterministic fault injection for the elastic runtime.
+
+A FaultPlan is a pure function of (seed, round, slot, attempt): the same
+plan replayed against the same schedule produces the identical fault
+sequence, so every elastic behavior — drops, stragglers, crashes, quorum
+retries — is testable on the 8-virtual-device CPU mesh with bitwise
+reproducibility (tests/test_elastic.py pins two full runs equal).  No
+wall-clock or global RNG state enters any decision; "time" in a plan is
+SIMULATED seconds derived from τ and a per-step cost model, which is what
+lets the straggler A/B acceptance hold on a one-core box.
+
+Spec grammar (``FaultPlan.from_spec``), comma-separated tokens:
+
+    straggler:<slot>x<mult>   slot runs <mult>× slower every round
+    crash:<slot>@<round>      slot crashes permanently at round
+    drop:<prob>               every (round, slot) drops with prob
+    delay:<prob>@<seconds>    transient extra delay with prob
+
+e.g. ``straggler:1x20,crash:2@3,drop:0.05``.  Malformed specs die with a
+ValueError naming the bad token (the repo-wide parser contract: never an
+IndexError out of a parse).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import struct
+from typing import Dict, Optional
+
+
+def _u01(seed: int, *keys) -> float:
+    """Uniform [0,1) as a pure hash of (seed, *keys) — query-order
+    independent, unlike a stateful RNG stream, so a retry loop that asks
+    about slots in any order sees the same draws."""
+    h = hashlib.sha256(repr((int(seed),) + tuple(keys)).encode()).digest()
+    return struct.unpack("<Q", h[:8])[0] / float(1 << 64)
+
+
+@dataclasses.dataclass(frozen=True)
+class FaultPlan:
+    """Seeded per-run fault schedule.
+
+    stragglers: slot -> simulated per-step slowdown multiplier (>= 1).
+    crashes: slot -> round at which the slot permanently crashes.
+    drop_prob: per-(round, slot, attempt) chance a report is lost.
+    delay_prob/delay_s: per-(round, slot, attempt) transient extra delay.
+    """
+
+    seed: int = 0
+    stragglers: Dict[int, float] = dataclasses.field(default_factory=dict)
+    crashes: Dict[int, int] = dataclasses.field(default_factory=dict)
+    drop_prob: float = 0.0
+    delay_prob: float = 0.0
+    delay_s: float = 0.0
+
+    def __post_init__(self):
+        for slot, mult in self.stragglers.items():
+            if mult < 1.0:
+                raise ValueError(f"straggler multiplier for slot {slot} "
+                                 f"must be >= 1, got {mult}")
+        for p, what in ((self.drop_prob, "drop_prob"),
+                        (self.delay_prob, "delay_prob")):
+            if not 0.0 <= p <= 1.0:
+                raise ValueError(f"{what} must be in [0, 1], got {p}")
+
+    # ------------------------------------------------------------- queries
+    def straggler_mult(self, slot: int) -> float:
+        return float(self.stragglers.get(int(slot), 1.0))
+
+    def crash_round(self, slot: int) -> Optional[int]:
+        r = self.crashes.get(int(slot))
+        return None if r is None else int(r)
+
+    def crashed(self, round_idx: int, slot: int) -> bool:
+        r = self.crash_round(slot)
+        return r is not None and round_idx >= r
+
+    def drops(self, round_idx: int, slot: int, attempt: int = 0) -> bool:
+        if self.drop_prob <= 0.0:
+            return False
+        return _u01(self.seed, "drop", round_idx, slot,
+                    attempt) < self.drop_prob
+
+    def transient_delay_s(self, round_idx: int, slot: int,
+                          attempt: int = 0) -> float:
+        if self.delay_prob <= 0.0 or self.delay_s <= 0.0:
+            return 0.0
+        if _u01(self.seed, "delay", round_idx, slot,
+                attempt) < self.delay_prob:
+            return float(self.delay_s)
+        return 0.0
+
+    def report_s(self, round_idx: int, slot: int, base_s: float,
+                 attempt: int = 0) -> float:
+        """Simulated seconds until this slot's round report: base τ-step
+        cost scaled by its straggler multiplier, plus any transient
+        delay drawn for (round, slot, attempt)."""
+        return (float(base_s) * self.straggler_mult(slot)
+                + self.transient_delay_s(round_idx, slot, attempt))
+
+    # -------------------------------------------------------------- parser
+    @classmethod
+    def from_spec(cls, spec: str, seed: int = 0) -> "FaultPlan":
+        """Parse the comma-separated token grammar (module docstring)."""
+        stragglers: Dict[int, float] = {}
+        crashes: Dict[int, int] = {}
+        drop_prob = delay_prob = delay_s = 0.0
+        for raw in (t.strip() for t in (spec or "").split(",")):
+            if not raw:
+                continue
+            kind, sep, rest = raw.partition(":")
+            try:
+                if kind == "straggler" and sep:
+                    slot, _, mult = rest.partition("x")
+                    stragglers[int(slot)] = float(mult)
+                elif kind == "crash" and sep:
+                    slot, _, rnd = rest.partition("@")
+                    crashes[int(slot)] = int(rnd)
+                elif kind == "drop" and sep:
+                    drop_prob = float(rest)
+                elif kind == "delay" and sep:
+                    prob, _, secs = rest.partition("@")
+                    delay_prob, delay_s = float(prob), float(secs)
+                else:
+                    raise ValueError("unknown token kind")
+            except ValueError as e:
+                raise ValueError(
+                    f"malformed chaos spec token {raw!r} in {spec!r}: {e} "
+                    f"(grammar: straggler:<slot>x<mult>, crash:<slot>@<r>, "
+                    f"drop:<p>, delay:<p>@<s>)") from None
+        return cls(seed=int(seed), stragglers=stragglers, crashes=crashes,
+                   drop_prob=drop_prob, delay_prob=delay_prob,
+                   delay_s=delay_s)

@@ -1,0 +1,421 @@
+"""Elastic training runtime: partial-quorum rounds, membership changes
+with snapshot catch-up, and adaptive τ — layered over DistributedSolver.
+
+The reference's driver loop is rigidly synchronous: `collect` waits for
+every executor, so one straggler stalls the fleet and one lost executor
+kills the job (reference: CifarApp.scala:95-136 collect over all
+workers).  This runtime keeps the solver's ONE-fused-program-per-round
+design and adds the backup-worker/partial-quorum recipe on top
+(PAPERS.md: "TensorFlow: A system for large-scale machine learning",
+§4.4): every round still computes all worker shards, but only the slots
+that "reported" inside the deadline enter the τ-interval average —
+a masked psum (dist.py masked round variant) — and dropped slots adopt
+the quorum average, which is precisely the periodic-averaging form of
+straggler re-sync.
+
+Membership is SLOT-based: the mesh's worker axis is fixed at
+construction, and elasticity is which slots are ACTIVE.  A leave/crash
+deactivates a slot (its shard assignment is deterministically rebalanced
+onto the survivors, data/partition.py); a join reactivates a slot,
+catching it up from the newest stepped snapshot (utils/orbax_ckpt.py
+resolve_latest) or, with no snapshot yet, from a live peer replica, then
+entering at the next round barrier.
+
+Everything the controller decides — deadlines, drops, stragglers, stall
+seconds, τ moves — runs on SIMULATED time derived from a FaultPlan and a
+per-step cost model, never wall-clock, so chaos runs replay bitwise on
+the 8-virtual-device CPU mesh (tests/test_elastic.py pins two runs
+producing identical event logs AND identical final params).
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..data.partition import initial_assignment, rebalance, shards_of
+from ..obs.metrics import MetricsRegistry
+from ..utils.orbax_ckpt import resolve_latest, restore_auto, save_step
+from .chaos import FaultPlan
+from .tau import AdaptiveTau
+
+
+class QuorumError(RuntimeError):
+    """A round could not assemble min_quorum reports within max_retries."""
+
+
+def _env_int(name: str, default: int) -> int:
+    v = os.environ.get(name, "").strip()
+    return int(v) if v else default
+
+
+def _env_float(name: str, default: Optional[float]) -> Optional[float]:
+    v = os.environ.get(name, "").strip()
+    return float(v) if v else default
+
+
+class ShardedFeed:
+    """A per-worker data source that draws round-robin from an assigned
+    set of dataset shards, each backed by its own lazily-created stream.
+
+    `set_shards` re-targets the feed when the elastic runtime rebalances
+    (data/partition.rebalance); streams persist across reassignment so a
+    shard returning to a worker resumes from its cursor (warm), and the
+    pull sequence is a pure function of the assignment history —
+    deterministic under chaos replay.  `stream_safe` marks the feed
+    round-agnostic for DistributedSolver's prefetch guard; the elastic
+    runtime itself refuses prefetch (τ can change between rounds)."""
+
+    stream_safe = True
+
+    def __init__(self, make_stream: Callable[[int], Callable[[], dict]],
+                 shard_ids: Sequence[int]) -> None:
+        self._make = make_stream
+        self._streams: Dict[int, Callable[[], dict]] = {}
+        self._ids: List[int] = []
+        self._i = 0
+        self.set_shards(shard_ids)
+
+    @property
+    def shard_ids(self) -> List[int]:
+        return list(self._ids)
+
+    def set_shards(self, ids: Sequence[int]) -> None:
+        self._ids = sorted(int(s) for s in ids)
+        if not self._ids:
+            raise ValueError("ShardedFeed needs at least one shard")
+        for s in self._ids:
+            if s not in self._streams:
+                self._streams[s] = self._make(s)
+
+    def __call__(self) -> dict:
+        s = self._ids[self._i % len(self._ids)]
+        self._i += 1
+        return self._streams[s]()
+
+
+class ElasticRuntime:
+    """Membership/round controller over a DistributedSolver.
+
+    deadline_s=None is the FULL BARRIER: every active slot is waited for
+    (and its simulated report time charged to stall), the reference
+    semantics.  A finite deadline turns rounds into partial-quorum:
+    slots whose simulated report exceeds it are masked out, subject to
+    `min_quorum` — a round below quorum retries with exponential backoff
+    (`sleep_fn` injectable so tests pass a recording stub) and dies with
+    QuorumError after `max_retries`.
+
+    step_time_s and comm_gbps are the simulation cost model: a round's
+    base report time is τ·step_time_s scaled per-slot by the fault
+    plan's straggler multipliers, and the communication cost fed to the
+    adaptive-τ controller is param_bytes_moved / comm_gbps — both
+    deterministic, which makes the A/B acceptance (strictly fewer
+    stall-seconds under partial quorum) a telemetry fact, not a timing
+    race."""
+
+    def __init__(self, solver, *,
+                 min_quorum: Optional[int] = None,
+                 deadline_s: Optional[float] = None,
+                 chaos: Optional[FaultPlan] = None,
+                 adaptive: Optional[AdaptiveTau] = None,
+                 snapshot_dir: Optional[str] = None,
+                 snapshot_every: Optional[int] = None,
+                 step_time_s: float = 0.05,
+                 comm_gbps: float = 1.0,
+                 max_retries: int = 3,
+                 backoff_s: float = 0.05,
+                 sleep_fn: Optional[Callable[[float], None]] = None,
+                 metrics: Optional[MetricsRegistry] = None) -> None:
+        if solver.mode != "average":
+            raise ValueError("ElasticRuntime requires mode='average' — "
+                             "partial quorum masks the τ-interval average")
+        if solver.has_dcn:
+            raise ValueError("ElasticRuntime runs on a flat worker mesh; "
+                             "the (dcn, workers) hierarchy is unsupported")
+        if solver._prefetch:
+            raise ValueError(
+                "ElasticRuntime is incompatible with prefetch: adaptive τ "
+                "changes the staged batch shape between rounds — call "
+                "set_prefetch(False) first")
+        self.solver = solver
+        n = solver.n_workers
+        self.min_quorum = (min_quorum if min_quorum is not None
+                           else _env_int("SPARKNET_ELASTIC_MIN_QUORUM",
+                                         max(1, n // 2)))
+        if not 1 <= self.min_quorum <= n:
+            raise ValueError(f"min_quorum must be in [1, {n}], got "
+                             f"{self.min_quorum}")
+        self.deadline_s = (deadline_s if deadline_s is not None
+                           else _env_float("SPARKNET_ELASTIC_DEADLINE_S",
+                                           None))
+        self.chaos = chaos
+        self.adaptive = adaptive
+        self.snapshot_dir = snapshot_dir
+        self.snapshot_every = (snapshot_every if snapshot_every is not None
+                               else _env_int(
+                                   "SPARKNET_ELASTIC_SNAPSHOT_EVERY", 0))
+        self.step_time_s = float(step_time_s)
+        self.comm_gbps = float(comm_gbps)
+        self.max_retries = int(max_retries)
+        self.backoff_s = float(backoff_s)
+        self.sleep_fn = sleep_fn if sleep_fn is not None else time.sleep
+        self.active = set(range(n))
+        self.events: List[Dict[str, Any]] = []
+        self.stall_sim_s = 0.0
+        self._scheduled_joins: Dict[int, int] = {}
+        # a planned crash fires ONCE: a slot that later rejoins (fresh
+        # worker occupying the freed slot) must not be re-crashed by the
+        # same plan entry
+        self._crashes_applied: set = set()
+        self._assignment: Optional[Dict[int, int]] = None
+        srcs = solver.train_sources or []
+        if srcs and all(hasattr(s, "set_shards") for s in srcs):
+            # runtime-managed sharding: seed the assignment from what the
+            # feeds currently own so rebalances preserve warm shards
+            self._assignment = {}
+            for w, s in enumerate(srcs):
+                for sid in s.shard_ids:
+                    self._assignment[sid] = w
+        m = metrics if metrics is not None else MetricsRegistry()
+        self.metrics = m
+        self._g_quorum = m.gauge("elastic_quorum")
+        self._g_active = m.gauge("elastic_active_workers")
+        self._g_tau = m.gauge("elastic_tau")
+        self._h_stall = m.histogram("elastic_stall_sim_seconds", window=4096)
+        self._c_rounds = m.counter("elastic_rounds")
+        self._c_retries = m.counter("elastic_quorum_retries")
+        self._c_drops = m.counter("elastic_dropped_reports")
+        self._c_leaves = m.counter("elastic_leaves")
+        self._c_joins = m.counter("elastic_joins")
+        self._c_snaps = m.counter("elastic_snapshots")
+        self._g_active.set(len(self.active))
+        self._g_tau.set(solver.tau)
+
+    # ------------------------------------------------------------- events
+    def _event(self, kind: str, **fields) -> Dict[str, Any]:
+        rec = self.solver.append_round_event(kind, **fields)
+        self.events.append(rec)
+        return rec
+
+    # --------------------------------------------------------- membership
+    def leave(self, slot: int, reason: str = "leave") -> None:
+        """Deactivate a slot: its reports stop entering rounds and its
+        shards rebalance onto the survivors.  The slot's replica keeps
+        computing inside the fused program (simulation-inherent); its
+        results are masked out of every average."""
+        slot = int(slot)
+        if slot not in self.active:
+            raise ValueError(f"slot {slot} is not active")
+        if len(self.active) == 1:
+            raise QuorumError("cannot deactivate the last active worker")
+        self.active.discard(slot)
+        moved: List[int] = []
+        if self._assignment is not None:
+            new = rebalance(self._assignment, sorted(self.active))
+            moved = sorted(s for s in new if new[s] != self._assignment[s])
+            self._assignment = new
+            self._apply_assignment()
+        self._c_leaves.inc()
+        self._g_active.set(len(self.active))
+        self._event(reason, slot=slot, active=sorted(self.active),
+                    moved_shards=moved)
+
+    def schedule_join(self, slot: int, round_idx: int) -> None:
+        """Arm a join to happen at the round_idx round barrier (run())."""
+        self._scheduled_joins[int(slot)] = int(round_idx)
+
+    def join(self, slot: int) -> None:
+        """Reactivate a slot at the current round barrier, catching its
+        replica up from the newest stepped snapshot under snapshot_dir
+        (orbax or native — resolve_latest finds either), or from a live
+        peer replica when no snapshot exists yet."""
+        slot = int(slot)
+        if slot in self.active:
+            raise ValueError(f"slot {slot} is already active")
+        path = (resolve_latest(self.snapshot_dir)
+                if self.snapshot_dir else None)
+        if path is not None:
+            _it, params, state = restore_auto(path)
+            source = os.path.basename(path)
+        else:
+            peer = min(self.active)
+            params = {k: np.asarray(v[peer])
+                      for k, v in self.solver.params_w.items()}
+            state = {k: tuple(np.asarray(h[peer]) for h in hs)
+                     for k, hs in self.solver.state_w.items()}
+            source = f"peer:{peer}"
+        self._install_slot(slot, params, state)
+        self.active.add(slot)
+        moved: List[int] = []
+        if self._assignment is not None:
+            new = rebalance(self._assignment, sorted(self.active))
+            moved = sorted(s for s in new if new[s] != self._assignment[s])
+            self._assignment = new
+            self._apply_assignment()
+        self._c_joins.inc()
+        self._g_active.set(len(self.active))
+        self._event("join", slot=slot, source=source,
+                    active=sorted(self.active), moved_shards=moved)
+
+    def _apply_assignment(self) -> None:
+        for w in sorted(self.active):
+            src = self.solver.train_sources[w]
+            src.set_shards(shards_of(self._assignment, w))
+
+    def _install_slot(self, slot: int, params: Dict[str, Any],
+                      state: Dict[str, tuple]) -> None:
+        """Overwrite one worker row of params_w/state_w host-side and
+        re-shard — the catch-up transfer a real joiner would receive."""
+        solver = self.solver
+        pw = {}
+        for k, v in solver.params_w.items():
+            a = np.asarray(v).copy()
+            a[slot] = np.asarray(params[k], dtype=a.dtype)
+            pw[k] = jnp.asarray(a)
+        solver.params_w = jax.device_put(pw, solver._wsh)
+        sw = {}
+        for k, hs in solver.state_w.items():
+            rows = []
+            for i, h in enumerate(hs):
+                a = np.asarray(h).copy()
+                a[slot] = np.asarray(state[k][i], dtype=a.dtype)
+                rows.append(jnp.asarray(a))
+            sw[k] = tuple(rows)
+        solver.state_w = jax.device_put(sw, solver._wsh)
+
+    # ---------------------------------------------------------- snapshots
+    def snapshot(self) -> Optional[str]:
+        """Stepped snapshot of the lowest ACTIVE replica (post-average all
+        included replicas are equal; slot 0 may be crashed, so "worker 0"
+        is not the safe choice here the way it is in solver.snapshot)."""
+        if not self.snapshot_dir:
+            return None
+        slot = min(self.active)
+        solver = self.solver
+        params = {k: np.asarray(v[slot])
+                  for k, v in solver.params_w.items()}
+        state = {k: tuple(np.asarray(h[slot]) for h in hs)
+                 for k, hs in solver.state_w.items()}
+        path = save_step(self.snapshot_dir, solver.round, solver.iter,
+                         params, state)
+        self._c_snaps.inc()
+        self._event("snapshot", step=solver.round, slot=slot,
+                    path=os.path.basename(path))
+        return path
+
+    # -------------------------------------------------------------- rounds
+    def run_round(self) -> float:
+        """One elastic round: apply scheduled crashes, assemble a quorum
+        under the (simulated) deadline with retry/backoff, dispatch the
+        masked round, account simulated stall, drive the adaptive-τ
+        controller, and cut the snapshot cadence."""
+        solver = self.solver
+        r = solver.round
+        if self.chaos is not None:
+            for slot in sorted(self.active):
+                if (self.chaos.crashed(r, slot)
+                        and slot not in self._crashes_applied):
+                    self._crashes_applied.add(slot)
+                    self.leave(slot, reason="crash")
+        base_s = solver.tau * self.step_time_s
+        attempt = 0
+        while True:
+            report: Dict[int, float] = {}
+            dropped: List[int] = []
+            for slot in sorted(self.active):
+                if self.chaos is not None:
+                    if self.chaos.drops(r, slot, attempt):
+                        dropped.append(slot)
+                        continue
+                    report[slot] = self.chaos.report_s(r, slot, base_s,
+                                                       attempt)
+                else:
+                    report[slot] = base_s
+            if dropped:
+                self._c_drops.inc(len(dropped))
+            if self.deadline_s is not None:
+                included = {s: t for s, t in report.items()
+                            if t <= self.deadline_s}
+            else:
+                included = report  # full barrier: wait for every report
+            if len(included) >= self.min_quorum:
+                break
+            attempt += 1
+            self._c_retries.inc()
+            self._event("quorum_retry", attempt=attempt,
+                        reported=sorted(included),
+                        dropped=dropped, need=self.min_quorum)
+            if attempt > self.max_retries:
+                raise QuorumError(
+                    f"round {r}: only {len(included)} of "
+                    f"{len(self.active)} active workers reported "
+                    f"(min_quorum={self.min_quorum}) after "
+                    f"{self.max_retries} retries")
+            self.sleep_fn(self.backoff_s * (2 ** (attempt - 1)))
+        # simulated straggler stall: how long the round barrier waited
+        # past the FASTEST included report — zero when included reports
+        # are balanced, (mult-1)·τ·step under a straggler that made the
+        # cut.  Dropped-by-deadline slots charge nothing: that is the
+        # entire point of partial quorum, and what the A/B pins.
+        stall = (max(included.values()) - min(included.values())
+                 if included else 0.0)
+        mask = np.zeros(solver.n_workers, dtype=np.float32)
+        mask[sorted(included)] = 1.0
+        loss = solver.run_round(mask=mask)
+        self.stall_sim_s += stall
+        self._h_stall.observe(stall)
+        self._c_rounds.inc()
+        self._g_quorum.set(len(included))
+        self._g_tau.set(solver.tau)
+        self._event("elastic_round", round_idx=r, quorum=len(included),
+                    included=sorted(included),
+                    missing=sorted(set(range(solver.n_workers))
+                                   - set(included)),
+                    stall_sim_s=round(stall, 6),
+                    tau_effective=solver.tau, attempts=attempt)
+        if self.adaptive is not None:
+            comm_s = (2 * (solver.n_workers - 1) * solver._param_bytes
+                      / (self.comm_gbps * 1e9))
+            new_tau = self.adaptive.update(stall, comm_s)
+            if new_tau != solver.tau:
+                old = solver.tau
+                solver.set_tau(new_tau)
+                for src in solver.train_sources or []:
+                    if hasattr(src, "tau"):
+                        src.tau = new_tau
+                self._g_tau.set(new_tau)
+                self._event("tau_change", tau_from=old, tau_to=new_tau,
+                            stall_s=round(stall, 6),
+                            comm_s=round(comm_s, 6))
+        if (self.snapshot_dir and self.snapshot_every
+                and solver.round % self.snapshot_every == 0):
+            self.snapshot()
+        return loss
+
+    def run(self, n_rounds: int) -> List[float]:
+        """Drive n_rounds, admitting scheduled joins at round barriers."""
+        losses = []
+        for _ in range(int(n_rounds)):
+            r = self.solver.round
+            for slot, jr in sorted(self._scheduled_joins.items()):
+                if jr <= r and slot not in self.active:
+                    self.join(slot)
+            losses.append(self.run_round())
+        return losses
+
+    def stats(self) -> Dict[str, Any]:
+        return {"rounds": int(self._c_rounds.value),
+                "active_workers": sorted(self.active),
+                "stall_sim_s": round(self.stall_sim_s, 6),
+                "tau": self.solver.tau,
+                "quorum_retries": int(self._c_retries.value),
+                "dropped_reports": int(self._c_drops.value),
+                "leaves": int(self._c_leaves.value),
+                "joins": int(self._c_joins.value),
+                "snapshots": int(self._c_snaps.value),
+                "events": len(self.events)}

@@ -1,0 +1,67 @@
+"""Adaptive-τ controller: move the averaging interval with the observed
+straggler-stall / communication balance.
+
+SparkNet's tradeoff (PAPER.md; the paper's §3 analysis): larger τ
+amortizes each synchronization over more local steps — exactly what you
+want when waiting on stragglers (stall) dominates the cost of a round —
+but too-large τ slows convergence per iteration.  The controller grows τ
+(doubling, like TCP slow-start in reverse) while stall dominates the
+communication cost for `patience` consecutive rounds, shrinks it back
+(halving) when rounds are balanced, and always clamps to
+[tau_min, tau_max].  Inputs are taken from round telemetry
+(DistributedSolver.round_stats() / the elastic runtime's simulated stall
+clock), never wall-clock direct, so controller trajectories are
+deterministic in tests.
+"""
+
+from __future__ import annotations
+
+
+class AdaptiveTau:
+    """Hysteretic doubling/halving controller over τ.
+
+    update(stall_s, comm_s) returns the τ to use NEXT round:
+      ratio = stall_s / max(comm_s, eps)
+      ratio > grow_ratio  for `patience` consecutive rounds -> τ *= 2
+      ratio < shrink_ratio for `patience` consecutive rounds -> τ //= 2
+    clamped to [tau_min, tau_max].  The consecutive-round hysteresis is
+    what keeps one noisy round from flapping τ (and recompiling the
+    round program) — the round-fn cache in DistributedSolver makes an
+    oscillation cheap anyway, but a stable τ keeps the telemetry legible.
+    """
+
+    def __init__(self, tau0: int, *, tau_min: int = 1, tau_max: int = 64,
+                 grow_ratio: float = 1.0, shrink_ratio: float = 0.25,
+                 patience: int = 2) -> None:
+        if tau_min < 1:
+            raise ValueError(f"tau_min must be >= 1, got {tau_min}")
+        if tau_max < tau_min:
+            raise ValueError(f"tau_max ({tau_max}) < tau_min ({tau_min})")
+        if shrink_ratio >= grow_ratio:
+            raise ValueError(
+                f"shrink_ratio ({shrink_ratio}) must be below grow_ratio "
+                f"({grow_ratio}) — equal thresholds flap")
+        if patience < 1:
+            raise ValueError(f"patience must be >= 1, got {patience}")
+        self.tau_min, self.tau_max = int(tau_min), int(tau_max)
+        self.grow_ratio, self.shrink_ratio = grow_ratio, shrink_ratio
+        self.patience = int(patience)
+        self.tau = min(max(int(tau0), self.tau_min), self.tau_max)
+        self._hi = 0
+        self._lo = 0
+
+    def update(self, stall_s: float, comm_s: float) -> int:
+        ratio = float(stall_s) / max(float(comm_s), 1e-9)
+        if ratio > self.grow_ratio:
+            self._hi, self._lo = self._hi + 1, 0
+        elif ratio < self.shrink_ratio:
+            self._hi, self._lo = 0, self._lo + 1
+        else:
+            self._hi = self._lo = 0
+        if self._hi >= self.patience:
+            self._hi = 0
+            self.tau = min(self.tau * 2, self.tau_max)
+        elif self._lo >= self.patience:
+            self._lo = 0
+            self.tau = max(self.tau // 2, self.tau_min)
+        return self.tau

@@ -16,6 +16,11 @@ Workloads:
 - ``train-round``: a tiny DistributedSolver on synthetic data for a few
                    rounds — exercises dist.round/stage/dispatch/sync and
                    the ingest spans, then prints solver.round_stats().
+- ``train-elastic``: the train-round toy behind an ElasticRuntime with a
+                   seeded 20× straggler under partial-quorum deadlines —
+                   exercises the masked round plus the elastic metrics
+                   (quorum/active/τ gauges, simulated-stall histogram),
+                   then prints the runtime's stats() snapshot.
 
 Output path: --out wins, else SPARKNET_TRACE, else /tmp/sparknet_trace.json.
 The trace loads in https://ui.perfetto.dev or chrome://tracing; the
@@ -118,6 +123,55 @@ def _workload_train_round(rounds: int = 2, workers: int = 1) -> None:
     print(json.dumps({k: v for k, v in stats.items() if k != "per_round"}))
 
 
+def _workload_train_elastic(rounds: int = 3, workers: int = 2) -> None:
+    import json
+
+    from ..elastic import ElasticRuntime, FaultPlan
+    from ..parallel.dist import DistributedSolver
+    from ..proto import caffe_pb
+
+    net_text = """
+        name: 'trace_toy'
+        layer { name: 'data' type: 'MemoryData' top: 'data' top: 'label'
+                memory_data_param { batch_size: 16 channels: 1
+                                    height: 8 width: 8 } }
+        layer { name: 'ip1' type: 'InnerProduct' bottom: 'data' top: 'ip1'
+                inner_product_param { num_output: 16 } }
+        layer { name: 'relu1' type: 'ReLU' bottom: 'ip1' top: 'ip1' }
+        layer { name: 'ip2' type: 'InnerProduct' bottom: 'ip1' top: 'ip2'
+                inner_product_param { num_output: 4 } }
+        layer { name: 'loss' type: 'SoftmaxWithLoss' bottom: 'ip2'
+                bottom: 'label' top: 'loss' }
+    """
+    sp_text = ("base_lr: 0.05 lr_policy: 'fixed' momentum: 0.9 "
+               "random_seed: 7")
+    net = caffe_pb.parse_net_text(net_text)
+    sparam = caffe_pb.SolverParameter(caffe_pb.parse(sp_text))
+    solver = DistributedSolver(sparam, net_param=net, n_workers=workers,
+                               tau=3, scan_unroll=True)
+
+    def stream(seed):
+        rng = np.random.RandomState(seed)
+
+        def src():
+            return {"data": rng.rand(16, 1, 8, 8).astype(np.float32),
+                    "label": rng.randint(0, 4, 16).astype(np.int32)}
+        return src
+
+    solver.set_train_data([stream(w) for w in range(workers)])
+    # the straggler needs a peer to be masked against; a 1-worker run
+    # (the CLI default) exercises the plain quorum path instead
+    strag = {workers - 1: 20.0} if workers > 1 else {}
+    rt = ElasticRuntime(solver, min_quorum=1, deadline_s=0.5,
+                        chaos=FaultPlan(seed=1, stragglers=strag),
+                        step_time_s=0.05, sleep_fn=lambda _t: None)
+    for _ in range(rounds):
+        loss = rt.run_round()
+    print(f"final round loss = {loss:.6f}")
+    print(json.dumps({k: v for k, v in rt.stats().items()
+                      if k != "events"}))
+
+
 def cmd_trace(args) -> int:
     out = (args.out or os.environ.get("SPARKNET_TRACE")
            or "/tmp/sparknet_trace.json")
@@ -127,6 +181,9 @@ def cmd_trace(args) -> int:
             _workload_time()
         elif args.workload == "serve":
             _workload_serve(n_requests=args.requests)
+        elif args.workload == "train-elastic":
+            _workload_train_elastic(rounds=args.rounds,
+                                    workers=args.workers)
         else:
             _workload_train_round(rounds=args.rounds,
                                   workers=args.workers)
@@ -143,7 +200,8 @@ def register(sub) -> None:
         "trace", help="run a short workload with the span tracer armed; "
                       "write Chrome-trace JSON + text summary (obs/)")
     s.add_argument("--workload", default="time",
-                   choices=["time", "serve", "train-round"])
+                   choices=["time", "serve", "train-round",
+                            "train-elastic"])
     s.add_argument("--out",
                    help="trace path (default: SPARKNET_TRACE env, then "
                         "/tmp/sparknet_trace.json)")

@@ -34,7 +34,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..data.counters import IngestCounters
 from ..obs.metrics import MetricsRegistry
-from ..obs.trace import device_annotation, span, timed_span
+from ..obs.trace import device_annotation, now_s, span, timed_span
 from ..data.pipeline import (PipelinedIngestExecutor, default_prefetch_depth,
                              default_pull_workers)
 from ..proto.caffe_pb import NetParameter, SolverParameter
@@ -46,7 +46,7 @@ from ..solver.solver import (DataSource, accumulate_test_outputs,
                              parse_slot_arrays, resolve_precision,
                              resolve_solverstate_path, save_params_file,
                              write_native_snapshot)
-from .mesh import DCN_AXIS, WORKER_AXIS, make_mesh
+from .mesh import DCN_AXIS, WORKER_AXIS, make_mesh, worker_rows
 
 
 def _stack_tree(tree, n: int):
@@ -174,7 +174,16 @@ class DistributedSolver:
         self._ingest_exec = None  # PipelinedIngestExecutor while prefetching
         self._ingest_counters = IngestCounters()
         self._num_test_batches = 0
-        self._round_fns: Dict[bool, Any] = {}
+        # compiled round programs, keyed (tau, avg_dcn, masked): the
+        # elastic runtime's adaptive-τ controller flips τ mid-run and a
+        # keyed cache reuses both compiles when it oscillates
+        self._round_fns: Dict[Any, Any] = {}
+        # elastic hooks: per-worker staging wall-seconds from the LAST
+        # serially staged round, and an optional deadline policy
+        # `hook(round_idx, stage_seconds) -> mask or None` consulted by
+        # run_round when the caller passes no explicit mask
+        self._stage_worker_s: Dict[int, float] = {}
+        self.round_deadline_hook = None
         self._test_step = jax.jit(self._build_test_step())
         # the model under test is the replica MEAN — identical to worker 0
         # right after a global averaging round, and the reference's
@@ -204,19 +213,31 @@ class DistributedSolver:
         self._round_log_warned = False
 
     # ----------------------------------------------------------------- build
-    def _round_fn(self, avg_dcn: bool = True):
+    def _round_fn(self, avg_dcn: bool = True, masked: bool = False):
         if self.mode == "sync":
             avg_dcn = True  # flag unused in sync mode; avoid a 2nd compile
-        if avg_dcn not in self._round_fns:
-            self._round_fns[avg_dcn] = self._build_round_fn(avg_dcn)
-        return self._round_fns[avg_dcn]
+        key = (self.tau, avg_dcn, masked)
+        if key not in self._round_fns:
+            self._round_fns[key] = self._build_round_fn(avg_dcn,
+                                                        masked=masked)
+        return self._round_fns[key]
 
-    def _build_round_fn(self, avg_dcn: bool = True):
+    def _build_round_fn(self, avg_dcn: bool = True, masked: bool = False):
         tau = self.tau
         mode = self.mode
         sync_history = self.sync_history
         axis = WORKER_AXIS
         has_dcn = self.has_dcn
+        if masked:
+            if mode != "average":
+                raise ValueError(
+                    "partial-quorum (masked) rounds require mode='average': "
+                    "sync mode has no τ-interval average to mask")
+            if has_dcn:
+                raise ValueError(
+                    "partial-quorum (masked) rounds are not supported on a "
+                    "(dcn, workers) hierarchical mesh — run the elastic "
+                    "runtime on a flat worker mesh")
         # sync mode always syncs globally; average mode crosses DCN only on
         # avg_dcn rounds (the dcn_interval hierarchy)
         sync_axes = (DCN_AXIS, WORKER_AXIS) if has_dcn else WORKER_AXIS
@@ -238,19 +259,20 @@ class DistributedSolver:
             stepper = fuse_transform_into_step(self.device_transform,
                                                stepper)
 
-        def round_shard(params, state, it0, batches, rng):
+        def round_shard(params, state, it0, batches, rng, wmask=None):
             # labels this round's XLA ops when SPARKNET_JAX_ANNOTATE=1;
             # inert nullcontext otherwise (profiler RPCs can wedge the
             # axon tunnel)
             with device_annotation("sparknet.dist_round"):
-                return _round_shard(params, state, it0, batches, rng)
+                return _round_shard(params, state, it0, batches, rng, wmask)
 
-        def _round_shard(params, state, it0, batches, rng):
+        def _round_shard(params, state, it0, batches, rng, wmask):
             # shard_map hands us the leading worker-block of size 1: strip it.
             params = jax.tree.map(lambda a: a[0], params)
             state = jax.tree.map(lambda a: a[0], state)
             batches = jax.tree.map(lambda a: a[0], batches)
             rng = rng[0]
+            w = wmask[0] if masked else None
 
             def body(carry, xs):
                 p, s, it = carry
@@ -271,6 +293,36 @@ class DistributedSolver:
                 (params, state, _), losses = jax.lax.scan(
                     body, (params, state, it0), (batches, step_rngs),
                     unroll=self.scan_unroll)
+            if masked:
+                # partial-quorum average: psum of mask-scaled replica
+                # contributions over the worker axis, divided by the
+                # quorum size.  Scaling by 1.0 is the bitwise identity and
+                # a 0.0-scaled replica is bitwise-neutral inside the psum
+                # chain, so the result EQUALS the dense average over just
+                # the included workers (tests/test_elastic.py pins this
+                # bitwise on the CPU mesh).  The psum replicates the
+                # result to EVERY slot — dropped workers adopt the quorum
+                # average too, the straggler re-sync semantics of the
+                # backup-worker recipe (PAPERS.md: TensorFlow §4.4).
+                wsum = jax.lax.psum(w, axis)
+
+                def mavg(t):
+                    return jax.tree.map(
+                        lambda a: jax.lax.psum(a * w.astype(a.dtype), axis)
+                        / wsum.astype(a.dtype), t)
+
+                params = mavg(params)
+                if sync_history == "average":
+                    state = mavg(state)
+                elif sync_history == "reset":
+                    state = jax.tree.map(jnp.zeros_like, state)
+                # quorum-mean loss: dropped workers' losses are excluded
+                # from the reported round loss the same way their weights
+                # are excluded from the average
+                loss = jax.lax.psum(jnp.mean(losses) * w, axis) / wsum
+                return (jax.tree.map(lambda a: a[None], params),
+                        jax.tree.map(lambda a: a[None], state),
+                        loss)
             if mode == "average":
                 # the τ-interval weight average (WeightCollection mean,
                 # Net.scala:14-47) as one ICI collective...
@@ -297,9 +349,12 @@ class DistributedSolver:
                     loss)
 
         wspec = self._dataspec
+        in_specs = (wspec, wspec, P(), wspec, wspec)
+        if masked:
+            in_specs = in_specs + (wspec,)
         mapped = shard_map(
             round_shard, mesh=self.mesh,
-            in_specs=(wspec, wspec, P(), wspec, wspec),
+            in_specs=in_specs,
             out_specs=(wspec, wspec, P()),
             check_vma=False)
         return jax.jit(mapped, donate_argnums=(0, 1))
@@ -378,7 +433,7 @@ class DistributedSolver:
             return list(range(self.n_workers))
         # leading-dim shard w owns the w-th row of the device grid (the
         # trailing model axis, if any, replicates within the row)
-        rows = np.asarray(self.mesh.devices).reshape(self.n_workers, -1)
+        rows = worker_rows(self.mesh, self.n_workers)
         pid = jax.process_index()
         return [w for w in range(self.n_workers)
                 if any(d.process_index == pid for d in rows[w])]
@@ -437,11 +492,16 @@ class DistributedSolver:
                 f"({jax.process_count()} processes)")
         c = self._ingest_counters
         single = jax.process_count() == 1
-        rows = (np.asarray(self.mesh.devices).reshape(self.n_workers, -1)
-                if single else None)
+        rows = worker_rows(self.mesh, self.n_workers) if single else None
+        # fresh per-round map so the deadline hook never reads a stale
+        # worker's time after membership changed (written per-worker below;
+        # distinct keys, so concurrent pool writes don't race)
+        stage_s: Dict[int, float] = {}
+        self._stage_worker_s = stage_s
 
         def stage_worker(w: int):
             src = self.train_sources[w]
+            t0 = now_s()
             with span("ingest.stage_worker", worker=w, round=round_idx,
                       tau=self.tau):
                 with c.timed("pull", items=self.tau):
@@ -450,15 +510,18 @@ class DistributedSolver:
                     stacked = {k: np.stack([p[k] for p in pulls])
                                for k in pulls[0]}
                 if not single:
+                    stage_s[w] = now_s() - t0
                     return stacked
                 # eager dispatch: this worker's block starts its copy now
                 # (model-parallel rows get the same host block on every
                 # device in the row, matching the replicated trailing axes
                 # of _wsh)
                 with c.timed("device_put"):
-                    return {k: [jax.device_put(v[None], d)
-                                for d in rows[w]]
-                            for k, v in stacked.items()}
+                    out = {k: [jax.device_put(v[None], d)
+                               for d in rows[w]]
+                           for k, v in stacked.items()}
+                stage_s[w] = now_s() - t0
+                return out
 
         per_worker = self._map_workers(stage_worker, local)
         if single:
@@ -556,9 +619,44 @@ class DistributedSolver:
             self._round_log_path = None
             self._round_log_file = None
 
+    def append_round_event(self, event: str, **fields) -> Dict[str, Any]:
+        """Append a non-round EVENT record to the armed round JSONL (join/
+        leave/crash/τ-change lines from the elastic runtime).  Event
+        records carry an `event` key so round-record consumers can filter
+        them; they do NOT enter round_stats()'s per_round list — those
+        records keep one stable schema."""
+        rec: Dict[str, Any] = {"event": event, "round": self.round,
+                               "iter": self.iter}
+        rec.update(fields)
+        self._append_round_log(rec)
+        return rec
+
+    def set_tau(self, tau: int) -> None:
+        """Change τ between rounds (the adaptive-τ controller's lever).
+        Compiled round programs are cached per (τ, flags), so oscillating
+        between two values re-uses both compiles.  Refused while prefetch
+        is armed: staged rounds were pulled with the OLD τ and would
+        dispatch mis-shaped batch stacks."""
+        tau = int(tau)
+        if self.mode != "average":
+            raise ValueError("set_tau requires mode='average': sync mode "
+                             "averages gradients every step (τ is fixed 1)")
+        if tau < 1:
+            raise ValueError(f"tau must be >= 1, got {tau}")
+        if tau == self.tau:
+            return
+        if self._prefetch or self._ingest_exec is not None:
+            raise ValueError(
+                "set_tau while prefetch is armed would dispatch staged "
+                "batch stacks of the old τ — call set_prefetch(False) and "
+                "drain staged rounds first")
+        self.tau = tau
+
     def _record_round(self, round_idx: int, iter_start: int, loss: float,
                       avg_dcn: bool, broadcast_s: float, dispatch_s: float,
-                      collect_s: float, stall_s: float) -> None:
+                      collect_s: float, stall_s: float,
+                      quorum: Optional[int] = None,
+                      missing_workers: Optional[List[int]] = None) -> None:
         h = self._round_hists
         h["broadcast"].observe(broadcast_s)
         h["dispatch"].observe(dispatch_s)
@@ -586,7 +684,15 @@ class DistributedSolver:
                "stall_s": round(stall_s, 6),
                "param_bytes": self._param_bytes,
                "param_bytes_moved": moved,
-               "avg_dcn": bool(avg_dcn)}
+               "avg_dcn": bool(avg_dcn),
+               # elastic extension (appended so pre-elastic consumers of
+               # the JSONL see byte-identical prefixes for dense rounds):
+               # quorum = workers whose τ-step work entered the average;
+               # tau_effective = the τ in force THIS round (the adaptive
+               # controller moves self.tau between rounds)
+               "quorum": n if quorum is None else int(quorum),
+               "missing_workers": sorted(missing_workers or []),
+               "tau_effective": self.tau}
         self._round_records.append(rec)
         self._append_round_log(rec)
 
@@ -628,7 +734,28 @@ class DistributedSolver:
             it = max(0, self.iter - 1)
         return float(learning_rate(self.param, it))
 
-    def run_round(self, prefetch_next: Optional[bool] = None) -> float:
+    def _normalize_mask(self, mask) -> Optional[np.ndarray]:
+        """Validate a per-worker inclusion mask; None when dense.  An
+        all-ones mask short-circuits to the dense program (same numerics,
+        no second compile)."""
+        if mask is None:
+            return None
+        arr = np.asarray(mask, dtype=np.float32).reshape(-1)
+        if arr.shape[0] != self.n_workers:
+            raise ValueError(f"mask must have one entry per worker "
+                             f"({self.n_workers}), got shape {arr.shape}")
+        if not np.all((arr == 0.0) | (arr == 1.0)):
+            raise ValueError("mask entries must be 0 or 1")
+        if arr.sum() < 1:
+            raise ValueError("mask drops every worker — a round needs at "
+                             "least one participant (raise the deadline "
+                             "or retry, elastic/runtime.py does)")
+        if arr.sum() == self.n_workers:
+            return None
+        return arr
+
+    def run_round(self, prefetch_next: Optional[bool] = None, *,
+                  mask=None) -> float:
         """One outer round: τ local steps per worker + weight average
         (reference: one iteration of the while(true) driver loop,
         CifarApp.scala:95-136).  Returns mean loss over the round.
@@ -647,7 +774,15 @@ class DistributedSolver:
         rounds drain in order on subsequent calls rather than being
         discarded (a discard would silently offset the streams).  A pull
         failure raises on the run_round that reaches the failed round —
-        never a silently offset stream."""
+        never a silently offset stream.
+
+        `mask`: optional per-worker 0/1 inclusion vector — a PARTIAL-QUORUM
+        round: only mask=1 workers' τ-step results enter the average, and
+        every worker (dropped ones included) adopts the quorum average
+        (straggler re-sync).  All-ones degenerates to the dense program.
+        When no mask is passed and `round_deadline_hook` is set, the hook
+        is consulted with this round's per-worker staging seconds and may
+        return a mask (the elastic runtime's deadline policy)."""
         round_idx, iter_start = self.round, self.iter
         with span("dist.round", round=round_idx, tau=self.tau,
                   workers=self.n_workers) as rsp:
@@ -677,13 +812,32 @@ class DistributedSolver:
             avg_dcn = (not self.has_dcn
                        or self.round % self.dcn_interval
                        == self.dcn_interval - 1)
+            if mask is None and self.round_deadline_hook is not None:
+                mask = self.round_deadline_hook(round_idx,
+                                                dict(self._stage_worker_s))
+            marr = self._normalize_mask(mask)
+            quorum = missing = None
+            if marr is not None:
+                quorum = int(marr.sum())
+                missing = [i for i in range(self.n_workers)
+                           if marr[i] == 0.0]
             # async dispatch: the jitted round returns immediately, so the
             # float(loss) fetch below is what overlaps the coordinator's
             # staging of the next rounds
             with timed_span("dist.dispatch", round=round_idx) as t_disp:
-                self.params_w, self.state_w, loss = self._round_fn(avg_dcn)(
-                    self.params_w, self.state_w, jnp.int32(self.iter),
-                    batches, rngs)
+                if marr is None:
+                    self.params_w, self.state_w, loss = \
+                        self._round_fn(avg_dcn)(
+                            self.params_w, self.state_w,
+                            jnp.int32(self.iter), batches, rngs)
+                else:
+                    local = np.asarray(self.local_worker_ids())
+                    wdev = self._put_worker_major(
+                        marr if jax.process_count() == 1 else marr[local])
+                    self.params_w, self.state_w, loss = \
+                        self._round_fn(avg_dcn, masked=True)(
+                            self.params_w, self.state_w,
+                            jnp.int32(self.iter), batches, rngs, wdev)
             self.iter += self.tau
             self.round += 1
             # "collect" leg: the VALUE fetch of the round loss is the only
@@ -696,7 +850,8 @@ class DistributedSolver:
                                t_stage.elapsed_s, t_disp.elapsed_s,
                                t_sync.elapsed_s,
                                self._ingest_counters.seconds("stall")
-                               - stall0)
+                               - stall0,
+                               quorum=quorum, missing_workers=missing)
             rsp.set(loss=round(loss_f, 6),
                     broadcast_s=round(t_stage.elapsed_s, 6),
                     tau_steps_s=round(t_disp.elapsed_s + t_sync.elapsed_s,

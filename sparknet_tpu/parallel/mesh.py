@@ -91,6 +91,14 @@ def init_distributed(coordinator_address: Optional[str] = None,
                                process_id=process_id)
 
 
+def worker_rows(mesh: Mesh, n_workers: int) -> np.ndarray:
+    """Device grid reshaped to one row per worker: row w holds worker w's
+    device(s) — a single chip in pure DP, the replicated trailing model
+    axis otherwise.  The per-worker placement map used by staging and by
+    the elastic runtime's membership accounting."""
+    return np.asarray(mesh.devices).reshape(n_workers, -1)
+
+
 def worker_sharding(mesh: Mesh) -> NamedSharding:
     """Leading-axis sharding over workers (per-replica stacked data/params)."""
     return NamedSharding(mesh, P(WORKER_AXIS))

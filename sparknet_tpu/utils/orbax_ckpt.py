@@ -17,10 +17,13 @@ directory); extensioned paths keep the npz/caffe formats.
 from __future__ import annotations
 
 import os
+import re
 from typing import Any, Callable, Dict, Optional, Tuple
 
 import jax
 import numpy as np
+
+_STEP_RE = re.compile(r"^step_(\d+)(\.npz)?$")
 
 
 def _checkpointer():
@@ -65,6 +68,53 @@ def restore_auto(path: str, *, known_params=None,
     from ..solver.solver import parse_native_snapshot
 
     return parse_native_snapshot(path)
+
+
+# ------------------------------------------------ stepped snapshot roots
+# The elastic runtime snapshots every few rounds under one root directory
+# so a joining worker can catch up from "whatever the newest snapshot is"
+# without coordinating a filename with the writer (role of
+# Solver::SnapshotFilename, reference: caffe/src/caffe/solver.cpp:421-431,
+# generalized to a resolve-latest directory scan).
+
+def step_path(root: str, step: int) -> str:
+    """Canonical per-step snapshot location under a root directory."""
+    return os.path.join(root, f"step_{int(step):08d}")
+
+
+def save_step(root: str, step: int, it: int, params, state) -> str:
+    """Write a stepped snapshot under `root` and return its path.
+
+    Delegates to save_auto, so the artifact is an orbax directory when
+    orbax is installed and a native `.npz` triple otherwise — either
+    form is found again by latest_step/resolve_latest."""
+    os.makedirs(root, exist_ok=True)
+    return save_auto(step_path(root, step), it, params, state)
+
+
+def latest_step(root: str) -> Optional[int]:
+    """Highest step number with a snapshot under `root`, or None."""
+    if not os.path.isdir(root):
+        return None
+    steps = [int(m.group(1)) for m in
+             (_STEP_RE.match(fn) for fn in os.listdir(root)) if m]
+    return max(steps) if steps else None
+
+
+def resolve_latest(root: str) -> Optional[str]:
+    """Path of the newest stepped snapshot under `root`, or None.
+
+    Prefers the orbax directory form over a same-step `.npz` fallback
+    artifact (both can coexist after an orbax install appears mid-run)."""
+    step = latest_step(root)
+    if step is None:
+        return None
+    p = step_path(root, step)
+    if os.path.isdir(p):
+        return p
+    if os.path.exists(p + ".npz"):
+        return p + ".npz"
+    return None
 
 
 def save(path: str, it: int, params: Dict[str, jax.Array],

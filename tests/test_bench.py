@@ -304,3 +304,54 @@ def test_persist_leg_never_raises_on_malformed_record(tmp_path,
     bench._persist_leg("future", {"future_metric": 7.0})
     rec = _json.loads(lg.read_text())
     assert rec["future_metric"] == 7.0
+
+
+def test_bench_elastic_leg_contract(monkeypatch):
+    """The elastic leg runs chaos_run.py --ab in a SUBPROCESS (it needs
+    its own 8-device backend) and parses one JSON line; pin the field
+    contract against _KNOWN_FIELDS/_KNOWN_LEGS and the failure modes
+    (non-zero exit, not-ok record) that the guarded leg relies on to
+    omit fields rather than stale the record.  The live subprocess path
+    is exercised by tests/test_elastic.py's chaos-marked smoke."""
+    import json as _json
+    import subprocess
+
+    import bench
+
+    canned = {"workers": 8, "seed": 5, "rounds": 6, "losses_finite": True,
+              "final_active": 8, "joins": 1, "crashes": 1, "snapshots": 6,
+              "stall_sim_s": 0.0, "tau_final": 1, "events": 11,
+              "ab_rounds": 6, "straggler_mult": 20.0,
+              "full_barrier_stall_s": 11.4, "partial_quorum_stall_s": 0.0,
+              "stall_ratio": 0.0, "ok": True}
+
+    class _Proc:
+        returncode = 0
+        stderr = ""
+        stdout = "ignored progress line\n" + _json.dumps(canned) + "\n"
+
+    calls = []
+
+    def fake_run(cmd, **kw):
+        calls.append(cmd)
+        return _Proc()
+
+    monkeypatch.setattr(subprocess, "run", fake_run)
+    r = bench.bench_elastic()
+    assert calls and calls[0][1].endswith("chaos_run.py")
+    assert "--ab" in calls[0]
+    assert r["elastic_full_barrier_stall_s"] == 11.4
+    assert r["elastic_quorum_stall_s"] == 0.0
+    assert r["elastic_joins"] == 1 and r["elastic_crashes"] == 1
+    assert set(r) <= bench._KNOWN_FIELDS
+    assert "elastic" in bench._KNOWN_LEGS
+
+    _Proc.returncode = 1
+    _Proc.stderr = "boom"
+    with pytest.raises(RuntimeError, match="exited 1"):
+        bench.bench_elastic()
+    _Proc.returncode = 0
+    canned["ok"] = False
+    _Proc.stdout = _json.dumps(canned) + "\n"
+    with pytest.raises(RuntimeError, match="not-ok"):
+        bench.bench_elastic()

@@ -1,0 +1,554 @@
+"""Elastic training runtime (sparknet_tpu/elastic/ + the masked-round
+variant in parallel/dist.py).
+
+Pins the PR-10 acceptance set on the 8-virtual-device CPU mesh:
+  - masked partial-quorum average == dense average over the remaining
+    workers, BITWISE (the psum chain is left-to-right sequential float32
+    addition on the host mesh);
+  - a crash at round R and a snapshot-catch-up join at R+2 both
+    complete, and two identical chaos runs produce identical event logs
+    AND bitwise-identical final params (simulated-time determinism);
+  - the injected-straggler A/B: strictly fewer SIMULATED stall-seconds
+    under partial quorum than the full barrier, from round telemetry;
+  - adaptive τ converges upward to tau_max under a persistent straggler
+    behind the full barrier, stays within [tau_min, tau_max], and logs
+    every move as a tau_change event record.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+N = 8  # the conftest virtual mesh width
+
+
+# ------------------------------------------------------------ fixtures
+
+def toy_solver(workers=N, tau=2, mode="average"):
+    from sparknet_tpu.core import layers_dsl as dsl
+    from sparknet_tpu.parallel.dist import DistributedSolver
+    from sparknet_tpu.proto import caffe_pb
+    from sparknet_tpu.proto.textformat import parse
+
+    net = dsl.net_param(
+        "elastic_toy",
+        dsl.memory_data_layer("data", ["data", "label"], batch=16,
+                              channels=1, height=4, width=4),
+        dsl.inner_product_layer("ip1", "data", num_output=8),
+        dsl.relu_layer("relu1", "ip1"),
+        dsl.inner_product_layer("ip2", "ip1", num_output=2),
+        dsl.softmax_with_loss_layer("loss", ["ip2", "label"]),
+    )
+    sp = caffe_pb.SolverParameter(parse(
+        "base_lr: 0.05 lr_policy: 'fixed' momentum: 0.9 random_seed: 7"))
+    solver = DistributedSolver(sp, net_param=net, n_workers=workers,
+                               tau=tau, mode=mode, scan_unroll=True)
+    solver.set_train_data([_stream(w) for w in range(workers)])
+    return solver
+
+
+def _stream(seed):
+    rng = np.random.RandomState(seed)
+
+    def src():
+        x = rng.randn(16, 1, 4, 4).astype(np.float32)
+        return {"data": x,
+                "label": (x.mean(axis=(1, 2, 3)) > 0).astype(np.int32)}
+    return src
+
+
+def sharded_solver(workers=N, tau=2):
+    """toy solver fed by ShardedFeeds (2 shards/worker) so the elastic
+    runtime manages the shard assignment."""
+    from sparknet_tpu.elastic import ShardedFeed
+
+    solver = toy_solver(workers, tau)
+
+    def make_stream(shard):
+        return _stream(1000 + shard)
+
+    solver.set_train_data([ShardedFeed(make_stream, [w, w + workers])
+                           for w in range(workers)])
+    return solver
+
+
+# --------------------------------------- masked rounds (parallel/dist.py)
+
+def test_masked_average_bitwise_equals_dense_over_remaining():
+    """THE quorum-correctness pin: a round that drops worker k must land
+    exactly the float32 average of the remaining workers' post-τ local
+    params — not approximately (averaging is the algorithm's semantic
+    core; a silently-skewed masked mean would corrupt every elastic
+    run).  Locals are extracted with onehot masks (every slot then holds
+    worker i's local result), the reference average is sequential
+    left-to-right host float32 — bitwise what the psum chain computes on
+    the virtual mesh."""
+    s = toy_solver()
+    p0 = jax.tree.map(np.asarray, s.params_w)
+    st0 = jax.tree.map(np.asarray, s.state_w)
+
+    def reset():
+        s.params_w = jax.device_put(
+            {k: jnp.asarray(v) for k, v in p0.items()}, s._wsh)
+        s.state_w = jax.device_put(jax.tree.map(jnp.asarray, st0), s._wsh)
+        s.iter = 0
+        s.round = 0
+        s.set_train_data([_stream(w) for w in range(N)])
+
+    locals_ = []
+    for i in range(N):
+        reset()
+        mask = np.zeros(N)
+        mask[i] = 1.0
+        s.run_round(mask=mask)
+        pw = {k: np.asarray(v) for k, v in s.params_w.items()}
+        for k, v in pw.items():  # every slot adopted worker i's locals
+            for j in range(1, N):
+                assert np.array_equal(v[0], v[j]), (k, i, j)
+        locals_.append({k: v[0].copy() for k, v in pw.items()})
+
+    k_drop = 3
+    reset()
+    mask = np.ones(N)
+    mask[k_drop] = 0.0
+    s.run_round(mask=mask)
+    got = {k: np.asarray(v)[0] for k, v in s.params_w.items()}
+    for k in got:
+        acc = None
+        for i in range(N):
+            if i == k_drop:
+                continue
+            acc = (locals_[i][k].copy() if acc is None
+                   else acc + locals_[i][k])
+        ref = acc / np.float32(N - 1)
+        assert got[k].dtype == ref.dtype
+        assert np.array_equal(got[k], ref), k
+
+    # round record: quorum keys appended at the END (prior keys stay
+    # byte-stable for pre-elastic JSONL consumers)
+    rec = s.round_stats()["per_round"][-1]
+    assert rec["quorum"] == N - 1
+    assert rec["missing_workers"] == [k_drop]
+    assert rec["tau_effective"] == s.tau
+    assert list(rec)[-3:] == ["quorum", "missing_workers", "tau_effective"]
+    full = s.round_stats()["per_round"][0]  # onehot rounds: quorum 1
+    assert full["quorum"] == 1 and len(full["missing_workers"]) == N - 1
+
+    # set_tau mid-run: next round runs τ=4 (iter advances by 4)
+    it0 = s.iter
+    s.set_tau(4)
+    s.run_round()
+    assert s.iter == it0 + 4
+    assert s.round_stats()["per_round"][-1]["tau_effective"] == 4
+
+
+def test_normalize_mask_validation():
+    s = toy_solver()
+    assert s._normalize_mask(None) is None
+    assert s._normalize_mask(np.ones(N)) is None  # all-ones -> dense
+    with pytest.raises(ValueError, match="one entry per worker"):
+        s._normalize_mask(np.ones(N - 1))
+    with pytest.raises(ValueError, match="0 or 1"):
+        s._normalize_mask(np.full(N, 0.5))
+    with pytest.raises(ValueError, match="at least one participant"):
+        s._normalize_mask(np.zeros(N))
+
+
+def test_set_tau_guards():
+    s = toy_solver()
+    with pytest.raises(ValueError, match="tau must be >= 1"):
+        s.set_tau(0)
+    s.set_tau(3)
+    assert s.tau == 3
+    s_sync = toy_solver(mode="sync")
+    with pytest.raises(ValueError, match="mode='average'"):
+        s_sync.set_tau(2)
+
+
+# --------------------------------------------------- chaos.py (FaultPlan)
+
+def test_fault_plan_spec_and_queries():
+    from sparknet_tpu.elastic import FaultPlan
+
+    p = FaultPlan.from_spec("straggler:1x20, crash:2@3, drop:0.5,"
+                            "delay:0.25@2.0", seed=11)
+    assert p.straggler_mult(1) == 20.0 and p.straggler_mult(0) == 1.0
+    assert p.crash_round(2) == 3 and p.crash_round(5) is None
+    assert not p.crashed(2, 2) and p.crashed(3, 2) and p.crashed(9, 2)
+    # report_s: straggler scales the base cost deterministically
+    base = 0.1
+    assert p.report_s(0, 0, base) >= base
+    assert FaultPlan(stragglers={1: 4.0}).report_s(0, 1, base) == 0.4
+    # draws are a pure hash of (seed, keys): query order cannot matter,
+    # and the same query repeats identically
+    seq1 = [p.drops(r, s, 0) for r in range(4) for s in range(8)]
+    seq2 = [p.drops(r, s, 0) for r in reversed(range(4))
+            for s in reversed(range(8))]
+    assert seq1 == list(reversed(seq2))
+    assert any(seq1) and not all(seq1)  # p=0.5 over 32 draws
+    # empty spec -> no faults
+    q = FaultPlan.from_spec("")
+    assert q.report_s(0, 3, base) == base and not q.drops(0, 3)
+
+
+def test_fault_plan_rejects_malformed():
+    from sparknet_tpu.elastic import FaultPlan
+
+    for bad in ("straggler:1", "straggler:x20", "crash:2", "crash:a@1",
+                "drop:abc", "delay:0.5", "wat:1", "straggler:0x0.5"):
+        with pytest.raises(ValueError, match="straggler|malformed"):
+            FaultPlan.from_spec(bad)
+    with pytest.raises(ValueError, match="drop_prob"):
+        FaultPlan(drop_prob=1.5)
+
+
+# ------------------------------------------------------ tau.py (AdaptiveTau)
+
+def test_adaptive_tau_controller():
+    from sparknet_tpu.elastic import AdaptiveTau
+
+    c = AdaptiveTau(4, tau_min=2, tau_max=16, patience=2)
+    # stall dominates for `patience` rounds -> double; keeps doubling to
+    # the clamp and NEVER exceeds it
+    taus = [c.update(stall_s=10.0, comm_s=1.0) for _ in range(10)]
+    assert taus[0] == 4 and taus[1] == 8  # patience=2: 2nd round moves
+    assert max(taus) == 16 and taus[-1] == 16
+    assert all(2 <= t <= 16 for t in taus)
+    # balanced rounds in between reset the hysteresis
+    c2 = AdaptiveTau(4, tau_min=2, tau_max=16, patience=2)
+    c2.update(10.0, 1.0)
+    c2.update(0.5, 1.0)  # ratio in the dead band -> counters reset
+    assert c2.update(10.0, 1.0) == 4  # needs patience again
+    # cheap comm -> halve down to tau_min
+    c3 = AdaptiveTau(8, tau_min=2, tau_max=16, patience=1)
+    assert c3.update(0.0, 1.0) == 4
+    assert c3.update(0.0, 1.0) == 2
+    assert c3.update(0.0, 1.0) == 2  # clamped
+    # tau0 clamps into range
+    assert AdaptiveTau(100, tau_max=8).tau == 8
+
+
+def test_adaptive_tau_validation():
+    from sparknet_tpu.elastic import AdaptiveTau
+
+    with pytest.raises(ValueError, match="tau_min"):
+        AdaptiveTau(2, tau_min=0)
+    with pytest.raises(ValueError, match="tau_max"):
+        AdaptiveTau(2, tau_min=4, tau_max=2)
+    with pytest.raises(ValueError, match="shrink_ratio"):
+        AdaptiveTau(2, grow_ratio=1.0, shrink_ratio=1.0)
+    with pytest.raises(ValueError, match="patience"):
+        AdaptiveTau(2, patience=0)
+
+
+# ------------------------------------------- data/partition.py rebalance
+
+def test_rebalance_properties():
+    from sparknet_tpu.data.partition import (initial_assignment, rebalance,
+                                             shards_of)
+
+    def loads(a):
+        out = {}
+        for s, w in a.items():
+            out[w] = out.get(w, 0) + 1
+        return out
+
+    a0 = initial_assignment(16, range(8))
+    assert sorted(a0) == list(range(16))
+    assert set(loads(a0).values()) == {2}
+
+    # LEAVE: only the leaver's shards move; survivors keep theirs warm
+    a1 = rebalance(a0, [w for w in range(8) if w != 3])
+    assert 3 not in a1.values()
+    for s in a0:
+        if a0[s] != 3:
+            assert a1[s] == a0[s], f"shard {s} moved off a survivor"
+    ld = loads(a1)
+    assert max(ld.values()) - min(ld.values()) <= 1
+
+    # JOIN: shards move ONLY onto the joiner, load stays within 1
+    a2 = rebalance(a1, list(range(8)))
+    for s in a1:
+        if a2[s] != a1[s]:
+            assert a2[s] == 3, f"shard {s} moved to a non-joiner"
+    ld2 = loads(a2)
+    assert max(ld2.values()) - min(ld2.values()) <= 1
+    assert sorted(a2) == list(range(16))  # every shard owned exactly once
+
+    # deterministic: same inputs, same output
+    assert rebalance(a0, [0, 1, 2]) == rebalance(a0, [2, 1, 0])
+    assert shards_of(a2, 3) == sorted(s for s in a2 if a2[s] == 3)
+    with pytest.raises(ValueError):
+        initial_assignment(0, [0])
+    with pytest.raises(ValueError):
+        initial_assignment(4, [])
+
+
+def test_sharded_feed():
+    from sparknet_tpu.elastic import ShardedFeed
+
+    made = []
+
+    def mk(shard):
+        made.append(shard)
+        rng = iter(range(100 * shard, 100 * shard + 100))
+        return lambda: {"shard": shard, "n": next(rng)}
+
+    f = ShardedFeed(mk, [2, 0])
+    assert f.shard_ids == [0, 2]
+    assert [f()["shard"] for _ in range(4)] == [0, 2, 0, 2]
+    # reassignment: stream objects persist, cursors stay warm
+    f.set_shards([0, 2, 5])
+    assert made == [0, 2, 5]  # 0 and 2 NOT rebuilt
+    nxt = f()  # cursor continues; shard 2 resumes at its third draw
+    assert nxt["shard"] == 2 and nxt["n"] == 202
+    with pytest.raises(ValueError, match="at least one shard"):
+        f.set_shards([])
+
+
+# ----------------------------------------- orbax stepped-snapshot helpers
+
+def test_orbax_step_helpers(tmp_path):
+    from sparknet_tpu.utils.orbax_ckpt import (latest_step, resolve_latest,
+                                               save_step, step_path)
+
+    root = str(tmp_path / "snaps")
+    assert latest_step(root) is None and resolve_latest(root) is None
+    params = {"w": np.arange(6, dtype=np.float32).reshape(2, 3)}
+    state = {"w": (np.zeros((2, 3), np.float32),)}
+    p1 = save_step(root, 1, 10, params, state)
+    params2 = {"w": params["w"] * 2}
+    p2 = save_step(root, 12, 120, params2, state)
+    assert latest_step(root) == 12
+    assert resolve_latest(root) == p2
+    assert p2.startswith(step_path(root, 12))
+    assert p1 != p2
+
+
+def test_snapshot_restores_across_worker_counts(tmp_path):
+    """A snapshot is ONE replica's params (worker count never enters the
+    artifact), so a snapshot cut under an 8-worker run must catch up a
+    joiner in a 4-worker run bitwise."""
+    from sparknet_tpu.elastic import ElasticRuntime
+    from sparknet_tpu.utils.orbax_ckpt import restore_auto, resolve_latest
+
+    snapdir = str(tmp_path / "xsnaps")
+    rt8 = ElasticRuntime(sharded_solver(workers=8), snapshot_dir=snapdir,
+                         sleep_fn=lambda _t: None)
+    rt8.snapshot()
+    _it, ref_params, _state = restore_auto(resolve_latest(snapdir))
+
+    rt4 = ElasticRuntime(sharded_solver(workers=4), snapshot_dir=snapdir,
+                         min_quorum=1, sleep_fn=lambda _t: None)
+    rt4.leave(3)
+    rt4.join(3)  # catches up from the 8-worker snapshot
+    ev = rt4.events[-1]
+    assert ev["event"] == "join" and ev["source"].startswith("step_")
+    for k, v in rt4.solver.params_w.items():
+        assert np.array_equal(np.asarray(v)[3], ref_params[k]), k
+
+
+# --------------------------------------------------- ElasticRuntime rounds
+
+def _noop_sleep(_t):
+    pass
+
+
+def test_runtime_constructor_validation():
+    from sparknet_tpu.elastic import ElasticRuntime
+
+    with pytest.raises(ValueError, match="mode='average'"):
+        ElasticRuntime(toy_solver(mode="sync"))
+    with pytest.raises(ValueError, match="min_quorum"):
+        ElasticRuntime(toy_solver(), min_quorum=N + 1)
+    s = toy_solver()
+    s.set_prefetch(True)
+    with pytest.raises(ValueError, match="prefetch"):
+        ElasticRuntime(s)
+
+
+def test_quorum_retry_backoff_and_failure():
+    """Below min_quorum the round retries with exponential backoff (the
+    injectable sleep_fn records it) and dies with QuorumError — before
+    any device dispatch, so this test never compiles a round."""
+    from sparknet_tpu.elastic import ElasticRuntime, FaultPlan, QuorumError
+
+    slept = []
+    plan = FaultPlan(seed=3, stragglers={w: 50.0 for w in range(N)})
+    rt = ElasticRuntime(toy_solver(), min_quorum=4, deadline_s=0.5,
+                        chaos=plan, step_time_s=0.05, max_retries=3,
+                        backoff_s=0.01, sleep_fn=slept.append)
+    with pytest.raises(QuorumError, match="min_quorum=4"):
+        rt.run_round()
+    assert slept == [0.01, 0.02, 0.04]  # backoff doubles per attempt
+    retries = [e for e in rt.events if e["event"] == "quorum_retry"]
+    assert [e["attempt"] for e in retries] == [1, 2, 3, 4]
+    assert rt.stats()["quorum_retries"] == 4
+
+
+def test_leave_join_guards():
+    from sparknet_tpu.elastic import ElasticRuntime, QuorumError
+
+    rt = ElasticRuntime(sharded_solver(), sleep_fn=_noop_sleep)
+    with pytest.raises(ValueError, match="already active"):
+        rt.join(0)
+    rt.leave(5)
+    with pytest.raises(ValueError, match="not active"):
+        rt.leave(5)
+    for w in [0, 1, 2, 3, 4, 6]:
+        rt.leave(w)
+    with pytest.raises(QuorumError, match="last active"):
+        rt.leave(7)
+    # shards followed the survivors: lone worker 7 owns the universe
+    assert rt.solver.train_sources[7].shard_ids == list(range(2 * N))
+
+
+def test_chaos_crash_join_determinism(tmp_path):
+    """The e2e acceptance: crash at round 2 + snapshot-catch-up join at
+    round 4 both complete under partial quorum with a 20× straggler and
+    an adaptive-τ controller — and the WHOLE thing replays bitwise
+    (identical event logs, identical final params) because every control
+    decision runs on simulated time."""
+    from sparknet_tpu.elastic import (AdaptiveTau, ElasticRuntime,
+                                      FaultPlan)
+
+    def run(snapdir):
+        s = sharded_solver()
+        plan = FaultPlan.from_spec("straggler:1x20,crash:2@2", seed=5)
+        rt = ElasticRuntime(
+            s, min_quorum=4, deadline_s=0.5, chaos=plan,
+            adaptive=AdaptiveTau(2, tau_min=1, tau_max=16, patience=2),
+            snapshot_dir=str(snapdir), snapshot_every=1, step_time_s=0.05,
+            sleep_fn=_noop_sleep)
+        rt.schedule_join(2, 4)
+        losses = rt.run(6)
+        pw = {k: np.asarray(v) for k, v in s.params_w.items()}
+        return rt, losses, pw
+
+    rt1, losses1, pw1 = run(tmp_path / "a")
+    rt2, losses2, pw2 = run(tmp_path / "b")
+
+    st = rt1.stats()
+    assert len(losses1) == 6 and all(np.isfinite(losses1))
+    assert st["leaves"] == 1 and st["joins"] == 1
+    assert st["active_workers"] == list(range(N))  # slot 2 came back
+    kinds = [e["event"] for e in rt1.events]
+    assert "crash" in kinds and "join" in kinds and "snapshot" in kinds
+    join = next(e for e in rt1.events if e["event"] == "join")
+    assert join["source"].startswith("step_")  # snapshot, not peer copy
+    # the straggler is masked out of every round it overshoots
+    rounds = [e for e in rt1.events if e["event"] == "elastic_round"]
+    assert all(1 in e["missing"] for e in rounds)
+    assert all(e["stall_sim_s"] == 0.0 for e in rounds)
+
+    # determinism: equal losses, equal event logs, bitwise-equal params
+    assert losses1 == losses2
+    strip = lambda evs: [{k: v for k, v in e.items() if k != "path"}
+                         for e in evs]
+    assert strip(rt1.events) == strip(rt2.events)
+    for k in pw1:
+        assert np.array_equal(pw1[k], pw2[k]), k
+
+
+def test_straggler_ab_partial_quorum_strictly_fewer_stall():
+    """The A/B acceptance, decided on SIMULATED stall-seconds from round
+    telemetry: the full barrier charges the 20× straggler every round;
+    partial quorum masks it and charges zero."""
+    from sparknet_tpu.elastic import ElasticRuntime, FaultPlan
+
+    def arm(deadline_s):
+        rt = ElasticRuntime(sharded_solver(), min_quorum=4,
+                            deadline_s=deadline_s,
+                            chaos=FaultPlan(seed=5, stragglers={1: 20.0}),
+                            step_time_s=0.05, sleep_fn=_noop_sleep)
+        rt.run(3)
+        return rt
+
+    full = arm(None)
+    quorum = arm(0.5)
+    f, q = full.stats()["stall_sim_s"], quorum.stats()["stall_sim_s"]
+    assert q < f, (q, f)
+    assert q == 0.0  # the straggler never makes the 0.5 s deadline
+    # and the telemetry agrees with the aggregate
+    fr = [e for e in full.events if e["event"] == "elastic_round"]
+    assert abs(sum(e["stall_sim_s"] for e in fr) - f) < 1e-9
+    assert all(e["quorum"] == N for e in fr)  # barrier: nobody excluded
+
+
+def test_adaptive_tau_converges_up_under_full_barrier_straggler():
+    """Behind the FULL BARRIER a persistent straggler charges
+    (mult−1)·τ·step of stall every round, so the controller must walk τ
+    up to tau_max deterministically, logging each move as a tau_change
+    event, with tau_effective always inside [tau_min, tau_max]."""
+    from sparknet_tpu.elastic import (AdaptiveTau, ElasticRuntime,
+                                      FaultPlan)
+
+    s = sharded_solver(tau=2)
+    rt = ElasticRuntime(
+        s, deadline_s=None, chaos=FaultPlan(seed=1, stragglers={1: 20.0}),
+        adaptive=AdaptiveTau(2, tau_min=1, tau_max=8, patience=2),
+        step_time_s=0.05, sleep_fn=_noop_sleep)
+    rt.run(6)
+    assert s.tau == 8  # 2 -> 4 -> 8 with patience 2 over 6 rounds
+    moves = [e for e in rt.events if e["event"] == "tau_change"]
+    assert [(e["tau_from"], e["tau_to"]) for e in moves] == [(2, 4), (4, 8)]
+    taus = [e["tau_effective"] for e in rt.events
+            if e["event"] == "elastic_round"]
+    # patience=2: two stalled rounds per doubling, each move lands the
+    # round AFTER the controller fires
+    assert taus == [2, 2, 4, 4, 8, 8]
+    assert all(1 <= t <= 8 for t in taus)
+
+
+def test_round_log_jsonl_carries_events(tmp_path):
+    """Event records ride the round JSONL stream (tagged with `event`)
+    but stay OUT of round_stats()'s per_round list."""
+    from sparknet_tpu.elastic import ElasticRuntime, FaultPlan
+
+    s = sharded_solver()
+    log = tmp_path / "rounds.jsonl"
+    s.set_round_log(str(log))
+    rt = ElasticRuntime(s, min_quorum=4, deadline_s=0.5,
+                        chaos=FaultPlan(seed=5, stragglers={1: 20.0}),
+                        step_time_s=0.05, sleep_fn=_noop_sleep)
+    rt.run(2)
+    recs = [json.loads(ln) for ln in log.read_text().splitlines()]
+    rounds = [r for r in recs if "event" not in r]
+    events = [r for r in recs if "event" in r]
+    assert len(rounds) == 2 and len(events) >= 2
+    assert all(r["quorum"] == N - 1 for r in rounds)
+    assert all(r["missing_workers"] == [1] for r in rounds)
+    assert all("round" in e and "iter" in e for e in events)
+    assert all("event" not in r for r in s.round_stats()["per_round"])
+
+
+# --------------------------------------------------- chaos smoke (script)
+
+@pytest.mark.chaos
+def test_chaos_run_script_smoke():
+    """scripts/chaos_run.py end-to-end in a subprocess (its own backend:
+    the 8-device virtual mesh), --ab included — the exact invocation the
+    bench.py elastic leg makes, pinned to its one-JSON-line contract."""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+    proc = subprocess.run(
+        [sys.executable, os.path.join(repo, "scripts", "chaos_run.py"),
+         "--ab", "--rounds", "5"],
+        capture_output=True, text=True, env=env, timeout=300, cwd=repo)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    lines = [ln for ln in proc.stdout.splitlines() if ln.strip()]
+    assert len(lines) == 1, lines  # ONE JSON line
+    rec = json.loads(lines[0])
+    assert rec["ok"] and rec["losses_finite"]
+    assert rec["joins"] == 1 and rec["crashes"] == 1
+    assert rec["final_active"] == 8
+    assert rec["partial_quorum_stall_s"] < rec["full_barrier_stall_s"]
