@@ -1,0 +1,10 @@
+#!/usr/bin/env bash
+# CI gate for the static-analysis subsystem: exits non-zero on ANY lint
+# finding (the `sparknet lint` verb's exit-code contract; rule catalog in
+# ANALYSIS.md).  Extra args pass through, e.g.:
+#   scripts/lint_gate.sh                       # lint the package
+#   scripts/lint_gate.sh --select R001,R004    # subset of rules
+#   scripts/lint_gate.sh --jaxpr round         # + trace the fused round
+set -euo pipefail
+cd "$(dirname "$0")/.."
+exec python -m sparknet_tpu.cli lint --format json "$@"

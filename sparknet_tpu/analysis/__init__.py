@@ -1,0 +1,30 @@
+"""Static analysis for the repo's hand-enforced invariants.
+
+Two halves, one `sparknet lint` verb:
+
+- `engine` + `rules`: an AST lint engine whose project rules replace the
+  scattered regex pins (clock discipline, parser error contracts,
+  custom-VJP grad coverage, SPARKNET_* knob registry, serving lock
+  discipline).  `tests/test_lint.py` runs the engine over the package so
+  the tier-1 suite self-enforces.
+- `jaxpr_audit`: traces the fused training round (parallel/dist.py) and
+  serving forwards and reports what source-level linting cannot see —
+  host-transfer/callback primitives, float dtype-conversion edges, and
+  weak-typed inputs that fragment the jit cache.
+
+Rule catalog and suppression syntax: ANALYSIS.md.
+"""
+
+from .engine import Finding, LintEngine, format_human, format_json
+from .rules import default_rules
+
+
+def run_lint(root, *, repo_root=None, select=None):
+    """Lint `root` (a package directory) with the default project rules;
+    returns the sorted Finding list."""
+    return LintEngine(default_rules()).run(root, repo_root=repo_root,
+                                           select=select)
+
+
+__all__ = ["Finding", "LintEngine", "default_rules", "run_lint",
+           "format_human", "format_json"]

@@ -1,0 +1,96 @@
+"""`sparknet lint` CLI verb.
+
+    python -m sparknet_tpu.cli lint                      # lint the package
+    python -m sparknet_tpu.cli lint --format json        # machine output
+    python -m sparknet_tpu.cli lint --select R001,R004   # subset of rules
+    python -m sparknet_tpu.cli lint --jaxpr round        # + trace the fused
+                                                         #   round and audit it
+    python -m sparknet_tpu.cli lint --jaxpr serve --model lenet
+
+Exit code 1 on ANY finding (scripts/lint_gate.sh relies on this), 0 when
+clean.  JSON schema: engine.format_json — {"version", "count",
+"findings": [{rule, path, line, col, message}]}, plus "jaxpr" when a
+--jaxpr leg ran.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+
+def cmd_lint(args) -> int:
+    from . import jaxpr_audit
+    from .engine import LintEngine, format_human, format_json
+    from .rules import default_rules
+
+    pkg_dir = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    roots = args.paths or [pkg_dir]
+    select = ([s.strip() for s in args.select.split(",") if s.strip()]
+              if args.select else None)
+
+    findings = []
+    try:
+        for root in roots:
+            repo_root = (os.path.dirname(os.path.abspath(root))
+                         if args.repo_root is None else args.repo_root)
+            findings.extend(LintEngine(default_rules()).run(
+                root, repo_root=repo_root, select=select))
+    except ValueError as e:
+        print(f"lint: {e}", file=sys.stderr)
+        return 2
+
+    jaxpr_reports = []
+    jaxpr_violations = []
+    for leg in (args.jaxpr or []):
+        if leg == "round":
+            report = jaxpr_audit.audit_training_round(
+                n_workers=args.workers, tau=args.tau)
+        else:  # serve
+            report = jaxpr_audit.audit_serving_forward(
+                args.model, quant=args.quant or None)
+        jaxpr_reports.append(report)
+        jaxpr_violations.extend(jaxpr_audit.findings_from_report(report))
+
+    rc = 1 if (findings or jaxpr_violations) else 0
+    if args.format == "json":
+        extra = {"jaxpr": jaxpr_reports} if jaxpr_reports else None
+        print(format_json(findings, extra=extra))
+    else:
+        print(format_human(findings))
+        for rep in jaxpr_reports:
+            print(f"jaxpr[{rep['program']}]: {rep['n_eqns']} eqns, "
+                  f"host_transfers={rep['host_transfers']}, "
+                  f"convert_edges={rep['convert_edges']}, "
+                  f"weak_invars={rep['weak_type_invars']}")
+        for v in jaxpr_violations:
+            print(f"jaxpr violation: {v}")
+    return rc
+
+
+def register(sub) -> None:
+    p = sub.add_parser(
+        "lint", help="static analysis: AST rules + jaxpr audit "
+        "(ANALYSIS.md)")
+    p.add_argument("paths", nargs="*",
+                   help="package directories to lint (default: the "
+                        "installed sparknet_tpu package)")
+    p.add_argument("--format", choices=["text", "json"], default="text")
+    p.add_argument("--select",
+                   help="comma-separated rule ids (e.g. R001,R004)")
+    p.add_argument("--repo-root",
+                   help="overrides the tests/README anchor directory "
+                        "(default: parent of each linted path)")
+    p.add_argument("--jaxpr", action="append", choices=["round", "serve"],
+                   help="also trace + audit a hot program (repeatable)")
+    p.add_argument("--workers", type=int, default=8,
+                   help="worker count for --jaxpr round (needs that many "
+                        "local devices)")
+    p.add_argument("--tau", type=int, default=2,
+                   help="local steps per round for --jaxpr round")
+    p.add_argument("--model", default="lenet",
+                   help="model-zoo name or deploy prototxt for "
+                        "--jaxpr serve")
+    p.add_argument("--quant", default=None,
+                   help="quant mode for --jaxpr serve (e.g. bf16)")
+    p.set_defaults(fn=cmd_lint)

@@ -1,0 +1,222 @@
+"""Rule-based AST lint engine.
+
+The repo pins its invariants with static checks; until now each one was
+a bespoke regex walk inside a test (tests/test_obs.py clock scan,
+tests/test_grad_coverage.py knob/vjp scans).  Regexes cannot see
+`import time as t` or `from struct import unpack`, and every new
+invariant re-implemented the file walk.  This engine centralizes the
+walk: each Rule sees parsed modules (`check_module`) and the whole
+project (`finalize`), carries its own allowlist, and emits Findings that
+one formatter pair renders for humans (`path:line:col RULE message`) or
+machines (versioned JSON, see format_json).
+
+Suppressions: a `# sparknet: noqa` comment suppresses every rule on its
+line; `# sparknet: noqa[R001]` (comma-separated ids) suppresses just
+those rules.  Allowlists are per-rule and path-based — the difference is
+intent: an allowlist entry says "this module is the sanctioned owner of
+the pattern", a noqa says "this one line is a reviewed exception".
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import json
+import os
+import re
+from typing import Dict, Iterable, List, Optional, Sequence, Set
+
+JSON_SCHEMA_VERSION = 1
+
+# `# sparknet: noqa` (blanket) or `# sparknet: noqa[R001, R002]`
+_NOQA_RE = re.compile(r"#\s*sparknet:\s*noqa(?:\[([A-Za-z0-9_, ]+)\])?")
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One rule violation, anchored to a source location."""
+
+    rule: str
+    path: str        # posix-style path relative to the linted root
+    line: int        # 1-based; 0 for whole-project findings
+    col: int
+    message: str
+
+    def sort_key(self):
+        return (self.path, self.line, self.col, self.rule)
+
+    def to_dict(self) -> Dict[str, object]:
+        return {"rule": self.rule, "path": self.path, "line": self.line,
+                "col": self.col, "message": self.message}
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}:{self.col} {self.rule} " \
+               f"{self.message}"
+
+
+class ModuleContext:
+    """One parsed source file: tree + source + per-line noqa map."""
+
+    def __init__(self, root: str, path: str) -> None:
+        self.abs_path = path
+        self.rel = os.path.relpath(path, root).replace(os.sep, "/")
+        with open(path, encoding="utf-8") as f:
+            self.source = f.read()
+        self.tree: Optional[ast.AST] = None
+        self.syntax_error: Optional[SyntaxError] = None
+        try:
+            self.tree = ast.parse(self.source, filename=path)
+        except SyntaxError as e:
+            self.syntax_error = e
+        # line -> None (blanket) or set of suppressed rule ids
+        self.noqa: Dict[int, Optional[Set[str]]] = {}
+        for i, text in enumerate(self.source.splitlines(), start=1):
+            m = _NOQA_RE.search(text)
+            if not m:
+                continue
+            if m.group(1) is None:
+                self.noqa[i] = None
+            else:
+                ids = {s.strip() for s in m.group(1).split(",") if s.strip()}
+                prev = self.noqa.get(i, set())
+                self.noqa[i] = None if prev is None else (prev or set()) | ids
+
+    def suppressed(self, rule_id: str, line: int) -> bool:
+        if line not in self.noqa:
+            return False
+        ids = self.noqa[line]
+        return ids is None or rule_id in ids
+
+
+class Project:
+    """Everything a project-level rule may need: the linted root, the
+    repository root (for tests/ and README.md), and the parsed modules."""
+
+    def __init__(self, root: str, repo_root: str,
+                 modules: Sequence[ModuleContext]) -> None:
+        self.root = root
+        self.repo_root = repo_root
+        self.modules = list(modules)
+
+
+class Rule:
+    """Base rule.  Subclasses set `id`/`name`/`rationale`, an optional
+    path `allowlist` (rel-posix paths the rule skips entirely), and
+    implement `check_module` and/or `finalize`."""
+
+    id: str = "R000"
+    name: str = "unnamed"
+    rationale: str = ""
+    allowlist: frozenset = frozenset()
+
+    def applies_to(self, ctx: ModuleContext) -> bool:
+        return ctx.rel not in self.allowlist
+
+    def check_module(self, ctx: ModuleContext) -> List[Finding]:
+        return []
+
+    def finalize(self, project: Project) -> List[Finding]:
+        return []
+
+    # -- helpers shared by the concrete rules
+    def finding(self, ctx_or_path, node_or_line, message: str,
+                col: int = 0) -> Finding:
+        if isinstance(ctx_or_path, ModuleContext):
+            path = ctx_or_path.rel
+        else:
+            path = ctx_or_path
+        if isinstance(node_or_line, ast.AST):
+            line = getattr(node_or_line, "lineno", 0)
+            col = getattr(node_or_line, "col_offset", 0)
+        else:
+            line = int(node_or_line)
+        return Finding(self.id, path, line, col, message)
+
+
+def _iter_py_files(root: str) -> Iterable[str]:
+    for dirpath, dirs, files in os.walk(root):
+        dirs[:] = [d for d in dirs if d != "__pycache__"]
+        for fn in sorted(files):
+            if fn.endswith(".py"):
+                yield os.path.join(dirpath, fn)
+
+
+class LintEngine:
+    """Runs a rule set over a package directory."""
+
+    def __init__(self, rules: Sequence[Rule]) -> None:
+        ids = [r.id for r in rules]
+        if len(ids) != len(set(ids)):
+            raise ValueError(f"duplicate rule ids: {sorted(ids)}")
+        self.rules = list(rules)
+
+    def run(self, root: str, *, repo_root: Optional[str] = None,
+            select: Optional[Sequence[str]] = None) -> List[Finding]:
+        """Lint every .py under `root`.  `repo_root` (default: parent of
+        root) anchors project-level lookups (tests/, README.md);
+        `select` restricts to the given rule ids."""
+        root = os.path.abspath(root)
+        if not os.path.isdir(root):
+            raise ValueError(f"lint root {root!r} is not a directory")
+        if repo_root is None:
+            repo_root = os.path.dirname(root)
+        rules = self.rules
+        if select:
+            wanted = set(select)
+            unknown = wanted - {r.id for r in rules}
+            if unknown:
+                raise ValueError(
+                    f"unknown rule id(s) {sorted(unknown)}; "
+                    f"have {sorted(r.id for r in rules)}")
+            rules = [r for r in rules if r.id in wanted]
+
+        modules = [ModuleContext(root, p) for p in _iter_py_files(root)]
+        findings: List[Finding] = []
+        for ctx in modules:
+            if ctx.syntax_error is not None:
+                e = ctx.syntax_error
+                findings.append(Finding(
+                    "E000", ctx.rel, e.lineno or 0, e.offset or 0,
+                    f"file does not parse: {e.msg}"))
+                continue
+            for rule in rules:
+                if not rule.applies_to(ctx):
+                    continue
+                for f in rule.check_module(ctx):
+                    if not ctx.suppressed(f.rule, f.line):
+                        findings.append(f)
+        project = Project(root, repo_root,
+                          [m for m in modules if m.tree is not None])
+        by_rel = {m.rel: m for m in project.modules}
+        for rule in rules:
+            for f in rule.finalize(project):
+                ctx = by_rel.get(f.path)
+                if ctx is not None and ctx.suppressed(f.rule, f.line):
+                    continue
+                findings.append(f)
+        return sorted(findings, key=Finding.sort_key)
+
+
+def format_human(findings: Sequence[Finding]) -> str:
+    if not findings:
+        return "sparknet lint: clean (0 findings)"
+    lines = [f.render() for f in findings]
+    lines.append(f"sparknet lint: {len(findings)} finding"
+                 f"{'s' if len(findings) != 1 else ''}")
+    return "\n".join(lines)
+
+
+def format_json(findings: Sequence[Finding],
+                extra: Optional[Dict[str, object]] = None) -> str:
+    """Versioned machine output:
+    {"version": 1, "count": N, "findings": [{rule, path, line, col,
+    message}, ...]} plus any `extra` top-level keys (the CLI attaches
+    the jaxpr audit report under "jaxpr")."""
+    doc: Dict[str, object] = {
+        "version": JSON_SCHEMA_VERSION,
+        "count": len(findings),
+        "findings": [f.to_dict() for f in findings],
+    }
+    if extra:
+        doc.update(extra)
+    return json.dumps(doc, indent=2, sort_keys=False)

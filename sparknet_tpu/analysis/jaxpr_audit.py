@@ -1,0 +1,247 @@
+"""jaxpr auditor: program-graph checks the source-level lint cannot see.
+
+The hot programs — the fused training round (parallel/dist.py) and the
+serving forward (serving/engine.py) — carry invariants that only show up
+AFTER tracing: no host-transfer/callback primitives (a stray
+pure_callback inside the round would serialize every τ-step through the
+host, catastrophic over the axon tunnel), no accidental float
+dtype-conversion edges (the planned bf16 mixed-precision work pins
+"averaging stays fp32"; an fp32<->bf16 convert_element_type edge is
+exactly where that silently breaks), and no weak-typed inputs (each
+weak/strong variant of an input dtype is a separate jit cache entry —
+recompile hazards the bounded-compile guarantee exists to prevent).
+
+TensorFlow's dataflow-graph paper (PAPERS.md) is the precedent: these
+are properties of the program graph, checkable without running it.
+
+`audit_jaxpr` walks a ClosedJaxpr recursively (a jitted fn traces to one
+`pjit` eqn whose sub-jaxpr holds the real program — the walk descends
+through every Jaxpr/ClosedJaxpr found in eqn params, scan/while/cond
+bodies included).  `audit_training_round` / `audit_serving_forward`
+build the repo's actual hot programs and audit them; tests/test_lint.py
+pins zero host transfers in the fused round at N=8 on the CPU mesh.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Iterator, List, Optional
+
+# Primitives that move data or control to the host mid-program.  Names
+# cover current jax (pure_callback/io_callback/debug_callback) and the
+# older host_callback/outside_call spellings so the audit stays meaningful
+# across versions.
+HOST_TRANSFER_PRIMS = frozenset({
+    "pure_callback", "io_callback", "debug_callback", "callback",
+    "outside_call", "host_callback_call", "host_local_array_to_global",
+    "infeed", "outfeed",
+})
+
+_FLOAT_KINDS = ("float16", "bfloat16", "float32", "float64")
+
+
+def _float_bits(dtype_name: str) -> Optional[int]:
+    if dtype_name in ("float16", "bfloat16"):
+        return 16
+    if dtype_name == "float32":
+        return 32
+    if dtype_name == "float64":
+        return 64
+    return None
+
+
+def _sub_jaxprs(params: Dict[str, Any]) -> Iterator[Any]:
+    """Every Jaxpr/ClosedJaxpr nested in an eqn's params (scan/while/
+    cond/pjit bodies arrive as single values, branch lists, or tuples)."""
+    import jax.core as core
+
+    closed = getattr(core, "ClosedJaxpr", None)
+    plain = getattr(core, "Jaxpr", None)
+    kinds = tuple(t for t in (closed, plain) if t is not None)
+
+    def walk(v: Any) -> Iterator[Any]:
+        if isinstance(v, kinds):
+            yield v
+        elif isinstance(v, (list, tuple)):
+            for e in v:
+                yield from walk(e)
+
+    for v in params.values():
+        yield from walk(v)
+
+
+def _as_jaxpr(obj: Any) -> Any:
+    return obj.jaxpr if hasattr(obj, "jaxpr") else obj
+
+
+def iter_eqns(closed_or_jaxpr: Any) -> Iterator[Any]:
+    """All eqns of a (Closed)Jaxpr, recursively through sub-jaxprs."""
+    jaxpr = _as_jaxpr(closed_or_jaxpr)
+    for eqn in jaxpr.eqns:
+        yield eqn
+        for sub in _sub_jaxprs(eqn.params):
+            yield from iter_eqns(sub)
+
+
+def audit_jaxpr(closed_jaxpr: Any) -> Dict[str, Any]:
+    """Audit one traced program; returns a JSON-ready report:
+
+    - host_transfers: {primitive_name: count} over HOST_TRANSFER_PRIMS
+    - convert_edges: float->float convert_element_type edges with
+      direction (upcast/downcast/width-preserving like f16<->bf16)
+    - weak_type_invars / weak_type_consts: jit-cache fragmentation
+      hazards among the program's inputs
+    - n_eqns: total eqn count (recursive), a coarse program-size stamp
+    """
+    host: Dict[str, int] = {}
+    edges: Dict[tuple, int] = {}
+    n_eqns = 0
+    for eqn in iter_eqns(closed_jaxpr):
+        n_eqns += 1
+        prim = eqn.primitive.name
+        if prim in HOST_TRANSFER_PRIMS:
+            host[prim] = host.get(prim, 0) + 1
+        elif prim == "convert_element_type":
+            src = eqn.invars[0].aval
+            src_name = getattr(getattr(src, "dtype", None), "name", None)
+            dst = eqn.params.get("new_dtype")
+            dst_name = getattr(dst, "name", str(dst) if dst else None)
+            if (src_name in _FLOAT_KINDS and dst_name in _FLOAT_KINDS
+                    and src_name != dst_name):
+                edges[(src_name, dst_name)] = \
+                    edges.get((src_name, dst_name), 0) + 1
+
+    def direction(src: str, dst: str) -> str:
+        sb, db = _float_bits(src), _float_bits(dst)
+        if sb is None or db is None or sb == db:
+            return "width-preserving"
+        return "upcast" if db > sb else "downcast"
+
+    jaxpr = _as_jaxpr(closed_jaxpr)
+    weak_invars = sum(1 for v in jaxpr.invars
+                      if getattr(v.aval, "weak_type", False))
+    weak_consts = sum(1 for v in jaxpr.constvars
+                      if getattr(v.aval, "weak_type", False))
+    return {
+        "n_eqns": n_eqns,
+        "host_transfers": dict(sorted(host.items())),
+        "convert_edges": [
+            {"from": s, "to": d, "direction": direction(s, d), "count": c}
+            for (s, d), c in sorted(edges.items())],
+        "weak_type_invars": weak_invars,
+        "weak_type_consts": weak_consts,
+    }
+
+
+def audit_fn(fn, *args, **kwargs) -> Dict[str, Any]:
+    """Trace `fn(*args)` (jitted or plain) and audit the program."""
+    import jax
+
+    return audit_jaxpr(jax.make_jaxpr(fn, **kwargs)(*args))
+
+
+# ------------------------------------------------------- repo hot programs
+
+def _toy_round_solver(n_workers: int, tau: int):
+    """A small DistributedSolver whose fused round has the production
+    structure (shard_map + lax.scan τ-steps + pmean averaging) at toy
+    sizes — the same shape tests/test_obs.py's telemetry tests trace."""
+    import numpy as np
+
+    from ..core import layers_dsl as dsl
+    from ..parallel.dist import DistributedSolver
+    from ..proto import caffe_pb
+    from ..proto.textformat import parse
+
+    net = dsl.net_param(
+        "lint_audit_toy",
+        dsl.memory_data_layer("data", ["data", "label"], batch=16,
+                              channels=1, height=4, width=4),
+        dsl.inner_product_layer("ip1", "data", num_output=8),
+        dsl.relu_layer("relu1", "ip1"),
+        dsl.inner_product_layer("ip2", "ip1", num_output=2),
+        dsl.softmax_with_loss_layer("loss", ["ip2", "label"]),
+    )
+    sp = caffe_pb.SolverParameter(parse(
+        "base_lr: 0.05 lr_policy: 'fixed' momentum: 0.9 random_seed: 7"))
+    solver = DistributedSolver(sp, net_param=net, n_workers=n_workers,
+                               tau=tau)
+
+    def stream(seed):
+        rng = np.random.RandomState(seed)
+
+        def src():
+            x = rng.randn(16, 1, 4, 4).astype(np.float32)
+            return {"data": x,
+                    "label": (x.mean(axis=(1, 2, 3)) > 0)
+                    .astype(np.int32)}
+        return src
+
+    solver.set_train_data([stream(w) for w in range(n_workers)])
+    return solver
+
+
+def audit_training_round(n_workers: int = 8, tau: int = 2,
+                         ) -> Dict[str, Any]:
+    """Trace and audit the fused training round at `n_workers` workers
+    (requires that many local devices — the CPU mesh provides 8 via
+    XLA_FLAGS=--xla_force_host_platform_device_count=8)."""
+    import jax
+    import jax.numpy as jnp
+
+    if len(jax.devices()) < n_workers:
+        raise RuntimeError(
+            f"audit_training_round needs {n_workers} devices, have "
+            f"{len(jax.devices())} (run on the CPU mesh: JAX_PLATFORMS="
+            f"cpu XLA_FLAGS=--xla_force_host_platform_device_count="
+            f"{n_workers})")
+    solver = _toy_round_solver(n_workers, tau)
+    batches, rngs = solver._stage_round(0)
+    closed = jax.make_jaxpr(solver._round_fn(True))(
+        solver.params_w, solver.state_w, jnp.int32(0), batches, rngs)
+    report = audit_jaxpr(closed)
+    report["program"] = "training_round"
+    report["workers"] = n_workers
+    report["tau"] = tau
+    return report
+
+
+def audit_serving_forward(spec: str = "lenet", *, batch: int = 4,
+                          quant: Optional[str] = None) -> Dict[str, Any]:
+    """Trace and audit the serving forward for one bucket (no warmup —
+    tracing only, nothing executes)."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..serving.engine import ModelRunner, resolve_net_param
+
+    runner = ModelRunner(resolve_net_param(spec, max_batch=batch),
+                         max_batch=batch, quant=quant)
+    bucket = min(runner.buckets)
+    x = jnp.zeros((bucket,) + runner.sample_shape, jnp.float32)
+    closed = jax.make_jaxpr(runner._jfwd)(runner._exec_params, x)
+    report = audit_jaxpr(closed)
+    report["program"] = "serving_forward"
+    report["model"] = spec
+    report["bucket"] = bucket
+    report["quant"] = runner.quant
+    return report
+
+
+def findings_from_report(report: Dict[str, Any],
+                         expect_no_convert: bool = False) -> List[str]:
+    """Render a report's violations as human-readable strings (the CLI
+    exits non-zero when any exist).  Host transfers and weak-typed
+    inputs are always violations; convert edges only when the caller
+    opts in (quantized serving legitimately converts)."""
+    out = []
+    prog = report.get("program", "program")
+    for prim, n in report["host_transfers"].items():
+        out.append(f"{prog}: {n}x host-transfer primitive {prim}")
+    if report["weak_type_invars"]:
+        out.append(f"{prog}: {report['weak_type_invars']} weak-typed "
+                   f"inputs (jit cache fragmentation hazard)")
+    if expect_no_convert:
+        for e in report["convert_edges"]:
+            out.append(f"{prog}: {e['count']}x {e['direction']} "
+                       f"{e['from']}->{e['to']}")
+    return out

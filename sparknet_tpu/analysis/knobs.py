@@ -1,0 +1,57 @@
+"""Central declaration of every SPARKNET_* environment knob.
+
+Rule R004 (analysis/rules.py KnobRegistryRule) enforces a three-way
+agreement: every knob the package mentions must appear HERE and in the
+README.md table, and every declaration here must still be mentioned
+somewhere in the package (no stale rows).  The value is a one-line
+summary; the README table stays the operator-facing documentation.
+
+Scope: knobs read by the `sparknet_tpu` package.  `bench.py` reads
+SPARKNET_BENCH_* and tests/conftest.py reads SPARKNET_TEST_PLATFORM;
+both live outside the package and are deliberately not declared.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+KNOBS: Dict[str, str] = {
+    # -- kernels / op dispatch
+    "SPARKNET_FUSED_BLOCKS": "fuse conv->[relu]->LRN->pool towers "
+                             "(off|xla|pallas)",
+    "SPARKNET_LRN_IMPL": "ACROSS_CHANNELS LRN formulation "
+                         "(xla|pallas|matmul)",
+    "SPARKNET_MAXPOOL_BWD": "max-pool backward formulation "
+                            "(native|unrolled|residue|uniform)",
+    "SPARKNET_FLASH_ATTENTION": "opt into the Pallas flash-attention "
+                                "kernel after its compile probe",
+    "SPARKNET_FLASH_PROBE_RESULT": "force the flash-attention compile "
+                                   "probe verdict (ok|fail)",
+    "SPARKNET_FLASH_PROBE_TIMEOUT": "bound the flash-attention compile "
+                                    "probe (seconds)",
+    "SPARKNET_CACHE_DIR": "where probe verdicts persist",
+    "SPARKNET_COMPILE_CACHE": "persistent XLA compile cache directory",
+    # -- observability
+    "SPARKNET_TRACE": "arm the span tracer; Chrome-trace JSON at exit",
+    "SPARKNET_JAX_ANNOTATE": "label XLA ops with span names (opt-in)",
+    "SPARKNET_ROUND_LOG": "per-round training telemetry JSONL path",
+    # -- serving
+    "SPARKNET_SERVE_REPLICAS": "serving replicas placed per loaded model",
+    "SPARKNET_SERVE_MIN_FILL": "batch rows a replica waits for before "
+                               "dispatching",
+    # -- ingest
+    "SPARKNET_PREFETCH_DEPTH": "rounds staged ahead by the prefetcher",
+    "SPARKNET_INGEST_PROCS": "force multi-process ingest",
+    "SPARKNET_INGEST_WORKERS": "cap the ingest pool worker count",
+    "SPARKNET_PULL_WORKERS": "cap the source pull-pool width",
+    "SPARKNET_JPEG_LIB": "libjpeg .so override for native decode",
+    # -- elastic training
+    "SPARKNET_ELASTIC_MIN_QUORUM": "smallest worker quorum a "
+                                   "partial-quorum round averages over",
+    "SPARKNET_ELASTIC_DEADLINE_S": "per-round report deadline (seconds)",
+    "SPARKNET_ELASTIC_SNAPSHOT_EVERY": "rounds between elastic catch-up "
+                                       "snapshots",
+    "SPARKNET_CHAOS_SEED": "default seed for --chaos fault plans",
+    "SPARKNET_TAU_MIN": "adaptive-tau controller floor",
+    "SPARKNET_TAU_MAX": "adaptive-tau controller ceiling",
+}

@@ -1,0 +1,552 @@
+"""Project rules for the sparknet lint engine.
+
+Each rule replaces (and strengthens) a hand-rolled regex pin:
+
+- R001 clock discipline — supersedes tests/test_obs.py's regex, which
+  an `import time as t` or `from time import perf_counter` walked right
+  past.  AST alias tracking closes both holes and adds `monotonic`.
+- R002 parser error contract — every file-format parser must die with a
+  filename-bearing ValueError, never a bare struct.error (the contract
+  the per-parser tests pin at runtime; this rule pins it at the source
+  level, including the call graph the runtime tests can't cover).
+- R003 custom-VJP grad coverage — the tests/test_grad_coverage.py scan,
+  moved onto real decorator parsing (the regex guessed "first def after
+  a custom_vjp mention").
+- R004 SPARKNET_* knob registry — knobs must appear in the central
+  declaration (analysis/knobs.py) AND the README table; stale
+  declarations are flagged too.
+- R005 serving lock discipline — no jit/device-put/value-fetch or
+  blocking join while holding a Lock/Condition in serving/ (the
+  reload-under-traffic and CV-wakeup paths depend on dispatch running
+  OUTSIDE the lock; serving/scheduler.py documents the contract).
+
+Full catalog with rationale and suppression syntax: ANALYSIS.md.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import Dict, List, Optional, Set, Tuple
+
+from .engine import Finding, ModuleContext, Project, Rule
+
+# --------------------------------------------------------------------- R001
+
+_CLOCK_NAMES = frozenset({
+    "time", "time_ns", "perf_counter", "perf_counter_ns",
+    "monotonic", "monotonic_ns",
+})
+
+
+class ClockDisciplineRule(Rule):
+    """Raw clock reads outside the allowlist: every hot-path timestamp
+    must flow through obs.trace.now_s so tracing, telemetry, and timers
+    share one clock."""
+
+    id = "R001"
+    name = "clock-discipline"
+    rationale = ("timestamps must flow through obs.trace.now_s; a raw "
+                 "time.time()/perf_counter()/monotonic() elsewhere is a "
+                 "drift bug waiting to happen")
+    allowlist = frozenset({
+        "obs/trace.py",        # defines now_s — THE timestamp primitive
+        "apps/cifar_app.py",   # wall-clock log FILENAME (reference parity)
+        "apps/imagenet_app.py",  # wall-clock log FILENAME (reference parity)
+    })
+
+    def check_module(self, ctx: ModuleContext) -> List[Finding]:
+        findings: List[Finding] = []
+        time_aliases: Set[str] = set()
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    if alias.name == "time":
+                        time_aliases.add(alias.asname or "time")
+            elif isinstance(node, ast.ImportFrom):
+                if node.module == "time" and node.level == 0:
+                    for alias in node.names:
+                        if alias.name in _CLOCK_NAMES:
+                            findings.append(self.finding(
+                                ctx, node,
+                                f"from-import of clock "
+                                f"time.{alias.name}"
+                                + (f" as {alias.asname}" if alias.asname
+                                   else "")
+                                + " (use obs.trace.now_s)"))
+        for node in ast.walk(ctx.tree):
+            if (isinstance(node, ast.Attribute)
+                    and isinstance(node.value, ast.Name)
+                    and node.value.id in time_aliases
+                    and node.attr in _CLOCK_NAMES):
+                findings.append(self.finding(
+                    ctx, node,
+                    f"raw clock {node.value.id}.{node.attr} "
+                    f"(use obs.trace.now_s)"))
+        return findings
+
+
+# --------------------------------------------------------------------- R002
+
+_UNPACK_NAMES = frozenset({"unpack", "unpack_from", "iter_unpack"})
+
+
+def _handler_catches_struct_error(handler: ast.ExceptHandler,
+                                  struct_aliases: Set[str]) -> bool:
+    """True when the handler type includes struct.error, Exception, or
+    BaseException (directly or inside a tuple)."""
+    def one(t: Optional[ast.expr]) -> bool:
+        if t is None:  # bare `except:` catches everything
+            return True
+        if isinstance(t, ast.Tuple):
+            return any(one(e) for e in t.elts)
+        if isinstance(t, ast.Name):
+            return t.id in ("Exception", "BaseException", "error")
+        if isinstance(t, ast.Attribute):
+            return (t.attr == "error"
+                    and isinstance(t.value, ast.Name)
+                    and t.value.id in struct_aliases)
+        return False
+
+    return one(handler.type)
+
+
+def _handler_names_struct_error(handler: ast.ExceptHandler,
+                                struct_aliases: Set[str]) -> bool:
+    """True when the handler NAMES struct.error specifically (directly
+    or in a tuple) — generic Exception handlers guard, but only explicit
+    struct.error handlers owe the raise-ValueError obligation."""
+    def one(t: ast.expr) -> bool:
+        if isinstance(t, ast.Tuple):
+            return any(one(e) for e in t.elts)
+        if isinstance(t, ast.Attribute):
+            return (t.attr == "error"
+                    and isinstance(t.value, ast.Name)
+                    and t.value.id in struct_aliases)
+        if isinstance(t, ast.Name):
+            return t.id == "error"
+        return False
+
+    return handler.type is not None and one(handler.type)
+
+
+def _terminal_call_name(func: ast.expr) -> Optional[str]:
+    if isinstance(func, ast.Name):
+        return func.id
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    return None
+
+
+class _FuncInfo:
+    __slots__ = ("node", "qualname", "public", "unguarded_unpacks",
+                 "unguarded_calls", "is_raiser")
+
+    def __init__(self, node: ast.AST, qualname: str, public: bool) -> None:
+        self.node = node
+        self.qualname = qualname
+        self.public = public
+        # (node, message) for struct.unpack* calls not under a guarding try
+        self.unguarded_unpacks: List[ast.AST] = []
+        # terminal callee names invoked outside a guarding try
+        self.unguarded_calls: Set[str] = set()
+        self.is_raiser = False
+
+
+class ParserErrorContractRule(Rule):
+    """Every parser under proto//data/ must route struct failures to a
+    filename-bearing ValueError: a struct.unpack reachable from a public
+    function without an intervening `except struct.error -> ValueError`
+    is a contract escape (the malformed-input tests pin IndexError/
+    struct.error never reach callers; this pins it for paths those tests
+    don't construct)."""
+
+    id = "R002"
+    name = "parser-error-contract"
+    rationale = ("file-format parsers die with a file-naming ValueError "
+                 "on malformed input — never struct.error/IndexError "
+                 "(pinned by the per-parser malformed-input tests)")
+    prefixes = ("proto/", "data/")
+
+    def applies_to(self, ctx: ModuleContext) -> bool:
+        return (super().applies_to(ctx)
+                and ctx.rel.startswith(self.prefixes))
+
+    def check_module(self, ctx: ModuleContext) -> List[Finding]:
+        struct_aliases: Set[str] = set()
+        unpack_aliases: Set[str] = set()  # from struct import unpack [as u]
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    if alias.name == "struct":
+                        struct_aliases.add(alias.asname or "struct")
+            elif (isinstance(node, ast.ImportFrom)
+                    and node.module == "struct" and node.level == 0):
+                for alias in node.names:
+                    if alias.name in _UNPACK_NAMES:
+                        unpack_aliases.add(alias.asname or alias.name)
+        if not struct_aliases and not unpack_aliases:
+            return []
+
+        def is_unpack_call(call: ast.Call) -> bool:
+            f = call.func
+            if (isinstance(f, ast.Attribute) and f.attr in _UNPACK_NAMES
+                    and isinstance(f.value, ast.Name)
+                    and f.value.id in struct_aliases):
+                return True
+            return isinstance(f, ast.Name) and f.id in unpack_aliases
+
+        # ---- collect per-function call/unpack sites with guard status
+        funcs: Dict[str, _FuncInfo] = {}
+        handler_findings: List[Finding] = []
+
+        def walk_stmts(body, info: _FuncInfo, guarded: bool,
+                       cls: Optional[str]) -> None:
+            for stmt in body:
+                walk_node(stmt, info, guarded, cls)
+
+        def walk_node(node: ast.AST, info: Optional[_FuncInfo],
+                      guarded: bool, cls: Optional[str]) -> None:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                name = node.name
+                public = (not name.startswith("_")
+                          or (name.startswith("__")
+                              and name.endswith("__")))
+                if cls is not None:
+                    public = public and not cls.startswith("_")
+                    qual = f"{cls}.{name}"
+                else:
+                    qual = name
+                child = funcs.setdefault(qual, _FuncInfo(node, qual, public))
+                # also index bare method names so attribute calls on any
+                # receiver (obj.entries()) resolve within the module
+                funcs.setdefault(name, child)
+                walk_stmts(node.body, child, False, cls)
+                return
+            if isinstance(node, ast.ClassDef):
+                for stmt in node.body:
+                    walk_node(stmt, info, guarded, node.name)
+                return
+            if isinstance(node, ast.Try):
+                catches = any(
+                    _handler_catches_struct_error(h, struct_aliases)
+                    for h in node.handlers)
+                walk_stmts(node.body, info, guarded or catches, cls)
+                for h in node.handlers:
+                    if _handler_names_struct_error(h, struct_aliases):
+                        handler_findings.extend(
+                            self._check_handler(ctx, h))
+                    walk_stmts(h.body, info, guarded, cls)
+                walk_stmts(node.orelse, info, guarded, cls)
+                walk_stmts(node.finalbody, info, guarded, cls)
+                return
+            if isinstance(node, ast.Call) and info is not None:
+                if is_unpack_call(node):
+                    if not guarded:
+                        info.unguarded_unpacks.append(node)
+                elif not guarded:
+                    name = _terminal_call_name(node.func)
+                    if name:
+                        info.unguarded_calls.add(name)
+            for child in ast.iter_child_nodes(node):
+                walk_node(child, info, guarded, cls)
+
+        module_info = _FuncInfo(ctx.tree, "<module>", False)
+        for stmt in ctx.tree.body:
+            walk_node(stmt, module_info, False, None)
+
+        # ---- propagate raiser-ness through the local call graph
+        infos = {info.qualname: info for info in funcs.values()}
+        for info in infos.values():
+            info.is_raiser = bool(info.unguarded_unpacks)
+        changed = True
+        while changed:
+            changed = False
+            for info in infos.values():
+                if info.is_raiser:
+                    continue
+                for callee in info.unguarded_calls:
+                    target = funcs.get(callee)
+                    if target is not None and target.is_raiser:
+                        info.is_raiser = True
+                        changed = True
+                        break
+
+        findings = list(handler_findings)
+        for info in infos.values():
+            if not (info.is_raiser and info.public):
+                continue
+            if info.unguarded_unpacks:
+                node = info.unguarded_unpacks[0]
+                how = "calls struct.unpack"
+            else:
+                node = info.node
+                culprits = sorted(
+                    c for c in info.unguarded_calls
+                    if funcs.get(c) is not None and funcs[c].is_raiser)
+                how = f"reaches struct.unpack via {', '.join(culprits)}"
+            findings.append(self.finding(
+                ctx, node,
+                f"public parser {info.qualname} {how} without a guarding "
+                f"`except struct.error` -> file-naming ValueError"))
+        return findings
+
+    def _check_handler(self, ctx: ModuleContext,
+                       handler: ast.ExceptHandler) -> List[Finding]:
+        """A handler that catches struct.error must raise ValueError —
+        swallowing or bare-re-raising both break the contract."""
+        raises = [n for n in ast.walk(handler)
+                  if isinstance(n, ast.Raise)]
+        for r in raises:
+            if r.exc is None:
+                return [self.finding(
+                    ctx, r, "except struct.error re-raises the raw "
+                    "error instead of a file-naming ValueError")]
+            name = None
+            if isinstance(r.exc, ast.Call):
+                name = _terminal_call_name(r.exc.func)
+            elif isinstance(r.exc, ast.Name):
+                name = r.exc.id
+            if name == "ValueError":
+                return []
+        if raises:
+            return [self.finding(
+                ctx, raises[0], "except struct.error raises something "
+                "other than ValueError")]
+        return [self.finding(
+            ctx, handler, "except struct.error swallows the error; "
+            "raise a file-naming ValueError instead")]
+
+
+# --------------------------------------------------------------------- R003
+
+def _decorator_is_custom_vjp(dec: ast.expr) -> bool:
+    def base(e: ast.expr) -> bool:
+        return ((isinstance(e, ast.Name) and e.id == "custom_vjp")
+                or (isinstance(e, ast.Attribute)
+                    and e.attr == "custom_vjp"))
+
+    if base(dec):
+        return True
+    if isinstance(dec, ast.Call):
+        # @jax.custom_vjp(...), @partial(jax.custom_vjp, nondiff...),
+        # @functools.partial(jax.custom_vjp, ...)
+        if base(dec.func):
+            return True
+        fname = _terminal_call_name(dec.func)
+        if fname == "partial":
+            return any(base(a) for a in dec.args)
+    return False
+
+
+def find_custom_vjp_ops(project_root: str) -> List[Tuple[str, str, int]]:
+    """(op_name, rel_file, line) for every custom_vjp-decorated def under
+    <project_root>/ops — the AST replacement for the regex scan
+    tests/test_grad_coverage.py used to carry."""
+    ops_dir = os.path.join(project_root, "ops")
+    found: List[Tuple[str, str, int]] = []
+    if not os.path.isdir(ops_dir):
+        return found
+    for fn in sorted(os.listdir(ops_dir)):
+        if not fn.endswith(".py"):
+            continue
+        path = os.path.join(ops_dir, fn)
+        with open(path, encoding="utf-8") as f:
+            try:
+                tree = ast.parse(f.read(), filename=path)
+            except SyntaxError:
+                continue  # E000 covers it
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                if any(_decorator_is_custom_vjp(d)
+                       for d in node.decorator_list):
+                    found.append((node.name, f"ops/{fn}", node.lineno))
+    return found
+
+
+class GradCoverageRule(Rule):
+    """Every custom_vjp op in ops/ must be exercised by a numerical
+    check_grads test in tests/, or carry an explicit exemption."""
+
+    id = "R003"
+    name = "custom-vjp-grad-coverage"
+    rationale = ("a hand-written backward with a silent sign/transpose "
+                 "error corrupts training while forward tests stay "
+                 "green; each custom_vjp op needs a check_grads test")
+    # ops whose backward is intentionally NOT the true gradient
+    exempt_ops = frozenset({
+        # AVE-style uniform routing, ATTRIBUTION ONLY: deliberately wrong
+        # gradients to isolate SelectAndScatter cost (ops/pooling.py)
+        "_max_pool_uniform_bwd",
+    })
+
+    def __init__(self, exempt_ops: Optional[Set[str]] = None) -> None:
+        if exempt_ops is not None:
+            self.exempt_ops = frozenset(exempt_ops)
+
+    def finalize(self, project: Project) -> List[Finding]:
+        ops = find_custom_vjp_ops(project.root)
+        tests_dir = os.path.join(project.repo_root, "tests")
+        sources: List[str] = []
+        if os.path.isdir(tests_dir):
+            for fn in sorted(os.listdir(tests_dir)):
+                if fn.endswith(".py"):
+                    with open(os.path.join(tests_dir, fn),
+                              encoding="utf-8") as f:
+                        sources.append(f.read())
+        findings = []
+        for name, rel, line in ops:
+            if name in self.exempt_ops:
+                continue
+            if any("check_grads" in src and name in src
+                   for src in sources):
+                continue
+            findings.append(self.finding(
+                rel, line,
+                f"custom_vjp op {name} has no check_grads test under "
+                f"tests/ (add one, or an explicit exemption with a "
+                f"reason)"))
+        return findings
+
+
+# --------------------------------------------------------------------- R004
+
+_KNOB_TOKEN_RE = re.compile(r"SPARKNET_[A-Z0-9_]+")
+
+
+class KnobRegistryRule(Rule):
+    """Every SPARKNET_* knob the package mentions must be declared in the
+    central registry (analysis/knobs.py) and documented in the README
+    table; declarations nothing mentions anymore are stale."""
+
+    id = "R004"
+    name = "knob-registry"
+    rationale = ("an env knob that ships undeclared or undocumented is "
+                 "invisible to operators; the registry + README table "
+                 "are the single source of truth")
+    # the declaration site itself and this rule's own regex literal
+    allowlist = frozenset({"analysis/knobs.py"})
+
+    def __init__(self, declared: Optional[Dict[str, str]] = None,
+                 readme_name: str = "README.md") -> None:
+        self._declared = declared
+        self.readme_name = readme_name
+
+    def _declarations(self) -> Dict[str, str]:
+        if self._declared is not None:
+            return self._declared
+        from .knobs import KNOBS
+        return KNOBS
+
+    def finalize(self, project: Project) -> List[Finding]:
+        declared = self._declarations()
+        readme_path = os.path.join(project.repo_root, self.readme_name)
+        readme = ""
+        if os.path.exists(readme_path):
+            with open(readme_path, encoding="utf-8") as f:
+                readme = f.read()
+
+        seen: Dict[str, Tuple[str, int]] = {}  # knob -> first (rel, line)
+        for ctx in project.modules:
+            if not self.applies_to(ctx):
+                continue
+            for i, text in enumerate(ctx.source.splitlines(), start=1):
+                for m in _KNOB_TOKEN_RE.finditer(text):
+                    seen.setdefault(m.group(0), (ctx.rel, i))
+
+        findings = []
+        for knob in sorted(seen):
+            rel, line = seen[knob]
+            if knob not in declared:
+                findings.append(self.finding(
+                    rel, line,
+                    f"env knob {knob} is not declared in "
+                    f"analysis/knobs.py KNOBS"))
+            if knob not in readme:
+                findings.append(self.finding(
+                    rel, line,
+                    f"env knob {knob} is not documented in "
+                    f"{self.readme_name}"))
+        for knob in sorted(set(declared) - set(seen)):
+            findings.append(self.finding(
+                "analysis/knobs.py", 0,
+                f"declared knob {knob} is never mentioned by the "
+                f"package — stale declaration"))
+        return findings
+
+
+# --------------------------------------------------------------------- R005
+
+_LOCKISH_RE = re.compile(r"lock|cv|cond", re.IGNORECASE)
+
+# device dispatch, value fetches, and blocking joins that must not run
+# while holding a serving-stack lock
+_BLOCKED_UNDER_LOCK = frozenset({
+    "jit", "device_put", "device_get", "block_until_ready",
+    "forward_padded", "forward", "warmup", "replicate", "calibrate_quant",
+    "asarray", "result", "join",
+})
+
+
+def _terminal_name(expr: ast.expr) -> Optional[str]:
+    if isinstance(expr, ast.Name):
+        return expr.id
+    if isinstance(expr, ast.Attribute):
+        return expr.attr
+    if isinstance(expr, ast.Call):
+        return _terminal_call_name(expr.func)
+    return None
+
+
+class LockDisciplineRule(Rule):
+    """In serving/, a `with <lock-ish>:` body must not dispatch device
+    work, fetch values, or block on joins — admission/routing must never
+    stall behind device time (serving/scheduler.py's contract)."""
+
+    id = "R005"
+    name = "serving-lock-discipline"
+    rationale = ("device dispatch or a blocking join inside a held "
+                 "Lock/Condition serializes the serving stack and can "
+                 "deadlock the CV-wakeup and reload-under-traffic paths")
+    prefixes = ("serving/",)
+
+    def applies_to(self, ctx: ModuleContext) -> bool:
+        return (super().applies_to(ctx)
+                and ctx.rel.startswith(self.prefixes))
+
+    def check_module(self, ctx: ModuleContext) -> List[Finding]:
+        findings: List[Finding] = []
+
+        def lockish(item: ast.withitem) -> bool:
+            name = _terminal_name(item.context_expr)
+            return bool(name and _LOCKISH_RE.search(name))
+
+        def scan_body(node: ast.AST) -> None:
+            for child in ast.walk(node):
+                if isinstance(child, ast.Call):
+                    name = _terminal_call_name(child.func)
+                    if name in _BLOCKED_UNDER_LOCK:
+                        findings.append(self.finding(
+                            ctx, child,
+                            f"{name}() while holding a serving lock — "
+                            f"move dispatch/fetch outside the `with`"))
+
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, (ast.With, ast.AsyncWith)) \
+                    and any(lockish(it) for it in node.items):
+                for stmt in node.body:
+                    scan_body(stmt)
+        return findings
+
+
+# ------------------------------------------------------------------ factory
+
+def default_rules() -> List[Rule]:
+    return [
+        ClockDisciplineRule(),
+        ParserErrorContractRule(),
+        GradCoverageRule(),
+        KnobRegistryRule(),
+        LockDisciplineRule(),
+    ]

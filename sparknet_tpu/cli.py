@@ -545,6 +545,9 @@ def main(argv=None) -> int:
     from .obs import cli as obs_cli
     obs_cli.register(sub)
 
+    from .analysis import cli as analysis_cli
+    analysis_cli.register(sub)
+
     args = p.parse_args(argv)
     return args.fn(args)
 

@@ -216,7 +216,11 @@ def read_log_records(path: str, *, verify: bool = True) -> Iterator[bytes]:
         if block_left < LOG_HEADER:
             pos += block_left  # zero trailer
             continue
-        masked, length, rtype = struct.unpack_from("<IHB", data, pos)
+        try:
+            masked, length, rtype = struct.unpack_from("<IHB", data, pos)
+        except struct.error as e:
+            raise ValueError(
+                f"{path}: corrupt log record header ({e})") from None
         if masked == 0 and length == 0 and rtype == 0:
             pos += block_left  # padding to end of block
             continue
@@ -303,12 +307,17 @@ class SSTableReader:
     internal-key entries in order (table.cc / format.cc)."""
 
     def __init__(self, path: str, *, verify: bool = False) -> None:
+        self.path = path
         with open(path, "rb") as f:
             self.data = f.read()
         if len(self.data) < FOOTER_SIZE:
             raise ValueError(f"{path}: too small for an sstable")
         footer = self.data[-FOOTER_SIZE:]
-        magic = struct.unpack_from("<Q", footer, FOOTER_SIZE - 8)[0]
+        try:
+            magic = struct.unpack_from("<Q", footer, FOOTER_SIZE - 8)[0]
+        except struct.error as e:
+            raise ValueError(
+                f"{path}: unreadable sstable footer ({e})") from None
         if magic != TABLE_MAGIC:
             raise ValueError(f"{path}: bad sstable magic {magic:#x}")
         pos = 0
@@ -317,6 +326,12 @@ class SSTableReader:
         self._verify = verify
 
     def _load_block(self, offset: int, size: int) -> bytes:
+        # every handle carries a 5-byte trailer (1 ctype + 4 crc); a corrupt
+        # index entry pointing past EOF used to escape as IndexError below
+        if offset + size + 5 > len(self.data):
+            raise ValueError(
+                f"{self.path}: block handle ({offset}, {size}) points past "
+                f"end of file ({len(self.data)} bytes)")
         raw = self.data[offset:offset + size]
         ctype = self.data[offset + size]
         if self._verify:
@@ -332,10 +347,14 @@ class SSTableReader:
 
     def entries(self) -> Iterator[Tuple[bytes, bytes]]:
         """(internal_key, value) across all data blocks, in key order."""
-        index = self._load_block(self._index_off, self._index_size)
-        for _sep_key, handle in _parse_block(index):
-            off, size, _ = _block_handle(handle, 0)
-            yield from _parse_block(self._load_block(off, size))
+        try:
+            index = self._load_block(self._index_off, self._index_size)
+            for _sep_key, handle in _parse_block(index):
+                off, size, _ = _block_handle(handle, 0)
+                yield from _parse_block(self._load_block(off, size))
+        except struct.error as e:
+            raise ValueError(
+                f"{self.path}: corrupt sstable block ({e})") from None
 
 
 def _split_internal(ikey: bytes) -> Tuple[bytes, int, int]:
@@ -448,7 +467,11 @@ class LevelDBReader:
             if not m or int(m.group(1)) < floor:
                 continue
             for batch in read_log_records(p):
-                seq, count = struct.unpack_from("<QI", batch, 0)
+                try:
+                    seq, count = struct.unpack_from("<QI", batch, 0)
+                except struct.error as e:
+                    raise ValueError(
+                        f"{p}: corrupt WriteBatch header ({e})") from None
                 pos = 12
                 for _ in range(count):
                     op = batch[pos]
@@ -474,17 +497,23 @@ class LevelDBReader:
         user key, deletions drop the key (the DBIter collapse)."""
         import heapq
 
-        sources = [self._table_iter(p) for p in self._table_files]
-        if self._wal:
-            sources.append(iter(self._wal))
-        merged = heapq.merge(*sources, key=lambda e: (e[0], -e[1]))
-        current: Optional[bytes] = None
-        for user_key, _seq, vtype, value in merged:
-            if user_key == current:
-                continue  # an older sequence of an already-decided key
-            current = user_key
-            if vtype == TYPE_VALUE:
-                yield user_key, value
+        try:
+            sources = [self._table_iter(p) for p in self._table_files]
+            if self._wal:
+                sources.append(iter(self._wal))
+            merged = heapq.merge(*sources, key=lambda e: (e[0], -e[1]))
+            current: Optional[bytes] = None
+            for user_key, _seq, vtype, value in merged:
+                if user_key == current:
+                    continue  # an older sequence of an already-decided key
+                current = user_key
+                if vtype == TYPE_VALUE:
+                    yield user_key, value
+        except struct.error as e:
+            # the table iterators raise lazily (short internal keys land
+            # in _split_internal mid-merge), so the guard sits here
+            raise ValueError(
+                f"{self.path}: corrupt sstable entry ({e})") from None
 
     def __len__(self) -> int:
         return sum(1 for _ in self.items())

@@ -99,6 +99,7 @@ class LMDBReader:
 
         if os.path.isdir(path):
             path = os.path.join(path, "data.mdb")
+        self.path = path
         # mmap, not read(): reference ImageNet LMDBs run to hundreds of GB
         # (all access below is struct.unpack_from / slicing, both mmap-safe)
         self._f = open(path, "rb")
@@ -186,7 +187,13 @@ class LMDBReader:
         """(key, value) pairs in key order (LMDBCursor SeekToFirst/Next)."""
         if self.meta["root"] == P_INVALID:
             return
-        yield from self._walk(self.meta["root"])
+        try:
+            yield from self._walk(self.meta["root"])
+        except struct.error as e:
+            # a corrupt page table walks the cursor off the map; the walk
+            # raises lazily, so the guard lives at the consumption point
+            raise ValueError(
+                f"{self.path}: corrupt LMDB page ({e})") from None
 
     def __len__(self) -> int:
         return self.entries

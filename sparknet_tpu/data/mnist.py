@@ -34,7 +34,7 @@ def read_idx(path: str) -> np.ndarray:
                 raise ValueError(f"{path}: truncated idx dimension table")
             dims = struct.unpack(">" + "I" * ndim, raw_dims)
             data = np.frombuffer(f.read(), dtype=np.uint8)
-    except (EOFError, gzip.BadGzipFile, OSError) as e:
+    except (EOFError, gzip.BadGzipFile, OSError, struct.error) as e:
         # a cut-short or corrupt .gz stream fails inside read(), before
         # any of the checks above — keep the ValueError contract
         raise ValueError(f"{path}: unreadable idx file ({e})") from None

@@ -36,7 +36,6 @@ import atexit
 import collections
 import os
 import threading
-import time
 import weakref
 from typing import Any, Callable, List, Optional, Sequence
 
@@ -284,7 +283,7 @@ class PipelinedIngestExecutor:
         """Block until the coordinator can make no further progress without
         the consumer: ring full, limit reached, failed, or stopped.  Test
         hook (and a deterministic point to read pull counts)."""
-        deadline = time.monotonic() + timeout
+        deadline = now_s() + timeout
         with self._cv:
             while True:
                 idle = (not self._staging
@@ -292,7 +291,7 @@ class PipelinedIngestExecutor:
                              or len(self._ring) >= self.depth))
                 if idle:
                     return True
-                remaining = deadline - time.monotonic()
+                remaining = deadline - now_s()
                 if remaining <= 0:
                     return False
                 self._cv.wait(min(0.2, remaining))

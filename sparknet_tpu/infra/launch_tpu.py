@@ -23,8 +23,10 @@ import argparse
 import shlex
 import subprocess
 import sys
-import time
+import time  # sleep only; timing reads go through obs.trace.now_s
 from typing import Callable, List, Optional, Tuple
+
+from ..obs.trace import now_s
 
 # Commands run on every host after creation (the analogue of the AMI
 # setup + deploy rsync in spark_ec2.py setup_cluster).
@@ -184,7 +186,7 @@ def wait_for_state(cluster: TpuCluster, target: str, *,
     TpuClusterError on a FAILED-class state, on persistent describe
     errors, or on timeout, naming the last observed state so the
     operator can resume with `launch --resume`."""
-    deadline = time.monotonic() + timeout_s
+    deadline = now_s() + timeout_s
     state = "UNKNOWN"
     while True:
         rc, out = _describe_retrying(cluster, runner, sleep, poll_s)
@@ -203,7 +205,7 @@ def wait_for_state(cluster: TpuCluster, target: str, *,
                 f"{cluster.name} entered {state} while waiting for "
                 f"{target}; destroy and relaunch (spot slices can be "
                 f"preempted mid-create)")
-        if time.monotonic() >= deadline:
+        if now_s() >= deadline:
             raise TpuClusterError(
                 f"timed out after {timeout_s:g}s waiting for "
                 f"{cluster.name} to reach {target} (last state: {state}); "

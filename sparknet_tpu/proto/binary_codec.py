@@ -58,11 +58,17 @@ def _decode_scalar(kind: str, wt: int, val, unknown) -> object:
     if kind == "float":
         if wt != 5:
             raise ValueError(f"wire type {wt} for float")
-        return struct.unpack("<f", val)[0]
+        try:
+            return struct.unpack("<f", val)[0]
+        except struct.error as e:
+            raise ValueError(f"malformed float value: {e}") from None
     if kind == "double":
         if wt != 1:
             raise ValueError(f"wire type {wt} for double")
-        return struct.unpack("<d", val)[0]
+        try:
+            return struct.unpack("<d", val)[0]
+        except struct.error as e:
+            raise ValueError(f"malformed double value: {e}") from None
     if kind == "string":
         if wt != 2:
             raise ValueError(f"wire type {wt} for string")

@@ -96,8 +96,13 @@ def _packed_floats(chunks: List[object], unpacked: List[object]) -> np.ndarray:
     parts = []
     for c in chunks:
         parts.append(np.frombuffer(c, dtype="<f4"))
-    for u in unpacked:
-        parts.append(np.asarray([struct.unpack("<f", u)[0]], dtype=np.float32))
+    try:
+        for u in unpacked:
+            parts.append(np.asarray([struct.unpack("<f", u)[0]],
+                                    dtype=np.float32))
+    except (struct.error, TypeError) as e:
+        raise ValueError(f"malformed float value in blob data: {e}") \
+            from None
     if not parts:
         return np.zeros((0,), dtype=np.float32)
     return np.concatenate(parts)
@@ -115,7 +120,17 @@ def parse_blob(buf: bytes) -> np.ndarray:
     shape: Optional[List[int]] = None
     for field, wt, val in iter_fields(buf):
         if field == 5:
-            (data_chunks if wt == 2 else data_single).append(val)
+            # packed run (wt 2) or single fixed32 float (wt 5); a varint
+            # or fixed64 here is a corrupt blob — routing it into the
+            # float decode used to escape as TypeError/struct.error
+            if wt == 2:
+                data_chunks.append(val)
+            elif wt == 5:
+                data_single.append(val)
+            else:
+                raise ValueError(
+                    f"BlobProto data (field 5) has wire type {wt}; "
+                    f"expected packed (2) or fixed32 (5) floats")
         elif field == 7 and wt == 2:
             dims = []
             for f2, wt2, v2 in iter_fields(val):  # BlobShape

@@ -118,3 +118,19 @@ def test_overlong_varint_fails_fast(tmp_path):
     with pytest.raises(ValueError, match="varint"):
         read_caffemodel(str(p))
     assert time.time() - t0 < 1.0, "rejection was not O(1)"
+
+
+def test_blob_data_field_with_varint_wire_type_raises_value_error():
+    """BlobProto field 5 (data) carrying a varint or fixed64 is a corrupt
+    blob; routing it into the float decode used to escape as TypeError/
+    struct.error instead of the contract ValueError (lint rule R002
+    caught the escape; this pins the fix at runtime)."""
+    import pytest
+    from sparknet_tpu.proto.binaryproto import parse_blob
+
+    # field 5, wire type 0 (varint), value 7
+    with pytest.raises(ValueError, match="wire type 0"):
+        parse_blob(bytes([5 << 3 | 0, 7]))
+    # field 5, wire type 1 (fixed64)
+    with pytest.raises(ValueError, match="wire type 1"):
+        parse_blob(bytes([5 << 3 | 1]) + b"\x00" * 8)

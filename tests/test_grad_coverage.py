@@ -14,7 +14,6 @@ be documented in README.md, so a new knob cannot ship invisible
 """
 
 import os
-import re
 
 import jax
 import jax.numpy as jnp
@@ -23,66 +22,49 @@ from jax.test_util import check_grads
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
-# custom_vjp ops whose backward is intentionally NOT the true gradient,
-# with why — anything else found undecorated by a check_grads test fails
-_CHECK_GRADS_EXEMPT = {
-    # AVE-style uniform routing, ATTRIBUTION ONLY: deliberately wrong
-    # gradients to isolate SelectAndScatter cost (ops/pooling.py study)
-    "_max_pool_uniform_bwd",
-}
+# The exemption list (ops whose backward is intentionally NOT the true
+# gradient) lives with the rule: GradCoverageRule.exempt_ops in
+# sparknet_tpu/analysis/rules.py.
 
 
 def _custom_vjp_ops():
-    """(op_name, file) for every custom_vjp-decorated def in ops/."""
-    ops_dir = os.path.join(REPO, "sparknet_tpu", "ops")
-    found = []
-    for fn in sorted(os.listdir(ops_dir)):
-        if not fn.endswith(".py"):
-            continue
-        src = open(os.path.join(ops_dir, fn)).read()
-        # the decorator may span lines (functools.partial(...)); grab
-        # the first def after each custom_vjp mention
-        for m in re.finditer(r"custom_vjp", src):
-            d = re.search(r"\ndef\s+(\w+)", src[m.end():])
-            if d:
-                found.append((d.group(1), fn))
-    return found
+    """(op_name, file) for every custom_vjp-decorated def in ops/ —
+    thin wrapper over the AST scan in sparknet_tpu/analysis/rules.py
+    (real decorator parsing; the regex this used to carry guessed
+    "first def after a custom_vjp mention")."""
+    from sparknet_tpu.analysis.rules import find_custom_vjp_ops
+
+    return [(name, os.path.basename(rel))
+            for name, rel, _line in
+            find_custom_vjp_ops(os.path.join(REPO, "sparknet_tpu"))]
 
 
 def test_every_custom_vjp_op_has_check_grads_test():
-    ops = _custom_vjp_ops()
-    assert len(ops) >= 5  # the scan itself must keep finding them
-    tests_dir = os.path.dirname(os.path.abspath(__file__))
-    sources = {}
-    for fn in os.listdir(tests_dir):
-        if fn.endswith(".py"):
-            sources[fn] = open(os.path.join(tests_dir, fn)).read()
-    missing = []
-    for name, where in ops:
-        if name in _CHECK_GRADS_EXEMPT:
-            continue
-        covered = any("check_grads" in src and name in src
-                      for src in sources.values())
-        if not covered:
-            missing.append(f"{where}:{name}")
-    assert not missing, (
-        f"custom_vjp ops without a check_grads test (add one, or add an "
-        f"explicit exemption with a reason): {missing}")
+    # wrapper over sparknet lint rule R003 (GradCoverageRule carries the
+    # exemption list); the count assertion keeps the scan honest
+    from sparknet_tpu.analysis import run_lint
+
+    assert len(_custom_vjp_ops()) >= 5
+    findings = run_lint(os.path.join(REPO, "sparknet_tpu"),
+                        repo_root=REPO, select=["R003"])
+    assert not findings, (
+        "custom_vjp ops without a check_grads test (add one, or add an "
+        "explicit exemption with a reason):\n"
+        + "\n".join(f.render() for f in findings))
 
 
 def test_every_env_knob_documented_in_readme():
-    pkg = os.path.join(REPO, "sparknet_tpu")
-    knobs = set()
-    for dirpath, _dirs, files in os.walk(pkg):
-        for fn in files:
-            if fn.endswith(".py"):
-                src = open(os.path.join(dirpath, fn)).read()
-                knobs.update(re.findall(r"SPARKNET_[A-Z0-9_]+", src))
-    readme = open(os.path.join(REPO, "README.md")).read()
-    undocumented = sorted(k for k in knobs if k not in readme)
-    assert not undocumented, (
-        f"env knobs read by the package but missing from README.md: "
-        f"{undocumented}")
+    # wrapper over sparknet lint rule R004 (KnobRegistryRule): every
+    # SPARKNET_* knob must be declared in analysis/knobs.py AND
+    # documented in README.md, with no stale declarations
+    from sparknet_tpu.analysis import run_lint
+
+    findings = run_lint(os.path.join(REPO, "sparknet_tpu"),
+                        repo_root=REPO, select=["R004"])
+    assert not findings, (
+        "knob registry violations (declare in analysis/knobs.py + "
+        "document in README.md):\n"
+        + "\n".join(f.render() for f in findings))
 
 
 # ------------------------- the numerical checks the static scan demands

@@ -403,36 +403,20 @@ def test_phase_logger_context_manager(tmp_path, capsys):
 
 # -------------------------------------------------- static analysis: clocks
 
-# every module allowed to touch the raw clock, with why:
-_CLOCK_ALLOWLIST = {
-    "obs/trace.py",           # defines now_s — THE timestamp primitive
-    "apps/cifar_app.py",      # wall-clock log FILENAME (reference parity)
-    "apps/imagenet_app.py",   # wall-clock log FILENAME (reference parity)
-}
-
-
 def test_no_raw_clock_calls_outside_allowlist():
     """Hot-path timestamps must flow through obs.trace.now_s so tracing,
-    telemetry, and timers share one clock; a raw time.time()/
-    perf_counter() call elsewhere is a drift bug waiting to happen."""
-    pat = re.compile(r"time\.(time|perf_counter)\s*\(")
-    pkg = os.path.join(REPO, "sparknet_tpu")
-    offenders = []
-    for dirpath, _dirs, files in os.walk(pkg):
-        for fn in files:
-            if not fn.endswith(".py"):
-                continue
-            path = os.path.join(dirpath, fn)
-            rel = os.path.relpath(path, pkg).replace(os.sep, "/")
-            if rel in _CLOCK_ALLOWLIST:
-                continue
-            src = open(path).read()
-            for m in pat.finditer(src):
-                line = src.count("\n", 0, m.start()) + 1
-                offenders.append(f"{rel}:{line}")
-    assert not offenders, (
-        f"raw clock calls outside allowlist (use obs.trace.now_s): "
-        f"{offenders}")
+    telemetry, and timers share one clock.  Thin wrapper over sparknet
+    lint rule R001 (sparknet_tpu/analysis/rules.py ClockDisciplineRule,
+    which owns the allowlist) — the AST rule also catches the
+    `import time as t` / `from time import perf_counter` aliases the
+    regex this test used to carry walked right past."""
+    from sparknet_tpu.analysis import run_lint
+
+    findings = run_lint(os.path.join(REPO, "sparknet_tpu"),
+                        repo_root=REPO, select=["R001"])
+    assert not findings, (
+        "raw clock calls outside allowlist (use obs.trace.now_s):\n"
+        + "\n".join(f.render() for f in findings))
 
 
 # ------------------------------------------------------------ bench stamping
