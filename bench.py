@@ -497,8 +497,9 @@ def bench_elastic(rounds: int = 6):
     script = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                           "scripts", "chaos_run.py")
     proc = subprocess.run(
-        [sys.executable, script, "--ab", "--rounds", str(rounds)],
-        capture_output=True, text=True, env=env, timeout=600)
+        [sys.executable, script, "--ab", "--proc", "--rounds",
+         str(rounds)],
+        capture_output=True, text=True, env=env, timeout=900)
     if proc.returncode != 0:
         raise RuntimeError(
             f"chaos_run.py exited {proc.returncode}: "
@@ -514,7 +515,16 @@ def bench_elastic(rounds: int = 6):
            "elastic_tau_final": rec["tau_final"],
            "elastic_full_barrier_stall_s": rec["full_barrier_stall_s"],
            "elastic_quorum_stall_s": rec["partial_quorum_stall_s"],
-           "elastic_stall_ratio": rec["stall_ratio"]}
+           "elastic_stall_ratio": rec["stall_ratio"],
+           # process-level arm (schema v4): REAL worker subprocesses,
+           # seeded SIGKILL + manifest-validated snapshot catch-up join
+           "elastic_proc_workers": rec["proc_workers"],
+           "elastic_proc_rounds": rec["proc_rounds"],
+           "elastic_proc_quorums": rec["proc_quorums"],
+           "elastic_proc_crashes": int(rec["proc_crashes"]),
+           "elastic_proc_restarts": int(rec["proc_restarts"]),
+           "elastic_proc_join_source": rec["proc_join_source"],
+           "elastic_proc_torn_skipped": rec["proc_torn_skipped"]}
     log(json.dumps(out))
     return out
 
@@ -797,6 +807,12 @@ _KNOWN_FIELDS = {
     "elastic_crashes", "elastic_tau_final",
     "elastic_full_barrier_stall_s", "elastic_quorum_stall_s",
     "elastic_stall_ratio",
+    # process-level elastic arm (schema v4): real subprocess workers,
+    # SIGKILL chaos, snapshot catch-up join
+    "elastic_proc_workers", "elastic_proc_rounds",
+    "elastic_proc_quorums", "elastic_proc_crashes",
+    "elastic_proc_restarts", "elastic_proc_join_source",
+    "elastic_proc_torn_skipped",
 }
 
 # every leg name main() lands; leg_utc stamps outside this set (renamed
@@ -889,7 +905,10 @@ def _stale_record(reason: str) -> dict:
     return stale
 
 
-BENCH_SCHEMA_VERSION = 3  # v3: serving replica/topology stamps + the
+BENCH_SCHEMA_VERSION = 4  # v4: elastic leg gains the process-level arm
+#                           (elastic_proc_* — real subprocess workers,
+#                           SIGKILL chaos, snapshot catch-up join);
+#                           v3: serving replica/topology stamps + the
 #                           serving_mesh interleaved A/B leg
 
 # git SHA memo.  main() primes it up front (subprocess, once), so the

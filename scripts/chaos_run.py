@@ -16,7 +16,14 @@ vs partial quorum, comparing SIMULATED stall-seconds from round
 telemetry — deterministic on a one-core box, no wall-clock in the
 verdict.
 
-Run:  python scripts/chaos_run.py [--rounds 6] [--ab] [--seed 5]
+--proc additionally runs the PROCESS-level arm (elastic/proc.py): 4
+real worker subprocesses, a seeded SIGKILL of worker 2 at round 2, and
+a fresh-process join at round 4 restoring from the newest
+manifest-validated snapshot; --no_smoke skips the in-process smoke so
+scripts/lint_gate.sh can run the proc arm standalone.
+
+Run:  python scripts/chaos_run.py [--rounds 6] [--ab] [--proc]
+      [--no_smoke] [--seed 5]
 """
 
 import argparse
@@ -131,6 +138,46 @@ def run_ab(rounds: int, seed: int, mult: float = 20.0) -> dict:
             "stall_ratio": round(quorum / full, 6) if full else 0.0}
 
 
+def run_proc(rounds: int, seed: int) -> dict:
+    """Process-level chaos arm: 4 REAL worker subprocesses, a seeded
+    SIGKILL of worker 2 at round 2, a fresh-process join at round 4
+    restoring from the newest manifest-validated snapshot — the
+    acceptance scenario for the proc supervisor (quorum dips to N-1 for
+    the crashed rounds, then recovers)."""
+    from sparknet_tpu.elastic import FaultPlan, ProcSupervisor
+
+    n, join_round = 4, 4
+    with tempfile.TemporaryDirectory(prefix="chaos_proc_") as snapdir:
+        plan = FaultPlan.from_spec("crash:2@2", seed=seed)
+        with ProcSupervisor(n, tau=2, seed=seed, builder="toy",
+                            min_quorum=2, chaos=plan,
+                            snapshot_dir=snapdir, snapshot_every=1,
+                            deadline_s=60.0) as sup:
+            sup.schedule_join(2, join_round)
+            losses = sup.run(rounds)
+            st = sup.stats()
+            rec = [e for e in sup.events if e.get("kind") == "round"]
+            joins = [e for e in sup.events if e.get("kind") == "join"]
+        assert len(losses) == rounds and all(np.isfinite(losses)), losses
+        quorums = [e["quorum"] for e in rec]
+        # rounds 0..1 full house, crash rounds run at n-1, join recovers
+        assert quorums[:2] == [n, n], quorums
+        assert all(q == n - 1 for q in quorums[2:join_round]), quorums
+        assert all(q == n for q in quorums[join_round:]), quorums
+        assert joins and str(joins[0]["source"] or "").split(os.sep)[-1] \
+            .startswith("step_"), joins
+        assert st["worker_restarts"] == 1 and st["proc_crashes"] >= 1, st
+        return {"proc_workers": n, "proc_rounds": rounds,
+                "proc_quorums": quorums,
+                "proc_crashes": st["proc_crashes"],
+                "proc_restarts": st["worker_restarts"],
+                "proc_snapshots": st["snapshots"],
+                "proc_join_source": os.path.basename(
+                    str(joins[0]["source"])),
+                "proc_torn_skipped": st["torn_snapshots_skipped"],
+                "proc_final_iter": st["iter"]}
+
+
 def main() -> None:
     p = argparse.ArgumentParser()
     p.add_argument("--rounds", type=int, default=6)
@@ -138,12 +185,21 @@ def main() -> None:
     p.add_argument("--ab", action="store_true",
                    help="also run the full-barrier vs partial-quorum "
                         "stall A/B (the bench.py elastic leg)")
+    p.add_argument("--proc", action="store_true",
+                   help="also run the process-level supervisor arm "
+                        "(real SIGKILL + snapshot catch-up join)")
+    p.add_argument("--no_smoke", action="store_true",
+                   help="skip the in-process smoke (lint_gate runs the "
+                        "proc arm standalone)")
     a = p.parse_args()
 
     out = {"workers": N_WORKERS, "seed": a.seed}
-    out.update(run_smoke(a.rounds, a.seed))
+    if not a.no_smoke:
+        out.update(run_smoke(a.rounds, a.seed))
     if a.ab:
         out.update(run_ab(max(4, a.rounds), a.seed))
+    if a.proc:
+        out.update(run_proc(max(6, a.rounds), a.seed))
     out["ok"] = True
     print(json.dumps(out), flush=True)
 

@@ -1,10 +1,21 @@
 #!/usr/bin/env bash
-# CI gate for the static-analysis subsystem: exits non-zero on ANY lint
-# finding (the `sparknet lint` verb's exit-code contract; rule catalog in
-# ANALYSIS.md).  Extra args pass through, e.g.:
-#   scripts/lint_gate.sh                       # lint the package
+# CI gate for the static-analysis subsystem PLUS the proc-mode chaos
+# smoke: exits non-zero on ANY lint finding (the `sparknet lint` verb's
+# exit-code contract; rule catalog in ANALYSIS.md) or on a failed
+# process-level elastic run (scripts/chaos_run.py --proc: real worker
+# subprocesses, seeded SIGKILL, manifest-validated snapshot catch-up —
+# ONE JSON line with "ok": true, self-guarded by a hard timeout so a
+# wedged worker can never hang the gate).  Extra args pass through to
+# the lint verb, e.g.:
+#   scripts/lint_gate.sh                       # lint + proc smoke
 #   scripts/lint_gate.sh --select R001,R004    # subset of rules
 #   scripts/lint_gate.sh --jaxpr round         # + trace the fused round
+# Set SPARKNET_LINT_GATE_NO_PROC=1 to skip the smoke (lint-only, e.g.
+# on a box where fork/subprocess is forbidden).
 set -euo pipefail
 cd "$(dirname "$0")/.."
-exec python -m sparknet_tpu.cli lint --format json "$@"
+python -m sparknet_tpu.cli lint --format json "$@"
+if [ "${SPARKNET_LINT_GATE_NO_PROC:-0}" != "1" ]; then
+    timeout -k 10 420 env JAX_PLATFORMS=cpu \
+        python scripts/chaos_run.py --proc --no_smoke
+fi

@@ -51,6 +51,12 @@ KNOBS: Dict[str, str] = {
     "SPARKNET_ELASTIC_DEADLINE_S": "per-round report deadline (seconds)",
     "SPARKNET_ELASTIC_SNAPSHOT_EVERY": "rounds between elastic catch-up "
                                        "snapshots",
+    "SPARKNET_ELASTIC_PROC": "default worker-process count for the "
+                             "process-level elastic supervisor",
+    "SPARKNET_ELASTIC_PROC_DEADLINE_S": "proc-mode wall-clock round "
+                                        "deadline (seconds)",
+    "SPARKNET_ELASTIC_PROC_HEARTBEAT_S": "proc-mode worker heartbeat "
+                                         "period (seconds)",
     "SPARKNET_CHAOS_SEED": "default seed for --chaos fault plans",
     "SPARKNET_TAU_MIN": "adaptive-tau controller floor",
     "SPARKNET_TAU_MAX": "adaptive-tau controller ceiling",

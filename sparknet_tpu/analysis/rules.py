@@ -19,6 +19,10 @@ Each rule replaces (and strengthens) a hand-rolled regex pin:
   blocking join while holding a Lock/Condition in serving/ (the
   reload-under-traffic and CV-wakeup paths depend on dispatch running
   OUTSIDE the lock; serving/scheduler.py documents the contract).
+- R006 subprocess discipline — blocking subprocess launches
+  (run/call/check_call/check_output) must pass `timeout=`, and a module
+  holding a Popen must contain a kill path, so no spawned child can
+  hang the caller forever (the wedged-tunnel failure mode generalized).
 
 Full catalog with rationale and suppression syntax: ANALYSIS.md.
 """
@@ -542,6 +546,86 @@ class LockDisciplineRule(Rule):
 
 # ------------------------------------------------------------------ factory
 
+# --------------------------------------------------------------------- R006
+
+_SUBPROC_TIMEOUT_FNS = frozenset({"run", "call", "check_call",
+                                  "check_output"})
+_KILL_ATTRS = frozenset({"kill", "terminate", "send_signal"})
+
+
+class SubprocessDisciplineRule(Rule):
+    """Every blocking subprocess launch must carry a `timeout=`, and any
+    module that opens a long-lived `Popen` must contain a kill path
+    (`.kill()`/`.terminate()`/`.send_signal()`) so no child this repo
+    spawns has an unbounded lifetime.  Alias tracking mirrors R001:
+    `import subprocess as sp` and `from subprocess import run as r` are
+    both seen."""
+
+    id = "R006"
+    name = "subprocess-discipline"
+    rationale = ("a child process launched without a timeout (or a Popen "
+                 "with no kill path) hangs its caller forever when the "
+                 "child wedges — the axon-tunnel lesson, applied to every "
+                 "subprocess the package spawns")
+    allowlist = frozenset()
+
+    def check_module(self, ctx: ModuleContext) -> List[Finding]:
+        findings: List[Finding] = []
+        sub_aliases: Set[str] = set()
+        fn_aliases: Dict[str, str] = {}
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    if alias.name == "subprocess":
+                        sub_aliases.add(alias.asname or "subprocess")
+            elif isinstance(node, ast.ImportFrom):
+                if node.module == "subprocess" and node.level == 0:
+                    for alias in node.names:
+                        if (alias.name in _SUBPROC_TIMEOUT_FNS
+                                or alias.name == "Popen"):
+                            fn_aliases[alias.asname or alias.name] = \
+                                alias.name
+        if not sub_aliases and not fn_aliases:
+            return findings
+        has_kill_path = any(
+            isinstance(n, ast.Attribute) and n.attr in _KILL_ATTRS
+            for n in ast.walk(ctx.tree))
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            fn: Optional[str] = None
+            if (isinstance(f, ast.Attribute)
+                    and isinstance(f.value, ast.Name)
+                    and f.value.id in sub_aliases):
+                fn = f.attr
+            elif isinstance(f, ast.Name) and f.id in fn_aliases:
+                fn = fn_aliases[f.id]
+            if fn in _SUBPROC_TIMEOUT_FNS:
+                if any(kw.arg is None for kw in node.keywords):
+                    continue  # **kwargs spread: cannot prove absence
+                timeout_kw = next((kw for kw in node.keywords
+                                   if kw.arg == "timeout"), None)
+                if timeout_kw is None:
+                    findings.append(self.finding(
+                        ctx, node,
+                        f"subprocess.{fn} without timeout= — a wedged "
+                        f"child blocks the caller forever"))
+                elif (isinstance(timeout_kw.value, ast.Constant)
+                        and timeout_kw.value.value is None):
+                    findings.append(self.finding(
+                        ctx, node,
+                        f"subprocess.{fn} with timeout=None is no "
+                        f"timeout at all"))
+            elif fn == "Popen" and not has_kill_path:
+                findings.append(self.finding(
+                    ctx, node,
+                    "subprocess.Popen in a module with no kill path "
+                    "(.kill/.terminate/.send_signal) — the child's "
+                    "lifetime is unbounded"))
+        return findings
+
+
 def default_rules() -> List[Rule]:
     return [
         ClockDisciplineRule(),
@@ -549,4 +633,5 @@ def default_rules() -> List[Rule]:
         GradCoverageRule(),
         KnobRegistryRule(),
         LockDisciplineRule(),
+        SubprocessDisciplineRule(),
     ]

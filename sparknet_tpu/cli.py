@@ -84,6 +84,10 @@ def cmd_train(args) -> int:
         net = caffe_pb.replace_data_layers(net, bs, bs, int(c), int(h),
                                            int(w))
         sp = caffe_pb.load_solver_prototxt_with_net(args.solver, net)
+    proc_n = (args.proc_workers if args.proc_workers is not None
+              else int(os.environ.get("SPARKNET_ELASTIC_PROC", "0") or 0))
+    if proc_n:
+        return _train_proc(args, sp, proc_n, batches)
     if args.workers and args.workers > 1:
         return _train_distributed(args, sp, net, batches)
     solver = Solver(sp, net_param=net)
@@ -139,6 +143,77 @@ def _maybe_profile(args):
 
         return jax.profiler.trace(args.profile)
     return contextlib.nullcontext()
+
+
+def _train_proc(args, sp, n: int, batches) -> int:
+    """Process-level elastic training: N real OS worker subprocesses,
+    each a single-chip Solver on its own seeded shard, averaged per τ
+    rounds under the ProcSupervisor's watchdog (elastic/proc.py).
+    SIGINT here means snapshot-then-drain — a ctrl-C cuts a
+    manifest-committed snapshot and stops the workers cleanly instead of
+    abandoning the round."""
+    import math
+
+    from .elastic import FaultPlan, ProcSupervisor
+    from .solver.solver import write_native_snapshot
+    from .utils.signals import SignalHandler, SolverAction
+
+    if not getattr(args, "elastic", False):
+        raise SystemExit("--proc_workers requires --elastic: process "
+                         "workers are only driven by the elastic "
+                         "supervisor")
+    if batches is not None:
+        raise SystemExit(
+            "--proc_workers needs a self-feeding net (workers load their "
+            "own shards across process boundaries); drop --data")
+    tau = args.tau or 10
+    chaos = None
+    if args.chaos:
+        seed = (args.chaos_seed if args.chaos_seed is not None
+                else int(os.environ.get("SPARKNET_CHAOS_SEED", "0") or 0))
+        try:
+            chaos = FaultPlan.from_spec(args.chaos, seed=seed)
+        except ValueError as e:
+            raise SystemExit(str(e)) from None
+    n_iters = args.iterations or int(sp.max_iter) or 100
+    rounds = max(1, math.ceil(n_iters / tau))
+    handler = SignalHandler(
+        sigint_effect=SolverAction.SNAPSHOT_STOP,
+        sighup_effect=SolverAction.SNAPSHOT).install()
+    try:
+        with ProcSupervisor(
+                n, tau=tau, builder="solver",
+                worker_extra={"solver_path": args.solver},
+                min_quorum=args.min_quorum, deadline_s=args.deadline_s,
+                chaos=chaos, snapshot_dir=args.snapshot_dir,
+                snapshot_every=args.snapshot_every or 0,
+                round_log=getattr(args, "round_log", None),
+                action_source=handler) as sup:
+            while sup.iter_done < n_iters:
+                loss = sup.run_round()
+                print(f"Iteration {sup.iter_done}, loss = {loss:.6f} "
+                      f"(round {sup.rounds_done}, "
+                      f"{len(sup.active)}/{n} workers, tau={tau})")
+                action = handler.get_requested_action()
+                if action is SolverAction.SNAPSHOT_STOP:
+                    path = sup.snapshot()
+                    if path:
+                        print(f"Snapshotted state to {path}")
+                    break
+                if action is SolverAction.STOP:
+                    break
+                if action is SolverAction.SNAPSHOT:
+                    path = sup.snapshot()
+                    if path:
+                        print(f"Snapshotted state to {path}")
+            out = args.out or "trained.npz"
+            if sup.params_avg is None:
+                raise SystemExit("no round completed; nothing to save")
+            write_native_snapshot(out, sup.iter_done, sup.params_avg, {})
+    finally:
+        handler.uninstall()
+    print(f"Optimization Done. Snapshot written to {out}")
+    return 0
 
 
 def _train_distributed(args, sp, net, batches=None) -> int:
@@ -481,6 +556,12 @@ def main(argv=None) -> int:
                    help="append one JSON line of per-round telemetry per "
                         "round to this file (workers > 1; see DISTACC.md; "
                         "SPARKNET_ROUND_LOG env is the API-level knob)")
+    t.add_argument("--proc_workers", type=int,
+                   help="run N REAL worker subprocesses under the "
+                        "process-level elastic supervisor "
+                        "(elastic/proc.py; requires --elastic and a "
+                        "self-feeding net; SIGINT = snapshot-then-"
+                        "drain; default SPARKNET_ELASTIC_PROC env)")
     t.add_argument("--elastic", action="store_true",
                    help="wrap the distributed loop in the elastic runtime "
                         "(partial-quorum rounds, README 'Elastic "

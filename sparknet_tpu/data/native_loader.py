@@ -26,8 +26,10 @@ _lib: Optional[ctypes.CDLL] = None
 
 
 def _build_library() -> None:
+    # R006: the native lib is a handful of C files; a 10-minute compile
+    # means a hung toolchain, and the loader must fail rather than block
     subprocess.run(["make", "-s", "libsparknet_data.so"], cwd=_NATIVE_DIR,
-                   check=True)
+                   check=True, timeout=600)
 
 
 def get_library() -> ctypes.CDLL:

@@ -2,11 +2,26 @@
 mesh, slot-based join/leave with snapshot catch-up, deterministic fault
 injection, and an adaptive-τ controller.  See runtime.py for the design
 and the simulation/time model that makes every behavior testable on the
-8-virtual-device CPU mesh."""
+8-virtual-device CPU mesh — and proc.py for the process-level supervisor
+that graduates the same algebra to REAL preemption (worker subprocesses,
+SIGKILL/SIGSTOP chaos, wall-clock deadlines, manifest-validated snapshot
+catch-up).
+
+ProcSupervisor is imported lazily (module attribute) so `from
+sparknet_tpu.elastic import FaultPlan` stays cheap in worker processes.
+"""
 
 from .chaos import FaultPlan
 from .runtime import ElasticRuntime, QuorumError, ShardedFeed
 from .tau import AdaptiveTau
 
-__all__ = ["AdaptiveTau", "ElasticRuntime", "FaultPlan", "QuorumError",
-           "ShardedFeed"]
+__all__ = ["AdaptiveTau", "ElasticRuntime", "FaultPlan", "ProcSupervisor",
+           "QuorumError", "ShardedFeed", "masked_host_average"]
+
+
+def __getattr__(name):
+    if name in ("ProcSupervisor", "masked_host_average"):
+        from . import proc
+
+        return getattr(proc, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

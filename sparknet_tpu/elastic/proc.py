@@ -1,0 +1,668 @@
+"""Process-level elastic supervisor: REAL preemption over OS workers.
+
+PR 10's ElasticRuntime proved the round algebra (partial-quorum masked
+averaging, join/leave, seeded chaos) on simulated time inside one
+process.  This module graduates it to real multi-process preemption —
+the SparkNet failure model (arXiv:1511.06051 §3: workers may lag or die
+between τ-step averaging rounds) and the TensorFlow stance that worker
+failure + checkpoint recovery is a first-class system property
+(arXiv:1605.08695 §4.2) — with nothing simulated:
+
+- N worker subprocesses (elastic/proc_worker.py), each a single-chip
+  Solver on its own data shard, driven by JSON round commands over
+  stdin and reporting params through atomically-published npz files;
+- crash detection by `Popen.poll()` — a `kill -9` mid-round excludes
+  the worker from the round via the same partial-quorum average,
+  host-side (`masked_host_average`, sequential float32 over sorted
+  slots, mirroring the masked psum's survivor average);
+- a wall-clock report deadline + file-mtime heartbeat watchdog (the
+  real-time analogue of `parallel.dist.make_stage_deadline_hook` over
+  `solver._stage_worker_s`), retry-with-backoff before a QuorumError;
+- join = a FRESH process that catches up from the latest VALID snapshot
+  (utils/orbax_ckpt.resolve_latest — manifest-checked, torn snapshots
+  skipped);
+- the seeded FaultPlan (elastic/chaos.py) drives REAL signals: a
+  planned crash is a SIGKILL, a planned straggler is SIGSTOPped for the
+  round (its heartbeat genuinely stalls) and SIGCONT'd after collect,
+  so a chaos run is bitwise-replayable while every fault is an actual
+  OS event (pinned by tests/test_elastic_proc.py);
+- SIGINT means snapshot-then-drain (utils/signals.SNAPSHOT_STOP): cut a
+  manifest-committed snapshot, stop the workers, exit cleanly.
+
+Obs counters: worker_restarts, heartbeat_miss, proc_crashes,
+quorum_retries, dropped_reports, snapshots; torn_snapshots_skipped is
+process-wide in utils/orbax_ckpt and folded into stats().
+
+Knobs: SPARKNET_ELASTIC_PROC (CLI default worker count),
+SPARKNET_ELASTIC_PROC_DEADLINE_S (per-round report deadline, default
+30), SPARKNET_ELASTIC_PROC_HEARTBEAT_S (worker heartbeat period,
+default 0.25), SPARKNET_ELASTIC_MIN_QUORUM (shared with the in-process
+runtime).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import select
+import shutil
+import signal
+import subprocess
+import sys
+import tempfile
+import time  # sleep only; timestamps flow through obs.trace.now_s
+from typing import Any, Dict, List, Optional, Set
+
+import numpy as np
+
+from ..obs.metrics import MetricsRegistry
+from ..obs.trace import now_s
+from ..utils import orbax_ckpt
+from ..utils.signals import SignalHandler, SolverAction
+from .chaos import FaultPlan
+from .runtime import QuorumError
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))))
+
+
+def masked_host_average(params_by_slot: Dict[int, Dict[str, np.ndarray]]
+                        ) -> Dict[str, np.ndarray]:
+    """Quorum average over the surviving slots, host-side: sequential
+    left-to-right float32 accumulation in sorted-slot order — the same
+    fixed reduction order every replay sees, mirroring the masked psum's
+    `sum(p·w)/sum(w)` over survivors (parallel/dist.py)."""
+    if not params_by_slot:
+        raise ValueError("masked_host_average needs at least one report")
+    slots = sorted(params_by_slot)
+    out: Dict[str, np.ndarray] = {}
+    for k in params_by_slot[slots[0]]:
+        acc = np.array(params_by_slot[slots[0]][k], dtype=np.float32,
+                       copy=True)
+        for s in slots[1:]:
+            acc = acc + np.asarray(params_by_slot[s][k], dtype=np.float32)
+        out[k] = acc / np.float32(len(slots))
+    return out
+
+
+@dataclasses.dataclass
+class _Worker:
+    slot: int
+    proc: subprocess.Popen
+    cfg_path: str
+    hb_path: str
+    stderr_path: str
+    stderr_f: Any
+    hb_sig: Any = None          # last observed (mtime_ns,) stat signature
+    hb_stall_s: float = 0.0     # supervisor-side elapsed since it moved
+    hb_missed_round: bool = False
+
+
+class ProcSupervisor:
+    """Spawns and drives N elastic worker processes; one instance = one
+    training run.  Use as a context manager (close() reaps every child,
+    including SIGSTOP'd stragglers)."""
+
+    def __init__(self, n_workers: int, *, tau: int = 2, seed: int = 7,
+                 builder: str = "toy",
+                 workdir: Optional[str] = None,
+                 min_quorum: Optional[int] = None,
+                 deadline_s: Optional[float] = None,
+                 heartbeat_s: Optional[float] = None,
+                 chaos: Optional[FaultPlan] = None,
+                 snapshot_dir: Optional[str] = None,
+                 snapshot_every: int = 0,
+                 max_retries: int = 3, backoff_s: float = 0.25,
+                 restore: bool = False,
+                 round_log: Optional[str] = None,
+                 metrics: Optional[MetricsRegistry] = None,
+                 worker_extra: Optional[Dict[str, Any]] = None,
+                 spawn_timeout_s: float = 120.0,
+                 action_source: Optional[SignalHandler] = None,
+                 round_sleep_s: float = 0.0,
+                 poll_s: float = 0.02) -> None:
+        n_workers = int(n_workers)
+        if n_workers < 1:
+            raise ValueError(f"n_workers must be >= 1, got {n_workers}")
+        self.n_workers = n_workers
+        self.tau = int(tau)
+        self.seed = int(seed)
+        self.builder = str(builder)
+        if min_quorum is None:
+            min_quorum = int(os.environ.get(
+                "SPARKNET_ELASTIC_MIN_QUORUM", "0") or 0) \
+                or max(1, n_workers // 2)
+        if not 1 <= int(min_quorum) <= n_workers:
+            raise ValueError(f"min_quorum must be in [1, {n_workers}], "
+                             f"got {min_quorum}")
+        self.min_quorum = int(min_quorum)
+        if deadline_s is None:
+            deadline_s = float(os.environ.get(
+                "SPARKNET_ELASTIC_PROC_DEADLINE_S", "30") or 30)
+        self.deadline_s = float(deadline_s)
+        if heartbeat_s is None:
+            heartbeat_s = float(os.environ.get(
+                "SPARKNET_ELASTIC_PROC_HEARTBEAT_S", "0.25") or 0.25)
+        self.heartbeat_s = float(heartbeat_s)
+        self.hb_miss_after_s = max(4.0 * self.heartbeat_s, 1.0)
+        self.chaos = chaos
+        self.snapshot_dir = snapshot_dir
+        self.snapshot_every = int(snapshot_every)
+        self.max_retries = int(max_retries)
+        self.backoff_s = float(backoff_s)
+        self.restore = bool(restore)
+        self.round_log = round_log
+        self.worker_extra = dict(worker_extra or {})
+        self.spawn_timeout_s = float(spawn_timeout_s)
+        self.action_source = action_source
+        self.round_sleep_s = float(round_sleep_s)
+        self.poll_s = float(poll_s)
+
+        self._own_workdir = workdir is None
+        self.workdir = workdir
+        self.workers: Dict[int, _Worker] = {}
+        self.active: Set[int] = set()
+        self.left: Dict[int, str] = {}
+        self._joins: Dict[int, List[int]] = {}
+        self.params_avg: Optional[Dict[str, np.ndarray]] = None
+        self.iter_done = 0
+        self.rounds_done = 0
+        self.losses: List[float] = []
+        self.events: List[Dict[str, Any]] = []
+        self._crashes_applied: Set[int] = set()
+        self._restored_from: Optional[str] = None
+        self._started = False
+        self._closed = False
+
+        self.metrics = metrics or MetricsRegistry()
+        self.c_restarts = self.metrics.counter("worker_restarts")
+        self.c_hb_miss = self.metrics.counter("heartbeat_miss")
+        self.c_crashes = self.metrics.counter("proc_crashes")
+        self.c_rounds = self.metrics.counter("proc_rounds")
+        self.c_retries = self.metrics.counter("quorum_retries")
+        self.c_dropped = self.metrics.counter("dropped_reports")
+        self.c_snapshots = self.metrics.counter("snapshots")
+        self.g_active = self.metrics.gauge("proc_active_workers")
+        self.g_quorum = self.metrics.gauge("proc_quorum")
+
+    # ------------------------------------------------------------ lifecycle
+    def __enter__(self) -> "ProcSupervisor":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    def start(self) -> "ProcSupervisor":
+        if self._started:
+            raise RuntimeError("supervisor already started")
+        self._started = True
+        if self.workdir is None:
+            self.workdir = tempfile.mkdtemp(prefix="sparknet_proc_")
+        os.makedirs(self.workdir, exist_ok=True)
+        if self.snapshot_dir:
+            os.makedirs(self.snapshot_dir, exist_ok=True)
+        if self.restore and self.snapshot_dir:
+            src = orbax_ckpt.resolve_latest(self.snapshot_dir)
+            if src is not None:
+                it, params, _state = orbax_ckpt.restore_auto(src)
+                self.params_avg = {k: np.asarray(v)
+                                   for k, v in params.items()}
+                self.iter_done = int(it)
+                self._restored_from = src
+                self._event(kind="restore", source=src, iter=int(it))
+        for slot in range(self.n_workers):
+            self._spawn(slot)
+        for slot in range(self.n_workers):
+            self._wait_ready(self.workers[slot])
+            self.active.add(slot)
+        self.g_active.set(len(self.active))
+        return self
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        self._drain()
+        for w in self.workers.values():
+            for stream in (w.proc.stdin, w.proc.stdout):
+                try:
+                    if stream:
+                        stream.close()
+                except OSError:
+                    pass
+            try:
+                w.stderr_f.close()
+            except OSError:
+                pass
+        if self._own_workdir and self.workdir:
+            shutil.rmtree(self.workdir, ignore_errors=True)
+
+    def _drain(self) -> None:
+        """Stop every live worker: SIGCONT (a SIGSTOP'd straggler cannot
+        process a stop command), polite stop, then terminate/kill — the
+        guaranteed kill path for every Popen this module creates."""
+        for w in self.workers.values():
+            if w.proc.poll() is not None:
+                continue
+            try:
+                os.kill(w.proc.pid, signal.SIGCONT)
+            except (ProcessLookupError, OSError):
+                pass
+            try:
+                w.proc.stdin.write(json.dumps({"cmd": "stop"}) + "\n")
+                w.proc.stdin.flush()
+            except (BrokenPipeError, ValueError, OSError):
+                pass
+        for w in self.workers.values():
+            if w.proc.poll() is not None:
+                continue
+            try:
+                w.proc.wait(timeout=5)
+            except subprocess.TimeoutExpired:
+                w.proc.terminate()
+                try:
+                    w.proc.wait(timeout=2)
+                except subprocess.TimeoutExpired:
+                    w.proc.kill()
+                    w.proc.wait(timeout=5)
+
+    # ------------------------------------------------------------- spawning
+    def _worker_cfg(self, slot: int, restore_root: Optional[str]) -> dict:
+        cfg = {"slot": slot, "seed": self.seed, "tau": self.tau,
+               "builder": self.builder,
+               "heartbeat_path": os.path.join(self.workdir, f"hb_w{slot}"),
+               "heartbeat_s": self.heartbeat_s,
+               "restore_root": restore_root,
+               "round_sleep_s": self.round_sleep_s}
+        cfg.update(self.worker_extra)
+        return cfg
+
+    def _spawn(self, slot: int, restore_root: Optional[str] = None
+               ) -> _Worker:
+        cfg = self._worker_cfg(slot, restore_root)
+        cfg_path = os.path.join(self.workdir, f"worker_{slot}.json")
+        with open(cfg_path, "w") as f:
+            json.dump(cfg, f)
+        stderr_path = os.path.join(self.workdir, f"worker_{slot}.stderr")
+        stderr_f = open(stderr_path, "ab")
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        env["PYTHONPATH"] = _REPO_ROOT + os.pathsep + env.get(
+            "PYTHONPATH", "")
+        # start_new_session detaches workers from the terminal's process
+        # group: a ctrl-C reaches ONLY the supervisor, which then does
+        # snapshot-then-drain instead of every child dying mid-round
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "sparknet_tpu.elastic.proc_worker",
+             "--config", cfg_path],
+            stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+            stderr=stderr_f, text=True, bufsize=1,
+            start_new_session=True, env=env)
+        w = _Worker(slot=slot, proc=proc, cfg_path=cfg_path,
+                    hb_path=cfg["heartbeat_path"],
+                    stderr_path=stderr_path, stderr_f=stderr_f)
+        self.workers[slot] = w
+        self._event(kind="spawn", slot=slot, pid=proc.pid,
+                    restore_root=restore_root)
+        return w
+
+    def _stderr_tail(self, w: _Worker, n: int = 2000) -> str:
+        try:
+            with open(w.stderr_path, "rb") as f:
+                f.seek(max(0, os.path.getsize(w.stderr_path) - n))
+                return f.read().decode("utf-8", "replace")
+        except OSError:
+            return ""
+
+    def _wait_ready(self, w: _Worker) -> dict:
+        t0 = now_s()
+        while True:
+            remaining = self.spawn_timeout_s - (now_s() - t0)
+            if remaining <= 0:
+                break
+            r, _, _ = select.select([w.proc.stdout], [], [],
+                                    min(remaining, 0.5))
+            if not r:
+                if w.proc.poll() is not None:
+                    break
+                continue
+            line = w.proc.stdout.readline()
+            if not line:
+                break
+            try:
+                msg = json.loads(line)
+            except ValueError:
+                continue
+            if msg.get("ready"):
+                return msg
+        raise RuntimeError(
+            f"worker {w.slot} (pid {w.proc.pid}) never reported ready "
+            f"within {self.spawn_timeout_s:.0f}s (rc={w.proc.poll()}); "
+            f"stderr tail:\n{self._stderr_tail(w)}")
+
+    # ------------------------------------------------------------ telemetry
+    def _event(self, **fields) -> None:
+        self.events.append(fields)
+        if self.round_log:
+            with open(self.round_log, "a") as f:
+                f.write(json.dumps(fields) + "\n")
+                f.flush()
+
+    def _hb_tick(self, slots, dt: float, hb_missed: Set[int]) -> None:
+        for slot in slots:
+            w = self.workers.get(slot)
+            if w is None or not w.hb_path:
+                continue
+            try:
+                sig = (os.stat(w.hb_path).st_mtime_ns,)
+            except OSError:
+                sig = None
+            if sig != w.hb_sig:
+                w.hb_sig = sig
+                w.hb_stall_s = 0.0
+            else:
+                w.hb_stall_s += dt
+                if (w.hb_stall_s > self.hb_miss_after_s
+                        and not w.hb_missed_round):
+                    w.hb_missed_round = True
+                    self.c_hb_miss.inc()
+                    hb_missed.add(slot)
+
+    # ------------------------------------------------------------ membership
+    def schedule_join(self, slot: int, round_idx: int) -> None:
+        slot, round_idx = int(slot), int(round_idx)
+        if round_idx < self.rounds_done:
+            raise ValueError(f"cannot schedule a join at past round "
+                             f"{round_idx} (now at {self.rounds_done})")
+        self._joins.setdefault(round_idx, []).append(slot)
+
+    def _join(self, slot: int, round_idx: int) -> None:
+        if slot in self.active:
+            raise ValueError(f"slot {slot} is already active")
+        old = self.workers.get(slot)
+        if old is not None and old.proc.poll() is None:
+            old.proc.kill()
+            old.proc.wait(timeout=5)
+        restore_root = self.snapshot_dir if self.snapshot_dir else None
+        w = self._spawn(slot, restore_root=restore_root)
+        ready = self._wait_ready(w)
+        self.active.add(slot)
+        self.left.pop(slot, None)
+        self.c_restarts.inc()
+        self.g_active.set(len(self.active))
+        self._event(kind="join", slot=slot, round=round_idx,
+                    source=ready.get("restored_from"),
+                    iter=ready.get("iter"))
+
+    def _mark_left(self, slot: int, reason: str, round_idx: int) -> None:
+        self.active.discard(slot)
+        self.left[slot] = reason
+        self.g_active.set(len(self.active))
+        self._event(kind="leave", slot=slot, round=round_idx,
+                    reason=reason)
+
+    def _kill_slot(self, slot: int, reason: str, round_idx: int) -> None:
+        w = self.workers[slot]
+        if w.proc.poll() is None:
+            try:
+                os.kill(w.proc.pid, signal.SIGCONT)
+            except (ProcessLookupError, OSError):
+                pass
+            w.proc.kill()
+            try:
+                w.proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                pass
+        self.c_crashes.inc()
+        self._mark_left(slot, reason, round_idx)
+
+    def kill_worker(self, slot: int, sig: int = signal.SIGKILL) -> None:
+        """Deliver a REAL signal to a worker (tests/chaos tooling).  The
+        supervisor does not mark anything — detection must happen through
+        the same poll/deadline machinery a genuine fault exercises."""
+        os.kill(self.workers[slot].proc.pid, sig)
+
+    # ---------------------------------------------------------------- rounds
+    def _write_bcast(self, round_idx: int) -> str:
+        arrays = {f"param:{k}": np.asarray(v)
+                  for k, v in self.params_avg.items()}
+        arrays["__iter__"] = np.int64(self.iter_done)
+        path = os.path.join(self.workdir, f"bcast_{round_idx:06d}.npz")
+        tmp = path + f".tmp{os.getpid()}.npz"
+        with open(tmp, "wb") as f:
+            np.savez(f, **arrays)
+        os.replace(tmp, path)
+        return path
+
+    @staticmethod
+    def _read_report(path: str) -> dict:
+        with np.load(path) as data:
+            return {"params": {k[len("param:"):]: np.array(data[k])
+                               for k in data.files
+                               if k.startswith("param:")},
+                    "loss": float(data["__loss__"]),
+                    "iter": int(data["__iter__"]),
+                    "round": int(data["__round__"])}
+
+    def run_round(self) -> float:
+        """One τ-round over the worker fleet; returns the quorum-mean
+        loss.  Raises QuorumError when fewer than min_quorum workers
+        report within deadline_s across max_retries backoff windows."""
+        if not self._started:
+            raise RuntimeError("start() the supervisor first")
+        r = self.rounds_done
+        t_round0 = now_s()
+        for slot in sorted(self._joins.pop(r, [])):
+            self._join(slot, r)
+        crashed_this_round: List[int] = []
+        if self.chaos is not None:
+            for slot in sorted(self.active):
+                # one planned crash per slot: a fresh process joining the
+                # freed slot must not be re-crashed by the same plan entry
+                # (runtime.py `_crashes_applied` semantics)
+                if (self.chaos.crashed(r, slot)
+                        and slot not in self._crashes_applied):
+                    self._crashes_applied.add(slot)
+                    self._kill_slot(slot, "chaos_crash", r)
+                    crashed_this_round.append(slot)
+        for slot in sorted(self.active):
+            if self.workers[slot].proc.poll() is not None:
+                self._mark_left(slot, "exited", r)
+                crashed_this_round.append(slot)
+        if not self.active:
+            raise QuorumError(f"round {r}: no active workers remain")
+        stragglers = sorted(
+            s for s in self.active
+            if self.chaos is not None and self.chaos.straggler_mult(s) > 1.0)
+        bcast = (self._write_bcast(r)
+                 if self.params_avg is not None else None)
+        report_paths: Dict[int, str] = {}
+        dispatched: List[int] = []
+        for slot in sorted(self.active):
+            w = self.workers[slot]
+            rp = os.path.join(self.workdir, f"rep_{r:06d}_w{slot}.npz")
+            report_paths[slot] = rp
+            cmd = {"cmd": "round", "round": r, "tau": self.tau,
+                   "bcast": bcast, "report": rp}
+            try:
+                w.proc.stdin.write(json.dumps(cmd) + "\n")
+                w.proc.stdin.flush()
+                dispatched.append(slot)
+            except (BrokenPipeError, ValueError, OSError):
+                self._mark_left(slot, "pipe_closed", r)
+                crashed_this_round.append(slot)
+        # a planned straggler is preempted for the whole round: its
+        # heartbeat stalls for real, and the exclusion set stays a pure
+        # function of the FaultPlan (bitwise-replayable kill schedule)
+        for slot in stragglers:
+            if slot in self.active:
+                try:
+                    os.kill(self.workers[slot].proc.pid, signal.SIGSTOP)
+                except (ProcessLookupError, OSError):
+                    pass
+        for slot in dispatched:
+            w = self.workers[slot]
+            w.hb_sig = None
+            w.hb_stall_s = 0.0
+            w.hb_missed_round = False
+        pending = [s for s in dispatched
+                   if s in self.active and s not in stragglers]
+        reports: Dict[int, dict] = {}
+        dropped: Set[int] = set()
+        drop_counted: Set[Any] = set()
+        hb_missed: Set[int] = set()
+        try:
+            for attempt in range(self.max_retries + 1):
+                if attempt > 0:
+                    self.c_retries.inc()
+                    self._event(kind="quorum_retry", round=r,
+                                attempt=attempt,
+                                have=sorted(reports), need=self.min_quorum)
+                    time.sleep(self.backoff_s * attempt)
+                t0 = prev = now_s()
+                while pending:
+                    for slot in list(pending):
+                        w = self.workers[slot]
+                        rp = report_paths[slot]
+                        if os.path.exists(rp):
+                            if (self.chaos is not None
+                                    and self.chaos.drops(r, slot, attempt)):
+                                # the report is "lost" for this whole
+                                # attempt (the plan hash is stable per
+                                # (round, slot, attempt)); a retry may
+                                # redraw and accept it
+                                if (slot, attempt) not in drop_counted:
+                                    drop_counted.add((slot, attempt))
+                                    self.c_dropped.inc()
+                                dropped.add(slot)
+                                continue
+                            reports[slot] = self._read_report(rp)
+                            dropped.discard(slot)
+                            pending.remove(slot)
+                        elif w.proc.poll() is not None:
+                            self._mark_left(slot, "crashed_mid_round", r)
+                            crashed_this_round.append(slot)
+                            self.c_crashes.inc()
+                            pending.remove(slot)
+                    now = now_s()
+                    self._hb_tick(pending, now - prev, hb_missed)
+                    prev = now
+                    if not pending or now - t0 >= self.deadline_s:
+                        break
+                    time.sleep(self.poll_s)
+                if len(reports) >= self.min_quorum:
+                    break
+                # refill: a dropped report may clear on the next attempt,
+                # and a late worker may still land its file
+                pending = [s for s in dispatched
+                           if s in self.active and s not in reports
+                           and s not in stragglers]
+            else:
+                raise QuorumError(
+                    f"round {r}: quorum {len(reports)}/{self.min_quorum} "
+                    f"after {self.max_retries} retries "
+                    f"(deadline {self.deadline_s}s; reported="
+                    f"{sorted(reports)}, active={sorted(self.active)})")
+        finally:
+            for slot in stragglers:
+                w = self.workers.get(slot)
+                if w is not None and w.proc.poll() is None:
+                    try:
+                        os.kill(w.proc.pid, signal.SIGCONT)
+                    except (ProcessLookupError, OSError):
+                        pass
+        late = [s for s in pending if s in self.active]
+        included = sorted(reports)
+        self.params_avg = masked_host_average(
+            {s: reports[s]["params"] for s in included})
+        loss = float(np.mean([reports[s]["loss"] for s in included]))
+        self.iter_done = max(reports[s]["iter"] for s in included)
+        self.rounds_done += 1
+        self.losses.append(loss)
+        self.c_rounds.inc()
+        self.g_quorum.set(len(included))
+        missing = sorted(set(dispatched) - set(included))
+        self._event(kind="round", round=r, quorum=len(included),
+                    included=included, missing=missing,
+                    stragglers=stragglers,
+                    crashed=sorted(set(crashed_this_round)),
+                    late=late, dropped=sorted(dropped),
+                    heartbeat_miss=sorted(hb_missed),
+                    loss=round(loss, 8), iter=self.iter_done,
+                    tau=self.tau,
+                    wall_s=round(now_s() - t_round0, 6))
+        if (self.snapshot_dir and self.snapshot_every > 0
+                and self.rounds_done % self.snapshot_every == 0):
+            self.snapshot()
+        return loss
+
+    def snapshot(self) -> Optional[str]:
+        """Manifest-committed snapshot of the current quorum average
+        (orbax_ckpt.save_step: temp+fsync+atomic replace, then the
+        COMMIT manifest) — the artifact joins and supervisor restarts
+        catch up from."""
+        if self.snapshot_dir is None or self.params_avg is None:
+            return None
+        path = orbax_ckpt.save_step(self.snapshot_dir, self.rounds_done,
+                                    self.iter_done, self.params_avg, {})
+        self.c_snapshots.inc()
+        self._event(kind="snapshot", step=self.rounds_done,
+                    iter=self.iter_done, path=path)
+        return path
+
+    def run(self, n_rounds: int) -> List[float]:
+        """Drive n_rounds, honoring SIGINT as snapshot-then-drain (and
+        SIGHUP as snapshot-and-continue) via utils.signals — installed
+        here unless the caller supplied its own action_source."""
+        handler = self.action_source
+        own: Optional[SignalHandler] = None
+        if handler is None:
+            try:
+                own = SignalHandler(
+                    sigint_effect=SolverAction.SNAPSHOT_STOP,
+                    sighup_effect=SolverAction.SNAPSHOT).install()
+                handler = own
+            except ValueError:   # not the main thread: run un-handled
+                handler = None
+        losses: List[float] = []
+        try:
+            for _ in range(int(n_rounds)):
+                losses.append(self.run_round())
+                if handler is None:
+                    continue
+                action = handler.get_requested_action()
+                if action is SolverAction.SNAPSHOT_STOP:
+                    self.snapshot()
+                    self._drain()
+                    self._event(kind="sigint_snapshot_drain",
+                                round=self.rounds_done)
+                    break
+                if action is SolverAction.STOP:
+                    break
+                if action is SolverAction.SNAPSHOT:
+                    self.snapshot()
+        finally:
+            if own is not None:
+                own.uninstall()
+        return losses
+
+    # ----------------------------------------------------------------- stats
+    def stats(self) -> Dict[str, Any]:
+        counters = {name: c.value
+                    for name, c in [
+                        ("worker_restarts", self.c_restarts),
+                        ("heartbeat_miss", self.c_hb_miss),
+                        ("proc_crashes", self.c_crashes),
+                        ("proc_rounds", self.c_rounds),
+                        ("quorum_retries", self.c_retries),
+                        ("dropped_reports", self.c_dropped),
+                        ("snapshots", self.c_snapshots)]}
+        return {"rounds": self.rounds_done,
+                "active_workers": sorted(self.active),
+                "left": dict(self.left),
+                "iter": self.iter_done,
+                "restored_from": self._restored_from,
+                "torn_snapshots_skipped": orbax_ckpt.torn_skipped_total(),
+                **counters,
+                "events": len(self.events)}

@@ -1,0 +1,226 @@
+"""Worker entrypoint for the process-level elastic supervisor.
+
+One OS process = one SparkNet worker: it owns a single-chip Solver on its
+data shard and runs τ local steps per round command, the role a Spark
+executor's CaffeNet plays in the reference driver loop (reference:
+CifarApp.scala:120-130 — foreachPartition step + collect weights), but
+as a real preemptible process the supervisor can SIGKILL/SIGSTOP.
+
+Protocol (line-oriented JSON, supervisor -> stdin / stdout -> supervisor):
+
+  ready     {"ready": true, "slot": N, "restored_from": path|null,
+             "iter": it}      — printed once after build (+ optional
+                                snapshot catch-up restore)
+  round cmd {"cmd": "round", "round": r, "tau": t,
+             "bcast": path|null, "report": path}
+  stop  cmd {"cmd": "stop"}
+
+The worker NEVER writes to stdout after the ready line (an unread pipe
+would eventually block a long run); per-round results travel through the
+`report` npz, written tmp+fsync+`os.replace` so the supervisor can never
+observe a torn report.  A broadcast file (`bcast`) carries the previous
+round's quorum average; loading it re-syncs params (and the iteration
+counter, so the lr schedule tracks the cohort) — which is also how a
+SIGSTOP'd straggler rejoins the fold after SIGCONT.  Heartbeats are
+file-mtime touches on `heartbeat_path` every `heartbeat_s` from a
+daemon thread; they stall exactly while the process is stopped or dead,
+which is what the supervisor's watchdog measures.
+
+stdin EOF means the supervisor is gone: exit.  Chaos determinism note:
+the worker itself draws no randomness beyond its seeded feed and the
+solver's fold_in(iter) rng, so identical command schedules replay
+bitwise (pinned by tests/test_elastic_proc.py).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time  # sleep only; timestamps flow through obs.trace.now_s
+
+
+def _force_cpu() -> None:
+    # the box's sitecustomize pre-imports jax, so the live-config update
+    # is what actually takes effect (tests/conftest.py pattern)
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+
+def _beat(path: str, period_s: float, stop: threading.Event) -> None:
+    while not stop.wait(period_s):
+        try:
+            with open(path, "a"):
+                pass
+            os.utime(path, None)
+        except OSError:
+            return
+
+
+def _build_toy(cfg: dict):
+    """The chaos-toy net (scripts/chaos_run.py build_solver architecture)
+    as a SINGLE-chip Solver: small enough that N worker processes compile
+    and run inside the tier-1 budget."""
+    import numpy as np
+
+    import sparknet_tpu  # noqa: F401  (jax forward-compat graft)
+    from ..core import layers_dsl as dsl
+    from ..proto import caffe_pb
+    from ..proto.textformat import parse
+    from ..solver.solver import Solver
+
+    batch = int(cfg.get("toy", {}).get("batch", 16))
+    net = dsl.net_param(
+        "proc_toy",
+        dsl.memory_data_layer("data", ["data", "label"], batch=batch,
+                              channels=1, height=4, width=4),
+        dsl.inner_product_layer("ip1", "data", num_output=8),
+        dsl.relu_layer("relu1", "ip1"),
+        dsl.inner_product_layer("ip2", "ip1", num_output=2),
+        dsl.softmax_with_loss_layer("loss", ["ip2", "label"]),
+    )
+    sp = caffe_pb.SolverParameter(parse(
+        f"base_lr: 0.05 lr_policy: 'fixed' momentum: 0.9 "
+        f"random_seed: {int(cfg.get('seed', 7))}"))
+    solver = Solver(sp, net_param=net)
+    rng = np.random.RandomState(1000 + int(cfg["slot"]))
+
+    def src():
+        x = rng.randn(batch, 1, 4, 4).astype(np.float32)
+        return {"data": x,
+                "label": (x.mean(axis=(1, 2, 3)) > 0).astype(np.int32)}
+
+    solver.set_train_data(src)
+    return solver
+
+
+def _build_solver_file(cfg: dict):
+    """CLI proc mode: a real solver prototxt whose net self-feeds (the
+    DataReader semantics — data/feeds.make_net_feeds); each worker seeds
+    its stream by slot so shards are disjoint."""
+    from ..data.feeds import make_net_feeds
+    from ..proto import caffe_pb
+    from ..solver.solver import Solver
+
+    sp = caffe_pb.load_solver_prototxt(str(cfg["solver_path"]))
+    solver = Solver(sp)
+    feed = make_net_feeds(solver.net.net_param, "TRAIN",
+                          seed=1000 + int(cfg["slot"]))
+    if feed is None:
+        raise ValueError(
+            f"solver {cfg['solver_path']!r} has no self-feeding data "
+            f"layer; proc-mode workers cannot share a --data batch list "
+            f"across process boundaries")
+    solver.set_train_data(feed)
+    return solver
+
+
+def _load_bcast(solver, path: str) -> None:
+    import jax.numpy as jnp
+    import numpy as np
+
+    data = np.load(path)
+    params = {k[len("param:"):]: jnp.asarray(data[k])
+              for k in data.files if k.startswith("param:")}
+    if params:
+        solver.params = params
+    if "__iter__" in data.files:
+        solver.iter = int(data["__iter__"])
+
+
+def _write_report(path: str, round_idx: int, solver, loss: float) -> None:
+    """Atomic report publish: the supervisor polls for `path`, so its
+    appearance must imply completeness (tmp+fsync+os.replace)."""
+    import numpy as np
+
+    arrays = {f"param:{k}": np.asarray(v) for k, v in solver.params.items()}
+    arrays["__loss__"] = np.float64(loss)
+    arrays["__iter__"] = np.int64(solver.iter)
+    arrays["__round__"] = np.int64(round_idx)
+    tmp = os.path.join(os.path.dirname(os.path.abspath(path)),
+                       f".tmp.{os.getpid()}.{os.path.basename(path)}")
+    with open(tmp, "wb") as f:
+        np.savez(f, **arrays)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="proc_worker")
+    ap.add_argument("--config", required=True,
+                    help="worker config JSON written by the supervisor")
+    a = ap.parse_args(argv)
+    with open(a.config) as f:
+        cfg = json.load(f)
+    _force_cpu()
+
+    stop_beat = threading.Event()
+    hb = cfg.get("heartbeat_path")
+    if hb:
+        with open(hb, "a"):
+            pass
+        threading.Thread(target=_beat,
+                         args=(hb, float(cfg.get("heartbeat_s", 0.25)),
+                               stop_beat),
+                         daemon=True, name="proc-worker-heartbeat").start()
+
+    builder = cfg.get("builder", "toy")
+    if builder == "toy":
+        solver = _build_toy(cfg)
+    elif builder == "solver":
+        solver = _build_solver_file(cfg)
+    else:
+        raise ValueError(f"unknown proc worker builder {builder!r} "
+                         f"(expected 'toy' or 'solver')")
+
+    restored = None
+    root = cfg.get("restore_root")
+    if root:
+        from ..utils.orbax_ckpt import resolve_latest, restore_auto
+
+        src = resolve_latest(root)
+        if src is not None:
+            import jax.numpy as jnp
+
+            it, params, _state = restore_auto(src)
+            solver.params = {k: jnp.asarray(v) for k, v in params.items()}
+            solver.iter = int(it)
+            restored = src
+
+    print(json.dumps({"ready": True, "slot": int(cfg["slot"]),
+                      "restored_from": restored,
+                      "iter": int(solver.iter)}), flush=True)
+
+    sleep_s = float(cfg.get("round_sleep_s", 0.0))
+    for line in sys.stdin:
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            cmd = json.loads(line)
+        except ValueError:
+            print(f"proc_worker[{cfg['slot']}]: malformed command "
+                  f"{line!r}", file=sys.stderr, flush=True)
+            continue
+        kind = cmd.get("cmd")
+        if kind == "stop":
+            break
+        if kind != "round":
+            continue
+        if cmd.get("bcast"):
+            _load_bcast(solver, cmd["bcast"])
+        if sleep_s > 0.0:
+            time.sleep(sleep_s)  # test knob: widen the mid-round window
+        loss = solver.step(int(cmd.get("tau", cfg.get("tau", 1))))
+        _write_report(cmd["report"], int(cmd["round"]), solver, loss)
+    stop_beat.set()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -26,8 +26,10 @@ SHARD_PATTERN = "files-shuf-%03d.tar"
 def _read_shard(source: str, idx: int) -> bytes:
     path = f"{source.rstrip('/')}/{SHARD_PATTERN % idx}"
     if source.startswith("gs://"):
+        # R006: a ~1 GB shard over a slow link still finishes well
+        # inside an hour; past that the pull is wedged, not slow
         out = subprocess.run(["gsutil", "cat", path], check=True,
-                             stdout=subprocess.PIPE)
+                             stdout=subprocess.PIPE, timeout=3600)
         return out.stdout
     with open(path, "rb") as f:
         return f.read()

@@ -127,6 +127,16 @@ _BAD_STATES = {"PREEMPTED", "TERMINATED", "FAILED", "SUSPENDED"}
 Runner = Callable[[List[str]], Tuple[int, str]]
 
 
+# R006 subprocess discipline: every launch carries a timeout so a wedged
+# gcloud/ssh can never hang the lifecycle flow.  Describe is a state
+# poll (seconds); streaming verbs cover per-host setup, which takes
+# minutes — 2h is the "something is definitely wrong" bound, not a
+# target.  rc 124 mirrors coreutils timeout(1) so the retry/abort logic
+# upstream treats expiry as an ordinary failure.
+_DESCRIBE_TIMEOUT_S = 120
+_STREAMING_TIMEOUT_S = 7200
+
+
 def run_capture(cmd: List[str]) -> Tuple[int, str]:
     """Default runner: prints the argv line (operator visibility, like
     _execute), captures stdout for verbs whose output the flow parses
@@ -152,11 +162,22 @@ def run_capture(cmd: List[str]) -> Tuple[int, str]:
         verb = (cmd[1] if len(cmd) > 1
                 and not cmd[1].startswith("-") else "")
     if verb == "describe":
-        r = subprocess.run(cmd, capture_output=True, text=True)
+        try:
+            r = subprocess.run(cmd, capture_output=True, text=True,
+                               timeout=_DESCRIBE_TIMEOUT_S)
+        except subprocess.TimeoutExpired:
+            sys.stderr.write(f"describe timed out after "
+                             f"{_DESCRIBE_TIMEOUT_S}s\n")
+            return 124, ""
         if r.returncode != 0 and r.stderr:
             sys.stderr.write(r.stderr[-2000:])
         return r.returncode, r.stdout.strip()
-    return subprocess.call(cmd), ""
+    try:
+        return subprocess.call(cmd, timeout=_STREAMING_TIMEOUT_S), ""
+    except subprocess.TimeoutExpired:
+        sys.stderr.write(f"command timed out after "
+                         f"{_STREAMING_TIMEOUT_S}s\n")
+        return 124, ""
 
 
 # tolerate this many CONSECUTIVE describe failures before concluding
@@ -260,7 +281,11 @@ def _execute(cmds: List[List[str]], dry_run: bool) -> int:
         line = " ".join(shlex.quote(c) for c in cmd)
         print(line)
         if not dry_run:
-            rc = subprocess.call(cmd)
+            try:
+                rc = subprocess.call(cmd, timeout=_STREAMING_TIMEOUT_S)
+            except subprocess.TimeoutExpired:
+                print(f"command timed out after {_STREAMING_TIMEOUT_S}s")
+                rc = 124
             if rc != 0:
                 return rc
     return 0

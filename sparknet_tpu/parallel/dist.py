@@ -975,3 +975,56 @@ class DistributedSolver:
         params = self.net.set_weights(params, weights)
         self.params_w = jax.device_put(_stack_tree(params, self.n_workers),
                                        self._wsh)
+
+
+def make_stage_deadline_hook(deadline_s: float, *, min_quorum: int = 1,
+                             on_exclude=None):
+    """Wall-clock deadline policy over `solver._stage_worker_s`: a
+    `round_deadline_hook` that masks out workers whose serial staging
+    wall-seconds exceeded `deadline_s` last round — the real-time
+    analogue of ElasticRuntime's simulated-time deadline, and the hook
+    the proc supervisor mirrors for its report deadline.
+
+    Never masks below `min_quorum`: when too few workers meet the
+    deadline, the fastest `min_quorum` stay in (a round must always
+    average over someone).  Returns None (dense round) when every worker
+    met the deadline or no staging telemetry exists yet.
+
+    `on_exclude(round_idx, excluded_slots)` fires when the mask drops
+    anyone — the caller's counter/JSONL hook.
+
+    Install with ``solver.round_deadline_hook = make_stage_deadline_hook
+    (0.5, min_quorum=4)``; run_round consults it whenever the caller
+    passes no explicit mask (the elastic runtime's simulated masks take
+    precedence by construction).
+    """
+    deadline_s = float(deadline_s)
+    if deadline_s <= 0.0:
+        raise ValueError(f"deadline_s must be > 0, got {deadline_s}")
+    min_quorum = int(min_quorum)
+    if min_quorum < 1:
+        raise ValueError(f"min_quorum must be >= 1, got {min_quorum}")
+
+    def hook(round_idx: int, stage_s: Dict[int, float]):
+        if not stage_s:
+            return None
+        slow = {w for w, s in stage_s.items() if float(s) > deadline_s}
+        if not slow:
+            return None
+        n = 1 + max(stage_s)
+        keep = set(range(n)) - slow
+        if len(keep) < min_quorum:
+            # fastest-first refill up to quorum (ties broken by slot id
+            # so the mask is deterministic under equal timings)
+            for w in sorted(slow, key=lambda w: (stage_s[w], w)):
+                keep.add(w)
+                if len(keep) >= min_quorum:
+                    break
+        excluded = [w for w in range(n) if w not in keep]
+        if not excluded:
+            return None
+        if on_exclude is not None:
+            on_exclude(round_idx, excluded)
+        return [1.0 if w in keep else 0.0 for w in range(n)]
+
+    return hook

@@ -1,4 +1,4 @@
-"""Orbax checkpoint backend: sharded, multihost-safe snapshots.
+"""Orbax checkpoint backend: sharded, multihost-safe, CRASH-SAFE snapshots.
 
 The native `.npz` triple (solver/solver.py write_native_snapshot) gathers
 every array to one host — fine single-host, wrong for pods where each
@@ -12,18 +12,64 @@ The payload mirrors the native triple exactly: {"iter", "params",
 `GspmdTrainer.snapshot/restore` and `PipelineTrainer.snapshot/restore`
 dispatch here when the path has no file extension (a checkpoint
 directory); extensioned paths keep the npz/caffe formats.
+
+Crash safety (the kill-9-mid-save contract)
+-------------------------------------------
+Every write lands in a temp name in the destination directory, is
+fsync'd, and becomes visible only through an atomic ``os.replace`` — a
+reader can never observe a half-written artifact under its final name.
+Stepped snapshots additionally COMMIT through a manifest
+(``step_XXXXXXXX.manifest.json``, written atomically AFTER the artifact
+is durable) carrying the step/iter and sha256 checksums; `latest_step` /
+`resolve_latest` trust ONLY manifested steps whose checksums verify, so
+a snapshot torn by `kill -9` (or this box's reboot-wipes) is skipped
+with a warning and the previous valid step is returned instead.  A
+malformed snapshot handed to `restore_auto` dies with a file-naming
+ValueError — never `BadZipFile`/`struct.error` (the repo-wide parser
+contract).
 """
 
 from __future__ import annotations
 
+import hashlib
+import json
 import os
 import re
-from typing import Any, Callable, Dict, Optional, Tuple
+import shutil
+import warnings
+from typing import Any, Callable, Dict, Optional, Set, Tuple
 
 import jax
 import numpy as np
 
 _STEP_RE = re.compile(r"^step_(\d+)(\.npz)?$")
+MANIFEST_SUFFIX = ".manifest.json"
+MANIFEST_FORMAT = 1
+
+# torn/unmanifested snapshots skipped by latest_step/resolve_latest —
+# counted here (the obs `torn_snapshots_skipped` counter; the proc
+# supervisor folds it into its stats) and warned once per root.
+_TORN_SKIPPED = 0
+_WARNED_ROOTS: Set[str] = set()
+
+
+def torn_skipped_total() -> int:
+    """Process-wide count of snapshots latest_step/resolve_latest refused
+    (missing/malformed manifest or checksum mismatch)."""
+    return _TORN_SKIPPED
+
+
+def _note_torn(root: str, step: int, reason: str) -> None:
+    global _TORN_SKIPPED
+    _TORN_SKIPPED += 1
+    key = os.path.abspath(root)
+    if key not in _WARNED_ROOTS:
+        _WARNED_ROOTS.add(key)
+        warnings.warn(
+            f"skipping torn/unmanifested snapshot step {step} under "
+            f"{root!r}: {reason} (falling back to the previous valid "
+            f"step; further skips under this root are silent)",
+            stacklevel=3)
 
 
 def _checkpointer():
@@ -37,22 +83,124 @@ def is_orbax_path(path: str) -> bool:
     return not os.path.splitext(path)[1]
 
 
+# ----------------------------------------------------------- atomic plumbing
+
+def _fsync_fd_of(path: str) -> None:
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+def _fsync_dir(path: str) -> None:
+    _fsync_fd_of(path or ".")
+
+
+def _fsync_tree(path: str) -> None:
+    """fsync every regular file under `path` (itself, when a file)."""
+    if os.path.isdir(path):
+        for dirpath, _dirnames, filenames in os.walk(path):
+            for fn in filenames:
+                _fsync_fd_of(os.path.join(dirpath, fn))
+            _fsync_dir(dirpath)
+    else:
+        _fsync_fd_of(path)
+
+
+def _replace_into_place(tmp: str, final: str) -> None:
+    """Atomically publish `tmp` (file or dir) at `final`, displacing any
+    previous artifact, then fsync the parent directory entry."""
+    parent = os.path.dirname(os.path.abspath(final))
+    if os.path.isdir(final) and os.path.isdir(tmp):
+        # os.replace cannot clobber a non-empty directory: move the old
+        # artifact aside first, publish, then drop the old copy.
+        aside = final + f".old.{os.getpid()}"
+        if os.path.exists(aside):
+            shutil.rmtree(aside, ignore_errors=True)
+        os.replace(final, aside)
+        os.replace(tmp, final)
+        shutil.rmtree(aside, ignore_errors=True)
+    else:
+        os.replace(tmp, final)
+    _fsync_dir(parent)
+
+
+def _atomic_write_bytes(path: str, data: bytes) -> None:
+    tmp = os.path.join(os.path.dirname(os.path.abspath(path)),
+                       f".tmp.{os.path.basename(path)}.{os.getpid()}")
+    with open(tmp, "wb") as f:
+        f.write(data)
+        f.flush()
+        os.fsync(f.fileno())
+    _replace_into_place(tmp, path)
+
+
+def _sha256_file(path: str) -> Tuple[str, int]:
+    h = hashlib.sha256()
+    n = 0
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+            n += len(chunk)
+    return h.hexdigest(), n
+
+
+def _digest_artifact(path: str) -> Dict[str, Any]:
+    """Checksum record for a snapshot artifact: one (sha256, bytes) for a
+    file; a per-file map plus an aggregate digest for a directory."""
+    if os.path.isdir(path):
+        files: Dict[str, Any] = {}
+        agg = hashlib.sha256()
+        total = 0
+        for dirpath, dirnames, filenames in os.walk(path):
+            dirnames.sort()
+            for fn in sorted(filenames):
+                full = os.path.join(dirpath, fn)
+                rel = os.path.relpath(full, path).replace(os.sep, "/")
+                sha, nbytes = _sha256_file(full)
+                files[rel] = {"sha256": sha, "bytes": nbytes}
+                agg.update(rel.encode())
+                agg.update(sha.encode())
+                total += nbytes
+        return {"kind": "dir", "sha256": agg.hexdigest(), "bytes": total,
+                "files": files}
+    sha, nbytes = _sha256_file(path)
+    return {"kind": "file", "sha256": sha, "bytes": nbytes}
+
+
+# ----------------------------------------------------------------- save/auto
+
 def save_auto(path: str, it: int, params, state) -> str:
     """Extension-less path -> orbax directory; anything else (or orbax not
     installed — it is the optional `ckpt` extra) -> the native .npz
     triple, so a mid-training SIGINT snapshot never dies on a missing
-    optional dependency."""
+    optional dependency.
+
+    Either way the artifact is staged under a temp name, fsync'd, and
+    published with one atomic `os.replace`: a crash mid-save leaves only
+    a `.tmp.*` residue, never a half-written artifact at `path`."""
     if is_orbax_path(path):
         try:
             return save(path, it, params, state)
         except ImportError:
-            import warnings
-
             warnings.warn("orbax-checkpoint not installed; writing the "
                           "native .npz triple instead", stacklevel=2)
     from ..solver.solver import write_native_snapshot
 
-    return write_native_snapshot(path, it, params, state)
+    final = path if path.endswith(".npz") else path + ".npz"
+    parent = os.path.dirname(os.path.abspath(final))
+    os.makedirs(parent, exist_ok=True)
+    # the tmp name keeps the .npz suffix so np.savez writes exactly there
+    tmp = os.path.join(parent,
+                       f".tmp.{os.getpid()}.{os.path.basename(final)}")
+    written = write_native_snapshot(tmp, it, params, state)
+    _fsync_fd_of(written)
+    _replace_into_place(written, final)
+    return final
 
 
 def restore_auto(path: str, *, known_params=None,
@@ -60,14 +208,39 @@ def restore_auto(path: str, *, known_params=None,
                  state_sharding_for: Optional[Callable[[str], Any]] = None,
                  ) -> Tuple[int, Dict[str, Any], Dict[str, Tuple[Any, ...]]]:
     """Counterpart of save_auto: orbax directory when present, else the
-    legacy extension-less `.npz` the native writer produces."""
+    legacy extension-less `.npz` the native writer produces.
+
+    A torn or malformed snapshot dies with a ValueError naming the path
+    — never `zipfile.BadZipFile`/`struct.error`/`EOFError` (the repo-wide
+    parser contract, pinned by tests/test_ckpt_manifest.py)."""
+    import struct
+    import zipfile
+
     if is_orbax_path(path) and os.path.isdir(path):
-        return restore(path, known_params=known_params,
-                       sharding_for=sharding_for,
-                       state_sharding_for=state_sharding_for)
+        try:
+            return restore(path, known_params=known_params,
+                           sharding_for=sharding_for,
+                           state_sharding_for=state_sharding_for)
+        except (FileNotFoundError, KeyError, EOFError) as e:
+            raise ValueError(
+                f"torn or malformed orbax snapshot {path!r}: "
+                f"{type(e).__name__}: {e}") from None
     from ..solver.solver import parse_native_snapshot
 
-    return parse_native_snapshot(path)
+    try:
+        return parse_native_snapshot(path)
+    except (zipfile.BadZipFile, struct.error, EOFError, KeyError,
+            OSError) as e:
+        raise ValueError(
+            f"torn or malformed snapshot {path!r}: "
+            f"{type(e).__name__}: {e}") from None
+    except ValueError as e:
+        # np.load raises bare ValueErrors (e.g. the pickled-data refusal)
+        # that do not name the file; re-raise with the path attached
+        if path in str(e):
+            raise
+        raise ValueError(
+            f"torn or malformed snapshot {path!r}: {e}") from None
 
 
 # ------------------------------------------------ stepped snapshot roots
@@ -75,53 +248,138 @@ def restore_auto(path: str, *, known_params=None,
 # so a joining worker can catch up from "whatever the newest snapshot is"
 # without coordinating a filename with the writer (role of
 # Solver::SnapshotFilename, reference: caffe/src/caffe/solver.cpp:421-431,
-# generalized to a resolve-latest directory scan).
+# generalized to a resolve-latest directory scan with a COMMIT manifest).
 
 def step_path(root: str, step: int) -> str:
     """Canonical per-step snapshot location under a root directory."""
     return os.path.join(root, f"step_{int(step):08d}")
 
 
+def manifest_path(root: str, step: int) -> str:
+    return step_path(root, step) + MANIFEST_SUFFIX
+
+
+def write_step_manifest(root: str, step: int, it: int,
+                        artifact: str) -> str:
+    """COMMIT record for a stepped snapshot: written atomically AFTER the
+    artifact is durable, so manifest-present implies artifact-complete."""
+    digest = _digest_artifact(artifact)
+    record = {"format": MANIFEST_FORMAT, "step": int(step), "iter": int(it),
+              "artifact": os.path.basename(artifact)}
+    record.update(digest)
+    mp = manifest_path(root, step)
+    _atomic_write_bytes(mp, (json.dumps(record, sort_keys=True) + "\n")
+                        .encode())
+    return mp
+
+
+def load_step_manifest(root: str, step: int) -> Optional[Dict[str, Any]]:
+    """Parsed manifest for `step`, or None when missing/malformed (a torn
+    manifest means the commit never happened — same as missing)."""
+    mp = manifest_path(root, step)
+    try:
+        with open(mp, "rb") as f:
+            rec = json.loads(f.read().decode("utf-8"))
+    except (OSError, ValueError):
+        return None
+    if not isinstance(rec, dict) or "artifact" not in rec:
+        return None
+    return rec
+
+
+def validate_step(root: str, step: int) -> Optional[str]:
+    """Artifact path for `step` when its manifest verifies (existence,
+    byte counts, sha256) — else None.  This is THE gate between a
+    `step_*` dirname and a restore: a name alone proves nothing after a
+    kill -9."""
+    rec = load_step_manifest(root, step)
+    if rec is None:
+        return None
+    artifact = os.path.join(root, os.path.basename(str(rec["artifact"])))
+    try:
+        digest = _digest_artifact(artifact)
+    except OSError:
+        return None
+    if digest.get("kind") != rec.get("kind"):
+        return None
+    if digest.get("bytes") != rec.get("bytes"):
+        return None
+    if digest.get("sha256") != rec.get("sha256"):
+        return None
+    return artifact
+
+
 def save_step(root: str, step: int, it: int, params, state) -> str:
     """Write a stepped snapshot under `root` and return its path.
 
-    Delegates to save_auto, so the artifact is an orbax directory when
-    orbax is installed and a native `.npz` triple otherwise — either
-    form is found again by latest_step/resolve_latest."""
+    Delegates to save_auto (atomic temp+fsync+replace), so the artifact
+    is an orbax directory when orbax is installed and a native `.npz`
+    triple otherwise, then COMMITs it with a checksummed manifest —
+    only manifested steps are found again by latest_step/resolve_latest."""
     os.makedirs(root, exist_ok=True)
-    return save_auto(step_path(root, step), it, params, state)
+    artifact = save_auto(step_path(root, step), it, params, state)
+    write_step_manifest(root, step, it, artifact)
+    return artifact
+
+
+def _candidate_steps(root: str):
+    """Step numbers present under `root` (by artifact OR manifest name),
+    descending."""
+    steps = set()
+    for fn in os.listdir(root):
+        m = _STEP_RE.match(fn)
+        if m:
+            steps.add(int(m.group(1)))
+            continue
+        if fn.endswith(MANIFEST_SUFFIX):
+            m = _STEP_RE.match(fn[:-len(MANIFEST_SUFFIX)])
+            if m:
+                steps.add(int(m.group(1)))
+    return sorted(steps, reverse=True)
 
 
 def latest_step(root: str) -> Optional[int]:
-    """Highest step number with a snapshot under `root`, or None."""
+    """Highest step number with a VALID (manifest-verified) snapshot
+    under `root`, or None.  Torn/unmanifested steps are counted, warned
+    once per root, and skipped — the previous valid step wins."""
     if not os.path.isdir(root):
         return None
-    steps = [int(m.group(1)) for m in
-             (_STEP_RE.match(fn) for fn in os.listdir(root)) if m]
-    return max(steps) if steps else None
+    for step in _candidate_steps(root):
+        if validate_step(root, step) is not None:
+            return step
+        _note_torn(root, step, "manifest missing or checksum mismatch")
+    return None
 
 
 def resolve_latest(root: str) -> Optional[str]:
-    """Path of the newest stepped snapshot under `root`, or None.
+    """Path of the newest VALID stepped snapshot under `root`, or None.
 
-    Prefers the orbax directory form over a same-step `.npz` fallback
-    artifact (both can coexist after an orbax install appears mid-run)."""
+    The artifact form (orbax directory vs `.npz`) comes from the
+    manifest, so no interleaving of `kill -9` with save_step can make
+    this return an unloadable path (pinned by
+    tests/test_ckpt_manifest.py)."""
     step = latest_step(root)
     if step is None:
         return None
-    p = step_path(root, step)
-    if os.path.isdir(p):
-        return p
-    if os.path.exists(p + ".npz"):
-        return p + ".npz"
-    return None
+    return validate_step(root, step)
 
 
 def save(path: str, it: int, params: Dict[str, jax.Array],
          state: Dict[str, Tuple[jax.Array, ...]]) -> str:
+    """Orbax save, published atomically: the checkpointer writes into a
+    staging directory which replaces `path` in one rename."""
+    path = os.path.abspath(path)
+    parent = os.path.dirname(path)
+    os.makedirs(parent, exist_ok=True)
     payload = {"iter": np.int64(it), "params": dict(params),
                "state": {k: list(v) for k, v in state.items()}}
-    _checkpointer().save(os.path.abspath(path), payload, force=True)
+    tmp = os.path.join(parent,
+                       f".tmp.{os.path.basename(path)}.{os.getpid()}")
+    if os.path.exists(tmp):
+        shutil.rmtree(tmp, ignore_errors=True)
+    _checkpointer().save(tmp, payload, force=True)
+    _fsync_tree(tmp)
+    _replace_into_place(tmp, path)
     return path
 
 

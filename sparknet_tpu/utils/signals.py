@@ -16,6 +16,10 @@ class SolverAction(enum.Enum):
     NONE = 0
     STOP = 1
     SNAPSHOT = 2
+    # snapshot, then stop: the elastic proc supervisor's SIGINT default —
+    # cut a manifest-committed snapshot, drain the worker processes, and
+    # only then exit, so a ctrl-C never loses the round in flight
+    SNAPSHOT_STOP = 3
 
 
 class SignalHandler:
@@ -51,4 +55,5 @@ class SignalHandler:
 
 def parse_effect(name: str) -> SolverAction:
     return {"stop": SolverAction.STOP, "snapshot": SolverAction.SNAPSHOT,
+            "snapshot_stop": SolverAction.SNAPSHOT_STOP,
             "none": SolverAction.NONE}[name]

@@ -323,7 +323,12 @@ def test_bench_elastic_leg_contract(monkeypatch):
               "stall_sim_s": 0.0, "tau_final": 1, "events": 11,
               "ab_rounds": 6, "straggler_mult": 20.0,
               "full_barrier_stall_s": 11.4, "partial_quorum_stall_s": 0.0,
-              "stall_ratio": 0.0, "ok": True}
+              "stall_ratio": 0.0,
+              "proc_workers": 4, "proc_rounds": 6,
+              "proc_quorums": [4, 4, 3, 3, 4, 4], "proc_crashes": 1.0,
+              "proc_restarts": 1.0, "proc_snapshots": 6.0,
+              "proc_join_source": "step_00000004",
+              "proc_torn_skipped": 0, "proc_final_iter": 12, "ok": True}
 
     class _Proc:
         returncode = 0
@@ -339,10 +344,13 @@ def test_bench_elastic_leg_contract(monkeypatch):
     monkeypatch.setattr(subprocess, "run", fake_run)
     r = bench.bench_elastic()
     assert calls and calls[0][1].endswith("chaos_run.py")
-    assert "--ab" in calls[0]
+    assert "--ab" in calls[0] and "--proc" in calls[0]
     assert r["elastic_full_barrier_stall_s"] == 11.4
     assert r["elastic_quorum_stall_s"] == 0.0
     assert r["elastic_joins"] == 1 and r["elastic_crashes"] == 1
+    assert r["elastic_proc_quorums"] == [4, 4, 3, 3, 4, 4]
+    assert r["elastic_proc_restarts"] == 1
+    assert r["elastic_proc_join_source"].startswith("step_")
     assert set(r) <= bench._KNOWN_FIELDS
     assert "elastic" in bench._KNOWN_LEGS
 

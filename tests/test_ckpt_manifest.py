@@ -1,0 +1,177 @@
+"""Crash-safe stepped snapshots: the COMMIT-manifest protocol in
+utils/orbax_ckpt (save_step = atomic artifact THEN manifest; latest_step/
+resolve_latest trust only manifest-validated steps and fall back past
+torn ones).  The invariant these tests pin: NO interleaving of kill -9
+with save_step can make resolve_latest return a path restore_auto cannot
+load — a torn or unmanifested artifact is skipped (with a once-per-root
+warning + counter), never surfaced, and malformed snapshot bytes die
+with a file-naming ValueError, never BadZipFile/struct.error (the repo's
+parser contract)."""
+
+import json
+import os
+import shutil
+
+import numpy as np
+import pytest
+
+from sparknet_tpu.utils import orbax_ckpt
+from sparknet_tpu.utils.orbax_ckpt import (MANIFEST_SUFFIX, latest_step,
+                                           load_step_manifest,
+                                           manifest_path, resolve_latest,
+                                           restore_auto, save_step,
+                                           validate_step)
+
+
+def _params(v: float):
+    return {"w": np.full((3, 2), v, np.float32),
+            "b": np.arange(2, dtype=np.float32) + v}
+
+
+def _save_two(root):
+    p1 = save_step(root, 1, 10, _params(1.0), {})
+    p2 = save_step(root, 2, 20, _params(2.0), {})
+    return p1, p2
+
+
+def test_save_step_writes_manifest_and_roundtrips(tmp_path):
+    root = str(tmp_path)
+    _, p2 = _save_two(root)
+    m = load_step_manifest(root, 2)
+    assert m is not None and m["step"] == 2 and m["iter"] == 20
+    assert m["artifact"] == os.path.basename(p2)
+    assert validate_step(root, 2) == p2
+    it, params, _state = restore_auto(resolve_latest(root))
+    assert it == 20
+    np.testing.assert_array_equal(params["w"], _params(2.0)["w"])
+
+
+def test_latest_skips_unmanifested_stepdir(tmp_path):
+    """A bare step_N artifact with no COMMIT manifest is exactly what a
+    kill -9 between artifact-replace and manifest-write leaves behind:
+    it must be invisible to latest_step/resolve_latest."""
+    root = str(tmp_path)
+    p1, p2 = _save_two(root)
+    os.remove(manifest_path(root, 2))
+    assert latest_step(root) == 1
+    assert resolve_latest(root) == p1
+    it, _params_, _state = restore_auto(resolve_latest(root))
+    assert it == 10
+
+
+def _largest_file(d):
+    return max((os.path.join(dp, f) for dp, _, fs in os.walk(d)
+                for f in fs), key=os.path.getsize)
+
+
+def test_latest_skips_truncated_artifact_falls_back(tmp_path, recwarn):
+    root = str(tmp_path)
+    p1, p2 = _save_two(root)
+    before = orbax_ckpt.torn_skipped_total()
+    # torn write: manifest committed but artifact bytes later mangled
+    # (disk corruption / partial restore) — the checksum must catch it
+    victim = _largest_file(p2) if os.path.isdir(p2) else p2
+    with open(victim, "r+b") as f:
+        f.truncate(max(1, os.path.getsize(victim) // 2))
+    n_warn0 = len(recwarn)
+    assert latest_step(root) == 1
+    assert resolve_latest(root) == p1
+    it, params, _state = restore_auto(resolve_latest(root))
+    assert it == 10 and set(params) == {"w", "b"}
+    assert orbax_ckpt.torn_skipped_total() > before
+    # warn once per root, not once per probe
+    assert len(recwarn) == n_warn0 + 1
+    assert "torn" in str(recwarn[-1].message)
+
+
+def test_latest_skips_truncated_npz_falls_back(tmp_path):
+    """Same torn-artifact fallback for the NATIVE npz artifact kind
+    (truncated mid-write: manifest present, bytes short)."""
+    root = str(tmp_path)
+    p1, _p2 = _save_two(root)
+    p3 = orbax_ckpt.step_path(root, 3) + ".npz"
+    orbax_ckpt.save_auto(p3, 30, _params(3.0), {})
+    orbax_ckpt.write_step_manifest(root, 3, 30, p3)
+    assert latest_step(root) == 3
+    with open(p3, "r+b") as f:
+        f.truncate(os.path.getsize(p3) // 2)
+    assert latest_step(root) == 2
+    it, _params_, _state = restore_auto(resolve_latest(root))
+    assert it == 20
+
+
+def test_latest_skips_half_written_orbax_dir(tmp_path):
+    root = str(tmp_path)
+    p1, p2 = _save_two(root)
+    # half-written directory artifact: a file the manifest lists is gone
+    d = str(tmp_path / "step_00000003")
+    shutil.copytree(p2, d)
+    orbax_ckpt.write_step_manifest(root, 3, 30, d)
+    assert latest_step(root) == 3
+    os.remove(_largest_file(d))
+    assert latest_step(root) == 2
+    assert resolve_latest(root) == p2
+
+
+def test_checksum_mismatch_is_torn(tmp_path):
+    root = str(tmp_path)
+    p1, p2 = _save_two(root)
+    m = load_step_manifest(root, 2)
+    m["sha256"] = "0" * 64
+    with open(manifest_path(root, 2), "w") as f:
+        json.dump(m, f)
+    assert validate_step(root, 2) is None
+    assert resolve_latest(root) == p1
+
+
+def test_malformed_manifest_json_is_torn_not_raised(tmp_path):
+    root = str(tmp_path)
+    p1, _p2 = _save_two(root)
+    open(manifest_path(root, 2), "w").write("{not json")
+    assert load_step_manifest(root, 2) is None
+    assert resolve_latest(root) == p1
+
+
+def test_restore_auto_garbage_npz_dies_with_valueerror(tmp_path):
+    """The repo parser contract: malformed snapshot bytes name the file
+    in a ValueError — never zipfile.BadZipFile / struct.error."""
+    p = str(tmp_path / "step_00000009.npz")
+    open(p, "wb").write(b"\x00garbage not a zip")
+    with pytest.raises(ValueError, match="step_00000009"):
+        restore_auto(p)
+
+
+def test_tmp_residue_is_ignored(tmp_path):
+    """A crash mid-save leaves .tmp.* residue next to the steps; the
+    scanner must not mistake it for a candidate."""
+    root = str(tmp_path)
+    p1, _ = _save_two(root)
+    open(os.path.join(root, ".tmp.12345.step_00000007.npz"), "wb") \
+        .write(b"junk")
+    os.mkdir(os.path.join(root, ".tmp.step_00000008.999"))
+    assert latest_step(root) == 2
+
+
+@pytest.mark.parametrize("stop_after", ["artifact_tmp", "artifact",
+                                        "manifest_tmp"])
+def test_every_kill9_interleaving_resolves_loadable(tmp_path, stop_after):
+    """Simulate kill -9 at each boundary inside save_step(step=2): the
+    survivor state must always resolve to a LOADABLE artifact (step 1)."""
+    root = str(tmp_path)
+    p1 = save_step(root, 1, 10, _params(1.0), {})
+    p2 = orbax_ckpt.step_path(root, 2)
+    if stop_after == "artifact_tmp":
+        # killed mid-artifact-write: only a torn tmp exists
+        open(os.path.join(root, ".tmp.1.step_00000002.npz"), "wb") \
+            .write(b"half")
+    elif stop_after == "artifact":
+        # killed after artifact replace, before manifest
+        orbax_ckpt.save_auto(p2, 20, _params(2.0), {})
+    elif stop_after == "manifest_tmp":
+        orbax_ckpt.save_auto(p2, 20, _params(2.0), {})
+        open(manifest_path(root, 2) + ".tmp", "w").write("{half")
+    chosen = resolve_latest(root)
+    assert chosen == p1
+    it, params, _state = restore_auto(chosen)
+    assert it == 10
+    np.testing.assert_array_equal(params["w"], _params(1.0)["w"])

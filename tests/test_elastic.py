@@ -552,3 +552,39 @@ def test_chaos_run_script_smoke():
     assert rec["joins"] == 1 and rec["crashes"] == 1
     assert rec["final_active"] == 8
     assert rec["partial_quorum_stall_s"] < rec["full_barrier_stall_s"]
+
+
+# --------------------------------------- wall-clock stage deadline hook
+
+def test_stage_deadline_hook_masks_slow_workers():
+    from sparknet_tpu.parallel.dist import make_stage_deadline_hook
+
+    seen = []
+    hook = make_stage_deadline_hook(
+        0.5, min_quorum=2, on_exclude=lambda r, ex: seen.append((r, ex)))
+    # no telemetry yet / everyone on time -> dense round
+    assert hook(0, {}) is None
+    assert hook(0, {0: 0.1, 1: 0.2}) is None
+    # one slow worker masked out
+    assert hook(1, {0: 0.1, 1: 0.9, 2: 0.2}) == [1.0, 0.0, 1.0]
+    assert seen == [(1, [1])]
+
+
+def test_stage_deadline_hook_never_below_quorum():
+    from sparknet_tpu.parallel.dist import make_stage_deadline_hook
+
+    hook = make_stage_deadline_hook(0.5, min_quorum=2)
+    # everyone slow: the fastest two stay in (ties broken by slot id)
+    assert hook(0, {0: 2.0, 1: 1.0, 2: 3.0}) == [1.0, 1.0, 0.0]
+    assert hook(0, {0: 1.0, 1: 1.0, 2: 1.0}) == [1.0, 1.0, 0.0]
+    with pytest.raises(ValueError):
+        make_stage_deadline_hook(0.0)
+    with pytest.raises(ValueError):
+        make_stage_deadline_hook(1.0, min_quorum=0)
+
+
+def test_parse_effect_snapshot_stop():
+    from sparknet_tpu.utils.signals import SolverAction, parse_effect
+
+    assert parse_effect("snapshot_stop") is SolverAction.SNAPSHOT_STOP
+    assert parse_effect("stop") is SolverAction.STOP

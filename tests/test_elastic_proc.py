@@ -1,0 +1,246 @@
+"""Process-level elastic supervisor (elastic/proc.py): REAL worker
+subprocesses, REAL SIGKILL/SIGSTOP chaos, wall-clock watchdog, and
+manifest-validated snapshot catch-up — the semantics the in-process
+ElasticRuntime (tests/test_elastic.py) only simulates.
+
+Everything here spawns OS processes, so the module skips cleanly where
+the sandbox forbids fork/exec; the determinism pin is additionally
+marked slow (two full supervisor runs)."""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from sparknet_tpu.elastic import FaultPlan
+from sparknet_tpu.elastic.proc import ProcSupervisor, masked_host_average
+from sparknet_tpu.utils import orbax_ckpt
+
+
+def _can_spawn() -> bool:
+    try:
+        p = subprocess.run([sys.executable, "-c", "print(7*6)"],
+                           capture_output=True, text=True, timeout=60)
+        return p.returncode == 0 and "42" in p.stdout
+    except (OSError, subprocess.SubprocessError):
+        return False
+
+
+pytestmark = [
+    pytest.mark.chaos,
+    pytest.mark.skipif(not _can_spawn(),
+                       reason="sandbox forbids subprocess spawn"),
+]
+
+
+def _largest_file(d):
+    return max((os.path.join(dp, f) for dp, _, fs in os.walk(d)
+                for f in fs), key=os.path.getsize)
+
+
+def test_masked_host_average_matches_manual():
+    a = {"w": np.array([1.0, 3.0], np.float32)}
+    b = {"w": np.array([3.0, 5.0], np.float32)}
+    avg = masked_host_average({0: a, 3: b})
+    np.testing.assert_array_equal(avg["w"], np.array([2.0, 4.0],
+                                                     np.float32))
+    with pytest.raises(ValueError):
+        masked_host_average({})
+
+
+def test_proc_round_completes_full_quorum(tmp_path):
+    log = str(tmp_path / "rounds.jsonl")
+    with ProcSupervisor(2, tau=2, round_log=log) as sup:
+        losses = [sup.run_round(), sup.run_round()]
+        assert all(np.isfinite(losses)), losses
+        assert sup.iter_done == 4 and sup.rounds_done == 2
+        assert sup.params_avg and sorted(sup.active) == [0, 1]
+    recs = [json.loads(ln) for ln in open(log)]
+    rounds = [r for r in recs if r.get("kind") == "round"]
+    assert [r["quorum"] for r in rounds] == [2, 2]
+    assert all(r["missing"] == [] for r in rounds)
+
+
+def test_external_sigkill_mid_round_is_excluded_and_logged(tmp_path):
+    """kill -9 a worker WHILE it runs its τ steps: the survivors' round
+    completes at quorum N-1 and the round JSONL records the missing
+    worker — real crash detection, not plan bookkeeping."""
+    log = str(tmp_path / "rounds.jsonl")
+    with ProcSupervisor(3, tau=1, min_quorum=2, round_log=log,
+                        round_sleep_s=1.0, deadline_s=60.0) as sup:
+        killer = threading.Timer(
+            0.4, lambda: sup.kill_worker(1, signal.SIGKILL))
+        killer.start()
+        try:
+            loss = sup.run_round()
+        finally:
+            killer.cancel()
+        assert np.isfinite(loss)
+        assert sorted(sup.active) == [0, 2]
+        assert sup.left.get(1) in ("crashed_mid_round", "exited")
+    rec = [json.loads(ln) for ln in open(log)
+           if json.loads(ln).get("kind") == "round"][0]
+    assert rec["quorum"] == 2 and 1 in rec["missing"]
+    assert 1 in rec["crashed"]
+
+
+def test_restart_resumes_bitexact_from_last_valid_snapshot(tmp_path):
+    """Kill the newest snapshot's bytes (the supervisor dying mid-write)
+    and restart with restore=True: the new supervisor must resume from
+    the last VALID (manifest-checksummed) step, bitwise equal to the
+    average that step recorded."""
+    snap = str(tmp_path / "snaps")
+    with ProcSupervisor(2, tau=1, snapshot_dir=snap,
+                        snapshot_every=1) as sup:
+        sup.run_round()
+        avg_r1 = {k: np.array(v, copy=True)
+                  for k, v in sup.params_avg.items()}
+        sup.run_round()
+        assert orbax_ckpt.latest_step(snap) == 2
+    # tear the newest artifact; its manifest still claims it
+    art2 = orbax_ckpt.validate_step(snap, 2)
+    victim = _largest_file(art2) if os.path.isdir(art2) else art2
+    with open(victim, "r+b") as f:
+        f.truncate(max(1, os.path.getsize(victim) // 2))
+    with ProcSupervisor(2, tau=1, snapshot_dir=snap, snapshot_every=1,
+                        restore=True) as sup2:
+        assert sup2._restored_from is not None
+        assert "step_00000001" in sup2._restored_from
+        assert sup2.iter_done == 1
+        for k in avg_r1:
+            np.testing.assert_array_equal(sup2.params_avg[k], avg_r1[k])
+        # and training continues from there
+        assert np.isfinite(sup2.run_round())
+        assert sup2.iter_done == 2
+
+
+def test_plan_straggler_sigstop_excluded_and_survives(tmp_path):
+    """A planned straggler is SIGSTOPped for the round (REAL preemption),
+    excluded from the average a priori (plan-determined, so the kill
+    schedule stays bitwise-replayable), then SIGCONTed — stopped, not
+    dead."""
+    log = str(tmp_path / "rounds.jsonl")
+    plan = FaultPlan(seed=3, stragglers={1: 20.0})
+    with ProcSupervisor(2, tau=1, min_quorum=1, chaos=plan,
+                        round_log=log, deadline_s=60.0) as sup:
+        sup.run_round()
+        assert sorted(sup.active) == [0, 1]  # stopped, not dead
+        assert all(w.proc.poll() is None for w in sup.workers.values())
+    rec = [json.loads(ln) for ln in open(log)
+           if json.loads(ln).get("kind") == "round"][0]
+    assert rec["quorum"] == 1 and rec["stragglers"] == [1]
+    assert 1 in rec["missing"]
+
+
+def test_external_sigstop_trips_heartbeat_watchdog(tmp_path):
+    """An UNPLANNED stall (external SIGSTOP of a worker the round is
+    waiting on): its heartbeat file genuinely stops moving, the watchdog
+    counts a miss, and the round completes at partial quorum when the
+    wall deadline expires."""
+    log = str(tmp_path / "rounds.jsonl")
+    with ProcSupervisor(2, tau=1, min_quorum=1, round_log=log,
+                        round_sleep_s=1.0, deadline_s=3.0,
+                        heartbeat_s=0.1) as sup:
+        stopper = threading.Timer(
+            0.3, lambda: sup.kill_worker(1, signal.SIGSTOP))
+        stopper.start()
+        try:
+            loss = sup.run_round()
+        finally:
+            stopper.cancel()
+        assert np.isfinite(loss)
+        st = sup.stats()
+        assert st["heartbeat_miss"] >= 1
+        # close() drains with SIGCONT-first, so the stopped worker exits
+    rec = [json.loads(ln) for ln in open(log)
+           if json.loads(ln).get("kind") == "round"][0]
+    assert rec["quorum"] == 1 and rec["missing"] == [1]
+    assert rec["heartbeat_miss"] == [1]
+    assert rec["late"] == [1]
+
+
+def test_sigint_snapshot_then_drain(tmp_path):
+    """SNAPSHOT_STOP from the action source (what SIGINT maps to in proc
+    mode): cut a manifest-committed snapshot, drain the workers, stop —
+    never abandon the round in flight."""
+
+    class OneShotStop:
+        def __init__(self):
+            self.calls = 0
+
+        def get_requested_action(self):
+            from sparknet_tpu.utils.signals import SolverAction
+
+            self.calls += 1
+            return (SolverAction.SNAPSHOT_STOP if self.calls == 1
+                    else SolverAction.NONE)
+
+    snap = str(tmp_path / "snaps")
+    src = OneShotStop()
+    with ProcSupervisor(2, tau=1, snapshot_dir=snap,
+                        action_source=src) as sup:
+        losses = sup.run(5)
+        assert len(losses) == 1  # stopped after the first round
+        assert any(e["kind"] == "sigint_snapshot_drain"
+                   for e in sup.events)
+        # drained: every worker process has exited
+        assert all(w.proc.poll() is not None
+                   for w in sup.workers.values())
+    step = orbax_ckpt.latest_step(snap)
+    assert step is not None
+    it, params, _state = orbax_ckpt.restore_auto(
+        orbax_ckpt.resolve_latest(snap))
+    assert it == 1 and params
+
+
+def test_join_catches_up_from_manifest_validated_snapshot(tmp_path):
+    """The acceptance scenario, small: seeded SIGKILL of worker 1 at
+    round 1, fresh-process join at round 3 restoring from the newest
+    valid snapshot; quorum dips to N-1 then recovers."""
+    snap = str(tmp_path / "snaps")
+    plan = FaultPlan.from_spec("crash:1@1", seed=11)
+    with ProcSupervisor(2, tau=1, min_quorum=1, chaos=plan,
+                        snapshot_dir=snap, snapshot_every=1) as sup:
+        sup.schedule_join(1, 3)
+        losses = sup.run(4)
+        assert len(losses) == 4
+        rounds = [e for e in sup.events if e["kind"] == "round"]
+        assert [r["quorum"] for r in rounds] == [2, 1, 1, 2]
+        joins = [e for e in sup.events if e["kind"] == "join"]
+        assert len(joins) == 1
+        assert os.path.basename(str(joins[0]["source"])) \
+            .startswith("step_")
+        assert sup.stats()["worker_restarts"] == 1
+
+
+@pytest.mark.slow
+def test_two_run_determinism_bitwise(tmp_path):
+    """Same --chaos spec + seed => identical kill schedule AND bitwise
+    identical final params across two independent supervisor runs (the
+    proc-mode replay pin: exclusions are plan-determined, so real
+    signals do not break determinism)."""
+
+    def one(tag):
+        snap = str(tmp_path / f"snap_{tag}")
+        plan = FaultPlan.from_spec("crash:1@1", seed=23)
+        with ProcSupervisor(2, tau=2, min_quorum=1, chaos=plan, seed=5,
+                            snapshot_dir=snap, snapshot_every=2) as sup:
+            sup.run(3)
+            kills = [(e["kind"], e.get("slot"), e.get("round"))
+                     for e in sup.events
+                     if e["kind"] in ("leave", "join")]
+            return kills, {k: np.array(v, copy=True)
+                           for k, v in sup.params_avg.items()}
+
+    kills_a, params_a = one("a")
+    kills_b, params_b = one("b")
+    assert kills_a == kills_b
+    assert sorted(params_a) == sorted(params_b)
+    for k in params_a:
+        np.testing.assert_array_equal(params_a[k], params_b[k])

@@ -297,6 +297,67 @@ def test_r005_scoped_to_serving(tmp_path):
     assert fs == []
 
 
+# ------------------------------------------------------------------ R006
+
+def test_r006_flags_timeoutless_run_and_aliases(tmp_path):
+    # alias tracking mirrors R001: both import forms are seen
+    fs = _lint(tmp_path, {"a.py": """
+        import subprocess as sp
+        from subprocess import check_output as co
+
+        def f(cmd):
+            sp.run(cmd)
+            co(cmd)
+            sp.call(cmd, timeout=5)  # compliant
+    """}, ["R006"])
+    assert len(fs) == 2
+    assert all("without timeout=" in f.message for f in fs)
+    assert {f.line for f in fs} == {6, 7}
+
+
+def test_r006_timeout_none_is_flagged(tmp_path):
+    fs = _lint(tmp_path, {"a.py": """
+        import subprocess
+
+        def f(cmd):
+            subprocess.run(cmd, timeout=None)
+    """}, ["R006"])
+    assert len(fs) == 1 and "timeout=None" in fs[0].message
+
+
+def test_r006_popen_needs_kill_path(tmp_path):
+    bad = _lint(tmp_path, {"a.py": """
+        import subprocess
+
+        def f(cmd):
+            return subprocess.Popen(cmd)
+    """}, ["R006"])
+    assert len(bad) == 1 and "no kill path" in bad[0].message
+    good = _lint(tmp_path, {"a.py": """
+        import subprocess
+
+        def f(cmd):
+            p = subprocess.Popen(cmd)
+            try:
+                p.wait(timeout=5)
+            finally:
+                p.kill()
+            return p
+    """}, ["R006"])
+    assert good == []
+
+
+def test_r006_kwargs_spread_not_flagged(tmp_path):
+    # **kw may carry timeout=: absence is unprovable, so no finding
+    fs = _lint(tmp_path, {"a.py": """
+        import subprocess
+
+        def f(cmd, **kw):
+            return subprocess.run(cmd, **kw)
+    """}, ["R006"])
+    assert fs == []
+
+
 # --------------------------------------------------------- engine plumbing
 
 def test_syntax_error_becomes_e000(tmp_path):
@@ -330,7 +391,7 @@ def test_format_json_schema(tmp_path):
 
 def test_default_rules_ids_unique_and_complete():
     ids = [r.id for r in default_rules()]
-    assert ids == ["R001", "R002", "R003", "R004", "R005"]
+    assert ids == ["R001", "R002", "R003", "R004", "R005", "R006"]
     assert isinstance(default_rules()[0].check_module, object)
     assert all(isinstance(r.rationale, str) and r.rationale
                for r in default_rules())
@@ -439,13 +500,20 @@ def test_cli_lint_bad_select_exits_two(tmp_path, capsys):
 
 
 def test_lint_gate_script(tmp_path):
-    """scripts/lint_gate.sh: rc 0 on a clean tree, rc 1 on findings."""
+    """scripts/lint_gate.sh: rc 0 on a clean tree, rc 1 on findings.
+    SPARKNET_LINT_GATE_NO_PROC=1 keeps this a pure lint-contract test
+    (the proc chaos smoke the gate also runs is exercised by the
+    chaos-marked tests in tests/test_elastic_proc.py); the smoke's
+    presence in the gate is pinned below by inspection."""
     gate = os.path.join(REPO, "scripts", "lint_gate.sh")
+    text = open(gate).read()
+    assert "chaos_run.py --proc" in text and "timeout" in text
     clean = _mkpkg(tmp_path, {"ok.py": "x = 1\n"})
     dirty_dir = tmp_path / "dirty"
     dirty_dir.mkdir()
     (dirty_dir / "bad.py").write_text("import time\nT = time.time()\n")
-    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               SPARKNET_LINT_GATE_NO_PROC="1")
     rc_clean = subprocess.run(
         ["bash", gate, clean, "--select", "R001"],
         cwd=REPO, env=env, capture_output=True, text=True)
