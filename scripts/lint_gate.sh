@@ -11,10 +11,21 @@
 #   scripts/lint_gate.sh --select R001,R004    # subset of rules
 #   scripts/lint_gate.sh --jaxpr round         # + trace the fused round
 # Set SPARKNET_LINT_GATE_NO_PROC=1 to skip the smoke (lint-only, e.g.
-# on a box where fork/subprocess is forbidden).
+# on a box where fork/subprocess is forbidden) and
+# SPARKNET_LINT_GATE_NO_CONTRACT=1 to skip the jaxpr program-contract
+# check (needs the toy-solver deps + an 8-device CPU mesh to trace).
 set -euo pipefail
 cd "$(dirname "$0")/.."
 python -m sparknet_tpu.cli lint --format json "$@"
+if [ "${SPARKNET_LINT_GATE_NO_CONTRACT:-0}" != "1" ]; then
+    # full rule set already ran above; the contract leg re-runs one
+    # cheap rule only (the lint exit code contract needs A select) and
+    # diffs the traced round + serving forwards against CONTRACTS.json
+    timeout -k 10 600 env JAX_PLATFORMS=cpu \
+        XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+        python -m sparknet_tpu.cli lint --format json --select R007 \
+        --jaxpr round --jaxpr serve --model lenet --contract
+fi
 if [ "${SPARKNET_LINT_GATE_NO_PROC:-0}" != "1" ]; then
     timeout -k 10 420 env JAX_PLATFORMS=cpu \
         python scripts/chaos_run.py --proc --no_smoke

@@ -1,0 +1,732 @@
+"""Project-wide call graph with a lock-acquisition model.
+
+The per-module fixpoint R002 carries (raiser-ness propagated through
+bare-name calls) works because parser modules are self-contained; the
+concurrency invariants are not — `InferenceServer._get_placer` holds
+`self._lock` while constructing a `DevicePlacer`, whose `__init__` calls
+`serving_devices()`, which touches `jax.devices()` two modules away.
+Checking "no blocking work under a held lock" therefore needs ONE graph
+across the whole package: who calls whom, which locks are held at each
+call site, where locks are acquired, and which methods escape onto other
+threads (`Thread(target=self._worker)`, callbacks captured by lambdas).
+
+This module builds that graph; `concurrency.py` runs rules R007-R009
+over it.  The model (assumptions the rules inherit; blind spots are
+documented in ANALYSIS.md):
+
+- **Lock identity is a name, scoped by class.**  `with self._cv:` in
+  any `ReplicaScheduler` method denotes the lock `ReplicaScheduler._cv`;
+  two instances of the same class map to one node (lock-ORDER analysis
+  is instance-insensitive by design — an ABBA cycle between two
+  instances of one class is still reported).  Module-level locks are
+  `<rel>::<name>`; a lockish attribute on a foreign receiver
+  (`lm._swap_lock`) falls back to the wildcard owner `*.<attr>`.
+- **A lock attribute is discovered** from `self.x = threading.Lock()/
+  RLock()/Condition()/Semaphore()` in any method, from a dataclass
+  field annotated `threading.Lock` (or `field(default_factory=
+  threading.Lock)`), or — fallback — from any `with self.x:` whose
+  attribute name matches ``lock|cv|cond|mutex`` (R005's heuristic,
+  kept so locks injected through constructors still resolve).
+- **Held regions are lexical `with` bodies** plus `x.acquire()` /
+  `x.release()` pairs tracked within one statement list.  A local alias
+  `cv = self._cv` resolves through a per-function alias map (the
+  scheduler's worker loop does exactly this).
+- **Deferred code is not executed at its definition site**: lambda and
+  nested-def bodies contribute nothing to the enclosing function's call
+  sites or held regions.  The one consequence: a
+  `cv.wait_for(lambda: ...)` predicate that itself blocks is invisible.
+- **A method escapes onto another thread** when it (or a lambda calling
+  it) is handed to `Thread`/`Timer`/`Process` (or any `target=` kwarg),
+  to an executor-style callback sink (`submit`, `apply_async`,
+  `add_done_callback`, `run_in_executor`, `call_soon*`), to
+  `signal.signal` (handlers interleave asynchronously with the main
+  flow), or into the constructor of a class that itself spawns threads
+  (the scheduler's `run=lambda ...: self._run_batch(...)` callback runs
+  on scheduler worker threads).  Same-thread combinators — `jax.jit`,
+  `functools.partial`, `map` — do NOT make a method a thread entry.
+
+Resolution is deliberately conservative: `self.m()` resolves within the
+class; `Name()` resolves through same-module defs, then `from .x import
+Name` edges, then a unique project-wide match; `obj.m()` on a foreign
+receiver resolves only when `m` is defined exactly once in the project
+(ambiguous names like `get`/`submit`/`close` stay unresolved rather
+than guessing — missed edges over false ones).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from .engine import Project
+
+LOCK_FACTORIES = frozenset({
+    "Lock", "RLock", "Condition", "Semaphore", "BoundedSemaphore",
+})
+
+_LOCKISH_ATTR_RE = re.compile(r"lock|cv|cond|mutex", re.IGNORECASE)
+
+# dunder methods that are real external entry points (callers outside the
+# class invoke them); the constructor family is excluded everywhere —
+# writes in __init__ happen-before any thread can see the object.
+PUBLIC_DUNDERS = frozenset({
+    "__call__", "__enter__", "__exit__", "__iter__", "__next__",
+    "__len__", "__contains__", "__getitem__", "__setitem__",
+})
+CONSTRUCTORS = frozenset({"__init__", "__post_init__", "__new__",
+                          "__del__"})
+
+
+class CallSite:
+    """One call expression inside a function, with its lock context."""
+
+    __slots__ = ("name", "node", "held", "is_self", "recv_lock",
+                 "recv_dotted", "from_module", "recv_terminal",
+                 "n_args", "has_timeout", "is_name_call", "cb_methods",
+                 "has_target_kw")
+
+    def __init__(self, name: str, node: ast.Call, held: Tuple[str, ...],
+                 *, is_self: bool, recv_lock: Optional[str],
+                 recv_dotted: Optional[str], from_module: Optional[str],
+                 recv_terminal: Optional[str], is_name_call: bool) -> None:
+        self.name = name
+        self.node = node
+        self.held = held
+        self.is_self = is_self            # receiver is literally `self`
+        self.recv_lock = recv_lock        # lock id when receiver IS a lock
+        self.recv_dotted = recv_dotted    # "subprocess", "os.path", "jax"…
+        self.from_module = from_module    # Name call via `from X import n`
+        self.recv_terminal = recv_terminal
+        self.n_args = len(node.args)
+        self.has_timeout = any(
+            kw.arg == "timeout"
+            and not (isinstance(kw.value, ast.Constant)
+                     and kw.value.value is None)
+            for kw in node.keywords)
+        self.is_name_call = is_name_call
+        # self-methods handed to this call as values (directly or inside
+        # a lambda argument) — escape candidates, resolved by the
+        # builder's escape pass
+        self.cb_methods: Tuple[str, ...] = ()
+        self.has_target_kw = False
+
+
+class AttrAccess:
+    """A read or write of `self.<attr>` with the locks held at the site."""
+
+    __slots__ = ("attr", "node", "held", "is_write")
+
+    def __init__(self, attr: str, node: ast.AST, held: Tuple[str, ...],
+                 is_write: bool) -> None:
+        self.attr = attr
+        self.node = node
+        self.held = held
+        self.is_write = is_write
+
+
+class Acquire:
+    """One lock acquisition (with-enter or .acquire()) and what was
+    already held when it happened."""
+
+    __slots__ = ("lock", "node", "held_before")
+
+    def __init__(self, lock: str, node: ast.AST,
+                 held_before: Tuple[str, ...]) -> None:
+        self.lock = lock
+        self.node = node
+        self.held_before = held_before
+
+
+class FuncInfo:
+    __slots__ = ("rel", "cls", "name", "qual", "node", "public",
+                 "calls", "acquires", "accesses")
+
+    def __init__(self, rel: str, cls: Optional[str], name: str,
+                 node: ast.AST) -> None:
+        self.rel = rel
+        self.cls = cls
+        self.name = name
+        self.qual = f"{rel}::{cls}.{name}" if cls else f"{rel}::{name}"
+        self.node = node
+        self.public = (not name.startswith("_")) or name in PUBLIC_DUNDERS
+        self.calls: List[CallSite] = []
+        self.acquires: List[Acquire] = []
+        self.accesses: List[AttrAccess] = []
+
+
+class ClassInfo:
+    __slots__ = ("rel", "name", "node", "methods", "lock_attrs",
+                 "escapes")
+
+    def __init__(self, rel: str, name: str, node: ast.ClassDef) -> None:
+        self.rel = rel
+        self.name = name
+        self.node = node
+        self.methods: Dict[str, FuncInfo] = {}
+        self.lock_attrs: Dict[str, str] = {}   # attr -> factory name
+        self.escapes: Set[str] = set()         # methods run on other frames
+
+
+class ModuleIndex:
+    __slots__ = ("rel", "import_aliases", "from_imports", "module_locks",
+                 "threading_aliases", "from_threading")
+
+    def __init__(self, rel: str) -> None:
+        self.rel = rel
+        self.import_aliases: Dict[str, str] = {}   # local -> dotted module
+        # local -> (dotted module resolved against this file, orig name)
+        self.from_imports: Dict[str, Tuple[str, str]] = {}
+        self.module_locks: Set[str] = set()
+        self.threading_aliases: Set[str] = set()
+        self.from_threading: Dict[str, str] = {}   # local -> factory name
+
+
+class CallGraph:
+    """The whole-package index `concurrency.py` analyses."""
+
+    def __init__(self) -> None:
+        self.funcs: Dict[str, FuncInfo] = {}
+        self.classes: Dict[Tuple[str, str], ClassInfo] = {}
+        self.mods: Dict[str, ModuleIndex] = {}
+        self._by_bare: Dict[str, List[FuncInfo]] = {}
+        self._class_by_name: Dict[str, List[ClassInfo]] = {}
+        self._local_defs: Dict[Tuple[str, str], FuncInfo] = {}
+        self._rels: Set[str] = set()
+
+    # -- resolution -----------------------------------------------------
+    def _module_rel(self, dotted: str) -> Optional[str]:
+        p = dotted.replace(".", "/")
+        for cand in (f"{p}.py", f"{p}/__init__.py"):
+            if cand in self._rels:
+                return cand
+        # absolute import spelled with the package name prefix
+        if "/" in p:
+            tail = p.split("/", 1)[1]
+            for cand in (f"{tail}.py", f"{tail}/__init__.py"):
+                if cand in self._rels:
+                    return cand
+        return None
+
+    def _def_in(self, rel: str, name: str) -> Optional[FuncInfo]:
+        f = self._local_defs.get((rel, name))
+        if f is not None:
+            return f
+        ci = self.classes.get((rel, name))
+        if ci is not None:
+            return ci.methods.get("__init__")
+        return None
+
+    def resolve(self, cs: CallSite, caller: FuncInfo) -> List[FuncInfo]:
+        """Call targets for a site; empty when unknown or ambiguous."""
+        if cs.is_self and caller.cls is not None:
+            ci = self.classes.get((caller.rel, caller.cls))
+            if ci is not None:
+                m = ci.methods.get(cs.name)
+                return [m] if m is not None else []
+            return []
+        if cs.is_name_call:
+            t = self._def_in(caller.rel, cs.name)
+            if t is not None:
+                return [t]
+            mi = self.mods.get(caller.rel)
+            if mi is not None and cs.name in mi.from_imports:
+                dotted, orig = mi.from_imports[cs.name]
+                rel = self._module_rel(dotted)
+                if rel is not None:
+                    t = self._def_in(rel, orig)
+                    if t is not None:
+                        return [t]
+                return []
+            cands = self._class_by_name.get(cs.name, [])
+            if len(cands) == 1:
+                m = cands[0].methods.get("__init__")
+                return [m] if m is not None else []
+            funcs = [f for f in self._by_bare.get(cs.name, [])
+                     if f.cls is None]
+            return funcs if len(funcs) == 1 else []
+        if cs.recv_dotted is not None:
+            return []  # stdlib / external module call — classified, not walked
+        cands = self._by_bare.get(cs.name, [])
+        return cands if len(cands) == 1 else []
+
+
+def build_callgraph(project: Project) -> CallGraph:
+    """Build (and memoize on the Project) the package call graph."""
+    cached = getattr(project, "_sparknet_callgraph", None)
+    if cached is not None:
+        return cached
+    g = CallGraph()
+    g._rels = {m.rel for m in project.modules}
+    for ctx in project.modules:
+        _index_module(g, ctx)
+    for ctx in project.modules:
+        _walk_module(g, ctx)
+    for f in g.funcs.values():
+        g._by_bare.setdefault(f.name, []).append(f)
+    for fs in g._by_bare.values():
+        fs.sort(key=lambda f: f.qual)
+    for ci in g.classes.values():
+        g._class_by_name.setdefault(ci.name, []).append(ci)
+    for cs_ in g._class_by_name.values():
+        cs_.sort(key=lambda c: (c.rel, c.name))
+    _compute_escapes(g)
+    project._sparknet_callgraph = g
+    return g
+
+
+_THREAD_SPAWNERS = frozenset({"Thread", "Timer", "Process"})
+_CALLBACK_SINKS = frozenset({"submit", "apply_async", "add_done_callback",
+                             "run_in_executor", "call_soon",
+                             "call_soon_threadsafe"})
+
+
+def _compute_escapes(g: CallGraph) -> None:
+    """Which methods run on frames other than their caller's thread.
+
+    Phase 1 — direct: a self-method (or a lambda calling one) handed to
+    `Thread`/`Timer`/`Process`, to any call's `target=` kwarg, to an
+    executor-style callback sink, or to `signal.signal`.
+    Phase 2 — one hop indirect: handed into the constructor of a class
+    that itself spawns threads (constructor-injected callbacks like the
+    scheduler's `run=` execute on that class's worker threads).  Deeper
+    forwarding chains are a documented blind spot.
+    """
+    spawning: Set[Tuple[str, str]] = set()
+    for key in sorted(g.classes):
+        ci = g.classes[key]
+        names = set(ci.methods)
+        for n in sorted(ci.methods):
+            for cs in ci.methods[n].calls:
+                if cs.name in _THREAD_SPAWNERS:
+                    spawning.add(key)
+                cb = set(cs.cb_methods) & names
+                if not cb:
+                    continue
+                if (cs.name in _THREAD_SPAWNERS
+                        or cs.name in _CALLBACK_SINKS
+                        or cs.has_target_kw
+                        or (cs.name == "signal"
+                            and (cs.recv_dotted == "signal"
+                                 or cs.from_module == "signal"))):
+                    ci.escapes |= cb
+    threaded = {key for key in g.classes
+                if key in spawning or g.classes[key].escapes}
+    for key in sorted(g.classes):
+        ci = g.classes[key]
+        names = set(ci.methods)
+        for n in sorted(ci.methods):
+            fn = ci.methods[n]
+            for cs in fn.calls:
+                cb = set(cs.cb_methods) & names
+                if not cb:
+                    continue
+                for t in g.resolve(cs, fn):
+                    if (t.name == "__init__" and t.cls is not None
+                            and (t.rel, t.cls) in threaded):
+                        ci.escapes |= cb
+
+
+def _self_attr_refs(node: ast.AST) -> Set[str]:
+    """Names of `self.<x>` references anywhere inside `node` — used to
+    find the methods a lambda argument captures."""
+    out: Set[str] = set()
+    for sub in ast.walk(node):
+        if (isinstance(sub, ast.Attribute)
+                and isinstance(sub.value, ast.Name)
+                and sub.value.id == "self"):
+            out.add(sub.attr)
+    return out
+
+
+# ------------------------------------------------------------- module pass
+
+def _dotted_from_importfrom(rel: str, node: ast.ImportFrom) -> str:
+    if node.level == 0:
+        return node.module or ""
+    parts = rel.split("/")[:-1]          # package dirs of this file
+    if node.level > 1:
+        parts = parts[:len(parts) - (node.level - 1)]
+    if node.module:
+        parts = parts + node.module.split(".")
+    return ".".join(parts)
+
+
+def _index_module(g: CallGraph, ctx) -> None:
+    mi = ModuleIndex(ctx.rel)
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                mi.import_aliases[alias.asname
+                                  or alias.name.split(".")[0]] = alias.name
+                if alias.name == "threading":
+                    mi.threading_aliases.add(alias.asname or "threading")
+        elif isinstance(node, ast.ImportFrom):
+            dotted = _dotted_from_importfrom(ctx.rel, node)
+            for alias in node.names:
+                local = alias.asname or alias.name
+                mi.from_imports[local] = (dotted, alias.name)
+                if dotted == "threading" and alias.name in LOCK_FACTORIES:
+                    mi.from_threading[local] = alias.name
+    for stmt in ctx.tree.body:
+        if isinstance(stmt, ast.Assign) and _lock_factory_name(
+                stmt.value, mi) is not None:
+            for t in stmt.targets:
+                if isinstance(t, ast.Name):
+                    mi.module_locks.add(t.id)
+    g.mods[ctx.rel] = mi
+
+
+def _lock_factory_name(expr: ast.expr, mi: ModuleIndex) -> Optional[str]:
+    """Factory name when `expr` is a `threading.Lock()`-style call."""
+    if not isinstance(expr, ast.Call):
+        return None
+    f = expr.func
+    if (isinstance(f, ast.Attribute) and f.attr in LOCK_FACTORIES
+            and isinstance(f.value, ast.Name)
+            and f.value.id in mi.threading_aliases):
+        return f.attr
+    if isinstance(f, ast.Name) and f.id in mi.from_threading:
+        return mi.from_threading[f.id]
+    return None
+
+
+def _annotation_lock_factory(ann: Optional[ast.expr]) -> Optional[str]:
+    if isinstance(ann, ast.Attribute) and ann.attr in LOCK_FACTORIES:
+        return ann.attr
+    if isinstance(ann, ast.Name) and ann.id in LOCK_FACTORIES:
+        return ann.id
+    return None
+
+
+# ----------------------------------------------------------- function pass
+
+def _walk_module(g: CallGraph, ctx) -> None:
+    mi = g.mods[ctx.rel]
+    mod_fn = FuncInfo(ctx.rel, None, "<module>", ctx.tree)
+    g.funcs[mod_fn.qual] = mod_fn
+    g._local_defs[(ctx.rel, "<module>")] = mod_fn
+    top: List[ast.stmt] = []
+    for stmt in ctx.tree.body:
+        if isinstance(stmt, ast.ClassDef):
+            _walk_class(g, ctx, mi, stmt)
+        elif isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            fn = FuncInfo(ctx.rel, None, stmt.name, stmt)
+            g.funcs[fn.qual] = fn
+            g._local_defs[(ctx.rel, stmt.name)] = fn
+            _FuncWalker(g, mi, None, fn).run()
+        else:
+            top.append(stmt)
+    _FuncWalker(g, mi, None, mod_fn).run_body(top)
+
+
+def _walk_class(g: CallGraph, ctx, mi: ModuleIndex,
+                node: ast.ClassDef) -> None:
+    ci = ClassInfo(ctx.rel, node.name, node)
+    g.classes[(ctx.rel, node.name)] = ci
+    # dataclass-style lock fields
+    for stmt in node.body:
+        if isinstance(stmt, ast.AnnAssign) and isinstance(stmt.target,
+                                                          ast.Name):
+            fac = _annotation_lock_factory(stmt.annotation)
+            if fac is None and isinstance(stmt.value, ast.Call):
+                for kw in stmt.value.keywords:
+                    if kw.arg == "default_factory":
+                        fac = _annotation_lock_factory(kw.value)
+            if fac is not None:
+                ci.lock_attrs[stmt.target.id] = fac
+    # `self.x = threading.Lock()` in any method body
+    for meth in node.body:
+        if not isinstance(meth, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        for sub in ast.walk(meth):
+            if isinstance(sub, ast.Assign):
+                fac = _lock_factory_name(sub.value, mi)
+                if fac is None:
+                    continue
+                for t in sub.targets:
+                    if (isinstance(t, ast.Attribute)
+                            and isinstance(t.value, ast.Name)
+                            and t.value.id == "self"):
+                        ci.lock_attrs[t.attr] = fac
+    for meth in node.body:
+        if isinstance(meth, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            fn = FuncInfo(ctx.rel, node.name, meth.name, meth)
+            g.funcs[fn.qual] = fn
+            ci.methods[meth.name] = fn
+            _FuncWalker(g, mi, ci, fn).run()
+
+
+class _FuncWalker:
+    """Single pass over one function body: call sites with held locks,
+    lock acquisitions, self-attribute accesses, escape candidates."""
+
+    def __init__(self, g: CallGraph, mi: ModuleIndex,
+                 ci: Optional[ClassInfo], fn: FuncInfo) -> None:
+        self.g = g
+        self.mi = mi
+        self.ci = ci
+        self.fn = fn
+        self.aliases: Dict[str, str] = {}   # local name -> lock id
+
+    def run(self) -> None:
+        self.run_body(list(self.fn.node.body))
+
+    # -- lock identity --------------------------------------------------
+    def lock_id(self, expr: ast.expr) -> Optional[str]:
+        if (isinstance(expr, ast.Attribute)
+                and isinstance(expr.value, ast.Name)
+                and expr.value.id == "self"):
+            a = expr.attr
+            if self.ci is not None and a in self.ci.lock_attrs:
+                return f"{self.ci.name}.{a}"
+            if _LOCKISH_ATTR_RE.search(a):
+                owner = self.ci.name if self.ci is not None else "?"
+                return f"{owner}.{a}"
+            return None
+        if isinstance(expr, ast.Name):
+            n = expr.id
+            if n in self.aliases:
+                return self.aliases[n]
+            if n in self.mi.module_locks or (_LOCKISH_ATTR_RE.search(n)
+                                             and n not in
+                                             self.mi.import_aliases):
+                return f"{self.mi.rel}::{n}"
+            return None
+        if isinstance(expr, ast.Attribute) and _LOCKISH_ATTR_RE.search(
+                expr.attr):
+            return f"*.{expr.attr}"    # lockish attr on a foreign receiver
+        return None
+
+    # -- statement walk -------------------------------------------------
+    def run_body(self, body: Sequence[ast.stmt],
+                 held: Tuple[str, ...] = ()) -> None:
+        h = held
+        for stmt in body:
+            lid = self._acquire_call(stmt)
+            if lid is not None:
+                self.fn.acquires.append(Acquire(lid, stmt, h))
+                if lid not in h:
+                    h = h + (lid,)
+                continue
+            rid = self._release_call(stmt)
+            if rid is not None:
+                h = tuple(x for x in h if x != rid)
+                continue
+            self._stmt(stmt, h)
+
+    def _acquire_call(self, stmt: ast.stmt) -> Optional[str]:
+        if (isinstance(stmt, ast.Expr) and isinstance(stmt.value, ast.Call)
+                and isinstance(stmt.value.func, ast.Attribute)
+                and stmt.value.func.attr == "acquire"):
+            return self.lock_id(stmt.value.func.value)
+        return None
+
+    def _release_call(self, stmt: ast.stmt) -> Optional[str]:
+        if (isinstance(stmt, ast.Expr) and isinstance(stmt.value, ast.Call)
+                and isinstance(stmt.value.func, ast.Attribute)
+                and stmt.value.func.attr == "release"):
+            return self.lock_id(stmt.value.func.value)
+        return None
+
+    def _stmt(self, stmt: ast.stmt, held: Tuple[str, ...]) -> None:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            self._deferred(stmt)     # nested defs run later, elsewhere
+            return
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            new = held
+            for item in stmt.items:
+                self._expr(item.context_expr, held)
+                lid = self.lock_id(item.context_expr)
+                if lid is not None:
+                    self.fn.acquires.append(Acquire(lid, item.context_expr,
+                                                    new))
+                    if lid not in new:
+                        new = new + (lid,)
+            self.run_body(stmt.body, new)
+            return
+        if isinstance(stmt, ast.Try):
+            self.run_body(stmt.body, held)
+            for hd in stmt.handlers:
+                self.run_body(hd.body, held)
+            self.run_body(stmt.orelse, held)
+            self.run_body(stmt.finalbody, held)
+            return
+        if isinstance(stmt, (ast.If, ast.While)):
+            self._expr(stmt.test, held)
+            self.run_body(stmt.body, held)
+            self.run_body(stmt.orelse, held)
+            return
+        if isinstance(stmt, (ast.For, ast.AsyncFor)):
+            self._target(stmt.target, held)
+            self._expr(stmt.iter, held)
+            self.run_body(stmt.body, held)
+            self.run_body(stmt.orelse, held)
+            return
+        if isinstance(stmt, ast.Assign):
+            self._maybe_alias(stmt)
+            for t in stmt.targets:
+                self._target(t, held)
+            self._expr(stmt.value, held)
+            return
+        if isinstance(stmt, ast.AugAssign):
+            self._target(stmt.target, held)
+            self._expr(stmt.value, held)
+            return
+        if isinstance(stmt, ast.AnnAssign):
+            self._target(stmt.target, held)
+            if stmt.value is not None:
+                self._expr(stmt.value, held)
+            return
+        # Return / Expr / Raise / Assert / Delete / match / etc.
+        for field in ast.iter_fields(stmt):
+            v = field[1]
+            if isinstance(v, ast.AST):
+                if isinstance(v, ast.expr):
+                    self._expr(v, held)
+            elif isinstance(v, list):
+                for e in v:
+                    if isinstance(e, ast.stmt):
+                        self._stmt(e, held)
+                    elif isinstance(e, ast.expr):
+                        self._expr(e, held)
+
+    def _maybe_alias(self, stmt: ast.Assign) -> None:
+        if len(stmt.targets) == 1 and isinstance(stmt.targets[0], ast.Name):
+            lid = self.lock_id(stmt.value) if isinstance(
+                stmt.value, (ast.Attribute, ast.Name)) else None
+            if lid is not None:
+                self.aliases[stmt.targets[0].id] = lid
+
+    def _target(self, t: ast.expr, held: Tuple[str, ...]) -> None:
+        if isinstance(t, ast.Attribute):
+            if isinstance(t.value, ast.Name) and t.value.id == "self":
+                self.fn.accesses.append(AttrAccess(t.attr, t, held, True))
+            else:
+                self._expr(t.value, held)
+            return
+        if isinstance(t, ast.Subscript):
+            base = t.value
+            if (isinstance(base, ast.Attribute)
+                    and isinstance(base.value, ast.Name)
+                    and base.value.id == "self"):
+                self.fn.accesses.append(AttrAccess(base.attr, t, held,
+                                                   True))
+            else:
+                self._expr(base, held)
+            self._expr(t.slice, held)
+            return
+        if isinstance(t, (ast.Tuple, ast.List)):
+            for e in t.elts:
+                self._target(e, held)
+            return
+        if isinstance(t, ast.Starred):
+            self._target(t.value, held)
+
+    # -- expression walk ------------------------------------------------
+    def _expr(self, node: Optional[ast.expr],
+              held: Tuple[str, ...]) -> None:
+        if node is None:
+            return
+        if isinstance(node, ast.Lambda):
+            self._deferred(node)
+            return
+        if isinstance(node, ast.Call):
+            self._call(node, held)
+            return
+        if (isinstance(node, ast.Attribute)
+                and isinstance(node.value, ast.Name)
+                and node.value.id == "self"):
+            self.fn.accesses.append(AttrAccess(node.attr, node, held,
+                                               False))
+            return
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.expr):
+                self._expr(child, held)
+            elif isinstance(child, ast.comprehension):
+                self._expr(child.iter, held)
+                for c in child.ifs:
+                    self._expr(c, held)
+
+    def _dotted(self, expr: ast.expr) -> Optional[str]:
+        """Dotted module path when `expr` is rooted at an import alias
+        (`sp` -> "subprocess", `os.path` -> "os.path")."""
+        parts: List[str] = []
+        cur = expr
+        while isinstance(cur, ast.Attribute):
+            parts.append(cur.attr)
+            cur = cur.value
+        if not isinstance(cur, ast.Name):
+            return None
+        base = self.mi.import_aliases.get(cur.id)
+        if base is None:
+            return None
+        return ".".join([base] + list(reversed(parts)))
+
+    def _call(self, node: ast.Call, held: Tuple[str, ...]) -> None:
+        f = node.func
+        name: Optional[str] = None
+        is_self = False
+        recv_lock: Optional[str] = None
+        recv_dotted: Optional[str] = None
+        from_module: Optional[str] = None
+        recv_terminal: Optional[str] = None
+        is_name_call = False
+        if isinstance(f, ast.Attribute):
+            name = f.attr
+            is_self = (isinstance(f.value, ast.Name)
+                       and f.value.id == "self")
+            recv_lock = self.lock_id(f.value)
+            recv_dotted = self._dotted(f.value)
+            if isinstance(f.value, ast.Name):
+                recv_terminal = f.value.id
+            elif isinstance(f.value, ast.Attribute):
+                recv_terminal = f.value.attr
+            elif isinstance(f.value, ast.Constant):
+                recv_terminal = "<const>"
+            if not is_self:
+                self._expr(f.value, held)
+        elif isinstance(f, ast.Name):
+            name = f.id
+            is_name_call = True
+            fi = self.mi.from_imports.get(f.id)
+            if fi is not None:
+                from_module = fi[0]
+        else:
+            self._expr(f, held)
+        cs: Optional[CallSite] = None
+        if name is not None:
+            cs = CallSite(
+                name, node, held, is_self=is_self, recv_lock=recv_lock,
+                recv_dotted=recv_dotted, from_module=from_module,
+                recv_terminal=recv_terminal, is_name_call=is_name_call)
+            self.fn.calls.append(cs)
+        cb: Set[str] = set()
+        for kw in node.keywords:
+            if kw.arg == "target" and _self_attr_refs(kw.value):
+                if cs is not None:
+                    cs.has_target_kw = True
+        for arg in list(node.args) + [kw.value for kw in node.keywords]:
+            if (isinstance(arg, ast.Attribute)
+                    and isinstance(arg.value, ast.Name)
+                    and arg.value.id == "self"):
+                cb.add(arg.attr)
+                self.fn.accesses.append(AttrAccess(arg.attr, arg, held,
+                                                   False))
+            elif isinstance(arg, ast.Lambda):
+                cb |= _self_attr_refs(arg)
+            elif isinstance(arg, ast.Starred):
+                self._expr(arg.value, held)
+            else:
+                self._expr(arg, held)
+        if cb and cs is not None:
+            cs.cb_methods = tuple(sorted(cb))
+
+    def _deferred(self, node: ast.AST) -> None:
+        """Lambda / nested-def body: runs later on some other frame —
+        nothing in it is attributed to the enclosing function.  (Methods
+        captured by lambdas that are CALL ARGUMENTS are picked up as
+        cb_methods in _call; a lambda assigned to a variable first is a
+        documented blind spot.)"""
+        return

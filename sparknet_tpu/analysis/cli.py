@@ -6,11 +6,15 @@
     python -m sparknet_tpu.cli lint --jaxpr round        # + trace the fused
                                                          #   round and audit it
     python -m sparknet_tpu.cli lint --jaxpr serve --model lenet
+    python -m sparknet_tpu.cli lint --jaxpr round --contract
+                                                # diff vs CONTRACTS.json
+    python -m sparknet_tpu.cli lint --jaxpr round --jaxpr serve \
+        --update-contracts                      # rewrite the baseline
 
 Exit code 1 on ANY finding (scripts/lint_gate.sh relies on this), 0 when
 clean.  JSON schema: engine.format_json — {"version", "count",
 "findings": [{rule, path, line, col, message}]}, plus "jaxpr" when a
---jaxpr leg ran.
+--jaxpr leg ran (and "contract_violations" in --contract mode).
 """
 
 from __future__ import annotations
@@ -52,19 +56,55 @@ def cmd_lint(args) -> int:
         jaxpr_reports.append(report)
         jaxpr_violations.extend(jaxpr_audit.findings_from_report(report))
 
-    rc = 1 if (findings or jaxpr_violations) else 0
+    contracts_file = args.contracts_file or os.path.join(
+        os.path.dirname(pkg_dir), "CONTRACTS.json")
+    contract_violations = []
+    if args.update_contracts:
+        if not jaxpr_reports:
+            print("lint: --update-contracts needs at least one --jaxpr "
+                  "leg to trace", file=sys.stderr)
+            return 2
+        jaxpr_audit.update_contracts(contracts_file, jaxpr_reports)
+        print(f"lint: wrote {len(jaxpr_reports)} contract(s) to "
+              f"{contracts_file}", file=sys.stderr)
+    elif args.contract:
+        if not jaxpr_reports:
+            print("lint: --contract needs at least one --jaxpr leg to "
+                  "trace", file=sys.stderr)
+            return 2
+        try:
+            contracts = jaxpr_audit.load_contracts(contracts_file)
+        except (OSError, ValueError) as e:
+            print(f"lint: {e}", file=sys.stderr)
+            return 2
+        for report in jaxpr_reports:
+            contract_violations.extend(
+                jaxpr_audit.check_contract(report, contracts))
+
+    rc = 1 if (findings or jaxpr_violations or contract_violations) else 0
     if args.format == "json":
-        extra = {"jaxpr": jaxpr_reports} if jaxpr_reports else None
-        print(format_json(findings, extra=extra))
+        extra = {}
+        if jaxpr_reports:
+            extra["jaxpr"] = jaxpr_reports
+        if args.contract and not args.update_contracts:
+            extra["contract_violations"] = contract_violations
+        print(format_json(findings, extra=extra or None))
     else:
         print(format_human(findings))
         for rep in jaxpr_reports:
             print(f"jaxpr[{rep['program']}]: {rep['n_eqns']} eqns, "
                   f"host_transfers={rep['host_transfers']}, "
+                  f"collectives={rep.get('collectives', {})}, "
                   f"convert_edges={rep['convert_edges']}, "
                   f"weak_invars={rep['weak_type_invars']}")
         for v in jaxpr_violations:
             print(f"jaxpr violation: {v}")
+        for v in contract_violations:
+            print(f"contract drift: {v}")
+        if args.contract and not args.update_contracts \
+                and not contract_violations:
+            print(f"contracts: {len(jaxpr_reports)} program(s) match "
+                  f"{contracts_file}")
     return rc
 
 
@@ -93,4 +133,14 @@ def register(sub) -> None:
                         "--jaxpr serve")
     p.add_argument("--quant", default=None,
                    help="quant mode for --jaxpr serve (e.g. bf16)")
+    p.add_argument("--contract", action="store_true",
+                   help="diff each --jaxpr report against the committed "
+                        "CONTRACTS.json; drift exits 1")
+    p.add_argument("--update-contracts", action="store_true",
+                   help="rewrite the contract entries for the traced "
+                        "--jaxpr programs (review the diff before "
+                        "committing)")
+    p.add_argument("--contracts-file", default=None,
+                   help="contracts path (default: CONTRACTS.json next to "
+                        "the package)")
     p.set_defaults(fn=cmd_lint)

@@ -1,0 +1,447 @@
+"""Interprocedural concurrency rules: R007 / R008 / R009.
+
+Three rules share one whole-package analysis over the call graph built
+by `callgraph.py` (lock identity, held regions, escapes — the model and
+its blind spots are documented there and in ANALYSIS.md):
+
+- **R007 lock-order-cycles** — every lock acquisition is an edge from
+  each already-held lock (lexically held, or held on SOME call path
+  into the function — a may-analysis union) to the acquired one.  A
+  cycle in that graph is an ABBA deadlock waiting for the right
+  interleaving.  A lexical re-acquire of the same non-reentrant lock is
+  reported too; a *may*-path re-acquire is not (union semantics would
+  make it a guess).
+- **R008 blocking-under-lock, interprocedural** — R005 catches `jit()`
+  lexically inside `with self._lock:`; it cannot see
+  `DevicePlacer(...)` under the lock calling `serving_devices()` calling
+  `jax.devices()` two modules away.  Each function gets a *blocking
+  summary* (which blocking operations it can reach, with one witness
+  path), propagated to a fixpoint; any call site that lexically holds a
+  lock and resolves to a function with a non-empty summary is flagged at
+  that site — the frame where the fix (move the call outside the
+  `with`) belongs.  `Condition.wait`/`wait_for` on the lock held at the
+  site is NOT blocking (the wait releases it); waiting on anything else
+  while a lock is held is.
+- **R009 unguarded-shared-state** — for classes with at least one
+  *thread escape* (see the escape model in `callgraph.py`), every write
+  to `self.<attr>` must hold a lock — lexically, or because every
+  intra-class call path to the writing method holds one (a
+  must-analysis intersection: the scheduler's `_pick_replica` writes
+  `self._rr` with no `with` in sight, but its only caller holds `_cv`,
+  so it is guarded).  Methods are partitioned into *thread groups*:
+  each escaped method is its own thread; all public methods form ONE
+  "public API" group (clients are assumed to drive the object from a
+  single thread — two public calls racing each other is the caller's
+  bug).  Only attributes touched from >= 2 groups are flagged;
+  `__init__` writes are construction (happens-before the first escape)
+  and exempt.
+
+All three anchor findings at real source lines, so the engine's
+`# sparknet: noqa[R00x]` suppression grammar applies unchanged.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Dict, FrozenSet, List, Optional, Set, Tuple
+
+from .callgraph import (CONSTRUCTORS, PUBLIC_DUNDERS, CallGraph, CallSite,
+                        FuncInfo, build_callgraph)
+from .engine import Finding, Project, Rule
+
+# ----------------------------------------------------- blocking classifier
+
+_SUBPROC_FNS = frozenset({"run", "call", "check_call", "check_output"})
+# device dispatch / value fetches (R005's set, receiver-checked where a
+# bare name would be ambiguous) + thread/process/future waits
+_DISPATCH_FNS = frozenset({
+    "jit", "device_put", "device_get", "block_until_ready",
+    "forward", "forward_padded", "warmup", "replicate", "calibrate_quant",
+    "result",
+})
+_QUEUEISH_RE = re.compile(r"(^|_)q(ueue)?\d*$", re.IGNORECASE)
+
+
+def classify_blocking(cs: CallSite) -> Optional[Tuple[str, Optional[str]]]:
+    """(description, exempt_lock) when the call can block; else None.
+
+    `exempt_lock` is the one lock a `Condition.wait` releases while
+    sleeping — holding ONLY that lock at the site is fine, holding any
+    other lock is not.
+    """
+    n = cs.name
+    if n in _SUBPROC_FNS:
+        if cs.recv_dotted == "subprocess" or cs.from_module == "subprocess":
+            return (f"subprocess.{n}", None)
+        return None
+    if n == "communicate":
+        return ("Popen.communicate", None)
+    if n == "join":
+        # thread/process join()s take no positional args; str.join and
+        # os.path.join always do.
+        if cs.n_args == 0 and cs.recv_terminal != "path" \
+                and "path" not in (cs.recv_dotted or ""):
+            return ("join", None)
+        return None
+    if n in ("wait", "wait_for"):
+        return (n, cs.recv_lock)
+    if n == "devices" and cs.recv_dotted == "jax":
+        return ("jax.devices", None)
+    if n in _DISPATCH_FNS:
+        return (n, None)
+    if n == "sleep" and (cs.recv_dotted == "time"
+                         or cs.from_module == "time"):
+        return ("time.sleep", None)
+    if (n == "get" and cs.n_args == 0 and not cs.has_timeout
+            and cs.recv_terminal is not None
+            and _QUEUEISH_RE.search(cs.recv_terminal)):
+        return ("queue.get (no timeout)", None)
+    return None
+
+
+def _blocks(held: Tuple[str, ...], exempt: Optional[str]) -> List[str]:
+    """The held locks a blocking op would actually stall."""
+    return [l for l in held if l != exempt]
+
+
+# ------------------------------------------------------- shared analysis
+
+class _Analysis:
+    """Everything R007/R008 need, computed once per Project."""
+
+    def __init__(self, graph: CallGraph) -> None:
+        self.graph = graph
+        # resolved call edges: (caller, callee, held at the site, site)
+        self.edges: List[Tuple[FuncInfo, FuncInfo, Tuple[str, ...],
+                               CallSite]] = []
+        for qual in sorted(graph.funcs):
+            fn = graph.funcs[qual]
+            for cs in fn.calls:
+                for target in graph.resolve(cs, fn):
+                    self.edges.append((fn, target, cs.held, cs))
+        self.may_held = self._may_held()
+        self.summaries = self._summaries()
+
+    def _may_held(self) -> Dict[str, FrozenSet[str]]:
+        """Union over call paths of locks held when a function is
+        entered (empty for entry points nobody calls)."""
+        may: Dict[str, FrozenSet[str]] = {
+            q: frozenset() for q in self.graph.funcs}
+        changed = True
+        while changed:
+            changed = False
+            for caller, callee, held, _ in self.edges:
+                incoming = may[caller.qual] | frozenset(held)
+                if not incoming <= may[callee.qual]:
+                    may[callee.qual] = may[callee.qual] | incoming
+                    changed = True
+        return may
+
+    def _summaries(self) -> Dict[str, Dict[str, Tuple[Optional[str],
+                                                      Tuple[str, ...]]]]:
+        """qual -> {desc: (exempt_lock, witness path of quals)} for every
+        blocking operation the function can reach."""
+        summ: Dict[str, Dict[str, Tuple[Optional[str],
+                                        Tuple[str, ...]]]] = {
+            q: {} for q in self.graph.funcs}
+        for qual in sorted(self.graph.funcs):
+            fn = self.graph.funcs[qual]
+            for cs in fn.calls:
+                hit = classify_blocking(cs)
+                if hit is not None:
+                    desc, exempt = hit
+                    _merge(summ[qual], desc, exempt, ())
+        changed = True
+        while changed:
+            changed = False
+            for caller, callee, _, _ in self.edges:
+                for desc, (exempt, path) in summ[callee.qual].items():
+                    if caller.qual in path or caller.qual == callee.qual:
+                        continue   # don't thread paths through cycles
+                    if _merge(summ[caller.qual], desc, exempt,
+                              (callee.qual,) + path):
+                        changed = True
+        return summ
+
+
+def _merge(d: Dict[str, Tuple[Optional[str], Tuple[str, ...]]],
+           desc: str, exempt: Optional[str],
+           path: Tuple[str, ...]) -> bool:
+    cur = d.get(desc)
+    if cur is None or (len(path), path) < (len(cur[1]), cur[1]):
+        d[desc] = (exempt, path)
+        return True
+    return False
+
+
+def _analysis(project: Project) -> _Analysis:
+    cached = getattr(project, "_sparknet_concurrency", None)
+    if cached is None:
+        cached = _Analysis(build_callgraph(project))
+        project._sparknet_concurrency = cached
+    return cached
+
+
+def _short(qual: str) -> str:
+    return qual.split("::", 1)[1] if "::" in qual else qual
+
+
+# ----------------------------------------------------------------- R007
+
+class LockOrderRule(Rule):
+    """Cycles in the lock-order graph are deadlocks waiting for the
+    right interleaving; one global acquisition order breaks them."""
+
+    id = "R007"
+    name = "lock-order-cycles"
+    rationale = ("two call paths acquiring the same locks in opposite "
+                 "orders deadlock under the right interleaving; the "
+                 "lock-order graph must stay acyclic")
+
+    def finalize(self, project: Project) -> List[Finding]:
+        an = _analysis(project)
+        # edge (A, B): B acquired while A held; witness = first site
+        witness: Dict[Tuple[str, str], Tuple[str, int]] = {}
+        findings: List[Finding] = []
+        for qual in sorted(an.graph.funcs):
+            fn = an.graph.funcs[qual]
+            for acq in fn.acquires:
+                lex = set(acq.held_before)
+                if acq.lock in lex and not _is_reentrant(an.graph,
+                                                         acq.lock):
+                    findings.append(self.finding(
+                        fn.rel, acq.node,
+                        f"non-reentrant lock {acq.lock} re-acquired "
+                        f"while already held — self-deadlock"))
+                for a in sorted(lex | set(an.may_held[qual])):
+                    if a != acq.lock:
+                        witness.setdefault(
+                            (a, acq.lock),
+                            (fn.rel, getattr(acq.node, "lineno", 0)))
+        adj: Dict[str, Set[str]] = {}
+        for a, b in witness:
+            adj.setdefault(a, set()).add(b)
+            adj.setdefault(b, set())
+        for scc in _sccs(adj):
+            if len(scc) < 2:
+                continue
+            locks = sorted(scc)
+            edges = sorted((a, b) for (a, b) in witness
+                           if a in scc and b in scc)
+            detail = ", ".join(
+                f"{a} -> {b} at {witness[(a, b)][0]}:{witness[(a, b)][1]}"
+                for a, b in edges)
+            rel, line = witness[edges[0]]
+            findings.append(self.finding(
+                rel, line,
+                f"lock-order cycle between {', '.join(locks)} "
+                f"(potential deadlock): {detail} — pick one global "
+                f"acquisition order"))
+        return findings
+
+
+def _is_reentrant(graph: CallGraph, lock_id: str) -> bool:
+    if "." not in lock_id or lock_id.startswith("*"):
+        return False
+    cls, attr = lock_id.rsplit(".", 1)
+    for (rel, name), ci in graph.classes.items():
+        if name == cls and ci.lock_attrs.get(attr) == "RLock":
+            return True
+    return False
+
+
+def _sccs(adj: Dict[str, Set[str]]) -> List[Set[str]]:
+    """Tarjan strongly-connected components, deterministic order."""
+    index: Dict[str, int] = {}
+    low: Dict[str, int] = {}
+    on_stack: Set[str] = set()
+    stack: List[str] = []
+    out: List[Set[str]] = []
+    counter = [0]
+
+    def strong(v: str) -> None:
+        index[v] = low[v] = counter[0]
+        counter[0] += 1
+        stack.append(v)
+        on_stack.add(v)
+        for w in sorted(adj.get(v, ())):
+            if w not in index:
+                strong(w)
+                low[v] = min(low[v], low[w])
+            elif w in on_stack:
+                low[v] = min(low[v], index[w])
+        if low[v] == index[v]:
+            comp: Set[str] = set()
+            while True:
+                w = stack.pop()
+                on_stack.discard(w)
+                comp.add(w)
+                if w == v:
+                    break
+            out.append(comp)
+
+    for v in sorted(adj):
+        if v not in index:
+            strong(v)
+    return out
+
+
+# ----------------------------------------------------------------- R008
+
+class BlockingUnderLockRule(Rule):
+    """No blocking work while a lock is held — lexically or through any
+    chain of calls the lock region makes (R005 generalized to the whole
+    package, interprocedurally)."""
+
+    id = "R008"
+    name = "blocking-under-lock"
+    rationale = ("a subprocess, device dispatch, value fetch, join, or "
+                 "untimed queue get reached while a Lock/Condition is "
+                 "held serializes every thread behind device/process "
+                 "time — the lock region must stay O(bookkeeping)")
+
+    def finalize(self, project: Project) -> List[Finding]:
+        an = _analysis(project)
+        findings: List[Finding] = []
+        seen: Set[Tuple[str, int, str]] = set()
+
+        def emit(fn: FuncInfo, cs: CallSite, msg: str, desc: str) -> None:
+            key = (fn.rel, getattr(cs.node, "lineno", 0), desc)
+            if key not in seen:
+                seen.add(key)
+                findings.append(self.finding(fn.rel, cs.node, msg))
+
+        for qual in sorted(an.graph.funcs):
+            fn = an.graph.funcs[qual]
+            for cs in fn.calls:
+                if not cs.held:
+                    continue
+                hit = classify_blocking(cs)
+                if hit is not None:
+                    desc, exempt = hit
+                    stalled = _blocks(cs.held, exempt)
+                    if stalled:
+                        emit(fn, cs,
+                             f"{desc} while holding "
+                             f"{', '.join(stalled)} — blocking call "
+                             f"under a held lock (move it outside the "
+                             f"lock region)", desc)
+                    continue
+                for target in an.graph.resolve(cs, fn):
+                    for desc, (exempt, path) in sorted(
+                            an.summaries[target.qual].items()):
+                        stalled = _blocks(cs.held, exempt)
+                        if not stalled:
+                            continue
+                        chain = " -> ".join(
+                            _short(q) for q in (target.qual,) + path)
+                        emit(fn, cs,
+                             f"{_short(target.qual)}() under "
+                             f"{', '.join(stalled)} reaches blocking "
+                             f"{desc} (via {chain}) — move the call "
+                             f"outside the lock region", desc)
+        return findings
+
+
+# ----------------------------------------------------------------- R009
+
+class SharedStateRule(Rule):
+    """In classes whose methods run on more than one thread, every write
+    to an attribute that another entry point also touches must hold a
+    lock (lexically, or on every intra-class call path)."""
+
+    id = "R009"
+    name = "unguarded-shared-state"
+    rationale = ("an attribute written without a lock in a class whose "
+                 "methods run on >= 2 threads is a data race: torn "
+                 "updates, lost writes, and reads of half-built state")
+
+    def finalize(self, project: Project) -> List[Finding]:
+        graph = build_callgraph(project)
+        findings: List[Finding] = []
+        for key in sorted(graph.classes):
+            ci = graph.classes[key]
+            if not ci.escapes:
+                continue   # single-threaded class: no audit
+            methods = ci.methods
+            # Thread GROUPS, not methods: each escaped method is its own
+            # thread; ALL public methods together are ONE group (the
+            # single-client-thread assumption — two public calls racing
+            # each other is the caller's bug, not the class's).  An attr
+            # is shared only when >= 2 groups touch it.
+            escaped = {n for n in ci.escapes
+                       if n in methods and n not in CONSTRUCTORS}
+            public = {n for n in methods
+                      if (not n.startswith("_") or n in PUBLIC_DUNDERS)
+                      and n not in CONSTRUCTORS and n not in escaped}
+            group_of: Dict[str, str] = {n: f"thread:{n}" for n in escaped}
+            group_of.update({n: "public API" for n in public})
+            entries = escaped | public
+            if len(set(group_of.values())) < 2:
+                continue
+            # intra-class self-call edges with the locks held at the site
+            calls: List[Tuple[str, str, Tuple[str, ...]]] = []
+            for n in sorted(methods):
+                for cs in methods[n].calls:
+                    if cs.is_self and cs.name in methods:
+                        calls.append((n, cs.name, cs.held))
+            # must-held: intersection over every call path from an entry
+            must: Dict[str, Optional[FrozenSet[str]]] = {
+                n: (frozenset() if n in entries else None)
+                for n in methods}
+            changed = True
+            while changed:
+                changed = False
+                for src, dst, held in calls:
+                    if must[src] is None:
+                        continue
+                    contrib = must[src] | frozenset(held)
+                    if must[dst] is None:
+                        must[dst] = contrib
+                        changed = True
+                    elif not must[dst] <= contrib:
+                        must[dst] = must[dst] & contrib
+                        changed = True
+            # reachability: entry -> methods it can run
+            reach: Dict[str, Set[str]] = {}
+            succ: Dict[str, Set[str]] = {}
+            for src, dst, _ in calls:
+                succ.setdefault(src, set()).add(dst)
+            for e in entries:
+                seen = {e}
+                todo = [e]
+                while todo:
+                    for nxt in succ.get(todo.pop(), ()):
+                        if nxt not in seen:
+                            seen.add(nxt)
+                            todo.append(nxt)
+                reach[e] = seen
+            # which thread GROUPS touch each attribute
+            touched: Dict[str, Set[str]] = {}
+            for e in sorted(entries):
+                for m in reach[e]:
+                    for acc in methods[m].accesses:
+                        touched.setdefault(acc.attr, set()).add(
+                            group_of[e])
+            for n in sorted(methods):
+                if n in CONSTRUCTORS or must[n] is None:
+                    continue
+                for acc in methods[n].accesses:
+                    if not acc.is_write:
+                        continue
+                    a = acc.attr
+                    if a in ci.lock_attrs or a in methods:
+                        continue
+                    if frozenset(acc.held) | must[n]:
+                        continue   # guarded, lexically or via call sites
+                    groups = touched.get(a, set())
+                    if len(groups) < 2:
+                        continue   # confined to one thread group
+                    findings.append(self.finding(
+                        ci.rel, acc.node,
+                        f"self.{a} written in {ci.name}.{n}() without a "
+                        f"guarding lock, but touched from "
+                        f"{len(groups)} thread groups "
+                        f"({', '.join(sorted(groups))}) — guard the "
+                        f"write or confine the attribute to one thread"))
+        return findings

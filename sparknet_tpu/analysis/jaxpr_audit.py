@@ -24,7 +24,9 @@ pins zero host transfers in the fused round at N=8 on the CPU mesh.
 
 from __future__ import annotations
 
-from typing import Any, Dict, Iterator, List, Optional
+import json
+import os
+from typing import Any, Dict, Iterator, List, Optional, Tuple
 
 # Primitives that move data or control to the host mid-program.  Names
 # cover current jax (pure_callback/io_callback/debug_callback) and the
@@ -34,6 +36,16 @@ HOST_TRANSFER_PRIMS = frozenset({
     "pure_callback", "io_callback", "debug_callback", "callback",
     "outside_call", "host_callback_call", "host_local_array_to_global",
     "infeed", "outfeed",
+})
+
+# Cross-device collective primitives — the census of these IS the
+# communication schedule of the program.  An extra psum in the fused
+# round means an extra cross-worker reduction every τ steps; contract
+# mode pins the exact count and byte volume.
+COLLECTIVE_PRIMS = frozenset({
+    "psum", "psum2", "all_gather", "all_gather_invariant", "ppermute",
+    "all_to_all", "reduce_scatter", "psum_scatter", "pmin", "pmax",
+    "pbroadcast",
 })
 
 _FLOAT_KINDS = ("float16", "bfloat16", "float32", "float64")
@@ -86,6 +98,10 @@ def audit_jaxpr(closed_jaxpr: Any) -> Dict[str, Any]:
     """Audit one traced program; returns a JSON-ready report:
 
     - host_transfers: {primitive_name: count} over HOST_TRANSFER_PRIMS
+    - collectives: {primitive_name: {"count": n, "bytes": b}} over
+      COLLECTIVE_PRIMS — `bytes` is the per-invocation input volume
+      (sum of array invar sizes x dtype itemsize), the wire-volume
+      proxy contract mode pins
     - convert_edges: float->float convert_element_type edges with
       direction (upcast/downcast/width-preserving like f16<->bf16)
     - weak_type_invars / weak_type_consts: jit-cache fragmentation
@@ -93,6 +109,7 @@ def audit_jaxpr(closed_jaxpr: Any) -> Dict[str, Any]:
     - n_eqns: total eqn count (recursive), a coarse program-size stamp
     """
     host: Dict[str, int] = {}
+    coll: Dict[str, Dict[str, int]] = {}
     edges: Dict[tuple, int] = {}
     n_eqns = 0
     for eqn in iter_eqns(closed_jaxpr):
@@ -100,6 +117,11 @@ def audit_jaxpr(closed_jaxpr: Any) -> Dict[str, Any]:
         prim = eqn.primitive.name
         if prim in HOST_TRANSFER_PRIMS:
             host[prim] = host.get(prim, 0) + 1
+        elif prim in COLLECTIVE_PRIMS:
+            c = coll.setdefault(prim, {"count": 0, "bytes": 0})
+            c["count"] += 1
+            c["bytes"] += sum(_aval_bytes(v.aval) for v in eqn.invars
+                              if hasattr(v, "aval"))
         elif prim == "convert_element_type":
             src = eqn.invars[0].aval
             src_name = getattr(getattr(src, "dtype", None), "name", None)
@@ -124,12 +146,21 @@ def audit_jaxpr(closed_jaxpr: Any) -> Dict[str, Any]:
     return {
         "n_eqns": n_eqns,
         "host_transfers": dict(sorted(host.items())),
+        "collectives": {k: dict(v) for k, v in sorted(coll.items())},
         "convert_edges": [
             {"from": s, "to": d, "direction": direction(s, d), "count": c}
             for (s, d), c in sorted(edges.items())],
         "weak_type_invars": weak_invars,
         "weak_type_consts": weak_consts,
     }
+
+
+def _aval_bytes(aval: Any) -> int:
+    size = getattr(aval, "size", None)
+    dtype = getattr(aval, "dtype", None)
+    if size is None or dtype is None:
+        return 0
+    return int(size) * int(getattr(dtype, "itemsize", 0))
 
 
 def audit_fn(fn, *args, **kwargs) -> Dict[str, Any]:
@@ -245,3 +276,119 @@ def findings_from_report(report: Dict[str, Any],
             out.append(f"{prog}: {e['count']}x {e['direction']} "
                        f"{e['from']}->{e['to']}")
     return out
+
+
+# ----------------------------------------------------- program contracts
+
+CONTRACTS_VERSION = 1
+
+# Contract fields: the STABLE invariants of a program — its
+# communication schedule, host coupling, and precision edges.  n_eqns is
+# deliberately NOT in the contract (it shifts with every jax upgrade and
+# fusion-pass tweak; pinning it would make contracts cry wolf).
+_CONTRACT_FIELDS = ("host_transfers", "collectives", "convert_edges",
+                    "weak_type_invars", "weak_type_consts")
+
+
+def contract_key(report: Dict[str, Any]) -> str:
+    """Stable identity of one audited program configuration."""
+    prog = report.get("program", "program")
+    if prog == "training_round":
+        return (f"training_round[workers={report['workers']},"
+                f"tau={report['tau']}]")
+    if prog == "serving_forward":
+        quant = report.get("quant") or "none"
+        return (f"serving_forward[model={report['model']},"
+                f"bucket={report['bucket']},quant={quant}]")
+    return prog
+
+
+def contract_from_report(report: Dict[str, Any]) -> Dict[str, Any]:
+    """The contract entry for one audit report (stable fields only)."""
+    return {f: report[f] for f in _CONTRACT_FIELDS}
+
+
+def diff_contracts(expected: Dict[str, Any],
+                   actual: Dict[str, Any]) -> List[str]:
+    """Human-readable drift between two contract entries; each line
+    names the drifted field as a dotted path, expected -> actual."""
+    out: List[str] = []
+
+    def walk(path: str, e: Any, a: Any) -> None:
+        if isinstance(e, dict) and isinstance(a, dict):
+            for k in sorted(set(e) | set(a)):
+                p = f"{path}.{k}" if path else str(k)
+                if k not in e:
+                    out.append(f"{p}: not in contract, now {a[k]!r}")
+                elif k not in a:
+                    out.append(f"{p}: contract has {e[k]!r}, now absent")
+                else:
+                    walk(p, e[k], a[k])
+            return
+        if isinstance(e, list) and isinstance(a, list):
+            # convert_edges: key rows by (from, to) so a message names
+            # the edge, not a list index
+            def keyed(rows: List[Any]) -> Optional[Dict[str, Any]]:
+                if all(isinstance(r, dict) and "from" in r and "to" in r
+                       for r in rows):
+                    return {f"{r['from']}->{r['to']}": r for r in rows}
+                return None
+            ek, ak = keyed(e), keyed(a)
+            if ek is not None and ak is not None:
+                walk(path, ek, ak)
+                return
+            if e != a:
+                out.append(f"{path}: contract has {e!r}, now {a!r}")
+            return
+        if e != a:
+            out.append(f"{path}: contract has {e!r}, now {a!r}")
+
+    walk("", expected, actual)
+    return out
+
+
+def load_contracts(path: str) -> Dict[str, Any]:
+    """Parse CONTRACTS.json; malformed input dies with a file-naming
+    ValueError (the repo-wide parser contract, R002's runtime face)."""
+    try:
+        with open(path) as f:
+            data = json.load(f)
+    except json.JSONDecodeError as e:
+        raise ValueError(f"{path}: malformed contracts file: {e}") from e
+    if not isinstance(data, dict) or "programs" not in data:
+        raise ValueError(f"{path}: malformed contracts file: expected an "
+                         f"object with a 'programs' key")
+    return data
+
+
+def check_contract(report: Dict[str, Any], contracts: Dict[str, Any],
+                   ) -> List[str]:
+    """Violations (empty = pass) of one report against the committed
+    contracts; a program with no committed entry is itself a violation
+    (contracts are allow-listed, never inferred at check time)."""
+    key = contract_key(report)
+    entry = contracts.get("programs", {}).get(key)
+    if entry is None:
+        return [f"{key}: no committed contract (run --update-contracts "
+                f"and review the diff)"]
+    return [f"{key}: {line}"
+            for line in diff_contracts(entry, contract_from_report(report))]
+
+
+def update_contracts(path: str, reports: List[Dict[str, Any]],
+                     ) -> Dict[str, Any]:
+    """Merge `reports` into the contracts file (existing entries for
+    other programs survive) and rewrite it deterministically."""
+    if os.path.exists(path):
+        data = load_contracts(path)
+    else:
+        data = {"version": CONTRACTS_VERSION, "programs": {}}
+    for report in reports:
+        data["programs"][contract_key(report)] = \
+            contract_from_report(report)
+    data["programs"] = dict(sorted(data["programs"].items()))
+    data["version"] = CONTRACTS_VERSION
+    with open(path, "w") as f:
+        json.dump(data, f, indent=2, sort_keys=True)
+        f.write("\n")
+    return data

@@ -23,6 +23,11 @@ Each rule replaces (and strengthens) a hand-rolled regex pin:
   (run/call/check_call/check_output) must pass `timeout=`, and a module
   holding a Popen must contain a kill path, so no spawned child can
   hang the caller forever (the wedged-tunnel failure mode generalized).
+- R007/R008/R009 (analysis/concurrency.py) — the interprocedural
+  concurrency pass over the whole-package call graph
+  (analysis/callgraph.py): lock-order cycles, blocking work reached
+  transitively under a held lock (R005 generalized), and unguarded
+  writes to attributes shared across thread entry points.
 
 Full catalog with rationale and suppression syntax: ANALYSIS.md.
 """
@@ -627,6 +632,8 @@ class SubprocessDisciplineRule(Rule):
 
 
 def default_rules() -> List[Rule]:
+    from .concurrency import (BlockingUnderLockRule, LockOrderRule,
+                              SharedStateRule)
     return [
         ClockDisciplineRule(),
         ParserErrorContractRule(),
@@ -634,4 +641,7 @@ def default_rules() -> List[Rule]:
         KnobRegistryRule(),
         LockDisciplineRule(),
         SubprocessDisciplineRule(),
+        LockOrderRule(),
+        BlockingUnderLockRule(),
+        SharedStateRule(),
     ]

@@ -40,7 +40,11 @@ def get_library() -> ctypes.CDLL:
         if _lib is not None:
             return _lib
         if not os.path.exists(_LIB_PATH):
-            _build_library()
+            # intentional blocking-under-lock: the whole point of the
+            # singleton is that ONE caller builds (bounded by make's
+            # 600 s timeout) while every other caller waits for the
+            # finished library instead of racing a second make
+            _build_library()  # sparknet: noqa[R008]
         lib = ctypes.CDLL(_LIB_PATH)
         lib.snt_loader_create.restype = ctypes.c_void_p
         lib.snt_loader_create.argtypes = [

@@ -160,14 +160,16 @@ def main(argv=None) -> int:
     _force_cpu()
 
     stop_beat = threading.Event()
+    beat_thread = None
     hb = cfg.get("heartbeat_path")
     if hb:
         with open(hb, "a"):
             pass
-        threading.Thread(target=_beat,
-                         args=(hb, float(cfg.get("heartbeat_s", 0.25)),
-                               stop_beat),
-                         daemon=True, name="proc-worker-heartbeat").start()
+        beat_thread = threading.Thread(
+            target=_beat,
+            args=(hb, float(cfg.get("heartbeat_s", 0.25)), stop_beat),
+            daemon=True, name="proc-worker-heartbeat")
+        beat_thread.start()
 
     builder = cfg.get("builder", "toy")
     if builder == "toy":
@@ -219,6 +221,11 @@ def main(argv=None) -> int:
         loss = solver.step(int(cmd.get("tau", cfg.get("tau", 1))))
         _write_report(cmd["report"], int(cmd["round"]), solver, loss)
     stop_beat.set()
+    if beat_thread is not None:
+        # bounded: the beat loop wakes on the event within one period,
+        # so this returns promptly; the timeout only caps a touch stuck
+        # on a dead filesystem
+        beat_thread.join(timeout=2.0)
     return 0
 
 

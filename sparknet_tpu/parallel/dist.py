@@ -384,8 +384,12 @@ class DistributedSolver:
         # validate BEFORE mutating: a caller that catches the ValueError
         # must not be left with the unsafe composition armed
         self._check_prefetch_safe(prefetch=self._prefetch, sources=sources)
-        self.train_sources = sources
+        # close FIRST: _close_ingest() joins the staging coordinator, so
+        # the swap below happens strictly after the last pull from the
+        # old sources (swapping first could hand a mid-stage round a mix
+        # of old and new streams)
         self._close_ingest()  # staged rounds came from the old sources
+        self.train_sources = sources  # sparknet: noqa[R009] — coordinator joined above; no stage thread is live across this write
 
     def _check_prefetch_safe(self, *, prefetch: Optional[bool] = None,
                              sources=None) -> None:
@@ -462,9 +466,15 @@ class DistributedSolver:
 
             if self._pull_pool is not None:
                 self._pull_pool.shutdown(wait=False)
-            self._pull_pool = cf.ThreadPoolExecutor(
+            # staging is single-threaded by protocol: _map_workers runs
+            # only inside _stage_round, which executes either inline (no
+            # prefetch) or on the ONE ingest coordinator — and arming /
+            # disarming transitions join the coordinator (_close_ingest)
+            # before the other mode stages, so this lazy build never
+            # races itself
+            self._pull_pool = cf.ThreadPoolExecutor(  # sparknet: noqa[R009]
                 max_workers=n_pull, thread_name_prefix="sparknet-pull")
-            self._pull_pool_size = n_pull
+            self._pull_pool_size = n_pull  # sparknet: noqa[R009] — same staging-thread confinement as the pool itself
         return list(self._pull_pool.map(fn, workers))
 
     def _stage_round(self, round_idx: int):
@@ -497,7 +507,10 @@ class DistributedSolver:
         # worker's time after membership changed (written per-worker below;
         # distinct keys, so concurrent pool writes don't race)
         stage_s: Dict[int, float] = {}
-        self._stage_worker_s = stage_s
+        # deliberate publish-by-reference-swap: the deadline hook (public
+        # thread) reads whatever map is current; a torn read sees either
+        # the old complete map or the new empty one, never a mix
+        self._stage_worker_s = stage_s  # sparknet: noqa[R009]
 
         def stage_worker(w: int):
             src = self.train_sources[w]
@@ -567,7 +580,10 @@ class DistributedSolver:
         if depth is not None:
             self._prefetch_depth = int(depth)
         if pull_workers is not None:
-            self._pull_workers = max(1, int(pull_workers))
+            # GIL-atomic int store, read by _map_workers only at round
+            # START (a whole staging pass sees one value); reconfiguring
+            # mid-round takes effect next round — by design
+            self._pull_workers = max(1, int(pull_workers))  # sparknet: noqa[R009]
         if not on and self._ingest_exec is not None:
             self._ingest_exec.stop_staging()
 

@@ -179,9 +179,13 @@ class ReplicaScheduler:
                     flushed.extend(dq)
                     dq.clear()
             self._cv.notify_all()
+        # bounded join: a worker stuck in device math (wedged tunnel)
+        # must not hang shutdown forever — the threads are daemonic, so
+        # after the timeout they die with the process; 30 s matches the
+        # ingest executor's close() bound
         for t in self._threads:
             if t is not threading.current_thread():
-                t.join()
+                t.join(timeout=30.0)
         return flushed
 
     # --------------------------------------------------------------- observe

