@@ -529,6 +529,53 @@ def bench_elastic(rounds: int = 6):
     return out
 
 
+def bench_trainserve():
+    """Train-while-serve loop via `scripts/trainserve_run.py --smoke` in
+    a subprocess: a lenet trainer subprocess publishing gated snapshot
+    generations, a live InferenceServer under seeded open-loop load, and
+    the PromotionWatcher hot-swapping each promoted generation into the
+    replica set — the record carries promotions, staleness mean/max,
+    the swap-induced p99 delta, and the zero-drop bar (dropped must be
+    0 across generation swaps or the leg raises).
+
+    A subprocess because the trainer itself is a subprocess and the
+    scenario wants a clean CPU backend; re-raises on a non-zero exit or
+    a not-ok line so the guarded leg in _run_legs omits the fields."""
+    import subprocess
+
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    script = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "scripts", "trainserve_run.py")
+    proc = subprocess.run(
+        [sys.executable, script, "--smoke", "--corrupt_at", "1"],
+        capture_output=True, text=True, env=env, timeout=900)
+    if proc.returncode != 0:
+        raise RuntimeError(
+            f"trainserve_run.py exited {proc.returncode}: "
+            f"{proc.stderr.strip()[-500:]}")
+    # trainserve_run prints ONE JSON line on stdout (chaos_run contract)
+    rec = json.loads(proc.stdout.strip().splitlines()[-1])
+    if not rec.get("ok"):
+        raise RuntimeError(f"trainserve_run.py reported not-ok: {rec}")
+    if rec.get("dropped"):
+        raise RuntimeError(
+            f"trainserve dropped {rec['dropped']} requests across "
+            f"generation swaps: {rec}")
+    out = {"trainserve_promotions": int(rec["promotions"]),
+           "trainserve_rejections": int(rec["rejections"]),
+           "trainserve_staleness_mean": rec["staleness_mean"],
+           "trainserve_staleness_max": rec["staleness_max"],
+           "trainserve_swap_p99_delta_ms": rec["swap_p99_delta_ms"],
+           "trainserve_dropped": int(rec["dropped"]),
+           "trainserve_completed": int(rec["completed"]),
+           "trainserve_generations": int(rec["generations"]),
+           "trainserve_agreement_mean": rec["agreement_mean"],
+           "trainserve_traffic_records": int(rec["traffic_records"])}
+    log(json.dumps(out))
+    return out
+
+
 def bench_longctx_lm(seq_len: int = 16384, n_layers: int = 4,
                      d_model: int = 512, heads: int = 8,
                      block: int = 1024):
@@ -813,6 +860,13 @@ _KNOWN_FIELDS = {
     "elastic_proc_quorums", "elastic_proc_crashes",
     "elastic_proc_restarts", "elastic_proc_join_source",
     "elastic_proc_torn_skipped",
+    # train-while-serve loop (schema v5): live trainer subprocess +
+    # promotion watcher + served-traffic capture, zero-drop bar
+    "trainserve_promotions", "trainserve_rejections",
+    "trainserve_staleness_mean", "trainserve_staleness_max",
+    "trainserve_swap_p99_delta_ms", "trainserve_dropped",
+    "trainserve_completed", "trainserve_generations",
+    "trainserve_agreement_mean", "trainserve_traffic_records",
 }
 
 # every leg name main() lands; leg_utc stamps outside this set (renamed
@@ -822,7 +876,7 @@ _KNOWN_LEGS = {
     "alexnet_train", "googlenet_train_b64", "googlenet_train_b128",
     "alexnet_infer", "googlenet_infer", "longctx_lm", "cifar_e2e",
     "imagenet_native", "serving", "serving_int8", "serving_mesh",
-    "elastic",
+    "elastic", "trainserve",
 }
 
 
@@ -905,7 +959,11 @@ def _stale_record(reason: str) -> dict:
     return stale
 
 
-BENCH_SCHEMA_VERSION = 4  # v4: elastic leg gains the process-level arm
+BENCH_SCHEMA_VERSION = 5  # v5: trainserve leg (train-while-serve loop —
+#                           promotions, staleness mean/max, swap p99
+#                           delta, dropped==0 bar; trainserve_run.py
+#                           subprocess);
+#                           v4: elastic leg gains the process-level arm
 #                           (elastic_proc_* — real subprocess workers,
 #                           SIGKILL chaos, snapshot catch-up join);
 #                           v3: serving replica/topology stamps + the
@@ -1229,6 +1287,19 @@ def _run_legs(land) -> None:
             "elastic_crashes", "elastic_tau_final",
             "elastic_full_barrier_stall_s", "elastic_quorum_stall_s",
             "elastic_stall_ratio")})
+    # train-while-serve loop (subprocess; CPU path like the serving and
+    # elastic legs) — promotions + zero-drop bar across generation swaps
+    try:
+        trainserve = bench_trainserve()
+    except Exception as e:
+        log(f"trainserve leg failed, omitting its fields: {e!r}")
+    else:
+        land("trainserve", {k: trainserve[k] for k in (
+            "trainserve_promotions", "trainserve_rejections",
+            "trainserve_staleness_mean", "trainserve_staleness_max",
+            "trainserve_swap_p99_delta_ms", "trainserve_dropped",
+            "trainserve_completed", "trainserve_generations",
+            "trainserve_agreement_mean", "trainserve_traffic_records")})
     try:
         imgnet_native = bench_imagenet_native()
     except Exception as e:

@@ -11,9 +11,12 @@
 #   scripts/lint_gate.sh --select R001,R004    # subset of rules
 #   scripts/lint_gate.sh --jaxpr round         # + trace the fused round
 # Set SPARKNET_LINT_GATE_NO_PROC=1 to skip the smoke (lint-only, e.g.
-# on a box where fork/subprocess is forbidden) and
+# on a box where fork/subprocess is forbidden),
 # SPARKNET_LINT_GATE_NO_CONTRACT=1 to skip the jaxpr program-contract
-# check (needs the toy-solver deps + an 8-device CPU mesh to trace).
+# check (needs the toy-solver deps + an 8-device CPU mesh to trace),
+# and SPARKNET_LINT_GATE_NO_TRAINSERVE=1 to skip the train-while-serve
+# smoke (scripts/trainserve_run.py: tiny lenet trainer subprocess + live
+# server, >= 2 hot promotions with dropped_requests == 0).
 set -euo pipefail
 cd "$(dirname "$0")/.."
 python -m sparknet_tpu.cli lint --format json "$@"
@@ -29,4 +32,11 @@ fi
 if [ "${SPARKNET_LINT_GATE_NO_PROC:-0}" != "1" ]; then
     timeout -k 10 420 env JAX_PLATFORMS=cpu \
         python scripts/chaos_run.py --proc --no_smoke
+fi
+if [ "${SPARKNET_LINT_GATE_NO_TRAINSERVE:-0}" != "1" ]; then
+    # train-while-serve smoke: tiny lenet, 2 gated promotions into the
+    # live replica set, assert dropped_requests == 0 (--smoke exits
+    # non-zero on a miss; prints ONE JSON line)
+    timeout -k 10 420 env JAX_PLATFORMS=cpu \
+        python scripts/trainserve_run.py --smoke
 fi

@@ -20,7 +20,11 @@ with `--jsonl out.jsonl` it also appends one record per request (id,
 model, replica, bucket, queue_wait/assembly/device/total ms, or the
 rejection error) — commit those incrementally
 (scripts/autocommit_distacc.sh pattern) so a box reboot cannot eat an
-in-flight study.
+in-flight study.  `--log DIR` additionally records the served
+request/response stream as TrafficLogger shards
+(sparknet_tpu/deploy/traffic.py format): sample + served argmax +
+serving generation, re-ingestable as a training feed
+(`deploy.traffic.traffic_feed` — the train-while-serve reverse edge).
 
 Examples:
     python scripts/serve_loadgen.py --model lenet --mode open --qps 200
@@ -95,6 +99,11 @@ def main() -> None:
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--jsonl", default=None,
                    help="append one record per request to this file")
+    p.add_argument("--log", default=None,
+                   help="also record the served request/response stream "
+                        "as TrafficLogger shards under this directory "
+                        "(sparknet_tpu/deploy/traffic.py format — "
+                        "re-ingestable as a training feed)")
     a = p.parse_args()
     if a.model and a.models:
         raise SystemExit("pass --model OR --models, not both")
@@ -132,6 +141,12 @@ def main() -> None:
     if a.min_fill is not None:
         cfg.min_fill = a.min_fill
     server = InferenceServer(cfg)
+    traffic = None
+    if a.log:
+        from sparknet_tpu.deploy.traffic import TrafficLogger
+
+        traffic = TrafficLogger(a.log,
+                                model=a.model if not a.models else None)
     rejects = {"n": 0}
     rejects_lock = threading.Lock()
 
@@ -163,6 +178,13 @@ def main() -> None:
                              seed=a.seed, replicas=a.replicas)
             shape = lm.runner.sample_shape
             pools[name] = rng.rand(64, *shape).astype(np.float32)
+            if traffic is not None:
+                # tap the delivery path itself (batcher-thread hook), so
+                # the log holds exactly what was SERVED — argmax label +
+                # the generation that answered, in delivery order
+                server.add_response_hook(
+                    name, lambda s, r: traffic.log(
+                        s, r.argmax, generation=r.generation))
             log(f"loaded {name}: input {shape}, buckets "
                 f"{lm.runner.buckets}, {lm.n_replicas} replica(s), "
                 f"{lm.runner.compile_count()} compiles/replica")
@@ -230,6 +252,8 @@ def main() -> None:
         stats = server.stats()["models"]
     finally:
         server.close(drain=True)
+        if traffic is not None:
+            traffic.close()  # publish the short tail shard
         if sink is not None:
             sink.close()
 
@@ -275,6 +299,10 @@ def main() -> None:
                     stats[n]["queue_wait_ms"]["p99_ms"]})
     if a.mode == "open":
         out["offered_qps"] = a.qps
+    if traffic is not None:
+        out["traffic_records"] = traffic.records_logged
+        out["traffic_shards"] = traffic.shards_written
+        out["traffic_dir"] = a.log
     print(json.dumps(out), flush=True)
 
 

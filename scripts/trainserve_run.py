@@ -1,0 +1,90 @@
+"""Train-while-serve smoke: trainer subprocess + live server + promotion
+watcher supervised as one run, printing ONE JSON line (the bench.py
+`trainserve` leg subprocess protocol — same contract as chaos_run.py).
+
+Default (smoke) scenario, tuned to finish in well under a minute on one
+CPU core:
+  - a lenet trainer subprocess publishing a bootstrap snapshot + 4
+    generations (deploy/train_driver.py synthetic pattern stream),
+  - an InferenceServer under seeded ~50 qps open-loop load,
+  - the PromotionWatcher hot-promoting each gated generation into the
+    replica set, with the served-traffic logger tapped in.
+
+--smoke asserts the acceptance bar (>= 2 promotions, dropped == 0) and
+exits non-zero on a miss; --corrupt_at N additionally has the trainer
+publish snapshot N corrupted, so the run must ALSO show >= 1 rejection.
+
+Run:  python scripts/trainserve_run.py --smoke [--corrupt_at 1]
+      [--duration_s 120] [--qps 50] [--promotions 2] [--workdir DIR]
+"""
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+
+# force the CPU platform BEFORE any backend use; the box's sitecustomize
+# pre-imports jax, so the live-config update is what actually takes
+# effect (tests/conftest.py pattern)
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="trainserve_run",
+        description="train-while-serve smoke (ONE JSON line on stdout)")
+    ap.add_argument("--smoke", action="store_true",
+                    help="assert the acceptance bar: >= --promotions "
+                         "promotions, dropped == 0 (and >= 1 rejection "
+                         "when --corrupt_at is set)")
+    ap.add_argument("--workdir", default=None)
+    ap.add_argument("--model", default="lenet")
+    ap.add_argument("--duration_s", type=float, default=120.0)
+    ap.add_argument("--qps", type=float, default=50.0)
+    ap.add_argument("--promotions", type=int, default=2)
+    ap.add_argument("--snapshots", type=int, default=4)
+    ap.add_argument("--snapshot_every", type=int, default=8)
+    ap.add_argument("--corrupt_at", type=int, default=None)
+    ap.add_argument("--replicas", type=int, default=1)
+    ap.add_argument("--seed", type=int, default=7)
+    a = ap.parse_args(argv)
+
+    from sparknet_tpu.deploy.session import TrainServeSession
+
+    workdir = a.workdir or tempfile.mkdtemp(prefix="sparknet-trainserve-")
+    session = TrainServeSession(
+        workdir, model=a.model, replicas=a.replicas,
+        qps=a.qps, duration_s=a.duration_s,
+        target_promotions=a.promotions,
+        snapshots=a.snapshots, snapshot_every=a.snapshot_every,
+        warm_iters=8, step_sleep_s=0.5, poll_s=0.1,
+        corrupt_at=a.corrupt_at, traffic_rotate=32, seed=a.seed)
+    summary = session.run()
+    summary["workdir"] = workdir
+    summary["corrupt_at"] = a.corrupt_at
+
+    if a.smoke:
+        problems = []
+        if summary["promotions"] < a.promotions:
+            problems.append(
+                f"promotions {summary['promotions']} < {a.promotions}")
+        if summary["dropped"] != 0:
+            problems.append(f"dropped {summary['dropped']} != 0")
+        if a.corrupt_at is not None and summary["rejections"] < 1:
+            problems.append("corrupted snapshot was not rejected")
+        if problems:
+            summary["ok"] = False
+            summary["problems"] = problems
+    print(json.dumps(summary), flush=True)
+    return 0 if summary.get("ok") else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

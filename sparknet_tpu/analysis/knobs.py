@@ -60,4 +60,16 @@ KNOBS: Dict[str, str] = {
     "SPARKNET_CHAOS_SEED": "default seed for --chaos fault plans",
     "SPARKNET_TAU_MIN": "adaptive-tau controller floor",
     "SPARKNET_TAU_MAX": "adaptive-tau controller ceiling",
+    # -- continuous deployment (train-while-serve)
+    "SPARKNET_DEPLOY_POLL_S": "promotion-watcher snapshot poll period "
+                              "(seconds)",
+    "SPARKNET_DEPLOY_MIN_AGREEMENT": "top-1 agreement floor a candidate "
+                                     "generation must reach to promote",
+    "SPARKNET_DEPLOY_MAX_STALENESS": "snapshot steps the served "
+                                     "generation may lag before a "
+                                     "staleness alert",
+    "SPARKNET_DEPLOY_TRAFFIC_DIR": "served-traffic shard directory "
+                                   "override",
+    "SPARKNET_DEPLOY_TRAFFIC_ROTATE": "served-traffic records per shard "
+                                      "before rotation",
 }

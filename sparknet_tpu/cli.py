@@ -9,10 +9,12 @@ time :290-376, device_query :139-151; brew-verb registry :55-70).
     python -m sparknet_tpu.cli time --model M.prototxt [--iterations N]
     python -m sparknet_tpu.cli device_query
     python -m sparknet_tpu.cli serve --model lenet [< requests.jsonl]
+    python -m sparknet_tpu.cli deploy --model lenet --promotions 2
 
 `serve` (no reference counterpart) fronts a net with the online
 micro-batching engine (serving/) — JSONL requests in, JSONL responses
-out.
+out.  `deploy` supervises a full train-while-serve run: trainer
+subprocess + live server + promotion watcher (deploy/).
 
 Data sources (`--data`): a directory of CIFAR-10 binary batches, or an .npz
 with `data`/`label` arrays.  Nets with in-graph data layers are fed through
@@ -628,6 +630,9 @@ def main(argv=None) -> int:
 
     from .analysis import cli as analysis_cli
     analysis_cli.register(sub)
+
+    from .deploy import cli as deploy_cli
+    deploy_cli.register(sub)
 
     args = p.parse_args(argv)
     return args.fn(args)

@@ -41,8 +41,9 @@ from typing import Any, Callable, List, Optional, Sequence
 
 from ..obs.trace import now_s, span
 
-__all__ = ["PipelinedIngestExecutor", "pooled_map", "shared_pool_size",
-           "default_prefetch_depth", "default_pull_workers"]
+__all__ = ["PipelinedIngestExecutor", "pooled_map", "prefetch_map",
+           "shared_pool_size", "default_prefetch_depth",
+           "default_pull_workers"]
 
 
 def default_prefetch_depth() -> int:
@@ -124,6 +125,32 @@ def pooled_map(fn: Callable[[Any], Any], items: Sequence[Any],
     return list(pool.map(fn, items))
 
 
+def prefetch_map(fn: Callable[[Any], Any], items: Sequence[Any], *,
+                 depth: Optional[int] = None, counters=None):
+    """Ordered generator over `fn(item)` with a depth-k lookahead ring:
+    item i+1..i+depth stage on the coordinator thread while the consumer
+    works on item i.  This is PipelinedIngestExecutor turned into a
+    plain iteration primitive — the deploy traffic feed uses it to keep
+    the next shard's decode hidden behind the solver's step, the same
+    way the solvers hide whole-round staging.  Exceptions surface on the
+    iteration that reaches the failed item (loud-failure contract);
+    the executor is closed when the generator is exhausted or closed."""
+    items = list(items)
+    if not items:
+        return
+    if depth is None:
+        depth = default_prefetch_depth()
+    ex = PipelinedIngestExecutor(lambda r: fn(items[r]),
+                                 depth=max(1, int(depth)),
+                                 counters=counters, limit=len(items),
+                                 name="sparknet-prefetch-map")
+    try:
+        for r in range(len(items)):
+            yield ex.get(expected_round=r)
+    finally:
+        ex.close()
+
+
 # A coordinator thread caught inside a jax call while the interpreter tears
 # the XLA runtime down aborts the whole process ("terminate called without
 # an active exception") — stop every live executor BEFORE teardown.
@@ -154,6 +181,7 @@ class PipelinedIngestExecutor:
 
     def __init__(self, stage_fn: Callable[[int], Any], *, depth: int,
                  counters=None, start_round: int = 0,
+                 limit: Optional[int] = None,
                  name: str = "sparknet-ingest-ring") -> None:
         if depth < 1:
             raise ValueError(f"depth must be >= 1, got {depth}")
@@ -166,7 +194,10 @@ class PipelinedIngestExecutor:
         self._cv = threading.Condition()
         self._next = int(start_round)   # next round index to stage
         self._staging = False           # coordinator mid-stage_fn
-        self._limit: Optional[int] = None
+        # a construction-time limit bounds staging BEFORE the coordinator
+        # thread starts (prefetch_map's finite-item case); stop_staging()
+        # can only lower it afterwards
+        self._limit: Optional[int] = None if limit is None else int(limit)
         self._stop = False
         self._done = False
         self._err: Optional[tuple] = None

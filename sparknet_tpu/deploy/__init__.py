@@ -1,0 +1,42 @@
+"""Train-while-serve: continuous deployment of a live training run.
+
+The circular loop this package closes (README "Train-while-serve"):
+
+    trainer ──save_step──> snapshots/ ──PromotionWatcher──> live server
+       ^                                                        │
+       └──traffic_feed──  traffic/  <──TrafficLogger (hook) ────┘
+
+- `watcher.PromotionWatcher`: polls the snapshot dir, gates each
+  manifest-valid generation on cross-generation top-1 agreement, and
+  hot-swaps the whole replica set (registry reload) with zero dropped
+  requests.
+- `traffic.TrafficLogger` / `traffic.traffic_feed`: served requests as
+  an atomically-rotated shard stream that trains bit-exactly when
+  re-ingested.
+- `session.TrainServeSession`: trainer subprocess + server + watcher +
+  logger supervised as one run (the `sparknet deploy` verb and the
+  bench `trainserve` leg).
+
+Knobs (analysis/knobs.py registry): SPARKNET_DEPLOY_POLL_S,
+SPARKNET_DEPLOY_MIN_AGREEMENT, SPARKNET_DEPLOY_MAX_STALENESS,
+SPARKNET_DEPLOY_TRAFFIC_DIR, SPARKNET_DEPLOY_TRAFFIC_ROTATE.
+"""
+
+from .traffic import (TrafficLogger, list_shards, read_shard,
+                      read_traffic_log, traffic_feed)
+from .watcher import PromotionWatcher, write_weights_npz
+
+__all__ = [
+    "TrafficLogger", "list_shards", "read_shard", "read_traffic_log",
+    "traffic_feed", "PromotionWatcher", "write_weights_npz",
+    "TrainServeSession",
+]
+
+
+def __getattr__(name):
+    # session imports serving lazily; keep package import light
+    if name == "TrainServeSession":
+        from .session import TrainServeSession
+
+        return TrainServeSession
+    raise AttributeError(name)

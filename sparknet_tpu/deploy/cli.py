@@ -1,0 +1,92 @@
+"""The `deploy` CLI verb: one supervised train-while-serve run.
+
+    python -m sparknet_tpu.cli deploy --model lenet --workdir /tmp/ts \\
+        --duration_s 60 --qps 40 --promotions 2
+
+Spawns the snapshotting trainer subprocess, serves the model with the
+online engine, and hot-promotes each gated snapshot generation into the
+live replica set (deploy/session.py).  SIGINT = drain-then-stop via
+utils/signals: stop admitting new load, settle every admitted future,
+snapshot-stop the trainer, exit 0 — nothing is dropped on a ctrl-C.
+
+Prints ONE summary JSON line (the bench trainserve leg's schema).
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import tempfile
+
+
+def cmd_deploy(args) -> int:
+    from ..utils.signals import SignalHandler, SolverAction
+    from .session import TrainServeSession
+
+    workdir = args.workdir or tempfile.mkdtemp(prefix="sparknet-deploy-")
+    handler = SignalHandler(
+        sigint_effect=SolverAction.STOP,
+        sighup_effect=SolverAction.NONE).install()
+    session = TrainServeSession(
+        workdir, model=args.model, replicas=args.replicas,
+        max_batch=args.max_batch, qps=args.qps,
+        duration_s=args.duration_s,
+        target_promotions=args.promotions,
+        snapshots=args.snapshots,
+        snapshot_every=args.snapshot_every,
+        warm_iters=args.warm_iters, train_batch=args.train_batch,
+        step_sleep_s=args.step_sleep_s, corrupt_at=args.corrupt_at,
+        poll_s=args.poll_s, min_agreement=args.min_agreement,
+        max_staleness=args.max_staleness, seed=args.seed,
+        action_source=handler)
+    summary = session.run()
+    summary["workdir"] = workdir
+    print(json.dumps(summary), flush=True)
+    if not summary.get("ok"):
+        print(f"deploy run not ok: dropped={summary.get('dropped')} "
+              f"promotions={summary.get('promotions')} "
+              f"(events: {session.event_log})", file=sys.stderr)
+        return 1
+    return 0
+
+
+def register(sub) -> None:
+    d = sub.add_parser(
+        "deploy",
+        help="train-while-serve: trainer subprocess + live server + "
+             "promotion watcher in one supervised run")
+    d.add_argument("--model", default="lenet",
+                   help="model-zoo name with both train and deploy forms")
+    d.add_argument("--workdir",
+                   help="run directory (snapshots/, traffic/, "
+                        "weights.npz, deploy_events.jsonl); default a "
+                        "fresh temp dir")
+    d.add_argument("--replicas", type=int, default=1)
+    d.add_argument("--max_batch", type=int, default=4)
+    d.add_argument("--qps", type=float, default=40.0)
+    d.add_argument("--duration_s", type=float, default=60.0,
+                   help="hard deadline; the run ends early once "
+                        "--promotions generations promoted")
+    d.add_argument("--promotions", type=int, default=2,
+                   help="generation-swap target before stopping")
+    d.add_argument("--snapshots", type=int, default=4,
+                   help="trainer snapshot generations beyond bootstrap")
+    d.add_argument("--snapshot_every", type=int, default=12,
+                   help="trainer iterations between snapshots")
+    d.add_argument("--warm_iters", type=int, default=10)
+    d.add_argument("--train_batch", type=int, default=16)
+    d.add_argument("--step_sleep_s", type=float, default=0.0)
+    d.add_argument("--corrupt_at", type=int,
+                   help="trainer publishes THIS snapshot step corrupted "
+                        "(the agreement gate must reject it)")
+    d.add_argument("--poll_s", type=float,
+                   help="watcher poll period "
+                        "(default SPARKNET_DEPLOY_POLL_S)")
+    d.add_argument("--min_agreement", type=float,
+                   help="promotion agreement floor "
+                        "(default SPARKNET_DEPLOY_MIN_AGREEMENT)")
+    d.add_argument("--max_staleness", type=int,
+                   help="staleness-alert threshold in snapshot steps "
+                        "(default SPARKNET_DEPLOY_MAX_STALENESS)")
+    d.add_argument("--seed", type=int, default=7)
+    d.set_defaults(fn=cmd_deploy)

@@ -1,0 +1,308 @@
+"""One supervised train-while-serve run: trainer subprocess + inference
+server + promotion watcher + traffic logger, wired into the circular
+loop and torn down in the right order.
+
+The session owns the workdir layout::
+
+    workdir/
+      snapshots/           save_step generations from the trainer
+      traffic/             TrafficLogger shards (the reverse edge)
+      weights.npz          atomically-rewritten promoted weights
+      deploy_events.jsonl  promote/reject/staleness/swap_spike stream
+      trainer.out/.err     trainer subprocess stdio
+
+Lifecycle (also the `sparknet deploy` verb's body):
+
+1. spawn the trainer (`deploy/train_driver`) as a detached process group
+   — the one Popen in the session, with the full R006 kill ladder
+   (SIGINT drain -> wait -> terminate -> kill);
+2. watcher.bootstrap(): block for the trainer's FIRST committed
+   snapshot, publish it as weights.npz;
+3. server.load() warm-starts from those weights, TrafficLogger taps in
+   via add_response_hook, watcher.start() begins polling;
+4. open-loop seeded load until the promotion target / deadline, then
+   settle every future — an unresolved or errored future counts as a
+   DROPPED request, and the acceptance bar is dropped == 0 across
+   generation swaps;
+5. teardown in reverse (watcher, trainer, server-drain, traffic flush)
+   and return one summary dict (the bench trainserve leg's payload).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time  # sleep only; timing goes through obs.trace.now_s
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from ..obs.trace import now_s
+from .traffic import TrafficLogger, default_traffic_dir
+from .watcher import PromotionWatcher
+
+
+def _read_last_json_line(path: str) -> Optional[dict]:
+    try:
+        with open(path) as f:
+            lines = [ln.strip() for ln in f if ln.strip()]
+    except OSError:
+        return None
+    for ln in reversed(lines):
+        try:
+            return json.loads(ln)
+        except ValueError:
+            continue
+    return None
+
+
+class TrainServeSession:
+    """Run the full loop once and report.  Single-use: construct,
+    `run()`, read the summary."""
+
+    def __init__(self, workdir: str, *, model: str = "lenet",
+                 replicas: int = 1, max_batch: int = 4,
+                 qps: float = 60.0, duration_s: float = 60.0,
+                 target_promotions: int = 2,
+                 snapshots: int = 4, snapshot_every: int = 12,
+                 warm_iters: int = 10, train_batch: int = 16,
+                 step_sleep_s: float = 0.0,
+                 corrupt_at: Optional[int] = None,
+                 poll_s: Optional[float] = None,
+                 min_agreement: Optional[float] = None,
+                 max_staleness: Optional[int] = None,
+                 gate_batches: int = 2,
+                 traffic_rotate: Optional[int] = None,
+                 seed: int = 7, action_source=None) -> None:
+        self.workdir = str(workdir)
+        self.model = model
+        self.replicas = int(replicas)
+        self.max_batch = int(max_batch)
+        self.qps = float(qps)
+        self.duration_s = float(duration_s)
+        self.target_promotions = int(target_promotions)
+        self.snapshots = int(snapshots)
+        self.snapshot_every = int(snapshot_every)
+        self.warm_iters = int(warm_iters)
+        self.train_batch = int(train_batch)
+        self.step_sleep_s = float(step_sleep_s)
+        self.corrupt_at = corrupt_at
+        self.poll_s = poll_s
+        self.min_agreement = min_agreement
+        self.max_staleness = max_staleness
+        self.gate_batches = int(gate_batches)
+        self.traffic_rotate = traffic_rotate
+        self.seed = int(seed)
+        # utils/signals.SignalHandler (or anything with
+        # get_requested_action): STOP/SNAPSHOT_STOP = drain-then-stop
+        self.action_source = action_source
+
+        self.snapshot_dir = os.path.join(self.workdir, "snapshots")
+        self.traffic_dir = (default_traffic_dir()
+                            or os.path.join(self.workdir, "traffic"))
+        self.weights_path = os.path.join(self.workdir, "weights.npz")
+        self.event_log = os.path.join(self.workdir, "deploy_events.jsonl")
+        self.trainer: Optional[subprocess.Popen] = None
+        self.watcher: Optional[PromotionWatcher] = None
+        self.responses: List[Any] = []
+        self._stop_requested = False
+
+    # -------------------------------------------------------------- trainer
+    def _spawn_trainer(self) -> subprocess.Popen:
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        env.setdefault("PYTHONPATH", os.getcwd())
+        cmd = [sys.executable, "-m", "sparknet_tpu.deploy.train_driver",
+               "--model", self.model,
+               "--snapshot_dir", self.snapshot_dir,
+               "--snapshots", str(self.snapshots),
+               "--snapshot_every", str(self.snapshot_every),
+               "--warm_iters", str(self.warm_iters),
+               "--batch", str(self.train_batch),
+               "--seed", str(self.seed),
+               "--step_sleep_s", str(self.step_sleep_s)]
+        if self.corrupt_at is not None:
+            cmd += ["--corrupt_at", str(int(self.corrupt_at))]
+        out = open(os.path.join(self.workdir, "trainer.out"), "w")
+        err = open(os.path.join(self.workdir, "trainer.err"), "w")
+        try:
+            # own process group: the session's SIGINT must not fan out
+            # to the trainer before the drain path decides to send it
+            proc = subprocess.Popen(cmd, stdout=out, stderr=err,
+                                    start_new_session=True, env=env)
+        finally:
+            out.close()
+            err.close()
+        return proc
+
+    def _stop_trainer(self, *, timeout_s: float = 30.0) -> Optional[int]:
+        """R006 kill ladder: polite SIGINT (snapshot-then-stop), then
+        terminate, then kill — the trainer can never outlive the
+        session."""
+        proc = self.trainer
+        if proc is None:
+            return None
+        if proc.poll() is None:
+            try:
+                proc.send_signal(signal.SIGINT)
+            except OSError:
+                pass
+            try:
+                proc.wait(timeout=timeout_s)
+            except subprocess.TimeoutExpired:
+                proc.terminate()
+                try:
+                    proc.wait(timeout=5.0)
+                except subprocess.TimeoutExpired:
+                    proc.kill()
+                    proc.wait(timeout=5.0)
+        return proc.returncode
+
+    # ------------------------------------------------------------ load loop
+    def request_stop(self) -> None:
+        """Drain-then-stop (the deploy verb's SIGINT effect): the load
+        loop exits at its next tick; teardown settles every admitted
+        future before anything is torn down."""
+        self._stop_requested = True
+
+    def _open_loop(self, server, lm) -> Dict[str, int]:
+        """Seeded open-loop load against the live model: submit at
+        ~qps until the promotion target (plus a post-swap tail so the
+        swap-spike histogram has post-generation samples) or the
+        deadline, collecting every future."""
+        from ..serving.errors import ServingError
+
+        rng = np.random.RandomState(self.seed ^ 0x10AD)
+        pool = [rng.rand(*lm.runner.sample_shape).astype(np.float32)
+                for _ in range(64)]
+        period = 1.0 / max(1e-6, self.qps)
+        deadline = now_s() + self.duration_s
+        futures: List[Any] = []
+        overloaded = 0
+        i = 0
+        tail = None
+        while now_s() < deadline and not self._stop_requested:
+            try:
+                futures.append(server.submit(self.model,
+                                             pool[i % len(pool)]))
+            except ServingError:
+                overloaded += 1
+            i += 1
+            if self.action_source is not None:
+                action = self.action_source.get_requested_action()
+                if action.name in ("STOP", "SNAPSHOT_STOP"):
+                    self.request_stop()
+            w = self.watcher
+            if (tail is None and w is not None
+                    and w.c_promotions.value >= self.target_promotions):
+                # promotion target met: serve a short tail so the last
+                # swap's post-generation p99 means something
+                tail = min(deadline,
+                           now_s() + max(1.0, 32 * period))
+            if tail is not None and now_s() >= tail:
+                break
+            time.sleep(period)  # open-loop pacing only
+        self._futures = futures
+        return {"submitted": len(futures), "overloaded": overloaded}
+
+    def _settle(self, timeout_s: float = 30.0) -> Dict[str, Any]:
+        """Resolve every admitted future.  Anything that raises or never
+        resolves is a DROPPED request — the acceptance bar across
+        generation swaps is dropped == 0."""
+        dropped = 0
+        per_gen: Dict[int, int] = {}
+        deadline = now_s() + timeout_s
+        for fut in getattr(self, "_futures", []):
+            try:
+                resp = fut.result(timeout=max(0.1, deadline - now_s()))
+            except Exception:
+                dropped += 1
+                continue
+            self.responses.append(resp)
+            per_gen[resp.generation] = per_gen.get(resp.generation, 0) + 1
+        return {"completed": len(self.responses), "dropped": dropped,
+                "per_generation": {str(k): v
+                                   for k, v in sorted(per_gen.items())}}
+
+    # ------------------------------------------------------------------ run
+    def run(self) -> Dict[str, Any]:
+        from ..serving.server import InferenceServer, ServerConfig
+
+        os.makedirs(self.snapshot_dir, exist_ok=True)
+        os.makedirs(self.traffic_dir, exist_ok=True)
+        t_start = now_s()
+        self.trainer = self._spawn_trainer()
+        summary: Dict[str, Any] = {"ok": False}
+        server = InferenceServer(ServerConfig(max_batch=self.max_batch))
+        traffic = TrafficLogger(self.traffic_dir,
+                                rotate_every=self.traffic_rotate,
+                                model=self.model)
+        try:
+            self.watcher = PromotionWatcher(
+                server, self.model, self.snapshot_dir,
+                weights_path=self.weights_path,
+                poll_s=self.poll_s, min_agreement=self.min_agreement,
+                max_staleness=self.max_staleness,
+                gate_batches=self.gate_batches, seed=self.seed,
+                event_log=self.event_log)
+            self.watcher.bootstrap(timeout_s=max(60.0, self.duration_s))
+            lm = server.load(self.model, weights=self.weights_path,
+                             buckets=(self.max_batch,),
+                             seed=self.seed, replicas=self.replicas)
+
+            def tap(sample, resp):
+                traffic.log(sample, resp.argmax,
+                            generation=resp.generation)
+
+            server.add_response_hook(self.model, tap)
+            self.watcher.start()
+            load = self._open_loop(server, lm)
+            settled = self._settle()
+            self.watcher.stop()
+            server.drain()
+            wstats = self.watcher.stats()
+            trainer_rc = self._stop_trainer()
+            trainer_report = _read_last_json_line(
+                os.path.join(self.workdir, "trainer.out"))
+            summary = {
+                "ok": (settled["dropped"] == 0
+                       and wstats["promotions"] >= 1),
+                "model": self.model,
+                "replicas": lm.n_replicas,
+                "promotions": wstats["promotions"],
+                "rejections": wstats["rejections"],
+                "staleness_mean":
+                    wstats["staleness"].get("mean", 0.0),
+                "staleness_max": wstats["staleness"].get("max", 0.0),
+                "staleness_now": wstats["staleness_now"],
+                "swap_p99_delta_ms":
+                    wstats["swap_p99_delta_ms"].get("mean_ms", 0.0),
+                "agreement_mean":
+                    wstats["agreement"].get("mean", 0.0),
+                "generations": int(lm.generation) + 1,
+                "generation_steps": wstats["generation_steps"],
+                "submitted": load["submitted"],
+                "overloaded": load["overloaded"],
+                "completed": settled["completed"],
+                "dropped": settled["dropped"],
+                "per_generation": settled["per_generation"],
+                "traffic_records": traffic.records_logged,
+                "traffic_shards": traffic.shards_written,
+                "trainer_rc": trainer_rc,
+                "trainer": trainer_report,
+                "elapsed_s": round(now_s() - t_start, 3),
+            }
+            return summary
+        finally:
+            if self.watcher is not None:
+                self.watcher.stop()
+            self._stop_trainer()
+            traffic.close()
+            summary["traffic_shards"] = traffic.shards_written
+            try:
+                server.close(drain=True)
+            except Exception:
+                server.close(drain=False)

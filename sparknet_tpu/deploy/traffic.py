@@ -1,0 +1,236 @@
+"""Served-traffic capture as a training stream: the reverse edge of the
+train-while-serve loop.
+
+`TrafficLogger` records (sample, served label, generation) triples from a
+live server — normally tapped in via `InferenceServer.add_response_hook`
+— and publishes them as ATOMICALLY-ROTATED npz shards under one
+directory: records accumulate in memory and every `rotate_every` records
+(or on flush/close) one `traffic_XXXXXXXX.npz` shard is staged under a
+temp name and published with a single `os.replace`, so a concurrent
+reader (or a kill -9) can never observe a half-written shard under a
+final name.  `traffic_feed` turns a shard directory back into the
+`data/feeds.py` callable shape (`{"data": ..., "label": ...}` batches),
+prefetching shard decodes through `data/pipeline.prefetch_map` — the
+circular loop: served traffic re-ingested as a training feed trains
+bit-exactly against the same data fed directly (pinned by
+tests/test_deploy.py; float32 arrays round-trip npz bitwise).
+
+Parser contract: a malformed/truncated shard dies with a ValueError
+naming the file — never `BadZipFile`/`KeyError`/`EOFError` (the
+repo-wide file-format contract, lint R002's taxonomy).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import zipfile
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+TRAFFIC_FORMAT = 1
+_SHARD_PREFIX = "traffic_"
+_SHARD_SUFFIX = ".npz"
+
+
+def default_rotate_every() -> int:
+    """SPARKNET_DEPLOY_TRAFFIC_ROTATE: records per shard before the
+    logger rotates (default 256 — small enough that a short serve run
+    still publishes trainable shards, large enough that shard overhead
+    stays negligible at study scale)."""
+    return max(1, int(os.environ.get("SPARKNET_DEPLOY_TRAFFIC_ROTATE",
+                                     "256")))
+
+
+def default_traffic_dir() -> Optional[str]:
+    """SPARKNET_DEPLOY_TRAFFIC_DIR: where served traffic lands when the
+    deploy verb is not given an explicit --traffic_dir (None = a
+    workdir-local default chosen by the session)."""
+    return os.environ.get("SPARKNET_DEPLOY_TRAFFIC_DIR") or None
+
+
+def shard_path(root: str, seq: int) -> str:
+    return os.path.join(root, f"{_SHARD_PREFIX}{int(seq):08d}{_SHARD_SUFFIX}")
+
+
+def list_shards(root: str) -> List[str]:
+    """Complete (atomically published) shards under `root`, in sequence
+    order.  Temp-staged files never match the shard name pattern, so a
+    reader racing the logger sees only whole shards."""
+    if not os.path.isdir(root):
+        return []
+    out = []
+    for fn in os.listdir(root):
+        if (fn.startswith(_SHARD_PREFIX) and fn.endswith(_SHARD_SUFFIX)
+                and fn[len(_SHARD_PREFIX):-len(_SHARD_SUFFIX)].isdigit()):
+            out.append(os.path.join(root, fn))
+    return sorted(out)
+
+
+class TrafficLogger:
+    """Thread-safe served-request recorder with atomic shard rotation.
+
+    `log()` is called on the server's batcher thread (response-hook tap),
+    so the under-lock work is a buffer append only; the npz encode and
+    the atomic publish happen on the caller that crosses the rotation
+    threshold, OUTSIDE the lock — a slow disk stalls at most one batch's
+    hook, never a concurrent logger."""
+
+    def __init__(self, root: str, *, rotate_every: Optional[int] = None,
+                 model: Optional[str] = None) -> None:
+        self.root = str(root)
+        self.rotate_every = (default_rotate_every()
+                             if rotate_every is None
+                             else max(1, int(rotate_every)))
+        self.model = model
+        os.makedirs(self.root, exist_ok=True)
+        self._lock = threading.Lock()
+        self._buf: List[Tuple[np.ndarray, int, int]] = []
+        self._seq = len(list_shards(self.root))  # append after a restart
+        self.records_logged = 0
+        self.shards_written = 0
+
+    def log(self, sample, label: int, generation: int = 0) -> None:
+        """Record one served request: the input sample and the label the
+        server answered with (plus the generation that answered it)."""
+        x = np.asarray(sample, dtype=np.float32)
+        with self._lock:
+            self._buf.append((x, int(label), int(generation)))
+            self.records_logged += 1
+            batch = None
+            if len(self._buf) >= self.rotate_every:
+                batch, self._buf = self._buf, []
+                seq = self._seq
+                self._seq += 1
+        if batch is not None:
+            self._write_shard(seq, batch)
+
+    def flush(self) -> Optional[str]:
+        """Publish whatever is buffered as a (possibly short) shard;
+        returns its path or None when the buffer was empty."""
+        with self._lock:
+            if not self._buf:
+                return None
+            batch, self._buf = self._buf, []
+            seq = self._seq
+            self._seq += 1
+        return self._write_shard(seq, batch)
+
+    def close(self) -> None:
+        self.flush()
+
+    def __enter__(self) -> "TrafficLogger":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    def _write_shard(self, seq: int, batch) -> str:
+        final = shard_path(self.root, seq)
+        data = np.stack([x for x, _l, _g in batch]).astype(np.float32)
+        label = np.asarray([l for _x, l, _g in batch], dtype=np.int32)
+        gen = np.asarray([g for _x, _l, g in batch], dtype=np.int32)
+        meta = json.dumps({"format": TRAFFIC_FORMAT, "count": len(batch),
+                           "model": self.model}, sort_keys=True)
+        tmp = os.path.join(self.root,
+                           f".tmp.{os.path.basename(final)}.{os.getpid()}")
+        with open(tmp, "wb") as f:
+            np.savez(f, data=data, label=label, generation=gen,
+                     meta=np.frombuffer(meta.encode("utf-8"),
+                                        dtype=np.uint8))
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, final)
+        with self._lock:
+            self.shards_written += 1
+        return final
+
+
+def read_shard(path: str) -> Dict[str, np.ndarray]:
+    """One shard -> {"data", "label", "generation"} arrays, validated
+    against the embedded meta record.  Malformed input dies with a
+    ValueError naming the file (repo parser contract)."""
+    try:
+        with np.load(path) as z:
+            missing = {"data", "label", "generation",
+                       "meta"} - set(z.files)
+            if missing:
+                raise ValueError(f"traffic shard {path!r} lacks arrays "
+                                 f"{sorted(missing)}")
+            data = np.asarray(z["data"], dtype=np.float32)
+            label = np.asarray(z["label"], dtype=np.int32)
+            gen = np.asarray(z["generation"], dtype=np.int32)
+            meta_raw = bytes(np.asarray(z["meta"], dtype=np.uint8))
+    except (zipfile.BadZipFile, OSError, EOFError, KeyError) as e:
+        raise ValueError(f"malformed traffic shard {path!r}: "
+                         f"{type(e).__name__}: {e}") from None
+    except ValueError as e:
+        if path in str(e):
+            raise
+        raise ValueError(
+            f"malformed traffic shard {path!r}: {e}") from None
+    try:
+        meta = json.loads(meta_raw.decode("utf-8"))
+    except (UnicodeDecodeError, json.JSONDecodeError) as e:
+        raise ValueError(f"malformed traffic shard {path!r}: bad meta "
+                         f"record: {e}") from None
+    if not isinstance(meta, dict) or meta.get("format") != TRAFFIC_FORMAT:
+        raise ValueError(f"traffic shard {path!r}: unsupported format "
+                         f"{meta.get('format') if isinstance(meta, dict) else meta!r} "
+                         f"(this reader speaks {TRAFFIC_FORMAT})")
+    n = int(meta.get("count", -1))
+    if not (len(data) == len(label) == len(gen) == n):
+        raise ValueError(
+            f"traffic shard {path!r}: meta count {n} != array lengths "
+            f"(data={len(data)}, label={len(label)}, gen={len(gen)})")
+    return {"data": data, "label": label, "generation": gen}
+
+
+def read_traffic_log(root_or_paths) -> Dict[str, np.ndarray]:
+    """Concatenate a shard directory (or an explicit path list) back into
+    one record stream, in shard order — shard order IS arrival order, so
+    the result replays served traffic exactly."""
+    paths = (list(root_or_paths)
+             if isinstance(root_or_paths, (list, tuple))
+             else list_shards(str(root_or_paths)))
+    if not paths:
+        raise ValueError(
+            f"no traffic shards found under {root_or_paths!r}")
+    from ..data.pipeline import prefetch_map
+
+    shards = list(prefetch_map(read_shard, paths))
+    return {k: np.concatenate([s[k] for s in shards])
+            for k in ("data", "label", "generation")}
+
+
+def traffic_feed(root_or_paths, batch: int, *, loop: bool = True):
+    """A `data/feeds.py`-shaped source over a traffic log: each call
+    returns the next consecutive `{"data", "label"}` batch, cycling when
+    `loop` (a finite log must still feed an open-ended solver run).
+    Batches reproduce the logged sample order exactly, so training from
+    the feed is bit-exact against training from the original stream
+    (float32 npz round-trip is lossless)."""
+    rec = read_traffic_log(root_or_paths)
+    data, label = rec["data"], rec["label"]
+    n = len(data)
+    batch = int(batch)
+    if n < batch:
+        raise ValueError(
+            f"traffic log holds {n} records < batch {batch}")
+    state = {"i": 0}
+
+    def source() -> Dict[str, np.ndarray]:
+        i = state["i"]
+        if i + batch > n:
+            if not loop:
+                raise ValueError(
+                    f"traffic feed exhausted after {i} records "
+                    f"(loop=False)")
+            i = 0
+        state["i"] = i + batch
+        return {"data": data[i:i + batch],
+                "label": label[i:i + batch]}
+
+    return source

@@ -1,0 +1,215 @@
+"""Snapshotting trainer subprocess for the train-while-serve loop.
+
+Runnable as `python -m sparknet_tpu.deploy.train_driver`: builds a
+train-form zoo model + single-chip Solver, feeds it a SEEDED learnable
+synthetic stream (label = top-half mean > bottom-half mean — the same
+provably-learnable-family trick as scripts/accuracy_run.py's synthetic
+set, shaped to whatever the net's MemoryData layer declares), and
+publishes a manifest-committed snapshot (`utils/orbax_ckpt.save_step`)
+every `--snapshot_every` iterations.  The PromotionWatcher on the other
+side of the snapshot dir only ever sees committed generations; a kill -9
+mid-write leaves a torn artifact no manifest points at.
+
+Chaos/acceptance hooks:
+
+- `--corrupt_at N` writes snapshot N with the classifier's output units
+  cyclically shifted — every value finite and well-scaled, but top-1
+  argmax maps through the shift, so cross-generation agreement with the
+  honest serving generation collapses to ~0: the candidate must be
+  rejected by the watcher's AGREEMENT gate specifically, not by its
+  finiteness/shape screens.  Training itself continues on the honest
+  params; the next snapshot is good again.
+- `--traffic_feed DIR` trains from a recorded traffic-shard directory
+  (`deploy/traffic.traffic_feed`) instead of the synthetic stream — the
+  circular serve->log->train loop, driven end to end.
+- SIGINT = snapshot-then-stop via `utils/signals.SignalHandler` (the
+  deploy verb's drain path sends it on shutdown).
+
+Exit prints ONE JSON line (`{"ok": true, ...}`) like every other
+subprocess in this repo (scripts/chaos_run.py protocol).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time  # sleep only; timestamps flow through obs.trace.now_s
+
+
+def _force_cpu() -> None:
+    # the box's sitecustomize pre-imports jax, so the live-config update
+    # is what actually takes effect (tests/conftest.py pattern)
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+
+def input_shape_of(net_param):
+    """(channels, height, width) a net's MemoryData layer expects —
+    what the synthetic stream must produce."""
+    for layer in net_param.layers:
+        if layer.type == "MemoryData":
+            p = layer.memory_data_param
+            return (int(p.channels), int(p.height), int(p.width))
+    raise ValueError("net has no MemoryData layer; the deploy train "
+                     "driver only feeds caller-fed nets")
+
+
+def synthetic_source(shape, batch: int, n_classes: int, seed: int,
+                     *, noise: float = 0.25, amplitude: float = 0.5,
+                     noise_seed: int = None):
+    """Seeded learnable stream: a fixed unit-RMS pattern added with sign
+    +/- (label = sign), under gaussian noise — the accuracy_run.py
+    synthetic-family trick, sized so lenet at lr~0.002 trains stably.
+
+    High-margin ON PURPOSE: the trained weights align with the pattern
+    direction, which makes the logit of ANY probe input essentially a
+    fixed projection — so consecutive snapshot generations top-1 agree
+    near-1.0 even on the watcher's uniform probe batches, while a
+    class-shifted (corrupted) candidate agrees near 0.  A boundary-
+    hugging task (e.g. mean thresholding, where uniform probes sit ON
+    the decision boundary) makes the agreement gate a coin flip —
+    measured, not assumed.
+
+    `noise_seed` splits the two rng roles: the PATTERN (the task) always
+    draws from `seed`, while the sign/noise stream draws from
+    `noise_seed` when given — so elastic worker shards can be disjoint
+    streams of the SAME task (elastic/proc_worker._build_lenet)."""
+    import numpy as np
+
+    pat = np.random.RandomState(seed).randn(*shape).astype(np.float32)
+    pat /= np.sqrt((pat ** 2).mean())
+    rng = np.random.RandomState(seed if noise_seed is None else noise_seed)
+
+    def src():
+        sign = rng.randint(0, 2, size=batch).astype(np.float32) * 2 - 1
+        x = (noise * rng.randn(batch, *shape).astype(np.float32)
+             + sign.reshape((batch,) + (1,) * len(shape))
+             * amplitude * pat)
+        return {"data": x,
+                "label": (sign > 0).astype(np.int32) % n_classes}
+
+    return src
+
+
+def corrupt_params(params):
+    """Finite, well-scaled, deliberately WRONG: cyclically shift the
+    deepest 2-D (classifier) weight's output units — and its bias —
+    so argmax permutes and cross-generation top-1 agreement drops to
+    ~0.  Defeats the agreement gate without tripping the cheaper
+    finiteness/shape screens first."""
+    import numpy as np
+
+    out = {k: np.asarray(v).copy() for k, v in params.items()}
+    mats = [k for k in out if out[k].ndim == 2]
+    if not mats:
+        raise ValueError("corrupt_at: net has no 2-D classifier weight "
+                         "to shift")
+    k = mats[-1]
+    out[k] = np.roll(out[k], 1, axis=0)
+    kb = k.rsplit("/", 1)[0] + "/1"
+    if kb in out:
+        out[kb] = np.roll(out[kb], 1, axis=0)
+    return out
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="sparknet-deploy-trainer",
+        description="snapshotting trainer leg of the deploy loop")
+    ap.add_argument("--model", default="lenet",
+                    help="model-zoo name (train form must exist)")
+    ap.add_argument("--snapshot_dir", required=True)
+    ap.add_argument("--snapshots", type=int, default=4,
+                    help="snapshot generations to publish (beyond the "
+                         "step-0 bootstrap snapshot)")
+    ap.add_argument("--snapshot_every", type=int, default=12,
+                    help="solver iterations between snapshots")
+    ap.add_argument("--warm_iters", type=int, default=10,
+                    help="iterations BEFORE the step-0 snapshot, so the "
+                         "bootstrap generation is already off the "
+                         "chaotic near-init argmax regime")
+    ap.add_argument("--batch", type=int, default=16)
+    ap.add_argument("--lr", type=float, default=0.002,
+                    help="fixed lr; 0.002 is the measured stable point "
+                         "for lenet on the synthetic pattern stream "
+                         "(0.01+ diverges to NaN within ~3 snapshots)")
+    ap.add_argument("--seed", type=int, default=7)
+    ap.add_argument("--n_classes", type=int, default=10)
+    ap.add_argument("--step_sleep_s", type=float, default=0.0,
+                    help="pause between snapshots (test knob: widens "
+                         "the watcher's promotion windows)")
+    ap.add_argument("--corrupt_at", type=int, default=None,
+                    help="publish THIS snapshot step corrupted "
+                         "(agreement-gate chaos hook)")
+    ap.add_argument("--traffic_feed", default=None,
+                    help="train from this traffic-shard dir instead of "
+                         "the synthetic stream (circular loop)")
+    a = ap.parse_args(argv)
+    _force_cpu()
+
+    from ..models import get_model
+    from ..proto import caffe_pb
+    from ..proto.textformat import parse
+    from ..solver.solver import Solver
+    from ..utils.orbax_ckpt import save_step
+    from ..utils.signals import SignalHandler, SolverAction
+
+    net_param = get_model(a.model, batch=int(a.batch), deploy=False)
+    sp = caffe_pb.SolverParameter(parse(
+        f"base_lr: {float(a.lr)} lr_policy: 'fixed' momentum: 0.9 "
+        f"random_seed: {int(a.seed)}"))
+    solver = Solver(sp, net_param=net_param)
+    if a.traffic_feed:
+        from .traffic import traffic_feed
+
+        solver.set_train_data(traffic_feed(a.traffic_feed, int(a.batch)))
+    else:
+        solver.set_train_data(synthetic_source(
+            input_shape_of(net_param), int(a.batch), int(a.n_classes),
+            int(a.seed)))
+
+    handler = SignalHandler(
+        sigint_effect=SolverAction.SNAPSHOT_STOP).install()
+
+    losses = []
+    if a.warm_iters > 0:
+        losses.append(float(solver.step(int(a.warm_iters))))
+
+    def publish(step: int) -> None:
+        params = solver.params
+        if a.corrupt_at is not None and step == int(a.corrupt_at):
+            params = corrupt_params(params)
+        save_step(a.snapshot_dir, int(step), int(solver.iter), params,
+                  solver.state)
+
+    publish(0)
+    step = 0
+    stopped = None
+    while step < int(a.snapshots):
+        losses.append(float(solver.step(int(a.snapshot_every))))
+        step += 1
+        publish(step)
+        action = handler.get_requested_action()
+        if action in (SolverAction.STOP, SolverAction.SNAPSHOT_STOP):
+            stopped = action.name
+            break
+        if a.step_sleep_s > 0:
+            time.sleep(float(a.step_sleep_s))  # test knob pacing only
+    print(json.dumps({
+        "ok": True, "model": a.model, "iters": int(solver.iter),
+        "snapshots": step + 1, "final_step": step,
+        "corrupted_step": a.corrupt_at,
+        "loss_first": round(losses[0], 5) if losses else None,
+        "loss_last": round(losses[-1], 5) if losses else None,
+        "stopped": stopped,
+        "feed": "traffic" if a.traffic_feed else "synthetic",
+    }), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,365 @@
+"""Promotion watcher: the forward edge of the train-while-serve loop.
+
+Polls a live training run's snapshot root through the manifest-validated
+`utils/orbax_ckpt.latest_step`/`validate_step` surface (torn kill-9
+snapshots are invisible by construction), gates every NEW candidate step
+on a seeded-batch top-1 agreement + health check against the generation
+currently serving (the PR 7 quant calibration-gate pattern:
+`ModelRunner.calibrate_quant` scores a quantized forward against its
+fp32 master the same way), and on a pass hot-loads the candidate into
+the WHOLE replica set via `ModelRegistry.reload` — the registry's atomic
+generation swap means in-flight batches complete on the old params and
+no request is dropped or mixed.
+
+Promotion state machine (documented in README "Train-while-serve"):
+
+    IDLE --new valid step--> GATE --agreement >= floor--> PROMOTE
+      ^                       |                             |
+      |                       +--reject (agreement/restore/ |
+      |                          missing-params/nonfinite)  |
+      +---------------------- (staleness gauge updated) <---+
+
+Everything a product would chart lands in one obs MetricsRegistry:
+`model_staleness_rounds` (gauge + histogram: snapshot steps between the
+trainer's newest step and the step the served generation was promoted
+from), `generation_agreement` (cross-generation drift), and
+`swap_p99_delta_ms` (post-swap p99 minus the retired generation's p99 —
+the swap-induced latency spike).  Promotion/rejection/staleness events
+append to a round-log-style JSONL stream (schema in DISTACC.md).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from ..obs.metrics import MetricsRegistry
+from ..obs.trace import now_s
+from ..utils import orbax_ckpt
+
+
+def default_poll_s() -> float:
+    """SPARKNET_DEPLOY_POLL_S: snapshot-dir poll period (default 0.25 s —
+    one listdir + newest-manifest checksum per poll)."""
+    return float(os.environ.get("SPARKNET_DEPLOY_POLL_S", "0.25") or 0.25)
+
+
+def default_min_agreement() -> float:
+    """SPARKNET_DEPLOY_MIN_AGREEMENT: top-1 agreement floor a candidate
+    generation must reach against the serving generation (default 0.5 —
+    consecutive SGD generations agree far above it, a corrupted/NaN
+    snapshot lands near chance)."""
+    return float(os.environ.get("SPARKNET_DEPLOY_MIN_AGREEMENT", "0.5")
+                 or 0.5)
+
+
+def default_max_staleness() -> int:
+    """SPARKNET_DEPLOY_MAX_STALENESS: snapshot steps the served
+    generation may lag the trainer before the watcher raises a staleness
+    alert event (default 4)."""
+    return int(os.environ.get("SPARKNET_DEPLOY_MAX_STALENESS", "4") or 4)
+
+
+def write_weights_npz(path: str, params: Dict[str, Any]) -> str:
+    """Param-keyed npz weights file, published atomically (tmp + fsync +
+    os.replace) so `ModelRegistry.reload` can never read a half-written
+    file mid-promotion.  The key set is exactly what
+    `classify.load_pretrained`'s npz path overlays onto a fresh net."""
+    parent = os.path.dirname(os.path.abspath(path)) or "."
+    os.makedirs(parent, exist_ok=True)
+    tmp = os.path.join(parent,
+                       f".tmp.{os.path.basename(path)}.{os.getpid()}")
+    with open(tmp, "wb") as f:
+        np.savez(f, **{k: np.asarray(v) for k, v in params.items()})
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+    return path
+
+
+class PromotionWatcher:
+    """Promotes manifest-valid training snapshots into a live
+    InferenceServer model, one generation at a time.
+
+    Single-threaded: `poll_once` is called either from `run()`'s loop
+    (via `start()`'s daemon thread) or directly by tests/drivers — never
+    concurrently.  The only cross-thread surfaces it touches are the
+    registry's lock-guarded reload/swap and the runner's pure-functional
+    `forward_padded_with`, both safe against live batcher threads."""
+
+    def __init__(self, server, model: str, snapshot_root: str, *,
+                 weights_path: str,
+                 poll_s: Optional[float] = None,
+                 min_agreement: Optional[float] = None,
+                 max_staleness: Optional[int] = None,
+                 gate_batches: int = 2,
+                 seed: int = 0,
+                 metrics: Optional[MetricsRegistry] = None,
+                 event_log: Optional[str] = None,
+                 spike_min_requests: int = 16) -> None:
+        self.server = server
+        self.model = str(model)
+        self.snapshot_root = str(snapshot_root)
+        self.weights_path = str(weights_path)
+        self.poll_s = default_poll_s() if poll_s is None else float(poll_s)
+        self.min_agreement = (default_min_agreement()
+                              if min_agreement is None
+                              else float(min_agreement))
+        self.max_staleness = (default_max_staleness()
+                              if max_staleness is None
+                              else int(max_staleness))
+        self.gate_batches = max(1, int(gate_batches))
+        self.seed = int(seed)
+        self.event_log = event_log
+        self.spike_min_requests = max(1, int(spike_min_requests))
+
+        self.metrics = metrics or MetricsRegistry()
+        self.g_staleness = self.metrics.gauge("model_staleness_rounds")
+        self.h_staleness = self.metrics.histogram(
+            "model_staleness_rounds_observed")
+        self.h_agreement = self.metrics.histogram("generation_agreement")
+        self.h_swap_delta = self.metrics.histogram("swap_p99_delta_ms")
+        self.c_promotions = self.metrics.counter("promotions_total")
+        self.c_rejections = self.metrics.counter("promotions_rejected")
+        self.c_alerts = self.metrics.counter("staleness_alerts")
+
+        self.promoted_step: Optional[int] = None
+        self.generation_steps: Dict[int, int] = {}  # generation -> step
+        self.events: List[Dict[str, Any]] = []
+        self._rejected_step: Optional[int] = None
+        self._pending_spike: Optional[Dict[str, float]] = None
+        # guards the promotion-state attributes above: poll_once runs on
+        # the run() thread while stats()/callers read from theirs.  Held
+        # for plain assignments only — never across a forward/reload
+        # (the R005 contract).
+        self._mu = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # ------------------------------------------------------------ telemetry
+    def _event(self, kind: str, **fields) -> Dict[str, Any]:
+        rec = {"kind": kind, "model": self.model}
+        rec.update(fields)
+        self.events.append(rec)
+        if self.event_log:
+            with open(self.event_log, "a") as f:
+                f.write(json.dumps(rec) + "\n")
+                f.flush()
+        return rec
+
+    def staleness_summary(self) -> Dict[str, float]:
+        return self.h_staleness.summary()
+
+    def swap_delta_summary(self) -> Dict[str, float]:
+        return self.h_swap_delta.summary(key_suffix="_ms")
+
+    def agreement_summary(self) -> Dict[str, float]:
+        return self.h_agreement.summary()
+
+    # ------------------------------------------------------------ bootstrap
+    def bootstrap(self, *, timeout_s: float = 60.0) -> int:
+        """Block for the trainer's FIRST valid snapshot and write it as
+        the generation-0 weights file — called BEFORE server.load so the
+        model comes up already warm-started (no gate: there is no serving
+        generation to agree with yet).  Raises on timeout."""
+        step = orbax_ckpt.wait_for_step(self.snapshot_root,
+                                        timeout_s=timeout_s,
+                                        poll_s=self.poll_s)
+        if step is None:
+            raise ValueError(
+                f"no valid snapshot appeared under "
+                f"{self.snapshot_root!r} within {timeout_s:.0f}s")
+        artifact = orbax_ckpt.validate_step(self.snapshot_root, step)
+        if artifact is None:  # raced a newer writer; re-resolve
+            artifact = orbax_ckpt.resolve_latest(self.snapshot_root)
+            step = orbax_ckpt.latest_step(self.snapshot_root)
+        it, params, _state = orbax_ckpt.restore_auto(artifact)
+        write_weights_npz(self.weights_path, params)
+        with self._mu:
+            self.promoted_step = int(step)
+        self._event("bootstrap", step=int(step), iter=int(it),
+                    weights=os.path.basename(self.weights_path))
+        return int(step)
+
+    # ----------------------------------------------------------------- gate
+    def _gate(self, runner, params: Dict[str, Any]) -> Dict[str, Any]:
+        """Agreement/health check of candidate `params` against the
+        serving generation, on seeded synthetic batches at the largest
+        warmed bucket (calibrate_quant's protocol).  Returns a verdict
+        dict; never raises for a bad candidate."""
+        from ..ops.quant import top1_agreement
+
+        missing = set(runner.net.param_inits) - set(params)
+        if missing:
+            return {"ok": False, "reason": "missing-params",
+                    "detail": sorted(missing)[:8]}
+        ref_params = runner.params
+        cand = {}
+        for k, v in ref_params.items():
+            a = np.asarray(params[k])
+            r = np.asarray(v)
+            if a.shape != r.shape:
+                return {"ok": False, "reason": "shape-mismatch",
+                        "detail": f"{k}: {a.shape} != {r.shape}"}
+            cand[k] = a.astype(r.dtype, copy=False)
+        if not all(np.isfinite(a).all() for a in cand.values()):
+            return {"ok": False, "reason": "nonfinite-params"}
+        rng = np.random.RandomState(self.seed ^ 0xDEA1)
+        bucket = max(runner.buckets)
+        agree = []
+        for _ in range(self.gate_batches):
+            x = rng.rand(bucket, *runner.sample_shape).astype(np.float32)
+            ref = runner.forward_padded_with(ref_params, x)
+            got = runner.forward_padded_with(cand, x)
+            if not np.isfinite(got).all():
+                return {"ok": False, "reason": "nonfinite-probs"}
+            agree.append(top1_agreement(ref, got))
+        agreement = float(np.mean(agree))
+        self.h_agreement.observe(agreement)
+        if agreement < self.min_agreement:
+            return {"ok": False, "reason": "agreement",
+                    "agreement": agreement}
+        return {"ok": True, "agreement": agreement, "params": cand}
+
+    # ------------------------------------------------------------ the poll
+    def _update_staleness(self, latest: int) -> int:
+        base = self.promoted_step if self.promoted_step is not None else -1
+        staleness = max(0, int(latest) - int(base)) if base >= 0 \
+            else int(latest) + 1
+        self.g_staleness.set(staleness)
+        self.h_staleness.observe(float(staleness))
+        if staleness > self.max_staleness:
+            self.c_alerts.inc()
+            self._event("staleness", step=int(latest),
+                        promoted_step=self.promoted_step,
+                        staleness=staleness, alert=True)
+        return staleness
+
+    def _maybe_record_swap_spike(self, lm, force: bool = False) -> None:
+        """Post-swap p99 minus the retired generation's p99, recorded
+        once the fresh generation has seen enough requests for its p99
+        to mean something (or at stop time with whatever it has)."""
+        pending = self._pending_spike
+        if pending is None:
+            return
+        post = lm.stats.latency_summary("total")
+        if post["count"] < (1 if force else self.spike_min_requests):
+            return
+        delta = float(post["p99_ms"]) - float(pending["pre_p99_ms"])
+        self.h_swap_delta.observe(delta)
+        with self._mu:
+            self._pending_spike = None
+        self._event("swap_spike", generation=int(pending["generation"]),
+                    pre_p99_ms=round(float(pending["pre_p99_ms"]), 4),
+                    post_p99_ms=round(float(post["p99_ms"]), 4),
+                    delta_ms=round(delta, 4),
+                    post_count=int(post["count"]))
+
+    def poll_once(self) -> Optional[Dict[str, Any]]:
+        """One watcher turn: update staleness, and when a NEW valid step
+        exists, gate it and either promote (registry reload + atomic
+        swap) or record a rejection.  Returns the promote/reject event,
+        or None when nothing new was found."""
+        lm = self.server.registry.get(self.model)
+        self._maybe_record_swap_spike(lm)
+        latest = orbax_ckpt.latest_step(self.snapshot_root)
+        if latest is None:
+            return None
+        self._update_staleness(latest)
+        if self.promoted_step is not None and latest <= self.promoted_step:
+            return None
+        if self._rejected_step is not None and latest <= self._rejected_step:
+            return None  # wait for a newer candidate than the rejected one
+        artifact = orbax_ckpt.validate_step(self.snapshot_root, latest)
+        if artifact is None:
+            return None  # raced the writer; next poll re-resolves
+        t0 = now_s()
+        try:
+            it, params, _state = orbax_ckpt.restore_auto(artifact)
+        except ValueError as e:
+            self.c_rejections.inc()
+            with self._mu:
+                self._rejected_step = int(latest)
+            return self._event("reject", step=int(latest),
+                               reason="restore", detail=str(e)[:200])
+        runner = lm.runner
+        verdict = self._gate(runner, params)
+        gate_s = now_s() - t0
+        if not verdict["ok"]:
+            self.c_rejections.inc()
+            with self._mu:
+                self._rejected_step = int(latest)
+            rec = {k: v for k, v in verdict.items()
+                   if k in ("reason", "agreement", "detail")}
+            return self._event("reject", step=int(latest), iter=int(it),
+                               gate_s=round(gate_s, 4), **rec)
+        staleness_before = self.g_staleness.value
+        pre_p99 = lm.stats.latency_summary("total")["p99_ms"]
+        write_weights_npz(self.weights_path, verdict["params"])
+        t1 = now_s()
+        self.server.reload(self.model)
+        swap_s = now_s() - t1
+        with self._mu:
+            self.promoted_step = int(latest)
+            self._rejected_step = None
+            self.generation_steps[int(lm.generation)] = int(latest)
+            self._pending_spike = {"pre_p99_ms": float(pre_p99),
+                                   "generation": float(lm.generation)}
+        self.c_promotions.inc()
+        self._update_staleness(
+            orbax_ckpt.latest_step(self.snapshot_root) or latest)
+        return self._event(
+            "promote", step=int(latest), iter=int(it),
+            generation=int(lm.generation),
+            agreement=round(float(verdict["agreement"]), 4),
+            staleness_before=int(staleness_before),
+            staleness_after=int(self.g_staleness.value),
+            gate_s=round(gate_s, 4), swap_s=round(swap_s, 4))
+
+    # ------------------------------------------------------------ run loop
+    def run(self, *, duration_s: Optional[float] = None) -> None:
+        deadline = None if duration_s is None else now_s() + duration_s
+        while not self._stop.is_set():
+            if deadline is not None and now_s() >= deadline:
+                return
+            self.poll_once()
+            self._stop.wait(self.poll_s)  # interruptible pacing, not timing
+
+    def start(self) -> "PromotionWatcher":
+        if self._thread is not None:
+            raise RuntimeError("watcher already started")
+        self._stop.clear()
+        self._thread = threading.Thread(target=self.run, daemon=True,
+                                        name=f"sparknet-deploy-watch-"
+                                             f"{self.model}")
+        self._thread.start()
+        return self
+
+    def stop(self, *, timeout_s: float = 10.0) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=timeout_s)
+            self._thread = None
+        try:
+            lm = self.server.registry.get(self.model)
+        except Exception:
+            return
+        self._maybe_record_swap_spike(lm, force=True)
+
+    def stats(self) -> Dict[str, Any]:
+        with self._mu:
+            promoted_step = self.promoted_step
+            generation_steps = dict(self.generation_steps)
+        return {"promotions": int(self.c_promotions.value),
+                "rejections": int(self.c_rejections.value),
+                "staleness_alerts": int(self.c_alerts.value),
+                "staleness_now": int(self.g_staleness.value),
+                "staleness": self.staleness_summary(),
+                "agreement": self.agreement_summary(),
+                "swap_p99_delta_ms": self.swap_delta_summary(),
+                "promoted_step": promoted_step,
+                "generation_steps": generation_steps}

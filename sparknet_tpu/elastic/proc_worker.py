@@ -98,6 +98,35 @@ def _build_toy(cfg: dict):
     return solver
 
 
+def _build_lenet(cfg: dict):
+    """A REAL zoo net (train-form lenet) on the deploy subsystem's
+    high-margin pattern stream (deploy/train_driver.synthetic_source):
+    the proc-elastic trainer arm of the train-while-serve loop.  Each
+    slot salts only the sign/noise stream (`noise_seed`) so shards are
+    disjoint draws of the SAME task — the pattern direction comes from
+    the shared seed, and averaging worker params stays constructive.
+    lr 0.002 is the measured stable point (see deploy/train_driver.py).
+    """
+    import sparknet_tpu  # noqa: F401  (jax forward-compat graft)
+    from ..deploy.train_driver import input_shape_of, synthetic_source
+    from ..models import get_model
+    from ..proto import caffe_pb
+    from ..proto.textformat import parse
+    from ..solver.solver import Solver
+
+    sub = cfg.get("lenet", {})
+    batch = int(sub.get("batch", 16))
+    net = get_model("lenet", batch=batch, deploy=False)
+    sp = caffe_pb.SolverParameter(parse(
+        f"base_lr: {float(sub.get('lr', 0.002))} lr_policy: 'fixed' "
+        f"momentum: 0.9 random_seed: {int(cfg.get('seed', 7))}"))
+    solver = Solver(sp, net_param=net)
+    solver.set_train_data(synthetic_source(
+        input_shape_of(net), batch, int(sub.get("n_classes", 10)),
+        int(cfg.get("seed", 7)), noise_seed=1000 + int(cfg["slot"])))
+    return solver
+
+
 def _build_solver_file(cfg: dict):
     """CLI proc mode: a real solver prototxt whose net self-feeds (the
     DataReader semantics — data/feeds.make_net_feeds); each worker seeds
@@ -174,11 +203,13 @@ def main(argv=None) -> int:
     builder = cfg.get("builder", "toy")
     if builder == "toy":
         solver = _build_toy(cfg)
+    elif builder == "lenet":
+        solver = _build_lenet(cfg)
     elif builder == "solver":
         solver = _build_solver_file(cfg)
     else:
         raise ValueError(f"unknown proc worker builder {builder!r} "
-                         f"(expected 'toy' or 'solver')")
+                         f"(expected 'toy', 'lenet', or 'solver')")
 
     restored = None
     root = cfg.get("restore_root")

@@ -184,6 +184,34 @@ class ModelRunner:
         # anyway
         return np.asarray(self._jfwd(self._exec_params, xj))
 
+    def forward_padded_with(self, params, x: np.ndarray) -> np.ndarray:
+        """forward_padded under an ALTERNATE fp32 param tree through this
+        runner's already-compiled program (same bucket shapes, so no new
+        compile) — the promotion gate's primitive (deploy/watcher.py):
+        a candidate training snapshot is scored against the generation
+        currently serving without building a throwaway ModelRunner.
+        Unlike forward_padded this mutates NO runner state (no
+        _shapes_seen bookkeeping), so it is safe to call from the watcher
+        thread concurrently with the batcher thread's forward_padded."""
+        if tuple(x.shape[1:]) != self.sample_shape:
+            raise ValueError(
+                f"sample shape {tuple(x.shape[1:])} != model input "
+                f"{self.sample_shape}")
+        if len(x) not in self.buckets:
+            raise ValueError(
+                f"batch {len(x)} is not a warmed bucket {self.buckets}; "
+                f"pad with buckets.pad_to_bucket first")
+        import jax
+        import jax.numpy as jnp
+
+        # the quantized hot path's program expects a quantized tree;
+        # gate through the fp32 reference program instead (the same one
+        # calibration scores against)
+        jfwd = self._jref if self.quant != "fp32" else self._jfwd
+        xj = (jax.device_put(x, self.device) if self.device is not None
+              else jnp.asarray(x))
+        return np.asarray(jfwd(params, xj))
+
     def calibrate_quant(self, n_batches: int = 2, *,
                         min_agreement: Optional[float] = None,
                         ) -> Optional[float]:

@@ -41,6 +41,11 @@ class LoadedModel:
     load_kwargs: dict = field(default_factory=dict)
     replicas: List[ModelRunner] = field(default_factory=list, repr=False)
     devices: Optional[list] = field(default=None, repr=False)
+    # total-latency summary of the generation retired by the last swap()
+    # (None until the first swap): the "pre" side of the swap-induced
+    # p99 spike the deploy watcher measures — the fresh generation's
+    # stats start empty, so its own summary IS the "post" side.
+    pre_swap_total_ms: Optional[dict] = field(default=None, repr=False)
     _swap_lock: threading.Lock = field(default_factory=threading.Lock,
                                        repr=False, compare=False)
 
@@ -61,11 +66,16 @@ class LoadedModel:
 
     def swap(self, runner: ModelRunner, replicas: List[ModelRunner],
              stats: ModelStats) -> None:
+        # summarized OUTSIDE the swap lock: replica_snapshot holds it on
+        # every dispatch, and the old stats object stays valid (batches
+        # in flight against the old set still record into it)
+        pre = self.stats.latency_summary("total")
         with self._swap_lock:
             self.runner = runner
             self.replicas = replicas
             self.stats = stats
             self.generation += 1
+            self.pre_swap_total_ms = pre
 
 
 def _build_replicas(master: ModelRunner, devices: Optional[Sequence],
@@ -185,6 +195,8 @@ class ModelRegistry:
             snap["generation"] = lm.generation
             snap["spec"] = lm.spec
             snap["n_replicas"] = lm.n_replicas
+            if lm.pre_swap_total_ms is not None:
+                snap["pre_swap_total_ms"] = lm.pre_swap_total_ms
             if lm.devices is not None:
                 snap["devices"] = [str(d) for d in lm.devices]
             snap.update({f"engine_{k}": v

@@ -156,6 +156,12 @@ class InferenceServer:
         self._lock = threading.Lock()
         self._accepting = True
         self._closed = False
+        # model -> [fn(sample, response)] observers of every delivered
+        # response (the deploy TrafficLogger's tap); called on the
+        # batcher thread AFTER futures resolve, so a slow/broken hook
+        # delays only subsequent batches, never a client's result
+        self._response_hooks: Dict[str, List] = {}
+        self._hook_warned: set = set()
 
     def _get_placer(self) -> DevicePlacer:
         """Lazy so the default single-replica path never touches
@@ -346,6 +352,44 @@ class InferenceServer:
                 futs.append(f)
         return futs
 
+    # ---------------------------------------------------------------- hooks
+    def add_response_hook(self, model: str, hook) -> None:
+        """Register `hook(sample, response)` to observe every DELIVERED
+        response of `model` (rejections never reach hooks).  This is how
+        the deploy subsystem's TrafficLogger records served traffic as a
+        training stream without sitting between client and server."""
+        if not callable(hook):
+            raise ValueError("response hook must be callable")
+        with self._lock:
+            self._response_hooks.setdefault(model, []).append(hook)
+
+    def remove_response_hook(self, model: str, hook) -> None:
+        with self._lock:
+            hooks = self._response_hooks.get(model, [])
+            if hook in hooks:
+                hooks.remove(hook)
+
+    def _fire_response_hooks(self, model: str, pairs) -> None:
+        """pairs: [(sample, Response)].  A hook exception must not kill
+        the batcher thread (every future is already resolved) — warn once
+        per hook and keep serving."""
+        import warnings
+
+        with self._lock:
+            hooks = list(self._response_hooks.get(model, ()))
+        for hook in hooks:
+            for sample, resp in pairs:
+                try:
+                    hook(sample, resp)
+                except Exception as e:
+                    if id(hook) not in self._hook_warned:
+                        self._hook_warned.add(id(hook))
+                        warnings.warn(
+                            f"response hook {hook!r} for {model!r} "
+                            f"raised {type(e).__name__}: {e} (hook "
+                            f"errors are reported once and ignored)")
+                    break
+
     def _lane(self, model: str) -> _Lane:
         with self._lock:
             lane = self._lanes.get(model)
@@ -403,6 +447,7 @@ class InferenceServer:
         t_done = now_s()
         device_ms = (t_done - t_launch) * 1e3
         lm.stats.observe_batch(len(live), bucket)
+        delivered = []
         with span("serve.respond", model=lm.name, bucket=bucket,
                   live=len(live)) as sp:
             for i, r in enumerate(live):
@@ -411,16 +456,19 @@ class InferenceServer:
                 assembly_ms = (t_launch - r.t_pop) * 1e3
                 lm.stats.observe_request(queue_wait_ms, assembly_ms,
                                          device_ms, total_ms)
-                r.future.set_result(Response(
+                resp = Response(
                     probs=out[i], model=lm.name, generation=generation,
                     bucket=bucket, batch_live=len(live),
                     queue_wait_ms=round(queue_wait_ms, 4),
                     assembly_ms=round(assembly_ms, 4),
                     device_ms=round(device_ms, 4),
                     total_ms=round(total_ms, 4),
-                    replica=replica_idx))
+                    replica=replica_idx)
+                r.future.set_result(resp)
+                delivered.append((r.sample, resp))
             sp.set(completed=lm.stats.value("completed"),
                    batches=lm.stats.value("batches"))
+        self._fire_response_hooks(lm.name, delivered)
 
     # -------------------------------------------------------------- observe
     def stats(self) -> Dict[str, object]:

@@ -171,6 +171,17 @@ class ModelStats:
         series["device"].observe(device_ms)
         series["total"].observe(total_ms)
 
+    def latency_summary(self, leg: str = "total") -> Dict[str, float]:
+        """Summary of ONE latency leg (count/mean/max/p50/p95/p99, _ms
+        keys) — the promotion watcher's pre/post-swap p99 probe reads
+        this without paying for a full snapshot()."""
+        with self._lock:
+            s = self._series.get(leg)
+            if s is None:
+                raise ValueError(f"unknown latency leg {leg!r}; one of "
+                                 f"{sorted(self._series)}")
+        return s.summary(key_suffix="_ms")
+
     def snapshot(self) -> Dict[str, object]:
         with self._lock:
             out: Dict[str, object] = {name: int(c.value)

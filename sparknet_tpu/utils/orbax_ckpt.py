@@ -351,6 +351,30 @@ def latest_step(root: str) -> Optional[int]:
     return None
 
 
+def wait_for_step(root: str, *, newer_than: Optional[int] = None,
+                  timeout_s: float = 30.0,
+                  poll_s: float = 0.05) -> Optional[int]:
+    """Block until a VALID stepped snapshot exists under `root` (strictly
+    newer than `newer_than` when given); returns its step number, or
+    None on timeout.  Cheap by construction: each poll is one listdir
+    plus manifest validation of the newest candidate only (latest_step
+    returns at the first valid step), so a deploy watcher can sit on a
+    live training run's snapshot dir without competing with it for IO."""
+    import time  # sleep only; timing goes through obs.trace.now_s
+
+    from ..obs.trace import now_s
+
+    deadline = now_s() + float(timeout_s)
+    while True:
+        step = latest_step(root)
+        if step is not None and (newer_than is None
+                                 or step > int(newer_than)):
+            return step
+        if now_s() >= deadline:
+            return None
+        time.sleep(max(0.001, float(poll_s)))
+
+
 def resolve_latest(root: str) -> Optional[str]:
     """Path of the newest VALID stepped snapshot under `root`, or None.
 

@@ -363,3 +363,65 @@ def test_bench_elastic_leg_contract(monkeypatch):
     _Proc.stdout = _json.dumps(canned) + "\n"
     with pytest.raises(RuntimeError, match="not-ok"):
         bench.bench_elastic()
+
+
+def test_bench_trainserve_leg_contract(monkeypatch):
+    """The trainserve leg (schema v5) runs trainserve_run.py --smoke in
+    a SUBPROCESS and parses one JSON line; pin the field mapping against
+    _KNOWN_FIELDS/_KNOWN_LEGS and every failure mode the guarded leg
+    relies on — non-zero exit, not-ok record, and the zero-drop bar
+    (dropped > 0 must RAISE, never land as a stale-looking record).
+    The live path is tests/test_deploy.py's e2e session test."""
+    import json as _json
+    import subprocess
+
+    import bench
+
+    assert bench.BENCH_SCHEMA_VERSION == 5
+    canned = {"ok": True, "model": "lenet", "promotions": 2,
+              "rejections": 1, "staleness_mean": 0.6, "staleness_max": 1.0,
+              "swap_p99_delta_ms": 3.25, "dropped": 0, "completed": 132,
+              "generations": 3, "agreement_mean": 0.98,
+              "traffic_records": 132, "submitted": 132}
+
+    class _Proc:
+        returncode = 0
+        stderr = ""
+        stdout = "progress noise\n" + _json.dumps(canned) + "\n"
+
+    calls = []
+
+    def fake_run(cmd, **kw):
+        calls.append(cmd)
+        return _Proc()
+
+    monkeypatch.setattr(subprocess, "run", fake_run)
+    r = bench.bench_trainserve()
+    assert calls and calls[0][1].endswith("trainserve_run.py")
+    assert "--smoke" in calls[0] and "--corrupt_at" in calls[0]
+    assert r["trainserve_promotions"] == 2
+    assert r["trainserve_rejections"] == 1
+    assert r["trainserve_staleness_mean"] == 0.6
+    assert r["trainserve_staleness_max"] == 1.0
+    assert r["trainserve_swap_p99_delta_ms"] == 3.25
+    assert r["trainserve_dropped"] == 0
+    assert r["trainserve_generations"] == 3
+    assert r["trainserve_agreement_mean"] == 0.98
+    assert r["trainserve_traffic_records"] == 132
+    assert set(r) <= bench._KNOWN_FIELDS
+    assert "trainserve" in bench._KNOWN_LEGS
+
+    _Proc.returncode = 1
+    _Proc.stderr = "boom"
+    with pytest.raises(RuntimeError, match="exited 1"):
+        bench.bench_trainserve()
+    _Proc.returncode = 0
+    canned["ok"] = False
+    _Proc.stdout = _json.dumps(canned) + "\n"
+    with pytest.raises(RuntimeError, match="not-ok"):
+        bench.bench_trainserve()
+    canned["ok"] = True
+    canned["dropped"] = 3
+    _Proc.stdout = _json.dumps(canned) + "\n"
+    with pytest.raises(RuntimeError, match="dropped"):
+        bench.bench_trainserve()

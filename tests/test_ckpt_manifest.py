@@ -11,6 +11,7 @@ parser contract)."""
 import json
 import os
 import shutil
+import threading
 
 import numpy as np
 import pytest
@@ -175,3 +176,103 @@ def test_every_kill9_interleaving_resolves_loadable(tmp_path, stop_after):
     it, params, _state = restore_auto(chosen)
     assert it == 10
     np.testing.assert_array_equal(params["w"], _params(1.0)["w"])
+
+
+# --------------------------------------------- deploy-watcher race tests
+def test_resolve_latest_concurrent_with_save_step(tmp_path):
+    """The PromotionWatcher polls resolve_latest/restore_auto WHILE the
+    trainer's save_step publishes new generations: every path the poller
+    resolves must load, and the steps it observes must be monotone
+    non-decreasing (a poll can lag the writer but never travel back to
+    an older generation)."""
+    root = str(tmp_path)
+    save_step(root, 0, 0, _params(0.0), {})
+    errors = []
+
+    def writer():
+        try:
+            for s in range(1, 25):
+                save_step(root, s, s * 10, _params(float(s)), {})
+        except Exception as e:  # surface in the main thread's assert
+            errors.append(e)
+
+    t = threading.Thread(target=writer)
+    t.start()
+    seen = []
+    try:
+        while t.is_alive():
+            p = resolve_latest(root)
+            assert p is not None
+            it, params, _state = restore_auto(p)  # must ALWAYS load
+            v = it / 10
+            np.testing.assert_array_equal(params["w"],
+                                          _params(float(v))["w"])
+            seen.append(it)
+    finally:
+        t.join()
+    assert not errors
+    assert all(b >= a for a, b in zip(seen, seen[1:]))
+    assert latest_step(root) == 24
+
+
+def test_watcher_poll_never_sees_torn_or_older(tmp_path):
+    """The watcher's promotion protocol (latest_step -> validate_step ->
+    restore_auto) against a snapshot dir holding every kill-9 leftover
+    at once: a torn tmp, an unmanifested artifact, and a manifested-but-
+    truncated artifact must all be invisible — the poll lands on the
+    newest COMPLETE generation, never a torn or older one."""
+    root = str(tmp_path)
+    _save_two(root)  # valid steps 1 and 2
+    # killed mid-artifact-write at step 3: torn tmp only
+    open(os.path.join(root, ".tmp.1.step_00000003.npz"), "wb") \
+        .write(b"half")
+    # killed between artifact replace and manifest write at step 4
+    orbax_ckpt.save_auto(orbax_ckpt.step_path(root, 4) + ".npz", 40,
+                         _params(4.0), {})
+    # manifest committed at step 5 but artifact bytes later mangled
+    p5 = orbax_ckpt.step_path(root, 5) + ".npz"
+    orbax_ckpt.save_auto(p5, 50, _params(5.0), {})
+    orbax_ckpt.write_step_manifest(root, 5, 50, p5)
+    with open(p5, "r+b") as f:
+        f.truncate(os.path.getsize(p5) // 2)
+
+    latest = latest_step(root)
+    assert latest == 2
+    artifact = validate_step(root, latest)
+    assert artifact is not None
+    it, params, _state = restore_auto(artifact)
+    assert it == 20
+    np.testing.assert_array_equal(params["w"], _params(2.0)["w"])
+
+
+def test_wait_for_step_blocks_until_valid_and_times_out(tmp_path):
+    """orbax_ckpt.wait_for_step (the watcher's bootstrap primitive):
+    returns None on timeout over an empty root, wakes when a concurrent
+    save_step commits, and `newer_than` skips already-promoted steps."""
+    import time  # sleep only: staging the concurrent writer
+
+    root = str(tmp_path)
+    assert orbax_ckpt.wait_for_step(root, timeout_s=0.2,
+                                    poll_s=0.02) is None
+
+    def late_writer(step):
+        time.sleep(0.15)  # sleep only: let the waiter start polling
+        save_step(root, step, step * 10, _params(float(step)), {})
+
+    t = threading.Thread(target=late_writer, args=(0,))
+    t.start()
+    try:
+        assert orbax_ckpt.wait_for_step(root, timeout_s=10.0,
+                                        poll_s=0.02) == 0
+    finally:
+        t.join()
+    # step 0 exists but is not newer than 0: must time out, not return it
+    assert orbax_ckpt.wait_for_step(root, newer_than=0, timeout_s=0.2,
+                                    poll_s=0.02) is None
+    t = threading.Thread(target=late_writer, args=(1,))
+    t.start()
+    try:
+        assert orbax_ckpt.wait_for_step(root, newer_than=0,
+                                        timeout_s=10.0, poll_s=0.02) == 1
+    finally:
+        t.join()

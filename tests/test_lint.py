@@ -794,13 +794,18 @@ def test_lint_gate_script(tmp_path):
     # would re-trace the round; tests below cover the check itself)
     assert "--contract" in text
     assert "SPARKNET_LINT_GATE_NO_CONTRACT" in text
+    # the train-while-serve smoke rides the gate too (exercised live by
+    # tests/test_deploy.py's e2e session test)
+    assert "trainserve_run.py --smoke" in text
+    assert "SPARKNET_LINT_GATE_NO_TRAINSERVE" in text
     clean = _mkpkg(tmp_path, {"ok.py": "x = 1\n"})
     dirty_dir = tmp_path / "dirty"
     dirty_dir.mkdir()
     (dirty_dir / "bad.py").write_text("import time\nT = time.time()\n")
     env = dict(os.environ, JAX_PLATFORMS="cpu",
                SPARKNET_LINT_GATE_NO_PROC="1",
-               SPARKNET_LINT_GATE_NO_CONTRACT="1")
+               SPARKNET_LINT_GATE_NO_CONTRACT="1",
+               SPARKNET_LINT_GATE_NO_TRAINSERVE="1")
     rc_clean = subprocess.run(
         ["bash", gate, clean, "--select", "R001"],
         cwd=REPO, env=env, capture_output=True, text=True)

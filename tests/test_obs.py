@@ -426,8 +426,8 @@ def test_bench_stamp_provenance():
 
     payload = {"metric": "x", "value": 1.0}
     out = bench._stamp(payload)
-    # v4: the elastic leg's process-level arm (elastic_proc_* fields)
-    assert out["schema_version"] == bench.BENCH_SCHEMA_VERSION == 4
+    # v5: the trainserve leg (train-while-serve trainserve_* fields)
+    assert out["schema_version"] == bench.BENCH_SCHEMA_VERSION == 5
     assert "git_sha" in out and "env" in out
     assert all(k.startswith("SPARKNET_") for k in out["env"])
     assert out["value"] == 1.0
