@@ -576,6 +576,58 @@ def bench_trainserve():
     return out
 
 
+def bench_serving_resilience():
+    """Serving degradation drill via `scripts/serve_chaos_run.py --smoke`
+    in a subprocess: a seeded ServeFaultPlan (replica error-storm + hard
+    kill + latency spikes) under flash-crowd load against a live
+    3-replica server with the resilience control plane armed — the
+    record carries breaker trips/respawns, recovery time, sheds (batch
+    only), deadline drops, interactive p99, and the exactly-once bar
+    (dropped must be 0 or the leg raises; the smoke itself also asserts
+    bitwise fault-schedule replay and single-generation responses).
+
+    A subprocess for a clean CPU backend and because the smoke's exit
+    code IS the pass/fail signal; re-raises on a non-zero exit or a
+    not-ok line so the guarded leg in _run_legs omits the fields."""
+    import subprocess
+
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+    script = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "scripts", "serve_chaos_run.py")
+    proc = subprocess.run(
+        [sys.executable, script, "--smoke"],
+        capture_output=True, text=True, env=env, timeout=900)
+    if proc.returncode != 0:
+        raise RuntimeError(
+            f"serve_chaos_run.py exited {proc.returncode}: "
+            f"{proc.stderr.strip()[-500:]}")
+    # serve_chaos_run prints ONE JSON line on stdout (chaos_run contract)
+    rec = json.loads(proc.stdout.strip().splitlines()[-1])
+    if not rec.get("ok"):
+        raise RuntimeError(f"serve_chaos_run.py reported not-ok: {rec}")
+    if rec.get("dropped"):
+        raise RuntimeError(
+            f"serve chaos dropped {rec['dropped']} requests (every "
+            f"request must be answered exactly once): {rec}")
+    out = {"serving_resilience_requests": int(rec["requests"]),
+           "serving_resilience_completed": int(rec["completed"]),
+           "serving_resilience_dropped": int(rec["dropped"]),
+           "serving_resilience_sheds": int(rec["sheds"]),
+           "serving_resilience_deadline_drops": int(
+               rec["deadline_drops"]),
+           "serving_resilience_breaker_trips": int(rec["breaker_trips"]),
+           "serving_resilience_respawns": int(rec["respawns"]),
+           "serving_resilience_recovery_s": rec["recovery_s"],
+           "serving_resilience_interactive_p99_ms": rec[
+               "interactive_p99_ms"],
+           "serving_resilience_replay_bitwise": bool(
+               rec["replay_bitwise"])}
+    log(json.dumps(out))
+    return out
+
+
 def bench_longctx_lm(seq_len: int = 16384, n_layers: int = 4,
                      d_model: int = 512, heads: int = 8,
                      block: int = 1024):
@@ -867,6 +919,15 @@ _KNOWN_FIELDS = {
     "trainserve_swap_p99_delta_ms", "trainserve_dropped",
     "trainserve_completed", "trainserve_generations",
     "trainserve_agreement_mean", "trainserve_traffic_records",
+    # serving resilience drill (schema v6): seeded replica chaos under
+    # flash-crowd load — breaker trips, respawns, sheds, zero-drop bar
+    "serving_resilience_requests", "serving_resilience_completed",
+    "serving_resilience_dropped", "serving_resilience_sheds",
+    "serving_resilience_deadline_drops",
+    "serving_resilience_breaker_trips", "serving_resilience_respawns",
+    "serving_resilience_recovery_s",
+    "serving_resilience_interactive_p99_ms",
+    "serving_resilience_replay_bitwise",
 }
 
 # every leg name main() lands; leg_utc stamps outside this set (renamed
@@ -876,7 +937,7 @@ _KNOWN_LEGS = {
     "alexnet_train", "googlenet_train_b64", "googlenet_train_b128",
     "alexnet_infer", "googlenet_infer", "longctx_lm", "cifar_e2e",
     "imagenet_native", "serving", "serving_int8", "serving_mesh",
-    "elastic", "trainserve",
+    "elastic", "trainserve", "serving_resilience",
 }
 
 
@@ -959,7 +1020,11 @@ def _stale_record(reason: str) -> dict:
     return stale
 
 
-BENCH_SCHEMA_VERSION = 5  # v5: trainserve leg (train-while-serve loop —
+BENCH_SCHEMA_VERSION = 6  # v6: serving_resilience leg (degradation
+#                           drill — breaker trips/respawns, recovery_s,
+#                           sheds, interactive p99, dropped==0 bar;
+#                           serve_chaos_run.py subprocess);
+#                           v5: trainserve leg (train-while-serve loop —
 #                           promotions, staleness mean/max, swap p99
 #                           delta, dropped==0 bar; trainserve_run.py
 #                           subprocess);
@@ -1300,6 +1365,22 @@ def _run_legs(land) -> None:
             "trainserve_swap_p99_delta_ms", "trainserve_dropped",
             "trainserve_completed", "trainserve_generations",
             "trainserve_agreement_mean", "trainserve_traffic_records")})
+    # serving degradation drill (subprocess; CPU path) — breaker trips,
+    # recovery, sheds, exactly-once bar under seeded replica chaos
+    try:
+        resil = bench_serving_resilience()
+    except Exception as e:
+        log(f"serving_resilience leg failed, omitting its fields: {e!r}")
+    else:
+        land("serving_resilience", {k: resil[k] for k in (
+            "serving_resilience_requests", "serving_resilience_completed",
+            "serving_resilience_dropped", "serving_resilience_sheds",
+            "serving_resilience_deadline_drops",
+            "serving_resilience_breaker_trips",
+            "serving_resilience_respawns",
+            "serving_resilience_recovery_s",
+            "serving_resilience_interactive_p99_ms",
+            "serving_resilience_replay_bitwise")})
     try:
         imgnet_native = bench_imagenet_native()
     except Exception as e:

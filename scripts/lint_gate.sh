@@ -17,6 +17,9 @@
 # and SPARKNET_LINT_GATE_NO_TRAINSERVE=1 to skip the train-while-serve
 # smoke (scripts/trainserve_run.py: tiny lenet trainer subprocess + live
 # server, >= 2 hot promotions with dropped_requests == 0).
+# SPARKNET_LINT_GATE_NO_SERVECHAOS=1 skips the serving-resilience smoke
+# (scripts/serve_chaos_run.py: seeded error-storm + hard kill under a
+# flash crowd; breakers trip/respawn/re-admit, zero dropped requests).
 set -euo pipefail
 cd "$(dirname "$0")/.."
 python -m sparknet_tpu.cli lint --format json "$@"
@@ -39,4 +42,14 @@ if [ "${SPARKNET_LINT_GATE_NO_TRAINSERVE:-0}" != "1" ]; then
     # non-zero on a miss; prints ONE JSON line)
     timeout -k 10 420 env JAX_PLATFORMS=cpu \
         python scripts/trainserve_run.py --smoke
+fi
+if [ "${SPARKNET_LINT_GATE_NO_SERVECHAOS:-0}" != "1" ]; then
+    # serving-resilience smoke: seeded fault plan (error storm + hard
+    # kill + latency spikes) under a flash crowd; asserts breaker
+    # trips/evictions/respawns/half-open re-admission, exactly-once
+    # delivery, interactive p99 under SLO, and bitwise fault-schedule
+    # replay (--smoke exits non-zero on a miss; prints ONE JSON line)
+    timeout -k 10 420 env JAX_PLATFORMS=cpu \
+        XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+        python scripts/serve_chaos_run.py --smoke
 fi

@@ -1,0 +1,323 @@
+"""Serving resilience drill: seeded replica faults under flash-crowd
+load against a live InferenceServer, printing ONE JSON line (the
+bench.py `serving_resilience` leg subprocess protocol — same contract
+as chaos_run.py / trainserve_run.py).
+
+Default (smoke) scenario, tuned to finish in well under a minute on one
+CPU core:
+  - lenet over 3 replicas with the resilience control plane armed
+    (serving/resilience.py),
+  - a ServeFaultPlan injecting one replica error-storm (replica 0), one
+    hard kill (replica 1), and a latency spike on every replica so the
+    flash crowd deterministically outruns service capacity,
+  - a seeded open-loop flash crowd (rate steps up `--shape_factor`x at
+    the halfway mark) with a ~70/30 interactive/batch priority mix and
+    a deadline tag on a slice of the interactive traffic.
+
+--smoke asserts the acceptance bar and exits non-zero on a miss:
+breakers trip for BOTH faulted replicas, both are evicted + respawned +
+re-admitted through half-open probes (all breakers closed at the end),
+every request is answered exactly once with a status (dropped == 0) and
+a single generation stamp, interactive traffic absorbs ZERO sheds and
+its p99 stays under the SLO, sheds/deadline drops reconcile exactly
+across client observations, stats() counters, and JSONL events, and the
+fault SCHEDULE replays bitwise (two same-seed plan constructions agree
+on every (replica, dispatch) decision — the live event interleaving
+naturally varies with thread timing; determinism is defined over the
+schedule, like elastic/chaos.py).
+
+Run:  python scripts/serve_chaos_run.py --smoke [--requests 240]
+      [--qps 300] [--replicas 3] [--spec 'errstorm:0@6+10,kill:1@4']
+      [--workdir DIR]
+"""
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import time
+
+# force the CPU platform BEFORE any backend use; the box's sitecustomize
+# pre-imports jax, so the live-config update is what actually takes
+# effect (tests/conftest.py pattern)
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+DEFAULT_SPEC = ("errstorm:0@6+10,kill:1@4,"
+                "spike:0@0+4000x8,spike:1@0+4000x8,spike:2@0+4000x8")
+
+
+def _pct(vals, q):
+    import numpy as np
+
+    if not vals:
+        return 0.0
+    return round(float(np.percentile(np.asarray(vals, np.float64), q)), 3)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="serve_chaos_run",
+        description="serving resilience drill (ONE JSON line on stdout)")
+    ap.add_argument("--smoke", action="store_true",
+                    help="assert the degradation-drill acceptance bar "
+                         "and exit non-zero on a miss")
+    ap.add_argument("--workdir", default=None)
+    ap.add_argument("--model", default="lenet")
+    ap.add_argument("--requests", type=int, default=240)
+    ap.add_argument("--qps", type=float, default=300.0)
+    ap.add_argument("--shape_factor", type=float, default=4.0,
+                    help="flash-crowd rate multiplier from the halfway "
+                         "mark")
+    ap.add_argument("--replicas", type=int, default=3)
+    ap.add_argument("--max_batch", type=int, default=4)
+    ap.add_argument("--queue_depth", type=int, default=96)
+    ap.add_argument("--seed", type=int, default=7)
+    ap.add_argument("--spec", default=DEFAULT_SPEC,
+                    help="ServeFaultPlan token spec "
+                         "(serving/resilience.py grammar)")
+    ap.add_argument("--slo_ms", type=float, default=2000.0)
+    ap.add_argument("--shed_fraction", type=float, default=0.125)
+    ap.add_argument("--cooldown_s", type=float, default=0.2)
+    ap.add_argument("--interactive_frac", type=float, default=0.7)
+    ap.add_argument("--deadline_every", type=int, default=10,
+                    help="every Nth interactive request carries a tight "
+                         "deadline (0 disables)")
+    ap.add_argument("--deadline_ms", type=float, default=40.0)
+    ap.add_argument("--recovery_timeout_s", type=float, default=45.0)
+    ap.add_argument("--parity_checks", type=int, default=12)
+    a = ap.parse_args(argv)
+
+    import numpy as np
+
+    from sparknet_tpu.serving import (InferenceServer, RequestShed,
+                                      ResilienceConfig, ServeFaultPlan,
+                                      ServerConfig, ServingError,
+                                      pad_to_bucket)
+
+    workdir = a.workdir or tempfile.mkdtemp(prefix="sparknet-servechaos-")
+    os.makedirs(workdir, exist_ok=True)
+    event_log = os.path.join(workdir, "serve_events.jsonl")
+
+    # two independent constructions of the plan: the bitwise-replay
+    # contract is over the fault SCHEDULE (pure function of seed), so
+    # their decision digests must agree exactly
+    plan = ServeFaultPlan.from_spec(a.spec, seed=a.seed)
+    plan_replay = ServeFaultPlan.from_spec(a.spec, seed=a.seed)
+    digest = plan.schedule_digest(a.replicas, 2048)
+    replay_bitwise = digest == plan_replay.schedule_digest(a.replicas,
+                                                           2048)
+
+    rcfg = ResilienceConfig(
+        cooldown_s=a.cooldown_s, slo_ms=a.slo_ms,
+        shed_fraction=a.shed_fraction, fault_plan=plan,
+        event_log=event_log)
+    cfg = ServerConfig(max_batch=a.max_batch, max_wait_ms=2.0,
+                       queue_depth=a.queue_depth, resilience=rcfg)
+    server = InferenceServer(cfg)
+    t_start = time.perf_counter()
+    lm = server.load(a.model, seed=a.seed, replicas=a.replicas)
+    print(f"loaded {a.model}: {lm.n_replicas} replicas, buckets "
+          f"{lm.runner.buckets}; spec {a.spec!r}", file=sys.stderr,
+          flush=True)
+
+    rng = np.random.RandomState(a.seed)
+    pool = rng.rand(64, *lm.runner.sample_shape).astype(np.float32)
+    pris = ["interactive" if rng.rand() < a.interactive_frac else "batch"
+            for _ in range(a.requests)]
+    unit = rng.exponential(1.0, size=a.requests)
+
+    futs = []            # (rid, priority, future)
+    sync_rejects = {}    # error type name -> count
+    shed_client = 0
+    deadline_client_submit = 0
+    t0 = time.perf_counter()
+    next_t = t0
+    for i in range(a.requests):
+        mult = a.shape_factor if i / a.requests >= 0.5 else 1.0
+        next_t += unit[i] / (a.qps * mult)
+        now = time.perf_counter()
+        if next_t > now:
+            time.sleep(next_t - now)
+        kw = {}
+        if (a.deadline_every and pris[i] == "interactive"
+                and i % a.deadline_every == 0):
+            kw["deadline_ms"] = a.deadline_ms
+        try:
+            futs.append((i, pris[i],
+                         server.submit(a.model, pool[i % 64],
+                                       priority=pris[i], **kw)))
+        except ServingError as e:
+            kind = type(e).__name__
+            sync_rejects[kind] = sync_rejects.get(kind, 0) + 1
+            if isinstance(e, RequestShed):
+                shed_client += 1
+            elif kind == "DeadlineExceeded":
+                deadline_client_submit += 1
+    offered_s = time.perf_counter() - t0
+
+    lat_by_pri = {"interactive": [], "batch": []}
+    generations = set()
+    async_errs = {}
+    dropped = 0
+    parity_failed = 0
+    parity_checked = 0
+    for rid, pri, fut in futs:
+        try:
+            r = fut.result(timeout=120)
+        except ServingError as e:
+            kind = type(e).__name__
+            async_errs[kind] = async_errs.get(kind, 0) + 1
+            continue
+        except Exception:
+            dropped += 1      # future died without a serving status
+            continue
+        lat_by_pri[pri].append(r.total_ms)
+        generations.add(r.generation)
+        if parity_checked < a.parity_checks:
+            # PR-8 parity pin, extended over the resilience path: a
+            # response — even one requeued/retried across replicas or
+            # served by a respawned runner — is bitwise-replayable by a
+            # direct forward at its recorded bucket (same params, same
+            # program; the generation never bumped)
+            parity_checked += 1
+            ref = lm.runner.forward_padded(pad_to_bucket(
+                pool[rid % 64][None], r.bucket))[0]
+            if not np.array_equal(np.asarray(r.probs), ref):
+                parity_failed += 1
+
+    # recovery: every breaker must walk open -> respawn -> half-open
+    # probes -> closed; poll the control plane (bounded)
+    mgr = server.resilience(a.model)
+    t_rec = time.perf_counter()
+    while (not mgr.all_closed()
+           and time.perf_counter() - t_rec < a.recovery_timeout_s):
+        time.sleep(0.05)
+    recovered = mgr.all_closed()
+    stats = server.stats()
+    events = mgr.events_snapshot()
+    resil = stats["models"][a.model]["resilience"]
+    server.close(drain=True)
+
+    m = stats["models"][a.model]
+    ev_by_kind = {}
+    for e in events:
+        ev_by_kind[e["kind"]] = ev_by_kind.get(e["kind"], 0) + 1
+    with open(event_log) as f:
+        logged = [json.loads(line) for line in f if line.strip()]
+
+    answered = (m["completed"] + sum(sync_rejects.values())
+                + sum(async_errs.values()))
+    summary = {
+        "ok": True,
+        "model": a.model,
+        "replicas": a.replicas,
+        "spec": a.spec,
+        "seed": a.seed,
+        "requests": a.requests,
+        "offered_qps": a.qps,
+        "shape_factor": a.shape_factor,
+        "offered_s": round(offered_s, 3),
+        "elapsed_s": round(time.perf_counter() - t_start, 3),
+        "completed": m["completed"],
+        "answered": answered,
+        "dropped": dropped + (a.requests - answered),
+        "sync_rejects": dict(sorted(sync_rejects.items())),
+        "async_errors": dict(sorted(async_errs.items())),
+        "sheds": resil["sheds"],
+        "sheds_by_priority": resil["sheds_by_priority"],
+        "stat_rejected_shed": m["rejected_shed"],
+        "deadline_drops": resil["deadline_drops"],
+        "stat_rejected_deadline": m["rejected_deadline"],
+        "breaker_trips": resil["trips"],
+        "respawns": resil["respawns"],
+        "requeued": resil["requeued"],
+        "retried": resil["retried"],
+        "probes_ok": resil["probes_ok"],
+        "probes_failed": resil["probes_failed"],
+        "breakers": resil["breakers"],
+        "recovered": recovered,
+        "recovery_s": max([0.0] + list(
+            float(v) for v in resil["recovery_s"].values())),
+        "interactive_p50_ms": _pct(lat_by_pri["interactive"], 50),
+        "interactive_p99_ms": _pct(lat_by_pri["interactive"], 99),
+        "batch_p99_ms": _pct(lat_by_pri["batch"], 99),
+        "slo_ms": a.slo_ms,
+        "generations": sorted(generations),
+        "parity_checked": parity_checked,
+        "parity_failed": parity_failed,
+        "replay_bitwise": replay_bitwise,
+        "schedule_digest": digest,
+        "events": dict(sorted(ev_by_kind.items())),
+        "events_logged": len(logged),
+        "workdir": workdir,
+    }
+
+    if a.smoke:
+        problems = []
+        if not replay_bitwise:
+            problems.append("fault schedule did not replay bitwise")
+        if summary["breaker_trips"] < 2:
+            problems.append(f"breaker trips "
+                            f"{summary['breaker_trips']} < 2 "
+                            f"(error storm + hard kill must both trip)")
+        if summary["respawns"] < 2:
+            problems.append(f"respawns {summary['respawns']} < 2")
+        if not recovered:
+            problems.append(f"breakers not all closed after "
+                            f"{a.recovery_timeout_s}s: "
+                            f"{summary['breakers']}")
+        if summary["dropped"] != 0:
+            problems.append(f"dropped {summary['dropped']} != 0 "
+                            f"(every request must be answered)")
+        if summary["sheds"] < 1:
+            problems.append("no sheds under flash crowd")
+        if summary["sheds_by_priority"].get("interactive", 0) != 0:
+            problems.append(
+                f"interactive sheds "
+                f"{summary['sheds_by_priority']['interactive']} != 0 "
+                f"(batch must absorb 100% of sheds)")
+        if summary["stat_rejected_shed"] != summary["sheds"]:
+            problems.append(
+                f"shed accounting mismatch: stats "
+                f"{summary['stat_rejected_shed']} != control plane "
+                f"{summary['sheds']}")
+        if ev_by_kind.get("shed", 0) != summary["sheds"]:
+            problems.append(
+                f"shed events {ev_by_kind.get('shed', 0)} != sheds "
+                f"{summary['sheds']}")
+        if ev_by_kind.get("deadline_drop", 0) != \
+                summary["deadline_drops"]:
+            problems.append(
+                f"deadline_drop events "
+                f"{ev_by_kind.get('deadline_drop', 0)} != drops "
+                f"{summary['deadline_drops']}")
+        if len(logged) != len(events):
+            problems.append(f"event log lines {len(logged)} != "
+                            f"in-memory events {len(events)}")
+        if summary["interactive_p99_ms"] > a.slo_ms:
+            problems.append(
+                f"interactive p99 {summary['interactive_p99_ms']} ms "
+                f"over SLO {a.slo_ms} ms")
+        if summary["generations"] not in ([], [0]):
+            problems.append(f"mixed/bumped generations "
+                            f"{summary['generations']} (respawn must "
+                            f"not change the generation)")
+        if parity_failed:
+            problems.append(f"{parity_failed} responses failed the "
+                            f"bitwise replay parity pin")
+        if problems:
+            summary["ok"] = False
+            summary["problems"] = problems
+    print(json.dumps(summary), flush=True)
+    return 0 if summary.get("ok") else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

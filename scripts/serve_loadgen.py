@@ -15,6 +15,17 @@ rather than one hot model; the summary then carries per-model p50/p99
 next to the aggregate.  `--replicas N` spreads every loaded model over
 the device mesh (0 = one replica per device).
 
+Open-loop traffic can be SHAPED (`--shape diurnal|spike|flash_crowd`):
+the seeded exponential inter-arrival gaps are scaled by a deterministic
+rate profile over the run — a sinusoidal day (diurnal), a narrow
+mid-run burst (spike), or a sustained rate step at the halfway mark
+(flash_crowd, `--shape_factor`x) — so overload/resilience drills stop
+hand-rolling Poisson rates.  `--priority-mix interactive=0.7,batch=0.3`
+tags each request with a seeded priority class; with a
+resilience-enabled server (`--resilience`), batch traffic absorbs the
+SLO-aware sheds and the summary reports per-priority percentiles plus
+the shed/deadline-drop counts.
+
 Prints per-phase progress on stderr and ONE summary JSON line on stdout;
 with `--jsonl out.jsonl` it also appends one record per request (id,
 model, replica, bucket, queue_wait/assembly/device/total ms, or the
@@ -35,12 +46,61 @@ Examples:
 
 import argparse
 import json
+import math
 import os
 import sys
 import threading
 import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+SHAPES = ("constant", "diurnal", "spike", "flash_crowd")
+
+
+def _rate_multiplier(shape: str, progress: float, factor: float) -> float:
+    """Deterministic offered-rate profile at `progress` in [0, 1):
+    diurnal = one sinusoidal day over the run; spike = a factor-x burst
+    over the middle tenth; flash_crowd = a sustained factor-x step from
+    the halfway mark (the resilience drill's overload shape)."""
+    if shape == "diurnal":
+        return max(0.1, 1.0 + 0.6 * math.sin(2.0 * math.pi * progress))
+    if shape == "spike":
+        return factor if 0.45 <= progress < 0.55 else 1.0
+    if shape == "flash_crowd":
+        return factor if progress >= 0.5 else 1.0
+    return 1.0
+
+
+def _parse_priority_mix(spec):
+    """'interactive=0.7,batch=0.3' -> ({name: weight}, normalized);
+    None -> all-interactive.  Unknown classes and non-positive weights
+    are config errors."""
+    if not spec:
+        return None
+    out = {}
+    for part in spec.replace(" ", "").split(","):
+        if not part:
+            continue
+        name, sep, w = part.partition("=")
+        if not sep:
+            raise SystemExit(f"--priority-mix entry {part!r} needs "
+                             f"name=weight")
+        if name not in ("interactive", "batch"):
+            raise SystemExit(f"--priority-mix class {name!r} must be "
+                             f"'interactive' or 'batch'")
+        try:
+            weight = float(w)
+        except ValueError:
+            raise SystemExit(f"--priority-mix weight {w!r} for {name!r} "
+                             f"is not a number")
+        if weight <= 0:
+            raise SystemExit(f"--priority-mix weight for {name!r} must "
+                             f"be > 0, got {weight}")
+        out[name] = weight
+    if not out:
+        raise SystemExit("--priority-mix parsed to an empty mix")
+    total = sum(out.values())
+    return {k: v / total for k, v in out.items()}
 
 
 def _parse_models(spec: str):
@@ -81,6 +141,24 @@ def main() -> None:
     p.add_argument("--mode", choices=("closed", "open"), default="open")
     p.add_argument("--qps", type=float, default=200.0,
                    help="offered load (open loop only)")
+    p.add_argument("--shape", choices=SHAPES, default="constant",
+                   help="open-loop offered-rate profile over the run "
+                        "(seeded + deterministic): diurnal sinusoid, "
+                        "mid-run spike, or flash_crowd rate step")
+    p.add_argument("--shape_factor", type=float, default=4.0,
+                   help="peak rate multiplier for spike/flash_crowd")
+    p.add_argument("--priority-mix", dest="priority_mix", default=None,
+                   help="seeded per-request priority classes, e.g. "
+                        "'interactive=0.7,batch=0.3' (default: all "
+                        "interactive)")
+    p.add_argument("--resilience", action="store_true",
+                   help="serve with the resilience control plane armed "
+                        "(circuit breakers + SLO-aware batch shedding; "
+                        "serving/resilience.py)")
+    p.add_argument("--slo_ms", type=float, default=None,
+                   help="interactive latency SLO for the shed "
+                        "controller (with --resilience; default "
+                        "SPARKNET_SERVE_SLO_MS)")
     p.add_argument("--concurrency", type=int, default=8,
                    help="worker threads (closed loop only)")
     p.add_argument("--requests", type=int, default=500)
@@ -107,6 +185,14 @@ def main() -> None:
     a = p.parse_args()
     if a.model and a.models:
         raise SystemExit("pass --model OR --models, not both")
+    if a.shape != "constant" and a.mode != "open":
+        raise SystemExit("--shape applies to the open loop only (a "
+                         "closed loop self-throttles; its rate cannot "
+                         "be shaped)")
+    if a.shape_factor <= 0:
+        raise SystemExit(f"--shape_factor must be > 0, "
+                         f"got {a.shape_factor}")
+    pri_mix = _parse_priority_mix(a.priority_mix)
     mix = _parse_models(a.models) if a.models else [(a.model or "lenet",
                                                      1.0)]
     if a.weights and len(mix) > 1:
@@ -140,6 +226,13 @@ def main() -> None:
         queue_depth=a.queue_depth, default_deadline_ms=a.deadline_ms)
     if a.min_fill is not None:
         cfg.min_fill = a.min_fill
+    if a.resilience:
+        from sparknet_tpu.serving import ResilienceConfig
+
+        rcfg = ResilienceConfig()
+        if a.slo_ms is not None:
+            rcfg.slo_ms = a.slo_ms
+        cfg.resilience = rcfg
     server = InferenceServer(cfg)
     traffic = None
     if a.log:
@@ -148,26 +241,42 @@ def main() -> None:
         traffic = TrafficLogger(a.log,
                                 model=a.model if not a.models else None)
     rejects = {"n": 0}
+    rejects_by_type = {}
+    lat_by_pri = {"interactive": [], "batch": []}
     rejects_lock = threading.Lock()
 
-    def settle(rid, name, fut, t_submit):
+    def settle(rid, name, fut, t_submit, pri="interactive"):
         """Wait one future; record its disposition."""
         try:
             r = fut.result(timeout=120)
         except ServingError as e:
             with rejects_lock:
                 rejects["n"] += 1
-            record({"id": rid, "model": name,
+                kind = type(e).__name__
+                rejects_by_type[kind] = rejects_by_type.get(kind, 0) + 1
+            record({"id": rid, "model": name, "priority": pri,
                     "error": type(e).__name__, "status": e.status})
             return None
+        with rejects_lock:
+            lat_by_pri[pri].append(r.total_ms)
         record({"id": rid, "model": name, "replica": r.replica,
-                "bucket": r.bucket,
+                "priority": pri, "bucket": r.bucket,
                 "queue_wait_ms": r.queue_wait_ms,
                 "assembly_ms": r.assembly_ms,
                 "device_ms": r.device_ms, "total_ms": r.total_ms,
                 "client_ms": round((time.perf_counter() - t_submit) * 1e3,
                                    4)})
         return r
+
+    def reject_now(rid, name, pri, e):
+        """A submit() that raised synchronously (overload / shed /
+        dead-on-arrival deadline)."""
+        with rejects_lock:
+            rejects["n"] += 1
+            kind = type(e).__name__
+            rejects_by_type[kind] = rejects_by_type.get(kind, 0) + 1
+        record({"id": rid, "model": name, "priority": pri,
+                "error": type(e).__name__, "status": e.status})
 
     try:
         pools = {}
@@ -194,29 +303,41 @@ def main() -> None:
         # pre-draw the per-request model choice so open and closed loops
         # offer the identical traffic mix for a given seed
         choices = rng.choice(len(names), size=a.requests, p=weights)
+        # pre-drawn seeded priority tags — the same seed offers the
+        # same interactive/batch interleaving in both loop modes
+        if pri_mix is not None:
+            pri_names = sorted(pri_mix)
+            pris = [pri_names[j] for j in rng.choice(
+                len(pri_names), size=a.requests,
+                p=[pri_mix[k] for k in pri_names])]
+        else:
+            pris = ["interactive"] * a.requests
 
         t0 = time.perf_counter()
         if a.mode == "open":
-            gaps = rng.exponential(1.0 / a.qps, size=a.requests)
+            # scale[i] * standard-exponential is numpy's exponential()
+            # internally, so the constant shape reproduces the old
+            # rng.exponential(1/qps) stream bitwise for a given seed
+            unit = rng.exponential(1.0, size=a.requests)
             futs, next_t = [], t0
             for i in range(a.requests):
                 name = names[choices[i]]
-                next_t += gaps[i]
+                mult = _rate_multiplier(a.shape, i / a.requests,
+                                        a.shape_factor)
+                next_t += unit[i] / (a.qps * mult)
                 now = time.perf_counter()
                 if next_t > now:
                     time.sleep(next_t - now)
                 try:
                     futs.append((i, name,
                                  server.submit(name,
-                                               pools[name][i % 64]),
+                                               pools[name][i % 64],
+                                               priority=pris[i]),
                                  time.perf_counter()))
                 except ServingError as e:
-                    with rejects_lock:
-                        rejects["n"] += 1
-                    record({"id": i, "model": name,
-                            "error": type(e).__name__, "status": e.status})
+                    reject_now(i, name, pris[i], e)
             for rid, name, fut, ts in futs:
-                settle(rid, name, fut, ts)
+                settle(rid, name, fut, ts, pris[rid])
         else:
             counter = {"next": 0}
             counter_lock = threading.Lock()
@@ -232,15 +353,12 @@ def main() -> None:
                     ts = time.perf_counter()
                     try:
                         fut = server.submit(name, pools[name][rid % 64],
-                                            wait=True)
+                                            wait=True,
+                                            priority=pris[rid])
                     except ServingError as e:
-                        with rejects_lock:
-                            rejects["n"] += 1
-                        record({"id": rid, "model": name,
-                                "error": type(e).__name__,
-                                "status": e.status})
+                        reject_now(rid, name, pris[rid], e)
                         continue
-                    settle(rid, name, fut, ts)
+                    settle(rid, name, fut, ts, pris[rid])
 
             threads = [threading.Thread(target=worker, daemon=True)
                        for _ in range(a.concurrency)]
@@ -299,6 +417,33 @@ def main() -> None:
                     stats[n]["queue_wait_ms"]["p99_ms"]})
     if a.mode == "open":
         out["offered_qps"] = a.qps
+        out["shape"] = a.shape
+        if a.shape in ("spike", "flash_crowd"):
+            out["shape_factor"] = a.shape_factor
+    if rejects_by_type:
+        out["rejected_by_type"] = dict(sorted(rejects_by_type.items()))
+    if pri_mix is not None:
+        def _pcts(vals):
+            if not vals:
+                return {"count": 0}
+            v = np.asarray(vals, dtype=np.float64)
+            return {"count": int(len(v)),
+                    "p50_ms": round(float(np.percentile(v, 50)), 4),
+                    "p99_ms": round(float(np.percentile(v, 99)), 4)}
+        out["priority_mix"] = {k: round(v, 4)
+                               for k, v in sorted(pri_mix.items())}
+        out["per_priority"] = {k: _pcts(lat_by_pri[k])
+                               for k in sorted(lat_by_pri)}
+    if a.resilience:
+        resil = None
+        for n in names:
+            resil = stats[n].get("resilience")
+            if resil:
+                break
+        if resil is not None:
+            out["sheds"] = resil["sheds"]
+            out["deadline_drops"] = resil["deadline_drops"]
+            out["breaker_trips"] = resil["trips"]
     if traffic is not None:
         out["traffic_records"] = traffic.records_logged
         out["traffic_shards"] = traffic.shards_written

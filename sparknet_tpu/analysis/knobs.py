@@ -39,6 +39,20 @@ KNOBS: Dict[str, str] = {
     "SPARKNET_SERVE_REPLICAS": "serving replicas placed per loaded model",
     "SPARKNET_SERVE_MIN_FILL": "batch rows a replica waits for before "
                                "dispatching",
+    "SPARKNET_SERVE_SUBMIT_TIMEOUT_S": "bound on blocking "
+                                       "submit(wait=True) backpressure",
+    "SPARKNET_SERVE_BREAKER_WINDOW": "rolling outcome window per "
+                                     "replica circuit breaker",
+    "SPARKNET_SERVE_BREAKER_ERRS": "error fraction that trips a "
+                                   "replica breaker",
+    "SPARKNET_SERVE_BREAKER_COOLDOWN_S": "open-breaker cooldown before "
+                                         "half-open probing",
+    "SPARKNET_SERVE_PROBES": "consecutive half-open probe successes "
+                             "that close a breaker",
+    "SPARKNET_SERVE_SLO_MS": "interactive latency SLO the shed "
+                             "controller protects",
+    "SPARKNET_SERVE_SHED_FRACTION": "queue fraction beyond which "
+                                    "batch-priority requests shed",
     # -- ingest
     "SPARKNET_PREFETCH_DEPTH": "rounds staged ahead by the prefetcher",
     "SPARKNET_INGEST_PROCS": "force multi-process ingest",

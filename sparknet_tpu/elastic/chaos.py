@@ -37,6 +37,12 @@ def _u01(seed: int, *keys) -> float:
     return struct.unpack("<Q", h[:8])[0] / float(1 << 64)
 
 
+# public alias: serving/resilience.py's ServeFaultPlan draws from the
+# SAME keyed-hash stream discipline, so every chaos subsystem shares one
+# determinism story (two same-seed plans agree bitwise on every draw)
+u01 = _u01
+
+
 @dataclasses.dataclass(frozen=True)
 class FaultPlan:
     """Seeded per-run fault schedule.

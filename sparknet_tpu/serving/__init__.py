@@ -19,10 +19,12 @@ load generation: `scripts/serve_loadgen.py`.
 
 from .buckets import bucket_sizes, pad_to_bucket, pick_bucket
 from .engine import ModelRunner, resolve_net_param
-from .errors import (DeadlineExceeded, ModelNotLoaded, ServerClosed,
-                     ServerOverloaded, ServingError)
+from .errors import (DeadlineExceeded, ModelNotLoaded, RequestShed,
+                     ServerClosed, ServerOverloaded, ServingError)
 from .placement import DevicePlacer, resolve_replica_count, serving_mesh
 from .registry import LoadedModel, ModelRegistry
+from .resilience import (CircuitBreaker, ResilienceConfig,
+                         ResilienceManager, ServeFaultPlan)
 from .scheduler import ReplicaScheduler
 from .server import InferenceServer, Response, ServerConfig
 from .stats import LatencySeries, ModelStats
@@ -31,9 +33,11 @@ __all__ = [
     "InferenceServer", "ServerConfig", "Response",
     "ModelRegistry", "LoadedModel", "ModelRunner", "resolve_net_param",
     "ServingError", "ServerOverloaded", "ServerClosed",
-    "DeadlineExceeded", "ModelNotLoaded",
+    "DeadlineExceeded", "ModelNotLoaded", "RequestShed",
     "bucket_sizes", "pick_bucket", "pad_to_bucket",
     "DevicePlacer", "serving_mesh", "resolve_replica_count",
     "ReplicaScheduler",
     "LatencySeries", "ModelStats",
+    "ResilienceConfig", "ResilienceManager", "CircuitBreaker",
+    "ServeFaultPlan",
 ]

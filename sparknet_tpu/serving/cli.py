@@ -6,6 +6,10 @@ serving engine).
     python -m sparknet_tpu.cli serve --model lenet < requests.jsonl
 
 Request lines:  {"id": 7, "data": [[...]]}   # CHW (or flat) sample
+                # optional per-request fields: "priority":
+                # "interactive"|"batch" (SLO-aware shedding with
+                # --resilience) and "deadline_ms": 50 (overrides
+                # --deadline_ms; <= 0 is answered 504 immediately)
 Response lines: {"id": 7, "argmax": 3, "probs": [...], "bucket": 4,
                  "total_ms": 1.9}            # input order preserved
 Rejections:     {"id": 7, "error": "DeadlineExceeded", "status": 504}
@@ -61,6 +65,13 @@ def cmd_serve(args) -> int:
                        default_deadline_ms=args.deadline_ms)
     if args.min_fill is not None:
         cfg.min_fill = args.min_fill
+    if args.resilience:
+        from .resilience import ResilienceConfig
+
+        rcfg = ResilienceConfig()
+        if args.slo_ms is not None:
+            rcfg.slo_ms = args.slo_ms
+        cfg.resilience = rcfg
     server = InferenceServer(cfg)
     name = args.name or "default"
     try:
@@ -138,9 +149,14 @@ def cmd_serve(args) -> int:
                 data = np.asarray(obj["data"], dtype=np.float32)
                 if pre is not None:
                     data = pre.one(data)
+                kw = {}
+                if "deadline_ms" in obj:
+                    kw["deadline_ms"] = float(obj["deadline_ms"])
                 fut = server.submit(
                     name, data,
-                    wait=(args.overload == "wait"))
+                    wait=(args.overload == "wait"),
+                    priority=obj.get("priority", "interactive"),
+                    **kw)
                 pending.append((rid, fut))
             except Exception as e:
                 # a malformed or rejected REQUEST gets an error response
@@ -162,8 +178,10 @@ def cmd_serve(args) -> int:
             with open(args.stats_out, "w") as f:
                 json.dump(stats, f, indent=2)
         m = stats["models"][name]
+        shed_note = (f"{m['rejected_shed']} shed, "
+                     if args.resilience else "")
         print(f"served {m['completed']}/{n_in} requests "
-              f"({m['rejected_overload']} overloaded, "
+              f"({m['rejected_overload']} overloaded, {shed_note}"
               f"{m['rejected_deadline']} past deadline; "
               f"p50 {m['total_ms']['p50_ms']} ms, "
               f"p99 {m['total_ms']['p99_ms']} ms, "
@@ -213,6 +231,15 @@ def register(sub) -> None:
                    choices=["wait", "reject"],
                    help="full queue: block the reader (wait) or emit "
                         "503-style error lines (reject)")
+    s.add_argument("--resilience", action="store_true",
+                   help="arm the resilience control plane "
+                        "(serving/resilience.py): per-replica circuit "
+                        "breakers + SLO-aware shedding of batch-"
+                        "priority requests")
+    s.add_argument("--slo_ms", type=float,
+                   help="interactive latency SLO the shed controller "
+                        "protects (with --resilience; default "
+                        "SPARKNET_SERVE_SLO_MS)")
     s.add_argument("--preprocess", action="store_true",
                    help="treat 'data' as an HWC image: resize + center "
                         "crop to the model input (classify.Preprocessor)")

@@ -252,6 +252,22 @@ class ModelRunner:
                 f"batches of {bucket}")
         return self.quant_agreement
 
+    def health_probe(self, seed: int = 0) -> float:
+        """One seeded single-sample forward at the SMALLEST bucket,
+        value-fetched; returns the latency in ms.  The half-open probe
+        primitive (serving/resilience.py): exercises the same jitted
+        path live traffic uses — padding, dispatch, host fetch — without
+        touching scheduler state, and raises whatever the forward
+        raises so the breaker sees real failures."""
+        from ..obs.trace import now_s
+
+        rng = np.random.RandomState(int(seed) & 0x7FFFFFFF)
+        b = min(self.buckets)
+        x = rng.rand(b, *self.sample_shape).astype(np.float32)
+        t0 = now_s()
+        self.forward_padded(x)
+        return (now_s() - t0) * 1e3
+
     def warmup(self) -> int:
         """Pre-compile every bucket (zeros in, value-fetched out);
         returns the compile count afterwards, which steady-state traffic

@@ -33,6 +33,17 @@ class ServerClosed(ServingError):
     status = 503
 
 
+class RequestShed(ServerOverloaded):
+    """SLO-aware admission (serving/resilience.py): a batch-class
+    request rejected while the server protects interactive latency —
+    queue past the shed fraction, or the interactive EWMA over its SLO.
+    Subclasses ServerOverloaded so existing 503 back-off handlers catch
+    it unchanged; the distinct type lets loadgen/stats attribute sheds
+    exactly (`rejected_shed` vs `rejected_overload`)."""
+
+    status = 503
+
+
 class DeadlineExceeded(ServingError):
     """The request's deadline passed before its batch launched — the 504
     path.  Checked at batch assembly, so an expired request never spends

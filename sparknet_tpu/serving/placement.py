@@ -103,6 +103,7 @@ class DevicePlacer:
         self._lock = threading.Lock()
         self._load = [0] * len(self._devices)      # replicas resident
         self._owners: Dict[str, List[int]] = {}    # model -> device idxs
+        self._evicted: Dict[str, set] = {}         # model -> slot idxs
 
     @property
     def devices(self) -> List:
@@ -135,16 +136,63 @@ class DevicePlacer:
             self._release_locked(name)
 
     def _release_locked(self, name: str) -> None:
-        for i in self._owners.pop(name, ()):
-            self._load[i] -= 1
+        evicted = self._evicted.pop(name, set())
+        for slot, i in enumerate(self._owners.pop(name, ())):
+            if slot not in evicted:    # an evicted slot already gave
+                self._load[i] -= 1     # its residency back
+
+    def evict(self, name: str, slot: int):
+        """Release the DEVICE residency of one replica slot (the
+        breaker-open path) while keeping the slot -> device binding, so
+        `respawn()` rebuilds on the SAME device — TensorFlow's
+        re-placement model (PAPERS.md): the failed replica is a vacated
+        placement, not a lost device.  Returns the device; unknown
+        names/slots and double evictions are config errors."""
+        with self._lock:
+            idxs = self._slot_locked(name, slot)
+            evicted = self._evicted.setdefault(name, set())
+            if slot in evicted:
+                raise ValueError(f"slot {slot} of model {name!r} is "
+                                 f"already evicted")
+            evicted.add(int(slot))
+            self._load[idxs[slot]] -= 1
+            return self._devices[idxs[slot]]
+
+    def respawn(self, name: str, slot: int):
+        """Re-acquire the original device for an evicted slot (the
+        post-rebuild re-admission path); returns that device."""
+        with self._lock:
+            idxs = self._slot_locked(name, slot)
+            if slot not in self._evicted.get(name, set()):
+                raise ValueError(f"slot {slot} of model {name!r} is not "
+                                 f"evicted")
+            self._evicted[name].discard(int(slot))
+            self._load[idxs[slot]] += 1
+            return self._devices[idxs[slot]]
+
+    def _slot_locked(self, name: str, slot: int) -> List[int]:
+        idxs = self._owners.get(name)
+        if idxs is None:
+            raise ValueError(f"no placement recorded for model {name!r}")
+        if not 0 <= int(slot) < len(idxs):
+            raise ValueError(f"model {name!r} has {len(idxs)} placed "
+                             f"slot(s); slot {slot} does not exist")
+        return idxs
 
     def describe(self) -> Dict[str, object]:
         """JSON-ready placement snapshot for stats()/CLI: per-device
-        residency plus the model -> device map."""
+        residency plus the model -> device map (and any breaker-evicted
+        slots awaiting respawn)."""
         with self._lock:
-            return {
+            out = {
                 "devices": [str(d) for d in self._devices],
                 "load": list(self._load),
                 "models": {name: [str(self._devices[i]) for i in idxs]
                            for name, idxs in sorted(self._owners.items())},
             }
+            evicted = {name: sorted(slots)
+                       for name, slots in sorted(self._evicted.items())
+                       if slots}
+            if evicted:
+                out["evicted"] = evicted
+            return out

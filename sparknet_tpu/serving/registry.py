@@ -168,6 +168,37 @@ class ModelRegistry:
             lm.swap(master, replicas, ModelStats())
         return lm
 
+    def rebuild_replica(self, name: str, idx: int) -> ModelRunner:
+        """Build a FRESH runner for ONE replica slot on its recorded
+        device and swap it into the live set — the circuit-breaker
+        respawn path (serving/resilience.py).  Unlike reload() this
+        changes no parameters: the new runner replicates the CURRENT
+        master's params (bitwise-identical math), so the generation
+        does NOT bump — responses before and after the respawn are the
+        same generation because they ARE the same model.  A batch that
+        captured the old runner via replica_snapshot completes on it;
+        the next snapshot sees the fresh one (same atomicity contract
+        as swap())."""
+        lm = self.get(name)
+        with lm._swap_lock:
+            if not 0 <= int(idx) < len(lm.replicas):
+                raise ValueError(
+                    f"model {name!r} has {len(lm.replicas)} replica(s); "
+                    f"slot {idx} does not exist")
+            master = lm.replicas[0]
+            device = (lm.devices[idx] if lm.devices is not None
+                      else lm.replicas[idx].device)
+        # built OUTSIDE the swap lock: replicate() device_puts params
+        # and warmup() compiles — replica_snapshot holds the lock on
+        # every dispatch and must never stall behind a rebuild
+        fresh = master.replicate(device)
+        fresh.warmup()
+        with lm._swap_lock:
+            lm.replicas[idx] = fresh
+            if int(idx) == 0:
+                lm.runner = fresh
+        return fresh
+
     def unload(self, name: str) -> None:
         with self._lock:
             if self._models.pop(name, None) is None:

@@ -1,0 +1,735 @@
+"""Serving resilience control plane: per-replica circuit breakers,
+SLO-aware admission shedding, and seeded serving fault injection.
+
+Training got its fault story in two layers — partial-quorum masked
+averaging (parallel/elastic.py) and the process supervisor
+(elastic/proc.py) — both exercised by deterministic chaos
+(elastic/chaos.py).  This module is the serving-side twin, applying the
+same degrade-gracefully philosophy at the REQUEST layer, with
+TensorFlow's device-failure/re-placement model (PAPERS.md) as the
+blueprint: a replica is an evictable, respawnable placement, not a
+fixed resource.
+
+Three cooperating pieces, all owned per model lane by a
+`ResilienceManager`:
+
+- **CircuitBreaker** (one per replica slot): a rolling window of
+  dispatch outcomes drives closed -> open -> half-open -> closed.  On
+  trip, the manager disables the slot in the `ReplicaScheduler`,
+  drains-and-requeues its pending items onto healthy replicas (the
+  items were already admitted — requeueing bypasses queue_depth and
+  never re-rejects), releases the device slot via
+  `DevicePlacer.evict()`, and after a cooldown rebuilds a FRESH runner
+  on the SAME device (`ModelRegistry.rebuild_replica` +
+  `DevicePlacer.respawn`).  Re-admission is earned through half-open
+  probes: seeded single-sample forwards through the fresh runner; N
+  consecutive successes close the breaker, one failure re-opens it
+  (without rebuilding again — the respawn already happened this
+  episode).
+- **SLO-aware shedding**: requests carry a priority class
+  (``interactive`` | ``batch``).  When the lane's queue crosses
+  `shed_fraction` of queue_depth, or the interactive total-latency EWMA
+  exceeds `slo_ms`, BATCH requests are shed at admission with the 503
+  overload taxonomy (errors.RequestShed) — interactive traffic keeps
+  the queue.  Deadlines propagate the same way: a request already dead
+  at submit is answered 504 immediately, and one dead at batch
+  assembly is dropped before device time (both emit `deadline_drop`
+  events).
+- **ServeFaultPlan**: deterministic fault injection over the replica
+  dispatch stream, reusing elastic/chaos.py's sha256 `u01` draw.
+  Faults are keyed by (replica, dispatch index), never wall clock, so
+  the SCHEDULE is bitwise-replayable across runs (`schedule_digest`
+  pins it); live event interleavings naturally vary with thread
+  timing.  Grammar (``ServeFaultPlan.from_spec``), comma tokens:
+
+      errstorm:<replica>@<start>+<n>       n consecutive dispatch errors
+      spike:<replica>@<start>+<n>x<ms>     n dispatches delayed by ms
+      kill:<replica>@<dispatch>            hard kill: every dispatch
+                                           fails until respawn
+      flaky:<prob>                         per-dispatch error draw
+
+  Malformed tokens die with a ValueError naming the token (the
+  repo-wide parser contract).
+
+Every state transition lands as a wall-clock-free JSONL event
+(`replica_open` / `replica_probe` / `replica_respawn` / `shed` /
+`deadline_drop`; schema table in DISTACC.md) mirroring
+deploy/watcher.py's event discipline, and as breaker-state gauges in
+the model's ModelStats registry.  The drill is
+`scripts/serve_chaos_run.py` (ONE JSON line), smoked by
+scripts/lint_gate.sh and landed by bench.py's `serving_resilience` leg.
+
+Locking: the manager's `_mu` guards all mutable state and is NEVER
+held across a forward, a probe, a rebuild, a scheduler call, or a
+sleep (ANALYSIS.md R008); scheduler/placer/registry locks are acquired
+only while `_mu` is free, so no lock-order cycle exists (R007).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import os
+import threading
+import time
+from collections import deque
+from typing import Deque, Dict, List, Optional, Tuple
+
+from ..elastic.chaos import u01
+from ..obs.trace import now_s
+
+__all__ = [
+    "ResilienceConfig", "CircuitBreaker", "ServeFaultPlan",
+    "ResilienceManager", "PRIORITIES",
+    "BREAKER_WINDOW_ENV", "BREAKER_ERRS_ENV", "BREAKER_COOLDOWN_ENV",
+    "PROBES_ENV", "SLO_ENV", "SHED_FRACTION_ENV",
+]
+
+PRIORITIES = ("interactive", "batch")
+
+BREAKER_WINDOW_ENV = "SPARKNET_SERVE_BREAKER_WINDOW"
+BREAKER_ERRS_ENV = "SPARKNET_SERVE_BREAKER_ERRS"
+BREAKER_COOLDOWN_ENV = "SPARKNET_SERVE_BREAKER_COOLDOWN_S"
+PROBES_ENV = "SPARKNET_SERVE_PROBES"
+SLO_ENV = "SPARKNET_SERVE_SLO_MS"
+SHED_FRACTION_ENV = "SPARKNET_SERVE_SHED_FRACTION"
+
+
+def _env_float(name: str, default: float) -> float:
+    raw = os.environ.get(name)
+    if raw is None:
+        return float(default)
+    try:
+        return float(raw)
+    except ValueError:
+        raise ValueError(f"{name}={raw!r} is not a number")
+
+
+def _env_int(name: str, default: int) -> int:
+    raw = os.environ.get(name)
+    if raw is None:
+        return int(default)
+    try:
+        return int(raw)
+    except ValueError:
+        raise ValueError(f"{name}={raw!r} is not an int")
+
+
+# --------------------------------------------------------------- fault plan
+@dataclasses.dataclass(frozen=True)
+class ServeFaultPlan:
+    """Seeded serving fault schedule — a pure function of
+    (seed, replica, dispatch index), like elastic/chaos.py's FaultPlan
+    is of (seed, round, slot): no wall clock or RNG state enters any
+    decision, so two constructions from the same spec+seed agree
+    bitwise on every draw (`schedule_digest` pins this; the overload
+    soak and the drill replay it across two runs).
+
+    storms: replica -> (start, n): dispatches [start, start+n) error.
+    spikes: replica -> (start, n, ms): dispatches [start, start+n) are
+        delayed by `ms` before launching (latency-fault path — the
+        breaker sees slow successes, not errors).
+    kills: replica -> dispatch index at which the replica hard-dies:
+        every later dispatch errors until the control plane respawns
+        it (incarnation bump clears the kill — a fresh runner is a
+        fresh process in this model).
+    flaky_prob: per-(replica, dispatch) independent error draw.
+    """
+
+    seed: int = 0
+    storms: Dict[int, Tuple[int, int]] = dataclasses.field(
+        default_factory=dict)
+    spikes: Dict[int, Tuple[int, int, float]] = dataclasses.field(
+        default_factory=dict)
+    kills: Dict[int, int] = dataclasses.field(default_factory=dict)
+    flaky_prob: float = 0.0
+
+    def __post_init__(self):
+        for r, (start, n) in self.storms.items():
+            if start < 0 or n < 1:
+                raise ValueError(
+                    f"errstorm for replica {r} needs start >= 0 and "
+                    f"n >= 1, got start={start} n={n}")
+        for r, (start, n, ms) in self.spikes.items():
+            if start < 0 or n < 1 or ms <= 0:
+                raise ValueError(
+                    f"spike for replica {r} needs start >= 0, n >= 1 "
+                    f"and ms > 0, got start={start} n={n} ms={ms}")
+        for r, d in self.kills.items():
+            if d < 0:
+                raise ValueError(f"kill dispatch for replica {r} must "
+                                 f"be >= 0, got {d}")
+        if not 0.0 <= self.flaky_prob <= 1.0:
+            raise ValueError(f"flaky prob must be in [0, 1], "
+                             f"got {self.flaky_prob}")
+
+    # ------------------------------------------------------------- queries
+    def error_at(self, replica: int, dispatch: int) -> bool:
+        w = self.storms.get(int(replica))
+        if w is not None and w[0] <= dispatch < w[0] + w[1]:
+            return True
+        if self.flaky_prob > 0.0:
+            return u01(self.seed, "serve_err", int(replica),
+                       int(dispatch)) < self.flaky_prob
+        return False
+
+    def spike_ms(self, replica: int, dispatch: int) -> float:
+        w = self.spikes.get(int(replica))
+        if w is not None and w[0] <= dispatch < w[0] + w[1]:
+            return float(w[2])
+        return 0.0
+
+    def kill_at(self, replica: int) -> Optional[int]:
+        d = self.kills.get(int(replica))
+        return None if d is None else int(d)
+
+    def decision(self, replica: int, dispatch: int) -> str:
+        """Compact per-(replica, dispatch) fault decision — the unit the
+        bitwise replay contract is defined over."""
+        parts = []
+        k = self.kill_at(replica)
+        if k is not None and dispatch >= k:
+            parts.append("k")
+        if self.error_at(replica, dispatch):
+            parts.append("e")
+        ms = self.spike_ms(replica, dispatch)
+        if ms > 0:
+            parts.append(f"s{ms:g}")
+        return "".join(parts) or "."
+
+    def schedule_digest(self, n_replicas: int,
+                        n_dispatches: int = 4096) -> str:
+        """sha256 over every decision in the (replica, dispatch) grid —
+        two same-seed plans must produce the identical digest (the
+        drill's replay_bitwise check and the soak test pin it)."""
+        h = hashlib.sha256()
+        for r in range(int(n_replicas)):
+            for d in range(int(n_dispatches)):
+                h.update(self.decision(r, d).encode())
+                h.update(b"|")
+        return h.hexdigest()
+
+    # -------------------------------------------------------------- parser
+    @classmethod
+    def from_spec(cls, spec: str, seed: int = 0) -> "ServeFaultPlan":
+        """Parse the comma-separated token grammar (module docstring).
+        Malformed tokens die with a ValueError naming the token, never
+        an IndexError — the repo-wide parser contract."""
+        storms: Dict[int, Tuple[int, int]] = {}
+        spikes: Dict[int, Tuple[int, int, float]] = {}
+        kills: Dict[int, int] = {}
+        flaky = 0.0
+        for raw in (t.strip() for t in (spec or "").split(",")):
+            if not raw:
+                continue
+            kind, sep, rest = raw.partition(":")
+            try:
+                if kind == "errstorm" and sep:
+                    rep, at, window = rest.partition("@")
+                    start, plus, n = window.partition("+")
+                    if not (at and plus):
+                        raise ValueError("missing '@' or '+'")
+                    storms[int(rep)] = (int(start), int(n))
+                elif kind == "spike" and sep:
+                    rep, at, window = rest.partition("@")
+                    start, plus, tail = window.partition("+")
+                    n, x, ms = tail.partition("x")
+                    if not (at and plus and x):
+                        raise ValueError("missing '@', '+' or 'x'")
+                    spikes[int(rep)] = (int(start), int(n), float(ms))
+                elif kind == "kill" and sep:
+                    rep, at, d = rest.partition("@")
+                    if not at:
+                        raise ValueError("missing '@'")
+                    kills[int(rep)] = int(d)
+                elif kind == "flaky" and sep:
+                    flaky = float(rest)
+                else:
+                    raise ValueError("unknown token kind")
+            except ValueError as e:
+                raise ValueError(
+                    f"malformed serve chaos token {raw!r} in {spec!r}: "
+                    f"{e} (grammar: errstorm:<r>@<start>+<n>, "
+                    f"spike:<r>@<start>+<n>x<ms>, kill:<r>@<dispatch>, "
+                    f"flaky:<p>)") from None
+        return cls(seed=int(seed), storms=storms, spikes=spikes,
+                   kills=kills, flaky_prob=flaky)
+
+
+# ------------------------------------------------------------------ breaker
+class CircuitBreaker:
+    """closed -> open -> half-open -> closed over a rolling outcome
+    window for ONE replica slot.
+
+    Not thread-safe on its own: the ResilienceManager serializes every
+    access under its `_mu` (the breaker is pure bookkeeping — all side
+    effects of a transition live in the manager)."""
+
+    def __init__(self, *, window: int, error_threshold: float,
+                 min_samples: int, cooldown_s: float,
+                 half_open_probes: int) -> None:
+        if window < 1:
+            raise ValueError(f"window must be >= 1, got {window}")
+        if not 0.0 < error_threshold <= 1.0:
+            raise ValueError(f"error_threshold must be in (0, 1], "
+                             f"got {error_threshold}")
+        if min_samples < 1:
+            raise ValueError(f"min_samples must be >= 1, "
+                             f"got {min_samples}")
+        if cooldown_s <= 0:
+            raise ValueError(f"cooldown_s must be > 0, got {cooldown_s}")
+        if half_open_probes < 1:
+            raise ValueError(f"half_open_probes must be >= 1, "
+                             f"got {half_open_probes}")
+        self.window = int(window)
+        self.error_threshold = float(error_threshold)
+        self.min_samples = int(min_samples)
+        self.cooldown_s = float(cooldown_s)
+        self.half_open_probes = int(half_open_probes)
+        self.state = "closed"
+        self.trips = 0
+        self.opened_at = 0.0
+        self.respawned = False      # this open episode already rebuilt
+        self.probe_successes = 0
+        self._outcomes: Deque[bool] = deque(maxlen=self.window)
+
+    def record(self, ok: bool) -> bool:
+        """One closed-state dispatch outcome; True when this outcome
+        TRIPS the breaker (the caller then runs the open side effects —
+        disable, drain, requeue, evict).  Outcomes landing while open or
+        half-open (in-flight stragglers) are ignored: the episode's
+        verdict now belongs to the probes."""
+        if self.state != "closed":
+            return False
+        self._outcomes.append(bool(ok))
+        n = len(self._outcomes)
+        errs = n - sum(self._outcomes)
+        if n >= self.min_samples and errs / n >= self.error_threshold:
+            self.trip(now_s())
+            return True
+        return False
+
+    def trip(self, now: float) -> None:
+        self.state = "open"
+        self.trips += 1
+        self.opened_at = float(now)
+        self.respawned = False
+        self.probe_successes = 0
+        self._outcomes.clear()
+
+    def cooled_down(self, now: float) -> bool:
+        return self.state == "open" and \
+            now - self.opened_at >= self.cooldown_s
+
+    def begin_probing(self) -> None:
+        self.state = "half_open"
+        self.probe_successes = 0
+
+    def probe_ok(self) -> bool:
+        """One successful half-open probe; True once the success streak
+        closes the breaker."""
+        self.probe_successes += 1
+        if self.probe_successes >= self.half_open_probes:
+            self.state = "closed"
+            self._outcomes.clear()
+            return True
+        return False
+
+    def probe_fail(self, now: float) -> None:
+        """A failed half-open probe re-opens WITHOUT counting a new trip
+        or re-rebuilding (`respawned` survives): the episode continues,
+        the cooldown restarts."""
+        self.state = "open"
+        self.opened_at = float(now)
+        self.probe_successes = 0
+
+    def error_rate(self) -> float:
+        n = len(self._outcomes)
+        return 0.0 if n == 0 else (n - sum(self._outcomes)) / n
+
+
+# ------------------------------------------------------------------- config
+@dataclasses.dataclass
+class ResilienceConfig:
+    """Knobs of the serving resilience control plane.  Every default
+    reads its serve env knob (the module-level *_ENV names, registered
+    in analysis/knobs.py + the README table, R004) so deployments tune
+    without code; explicit constructor values win."""
+
+    breaker_window: int = dataclasses.field(
+        default_factory=lambda: _env_int(BREAKER_WINDOW_ENV, 16))
+    breaker_error_threshold: float = dataclasses.field(
+        default_factory=lambda: _env_float(BREAKER_ERRS_ENV, 0.5))
+    breaker_min_samples: int = 4
+    cooldown_s: float = dataclasses.field(
+        default_factory=lambda: _env_float(BREAKER_COOLDOWN_ENV, 0.25))
+    half_open_probes: int = dataclasses.field(
+        default_factory=lambda: _env_int(PROBES_ENV, 3))
+    slo_ms: float = dataclasses.field(
+        default_factory=lambda: _env_float(SLO_ENV, 500.0))
+    shed_fraction: float = dataclasses.field(
+        default_factory=lambda: _env_float(SHED_FRACTION_ENV, 0.5))
+    max_retries: int = 2        # per-request redispatches after a
+    #                             failed batch before its future errors
+    tick_s: float = 0.02        # maintenance thread period
+    probe_seed: int = 0         # health_probe input seed
+    fault_plan: Optional[ServeFaultPlan] = None
+    event_log: Optional[str] = None   # JSONL path (DISTACC.md schema)
+
+    def __post_init__(self) -> None:
+        if self.breaker_window < 1:
+            raise ValueError(f"breaker_window must be >= 1, "
+                             f"got {self.breaker_window}")
+        if not 0.0 < self.breaker_error_threshold <= 1.0:
+            raise ValueError(
+                f"breaker_error_threshold must be in (0, 1], "
+                f"got {self.breaker_error_threshold}")
+        if self.breaker_min_samples < 1:
+            raise ValueError(f"breaker_min_samples must be >= 1, "
+                             f"got {self.breaker_min_samples}")
+        if self.cooldown_s <= 0:
+            raise ValueError(f"cooldown_s must be > 0, "
+                             f"got {self.cooldown_s}")
+        if self.half_open_probes < 1:
+            raise ValueError(f"half_open_probes must be >= 1, "
+                             f"got {self.half_open_probes}")
+        if self.slo_ms <= 0:
+            raise ValueError(f"slo_ms must be > 0, got {self.slo_ms}")
+        if not 0.0 <= self.shed_fraction <= 1.0:
+            raise ValueError(f"shed_fraction must be in [0, 1], "
+                             f"got {self.shed_fraction}")
+        if self.max_retries < 0:
+            raise ValueError(f"max_retries must be >= 0, "
+                             f"got {self.max_retries}")
+        if self.tick_s <= 0:
+            raise ValueError(f"tick_s must be > 0, got {self.tick_s}")
+
+
+# ------------------------------------------------------------------ manager
+class ResilienceManager:
+    """Per-lane control plane: breakers + shed controller + fault
+    injection + the maintenance thread that walks an open breaker
+    through evict -> respawn -> half-open probes -> re-admission.
+
+    Wiring (serving/server.py): the lane's run callback consults
+    `on_dispatch` before each forward and reports outcomes via
+    `record_success`/`record_error`; admission consults
+    `should_shed_batch` and the deadline helpers.  The manager itself
+    only ever calls OUT to the scheduler (set_enabled / drain_replica /
+    requeue), the placer (evict / respawn), and the registry
+    (rebuild_replica) — never the reverse — with `_mu` released, so the
+    lock graph stays acyclic (ANALYSIS.md R007/R008)."""
+
+    def __init__(self, *, model: str, sched, lm, registry,
+                 placer=None, config: Optional[ResilienceConfig] = None,
+                 ) -> None:
+        self.cfg = config if config is not None else ResilienceConfig()
+        self._model = str(model)
+        self._sched = sched
+        self._lm = lm
+        self._registry = registry
+        self._placer = placer
+        self._plan = self.cfg.fault_plan
+        n = lm.n_replicas
+        self._n = n
+        self._mu = threading.Lock()
+        self._ev_mu = threading.Lock()   # serializes event-log appends
+        self._breakers = [
+            CircuitBreaker(window=self.cfg.breaker_window,
+                           error_threshold=self.cfg.breaker_error_threshold,
+                           min_samples=self.cfg.breaker_min_samples,
+                           cooldown_s=self.cfg.cooldown_s,
+                           half_open_probes=self.cfg.half_open_probes)
+            for _ in range(n)]
+        self._dispatch = [0] * n        # fault-plan index per replica
+        self._incarnation = [0] * n     # respawns bump; clears kills
+        self._dead = [False] * n        # hard-killed until respawn
+        self._opened_episode_at: Dict[int, float] = {}
+        self._recovery_s: Dict[int, float] = {}
+        self._interactive_ewma_ms: Optional[float] = None
+        self._sheds = 0
+        self._sheds_by_priority = {p: 0 for p in PRIORITIES}
+        self._deadline_drops = 0
+        self._requeued = 0
+        self._retried = 0
+        self._respawns = 0
+        self._probes_ok = 0
+        self._probes_failed = 0
+        self.events: List[dict] = []
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._loop, name=f"sparknet-resil-{model}",
+            daemon=True)
+        self._thread.start()
+
+    # ---------------------------------------------------------- fault feed
+    def on_dispatch(self, replica: int) -> Tuple[bool, float]:
+        """Called by the run callback before each forward on `replica`:
+        advances that replica's dispatch index through the fault plan
+        and returns (inject_error, spike_sleep_s).  A hard kill latches
+        `dead` — every subsequent dispatch errors until the respawn
+        bumps the incarnation (a fresh runner is a fresh process)."""
+        with self._mu:
+            d = self._dispatch[replica]
+            self._dispatch[replica] = d + 1
+            if (self._plan is not None
+                    and self._incarnation[replica] == 0
+                    and not self._dead[replica]):
+                k = self._plan.kill_at(replica)
+                if k is not None and d >= k:
+                    self._dead[replica] = True
+            err = self._dead[replica] or (
+                self._plan.error_at(replica, d)
+                if self._plan is not None else False)
+            spike_s = (self._plan.spike_ms(replica, d) / 1e3
+                       if self._plan is not None else 0.0)
+        return err, spike_s
+
+    def record_success(self, replica: int) -> None:
+        with self._mu:
+            self._breakers[replica].record(True)
+
+    def record_error(self, replica: int) -> None:
+        """One failed dispatch.  A trip (rolling-window threshold, or
+        immediately for a hard-killed replica) runs the open side
+        effects OUTSIDE the lock: disable routing, drain + requeue the
+        slot's pending items onto healthy replicas, release the device
+        slot."""
+        with self._mu:
+            br = self._breakers[replica]
+            tripped = br.record(False)
+            if (not tripped and self._dead[replica]
+                    and br.state == "closed"):
+                # a hard-killed replica fails every dispatch — trip NOW
+                # instead of burning min_samples more batches on it
+                br.trip(now_s())
+                tripped = True
+            if tripped:
+                self._opened_episode_at[replica] = br.opened_at
+        if tripped:
+            self._open_side_effects(replica)
+
+    def _open_side_effects(self, replica: int) -> None:
+        self._sched.set_enabled(replica, False)
+        drained = self._sched.drain_replica(replica)
+        if drained:
+            self._sched.requeue(drained, exclude=replica)
+            with self._mu:
+                self._requeued += len(drained)
+        device = None
+        if self._placer is not None:
+            try:
+                device = self._placer.evict(self._model, replica)
+            except ValueError:
+                device = None   # single-replica lanes have no placement
+        self._lm.stats.observe_breaker(replica, "open")
+        with self._mu:
+            trips = self._breakers[replica].trips
+        self._event("replica_open", replica=replica, trips=trips,
+                    requeued=len(drained),
+                    device=str(device) if device is not None else None)
+
+    # ------------------------------------------------------------ shedding
+    def should_shed_batch(self, queued_total: int,
+                          queue_depth: int) -> Optional[str]:
+        """A non-None reason means a batch-class request must be shed
+        NOW (admission raises RequestShed).  Interactive traffic is
+        never shed — it only ever sees the plain overload 503 at a
+        completely full queue."""
+        if queued_total >= self.cfg.shed_fraction * queue_depth:
+            return (f"queue {queued_total}/{queue_depth} at or over "
+                    f"shed fraction {self.cfg.shed_fraction}")
+        with self._mu:
+            ewma = self._interactive_ewma_ms
+        if ewma is not None and ewma > self.cfg.slo_ms:
+            return (f"interactive latency EWMA {ewma:.1f} ms over "
+                    f"SLO {self.cfg.slo_ms:g} ms")
+        return None
+
+    def count_shed(self, priority: str, queued: int,
+                   reason: str) -> None:
+        with self._mu:
+            self._sheds += 1
+            self._sheds_by_priority[priority] = \
+                self._sheds_by_priority.get(priority, 0) + 1
+        self._event("shed", priority=priority, queued=queued,
+                    reason=reason)
+
+    def observe_total(self, priority: str, total_ms: float) -> None:
+        """Completed-request latency feed for the shed controller; only
+        the interactive class drives the EWMA the SLO is defined over."""
+        if priority != "interactive":
+            return
+        with self._mu:
+            e = self._interactive_ewma_ms
+            self._interactive_ewma_ms = (
+                float(total_ms) if e is None
+                else 0.8 * e + 0.2 * float(total_ms))
+
+    def count_deadline_drop(self, stage: str, late_ms: float,
+                            replica: Optional[int] = None) -> None:
+        with self._mu:
+            self._deadline_drops += 1
+        fields = {"stage": stage, "late_ms": round(float(late_ms), 3)}
+        if replica is not None:
+            fields["replica"] = replica
+        self._event("deadline_drop", **fields)
+
+    def count_retried(self, n: int) -> None:
+        with self._mu:
+            self._retried += int(n)
+
+    # --------------------------------------------------------- maintenance
+    def _loop(self) -> None:
+        while not self._stop.wait(self.cfg.tick_s):
+            try:
+                self._tick()
+            except Exception as e:     # keep the control plane alive
+                self._event("resilience_error",
+                            error=f"{type(e).__name__}: {e}")
+
+    def _tick(self) -> None:
+        now = now_s()
+        for i in range(self._n):
+            with self._mu:
+                br = self._breakers[i]
+                actionable = br.cooled_down(now)
+                respawned = br.respawned
+            if not actionable:
+                continue
+            if not respawned:
+                if not self._respawn(i):
+                    continue        # retry next tick
+            self._probe_cycle(i)
+
+    def _respawn(self, i: int) -> bool:
+        """Rebuild a fresh runner for slot i on its original device and
+        re-acquire the placement residency.  The generation does NOT
+        bump — same params, bitwise-identical math (reload() is the
+        parameter-change path)."""
+        device = None
+        if self._placer is not None:
+            try:
+                device = self._placer.respawn(self._model, i)
+            except ValueError:
+                device = None
+        try:
+            self._registry.rebuild_replica(self._model, i)
+        except Exception as e:
+            self._event("resilience_error", replica=i,
+                        error=f"rebuild failed: "
+                              f"{type(e).__name__}: {e}")
+            return False
+        with self._mu:
+            self._incarnation[i] += 1
+            self._dead[i] = False
+            self._breakers[i].respawned = True
+            self._respawns += 1
+            incarnation = self._incarnation[i]
+        self._event("replica_respawn", replica=i,
+                    incarnation=incarnation,
+                    device=str(device) if device is not None else None)
+        return True
+
+    def _probe_cycle(self, i: int) -> None:
+        """Half-open probing: up to `half_open_probes` seeded forwards
+        through the fresh runner.  Probes draw from the SAME fault
+        schedule as live traffic (they advance the dispatch index), so
+        a replica inside an un-expired error storm keeps failing probes
+        and re-opens — re-admission is earned, not granted."""
+        with self._mu:
+            self._breakers[i].begin_probing()
+        self._lm.stats.observe_breaker(i, "half_open")
+        runner, _gen = self._lm.replica_snapshot(i)
+        closed = False
+        for _ in range(self.cfg.half_open_probes):
+            err, spike_s = self.on_dispatch(i)
+            ok = not err
+            if ok:
+                try:
+                    if spike_s > 0:
+                        time.sleep(spike_s)
+                    runner.health_probe(seed=self.cfg.probe_seed)
+                except Exception:
+                    ok = False
+            with self._mu:
+                if ok:
+                    self._probes_ok += 1
+                    closed = self._breakers[i].probe_ok()
+                else:
+                    self._probes_failed += 1
+                    self._breakers[i].probe_fail(now_s())
+                state = self._breakers[i].state
+                streak = self._breakers[i].probe_successes
+            self._event("replica_probe", replica=i, ok=ok,
+                        state_after=state, streak=streak)
+            if not ok:
+                self._lm.stats.observe_breaker(i, "open")
+                return
+        if closed:
+            self._sched.set_enabled(i, True)
+            self._lm.stats.observe_breaker(i, "closed")
+            with self._mu:
+                t0 = self._opened_episode_at.pop(i, None)
+                if t0 is not None:
+                    self._recovery_s[i] = now_s() - t0
+
+    # ------------------------------------------------------------- observe
+    def snapshot(self) -> Dict[str, object]:
+        """JSON-ready control-plane state for server.stats() and the
+        drill's accounting checks."""
+        with self._mu:
+            return {
+                "breakers": {str(i): self._breakers[i].state
+                             for i in range(self._n)},
+                "trips": sum(b.trips for b in self._breakers),
+                "open_now": sum(1 for b in self._breakers
+                                if b.state != "closed"),
+                "respawns": self._respawns,
+                "incarnations": list(self._incarnation),
+                "probes_ok": self._probes_ok,
+                "probes_failed": self._probes_failed,
+                "sheds": self._sheds,
+                "sheds_by_priority": dict(self._sheds_by_priority),
+                "deadline_drops": self._deadline_drops,
+                "requeued": self._requeued,
+                "retried": self._retried,
+                "recovery_s": {str(i): round(v, 3)
+                               for i, v in sorted(
+                                   self._recovery_s.items())},
+                "interactive_ewma_ms": (
+                    None if self._interactive_ewma_ms is None
+                    else round(self._interactive_ewma_ms, 3)),
+                "fault_plan": self._plan is not None,
+            }
+
+    def events_snapshot(self) -> List[dict]:
+        with self._mu:
+            return [dict(e) for e in self.events]
+
+    def all_closed(self) -> bool:
+        with self._mu:
+            return all(b.state == "closed" for b in self._breakers)
+
+    # ----------------------------------------------------------- lifecycle
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not threading.current_thread():
+            self._thread.join(timeout=30.0)
+
+    # -------------------------------------------------------------- events
+    def _event(self, kind: str, **fields) -> None:
+        """deploy/watcher.py's event discipline: wall-clock-free payload
+        appended to the in-memory list and (optionally) one JSONL line —
+        DISTACC.md documents the schema per kind."""
+        rec = {"kind": kind, "model": self._model}
+        rec.update(fields)
+        with self._mu:
+            self.events.append(rec)
+        path = self.cfg.event_log
+        if path:
+            with self._ev_mu:
+                with open(path, "a") as f:
+                    f.write(json.dumps(rec) + "\n")
+                    f.flush()

@@ -27,13 +27,33 @@ admission/routing never stalls behind device time.
 
 from __future__ import annotations
 
+import os
 import threading
 from collections import deque
 from typing import Callable, Deque, List, Optional, Sequence, Tuple
 
 from ..obs.trace import now_s
 
-__all__ = ["ReplicaScheduler", "SchedulerFull", "SchedulerClosed"]
+__all__ = ["ReplicaScheduler", "SchedulerFull", "SchedulerClosed",
+           "default_submit_timeout_s", "SUBMIT_TIMEOUT_ENV"]
+
+SUBMIT_TIMEOUT_ENV = "SPARKNET_SERVE_SUBMIT_TIMEOUT_S"
+
+
+def default_submit_timeout_s() -> float:
+    """SPARKNET_SERVE_SUBMIT_TIMEOUT_S: the bound on blocking
+    submit(wait=True) backpressure when the caller passes no explicit
+    timeout_s.  Before this knob an omitted timeout blocked the client
+    thread FOREVER on a saturated lane; now it surfaces as the same
+    SchedulerFull / 503 the non-blocking path raises."""
+    raw = os.environ.get(SUBMIT_TIMEOUT_ENV, "30")
+    try:
+        v = float(raw)
+    except ValueError:
+        raise ValueError(f"{SUBMIT_TIMEOUT_ENV}={raw!r} is not a number")
+    if v <= 0:
+        raise ValueError(f"{SUBMIT_TIMEOUT_ENV} must be > 0, got {v}")
+    return v
 
 
 class SchedulerFull(Exception):
@@ -74,6 +94,7 @@ class ReplicaScheduler:
         self._pending: List[Deque] = [deque() for _ in range(n_replicas)]
         self._inflight = [0] * n_replicas
         self._rr = 0                 # rotates the least-loaded tie-break
+        self._enabled = [True] * n_replicas   # breaker-controlled routing
         self._stopping = False
         self._threads = [
             threading.Thread(target=self._worker, args=(i,),
@@ -86,23 +107,25 @@ class ReplicaScheduler:
     # ------------------------------------------------------------- admission
     def submit(self, item, *, wait: bool = False,
                timeout_s: Optional[float] = None) -> int:
-        """Route `item` to the least-loaded replica; returns its index.
-        A full scheduler (total pending == queue_depth) raises
+        """Route `item` to the least-loaded ENABLED replica; returns its
+        index.  A full scheduler (total pending == queue_depth) raises
         SchedulerFull immediately, or after blocking up to `timeout_s`
-        when wait=True (backpressure mode)."""
+        when wait=True (backpressure mode; an omitted timeout_s is
+        bounded by SPARKNET_SERVE_SUBMIT_TIMEOUT_S — never an unbounded
+        block)."""
         with self._cv:
             if self._stopping:
                 raise SchedulerClosed("scheduler is stopping")
             if self._total_pending() >= self.queue_depth:
                 if not wait:
                     raise SchedulerFull(self.queue_depth)
-                deadline = (None if timeout_s is None
-                            else now_s() + float(timeout_s))
+                if timeout_s is None:
+                    timeout_s = default_submit_timeout_s()
+                deadline = now_s() + float(timeout_s)
                 while (self._total_pending() >= self.queue_depth
                        and not self._stopping):
-                    remaining = (None if deadline is None
-                                 else deadline - now_s())
-                    if remaining is not None and remaining <= 0:
+                    remaining = deadline - now_s()
+                    if remaining <= 0:
                         raise SchedulerFull(self.queue_depth)
                     self._cv.wait(remaining)
                 if self._stopping:
@@ -115,16 +138,67 @@ class ReplicaScheduler:
     def _total_pending(self) -> int:
         return sum(len(dq) for dq in self._pending)
 
-    def _pick_replica(self) -> int:
-        """Least (queued + in-flight); ties rotate from the last pick so
-        a burst onto an idle mesh spreads one-per-replica instead of
-        piling onto replica 0."""
+    def _pick_replica(self, exclude: Optional[int] = None) -> int:
+        """Least (queued + in-flight) over the ENABLED replicas; ties
+        rotate from the last pick so a burst onto an idle mesh spreads
+        one-per-replica instead of piling onto replica 0.  With every
+        replica disabled (all breakers open) admission still lands
+        somewhere — the item parks until a re-enable or the stop-time
+        drain, which is strictly better than dropping admitted work."""
         n = self.n_replicas
-        i = min(range(n),
+        pool = [k for k in range(n)
+                if self._enabled[k] and k != exclude]
+        if not pool:
+            pool = [k for k in range(n) if k != exclude] or list(range(n))
+        i = min(pool,
                 key=lambda k: (len(self._pending[k]) + self._inflight[k],
                                (k - self._rr) % n))
         self._rr = (i + 1) % n
         return i
+
+    # -------------------------------------------------- resilience control
+    def set_enabled(self, i: int, enabled: bool) -> None:
+        """Include/exclude replica i from routing (the circuit-breaker
+        lever).  Disabling never touches items already queued on i —
+        the caller drains and requeues them explicitly, so the
+        exactly-once story stays in one place."""
+        with self._cv:
+            self._enabled[i] = bool(enabled)
+            self._cv.notify_all()
+
+    def is_enabled(self, i: int) -> bool:
+        with self._cv:
+            return self._enabled[i]
+
+    def enabled_mask(self) -> List[bool]:
+        with self._cv:
+            return list(self._enabled)
+
+    def drain_replica(self, i: int) -> List:
+        """Atomically remove and return replica i's QUEUED items (the
+        breaker eviction path).  In-flight work is untouched — its math
+        is already launched and the run callback owns its futures."""
+        with self._cv:
+            items = list(self._pending[i])
+            self._pending[i].clear()
+            self._cv.notify_all()
+            return items
+
+    def requeue(self, items: Sequence, *,
+                exclude: Optional[int] = None) -> None:
+        """Re-admit ALREADY-ADMITTED items (drained from a tripped
+        replica, or a failed batch being retried) onto enabled replicas,
+        least-loaded first and skipping `exclude`.  Deliberately bypasses
+        queue_depth: these items passed admission once — re-rejecting or
+        dropping them would break the exactly-once contract."""
+        if not items:
+            return
+        with self._cv:
+            if self._stopping:
+                raise SchedulerClosed("scheduler is stopping")
+            for item in items:
+                self._pending[self._pick_replica(exclude)].append(item)
+            self._cv.notify_all()
 
     # --------------------------------------------------------------- workers
     def _worker(self, i: int) -> None:
@@ -132,7 +206,11 @@ class ReplicaScheduler:
         pending = self._pending[i]
         while True:
             with cv:
-                while not pending and not self._stopping:
+                # a disabled replica must not pop (its breaker is open)
+                # — unless we are stopping, when every queue drains so
+                # no admitted item is ever stranded
+                while (not self._stopping
+                       and (not pending or not self._enabled[i])):
                     cv.wait()
                 if not pending:          # stopping and nothing left
                     return

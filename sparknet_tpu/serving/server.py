@@ -37,6 +37,7 @@ from __future__ import annotations
 
 import os
 import threading
+import time
 from concurrent.futures import Future
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence
@@ -45,10 +46,11 @@ import numpy as np
 
 from ..obs.trace import now_s, span
 from .buckets import pad_to_bucket, pick_bucket
-from .errors import (DeadlineExceeded, ServerClosed, ServerOverloaded,
-                     ServingError)
+from .errors import (DeadlineExceeded, RequestShed, ServerClosed,
+                     ServerOverloaded, ServingError)
 from .placement import DevicePlacer, resolve_replica_count
 from .registry import LoadedModel, ModelRegistry
+from .resilience import PRIORITIES, ResilienceConfig, ResilienceManager
 from .scheduler import ReplicaScheduler, SchedulerClosed, SchedulerFull
 
 
@@ -77,6 +79,10 @@ class ServerConfig:
     poll_s: float = 0.05        # legacy PR-5 knob; kept so existing
     #                             ServerConfig(poll_s=...) callers construct
     min_fill: int = field(default_factory=_default_min_fill)
+    # opt-in resilience control plane (serving/resilience.py): circuit
+    # breakers + SLO-aware batch shedding + fault injection.  None (the
+    # default) keeps every pre-resilience behavior bit-for-bit.
+    resilience: Optional[ResilienceConfig] = None
 
 
 @dataclass
@@ -99,6 +105,7 @@ class Response:
     device_ms: float
     total_ms: float
     replica: int = 0
+    priority: str = "interactive"
 
     @property
     def argmax(self) -> int:
@@ -112,15 +119,18 @@ class _Request:
     t_submit: float
     deadline: Optional[float]   # absolute now_s seconds
     t_pop: float = 0.0
+    priority: str = "interactive"
+    retries: int = 0            # redispatches after failed batches
 
 
 @dataclass
 class _Lane:
-    """Per-model replica scheduler."""
+    """Per-model replica scheduler (+ optional resilience manager)."""
 
     model: LoadedModel
     sched: ReplicaScheduler
     stopping: bool = False
+    resil: Optional[ResilienceManager] = None
 
 
 class InferenceServer:
@@ -229,6 +239,11 @@ class InferenceServer:
             max_wait_ms=self.config.max_wait_ms,
             run=lambda i, batch: self._run_batch(lane, i, batch),
             name=name)
+        if self.config.resilience is not None:
+            lane.resil = ResilienceManager(
+                model=name, sched=lane.sched, lm=lm,
+                registry=self.registry, placer=self._placer,
+                config=self.config.resilience)
         with self._lock:
             old = self._lanes.get(name)
             self._lanes[name] = lane
@@ -278,6 +293,10 @@ class InferenceServer:
 
     def _stop_lane(self, lane: _Lane, *, drain: bool) -> None:
         lane.stopping = True
+        if lane.resil is not None:
+            # stop the maintenance thread FIRST so no probe/respawn
+            # races the scheduler teardown; breakers stay frozen
+            lane.resil.stop()
         for req in lane.sched.stop(drain=drain):
             lane.model.stats.bump("rejected_closed")
             req.future.set_exception(
@@ -293,14 +312,27 @@ class InferenceServer:
     def submit(self, model: str, sample, *,
                deadline_ms: Optional[float] = None,
                wait: bool = False,
-               wait_timeout_s: Optional[float] = None) -> Future:
+               wait_timeout_s: Optional[float] = None,
+               priority: str = "interactive") -> Future:
         """Admit one sample for scoring; returns a Future resolving to a
         Response (or raising the rejection).
 
         Admission is non-blocking by default: a full queue raises
         ServerOverloaded immediately (the 503 path).  wait=True turns
         overload into backpressure — block until space or
-        `wait_timeout_s` (then ServerOverloaded anyway)."""
+        `wait_timeout_s` (omitted: SPARKNET_SERVE_SUBMIT_TIMEOUT_S
+        bounds the block; then ServerOverloaded anyway).
+
+        `priority` ('interactive' | 'batch') feeds the SLO-aware shed
+        controller when the server runs with a ResilienceConfig: batch
+        traffic is shed (RequestShed, a 503) once the queue crosses the
+        shed fraction or interactive latency breaches its SLO, so
+        interactive p99 degrades LAST.  A request whose deadline is
+        already unmeetable at submit (deadline_ms <= 0) is answered 504
+        immediately — never queued, never device time."""
+        if priority not in PRIORITIES:
+            raise ValueError(f"priority must be one of {PRIORITIES}, "
+                             f"got {priority!r}")
         lane = self._lane(model)
         lm = lane.model
         x = np.asarray(sample, dtype=np.float32)
@@ -314,11 +346,31 @@ class InferenceServer:
             raise ServerClosed("server is shutting down")
         if deadline_ms is None:
             deadline_ms = self.config.default_deadline_ms
+        if deadline_ms is not None and float(deadline_ms) <= 0.0:
+            lm.stats.bump("submitted")
+            lm.stats.bump("rejected_deadline")
+            if lane.resil is not None:
+                lane.resil.count_deadline_drop(
+                    "submit", -float(deadline_ms))
+            raise DeadlineExceeded(
+                f"deadline {float(deadline_ms):g} ms is already "
+                f"unmeetable at submit")
+        if lane.resil is not None and priority == "batch":
+            queued = lane.sched.queued_total()
+            reason = lane.resil.should_shed_batch(
+                queued, self.config.queue_depth)
+            if reason is not None:
+                lm.stats.bump("submitted")
+                lm.stats.bump("rejected_shed")
+                lane.resil.count_shed(priority, queued, reason)
+                raise RequestShed(
+                    f"batch request to {model!r} shed: {reason}")
         t0 = now_s()
         req = _Request(
             sample=x, future=Future(), t_submit=t0,
             deadline=None if deadline_ms is None
-            else t0 + float(deadline_ms) / 1e3)
+            else t0 + float(deadline_ms) / 1e3,
+            priority=priority)
         lm.stats.bump("submitted")
         try:
             with span("serve.submit", model=model) as sp:
@@ -390,6 +442,12 @@ class InferenceServer:
                             f"errors are reported once and ignored)")
                     break
 
+    def resilience(self, model: str) -> Optional[ResilienceManager]:
+        """The model's resilience control plane (None when the server
+        was built without a ResilienceConfig) — the drill's and tests'
+        observability handle for breakers/events."""
+        return self._lane(model).resil
+
     def _lane(self, model: str) -> _Lane:
         with self._lock:
             lane = self._lanes.get(model)
@@ -408,6 +466,7 @@ class InferenceServer:
         never raises — every future is resolved here, rejections
         included."""
         lm = lane.model
+        mgr = lane.resil
         runner, generation = lm.replica_snapshot(replica_idx)
         with span("serve.assemble", model=lm.name,
                   replica=replica_idx) as sp:
@@ -417,6 +476,10 @@ class InferenceServer:
                 r.t_pop = now
                 if r.deadline is not None and now > r.deadline:
                     lm.stats.bump("rejected_deadline")
+                    if mgr is not None:
+                        mgr.count_deadline_drop(
+                            "assembly", (now - r.deadline) * 1e3,
+                            replica=replica_idx)
                     r.future.set_exception(DeadlineExceeded(
                         f"deadline passed "
                         f"{round((now - r.deadline) * 1e3, 2)}"
@@ -433,17 +496,51 @@ class InferenceServer:
         queued, inflight = lane.sched.depth(replica_idx)
         lm.stats.observe_replica(replica_idx, queued, inflight,
                                  dispatched=1)
+        inject_err, spike_s = (mgr.on_dispatch(replica_idx)
+                               if mgr is not None else (False, 0.0))
         t_launch = now_s()
         try:
             with span("serve.device", model=lm.name, bucket=bucket,
                       live=len(live), replica=replica_idx):
+                if spike_s > 0:
+                    # injected latency fault: the breaker sees a slow
+                    # SUCCESS (device_ms includes the spike)
+                    time.sleep(spike_s)
+                if inject_err:
+                    raise ServingError(
+                        f"injected fault on replica {replica_idx} "
+                        f"(ServeFaultPlan)")
                 out = runner.forward_padded(x)
         except Exception as e:
+            if mgr is not None:
+                mgr.record_error(replica_idx)
+                if not lane.stopping:
+                    # exactly-once recovery: redispatch the failed
+                    # requests onto healthy replicas (bounded retries);
+                    # futures resolve only on delivery or final failure
+                    retry = [r for r in live
+                             if r.retries < mgr.cfg.max_retries]
+                    for r in retry:
+                        r.retries += 1
+                    if retry:
+                        try:
+                            lane.sched.requeue(retry,
+                                               exclude=replica_idx)
+                            mgr.count_retried(len(retry))
+                            # identity filter: _Request's dataclass
+                            # __eq__ would compare sample arrays
+                            kept = {id(r) for r in retry}
+                            live = [r for r in live
+                                    if id(r) not in kept]
+                        except SchedulerClosed:
+                            pass    # fall through: fail them below
             lm.stats.bump("failed", len(live))
             for r in live:
                 r.future.set_exception(
                     ServingError(f"model {lm.name!r} forward failed: {e}"))
             return
+        if mgr is not None:
+            mgr.record_success(replica_idx)
         t_done = now_s()
         device_ms = (t_done - t_launch) * 1e3
         lm.stats.observe_batch(len(live), bucket)
@@ -463,7 +560,10 @@ class InferenceServer:
                     assembly_ms=round(assembly_ms, 4),
                     device_ms=round(device_ms, 4),
                     total_ms=round(total_ms, 4),
-                    replica=replica_idx)
+                    replica=replica_idx,
+                    priority=r.priority)
+                if mgr is not None:
+                    mgr.observe_total(r.priority, total_ms)
                 r.future.set_result(resp)
                 delivered.append((r.sample, resp))
             sp.set(completed=lm.stats.value("completed"),
@@ -490,6 +590,8 @@ class InferenceServer:
                 entry["queued_now"] = queued
                 entry["inflight_now"] = inflight
             per_model[name]["replicas"] = breakdown
+            if lane.resil is not None:
+                per_model[name]["resilience"] = lane.resil.snapshot()
         out: Dict[str, object] = {
             "models": per_model,
             "config": {"max_batch": self.config.max_batch,
@@ -497,7 +599,8 @@ class InferenceServer:
                        "queue_depth": self.config.queue_depth,
                        "min_fill": self.config.min_fill,
                        "default_deadline_ms":
-                           self.config.default_deadline_ms},
+                           self.config.default_deadline_ms,
+                       "resilience": self.config.resilience is not None},
             "accepting": self._accepting}
         if self._placer is not None:
             out["placement"] = self._placer.describe()

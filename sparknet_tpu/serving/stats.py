@@ -46,7 +46,9 @@ class ModelStats:
     -> device -> total)."""
 
     SERIES = ("queue_wait", "assembly", "device", "total")
-    REJECTS = ("rejected_overload", "rejected_deadline", "rejected_closed")
+    REJECTS = ("rejected_overload", "rejected_deadline",
+               "rejected_closed", "rejected_shed")
+    BREAKER_STATES = {"closed": 0, "open": 1, "half_open": 2}
 
     def __init__(self, window: int = 65536) -> None:
         self._lock = threading.Lock()
@@ -76,6 +78,9 @@ class ModelStats:
             self._replica_queue: Dict[int, object] = {}
             self._replica_inflight: Dict[int, object] = {}
             self._replica_dispatches: Dict[int, object] = {}
+            # breaker-state gauges (lazy like the replica gauges, so
+            # resilience-off servers keep the exact metric set)
+            self._breaker_state: Dict[int, object] = {}
 
     @property
     def registry(self) -> MetricsRegistry:
@@ -143,6 +148,25 @@ class ModelStats:
         if dispatched:
             d.inc(int(dispatched))
 
+    def observe_breaker(self, idx: int, state: str) -> None:
+        """Circuit-breaker state gauge for one replica slot
+        (`serving_replica_breaker_state{replica=i}`: 0 closed, 1 open,
+        2 half_open — resilience.py records every transition).  Rides
+        the private registry like the replica gauges, so the byte-pinned
+        snapshot() contract is untouched."""
+        code = self.BREAKER_STATES.get(state)
+        if code is None:
+            raise ValueError(f"unknown breaker state {state!r}; one of "
+                             f"{sorted(self.BREAKER_STATES)}")
+        i = int(idx)
+        with self._lock:
+            g = self._breaker_state.get(i)
+            if g is None:
+                g = self._registry.gauge("serving_replica_breaker_state",
+                                         labels={"replica": str(i)})
+                self._breaker_state[i] = g
+        g.set(code)
+
     def replica_breakdown(self) -> Dict[str, Dict[str, object]]:
         """replica index (str) -> {queued_now, queued_max, inflight_now,
         inflight_max, dispatches}.  Empty for single-replica models that
@@ -158,6 +182,9 @@ class ModelStats:
                                "inflight_now": int(f.value),
                                "inflight_max": int(f.max),
                                "dispatches": int(d.value)}
+                b = self._breaker_state.get(i)
+                if b is not None:
+                    out[str(i)]["breaker_state"] = int(b.value)
             return out
 
     def observe_request(self, queue_wait_ms: float, assembly_ms: float,

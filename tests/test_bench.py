@@ -377,7 +377,7 @@ def test_bench_trainserve_leg_contract(monkeypatch):
 
     import bench
 
-    assert bench.BENCH_SCHEMA_VERSION == 5
+    assert bench.BENCH_SCHEMA_VERSION == 6
     canned = {"ok": True, "model": "lenet", "promotions": 2,
               "rejections": 1, "staleness_mean": 0.6, "staleness_max": 1.0,
               "swap_p99_delta_ms": 3.25, "dropped": 0, "completed": 132,
@@ -425,3 +425,65 @@ def test_bench_trainserve_leg_contract(monkeypatch):
     _Proc.stdout = _json.dumps(canned) + "\n"
     with pytest.raises(RuntimeError, match="dropped"):
         bench.bench_trainserve()
+
+
+def test_bench_serving_resilience_leg_contract(monkeypatch):
+    """The serving_resilience leg (schema v6) runs serve_chaos_run.py
+    --smoke in a SUBPROCESS and parses one JSON line; pin the field
+    mapping against _KNOWN_FIELDS/_KNOWN_LEGS and every failure mode
+    the guarded leg relies on — non-zero exit, not-ok record, and the
+    exactly-once bar (dropped > 0 must RAISE, never land).  The live
+    path is tests/test_serving_resilience.py's chaos-marked drill."""
+    import json as _json
+    import subprocess
+
+    import bench
+
+    canned = {"ok": True, "model": "lenet", "requests": 240,
+              "completed": 202, "dropped": 0, "sheds": 31,
+              "deadline_drops": 7, "breaker_trips": 2, "respawns": 2,
+              "recovery_s": 2.26, "interactive_p99_ms": 205.2,
+              "replay_bitwise": True, "generations": [0]}
+
+    class _Proc:
+        returncode = 0
+        stderr = ""
+        stdout = "progress noise\n" + _json.dumps(canned) + "\n"
+
+    calls = []
+
+    def fake_run(cmd, **kw):
+        calls.append(cmd)
+        return _Proc()
+
+    monkeypatch.setattr(subprocess, "run", fake_run)
+    r = bench.bench_serving_resilience()
+    assert calls and calls[0][1].endswith("serve_chaos_run.py")
+    assert "--smoke" in calls[0]
+    assert r["serving_resilience_requests"] == 240
+    assert r["serving_resilience_completed"] == 202
+    assert r["serving_resilience_dropped"] == 0
+    assert r["serving_resilience_sheds"] == 31
+    assert r["serving_resilience_deadline_drops"] == 7
+    assert r["serving_resilience_breaker_trips"] == 2
+    assert r["serving_resilience_respawns"] == 2
+    assert r["serving_resilience_recovery_s"] == 2.26
+    assert r["serving_resilience_interactive_p99_ms"] == 205.2
+    assert r["serving_resilience_replay_bitwise"] is True
+    assert set(r) <= bench._KNOWN_FIELDS
+    assert "serving_resilience" in bench._KNOWN_LEGS
+
+    _Proc.returncode = 1
+    _Proc.stderr = "boom"
+    with pytest.raises(RuntimeError, match="exited 1"):
+        bench.bench_serving_resilience()
+    _Proc.returncode = 0
+    canned["ok"] = False
+    _Proc.stdout = _json.dumps(canned) + "\n"
+    with pytest.raises(RuntimeError, match="not-ok"):
+        bench.bench_serving_resilience()
+    canned["ok"] = True
+    canned["dropped"] = 3
+    _Proc.stdout = _json.dumps(canned) + "\n"
+    with pytest.raises(RuntimeError, match="dropped"):
+        bench.bench_serving_resilience()

@@ -798,6 +798,10 @@ def test_lint_gate_script(tmp_path):
     # tests/test_deploy.py's e2e session test)
     assert "trainserve_run.py --smoke" in text
     assert "SPARKNET_LINT_GATE_NO_TRAINSERVE" in text
+    # ... and the serving-resilience chaos smoke (exercised live by the
+    # chaos-marked tests in tests/test_serving_resilience.py)
+    assert "serve_chaos_run.py --smoke" in text
+    assert "SPARKNET_LINT_GATE_NO_SERVECHAOS" in text
     clean = _mkpkg(tmp_path, {"ok.py": "x = 1\n"})
     dirty_dir = tmp_path / "dirty"
     dirty_dir.mkdir()
@@ -805,7 +809,8 @@ def test_lint_gate_script(tmp_path):
     env = dict(os.environ, JAX_PLATFORMS="cpu",
                SPARKNET_LINT_GATE_NO_PROC="1",
                SPARKNET_LINT_GATE_NO_CONTRACT="1",
-               SPARKNET_LINT_GATE_NO_TRAINSERVE="1")
+               SPARKNET_LINT_GATE_NO_TRAINSERVE="1",
+               SPARKNET_LINT_GATE_NO_SERVECHAOS="1")
     rc_clean = subprocess.run(
         ["bash", gate, clean, "--select", "R001"],
         cwd=REPO, env=env, capture_output=True, text=True)

@@ -251,6 +251,7 @@ def test_model_stats_snapshot_byte_for_byte_zero_state():
     pinned = ('{"submitted": 0, "completed": 0, "failed": 0, '
               '"batches": 0, "rejected_overload": 0, '
               '"rejected_deadline": 0, "rejected_closed": 0, '
+              '"rejected_shed": 0, '
               '"batch_occupancy_mean": 0.0, "bucket_counts": {}, '
               f'"queue_wait_ms": {zero_ms}, "assembly_ms": {zero_ms}, '
               f'"device_ms": {zero_ms}, "total_ms": {zero_ms}}}')
@@ -426,8 +427,8 @@ def test_bench_stamp_provenance():
 
     payload = {"metric": "x", "value": 1.0}
     out = bench._stamp(payload)
-    # v5: the trainserve leg (train-while-serve trainserve_* fields)
-    assert out["schema_version"] == bench.BENCH_SCHEMA_VERSION == 5
+    # v6: the serving_resilience leg (serving_resilience_* fields)
+    assert out["schema_version"] == bench.BENCH_SCHEMA_VERSION == 6
     assert "git_sha" in out and "env" in out
     assert all(k.startswith("SPARKNET_") for k in out["env"])
     assert out["value"] == 1.0

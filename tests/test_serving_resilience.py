@@ -1,0 +1,634 @@
+"""Serving resilience control plane (sparknet_tpu/serving/resilience.py):
+circuit breakers walk closed -> open -> half-open -> closed with every
+side effect accounted (disable/drain/requeue/evict/respawn), SLO-aware
+shedding hits ONLY batch-priority traffic, deadlines propagate to 504s
+before device time, the seeded ServeFaultPlan is bitwise-replayable, and
+a respawned replica serves bitwise-identical math under the SAME
+generation stamp (the PR-8 parity pin, extended over eviction).
+
+The reference stack has no serving fault story at all (training-side
+solver restarts only: reference src/caffe/solver.cpp:444-465 Snapshot /
+Restore), so these tests are the contract.
+"""
+
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from sparknet_tpu.serving import (CircuitBreaker, DeadlineExceeded,
+                                  InferenceServer, RequestShed,
+                                  ResilienceConfig, ResilienceManager,
+                                  ServeFaultPlan, ServerConfig,
+                                  pad_to_bucket)
+from sparknet_tpu.serving.resilience import (BREAKER_COOLDOWN_ENV,
+                                             BREAKER_ERRS_ENV,
+                                             BREAKER_WINDOW_ENV,
+                                             PROBES_ENV,
+                                             SHED_FRACTION_ENV, SLO_ENV)
+
+LENET_SHAPE = (1, 28, 28)
+
+
+def _samples(n, seed=0, shape=LENET_SHAPE):
+    return np.random.RandomState(seed).rand(n, *shape).astype(np.float32)
+
+
+# ---------------------------------------------------------- fault plan
+def test_fault_plan_spec_round_trip_and_semantics():
+    plan = ServeFaultPlan.from_spec(
+        "errstorm:0@6+10, kill:1@4, spike:2@3+5x12.5, flaky:0.0", seed=9)
+    assert plan.storms == {0: (6, 10)}
+    assert plan.kills == {1: 4}
+    assert plan.spikes == {2: (3, 5, 12.5)}
+    # storm window is half-open [start, start+n)
+    assert not plan.error_at(0, 5)
+    assert plan.error_at(0, 6) and plan.error_at(0, 15)
+    assert not plan.error_at(0, 16)
+    # spikes delay, storms error; a spiked dispatch is NOT an error
+    assert plan.spike_ms(2, 3) == 12.5 and plan.spike_ms(2, 8) == 0.0
+    assert not plan.error_at(2, 3)
+    # kill is a latch in decision space: every dispatch >= 4 marked
+    assert plan.kill_at(1) == 4 and plan.kill_at(0) is None
+    assert plan.decision(1, 3) == "." and plan.decision(1, 4) == "k"
+    assert plan.decision(0, 6) == "e" and plan.decision(2, 4) == "s12.5"
+
+
+def test_fault_plan_schedule_replays_bitwise():
+    """The determinism contract: the fault SCHEDULE is a pure function
+    of (seed, replica, dispatch) — two constructions agree on every
+    decision, a different seed diverges (via the flaky sha256 draw)."""
+    spec = "errstorm:0@2+4,kill:2@7,flaky:0.31"
+    a = ServeFaultPlan.from_spec(spec, seed=5)
+    b = ServeFaultPlan.from_spec(spec, seed=5)
+    assert a.schedule_digest(3, 512) == b.schedule_digest(3, 512)
+    c = ServeFaultPlan.from_spec(spec, seed=6)
+    assert a.schedule_digest(3, 512) != c.schedule_digest(3, 512)
+    # flaky draws reuse elastic/chaos.py's u01 — seeded, not clocked
+    hits = sum(a.error_at(1, d) for d in range(2000))
+    assert 450 < hits < 800          # ~0.31 of 2000, deterministic
+
+
+def test_fault_plan_parser_valueerror_contract():
+    """Malformed tokens die with a ValueError NAMING the token (the
+    repo-wide parser contract) — never IndexError/KeyError."""
+    for bad in ("errstorm:0@6", "spike:1@2+3", "kill:0", "flaky:lots",
+                "errstorm:x@1+2", "spike:0@1+2xfast", "unknowntok:1",
+                "errstorm", "kill:1@-3", "flaky:1.5"):
+        with pytest.raises(ValueError, match="serve chaos|must be|prob"):
+            ServeFaultPlan.from_spec(bad)
+    # the offending token is named in the message
+    try:
+        ServeFaultPlan.from_spec("kill:1@4,errstorm:9@oops+2")
+    except ValueError as e:
+        assert "errstorm:9@oops+2" in str(e)
+    else:
+        pytest.fail("malformed token accepted")
+    # empty / whitespace specs are a clean no-fault plan
+    assert ServeFaultPlan.from_spec("").storms == {}
+    assert ServeFaultPlan.from_spec(" , ").kills == {}
+
+
+# ------------------------------------------------------------- breaker
+def _breaker(**kw):
+    kw.setdefault("window", 8)
+    kw.setdefault("error_threshold", 0.5)
+    kw.setdefault("min_samples", 4)
+    kw.setdefault("cooldown_s", 0.05)
+    kw.setdefault("half_open_probes", 2)
+    return CircuitBreaker(**kw)
+
+
+def test_breaker_trips_on_rolling_window_not_before_min_samples():
+    br = _breaker()
+    # 3 straight errors: rate 1.0 but n < min_samples -> still closed
+    assert not br.record(False) and not br.record(False)
+    assert not br.record(False)
+    assert br.state == "closed" and br.trips == 0
+    assert br.record(False)               # 4th error trips
+    assert br.state == "open" and br.trips == 1
+    # outcomes landing while open (in-flight stragglers) are ignored
+    assert not br.record(True) and br.state == "open"
+
+
+def test_breaker_half_open_probe_streak_and_refail():
+    br = _breaker()
+    br.trip(100.0)
+    assert not br.cooled_down(100.01)
+    assert br.cooled_down(100.06)
+    br.begin_probing()
+    assert br.state == "half_open"
+    assert not br.probe_ok()              # streak 1/2: still half-open
+    br.probe_fail(200.0)                  # re-open WITHOUT a new trip
+    assert br.state == "open" and br.trips == 1
+    assert br.opened_at == 200.0 and br.probe_successes == 0
+    br.begin_probing()
+    assert not br.probe_ok()
+    assert br.probe_ok()                  # streak reaches 2 -> closed
+    assert br.state == "closed"
+    # a fresh window after closing: old outcomes don't linger
+    assert br.error_rate() == 0.0
+
+
+def test_breaker_validation():
+    for kw in ({"window": 0}, {"error_threshold": 0.0},
+               {"error_threshold": 1.5}, {"min_samples": 0},
+               {"cooldown_s": 0.0}, {"half_open_probes": 0}):
+        with pytest.raises(ValueError):
+            _breaker(**kw)
+
+
+# ---------------------------------------------------------- env knobs
+def test_resilience_config_env_defaults(monkeypatch):
+    for env in (BREAKER_WINDOW_ENV, BREAKER_ERRS_ENV,
+                BREAKER_COOLDOWN_ENV, PROBES_ENV, SLO_ENV,
+                SHED_FRACTION_ENV):
+        monkeypatch.delenv(env, raising=False)
+    cfg = ResilienceConfig()
+    assert cfg.breaker_window == 16
+    assert cfg.breaker_error_threshold == 0.5
+    assert cfg.cooldown_s == 0.25
+    assert cfg.half_open_probes == 3
+    assert cfg.slo_ms == 500.0
+    assert cfg.shed_fraction == 0.5
+    monkeypatch.setenv(BREAKER_WINDOW_ENV, "32")
+    monkeypatch.setenv(SLO_ENV, "250")
+    cfg = ResilienceConfig()
+    assert cfg.breaker_window == 32 and cfg.slo_ms == 250.0
+    # explicit constructor values beat the env
+    assert ResilienceConfig(slo_ms=90.0).slo_ms == 90.0
+    monkeypatch.setenv(SLO_ENV, "not_a_number")
+    with pytest.raises(ValueError, match=SLO_ENV):
+        ResilienceConfig()
+    monkeypatch.delenv(SLO_ENV, raising=False)
+    monkeypatch.setenv(SHED_FRACTION_ENV, "1.7")
+    with pytest.raises(ValueError, match="shed_fraction"):
+        ResilienceConfig()
+
+
+def test_resilience_config_validation():
+    with pytest.raises(ValueError, match="breaker_window"):
+        ResilienceConfig(breaker_window=0)
+    with pytest.raises(ValueError, match="cooldown_s"):
+        ResilienceConfig(cooldown_s=-1.0)
+    with pytest.raises(ValueError, match="max_retries"):
+        ResilienceConfig(max_retries=-1)
+
+
+def test_submit_timeout_knob(monkeypatch):
+    from sparknet_tpu.serving.scheduler import (SUBMIT_TIMEOUT_ENV,
+                                                default_submit_timeout_s)
+
+    monkeypatch.delenv(SUBMIT_TIMEOUT_ENV, raising=False)
+    assert default_submit_timeout_s() == 30.0
+    monkeypatch.setenv(SUBMIT_TIMEOUT_ENV, "2.5")
+    assert default_submit_timeout_s() == 2.5
+    monkeypatch.setenv(SUBMIT_TIMEOUT_ENV, "zero")
+    with pytest.raises(ValueError, match=SUBMIT_TIMEOUT_ENV):
+        default_submit_timeout_s()
+    monkeypatch.setenv(SUBMIT_TIMEOUT_ENV, "-4")
+    with pytest.raises(ValueError, match="> 0"):
+        default_submit_timeout_s()
+
+
+# ----------------------------------------------------------- scheduler
+def test_submit_wait_true_is_bounded_by_the_timeout_knob(monkeypatch):
+    """The PR-8 unbounded-block fix: a full scheduler with wait=True
+    blocks AT MOST the knob's seconds, then raises SchedulerFull (the
+    server maps it to 503) — a stuck replica can never hang a client
+    thread forever."""
+    from sparknet_tpu.serving.scheduler import (ReplicaScheduler,
+                                                SchedulerFull,
+                                                SUBMIT_TIMEOUT_ENV)
+
+    release = threading.Event()
+    sched = ReplicaScheduler(1, max_batch=1, queue_depth=3,
+                             run=lambda i, b: release.wait(10),
+                             name="t")
+    try:
+        sched.submit("wedged")            # worker takes it and blocks
+        time.sleep(0.05)
+        for k in range(3):
+            sched.submit(f"q{k}")         # fill the queue
+        with pytest.raises(SchedulerFull):
+            sched.submit("over", wait=False)
+        monkeypatch.setenv(SUBMIT_TIMEOUT_ENV, "0.2")
+        t0 = time.perf_counter()
+        with pytest.raises(SchedulerFull):
+            sched.submit("over", wait=True)     # knob bounds the block
+        elapsed = time.perf_counter() - t0
+        assert 0.15 <= elapsed < 5.0
+        # an explicit timeout_s beats the knob
+        t0 = time.perf_counter()
+        with pytest.raises(SchedulerFull):
+            sched.submit("over", wait=True, timeout_s=0.05)
+        assert time.perf_counter() - t0 < 0.2 + 1.0
+    finally:
+        release.set()
+        sched.stop(drain=True)
+
+
+def test_scheduler_disable_drain_requeue_exactly_once():
+    """The breaker eviction path at the scheduler layer: disabling stops
+    routing, drain+requeue moves the queued items (bypassing
+    queue_depth — they were already admitted), and every item is
+    processed EXACTLY once end to end."""
+    from sparknet_tpu.serving.scheduler import ReplicaScheduler
+
+    release = threading.Event()
+    done, mu = [], threading.Lock()
+
+    def run(i, batch):
+        release.wait(10)
+        with mu:
+            done.extend((i, item) for item in batch)
+
+    sched = ReplicaScheduler(2, max_batch=1, queue_depth=6, run=run,
+                             name="t")
+    try:
+        sched.submit("w0")                # blocks worker 0
+        sched.submit("w1")                # blocks worker 1
+        time.sleep(0.05)
+        for k in range(4):                # queued 2 per replica
+            sched.submit(f"q{k}")
+        assert sched.enabled_mask() == [True, True]
+        sched.set_enabled(0, False)
+        assert not sched.is_enabled(0)
+        drained = sched.drain_replica(0)
+        assert len(drained) == 2
+        assert sched.depth(0)[0] == 0
+        sched.requeue(drained, exclude=0)
+        # all four queued items now sit on the one enabled replica
+        assert sched.depth(1)[0] == 4
+        # new admissions also avoid the disabled replica
+        sched.submit("fresh")
+        assert sched.depth(0)[0] == 0 and sched.depth(1)[0] == 5
+        # requeue bypasses queue_depth outright: re-admitting past the
+        # admission cap must never re-reject already-admitted work
+        sched.requeue(["extra0", "extra1"], exclude=0)
+        assert sched.depth(1)[0] == 7
+    finally:
+        release.set()
+        sched.stop(drain=True)
+    items = sorted(item for _, item in done)
+    assert items == sorted(["w0", "w1", "q0", "q1", "q2", "q3", "fresh",
+                            "extra0", "extra1"])
+    # nothing ran on the disabled replica after the drain point
+    assert all(i == 1 for i, item in done
+               if item.startswith(("q", "f", "e")))
+
+
+def test_placer_evict_respawn_same_device():
+    from sparknet_tpu.serving.placement import DevicePlacer
+
+    p = DevicePlacer(["dev0", "dev1", "dev2"])
+    assert p.place("m", 2) == ["dev0", "dev1"]
+    dev = p.evict("m", 1)
+    assert dev == "dev1"
+    assert p.describe()["evicted"] == {"m": [1]}
+    # the freed device takes new load while the slot is out
+    assert p.describe()["load"] == [1, 0, 0]
+    with pytest.raises(ValueError, match="already evicted"):
+        p.evict("m", 1)
+    with pytest.raises(ValueError, match="not evicted"):
+        p.respawn("m", 0)
+    assert p.respawn("m", 1) == "dev1"    # SAME device, residency back
+    assert p.describe()["load"] == [1, 1, 0]
+    assert "evicted" not in p.describe()
+    with pytest.raises(ValueError, match="no placement"):
+        p.evict("ghost", 0)
+    with pytest.raises(ValueError, match="slot"):
+        p.evict("m", 9)
+    # release with an outstanding eviction stays consistent
+    p.evict("m", 0)
+    p.release("m")
+    assert p.describe()["load"] == [0, 0, 0]
+
+
+# ------------------------------------------------- server integration
+def _resil_server(tmp_path=None, **rkw):
+    rkw.setdefault("cooldown_s", 0.1)
+    rkw.setdefault("tick_s", 0.01)
+    if tmp_path is not None:
+        rkw.setdefault("event_log", str(tmp_path / "events.jsonl"))
+    rcfg = ResilienceConfig(**rkw)
+    cfg = ServerConfig(max_batch=4, max_wait_ms=2.0, queue_depth=16,
+                       resilience=rcfg)
+    return InferenceServer(cfg)
+
+
+def test_batch_sheds_interactive_passes(tmp_path):
+    """shed_fraction=0.0 makes the shed controller maximally paranoid:
+    EVERY batch-priority request sheds with the 503 taxonomy while
+    interactive traffic is untouched — and the books agree across the
+    exception type, stats(), the snapshot, and the JSONL event."""
+    server = _resil_server(tmp_path, shed_fraction=0.0)
+    try:
+        server.load("lenet")
+        x = _samples(1)[0]
+        r = server.submit("lenet", x, priority="interactive").result(30)
+        assert r.priority == "interactive"
+        with pytest.raises(RequestShed) as ei:
+            server.submit("lenet", x, priority="batch")
+        assert ei.value.status == 503
+        assert isinstance(ei.value, RequestShed)
+        with pytest.raises(ValueError, match="priority"):
+            server.submit("lenet", x, priority="bulk")
+        m = server.stats()["models"]["lenet"]
+        assert m["rejected_shed"] == 1
+        resil = m["resilience"]
+        assert resil["sheds"] == 1
+        assert resil["sheds_by_priority"] == {"interactive": 0,
+                                              "batch": 1}
+        mgr = server.resilience("lenet")
+        sheds = [e for e in mgr.events_snapshot() if e["kind"] == "shed"]
+        assert len(sheds) == 1 and sheds[0]["priority"] == "batch"
+        assert "shed fraction" in sheds[0]["reason"]
+        # the JSONL mirror carries the same record
+        logged = [json.loads(line) for line in
+                  open(mgr.cfg.event_log)]
+        assert [e for e in logged if e["kind"] == "shed"] == sheds
+    finally:
+        server.close(drain=True)
+
+
+def test_slo_ewma_sheds_batch(tmp_path):
+    """The latency arm: once the interactive total-latency EWMA sits
+    over slo_ms, batch admission sheds even with an empty queue."""
+    server = _resil_server(tmp_path, slo_ms=5.0, shed_fraction=1.0)
+    try:
+        server.load("lenet")
+        mgr = server.resilience("lenet")
+        # feed the controller directly: deterministic, no timing games
+        for _ in range(8):
+            mgr.observe_total("interactive", 80.0)
+        assert mgr.snapshot()["interactive_ewma_ms"] > 5.0
+        x = _samples(1)[0]
+        with pytest.raises(RequestShed, match="SLO"):
+            server.submit("lenet", x, priority="batch")
+        r = server.submit("lenet", x, priority="interactive").result(30)
+        assert r.argmax == int(np.argmax(np.asarray(r.probs)))
+        # batch latencies never move the interactive EWMA
+        before = mgr.snapshot()["interactive_ewma_ms"]
+        mgr.observe_total("batch", 10_000.0)
+        assert mgr.snapshot()["interactive_ewma_ms"] == before
+    finally:
+        server.close(drain=True)
+
+
+def test_dead_on_arrival_deadline_is_504_before_device_time(tmp_path):
+    server = _resil_server(tmp_path)
+    try:
+        server.load("lenet")
+        x = _samples(1)[0]
+        with pytest.raises(DeadlineExceeded):
+            server.submit("lenet", x, deadline_ms=0.0)
+        m = server.stats()["models"]["lenet"]
+        assert m["rejected_deadline"] == 1
+        assert m["resilience"]["deadline_drops"] == 1
+        drops = [e for e in server.resilience("lenet").events_snapshot()
+                 if e["kind"] == "deadline_drop"]
+        assert len(drops) == 1 and drops[0]["stage"] == "submit"
+    finally:
+        server.close(drain=True)
+
+
+def test_rebuild_replica_is_bitwise_and_keeps_the_generation():
+    """The respawn path must not perturb the math: a rebuilt replica
+    serves bitwise-identical probs under the SAME generation stamp
+    (reload() is the parameter-change path, not respawn)."""
+    server = InferenceServer(ServerConfig(max_batch=4, max_wait_ms=2.0,
+                                          queue_depth=16))
+    try:
+        lm = server.load("lenet", replicas=2)
+        x = _samples(1)[0]
+        old_runner, gen0 = lm.replica_snapshot(1)
+        ref = np.asarray(old_runner.forward_padded(
+            pad_to_bucket(x[None], 1)))
+        fresh = server.registry.rebuild_replica("lenet", 1)
+        new_runner, gen1 = lm.replica_snapshot(1)
+        assert new_runner is fresh and new_runner is not old_runner
+        assert gen1 == gen0               # NO generation bump
+        out = np.asarray(new_runner.forward_padded(
+            pad_to_bucket(x[None], 1)))
+        np.testing.assert_array_equal(out, ref)
+        # the fresh runner is warmed: serving through it compiles nothing
+        warmed = new_runner.compile_count()
+        r = server.submit("lenet", x).result(30)
+        assert r.generation == gen0
+        assert new_runner.compile_count() == warmed
+        from sparknet_tpu.serving import ModelNotLoaded
+        with pytest.raises(ModelNotLoaded):
+            server.registry.rebuild_replica("ghost", 0)
+        with pytest.raises(ValueError, match="slot"):
+            server.registry.rebuild_replica("lenet", 5)
+    finally:
+        server.close(drain=True)
+
+
+def test_health_probe_runs_the_real_forward():
+    server = InferenceServer(ServerConfig(max_batch=4, max_wait_ms=2.0,
+                                          queue_depth=16))
+    try:
+        lm = server.load("lenet")
+        ms = lm.runner.health_probe(seed=3)
+        assert ms > 0.0
+    finally:
+        server.close(drain=True)
+
+
+@pytest.mark.chaos
+def test_breaker_trips_evicts_respawns_and_readmits(tmp_path):
+    """The degradation drill in miniature: an error storm on replica 0
+    trips its breaker (disable + drain + requeue + evict), the
+    maintenance thread respawns it on the same device after cooldown,
+    half-open probes re-admit it, and EVERY submitted request is
+    answered exactly once with bitwise-correct probs under one
+    generation stamp."""
+    plan = ServeFaultPlan.from_spec("errstorm:0@0+8", seed=3)
+    server = _resil_server(tmp_path, fault_plan=plan, cooldown_s=0.1,
+                           half_open_probes=2)
+    try:
+        lm = server.load("lenet", replicas=2)
+        mgr = server.resilience("lenet")
+        xs = _samples(32, seed=11)
+        futs = []
+        for i in range(32):
+            futs.append(server.submit("lenet", xs[i]))
+            time.sleep(0.004)
+        rs = [f.result(timeout=60) for f in futs]   # exactly-once: all land
+        assert len(rs) == 32
+        assert {r.generation for r in rs} == {0}
+        for i in (0, 7, 19, 31):        # parity survives requeue/retry
+            ref = lm.runner.forward_padded(
+                pad_to_bucket(xs[i][None], rs[i].bucket))[0]
+            np.testing.assert_array_equal(np.asarray(rs[i].probs),
+                                          np.asarray(ref))
+        deadline = time.perf_counter() + 20.0
+        while not mgr.all_closed() and time.perf_counter() < deadline:
+            time.sleep(0.02)
+        snap = mgr.snapshot()
+        assert snap["trips"] >= 1
+        assert snap["respawns"] >= 1
+        assert snap["incarnations"][0] >= 1
+        assert snap["breakers"] == {"0": "closed", "1": "closed"}
+        assert snap["probes_ok"] >= 2
+        assert float(snap["recovery_s"].get("0", 0.0)) > 0.0
+        kinds = [e["kind"] for e in mgr.events_snapshot()]
+        assert "replica_open" in kinds and "replica_respawn" in kinds
+        assert "replica_probe" in kinds
+        opens = [e for e in mgr.events_snapshot()
+                 if e["kind"] == "replica_open"]
+        assert all(e["replica"] == 0 for e in opens)
+        # once re-admitted, the respawned replica takes traffic again
+        assert server.stats()["models"]["lenet"]["failed"] == 0
+        m = server.stats()["models"]["lenet"]
+        assert m["completed"] == 32
+        # breaker-state gauge surfaced per replica
+        rb = m["replicas"]["0"]
+        assert rb["breaker_state"] == 0   # closed again
+    finally:
+        server.close(drain=True)
+
+
+def _overload_soak(tmp_path, tag, seed=13):
+    """One seeded kill + flash-crowd pass; returns (digest, metrics).
+    Latency spikes on every replica make the crowd outrun service
+    capacity deterministically, so the shed path genuinely fires."""
+    spec = "kill:0@2,spike:0@0+500x6,spike:1@0+500x6"
+    plan = ServeFaultPlan.from_spec(spec, seed=seed)
+    digest = plan.schedule_digest(2, 512)
+    rcfg = ResilienceConfig(
+        cooldown_s=0.1, tick_s=0.01, slo_ms=5000.0, shed_fraction=0.2,
+        fault_plan=plan,
+        event_log=str(tmp_path / f"soak-{tag}.jsonl"))
+    server = InferenceServer(ServerConfig(
+        max_batch=4, max_wait_ms=2.0, queue_depth=20, resilience=rcfg))
+    try:
+        server.load("lenet", replicas=2)
+        mgr = server.resilience("lenet")
+        rng = np.random.RandomState(seed)
+        xs = rng.rand(64, *LENET_SHAPE).astype(np.float32)
+        pris = ["interactive" if rng.rand() < 0.7 else "batch"
+                for _ in range(120)]
+        gaps = rng.exponential(1.0, size=120)
+        futs, shed, overload = [], 0, 0
+        next_t = time.perf_counter()
+        for i in range(120):
+            qps = 800.0 if i >= 60 else 150.0    # flash crowd at half
+            next_t += gaps[i] / qps
+            dt = next_t - time.perf_counter()
+            if dt > 0:
+                time.sleep(dt)
+            try:
+                futs.append((i, pris[i],
+                             server.submit("lenet", xs[i % 64],
+                                           priority=pris[i])))
+            except RequestShed:
+                shed += 1
+                assert pris[i] == "batch"     # interactive never sheds
+            except Exception:
+                overload += 1
+        lat = {"interactive": [], "batch": []}
+        answered = 0
+        for i, pri, f in futs:
+            try:
+                r = f.result(timeout=60)
+            except Exception:
+                answered += 1          # a loud status is an answer too
+                continue
+            answered += 1
+            assert r.generation == 0
+            lat[pri].append(r.total_ms)
+        deadline = time.perf_counter() + 20.0
+        while not mgr.all_closed() and time.perf_counter() < deadline:
+            time.sleep(0.02)
+        snap = mgr.snapshot()
+        m = server.stats()["models"]["lenet"]
+        return digest, {
+            "answered": answered, "submitted": len(futs),
+            "shed_client": shed, "snap": snap,
+            "stat_shed": m["rejected_shed"],
+            "interactive_p99": (float(np.percentile(lat["interactive"],
+                                                    99))
+                                if lat["interactive"] else 0.0),
+            "all_closed": mgr.all_closed(),
+        }
+    finally:
+        server.close(drain=True)
+
+
+@pytest.mark.chaos
+@pytest.mark.slow
+def test_overload_soak_sheds_batch_only_and_replays_bitwise(tmp_path):
+    """Satellite (c): seeded replica kill + flash crowd.  Interactive
+    p99 stays under the SLO, batch absorbs 100% of the sheds, nothing
+    is dropped without a status, and the fault schedule replays bitwise
+    across two runs."""
+    d1, r1 = _overload_soak(tmp_path, "a")
+    d2, r2 = _overload_soak(tmp_path, "b")
+    assert d1 == d2                       # two-run bitwise determinism
+    for r in (r1, r2):
+        # every admitted future resolved (answer or loud status)
+        assert r["answered"] == r["submitted"]
+        snap = r["snap"]
+        assert snap["trips"] >= 1         # the kill tripped replica 0
+        assert snap["respawns"] >= 1
+        assert r["all_closed"]            # and it was re-admitted
+        # batch absorbs 100% of sheds
+        assert snap["sheds_by_priority"]["interactive"] == 0
+        assert snap["sheds_by_priority"]["batch"] == snap["sheds"]
+        assert r["stat_shed"] == snap["sheds"]
+        assert r["shed_client"] == snap["sheds"]
+        assert r["interactive_p99"] <= 5000.0
+    # the crowd genuinely exercised the shed path in at least one run
+    assert r1["snap"]["sheds"] + r2["snap"]["sheds"] >= 1
+
+
+def test_manager_snapshot_shape_and_stop_idempotent():
+    """ResilienceManager against stub collaborators: snapshot keys are
+    the drill's accounting surface, and stop() is idempotent."""
+
+    class _Sched:
+        def set_enabled(self, i, e):
+            pass
+
+        def drain_replica(self, i):
+            return []
+
+        def requeue(self, items, exclude=None):
+            pass
+
+    class _Stats:
+        def observe_breaker(self, i, state):
+            pass
+
+    class _LM:
+        n_replicas = 2
+        stats = _Stats()
+
+        def replica_snapshot(self, i):
+            return None, 0
+
+    mgr = ResilienceManager(model="m", sched=_Sched(), lm=_LM(),
+                            registry=None,
+                            config=ResilienceConfig(tick_s=0.01))
+    try:
+        snap = mgr.snapshot()
+        assert set(snap) == {
+            "breakers", "trips", "open_now", "respawns", "incarnations",
+            "probes_ok", "probes_failed", "sheds", "sheds_by_priority",
+            "deadline_drops", "requeued", "retried", "recovery_s",
+            "interactive_ewma_ms", "fault_plan"}
+        assert snap["breakers"] == {"0": "closed", "1": "closed"}
+        assert snap["fault_plan"] is False
+        assert mgr.all_closed()
+        # no fault plan -> on_dispatch injects nothing, only counts
+        assert mgr.on_dispatch(0) == (False, 0.0)
+        assert mgr.on_dispatch(0) == (False, 0.0)
+    finally:
+        mgr.stop()
+        mgr.stop()                        # idempotent
+    assert not mgr._thread.is_alive()
