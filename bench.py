@@ -785,9 +785,13 @@ def bench_imagenet_native(rounds: int = 3, tau: int = 5, batch: int = 64,
                      if k != "per_round"}
     finally:
         shutil.rmtree(tmp, ignore_errors=True)
+    from sparknet_tpu.ops.fused_block import effective_fused_blocks_mode
+
     out = {"imagenet_native_fed_imgs_per_sec":
            round(rounds * tau * batch / dt, 1),
            "imagenet_native_batch": batch, "imagenet_native_tau": tau,
+           "imagenet_native_precision": solver.precision,
+           "imagenet_native_fused_blocks": effective_fused_blocks_mode(),
            "imagenet_native_ingest": ingest,
            "imagenet_native_round_telemetry": telemetry}
     log(json.dumps(out))
@@ -810,7 +814,9 @@ def bench_cifar_e2e(rounds: int = 6, tau: int = 100,
     "round_telemetry": solver.round_stats() sans per_round} so the
     per-stage pull/stack/device_put/stall split AND the per-round phase
     means ride the driver record (data/counters.py + parallel/dist.py
-    round telemetry semantics)."""
+    round telemetry semantics).  `precision` and `fused_blocks` (the
+    EFFECTIVE fused-blocks mode — pallas degrades to xla off-TPU) stamp
+    the record so A/B runs are attributable."""
     import numpy as np
 
     from sparknet_tpu.apps.cifar_app import build_solver
@@ -845,7 +851,11 @@ def bench_cifar_e2e(rounds: int = 6, tau: int = 100,
     for r in range(rounds):
         solver.run_round(prefetch_next=r < rounds - 1)
     dt = time.perf_counter() - t0
+    from sparknet_tpu.ops.fused_block import effective_fused_blocks_mode
+
     return {"imgs_per_sec": rounds * tau * batch / dt,
+            "precision": solver.precision,
+            "fused_blocks": effective_fused_blocks_mode(),
             "ingest": solver.ingest_stats(),
             "round_telemetry": {k: v for k, v
                                 in solver.round_stats().items()
@@ -874,9 +884,14 @@ _KNOWN_FIELDS = {
     "alexnet_infer_imgs_per_sec", "googlenet_infer_imgs_per_sec",
     "longctx_lm_tok_per_sec", "cifar_e2e_imgs_per_sec",
     "cifar_e2e_ingest", "cifar_e2e_round_telemetry",
+    # attribution stamps (schema v7): precision + the EFFECTIVE
+    # fused-blocks mode (pallas degrades to xla off-TPU) on the two
+    # end-to-end training legs, so A/B records name what actually ran
+    "cifar_e2e_precision", "cifar_e2e_fused_blocks",
     "imagenet_native_fed_imgs_per_sec", "imagenet_native_batch",
     "imagenet_native_tau", "imagenet_native_ingest",
     "imagenet_native_round_telemetry",
+    "imagenet_native_precision", "imagenet_native_fused_blocks",
     # emit-time provenance stamps (_stamp); never persisted by
     # _persist_leg, listed so a hand-edited record carrying them is
     # not flagged as drift
@@ -1020,7 +1035,13 @@ def _stale_record(reason: str) -> dict:
     return stale
 
 
-BENCH_SCHEMA_VERSION = 6  # v6: serving_resilience leg (degradation
+BENCH_SCHEMA_VERSION = 7  # v7: cifar_e2e/imagenet_native records carry
+#                           precision + effective fused-blocks stamps
+#                           (cifar_e2e_precision, cifar_e2e_fused_blocks,
+#                           imagenet_native_precision,
+#                           imagenet_native_fused_blocks) so full-block
+#                           A/B runs are attributable;
+#                           v6: serving_resilience leg (degradation
 #                           drill — breaker trips/respawns, recovery_s,
 #                           sheds, interactive p99, dropped==0 bar;
 #                           serve_chaos_run.py subprocess);
@@ -1294,6 +1315,9 @@ def _run_legs(land) -> None:
                     "cifar_e2e_ingest": cifar_e2e["ingest"]}))
     land("cifar_e2e", {"cifar_e2e_imgs_per_sec":
                        round(cifar_e2e["imgs_per_sec"], 1),
+                       "cifar_e2e_precision": cifar_e2e["precision"],
+                       "cifar_e2e_fused_blocks":
+                       cifar_e2e["fused_blocks"],
                        "cifar_e2e_ingest": cifar_e2e["ingest"],
                        "cifar_e2e_round_telemetry":
                        cifar_e2e["round_telemetry"]})
@@ -1394,6 +1418,10 @@ def _run_legs(land) -> None:
               "imagenet_native_batch":
               imgnet_native["imagenet_native_batch"],
               "imagenet_native_tau": imgnet_native["imagenet_native_tau"],
+              "imagenet_native_precision":
+              imgnet_native["imagenet_native_precision"],
+              "imagenet_native_fused_blocks":
+              imgnet_native["imagenet_native_fused_blocks"],
               "imagenet_native_ingest":
               imgnet_native["imagenet_native_ingest"],
               "imagenet_native_round_telemetry":

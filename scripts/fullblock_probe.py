@@ -1,0 +1,190 @@
+"""Kernel-level A/B: full-block implicit-GEMM conv vs tail-only vs XLA.
+
+Three legs per geometry, the full-block decision data ISSUE 16 asks for:
+
+  full : ops/pallas_conv.fused_conv_block_pallas — conv on the MXU plus
+         the bias→[relu]→LRN→MAX-pool epilogue in ONE VMEM residency.
+  tail : ops/conv.conv2d (stock XLA conv) + fused_tail_pallas — the
+         PR 7 kernel, i.e. what SPARKNET_FUSED_BLOCKS=pallas-tail runs.
+  xla  : fused_conv_lrn_pool(impl="xla") — the stock composed ops.
+
+Timing is the probe_util amortized-dispatch template: ONE jitted scan of
+dependent steps, VALUE-fetch synced (block_until_ready lies on the axon
+tunnel), fetch floor subtracted, iters escalated until the window
+dominates the floor.  Losses are NON-LINEAR (sum(y**2) — sum(conv) gets
+folded by XLA), and every timing is sanity-checked against the device's
+peak FLOPs: an implied rate at/above peak means elision, not speed, and
+the row is flagged rather than trusted.  Legs run interleaved
+A/B/A/B within each rep (this box swings ~8% run-to-run through the
+tunnel — BENCH_NOTES.md).
+
+Off-TPU the pallas legs are meaningless-to-time: without --interpret
+they are SKIPPED (the xla leg still runs so the harness stays
+exercised); with --interpret they run under the Pallas emulator for a
+PARITY smoke only (bitwise full-vs-tail on integer inputs, allclose vs
+xla) and timings are stamped interpret=True so nobody quotes them.
+
+Run: python scripts/fullblock_probe.py [--interpret] [--reps 3]
+         [--shapes alex_norm1,goog_conv2] [--batch-scale 1.0]
+Prints one JSON line per row, one summary JSON line last.
+"""
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+# name, N, C, H, W, O, kh, stride, pad, groups, dtype
+# The two AlexNet norm blocks and the GoogLeNet conv2 stage — the
+# geometries core/fuse.py's matcher actually rewrites (bf16 on the
+# GoogLeNet stage: its fp32 VMEM estimate trips the budget gate).
+SHAPES = [
+    ("alex_norm1", 64, 3, 227, 227, 96, 11, 4, 0, 1, "float32"),
+    ("alex_norm2", 64, 96, 27, 27, 256, 5, 1, 2, 2, "float32"),
+    ("goog_conv2", 32, 64, 56, 56, 192, 3, 1, 1, 1, "bfloat16"),
+]
+
+LRN = dict(local_size=5, alpha=1e-4, beta=0.75, k=1.0)
+POOL = dict(pool_kernel=(3, 3), pool_stride=(2, 2), pool_pad=(0, 0))
+
+
+def _legs(x, w, b, stride, pad, groups, interpret):
+    """name -> fn(x) for the three forward paths of one geometry."""
+    from sparknet_tpu.ops import pallas_conv as pc
+    from sparknet_tpu.ops.conv import conv2d
+    from sparknet_tpu.ops.fused_block import (fused_conv_lrn_pool,
+                                              fused_tail_pallas)
+
+    def full(xx):
+        return pc.fused_conv_block_pallas(
+            xx, w, b, stride, pad, groups, 0.0, LRN["local_size"],
+            LRN["alpha"], LRN["beta"], LRN["k"], POOL["pool_kernel"],
+            POOL["pool_stride"], POOL["pool_pad"], interpret)
+
+    def tail(xx):
+        y = conv2d(xx, w, b, stride=stride, pad=pad, groups=groups)
+        return fused_tail_pallas(y, LRN["local_size"], LRN["alpha"],
+                                 LRN["beta"], LRN["k"], 0.0,
+                                 POOL["pool_kernel"], POOL["pool_stride"],
+                                 POOL["pool_pad"], interpret)
+
+    def xla(xx):
+        return fused_conv_lrn_pool(xx, w, b, stride=stride, pad=pad,
+                                   groups=groups, relu_slope=0.0,
+                                   impl="xla", **LRN, **POOL)
+
+    return {"full": full, "tail": tail, "xla": xla}
+
+
+def _row_flops(n, c, h, w, o, kh, stride, pad, groups):
+    oh = (h + 2 * pad - kh) // stride + 1
+    return 2 * n * o * (c // groups) * kh * kh * oh * oh
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--interpret", action="store_true",
+                    help="run pallas legs under the CPU emulator "
+                         "(parity smoke; timings stamped untrustworthy)")
+    ap.add_argument("--reps", type=int, default=3)
+    ap.add_argument("--base-iters", type=int, default=20)
+    ap.add_argument("--shapes", default=None,
+                    help="comma-separated subset of shape names")
+    ap.add_argument("--batch-scale", type=float, default=1.0,
+                    help="scale every N (interpret smoke uses e.g. 0.05)")
+    args = ap.parse_args()
+
+    import jax
+
+    jax.config.update("jax_platforms", jax.default_backend())
+    import jax.numpy as jnp
+    import numpy as np
+
+    from probe_util import amortized_scan_time_s, fetch_floor_s
+    from sparknet_tpu.ops import pallas_conv as pc
+    from sparknet_tpu.utils.flops import peak_flops
+
+    dev = jax.devices()[0]
+    on_tpu = jax.default_backend() == "tpu"
+    run_pallas = on_tpu or args.interpret
+    peak = peak_flops(dev)
+    floor = fetch_floor_s()
+    print(json.dumps(dict(event="config", device=str(dev),
+                          backend=jax.default_backend(),
+                          interpret=args.interpret,
+                          pallas_legs=run_pallas,
+                          fetch_floor_ms=round(1e3 * floor, 2))),
+          flush=True)
+
+    want = set(args.shapes.split(",")) if args.shapes else None
+    rng = np.random.default_rng(0)
+    summary = {}
+    for name, n, c, h, w, o, kh, st, pd, g, dt in SHAPES:
+        if want and name not in want:
+            continue
+        n = max(1, int(round(n * args.batch_scale)))
+        dtype = jnp.dtype(dt)
+        stride, pad = (st, st), (pd, pd)
+        # integer-valued fp32 makes the conv reduction exact in any
+        # order, so the full-vs-tail parity check below is BITWISE
+        x = jnp.asarray(rng.integers(-3, 4, size=(n, c, h, w)),
+                        dtype=dtype)
+        wt = jnp.asarray(rng.integers(-2, 3, size=(o, c // g, kh, kh)),
+                         dtype=dtype)
+        b = jnp.asarray(rng.integers(-2, 3, size=(o,)), dtype=dtype)
+        supported = pc.fullblock_supported(x, wt, stride=stride, pad=pad,
+                                           dilation=(1, 1), groups=g)
+        legs = _legs(x, wt, b, stride, pad, g, args.interpret)
+        if not run_pallas or not supported:
+            legs = {"xla": legs["xla"]} if not run_pallas else {
+                k: v for k, v in legs.items() if k != "full"}
+        row = dict(event="row", shape=name, batch=n, dtype=dt,
+                   fullblock_supported=bool(supported),
+                   interpret=args.interpret)
+
+        if run_pallas and supported:
+            y_full, y_tail = legs["full"](x), legs["tail"](x)
+            y_xla = _legs(x, wt, b, stride, pad, g, False)["xla"](x)
+            row["parity_full_vs_tail_bitwise"] = bool(
+                jnp.all(y_full == y_tail))
+            row["parity_full_vs_xla_allclose"] = bool(
+                jnp.allclose(y_full.astype(jnp.float32),
+                             y_xla.astype(jnp.float32),
+                             rtol=2e-2 if dt == "bfloat16" else 1e-5,
+                             atol=2e-2 if dt == "bfloat16" else 1e-5))
+
+        flops = _row_flops(n, c, h, w, o, kh, st, pd, g)
+        for leg, fn in legs.items():
+            # the scalar feedback keeps a real data dependency between
+            # scan steps while leaving the input numerically inert; the
+            # sum-of-squares reduce is the non-collapsible loss
+            def step(xx, fn=fn):
+                s = jnp.sum(jnp.square(fn(xx).astype(jnp.float32)))
+                return xx + (s * jnp.float32(1e-30)).astype(xx.dtype)
+
+            t = amortized_scan_time_s(step, x, floor,
+                                      base_iters=args.base_iters,
+                                      reps=args.reps)
+            tf = flops / t / 1e12
+            row[f"{leg}_ms"] = round(1e3 * t, 3)
+            row[f"{leg}_tflops"] = round(tf, 2)
+            # >= peak means XLA elided the work — flag, never trust
+            row[f"{leg}_above_peak"] = bool(flops / t >= peak)
+        if "full_ms" in row and "tail_ms" in row:
+            row["tail_over_full"] = round(row["tail_ms"]
+                                          / row["full_ms"], 3)
+        print(json.dumps(row), flush=True)
+        summary[name] = {k: v for k, v in row.items()
+                         if k not in ("event",)}
+
+    print(json.dumps(dict(event="summary", backend=jax.default_backend(),
+                          interpret=args.interpret,
+                          timings_trustworthy=bool(on_tpu),
+                          shapes=summary)), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -26,11 +26,14 @@ python -m sparknet_tpu.cli lint --format json "$@"
 if [ "${SPARKNET_LINT_GATE_NO_CONTRACT:-0}" != "1" ]; then
     # full rule set already ran above; the contract leg re-runs one
     # cheap rule only (the lint exit code contract needs A select) and
-    # diffs the traced round + serving forwards against CONTRACTS.json
+    # diffs the traced fp32 + bf16 rounds and the serving forward
+    # against CONTRACTS.json (the bf16 round pins fp32-psum collectives
+    # + the enumerated master-weight convert edges)
     timeout -k 10 600 env JAX_PLATFORMS=cpu \
         XLA_FLAGS=--xla_force_host_platform_device_count=8 \
         python -m sparknet_tpu.cli lint --format json --select R007 \
-        --jaxpr round --jaxpr serve --model lenet --contract
+        --jaxpr round --jaxpr round-bf16 --jaxpr serve --model lenet \
+        --contract
 fi
 if [ "${SPARKNET_LINT_GATE_NO_PROC:-0}" != "1" ]; then
     timeout -k 10 420 env JAX_PLATFORMS=cpu \

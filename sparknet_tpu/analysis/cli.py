@@ -47,9 +47,10 @@ def cmd_lint(args) -> int:
     jaxpr_reports = []
     jaxpr_violations = []
     for leg in (args.jaxpr or []):
-        if leg == "round":
+        if leg in ("round", "round-bf16"):
             report = jaxpr_audit.audit_training_round(
-                n_workers=args.workers, tau=args.tau)
+                n_workers=args.workers, tau=args.tau,
+                precision="bfloat16" if leg == "round-bf16" else None)
         else:  # serve
             report = jaxpr_audit.audit_serving_forward(
                 args.model, quant=args.quant or None)
@@ -121,7 +122,8 @@ def register(sub) -> None:
     p.add_argument("--repo-root",
                    help="overrides the tests/README anchor directory "
                         "(default: parent of each linted path)")
-    p.add_argument("--jaxpr", action="append", choices=["round", "serve"],
+    p.add_argument("--jaxpr", action="append",
+                   choices=["round", "round-bf16", "serve"],
                    help="also trace + audit a hot program (repeatable)")
     p.add_argument("--workers", type=int, default=8,
                    help="worker count for --jaxpr round (needs that many "

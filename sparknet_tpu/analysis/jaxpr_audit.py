@@ -172,7 +172,8 @@ def audit_fn(fn, *args, **kwargs) -> Dict[str, Any]:
 
 # ------------------------------------------------------- repo hot programs
 
-def _toy_round_solver(n_workers: int, tau: int):
+def _toy_round_solver(n_workers: int, tau: int,
+                      precision: Optional[str] = None):
     """A small DistributedSolver whose fused round has the production
     structure (shard_map + lax.scan τ-steps + pmean averaging) at toy
     sizes — the same shape tests/test_obs.py's telemetry tests trace."""
@@ -195,7 +196,7 @@ def _toy_round_solver(n_workers: int, tau: int):
     sp = caffe_pb.SolverParameter(parse(
         "base_lr: 0.05 lr_policy: 'fixed' momentum: 0.9 random_seed: 7"))
     solver = DistributedSolver(sp, net_param=net, n_workers=n_workers,
-                               tau=tau)
+                               tau=tau, precision=precision)
 
     def stream(seed):
         rng = np.random.RandomState(seed)
@@ -212,10 +213,14 @@ def _toy_round_solver(n_workers: int, tau: int):
 
 
 def audit_training_round(n_workers: int = 8, tau: int = 2,
+                         precision: Optional[str] = None,
                          ) -> Dict[str, Any]:
     """Trace and audit the fused training round at `n_workers` workers
     (requires that many local devices — the CPU mesh provides 8 via
-    XLA_FLAGS=--xla_force_host_platform_device_count=8)."""
+    XLA_FLAGS=--xla_force_host_platform_device_count=8).  `precision`
+    feeds DistributedSolver's mixed-precision knob (None -> fp32);
+    the bf16 round's contract pins that collectives stay fp32-psum and
+    enumerates the intended master-weight convert edges."""
     import jax
     import jax.numpy as jnp
 
@@ -225,7 +230,7 @@ def audit_training_round(n_workers: int = 8, tau: int = 2,
             f"{len(jax.devices())} (run on the CPU mesh: JAX_PLATFORMS="
             f"cpu XLA_FLAGS=--xla_force_host_platform_device_count="
             f"{n_workers})")
-    solver = _toy_round_solver(n_workers, tau)
+    solver = _toy_round_solver(n_workers, tau, precision)
     batches, rngs = solver._stage_round(0)
     closed = jax.make_jaxpr(solver._round_fn(True))(
         solver.params_w, solver.state_w, jnp.int32(0), batches, rngs)
@@ -233,6 +238,7 @@ def audit_training_round(n_workers: int = 8, tau: int = 2,
     report["program"] = "training_round"
     report["workers"] = n_workers
     report["tau"] = tau
+    report["precision"] = solver.precision
     return report
 
 
@@ -294,8 +300,16 @@ def contract_key(report: Dict[str, Any]) -> str:
     """Stable identity of one audited program configuration."""
     prog = report.get("program", "program")
     if prog == "training_round":
+        # fp32 rounds keep the historical key (no precision suffix) so
+        # the committed contract survives; non-fp32 rounds append a
+        # short form (bfloat16 -> bf16).
+        precision = report.get("precision") or "float32"
+        suffix = ""
+        if precision != "float32":
+            short = {"bfloat16": "bf16"}.get(precision, precision)
+            suffix = f",precision={short}"
         return (f"training_round[workers={report['workers']},"
-                f"tau={report['tau']}]")
+                f"tau={report['tau']}{suffix}]")
     if prog == "serving_forward":
         quant = report.get("quant") or "none"
         return (f"serving_forward[model={report['model']},"

@@ -18,7 +18,7 @@ from typing import Dict
 KNOBS: Dict[str, str] = {
     # -- kernels / op dispatch
     "SPARKNET_FUSED_BLOCKS": "fuse conv->[relu]->LRN->pool towers "
-                             "(off|xla|pallas)",
+                             "(off|xla|pallas|pallas-tail)",
     "SPARKNET_LRN_IMPL": "ACROSS_CHANNELS LRN formulation "
                          "(xla|pallas|matmul)",
     "SPARKNET_MAXPOOL_BWD": "max-pool backward formulation "

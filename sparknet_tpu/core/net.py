@@ -222,14 +222,17 @@ class Net:
                 tainted.update(bl.tops)
 
     def _fuse_tower_blocks(self) -> None:
-        """SPARKNET_FUSED_BLOCKS=xla|pallas: rewrite each matched
-        Convolution→[ReLU]→LRN→Pooling(MAX) run (core/fuse.py
+        """SPARKNET_FUSED_BLOCKS=xla|pallas|pallas-tail: rewrite each
+        matched Convolution→[ReLU]→LRN→Pooling(MAX) run (core/fuse.py
         match_conv_lrn_pool) into ONE fused layer over
         ops.fused_conv_lrn_pool.  The fused layer keeps the conv's name
         and param_keys, so get_weights/set_weights interchange and
         trained checkpoints are untouched; `xla` composes the stock ops
-        (bitwise-identical graph), `pallas` runs the fused tail kernel
-        on TPU with a graceful XLA fallback elsewhere."""
+        (bitwise-identical graph), `pallas` prefers the full-block
+        implicit-GEMM kernel (ops/pallas_conv.py) where its geometry
+        gate passes and the tail kernel elsewhere, `pallas-tail` forces
+        the tail-only kernel (A/B control) — all kernel modes run on
+        TPU with a graceful XLA fallback elsewhere."""
         from ..ops import fused_block as _fb
 
         mode = _fb.fused_blocks_mode()

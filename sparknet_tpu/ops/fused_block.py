@@ -31,12 +31,16 @@ pooling_layer.cpp:155-169 max routing):
     y_i     = xr_i * scale_i^{-beta}
     out     = maxpool(y)                   [ceil mode, -inf padding]
 
-Dispatch: SPARKNET_FUSED_BLOCKS=off|xla|pallas (mirrors SPARKNET_LRN_IMPL
-in ops/lrn.py; consumed by core/net.py's fusion pass).  `xla` composes
-the exact stock unfused ops inside one layer fn (bitwise-identical
-graph, lets XLA see the whole chain); `pallas` uses the fused kernel on
-TPU and falls back to the XLA composition gracefully elsewhere — tests
-exercise the kernel on CPU via interpret=True.
+Dispatch: SPARKNET_FUSED_BLOCKS=off|xla|pallas|pallas-tail (mirrors
+SPARKNET_LRN_IMPL in ops/lrn.py; consumed by core/net.py's fusion
+pass).  `xla` composes the exact stock unfused ops inside one layer fn
+(bitwise-identical graph, lets XLA see the whole chain); `pallas`
+prefers the full-block implicit-GEMM kernel (ops/pallas_conv.py — conv
+on the MXU plus this tail in ONE VMEM residency) where its geometry
+gate passes and otherwise uses the tail kernel here; `pallas-tail`
+forces the tail-only kernel (the full-block A/B control).  All kernel
+modes fall back to the XLA composition gracefully off-TPU — tests
+exercise the kernels on CPU via interpret=True.
 """
 
 from __future__ import annotations
@@ -55,13 +59,33 @@ from .pooling import _window_geometry, max_pool, pool_out_dim
 
 
 def fused_blocks_mode() -> str:
-    """SPARKNET_FUSED_BLOCKS=off|xla|pallas (default off; empty/0 = off)."""
+    """SPARKNET_FUSED_BLOCKS=off|xla|pallas|pallas-tail (default off;
+    empty/0 = off).  `pallas` prefers the full-block implicit-GEMM
+    kernel (ops/pallas_conv.py) where the geometry gate passes and falls
+    back to the tail-only kernel; `pallas-tail` forces the tail-only
+    kernel everywhere (the A/B control scripts/fullblock_probe.py
+    drives)."""
     mode = os.environ.get("SPARKNET_FUSED_BLOCKS")
     if mode in (None, "", "0", "off"):
         return "off"
-    if mode not in ("xla", "pallas"):
+    if mode not in ("xla", "pallas", "pallas-tail"):
         raise ValueError(
-            f"SPARKNET_FUSED_BLOCKS={mode!r}; expected off, xla, or pallas")
+            f"SPARKNET_FUSED_BLOCKS={mode!r}; expected off, xla, pallas, "
+            f"or pallas-tail")
+    return mode
+
+
+def effective_fused_blocks_mode() -> str:
+    """The mode that will actually execute on this process's backend:
+    both pallas modes degrade to the XLA composition off-TPU (the
+    graceful-fallback contract), so records stamped with this value are
+    attributable — a CPU-mesh A/B run labeled `pallas` would claim a
+    kernel that never ran."""
+    import jax
+
+    mode = fused_blocks_mode()
+    if mode in ("pallas", "pallas-tail") and jax.default_backend() != "tpu":
+        return "xla"
     return mode
 
 
@@ -321,23 +345,44 @@ def fused_conv_lrn_pool(x: jax.Array, w: jax.Array,
                         interpret: Optional[bool] = None) -> jax.Array:
     """One fused tower block: MXU conv + fused relu/LRN/max-pool tail.
 
-    impl='xla' composes the stock ops; impl='pallas' runs the fused tail
-    kernel when the backend is TPU and the shape qualifies, else falls
-    back to the XLA composition (interpret=True forces the kernel in
-    interpret mode for CPU testing)."""
-    y = conv2d(x, w, b, stride=tuple(stride), pad=tuple(pad),
-               dilation=tuple(dilation), groups=groups)
-    if impl == "pallas":
+    impl='xla' composes the stock ops; impl='pallas' prefers the
+    full-block implicit-GEMM kernel (ops/pallas_conv.py: conv on the MXU
+    + the whole epilogue in one VMEM residency) where its geometry gate
+    passes, degrading to the tail-only kernel and then to the XLA
+    composition; impl='pallas-tail' forces the tail-only kernel (the
+    full-block A/B control).  Kernels run when the backend is TPU, else
+    everything falls back to the XLA composition (interpret=True forces
+    the kernels in interpret mode for CPU testing)."""
+    if impl in ("pallas", "pallas-tail"):
         run_kernel = (interpret if interpret is not None
                       else jax.default_backend() == "tpu")
+        interp = bool(interpret) if interpret is not None else False
+        if impl == "pallas" and run_kernel:
+            # deferred: pallas_conv imports back into this module
+            from . import pallas_conv as _pc
+
+            if _pc.fullblock_supported(x, w, stride=tuple(stride),
+                                       pad=tuple(pad),
+                                       dilation=tuple(dilation),
+                                       groups=groups):
+                return _pc.fused_conv_block_pallas(
+                    x, w, b, tuple(stride), tuple(pad), groups,
+                    relu_slope, local_size, alpha, beta, k,
+                    tuple(pool_kernel), tuple(pool_stride),
+                    tuple(pool_pad), interp)
+        y = conv2d(x, w, b, stride=tuple(stride), pad=tuple(pad),
+                   dilation=tuple(dilation), groups=groups)
         if run_kernel and fused_tail_supported(y):
             return fused_tail_pallas(
                 y, local_size, alpha, beta, k, relu_slope,
                 tuple(pool_kernel), tuple(pool_stride), tuple(pool_pad),
-                bool(interpret) if interpret is not None else False)
+                interp)
     elif impl != "xla":
         raise ValueError(f"fused_conv_lrn_pool impl={impl!r}; "
-                         f"expected xla or pallas")
+                         f"expected xla, pallas, or pallas-tail")
+    else:
+        y = conv2d(x, w, b, stride=tuple(stride), pad=tuple(pad),
+                   dilation=tuple(dilation), groups=groups)
     return _tail_xla(y, local_size, alpha, beta, k, relu_slope,
                      pool_kernel, pool_stride, pool_pad)
 

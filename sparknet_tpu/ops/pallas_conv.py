@@ -1,0 +1,299 @@
+"""Full-block Pallas tower kernel: implicit-GEMM conv + fused epilogue.
+
+ops/fused_block.py fuses only the memory-bound TAIL of the AlexNet
+norm1/norm2 tower stages (relu → LRN → pool) and leaves the conv — the
+dominant FLOP sink — to stock XLA, so the conv output still makes one
+full HBM round-trip before the fused tail reads it back.  The PHAST
+Caffe-port lesson (PAPERS.md, arXiv:2005.13076) is that kernel-by-kernel
+translation leaves exactly this win on the table; Caffe itself
+(arXiv:1408.5093) collapsed the tower into one tight kernel.  This
+module closes the gap: ONE Pallas kernel per batch element computes the
+convolution as an implicit GEMM on the MXU and runs the whole
+bias → [ReLU] → LRN(ACROSS_CHANNELS) → ceil-mode MAX-pool epilogue in
+the same VMEM residency, writing only the pooled output to HBM.
+
+The conv keeps the MXU (the fused_block.py docstring's own warning: a
+hand-written VPU conv forfeits the systolic array):
+
+  * the (C, H, W) plane is zero-padded and stride-reshaped once, and the
+    kh·kw window offsets become UNIT-stride slices of the reshaped map —
+    the same Mosaic-safe reshape trick fused_block.py uses for the pool
+    (offset i ↦ r[:, di:di+oh, ri, ...] with (di, ri) = divmod(i, sh));
+  * stacking those slices yields the im2col matrix (C·kh·kw, oh·ow)
+    WITHOUT an HBM materialization — it exists only in VMEM;
+  * each filter group is one `jnp.dot` on the MXU with
+    preferred_element_type=float32, so bf16 inputs accumulate in fp32
+    (the mixed-precision contract: bf16 multiplicands, fp32 partials).
+
+The col-matrix row order is c·(kh·kw) + i·kw + j — the OIHW weight
+blob's own minor order — so `w.reshape(O, -1)` lines up with no
+in-kernel weight shuffle.
+
+Epilogue math is IDENTICAL to fused_block's tail kernel (the helpers are
+imported, not re-derived), so full-block and tail-only forwards agree
+bit-for-bit and the backward can reuse the tail kernel: the custom VJP
+recomputes the conv output (one XLA conv — cheaper than writing the
+pre-pool activation through HBM, the pallas_lrn measured lesson), routes
+dy through fused_tail_pallas's fused backward kernel, and closes with
+XLA's conv transpose for dx/dw/db.
+
+Dispatch (ops/fused_block.fused_conv_lrn_pool): SPARKNET_FUSED_BLOCKS=
+pallas prefers this kernel where `fullblock_supported` passes (AlexNet
+norm1/norm2; GoogLeNet's conv2 stage at bf16) and falls back to the
+tail-only kernel, then to the XLA composition; `pallas-tail` forces the
+tail-only kernel (the A/B control scripts/fullblock_probe.py drives).
+jax.experimental.pallas is imported only inside the grid call, keeping
+the portable path pallas-free (the ops.lrn deferred-import contract).
+
+Reference semantics: caffe conv_layer.cpp output dims (floor mode),
+lrn_layer.cpp:88-119 forward, pooling_layer.cpp:155-169 max routing.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from .conv import conv2d, conv_out_dim
+from .fused_block import (_apply_relu, _pool_geometry, _pool_patches,
+                          _PoolGeom, _winsum_c, fused_tail_pallas)
+from .lrn import _powm
+
+# VMEM footprint ceiling for the gate: the in-VMEM col matrix is the
+# big term (C·kh·kw·oh·ow), and 12 MB leaves headroom under the ~16 MB
+# core budget for Mosaic's own double-buffering.  AlexNet conv1/conv2
+# fit at fp32; GoogLeNet's conv2 stage (64ch 56² k3 → 192) fits at bf16
+# only — exactly the precision bench.py trains at.
+_VMEM_BUDGET = 12 * 2 ** 20
+
+
+def _conv_geometry(h: int, w: int, kernel: Tuple[int, int],
+                   stride: Tuple[int, int],
+                   pad: Tuple[int, int]) -> _PoolGeom:
+    """Reshape-trick geometry for the conv's window slices — the
+    fused_block._pool_geometry construction with FLOOR-mode output dims
+    (conv_layer.cpp) instead of ceil-mode pooling ones."""
+    kh, kw = kernel
+    sh, sw = stride
+    ph, pw = pad
+    oh = conv_out_dim(h, kh, ph, sh)
+    ow = conv_out_dim(w, kw, pw, sw)
+    need_h = max((oh - 1) * sh + kh, h + ph)
+    need_w = max((ow - 1) * sw + kw, w + pw)
+    hp = -(-need_h // sh) * sh
+    wp = -(-need_w // sw) * sw
+    return _PoolGeom(h, w, kh, kw, sh, sw, oh, ow, ph, pw, hp, wp,
+                     hp // sh, wp // sw)
+
+
+def _im2col_vmem(x: jax.Array, cg: _PoolGeom) -> jax.Array:
+    """(C, H, W) → the (C·kh·kw, oh·ow) col matrix, all unit-stride
+    slices (zero padding: conv semantics, not the pool's -inf)."""
+    c = x.shape[0]
+    xp = jnp.pad(x, ((0, 0),
+                     (cg.pad_h_lo, cg.hp - cg.h - cg.pad_h_lo),
+                     (cg.pad_w_lo, cg.wp - cg.w - cg.pad_w_lo)))
+    r = xp.reshape(c, cg.lh, cg.sh, cg.lw, cg.sw)
+    patches = []
+    for i in range(cg.kh):
+        di, ri = divmod(i, cg.sh)
+        for j in range(cg.kw):
+            dj, rj = divmod(j, cg.sw)
+            patches.append(r[:, di:di + cg.oh, ri, dj:dj + cg.ow, rj])
+    # stack on axis 1: row index c·(kh·kw) + i·kw + j, the OIHW minor
+    # order, so w.reshape(O, -1) needs no in-kernel shuffle
+    return jnp.stack(patches, axis=1).reshape(
+        c * cg.kh * cg.kw, cg.oh * cg.ow)
+
+
+def _fullblock_kernel(*refs, cg, pg, groups, relu_slope, pad_lo, pad_hi,
+                      alpha, beta, k, n):
+    if len(refs) == 4:
+        x_ref, w_ref, b_ref, y_ref = refs
+    else:
+        (x_ref, w_ref, y_ref), b_ref = refs, None
+    x = x_ref[0]
+    w = w_ref[...]
+    o = w.shape[0]
+    cols = _im2col_vmem(x, cg)
+    og = o // groups
+    rows = cols.shape[0] // groups
+    outs = []
+    for g in range(groups):
+        wg = w[g * og:(g + 1) * og].reshape(og, rows)
+        outs.append(jnp.dot(wg, cols[g * rows:(g + 1) * rows],
+                            preferred_element_type=jnp.float32))
+    y = (outs[0] if groups == 1
+         else jnp.concatenate(outs, axis=0)).reshape(o, cg.oh, cg.ow)
+    if b_ref is not None:
+        y = y + b_ref[...].astype(jnp.float32).reshape(o, 1, 1)
+    # epilogue: the EXACT fused_block tail formulation (same helpers),
+    # so full-block and tail-only forwards agree bit-for-bit
+    xr = _apply_relu(y, relu_slope)
+    scale = k + (alpha / n) * _winsum_c(xr * xr, pad_lo, pad_hi)
+    z = xr * _powm(scale, -beta)
+    pooled = _pool_patches(z, pg)
+    acc = pooled[0]
+    for p in pooled[1:]:
+        acc = jnp.maximum(acc, p)
+    y_ref[0] = acc.astype(y_ref.dtype)
+
+
+def _fullblock_grid_call(kernel, x, w, b, out_shape, interpret: bool):
+    # deferred: keeps jax.experimental.pallas off the module-import path
+    # (the ops.lrn dispatch contract, pinned by test_pallas_conv.py)
+    from jax.experimental import pallas as pl
+
+    bsz = x.shape[0]
+    in_specs = [pl.BlockSpec((1,) + tuple(x.shape[1:]),
+                             lambda i: (i, 0, 0, 0)),
+                pl.BlockSpec(tuple(w.shape), lambda i: (0, 0, 0, 0))]
+    inputs = [x, w]
+    if b is not None:
+        in_specs.append(pl.BlockSpec((int(b.shape[0]), 1),
+                                     lambda i: (0, 0)))
+        inputs.append(b.reshape(-1, 1))
+    out_spec = pl.BlockSpec((1,) + tuple(out_shape.shape[1:]),
+                            lambda i: (i, 0, 0, 0))
+    return pl.pallas_call(
+        kernel,
+        grid=(bsz,),
+        in_specs=in_specs,
+        out_specs=out_spec,
+        out_shape=out_shape,
+        interpret=interpret,
+    )(*inputs)
+
+
+# nondiff: (stride, pad, groups, relu_slope, local_size, alpha, beta, k,
+#           pool_kernel, pool_stride, pool_pad, interpret)
+@functools.partial(jax.custom_vjp, nondiff_argnums=tuple(range(3, 15)))
+def fused_conv_block_pallas(x: jax.Array, w: jax.Array,
+                            b: Optional[jax.Array],
+                            stride: Tuple[int, int],
+                            pad: Tuple[int, int], groups: int,
+                            relu_slope: Optional[float], local_size: int,
+                            alpha: float, beta: float, k: float,
+                            pool_kernel: Tuple[int, int],
+                            pool_stride: Tuple[int, int],
+                            pool_pad: Tuple[int, int],
+                            interpret: bool = False) -> jax.Array:
+    """The whole tower block — conv (implicit GEMM, MXU, fp32 accum) +
+    bias + [relu] + LRN(ACROSS) + ceil-mode MAX-pool — as ONE kernel.
+
+    x is (N, C, H, W), w is OIHW, b is (O,) or None; relu_slope=None
+    skips the relu stage.  Returns (N, O, pool_oh, pool_ow) in x.dtype."""
+    y, _ = _fullblock_fwd(x, w, b, stride, pad, groups, relu_slope,
+                          local_size, alpha, beta, k, pool_kernel,
+                          pool_stride, pool_pad, interpret)
+    return y
+
+
+def _fullblock_fwd(x, w, b, stride, pad, groups, relu_slope, local_size,
+                   alpha, beta, k, pool_kernel, pool_stride, pool_pad,
+                   interpret):
+    bsz, _, h, wd = x.shape
+    o, _, kh, kw = w.shape
+    cg = _conv_geometry(h, wd, (kh, kw), tuple(stride), tuple(pad))
+    pg = _pool_geometry(cg.oh, cg.ow, tuple(pool_kernel),
+                        tuple(pool_stride), tuple(pool_pad))
+    pad_lo = (local_size - 1) // 2
+    pad_hi = local_size - 1 - pad_lo
+    kern = functools.partial(
+        _fullblock_kernel, cg=cg, pg=pg, groups=groups,
+        relu_slope=relu_slope, pad_lo=pad_lo, pad_hi=pad_hi, alpha=alpha,
+        beta=beta, k=k, n=local_size)
+    out = jax.ShapeDtypeStruct((bsz, o, pg.oh, pg.ow), x.dtype)
+    y = _fullblock_grid_call(kern, x, w, b, out, interpret)
+    return y, (x, w, b)
+
+
+def _fullblock_bwd(stride, pad, groups, relu_slope, local_size, alpha,
+                   beta, k, pool_kernel, pool_stride, pool_pad, interpret,
+                   res, dy):
+    # recompute the conv output rather than saving it: one XLA conv beats
+    # writing the full pre-pool activation through HBM (the pallas_lrn
+    # measured lesson); the tail gradient then reuses fused_block's fused
+    # backward kernel, and XLA's conv transpose closes dx/dw/db
+    x, w, b = res
+
+    def conv(x_, w_, b_):
+        return conv2d(x_, w_, b_, stride=tuple(stride), pad=tuple(pad),
+                      groups=groups)
+
+    y_conv, conv_vjp = jax.vjp(conv, x, w, b)
+    _, tail_vjp = jax.vjp(
+        lambda y_: fused_tail_pallas(y_, local_size, alpha, beta, k,
+                                     relu_slope, tuple(pool_kernel),
+                                     tuple(pool_stride), tuple(pool_pad),
+                                     interpret), y_conv)
+    (dconv,) = tail_vjp(dy)
+    return conv_vjp(dconv)
+
+
+fused_conv_block_pallas.defvjp(
+    lambda x, w, b, stride, pad, groups, relu_slope, local_size, alpha,
+    beta, k, pool_kernel, pool_stride, pool_pad, interpret:
+        _fullblock_fwd(x, w, b, stride, pad, groups, relu_slope,
+                       local_size, alpha, beta, k, pool_kernel,
+                       pool_stride, pool_pad, interpret),
+    _fullblock_bwd)
+
+
+def _vmem_estimate(in_shape, w_shape, cg: _PoolGeom, dtype) -> int:
+    """Rough per-grid-cell VMEM bytes: padded input plane + in-VMEM col
+    matrix + weights (input dtype) + two fp32 activation-sized buffers
+    for the epilogue chain (conservative: Mosaic fuses most of it)."""
+    _, c, _, _ = in_shape
+    o = w_shape[0]
+    itm = 2 if dtype == jnp.bfloat16 else 4
+    return (c * cg.hp * cg.wp * itm
+            + c * cg.kh * cg.kw * cg.oh * cg.ow * itm
+            + o * w_shape[1] * cg.kh * cg.kw * itm
+            + 2 * o * cg.oh * cg.ow * 4)
+
+
+def fullblock_geometry_supported(in_shape: Tuple[int, ...],
+                                 w_shape: Tuple[int, ...], *,
+                                 stride: Tuple[int, int],
+                                 pad: Tuple[int, int],
+                                 dilation: Tuple[int, int] = (1, 1),
+                                 groups: int = 1,
+                                 dtype=jnp.float32) -> bool:
+    """Static gate for the full-block kernel: NCHW f32/bf16 input, unit
+    dilation (the reshape trick has no dilated form), output channels on
+    a whole sublane tile (the epilogue/backward ride the tail kernel's
+    layout, fused_tail_supported's condition), and the per-cell VMEM
+    estimate under _VMEM_BUDGET."""
+    if len(in_shape) != 4 or len(w_shape) != 4:
+        return False
+    if tuple(dilation) != (1, 1):
+        return False
+    dtype = jnp.dtype(dtype)
+    if dtype not in (jnp.dtype(jnp.float32), jnp.dtype(jnp.bfloat16)):
+        return False
+    o = w_shape[0]
+    sub = 16 if dtype == jnp.bfloat16 else 8
+    if o % sub != 0 or o % groups != 0 or in_shape[1] % groups != 0:
+        return False
+    _, _, h, wd = in_shape
+    kh, kw = int(w_shape[2]), int(w_shape[3])
+    if h + 2 * pad[0] < kh or wd + 2 * pad[1] < kw:
+        return False
+    cg = _conv_geometry(h, wd, (kh, kw), tuple(stride), tuple(pad))
+    return _vmem_estimate(in_shape, w_shape, cg, dtype) <= _VMEM_BUDGET
+
+
+def fullblock_supported(x: jax.Array, w: jax.Array, *,
+                        stride: Tuple[int, int], pad: Tuple[int, int],
+                        dilation: Tuple[int, int] = (1, 1),
+                        groups: int = 1) -> bool:
+    """Runtime gate: geometry + matching input/weight dtype."""
+    return (x.dtype == w.dtype
+            and fullblock_geometry_supported(
+                tuple(x.shape), tuple(w.shape), stride=tuple(stride),
+                pad=tuple(pad), dilation=tuple(dilation), groups=groups,
+                dtype=x.dtype))

@@ -187,6 +187,39 @@ def test_bench_imagenet_native_cpu():
             pytest.skip("libjpeg toolchain unavailable on this box")
         raise
     assert r["imagenet_native_fed_imgs_per_sec"] > 0
+    # schema-v7 attribution stamps: precision + the EFFECTIVE fused-blocks
+    # mode (off here — no env knob set, and pallas would degrade to xla
+    # off-TPU anyway), so A/B records name what actually ran
+    assert r["imagenet_native_precision"] in ("float32", "bfloat16")
+    assert r["imagenet_native_fused_blocks"] in ("off", "xla")
+    assert set(r) <= bench._KNOWN_FIELDS
+    assert "imagenet_native" in bench._KNOWN_LEGS
+
+
+def test_bench_cifar_e2e_stamps_cpu(monkeypatch):
+    """The cifar_e2e record carries the schema-v7 precision +
+    effective-fused-blocks stamps, and the fused-blocks stamp is the
+    EFFECTIVE mode: with SPARKNET_FUSED_BLOCKS=pallas on a CPU backend
+    the kernel never runs, so the record must say `xla`, not `pallas`
+    (an unattributable A/B run is worse than none)."""
+    import pytest
+
+    import bench
+
+    monkeypatch.setenv("SPARKNET_FUSED_BLOCKS", "pallas")
+    try:
+        r = bench.bench_cifar_e2e(rounds=1, tau=2)
+    except FileNotFoundError:
+        pytest.skip("reference prototxt tree unavailable on this box")
+    assert r["imgs_per_sec"] > 0
+    assert r["precision"] == "float32"  # cifar quick recipe default
+    assert r["fused_blocks"] == "xla"  # pallas degraded off-TPU
+    landed = {"cifar_e2e_imgs_per_sec": round(r["imgs_per_sec"], 1),
+              "cifar_e2e_precision": r["precision"],
+              "cifar_e2e_fused_blocks": r["fused_blocks"],
+              "cifar_e2e_ingest": r["ingest"],
+              "cifar_e2e_round_telemetry": r["round_telemetry"]}
+    assert set(landed) <= bench._KNOWN_FIELDS
 
 
 def test_bench_longctx_lm_cpu():
@@ -377,7 +410,7 @@ def test_bench_trainserve_leg_contract(monkeypatch):
 
     import bench
 
-    assert bench.BENCH_SCHEMA_VERSION == 6
+    assert bench.BENCH_SCHEMA_VERSION == 7
     canned = {"ok": True, "model": "lenet", "promotions": 2,
               "rejections": 1, "staleness_mean": 0.6, "staleness_max": 1.0,
               "swap_p99_delta_ms": 3.25, "dropped": 0, "completed": 132,

@@ -855,6 +855,37 @@ def test_committed_contracts_match_training_round():
     assert entry["host_transfers"] == {}
 
 
+def test_committed_contracts_match_bf16_training_round():
+    """The bf16 round's precision story is a committed artifact: the
+    contract key carries precision=bf16, collectives are the SAME
+    fp32 psum schedule as the fp32 round (averaging stays fp32 —
+    parallel/dist.py), and the master-weight cast edges of
+    solver/solver.py:make_loss_fn are enumerated, not incidental."""
+    import jax
+
+    from sparknet_tpu.analysis import jaxpr_audit as ja
+
+    contracts = ja.load_contracts(os.path.join(REPO, "CONTRACTS.json"))
+    key = "training_round[workers=8,tau=2,precision=bf16]"
+    entry = contracts["programs"][key]
+    fp32 = contracts["programs"]["training_round[workers=8,tau=2]"]
+    # fp32-psum claim: byte-for-byte the fp32 round's schedule
+    assert entry["collectives"] == fp32["collectives"]
+    assert entry["host_transfers"] == {}
+    dirs = {e["direction"] for e in entry["convert_edges"]}
+    kinds = {(e["from"], e["to"]) for e in entry["convert_edges"]}
+    assert dirs == {"upcast", "downcast"}
+    assert kinds == {("bfloat16", "float32"), ("float32", "bfloat16")}
+
+    if len(jax.devices()) < 8:
+        pytest.skip("recompute needs 8 local devices (CPU mesh)")
+    rep = ja.audit_training_round(n_workers=8, tau=2,
+                                  precision="bfloat16")
+    assert ja.contract_key(rep) == key
+    violations = ja.check_contract(rep, contracts)
+    assert violations == [], "\n".join(violations)
+
+
 def test_contract_detects_injected_downcast(tmp_path):
     """Acceptance criterion: a deliberately perturbed program fails the
     contract with a diff naming the drifted field."""

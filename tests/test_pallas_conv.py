@@ -1,0 +1,348 @@
+"""Full-block implicit-GEMM conv kernel (ops/pallas_conv.py) + its
+dispatch through ops/fused_block.fused_conv_lrn_pool and core/net.py.
+
+The kernel runs in interpret mode on the CPU test platform.  Parity is
+pinned at two strengths, deliberately:
+
+- BITWISE against the conv2d→fused_tail_pallas composition on
+  integer-valued fp32 inputs (integer values make the conv reduction
+  exact in any association order, so bit equality is well-defined —
+  the test_lrn_dispatch idiom).  This is the kernel's own contract:
+  its epilogue calls the very same helpers as the tail kernel.
+- allclose against the fully stock XLA composition (different reduce
+  orders over floats; the PR 7 tail tests use the same standard).
+"""
+
+import subprocess
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from sparknet_tpu.ops import fused_block as fb
+from sparknet_tpu.ops import pallas_conv as pc
+from sparknet_tpu.ops.conv import conv2d
+
+
+def _int_arrays(rng, n, c, h, w, o, kh, kw, groups, dtype=np.float32):
+    """Integer-valued inputs: conv sums stay exactly representable, so
+    cross-implementation comparisons can be bitwise."""
+    x = jnp.asarray(rng.randint(-3, 4, size=(n, c, h, w)).astype(dtype))
+    wt = jnp.asarray(rng.randint(-2, 3, size=(o, c // groups, kh, kw))
+                     .astype(dtype))
+    b = jnp.asarray(rng.randint(-2, 3, size=(o,)).astype(dtype))
+    return x, wt, b
+
+
+# AlexNet/GoogLeNet-style geometry sweep at test sizes: stride-4 k11
+# (alex conv1), grouped k5 pad2 (alex conv2), k3 pad1 (goog conv2),
+# 1x1, even kernel + padded pool + leaky relu, and a no-relu block.
+_GEOMS = [
+    dict(name="alex1", n=1, c=3, h=27, w=27, o=16, kh=11, kw=11,
+         stride=(4, 4), pad=(0, 0), groups=1, relu_slope=0.0,
+         pool_kernel=(3, 3), pool_stride=(2, 2), pool_pad=(0, 0)),
+    dict(name="alex2", n=2, c=8, h=15, w=15, o=16, kh=5, kw=5,
+         stride=(1, 1), pad=(2, 2), groups=2, relu_slope=0.0,
+         pool_kernel=(3, 3), pool_stride=(2, 2), pool_pad=(0, 0)),
+    dict(name="goog2", n=1, c=8, h=14, w=14, o=24, kh=3, kw=3,
+         stride=(1, 1), pad=(1, 1), groups=1, relu_slope=0.0,
+         pool_kernel=(3, 3), pool_stride=(2, 2), pool_pad=(0, 0)),
+    dict(name="1x1", n=2, c=8, h=9, w=9, o=8, kh=1, kw=1,
+         stride=(1, 1), pad=(0, 0), groups=1, relu_slope=None,
+         pool_kernel=(2, 2), pool_stride=(2, 2), pool_pad=(0, 0)),
+    dict(name="even_k", n=1, c=4, h=10, w=12, o=8, kh=2, kw=2,
+         stride=(2, 2), pad=(1, 1), groups=1, relu_slope=0.1,
+         pool_kernel=(3, 3), pool_stride=(2, 2), pool_pad=(1, 1)),
+]
+
+_LRN = dict(local_size=5, alpha=1e-4, beta=0.75, k=1.0)
+
+
+def _full(x, w, b, g, interpret=True):
+    return pc.fused_conv_block_pallas(
+        x, w, b, g["stride"], g["pad"], g["groups"], g["relu_slope"],
+        _LRN["local_size"], _LRN["alpha"], _LRN["beta"], _LRN["k"],
+        g["pool_kernel"], g["pool_stride"], g["pool_pad"], interpret)
+
+
+def _tail_composed(x, w, b, g, interpret=True):
+    y = conv2d(x, w, b, stride=g["stride"], pad=g["pad"],
+               groups=g["groups"])
+    return fb.fused_tail_pallas(y, _LRN["local_size"], _LRN["alpha"],
+                                _LRN["beta"], _LRN["k"], g["relu_slope"],
+                                g["pool_kernel"], g["pool_stride"],
+                                g["pool_pad"], interpret)
+
+
+def _xla_composed(x, w, b, g):
+    return fb.fused_conv_lrn_pool(
+        x, w, b, stride=g["stride"], pad=g["pad"], groups=g["groups"],
+        relu_slope=g["relu_slope"], pool_kernel=g["pool_kernel"],
+        pool_stride=g["pool_stride"], pool_pad=g["pool_pad"],
+        impl="xla", **_LRN)
+
+
+@pytest.mark.parametrize("g", _GEOMS, ids=[g["name"] for g in _GEOMS])
+def test_fullblock_bitwise_vs_tail_and_allclose_vs_xla(rng, g):
+    x, w, b = _int_arrays(rng, g["n"], g["c"], g["h"], g["w"], g["o"],
+                          g["kh"], g["kw"], g["groups"])
+    assert pc.fullblock_supported(x, w, stride=g["stride"], pad=g["pad"],
+                                  dilation=(1, 1), groups=g["groups"])
+    got = _full(x, w, b, g)
+    want_tail = _tail_composed(x, w, b, g)
+    want_xla = _xla_composed(x, w, b, g)
+    assert got.shape == want_xla.shape
+    assert np.array_equal(np.asarray(got), np.asarray(want_tail))
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want_xla),
+                               rtol=1e-5, atol=1e-5)
+
+
+@pytest.mark.parametrize("g", _GEOMS[:3],
+                         ids=[g["name"] for g in _GEOMS[:3]])
+def test_fullblock_backward_matches_composed(rng, g):
+    x, w, b = _int_arrays(rng, g["n"], g["c"], g["h"], g["w"], g["o"],
+                          g["kh"], g["kw"], g["groups"])
+
+    def via_full(x, w, b):
+        return jnp.sum(jnp.square(_full(x, w, b, g)))
+
+    def via_xla(x, w, b):
+        return jnp.sum(jnp.square(_xla_composed(x, w, b, g)))
+
+    gf = jax.grad(via_full, argnums=(0, 1, 2))(x, w, b)
+    gx = jax.grad(via_xla, argnums=(0, 1, 2))(x, w, b)
+    for a, e in zip(gf, gx):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(e),
+                                   rtol=5e-4, atol=5e-4)
+
+
+def test_fullblock_no_bias_backward(rng):
+    g = _GEOMS[2]
+    x, w, _ = _int_arrays(rng, g["n"], g["c"], g["h"], g["w"], g["o"],
+                          g["kh"], g["kw"], g["groups"])
+    got = _full(x, w, None, g)
+    want = _tail_composed(x, w, None, g)
+    assert np.array_equal(np.asarray(got), np.asarray(want))
+    gf = jax.grad(lambda a: jnp.sum(jnp.square(_full(a, w, None, g))))(x)
+    gx = jax.grad(
+        lambda a: jnp.sum(jnp.square(_xla_composed(a, w, None, g))))(x)
+    np.testing.assert_allclose(np.asarray(gf), np.asarray(gx),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_fused_conv_block_pallas_check_grads(rng):
+    """Numerical check of the custom VJP (lint R003's contract for every
+    custom_vjp op).  Inputs are well-separated positives so the
+    finite-difference probe cannot cross a max-pool tie or relu kink."""
+    from jax.test_util import check_grads
+
+    base = rng.permutation(np.arange(8 * 7 * 7)).astype(np.float32)
+    x = jnp.asarray(0.2 + 0.01 * base.reshape(1, 8, 7, 7))
+    wbase = rng.permutation(np.arange(8 * 8 * 3 * 3)).astype(np.float32)
+    w = jnp.asarray(0.01 + 0.001 * wbase.reshape(8, 8, 3, 3))
+    b = jnp.asarray(0.05 * np.arange(8, dtype=np.float32))
+
+    def f(x, w, b):
+        return pc.fused_conv_block_pallas(
+            x, w, b, (1, 1), (1, 1), 1, 0.0, 5, 1e-2, 0.75, 1.0,
+            (3, 3), (2, 2), (0, 0), True)
+
+    check_grads(f, (x, w, b), order=1, modes=["rev"], atol=5e-2,
+                rtol=5e-2, eps=1e-3)
+
+
+def test_fullblock_bf16(rng):
+    """bf16 in → bf16 out, fp32 accumulation inside: allclose to the
+    fp32 stock composition at bf16 tolerance (bitwise does NOT hold
+    across conv algorithms in bf16 — inputs are already rounded)."""
+    g = dict(name="bf16", n=1, c=8, h=10, w=10, o=16, kh=3, kw=3,
+             stride=(1, 1), pad=(1, 1), groups=1, relu_slope=0.0,
+             pool_kernel=(3, 3), pool_stride=(2, 2), pool_pad=(0, 0))
+    x, w, b = _int_arrays(rng, g["n"], g["c"], g["h"], g["w"], g["o"],
+                          g["kh"], g["kw"], g["groups"])
+    xb, wb, bb = (a.astype(jnp.bfloat16) for a in (x, w, b))
+    assert pc.fullblock_supported(xb, wb, stride=g["stride"],
+                                  pad=g["pad"], dilation=(1, 1),
+                                  groups=g["groups"])
+    got = _full(xb, wb, bb, g)
+    assert got.dtype == jnp.bfloat16
+    want = _xla_composed(x, w, b, g)
+    np.testing.assert_allclose(np.asarray(got, np.float32),
+                               np.asarray(want), rtol=2e-2, atol=2e-2)
+
+
+def test_fullblock_under_jit(rng):
+    g = _GEOMS[1]
+    x, w, b = _int_arrays(rng, g["n"], g["c"], g["h"], g["w"], g["o"],
+                          g["kh"], g["kw"], g["groups"])
+    got = jax.jit(lambda a: _full(a, w, b, g))(x)
+    assert np.array_equal(np.asarray(got),
+                          np.asarray(_tail_composed(x, w, b, g)))
+
+
+# --------------------------------------------------------- geometry gate
+
+def test_fullblock_geometry_gate():
+    ok = dict(stride=(1, 1), pad=(1, 1), dilation=(1, 1), groups=1)
+    assert pc.fullblock_geometry_supported((1, 8, 10, 10), (16, 8, 3, 3),
+                                           **ok)
+    # non-unit dilation: the stride-reshape im2col has no dilated form
+    assert not pc.fullblock_geometry_supported(
+        (1, 8, 10, 10), (16, 8, 3, 3), stride=(1, 1), pad=(1, 1),
+        dilation=(2, 2), groups=1)
+    # O off the sublane tile (f32 needs O % 8 == 0)
+    assert not pc.fullblock_geometry_supported(
+        (1, 8, 10, 10), (12, 8, 3, 3), **ok)
+    # bf16 needs O % 16 == 0: 24 fails in bf16, passes in f32
+    assert not pc.fullblock_geometry_supported(
+        (1, 8, 10, 10), (24, 8, 3, 3), dtype=jnp.bfloat16, **ok)
+    assert pc.fullblock_geometry_supported(
+        (1, 8, 10, 10), (24, 8, 3, 3), dtype=jnp.float32, **ok)
+    # non-NCHW rank
+    assert not pc.fullblock_geometry_supported((8, 10, 10), (16, 8, 3, 3),
+                                               **ok)
+    # per-cell VMEM estimate over the 12 MiB budget (the im2col col
+    # matrix alone is ~150 MiB here)
+    assert not pc.fullblock_geometry_supported(
+        (1, 64, 256, 256), (64, 64, 3, 3), **ok)
+    # dtype mismatch fails the runtime gate even with clean geometry
+    assert not pc.fullblock_supported(
+        jnp.zeros((1, 8, 10, 10), jnp.bfloat16),
+        jnp.zeros((16, 8, 3, 3), jnp.float32),
+        stride=(1, 1), pad=(1, 1), dilation=(1, 1), groups=1)
+    # int dtype rejected
+    assert not pc.fullblock_geometry_supported(
+        (1, 8, 10, 10), (16, 8, 3, 3), dtype=jnp.int32, **ok)
+
+
+# ------------------------------------------------------------- dispatch
+
+def test_dispatch_prefers_fullblock_where_supported(rng, monkeypatch):
+    calls = {"full": 0}
+    orig = pc.fused_conv_block_pallas
+
+    def counting(*a, **kw):
+        calls["full"] += 1
+        return orig(*a, **kw)
+
+    monkeypatch.setattr(pc, "fused_conv_block_pallas", counting)
+    g = _GEOMS[2]
+    x, w, b = _int_arrays(rng, g["n"], g["c"], g["h"], g["w"], g["o"],
+                          g["kh"], g["kw"], g["groups"])
+    got = fb.fused_conv_lrn_pool(
+        x, w, b, stride=g["stride"], pad=g["pad"], groups=g["groups"],
+        relu_slope=g["relu_slope"], pool_kernel=g["pool_kernel"],
+        pool_stride=g["pool_stride"], pool_pad=g["pool_pad"],
+        impl="pallas", interpret=True, **_LRN)
+    assert calls["full"] == 1
+    # compare via the un-patched original so the check itself does not
+    # bump the counter
+    want = orig(x, w, b, g["stride"], g["pad"], g["groups"],
+                g["relu_slope"], _LRN["local_size"], _LRN["alpha"],
+                _LRN["beta"], _LRN["k"], g["pool_kernel"],
+                g["pool_stride"], g["pool_pad"], True)
+    assert np.array_equal(np.asarray(got), np.asarray(want))
+
+    # unsupported geometry (O=12, off the f32 sublane tile) degrades to
+    # the tail path without touching the full-block kernel
+    w12 = jnp.asarray(rng.randint(-2, 3, size=(12, g["c"], g["kh"],
+                                               g["kw"]))
+                      .astype(np.float32))
+    y = fb.fused_conv_lrn_pool(
+        x, w12, None, stride=g["stride"], pad=g["pad"],
+        groups=g["groups"], relu_slope=g["relu_slope"],
+        pool_kernel=g["pool_kernel"], pool_stride=g["pool_stride"],
+        pool_pad=g["pool_pad"], impl="pallas", interpret=True, **_LRN)
+    assert calls["full"] == 1
+    g12 = dict(g, o=12)
+    want = _xla_composed(x, w12, None, g12)
+    np.testing.assert_allclose(np.asarray(y), np.asarray(want),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_dispatch_pallas_tail_forces_tail_kernel(rng, monkeypatch):
+    def boom(*a, **kw):  # the A/B control must never run the full block
+        raise AssertionError("full-block kernel ran under pallas-tail")
+
+    monkeypatch.setattr(pc, "fused_conv_block_pallas", boom)
+    g = _GEOMS[2]
+    x, w, b = _int_arrays(rng, g["n"], g["c"], g["h"], g["w"], g["o"],
+                          g["kh"], g["kw"], g["groups"])
+    got = fb.fused_conv_lrn_pool(
+        x, w, b, stride=g["stride"], pad=g["pad"], groups=g["groups"],
+        relu_slope=g["relu_slope"], pool_kernel=g["pool_kernel"],
+        pool_stride=g["pool_stride"], pool_pad=g["pool_pad"],
+        impl="pallas-tail", interpret=True, **_LRN)
+    assert np.array_equal(np.asarray(got),
+                          np.asarray(_tail_composed(x, w, b, g)))
+
+
+def test_fused_blocks_mode_pallas_tail(monkeypatch):
+    monkeypatch.setenv("SPARKNET_FUSED_BLOCKS", "pallas-tail")
+    assert fb.fused_blocks_mode() == "pallas-tail"
+    monkeypatch.setenv("SPARKNET_FUSED_BLOCKS", "bogus")
+    with pytest.raises(ValueError, match="pallas-tail"):
+        fb.fused_blocks_mode()
+
+
+def test_effective_fused_blocks_mode_cpu(monkeypatch):
+    """Off-TPU both pallas modes execute the XLA composition, and the
+    bench stamp must say so (an A/B record claiming a kernel that never
+    ran is worse than no record)."""
+    for mode, want in (("off", "off"), ("xla", "xla"),
+                       ("pallas", "xla"), ("pallas-tail", "xla")):
+        monkeypatch.setenv("SPARKNET_FUSED_BLOCKS", mode)
+        assert fb.effective_fused_blocks_mode() == want
+    monkeypatch.delenv("SPARKNET_FUSED_BLOCKS")
+    assert fb.effective_fused_blocks_mode() == "off"
+
+
+def test_net_pallas_tail_mode_cpu_bitwise(monkeypatch):
+    """SPARKNET_FUSED_BLOCKS=pallas-tail at the net level: the matcher
+    records the mode, and on CPU the forward falls back to the exact
+    XLA composition bits."""
+    from sparknet_tpu.core.net import Net
+    from sparknet_tpu.models import get_model
+
+    def build(mode):
+        monkeypatch.setenv("SPARKNET_FUSED_BLOCKS", mode)
+        return Net(get_model("alexnet", batch=2, n_classes=10, crop=67,
+                             deploy=True), "TEST")
+
+    tail = build("pallas-tail")
+    xla = build("xla")
+    assert [m["impl"] for m in tail.fused_blocks] == ["pallas-tail"] * 2
+    params = xla.init_params(seed=0)
+    rng = np.random.RandomState(0)
+    feed = {"data": jnp.asarray(rng.randn(2, 3, 67, 67)
+                                .astype(np.float32))}
+    out = [t for t in xla.blob_shapes if t.startswith("prob")][0]
+    want = xla.forward(params, feed)[out]
+    got = tail.forward(params, feed)[out]
+    assert np.array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_portable_path_keeps_pallas_unimported():
+    """Importing ops.pallas_conv and running every NON-kernel entry
+    point (the gates) must not drag jax.experimental.pallas in; neither
+    must the off-TPU pallas dispatch through fused_conv_lrn_pool."""
+    code = (
+        "import jax; jax.config.update('jax_platforms', 'cpu')\n"
+        "import sys, numpy as np, jax.numpy as jnp\n"
+        "from sparknet_tpu.ops import pallas_conv as pc\n"
+        "from sparknet_tpu.ops import fused_block as fb\n"
+        "x = jnp.asarray(np.ones((1, 8, 9, 9), np.float32))\n"
+        "w = jnp.asarray(np.ones((8, 8, 3, 3), np.float32))\n"
+        "assert pc.fullblock_supported(x, w, stride=(1, 1), pad=(1, 1),"
+        " dilation=(1, 1), groups=1)\n"
+        "fb.fused_conv_lrn_pool(x, w, impl='pallas')  # CPU fallback\n"
+        "bad = [m for m in sys.modules"
+        " if 'pallas' in m and not m.startswith('sparknet_tpu')]\n"
+        "assert not bad, bad\n"
+        "print('clean')\n")
+    r = subprocess.run([sys.executable, "-c", code], capture_output=True,
+                       timeout=240)
+    assert r.returncode == 0, r.stderr.decode()
+    assert b"clean" in r.stdout
