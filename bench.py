@@ -475,6 +475,110 @@ def bench_serving_mesh(model: str = "lenet", n_requests: int = 192,
     return out
 
 
+def bench_serving_sharded(model: str = "lenet", n_requests: int = 192,
+                          max_batch: int = 8, seed: int = 0,
+                          shards: int = 4, rounds: int = 3) -> dict:
+    """Sharded vs unsharded serving, interleaved A/B: one replica whose
+    params live gspmd-sharded over a `shards`-device mesh slice
+    (all-gathered at use inside the jitted forward — README "Sharded
+    serving") against one single-device unsharded replica, the SAME
+    closed-loop burst alternating A/B/A/B `rounds` times so host-noise
+    drift hits both arms equally (CLAUDE.md measurement discipline;
+    CPU-only leg).
+
+    Besides QPS/latency the leg lands the two claims the sharded path
+    makes: `serving_sharded_bitwise` (an idle-server bucket-1 probe —
+    same sample through both arms must agree to the BIT, the
+    gather-at-use design guarantee) and
+    `serving_sharded_post_warmup_compiles` (0 = the burst never
+    recompiled; gspmd shardings are part of the warmed cache key).  On
+    one physical core the slice shares a core with itself, so the ratio
+    mostly prices the gather + partitioner overhead — the honest stamp,
+    as with serving_mesh."""
+    import jax
+
+    from sparknet_tpu.serving import InferenceServer, ServerConfig
+
+    try:
+        devs = jax.devices("cpu")
+    except RuntimeError:
+        devs = jax.devices()
+    shards = int(shards)
+    if len(devs) < shards:
+        raise RuntimeError(
+            f"serving_sharded needs {shards} devices, have {len(devs)} "
+            f"(XLA_FLAGS=--xla_force_host_platform_device_count=8)")
+
+    def make(n_shards):
+        srv = InferenceServer(
+            ServerConfig(max_batch=max_batch,
+                         queue_depth=max(2 * n_requests, 64)),
+            devices=devs)
+        if n_shards == 1:
+            lm = srv.load(model, device=devs[0])
+        else:
+            lm = srv.load(model, replicas=1, shards=n_shards)
+        return srv, lm
+
+    single, lm1 = make(1)
+    sharded, lmS = make(shards)
+    warm_compiles = lmS.replicas[0].compile_count()
+    shape = lm1.runner.sample_shape
+    pool = np.random.RandomState(seed).rand(
+        64, *shape).astype(np.float32)
+    reqs = [pool[i % len(pool)] for i in range(n_requests)]
+
+    # bitwise probe while both servers are idle: the same sample rides
+    # a bucket-1 batch through each arm
+    p1 = single.submit(model, pool[0],
+                       wait=True).result(timeout=600).probs
+    pS = sharded.submit(model, pool[0],
+                        wait=True).result(timeout=600).probs
+    bitwise = bool(np.array_equal(np.asarray(p1), np.asarray(pS)))
+
+    def measure(srv):
+        t0 = time.perf_counter()
+        futs = srv.submit_many(model, reqs, wait=True)
+        lat = [f.result(timeout=600).total_ms for f in futs]
+        return n_requests / (time.perf_counter() - t0), lat
+
+    qps1, qpsS, lat1, latS = [], [], [], []
+    try:
+        for _ in range(max(1, int(rounds))):
+            q, l = measure(single)
+            qps1.append(q)
+            lat1 += l
+            q, l = measure(sharded)
+            qpsS.append(q)
+            latS += l
+        post_warmup = lmS.replicas[0].compile_count() - warm_compiles
+    finally:
+        single.close(drain=True)
+        sharded.close(drain=True)
+    q1 = float(np.median(qps1))
+    qS = float(np.median(qpsS))
+    out = {"serving_sharded_model": model,
+           "serving_sharded_shards": lmS.replicas[0].shards,
+           "serving_sharded_topology": _serving_topology(devs),
+           "serving_sharded_rounds": int(rounds),
+           "serving_sharded_n_requests": int(n_requests),
+           "serving_sharded_qps": round(qS, 1),
+           "serving_sharded_p50_ms": round(
+               float(np.percentile(latS, 50)), 3),
+           "serving_sharded_p99_ms": round(
+               float(np.percentile(latS, 99)), 3),
+           "serving_sharded_single_qps": round(q1, 1),
+           "serving_sharded_single_p50_ms": round(
+               float(np.percentile(lat1, 50)), 3),
+           "serving_sharded_single_p99_ms": round(
+               float(np.percentile(lat1, 99)), 3),
+           "serving_sharded_ratio": round(qS / q1, 3) if q1 else None,
+           "serving_sharded_bitwise": bitwise,
+           "serving_sharded_post_warmup_compiles": int(post_warmup)}
+    log(json.dumps(out))
+    return out
+
+
 def bench_elastic(rounds: int = 6):
     """Elastic-runtime straggler A/B via `scripts/chaos_run.py --ab` in a
     subprocess: the same seeded fault plan (one persistent 20× straggler,
@@ -915,6 +1019,15 @@ _KNOWN_FIELDS = {
     "serving_mesh_p50_ms", "serving_mesh_p99_ms",
     "serving_single_qps", "serving_single_p50_ms", "serving_single_p99_ms",
     "serving_mesh_speedup", "serving_mesh_compiles",
+    # sharded-serving A/B (schema v8): one gspmd slice replica vs one
+    # single-device replica, plus the bitwise and zero-recompile bars
+    "serving_sharded_model", "serving_sharded_shards",
+    "serving_sharded_topology", "serving_sharded_rounds",
+    "serving_sharded_n_requests", "serving_sharded_qps",
+    "serving_sharded_p50_ms", "serving_sharded_p99_ms",
+    "serving_sharded_single_qps", "serving_sharded_single_p50_ms",
+    "serving_sharded_single_p99_ms", "serving_sharded_ratio",
+    "serving_sharded_bitwise", "serving_sharded_post_warmup_compiles",
     # elastic-runtime straggler A/B (simulated stall-seconds, chaos_run
     # subprocess on the 8-device virtual CPU mesh)
     "elastic_workers", "elastic_rounds", "elastic_joins",
@@ -952,7 +1065,7 @@ _KNOWN_LEGS = {
     "alexnet_train", "googlenet_train_b64", "googlenet_train_b128",
     "alexnet_infer", "googlenet_infer", "longctx_lm", "cifar_e2e",
     "imagenet_native", "serving", "serving_int8", "serving_mesh",
-    "elastic", "trainserve", "serving_resilience",
+    "serving_sharded", "elastic", "trainserve", "serving_resilience",
 }
 
 
@@ -1035,7 +1148,11 @@ def _stale_record(reason: str) -> dict:
     return stale
 
 
-BENCH_SCHEMA_VERSION = 7  # v7: cifar_e2e/imagenet_native records carry
+BENCH_SCHEMA_VERSION = 8  # v8: serving_sharded leg (gspmd slice replica
+#                           vs single-device A/B — serving_sharded_*
+#                           QPS/latency, ratio, bitwise bar,
+#                           post-warmup-compiles==0 bar);
+#                           v7: cifar_e2e/imagenet_native records carry
 #                           precision + effective fused-blocks stamps
 #                           (cifar_e2e_precision, cifar_e2e_fused_blocks,
 #                           imagenet_native_precision,
@@ -1364,6 +1481,24 @@ def _run_legs(land) -> None:
             "serving_single_qps", "serving_single_p50_ms",
             "serving_single_p99_ms", "serving_mesh_speedup",
             "serving_mesh_compiles")})
+    # sharded-serving A/B leg (CPU devices; one gspmd slice replica vs
+    # one single-device replica, interleaved) — also lands the bitwise
+    # and zero-recompile bars the sharded path promises
+    try:
+        serving_s = bench_serving_sharded()
+    except Exception as e:
+        log(f"serving_sharded leg failed, omitting its fields: {e!r}")
+    else:
+        land("serving_sharded", {k: serving_s[k] for k in (
+            "serving_sharded_model", "serving_sharded_shards",
+            "serving_sharded_topology", "serving_sharded_rounds",
+            "serving_sharded_n_requests", "serving_sharded_qps",
+            "serving_sharded_p50_ms", "serving_sharded_p99_ms",
+            "serving_sharded_single_qps",
+            "serving_sharded_single_p50_ms",
+            "serving_sharded_single_p99_ms", "serving_sharded_ratio",
+            "serving_sharded_bitwise",
+            "serving_sharded_post_warmup_compiles")})
     # elastic straggler A/B (subprocess, virtual CPU mesh — see
     # bench_elastic docstring); guarded like the other CPU-path legs
     try:

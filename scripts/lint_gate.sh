@@ -20,6 +20,9 @@
 # SPARKNET_LINT_GATE_NO_SERVECHAOS=1 skips the serving-resilience smoke
 # (scripts/serve_chaos_run.py: seeded error-storm + hard kill under a
 # flash crowd; breakers trip/respawn/re-admit, zero dropped requests).
+# SPARKNET_LINT_GATE_NO_SHARDED=1 skips the sharded-serving contract leg
+# (compiles the gspmd slice forward at shards=4 and diffs its HLO
+# collective census against CONTRACTS.json; needs the 8-device mesh).
 set -euo pipefail
 cd "$(dirname "$0")/.."
 python -m sparknet_tpu.cli lint --format json "$@"
@@ -34,6 +37,16 @@ if [ "${SPARKNET_LINT_GATE_NO_CONTRACT:-0}" != "1" ]; then
         python -m sparknet_tpu.cli lint --format json --select R007 \
         --jaxpr round --jaxpr round-bf16 --jaxpr serve --model lenet \
         --contract
+fi
+if [ "${SPARKNET_LINT_GATE_NO_SHARDED:-0}" != "1" ]; then
+    # sharded-serving contract leg: the gspmd slice forward (replica =
+    # 4-device mesh slice) COMPILES, and its cross-slice communication
+    # schedule — the HLO all-gather census, invisible to a jaxpr walk —
+    # must match the committed serving_forward[...,shards=4] contract
+    timeout -k 10 600 env JAX_PLATFORMS=cpu \
+        XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+        python -m sparknet_tpu.cli lint --format json --select R007 \
+        --jaxpr serve-sharded --model lenet --shards 4 --contract
 fi
 if [ "${SPARKNET_LINT_GATE_NO_PROC:-0}" != "1" ]; then
     timeout -k 10 420 env JAX_PLATFORMS=cpu \

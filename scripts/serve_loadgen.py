@@ -170,6 +170,10 @@ def main() -> None:
                    help="replicas per model across the device mesh "
                         "(0 = one per device; default "
                         "SPARKNET_SERVE_REPLICAS)")
+    p.add_argument("--shards", type=int, default=None,
+                   help="devices per replica SLICE (gspmd-sharded "
+                        "params; 1 = unsharded; default "
+                        "SPARKNET_SERVE_SHARDS)")
     p.add_argument("--min_fill", type=int, default=None,
                    help="batch rows a replica waits for before dispatch "
                         "(default SPARKNET_SERVE_MIN_FILL, normally 1 = "
@@ -284,7 +288,8 @@ def main() -> None:
         for name, _w in mix:
             lm = server.load(name,
                              weights=a.weights if len(mix) == 1 else None,
-                             seed=a.seed, replicas=a.replicas)
+                             seed=a.seed, replicas=a.replicas,
+                             shards=a.shards)
             shape = lm.runner.sample_shape
             pools[name] = rng.rand(64, *shape).astype(np.float32)
             if traffic is not None:
@@ -392,6 +397,9 @@ def main() -> None:
                    "achieved_qps": round(
                        stats[n]["completed"] / elapsed, 1),
                    "replicas": stats[n].get("n_replicas", 1),
+                   "shards": stats[n].get("engine_shards", 1),
+                   "slice_devices":
+                       stats[n].get("engine_slice_devices"),
                    "batch_occupancy_mean":
                        stats[n]["batch_occupancy_mean"],
                    "bucket_counts": stats[n]["bucket_counts"],

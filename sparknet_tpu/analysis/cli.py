@@ -51,9 +51,10 @@ def cmd_lint(args) -> int:
             report = jaxpr_audit.audit_training_round(
                 n_workers=args.workers, tau=args.tau,
                 precision="bfloat16" if leg == "round-bf16" else None)
-        else:  # serve
+        else:  # serve / serve-sharded
             report = jaxpr_audit.audit_serving_forward(
-                args.model, quant=args.quant or None)
+                args.model, quant=args.quant or None,
+                shards=(args.shards if leg == "serve-sharded" else 1))
         jaxpr_reports.append(report)
         jaxpr_violations.extend(jaxpr_audit.findings_from_report(report))
 
@@ -123,8 +124,11 @@ def register(sub) -> None:
                    help="overrides the tests/README anchor directory "
                         "(default: parent of each linted path)")
     p.add_argument("--jaxpr", action="append",
-                   choices=["round", "round-bf16", "serve"],
-                   help="also trace + audit a hot program (repeatable)")
+                   choices=["round", "round-bf16", "serve",
+                            "serve-sharded"],
+                   help="also trace + audit a hot program (repeatable); "
+                        "serve-sharded compiles the gspmd slice forward "
+                        "and censuses its HLO collectives")
     p.add_argument("--workers", type=int, default=8,
                    help="worker count for --jaxpr round (needs that many "
                         "local devices)")
@@ -135,6 +139,9 @@ def register(sub) -> None:
                         "--jaxpr serve")
     p.add_argument("--quant", default=None,
                    help="quant mode for --jaxpr serve (e.g. bf16)")
+    p.add_argument("--shards", type=int, default=4,
+                   help="slice width for --jaxpr serve-sharded (needs "
+                        "that many local devices)")
     p.add_argument("--contract", action="store_true",
                    help="diff each --jaxpr report against the committed "
                         "CONTRACTS.json; drift exits 1")

@@ -170,6 +170,44 @@ def audit_fn(fn, *args, **kwargs) -> Dict[str, Any]:
     return audit_jaxpr(jax.make_jaxpr(fn, **kwargs)(*args))
 
 
+# HLO opcode -> wire census.  gspmd collectives never appear in a jaxpr
+# — the SPMD partitioner inserts them at COMPILE time — so the sharded
+# serving forward's communication schedule is read off the compiled HLO
+# text instead (the same census shape audit_jaxpr builds from jaxpr
+# collectives, so contracts pin both kinds identically).
+_HLO_COLLECTIVE_OPS = ("all-gather", "all-reduce", "reduce-scatter",
+                       "all-to-all", "collective-permute",
+                       "collective-broadcast")
+_HLO_DTYPE_BYTES = {"f64": 8, "f32": 4, "bf16": 2, "f16": 2,
+                    "s64": 8, "u64": 8, "s32": 4, "u32": 4, "s16": 2,
+                    "u16": 2, "s8": 1, "u8": 1, "pred": 1,
+                    "c64": 8, "c128": 16}
+
+
+def hlo_collective_census(hlo_text: str) -> Dict[str, Dict[str, int]]:
+    """{op: {"count", "bytes"}} over the collective ops in compiled HLO
+    text.  `bytes` is each op's RESULT volume from its shape token
+    (e.g. ``f32[500,800]`` -> 1.6e6) — for an all-gather that is the
+    fully materialized array per device, the wire-volume proxy the
+    sharded-serving contract pins."""
+    import re
+
+    pat = re.compile(
+        r"=\s*([a-z0-9]+)\[([0-9,]*)\]\S*\s+("
+        + "|".join(_HLO_COLLECTIVE_OPS) + r")\(")
+    coll: Dict[str, Dict[str, int]] = {}
+    for m in pat.finditer(hlo_text):
+        dtype, dims, op = m.groups()
+        size = 1
+        for d in dims.split(","):
+            if d:
+                size *= int(d)
+        c = coll.setdefault(op, {"count": 0, "bytes": 0})
+        c["count"] += 1
+        c["bytes"] += size * _HLO_DTYPE_BYTES.get(dtype, 0)
+    return {k: dict(v) for k, v in sorted(coll.items())}
+
+
 # ------------------------------------------------------- repo hot programs
 
 def _toy_round_solver(n_workers: int, tau: int,
@@ -243,24 +281,48 @@ def audit_training_round(n_workers: int = 8, tau: int = 2,
 
 
 def audit_serving_forward(spec: str = "lenet", *, batch: int = 4,
-                          quant: Optional[str] = None) -> Dict[str, Any]:
-    """Trace and audit the serving forward for one bucket (no warmup —
-    tracing only, nothing executes)."""
+                          quant: Optional[str] = None,
+                          shards: int = 1) -> Dict[str, Any]:
+    """Trace and audit the serving forward for one bucket.
+
+    `shards=1` is pure tracing — nothing executes.  `shards>1` audits
+    the gspmd-sharded exec path (replica = mesh slice of that many
+    devices): the jaxpr walk still supplies host transfers, convert
+    edges and weak types, but the collective census is read off the
+    COMPILED HLO (``hlo_collective_census``) because the SPMD
+    partitioner inserts the cross-slice gathers after tracing — a
+    jaxpr-level census would report an empty schedule and the contract
+    would pin nothing."""
     import jax
     import jax.numpy as jnp
 
     from ..serving.engine import ModelRunner, resolve_net_param
 
+    shards = int(shards)
+    if shards > 1 and len(jax.devices()) < shards:
+        raise RuntimeError(
+            f"audit_serving_forward(shards={shards}) needs {shards} "
+            f"devices, have {len(jax.devices())} (run on the CPU mesh: "
+            f"JAX_PLATFORMS=cpu XLA_FLAGS="
+            f"--xla_force_host_platform_device_count={max(shards, 8)})")
+    kwargs = {}
+    if shards > 1:
+        kwargs = {"shards": shards, "device": jax.devices()[:shards]}
     runner = ModelRunner(resolve_net_param(spec, max_batch=batch),
-                         max_batch=batch, quant=quant)
+                         max_batch=batch, quant=quant, **kwargs)
     bucket = min(runner.buckets)
     x = jnp.zeros((bucket,) + runner.sample_shape, jnp.float32)
     closed = jax.make_jaxpr(runner._jfwd)(runner._exec_params, x)
     report = audit_jaxpr(closed)
+    if shards > 1:
+        hlo = (runner._jfwd.lower(runner._exec_params, x)
+               .compile().as_text())
+        report["collectives"] = hlo_collective_census(hlo)
     report["program"] = "serving_forward"
     report["model"] = spec
     report["bucket"] = bucket
     report["quant"] = runner.quant
+    report["shards"] = shards
     return report
 
 
@@ -312,8 +374,12 @@ def contract_key(report: Dict[str, Any]) -> str:
                 f"tau={report['tau']}{suffix}]")
     if prog == "serving_forward":
         quant = report.get("quant") or "none"
+        # unsharded keeps the historical key (no shards suffix) so the
+        # committed contracts survive
+        shards = int(report.get("shards", 1) or 1)
+        suffix = f",shards={shards}" if shards > 1 else ""
         return (f"serving_forward[model={report['model']},"
-                f"bucket={report['bucket']},quant={quant}]")
+                f"bucket={report['bucket']},quant={quant}{suffix}]")
     return prog
 
 

@@ -37,6 +37,8 @@ KNOBS: Dict[str, str] = {
     "SPARKNET_ROUND_LOG": "per-round training telemetry JSONL path",
     # -- serving
     "SPARKNET_SERVE_REPLICAS": "serving replicas placed per loaded model",
+    "SPARKNET_SERVE_SHARDS": "devices per serving replica slice "
+                             "(gspmd-sharded params)",
     "SPARKNET_SERVE_MIN_FILL": "batch rows a replica waits for before "
                                "dispatching",
     "SPARKNET_SERVE_SUBMIT_TIMEOUT_S": "bound on blocking "

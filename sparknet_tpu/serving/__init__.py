@@ -21,7 +21,8 @@ from .buckets import bucket_sizes, pad_to_bucket, pick_bucket
 from .engine import ModelRunner, resolve_net_param
 from .errors import (DeadlineExceeded, ModelNotLoaded, RequestShed,
                      ServerClosed, ServerOverloaded, ServingError)
-from .placement import DevicePlacer, resolve_replica_count, serving_mesh
+from .placement import (DevicePlacer, resolve_replica_count,
+                        resolve_shard_count, serving_mesh)
 from .registry import LoadedModel, ModelRegistry
 from .resilience import (CircuitBreaker, ResilienceConfig,
                          ResilienceManager, ServeFaultPlan)
@@ -36,6 +37,7 @@ __all__ = [
     "DeadlineExceeded", "ModelNotLoaded", "RequestShed",
     "bucket_sizes", "pick_bucket", "pad_to_bucket",
     "DevicePlacer", "serving_mesh", "resolve_replica_count",
+    "resolve_shard_count",
     "ReplicaScheduler",
     "LatencySeries", "ModelStats",
     "ResilienceConfig", "ResilienceManager", "CircuitBreaker",

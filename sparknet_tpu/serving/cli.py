@@ -81,7 +81,7 @@ def cmd_serve(args) -> int:
                          quant_min_agreement=(args.quant_min_agreement
                                               if args.quant != "fp32"
                                               else None),
-                         replicas=args.replicas)
+                         replicas=args.replicas, shards=args.shards)
     except ValueError as e:
         # a failed quant calibration floor (or bad spec) is a load
         # error, not a crash
@@ -90,9 +90,12 @@ def cmd_serve(args) -> int:
     if lm.runner.quant != "fp32":
         quant_note = (f", quant {lm.runner.quant} "
                       f"(top-1 agreement {lm.runner.quant_agreement:.4f})")
+    shard_note = ""
+    if lm.runner.shards > 1:
+        shard_note = f" x {lm.runner.shards} shards"
     print(f"serving {args.model!r} as {name!r}: input "
           f"{lm.runner.sample_shape}, buckets {lm.runner.buckets}, "
-          f"{lm.n_replicas} replica(s), "
+          f"{lm.n_replicas} replica(s){shard_note}, "
           f"{lm.runner.compile_count()} programs warmed{quant_note}",
           file=sys.stderr, flush=True)
 
@@ -216,6 +219,11 @@ def register(sub) -> None:
                    help="model replicas spread across the device mesh "
                         "(0 = one per device; default "
                         "SPARKNET_SERVE_REPLICAS, normally 1)")
+    s.add_argument("--shards", type=int,
+                   help="devices per replica SLICE (gspmd-sharded "
+                        "params; 1 = unsharded; with --replicas 0, "
+                        "one replica per slice; default "
+                        "SPARKNET_SERVE_SHARDS, normally 1)")
     s.add_argument("--min_fill", type=int,
                    help="rows a replica waits for (up to max_wait_ms) "
                         "before dispatching; default "

@@ -50,14 +50,28 @@ class ModelRunner:
     """Jitted TEST-phase forward over a fixed bucket ladder.
 
     Single-threaded by design: exactly one batcher thread per model calls
-    `forward_padded` (serving/server.py), so no lock is taken here."""
+    `forward_padded` (serving/server.py), so no lock is taken here.
+
+    With `shards` > 1 the runner is SHARDED: `device` is a mesh slice (a
+    list of exactly `shards` devices), params ride `NamedSharding`s over
+    a (1, shards) `make_mesh` grid (the SAME mesh axes training's
+    GspmdTrainer uses — parallel/gspmd.py), and the forward jits with
+    gspmd in/out shardings so each device stores 1/shards of every big
+    blob at rest and XLA inserts the all-gathers that materialize them
+    at use (see _build_exec for why gather-at-use is the bitwise-safe
+    partitioning).  The partition policy is training's `infer_tp_specs`
+    verbatim: output-feature dim 0 of blobs >= `tp_min_elems` that
+    divide evenly, biases following their weights, everything else
+    replicated."""
 
     def __init__(self, net_param, *, weights: Optional[str] = None,
                  buckets: Optional[Sequence[int]] = None,
                  max_batch: int = 8, seed: int = 0,
                  device=None, quant: Optional[str] = None,
                  quant_calib_batches: int = 2,
-                 quant_min_agreement: Optional[float] = None) -> None:
+                 quant_min_agreement: Optional[float] = None,
+                 shards: int = 1,
+                 tp_min_elems: int = 1 << 16) -> None:
         import jax
 
         from ..core.net import Net
@@ -69,16 +83,29 @@ class ModelRunner:
         self.quant = validate_quant_mode(quant)
         self.quant_agreement: Optional[float] = None
         self._seed = int(seed)
+        self.shards = int(shards)
+        if self.shards < 1:
+            raise ValueError(
+                f"shards must be >= 1, got {self.shards}")
+        self.tp_min_elems = int(tp_min_elems)
         self.net = Net(net_param, "TEST")
         self.params = self.net.init_params(seed)
         if weights:
             self.params = load_pretrained(self.net, self.params, weights)
-        self.device = device
-        if device is not None:
-            # pin params to the target device; jit then executes there
-            # (bench.py's serving leg forces the CPU backend this way
-            # even when the process default platform is the TPU tunnel)
-            self.params = jax.device_put(self.params, device)
+        if self.shards > 1:
+            self.device = None
+            self._bind_slice(device if device is not None
+                             else jax.devices()[:self.shards])
+            self.params = self._shard_params(self.params)
+        else:
+            self.slice_devices = None
+            self.device = device
+            if device is not None:
+                # pin params to the target device; jit then executes
+                # there (bench.py's serving leg forces the CPU backend
+                # this way even when the process default platform is the
+                # TPU tunnel)
+                self.params = jax.device_put(self.params, device)
         self.input_blob = self.net.input_blobs[0]
         self.sample_shape: Tuple[int, ...] = tuple(
             self.net.blob_shapes[self.input_blob][1:])
@@ -88,6 +115,79 @@ class ModelRunner:
         if self.quant != "fp32":
             self.calibrate_quant(quant_calib_batches,
                                  min_agreement=quant_min_agreement)
+
+    # ------------------------------------------------------- sharded plumbing
+    def _bind_slice(self, devices) -> None:
+        """Bind this runner to a mesh slice: exactly `shards` devices,
+        one (1, shards) mesh over them, and the per-param
+        PartitionSpecs.  Called at construction and by replicate() when
+        cloning onto a different slice (the pspecs depend only on the
+        net + shard count, so every slice of every generation partitions
+        identically — a rebuild lands bitwise on the same sub-mesh)."""
+        from ..parallel.gspmd import infer_tp_specs
+        from ..parallel.mesh import make_mesh
+
+        devs = list(devices)
+        if len(devs) != self.shards:
+            raise ValueError(
+                f"sharded runner needs a device slice of exactly "
+                f"{self.shards} device(s), got {len(devs)}; on the CPU "
+                f"test platform export "
+                f"XLA_FLAGS=--xla_force_host_platform_device_count=8")
+        self.slice_devices = devs
+        self._mesh = make_mesh(n_workers=1, model_parallel=self.shards,
+                               devices=devs)
+        self._pspecs = infer_tp_specs(self.net, self._mesh,
+                                      min_tp_elems=self.tp_min_elems)
+
+    def _repl_sharding(self):
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        return NamedSharding(self._mesh, P())
+
+    def _shard_params(self, params):
+        """device_put the fp32 param tree onto the slice with its
+        per-param NamedShardings (the gspmd trainer's placement recipe,
+        parallel/gspmd.py GspmdTrainer.__init__)."""
+        import jax
+        from jax.sharding import NamedSharding
+
+        return {k: jax.device_put(v,
+                                  NamedSharding(self._mesh,
+                                                self._pspecs[k]))
+                for k, v in params.items()}
+
+    def _qtree_specs(self, qtree):
+        """Leaf-level PartitionSpecs for a quantized exec tree,
+        mirroring the fp32 pspecs: an int8-packed {"q", "scale"} leaf
+        inherits the weight's spec for "q" and shards its 1-D
+        per-output-channel "scale" over the same axis."""
+        from jax.sharding import PartitionSpec as P
+
+        from ..parallel.mesh import MODEL_AXIS
+
+        specs = {}
+        for key, val in qtree.items():
+            ps = self._pspecs.get(key, P())
+            if isinstance(val, dict):
+                specs[key] = {"q": ps,
+                              "scale": (P(MODEL_AXIS)
+                                        if len(ps) and ps[0] == MODEL_AXIS
+                                        else P())}
+            else:
+                specs[key] = ps
+        return specs
+
+    def tp_sharded_params(self) -> Dict[str, Tuple[int, ...]]:
+        """Which parameters actually shard over the model axis (empty
+        for unsharded runners) — introspection for tests/stats, same
+        shape as GspmdTrainer.tp_sharded_params."""
+        from jax.sharding import PartitionSpec as P
+
+        if self.shards <= 1:
+            return {}
+        return {k: tuple(self.net.param_inits[k].shape)
+                for k, s in self._pspecs.items() if s != P()}
 
     def _build_exec(self) -> None:
         """Build the device-side execution state from self.params/device:
@@ -102,6 +202,27 @@ class ModelRunner:
         net = self.net
         aux_blobs = list(net.input_blobs[1:])
         input_blob, output_blob = self.input_blob, self.output_blob
+
+        if self.shards > 1:
+            # bitwise contract of sharded serving: params live SHARDED
+            # at rest (each device holds 1/shards of every big blob —
+            # the memory-capacity win) and are all-gathered in-program
+            # at use.  An all-gather is a pure concat of exactly the
+            # master's values, so every downstream op is the
+            # single-device program verbatim and the output is bitwise-
+            # identical BY CONSTRUCTION — unlike activation tensor
+            # parallelism, whose sharded contractions re-order fp32
+            # partial sums (measured 1e-7-level drift on this backend)
+            # and can never meet the bitwise bar.  int8 packed params
+            # gather as int8, shrinking the cross-slice gather 4x.
+            repl_sh = self._repl_sharding()
+
+            def stage(tree):
+                return jax.tree_util.tree_map(
+                    lambda v: jax.lax.with_sharding_constraint(
+                        v, repl_sh), tree)
+        else:
+            stage = None
 
         def fwd(params, x):
             # labels the serving forward's XLA ops when
@@ -119,23 +240,55 @@ class ModelRunner:
                         else jnp.float32)
                 return net.forward(params, feed)[output_blob]
 
+        if self.shards > 1:
+            # params carry their NamedShardings in, the (small) score
+            # matrix comes back replicated over the slice, and XLA
+            # inserts the gathers in between — no manual communication
+            # code, the GspmdTrainer placement recipe applied to
+            # inference
+            from jax.sharding import NamedSharding
+
+            repl = self._repl_sharding()
+            param_sh = {k: NamedSharding(self._mesh, self._pspecs[k])
+                        for k in self.params}
+            sharded_jit = lambda f, in0: jax.jit(    # noqa: E731
+                f, in_shardings=(in0, repl), out_shardings=repl)
+
+            def sfwd(params, x):
+                return fwd(stage(params), x)
+        else:
+            sharded_jit = None
+            sfwd = fwd
+
         if self.quant == "fp32":
             self._exec_params = self.params
-            self._jfwd = jax.jit(fwd)
+            self._jfwd = (sharded_jit(sfwd, param_sh) if sharded_jit
+                          else jax.jit(fwd))
         else:
             # fp32 stays the master copy (calibration, interchange,
             # reload); the quantized tree is what the hot path carries
             qtree, dequant = build_quantized_params(self.params, self.quant)
-            if self.device is not None:
+            if self.shards > 1:
+                qspecs = self._qtree_specs(qtree)
+                qsh = jax.tree_util.tree_map(
+                    lambda s: NamedSharding(self._mesh, s), qspecs)
+                qtree = jax.device_put(qtree, qsh)
+            elif self.device is not None:
                 qtree = jax.device_put(qtree, self.device)
             self._exec_params = qtree
 
             def qfwd(qp, x):
-                p = dequant(qp)
+                # gather BEFORE dequant: the cross-slice bytes are the
+                # packed int8 + per-channel scales, 4x less than fp32
+                p = dequant(stage(qp) if stage else qp)
                 return fwd(p, x.astype(jnp.bfloat16)).astype(jnp.float32)
 
-            self._jfwd = jax.jit(qfwd)
-            self._jref = jax.jit(fwd)  # fp32 reference for calibration
+            if sharded_jit:
+                self._jfwd = sharded_jit(qfwd, qsh)
+                self._jref = sharded_jit(sfwd, param_sh)
+            else:
+                self._jfwd = jax.jit(qfwd)
+                self._jref = jax.jit(fwd)  # fp32 reference for calibration
         self.param_bytes = quantized_bytes(self._exec_params)
         self._shapes_seen: set = set()
 
@@ -146,19 +299,40 @@ class ModelRunner:
         compile independently and their math is bitwise-identical —
         same params, same program, different chip.  Quantization is
         re-derived from the same fp32 master (deterministic), so the
-        calibration agreement carries over untouched."""
+        calibration agreement carries over untouched.  For a sharded
+        runner `device` is a mesh slice (list of `shards` devices) and
+        the clone re-places the same master params with the same
+        PartitionSpecs on its own mesh."""
         import copy
 
         import jax
 
         clone = copy.copy(self)
-        clone.device = device
-        clone.params = jax.device_put(self.params, device)
+        if self.shards > 1:
+            clone._bind_slice(device)
+            clone.params = clone._shard_params(self.params)
+        else:
+            clone.device = device
+            clone.params = jax.device_put(self.params, device)
         clone._build_exec()
         clone.quant_agreement = self.quant_agreement
         return clone
 
     # ------------------------------------------------------------- execution
+    def _put_input(self, x: np.ndarray):
+        """Stage a host batch for the jitted forward: pinned to the
+        runner's device (unsharded), replicated over the slice mesh
+        (sharded — every shard sees the whole batch; the params are what
+        partitions), or left to the default placement."""
+        import jax
+        import jax.numpy as jnp
+
+        if self.shards > 1:
+            return jax.device_put(x, self._repl_sharding())
+        if self.device is not None:
+            return jax.device_put(x, self.device)
+        return jnp.asarray(x)
+
     def forward_padded(self, x: np.ndarray) -> np.ndarray:
         """(bucket, *sample_shape) float32 -> (bucket, n_outputs) float32
         on the host.  The bucket-shape contract is the caller's (server
@@ -172,11 +346,7 @@ class ModelRunner:
             raise ValueError(
                 f"batch {len(x)} is not a warmed bucket {self.buckets}; "
                 f"pad with buckets.pad_to_bucket first")
-        import jax
-        import jax.numpy as jnp
-
-        xj = (jax.device_put(x, self.device) if self.device is not None
-              else jnp.asarray(x))
+        xj = self._put_input(x)
         self._shapes_seen.add(tuple(x.shape))
         # np.asarray is a VALUE fetch: on the tunneled platform
         # block_until_ready returns before deferred execution completes
@@ -201,16 +371,11 @@ class ModelRunner:
             raise ValueError(
                 f"batch {len(x)} is not a warmed bucket {self.buckets}; "
                 f"pad with buckets.pad_to_bucket first")
-        import jax
-        import jax.numpy as jnp
-
         # the quantized hot path's program expects a quantized tree;
         # gate through the fp32 reference program instead (the same one
         # calibration scores against)
         jfwd = self._jref if self.quant != "fp32" else self._jfwd
-        xj = (jax.device_put(x, self.device) if self.device is not None
-              else jnp.asarray(x))
-        return np.asarray(jfwd(params, xj))
+        return np.asarray(jfwd(params, self._put_input(x)))
 
     def calibrate_quant(self, n_batches: int = 2, *,
                         min_agreement: Optional[float] = None,
@@ -224,11 +389,7 @@ class ModelRunner:
         No-op (None) on the fp32 path."""
         if self.quant == "fp32":
             return None
-        import jax
-
         from ..ops.quant import top1_agreement
-
-        import jax.numpy as jnp
 
         rng = np.random.RandomState(self._seed ^ 0x5EED)
         bucket = max(self.buckets)
@@ -237,8 +398,7 @@ class ModelRunner:
             x = rng.rand(bucket, *self.sample_shape).astype(np.float32)
             # same device/conversion path as forward_padded, so the
             # calibration compile IS the largest warmed bucket's program
-            xj = (jax.device_put(x, self.device)
-                  if self.device is not None else jnp.asarray(x))
+            xj = self._put_input(x)
             ref = np.asarray(self._jref(self.params, xj))
             got = np.asarray(self._jfwd(self._exec_params, xj))
             agree.append(top1_agreement(ref, got))
@@ -288,12 +448,17 @@ class ModelRunner:
             return len(self._shapes_seen)
 
     def describe(self) -> Dict[str, object]:
-        return {"input_blob": self.input_blob,
-                "sample_shape": list(self.sample_shape),
-                "output_blob": self.output_blob,
-                "n_outputs": self.n_outputs,
-                "buckets": list(self.buckets),
-                "compiles": self.compile_count(),
-                "quant": self.quant,
-                "quant_agreement": self.quant_agreement,
-                "param_bytes": self.param_bytes}
+        out = {"input_blob": self.input_blob,
+               "sample_shape": list(self.sample_shape),
+               "output_blob": self.output_blob,
+               "n_outputs": self.n_outputs,
+               "buckets": list(self.buckets),
+               "compiles": self.compile_count(),
+               "quant": self.quant,
+               "quant_agreement": self.quant_agreement,
+               "param_bytes": self.param_bytes,
+               "shards": self.shards}
+        if self.shards > 1:
+            out["slice_devices"] = [str(d) for d in self.slice_devices]
+            out["tp_params"] = sorted(self.tp_sharded_params())
+        return out

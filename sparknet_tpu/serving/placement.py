@@ -27,6 +27,17 @@ Two pieces:
 The replica count knob: `SPARKNET_SERVE_REPLICAS` (default 1 keeps the
 single-replica behavior every existing caller sees; 0 means "one replica
 per device" — saturate the mesh).
+
+Sharded serving generalizes the unit of placement: with
+`SPARKNET_SERVE_SHARDS=N` (or `shards_per_replica=N`) a replica is no
+longer one device but a mesh *slice* — N contiguous, pool-aligned
+devices hosting ONE gspmd-sharded copy of the model (engine.py's
+sharded exec path).  The placer's slot algebra (least-loaded placement,
+evict/respawn with a sticky slot -> slice binding, release) is
+unchanged; only the grain moves from device to slice.  Slices are
+aligned groups `devices[k*N:(k+1)*N]` so every replica of every model
+draws from the same fixed tiling and two sharded models can never
+interleave partial slices.
 """
 
 from __future__ import annotations
@@ -36,9 +47,11 @@ import threading
 from typing import Dict, List, Optional, Sequence
 
 __all__ = ["serving_mesh", "serving_devices", "DevicePlacer",
-           "resolve_replica_count", "REPLICAS_ENV"]
+           "resolve_replica_count", "resolve_shard_count",
+           "REPLICAS_ENV", "SHARDS_ENV"]
 
 REPLICAS_ENV = "SPARKNET_SERVE_REPLICAS"
+SHARDS_ENV = "SPARKNET_SERVE_SHARDS"
 
 
 def serving_devices(devices: Optional[Sequence] = None) -> List:
@@ -89,6 +102,25 @@ def resolve_replica_count(replicas: Optional[int],
     return replicas
 
 
+def resolve_shard_count(shards: Optional[int] = None) -> int:
+    """`shards` explicit wins; None reads SPARKNET_SERVE_SHARDS
+    (default 1 — the unsharded, whole-model-per-device path every
+    existing caller sees).  Shard counts are devices per replica slice,
+    so 0 has no "saturate" meaning and anything < 1 is a config
+    error."""
+    if shards is None:
+        try:
+            shards = int(os.environ.get(SHARDS_ENV, "1"))
+        except ValueError:
+            raise ValueError(
+                f"{SHARDS_ENV}={os.environ.get(SHARDS_ENV)!r} is not "
+                f"an int")
+    shards = int(shards)
+    if shards < 1:
+        raise ValueError(f"shards per replica must be >= 1, got {shards}")
+    return shards
+
+
 class DevicePlacer:
     """Least-loaded replica-slot assignment over a fixed device pool.
 
@@ -102,7 +134,10 @@ class DevicePlacer:
         self._devices = serving_devices(devices)
         self._lock = threading.Lock()
         self._load = [0] * len(self._devices)      # replicas resident
-        self._owners: Dict[str, List[int]] = {}    # model -> device idxs
+        # model -> per-slot device-index groups (a group is one device
+        # for shards=1, a whole mesh slice for shards>1)
+        self._owners: Dict[str, List[List[int]]] = {}
+        self._shards: Dict[str, int] = {}          # model -> slice width
         self._evicted: Dict[str, set] = {}         # model -> slot idxs
 
     @property
@@ -112,22 +147,47 @@ class DevicePlacer:
     def __len__(self) -> int:
         return len(self._devices)
 
-    def place(self, name: str, n_replicas: int) -> List:
-        """Assign `n_replicas` slots for model `name`, emptiest device
-        first, and record the residency.  Placing a name again first
-        releases its old slots (the reload/replace path)."""
+    def place(self, name: str, n_replicas: int,
+              shards_per_replica: int = 1) -> List:
+        """Assign `n_replicas` slots for model `name`, emptiest first,
+        and record the residency.  Placing a name again first releases
+        its old slots (the reload/replace path).
+
+        With `shards_per_replica` > 1 each slot is a mesh slice —
+        `shards_per_replica` contiguous pool-aligned devices — and the
+        return value is a list of device LISTS; least-loaded compares
+        total resident replicas per slice (slices, not raw devices, are
+        the routing grain).  The pool must tile exactly: a shard count
+        that does not divide it is a config error, not a silent
+        short-slice."""
         if n_replicas < 1:
             raise ValueError(f"n_replicas must be >= 1, got {n_replicas}")
+        s = int(shards_per_replica)
+        if s < 1:
+            raise ValueError(
+                f"shards_per_replica must be >= 1, got {s}")
+        if len(self._devices) % s != 0:
+            raise ValueError(
+                f"shards_per_replica={s} does not divide the "
+                f"{len(self._devices)}-device pool; sharded replicas "
+                f"need an exact tiling")
         with self._lock:
             self._release_locked(name)
-            picked: List[int] = []
+            groups = [list(range(k * s, (k + 1) * s))
+                      for k in range(len(self._devices) // s)]
+            picked: List[List[int]] = []
             for _ in range(int(n_replicas)):
-                i = min(range(len(self._devices)),
-                        key=lambda k: (self._load[k], k))
-                self._load[i] += 1
-                picked.append(i)
+                g = min(range(len(groups)),
+                        key=lambda k: (sum(self._load[i]
+                                           for i in groups[k]), k))
+                for i in groups[g]:
+                    self._load[i] += 1
+                picked.append(list(groups[g]))
             self._owners[name] = picked
-            return [self._devices[i] for i in picked]
+            self._shards[name] = s
+            if s == 1:
+                return [self._devices[g[0]] for g in picked]
+            return [[self._devices[i] for i in g] for g in picked]
 
     def release(self, name: str) -> None:
         """Drop model `name`'s residency (unload path); unknown names are
@@ -137,59 +197,85 @@ class DevicePlacer:
 
     def _release_locked(self, name: str) -> None:
         evicted = self._evicted.pop(name, set())
-        for slot, i in enumerate(self._owners.pop(name, ())):
+        self._shards.pop(name, None)
+        for slot, group in enumerate(self._owners.pop(name, ())):
             if slot not in evicted:    # an evicted slot already gave
-                self._load[i] -= 1     # its residency back
+                for i in group:        # its residency back
+                    self._load[i] -= 1
 
     def evict(self, name: str, slot: int):
         """Release the DEVICE residency of one replica slot (the
         breaker-open path) while keeping the slot -> device binding, so
-        `respawn()` rebuilds on the SAME device — TensorFlow's
-        re-placement model (PAPERS.md): the failed replica is a vacated
-        placement, not a lost device.  Returns the device; unknown
-        names/slots and double evictions are config errors."""
+        `respawn()` rebuilds on the SAME device (or the same whole
+        slice, for a sharded replica) — TensorFlow's re-placement model
+        (PAPERS.md): the failed replica is a vacated placement, not a
+        lost device.  Returns the device (a device list for sharded
+        slots); unknown names/slots and double evictions are config
+        errors."""
         with self._lock:
-            idxs = self._slot_locked(name, slot)
+            groups = self._slot_locked(name, slot)
             evicted = self._evicted.setdefault(name, set())
             if slot in evicted:
                 raise ValueError(f"slot {slot} of model {name!r} is "
                                  f"already evicted")
             evicted.add(int(slot))
-            self._load[idxs[slot]] -= 1
-            return self._devices[idxs[slot]]
+            for i in groups[slot]:
+                self._load[i] -= 1
+            return self._slot_devices_locked(name, groups[slot])
 
     def respawn(self, name: str, slot: int):
-        """Re-acquire the original device for an evicted slot (the
-        post-rebuild re-admission path); returns that device."""
+        """Re-acquire the original device(s) for an evicted slot (the
+        post-rebuild re-admission path); returns that device — the SAME
+        device set the slot was placed on, list-shaped for sharded
+        slots."""
         with self._lock:
-            idxs = self._slot_locked(name, slot)
+            groups = self._slot_locked(name, slot)
             if slot not in self._evicted.get(name, set()):
                 raise ValueError(f"slot {slot} of model {name!r} is not "
                                  f"evicted")
             self._evicted[name].discard(int(slot))
-            self._load[idxs[slot]] += 1
-            return self._devices[idxs[slot]]
+            for i in groups[slot]:
+                self._load[i] += 1
+            return self._slot_devices_locked(name, groups[slot])
 
-    def _slot_locked(self, name: str, slot: int) -> List[int]:
-        idxs = self._owners.get(name)
-        if idxs is None:
+    def _slot_devices_locked(self, name: str, group: List[int]):
+        if self._shards.get(name, 1) == 1:
+            return self._devices[group[0]]
+        return [self._devices[i] for i in group]
+
+    def _slot_locked(self, name: str, slot: int) -> List[List[int]]:
+        groups = self._owners.get(name)
+        if groups is None:
             raise ValueError(f"no placement recorded for model {name!r}")
-        if not 0 <= int(slot) < len(idxs):
-            raise ValueError(f"model {name!r} has {len(idxs)} placed "
+        if not 0 <= int(slot) < len(groups):
+            raise ValueError(f"model {name!r} has {len(groups)} placed "
                              f"slot(s); slot {slot} does not exist")
-        return idxs
+        return groups
 
     def describe(self) -> Dict[str, object]:
         """JSON-ready placement snapshot for stats()/CLI: per-device
         residency plus the model -> device map (and any breaker-evicted
-        slots awaiting respawn)."""
+        slots awaiting respawn).  Sharded models report each slot as a
+        device list under "models" plus their slice width under
+        "shards"; unsharded ones keep the flat historical shape."""
         with self._lock:
+            models: Dict[str, object] = {}
+            for name, groups in sorted(self._owners.items()):
+                if self._shards.get(name, 1) == 1:
+                    models[name] = [str(self._devices[g[0]])
+                                    for g in groups]
+                else:
+                    models[name] = [[str(self._devices[i]) for i in g]
+                                    for g in groups]
             out = {
                 "devices": [str(d) for d in self._devices],
                 "load": list(self._load),
-                "models": {name: [str(self._devices[i]) for i in idxs]
-                           for name, idxs in sorted(self._owners.items())},
+                "models": models,
             }
+            shards = {name: s for name, s in sorted(self._shards.items())
+                      if s > 1}
+            if shards:
+                out["shards"] = shards
             evicted = {name: sorted(slots)
                        for name, slots in sorted(self._evicted.items())
                        if slots}

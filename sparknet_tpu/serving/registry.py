@@ -106,7 +106,8 @@ class ModelRegistry:
              max_batch: int = 8, seed: int = 0, device=None,
              devices: Optional[Sequence] = None,
              warmup: bool = True, quant: Optional[str] = None,
-             quant_min_agreement: Optional[float] = None) -> LoadedModel:
+             quant_min_agreement: Optional[float] = None,
+             shards: int = 1) -> LoadedModel:
         """Build, (optionally) warm, and register a model under `name`.
         `spec` defaults to `name` (zoo entry or prototxt path).
         `devices` (a list) builds one replica per entry — the master on
@@ -116,16 +117,31 @@ class ModelRegistry:
         use reload() to rebuild in place with a bumped generation.
         `quant` selects the serving forward's numeric mode
         (serving/quant.py: fp32/bf16/int8); the kwargs are recorded, so
-        reload() rebuilds AND recalibrates the same quantized form."""
+        reload() rebuilds AND recalibrates the same quantized form.
+        `shards` > 1 makes every replica a mesh SLICE: each `devices`
+        entry (and `device`) must then be a list of exactly `shards`
+        devices, and runners build on the engine's sharded exec path —
+        recorded with the other kwargs so reload() and rebuild_replica()
+        re-shard identically."""
         spec = spec if spec is not None else name
         if device is not None and devices is not None:
             raise ValueError("pass device= (single replica) or devices= "
                              "(replica set), not both")
         if devices is not None and not list(devices):
             raise ValueError("devices= must be a non-empty list")
+        if int(shards) > 1:
+            slots = (list(devices) if devices is not None
+                     else ([device] if device is not None else []))
+            for d in slots:
+                if not isinstance(d, (list, tuple)):
+                    raise ValueError(
+                        f"shards={int(shards)} needs a device SLICE "
+                        f"(list of {int(shards)} devices) per replica "
+                        f"slot, got {d!r}")
         kwargs = {"buckets": buckets, "max_batch": max_batch,
                   "seed": seed, "quant": quant,
-                  "quant_min_agreement": quant_min_agreement}
+                  "quant_min_agreement": quant_min_agreement,
+                  "shards": int(shards)}
         dev0 = list(devices)[0] if devices is not None else device
         master = ModelRunner(
             resolve_net_param(spec, max_batch=max_batch),
@@ -186,8 +202,10 @@ class ModelRegistry:
                     f"model {name!r} has {len(lm.replicas)} replica(s); "
                     f"slot {idx} does not exist")
             master = lm.replicas[0]
+            rep = lm.replicas[idx]
             device = (lm.devices[idx] if lm.devices is not None
-                      else lm.replicas[idx].device)
+                      else (rep.slice_devices if rep.shards > 1
+                            else rep.device))
         # built OUTSIDE the swap lock: replicate() device_puts params
         # and warmup() compiles — replica_snapshot holds the lock on
         # every dispatch and must never stall behind a rebuild
@@ -229,7 +247,10 @@ class ModelRegistry:
             if lm.pre_swap_total_ms is not None:
                 snap["pre_swap_total_ms"] = lm.pre_swap_total_ms
             if lm.devices is not None:
-                snap["devices"] = [str(d) for d in lm.devices]
+                # a sharded replica's slot is a device LIST (its slice)
+                snap["devices"] = [
+                    [str(x) for x in d] if isinstance(d, (list, tuple))
+                    else str(d) for d in lm.devices]
             snap.update({f"engine_{k}": v
                          for k, v in lm.runner.describe().items()})
             out[lm.name] = snap

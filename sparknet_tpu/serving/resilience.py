@@ -116,6 +116,16 @@ def _env_int(name: str, default: int) -> int:
         raise ValueError(f"{name}={raw!r} is not an int")
 
 
+def _devstr(device):
+    """Event-field rendering for a placement: one device -> its str; a
+    sharded replica's mesh slice (a device list) -> the list of strs."""
+    if device is None:
+        return None
+    if isinstance(device, (list, tuple)):
+        return [str(d) for d in device]
+    return str(device)
+
+
 # --------------------------------------------------------------- fault plan
 @dataclasses.dataclass(frozen=True)
 class ServeFaultPlan:
@@ -527,8 +537,7 @@ class ResilienceManager:
         with self._mu:
             trips = self._breakers[replica].trips
         self._event("replica_open", replica=replica, trips=trips,
-                    requeued=len(drained),
-                    device=str(device) if device is not None else None)
+                    requeued=len(drained), device=_devstr(device))
 
     # ------------------------------------------------------------ shedding
     def should_shed_batch(self, queued_total: int,
@@ -628,8 +637,7 @@ class ResilienceManager:
             self._respawns += 1
             incarnation = self._incarnation[i]
         self._event("replica_respawn", replica=i,
-                    incarnation=incarnation,
-                    device=str(device) if device is not None else None)
+                    incarnation=incarnation, device=_devstr(device))
         return True
 
     def _probe_cycle(self, i: int) -> None:

@@ -48,7 +48,8 @@ from ..obs.trace import now_s, span
 from .buckets import pad_to_bucket, pick_bucket
 from .errors import (DeadlineExceeded, RequestShed, ServerClosed,
                      ServerOverloaded, ServingError)
-from .placement import DevicePlacer, resolve_replica_count
+from .placement import (DevicePlacer, resolve_replica_count,
+                        resolve_shard_count)
 from .registry import LoadedModel, ModelRegistry
 from .resilience import PRIORITIES, ResilienceConfig, ResilienceManager
 from .scheduler import ReplicaScheduler, SchedulerClosed, SchedulerFull
@@ -198,18 +199,40 @@ class InferenceServer:
              seed: int = 0, device=None, warmup: bool = True,
              quant: Optional[str] = None,
              quant_min_agreement: Optional[float] = None,
-             replicas: Optional[int] = None) -> LoadedModel:
+             replicas: Optional[int] = None,
+             shards: Optional[int] = None) -> LoadedModel:
         """Load + warm a model and start its scheduler.  `replicas`
         (default SPARKNET_SERVE_REPLICAS, normally 1; 0 = one per
         device) places that many replicas least-loaded-first across the
         device mesh; `device` pins the single-replica case explicitly
-        (mutually exclusive with replicas > 1).  The bucket ladder
-        defaults to powers of two up to config.max_batch."""
+        (mutually exclusive with replicas > 1).  `shards` (default
+        SPARKNET_SERVE_SHARDS, normally 1) makes each replica a mesh
+        SLICE of that many devices running the engine's sharded exec
+        path — placement always goes through the placer then (replicas=0
+        means one replica per slice, saturating the pool), and `device`
+        pinning is rejected.  The bucket ladder defaults to powers of
+        two up to config.max_batch."""
         if not self._accepting:
             raise ServerClosed("server is shutting down")
         n_rep = resolve_replica_count(replicas, None)
+        n_shards = resolve_shard_count(shards)
         devices = None
-        if n_rep != 1:
+        if n_shards > 1:
+            if device is not None:
+                raise ValueError("pass device= (single unsharded "
+                                 "replica) or shards= (sliced mesh "
+                                 "placement), not both")
+            placer = self._get_placer()
+            if n_rep == 0:
+                if len(placer) % n_shards != 0:
+                    raise ValueError(
+                        f"shards={n_shards} does not divide the "
+                        f"{len(placer)}-device pool; sharded replicas "
+                        f"need an exact tiling")
+                n_rep = len(placer) // n_shards
+            devices = placer.place(name, n_rep,
+                                   shards_per_replica=n_shards)
+        elif n_rep != 1:
             if device is not None:
                 raise ValueError("pass device= (single replica) or "
                                  "replicas= (mesh placement), not both")
@@ -222,7 +245,8 @@ class InferenceServer:
                 name, spec, weights=weights, buckets=buckets,
                 max_batch=self.config.max_batch, seed=seed,
                 device=device, devices=devices, warmup=warmup,
-                quant=quant, quant_min_agreement=quant_min_agreement)
+                quant=quant, quant_min_agreement=quant_min_agreement,
+                shards=n_shards)
         except Exception:
             if devices is not None:
                 self._get_placer().release(name)

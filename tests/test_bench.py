@@ -275,6 +275,34 @@ def test_bench_serving_mesh_leg_cpu():
     assert "serving_mesh" in bench._KNOWN_LEGS
 
 
+def test_bench_serving_sharded_leg_cpu():
+    """The serving_sharded leg (schema v8: interleaved A/B — one gspmd
+    slice replica vs one single-device replica) must stay runnable on
+    the CPU mesh and land its two hard bars: bucket-1 bitwise agreement
+    between the arms and ZERO post-warmup recompiles of the sharded
+    program."""
+    import jax
+    import pytest
+
+    import bench
+
+    if len(jax.devices()) < 2:
+        pytest.skip("needs >= 2 local devices")
+    r = bench.bench_serving_sharded(n_requests=32, shards=2, rounds=2)
+    assert r["serving_sharded_model"] == "lenet"
+    assert r["serving_sharded_shards"] == 2
+    assert r["serving_sharded_rounds"] == 2
+    assert r["serving_sharded_qps"] > 0
+    assert r["serving_sharded_single_qps"] > 0
+    assert r["serving_sharded_ratio"] > 0
+    assert r["serving_sharded_p99_ms"] >= r["serving_sharded_p50_ms"]
+    assert r["serving_sharded_topology"].split("x", 1)[0].isdigit()
+    assert r["serving_sharded_bitwise"] is True
+    assert r["serving_sharded_post_warmup_compiles"] == 0
+    assert set(r) <= bench._KNOWN_FIELDS
+    assert "serving_sharded" in bench._KNOWN_LEGS
+
+
 def test_persist_leg_incremental_contract(tmp_path, monkeypatch):
     """Per-leg last-good persistence (VERDICT r4 item 1): each completed
     leg merges immediately; a partial record still carries the contract
@@ -410,7 +438,7 @@ def test_bench_trainserve_leg_contract(monkeypatch):
 
     import bench
 
-    assert bench.BENCH_SCHEMA_VERSION == 7
+    assert bench.BENCH_SCHEMA_VERSION == 8
     canned = {"ok": True, "model": "lenet", "promotions": 2,
               "rejections": 1, "staleness_mean": 0.6, "staleness_max": 1.0,
               "swap_p99_delta_ms": 3.25, "dropped": 0, "completed": 132,

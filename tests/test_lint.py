@@ -802,6 +802,10 @@ def test_lint_gate_script(tmp_path):
     # chaos-marked tests in tests/test_serving_resilience.py)
     assert "serve_chaos_run.py --smoke" in text
     assert "SPARKNET_LINT_GATE_NO_SERVECHAOS" in text
+    # ... and the sharded-serving contract leg (exercised live by
+    # tests/test_serving_sharded.py's contract-census test)
+    assert "--jaxpr serve-sharded" in text
+    assert "SPARKNET_LINT_GATE_NO_SHARDED" in text
     clean = _mkpkg(tmp_path, {"ok.py": "x = 1\n"})
     dirty_dir = tmp_path / "dirty"
     dirty_dir.mkdir()
@@ -810,7 +814,8 @@ def test_lint_gate_script(tmp_path):
                SPARKNET_LINT_GATE_NO_PROC="1",
                SPARKNET_LINT_GATE_NO_CONTRACT="1",
                SPARKNET_LINT_GATE_NO_TRAINSERVE="1",
-               SPARKNET_LINT_GATE_NO_SERVECHAOS="1")
+               SPARKNET_LINT_GATE_NO_SERVECHAOS="1",
+               SPARKNET_LINT_GATE_NO_SHARDED="1")
     rc_clean = subprocess.run(
         ["bash", gate, clean, "--select", "R001"],
         cwd=REPO, env=env, capture_output=True, text=True)

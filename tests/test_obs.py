@@ -427,8 +427,8 @@ def test_bench_stamp_provenance():
 
     payload = {"metric": "x", "value": 1.0}
     out = bench._stamp(payload)
-    # v7: precision + effective fused-blocks stamps on the e2e legs
-    assert out["schema_version"] == bench.BENCH_SCHEMA_VERSION == 7
+    # v8: the serving_sharded A/B leg (bitwise + zero-recompile bars)
+    assert out["schema_version"] == bench.BENCH_SCHEMA_VERSION == 8
     assert "git_sha" in out and "env" in out
     assert all(k.startswith("SPARKNET_") for k in out["env"])
     assert out["value"] == 1.0

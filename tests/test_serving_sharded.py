@@ -1,0 +1,464 @@
+"""Sharded serving invariants (README "Sharded serving"): a replica is
+a gspmd mesh SLICE — params live sharded over the slice devices and
+all-gather at use inside the jitted forward — and the whole point of
+that design is that nothing about the math may move: a sharded replica
+answers BITWISE-identically to a single-device one (fp32 and int8, all
+buckets), with zero post-warmup recompiles, exactly-once semantics
+across reload-under-traffic, and the PR-15 resilience control plane
+composing unchanged (a tripped sharded replica drains/requeues, rebuilds
+on the SAME device slice, and re-admits through half-open probes).
+
+The placer's slot algebra generalizes from device to slice (least-loaded
+counts slices, non-dividing shard counts die loudly at load), and the
+sharded forward's communication schedule is a committed CONTRACTS.json
+entry censused from compiled HLO (ANALYSIS.md "Sharded serving
+contracts") — all pinned here.
+"""
+
+import json
+import os
+import threading
+import time
+
+import jax
+import numpy as np
+import pytest
+
+from sparknet_tpu.serving import (InferenceServer, ServerConfig,
+                                  pad_to_bucket, resolve_shard_count)
+from sparknet_tpu.serving.engine import ModelRunner, resolve_net_param
+from sparknet_tpu.serving.placement import (SHARDS_ENV, DevicePlacer)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+LENET_SHAPE = (1, 28, 28)
+SHARDS = 4
+
+needs_mesh = pytest.mark.skipif(
+    len(jax.devices()) < 8,
+    reason="needs the 8-device CPU mesh "
+           "(XLA_FLAGS=--xla_force_host_platform_device_count=8)")
+
+
+def _samples(n, seed=0, shape=LENET_SHAPE):
+    return np.random.RandomState(seed).rand(n, *shape).astype(np.float32)
+
+
+# ----------------------------------------------------------- knob
+
+
+def test_resolve_shard_count_env_and_errors(monkeypatch):
+    monkeypatch.delenv(SHARDS_ENV, raising=False)
+    assert resolve_shard_count(None) == 1      # unsharded default
+    monkeypatch.setenv(SHARDS_ENV, "4")
+    assert resolve_shard_count(None) == 4
+    assert resolve_shard_count(2) == 2         # explicit wins over env
+    monkeypatch.setenv(SHARDS_ENV, "lots")
+    with pytest.raises(ValueError, match=SHARDS_ENV):
+        resolve_shard_count(None)
+    for bad in (0, -1):
+        with pytest.raises(ValueError, match=">= 1"):
+            resolve_shard_count(bad)
+
+
+# ---------------------------------------------------------- placer
+
+
+def test_placer_non_dividing_shards_is_a_load_error():
+    p = DevicePlacer([f"dev{i}" for i in range(8)])
+    with pytest.raises(ValueError, match="does not divide"):
+        p.place("m", 1, shards_per_replica=3)
+    # and through the server it is a LOAD error, not a crash later
+    server = InferenceServer(ServerConfig(max_batch=4))
+    try:
+        with pytest.raises(ValueError, match="does not divide"):
+            server.load("lenet", shards=3)
+    finally:
+        server.close(drain=True)
+
+
+def test_placer_least_loaded_counts_slices_not_devices():
+    p = DevicePlacer([f"dev{i}" for i in range(8)])
+    # slices are contiguous aligned groups; emptiest-slice first with
+    # deterministic group-index tie-breaks
+    assert p.place("a", 1, shards_per_replica=4) == \
+        [["dev0", "dev1", "dev2", "dev3"]]
+    assert p.place("b", 1, shards_per_replica=4) == \
+        [["dev4", "dev5", "dev6", "dev7"]]
+    # both slices carry one replica: the tie breaks back to slice 0
+    assert p.place("c", 1, shards_per_replica=4) == \
+        [["dev0", "dev1", "dev2", "dev3"]]
+    assert p.describe()["load"] == [2, 2, 2, 2, 1, 1, 1, 1]
+    # a 2-wide model sees 4 slices and spreads over the emptiest ones
+    # (slice load is the SUM of member loads, not any single device's)
+    assert p.place("d", 2, shards_per_replica=2) == \
+        [["dev4", "dev5"], ["dev6", "dev7"]]
+    d = p.describe()
+    assert d["shards"] == {"a": 4, "b": 4, "c": 4, "d": 2}
+    assert d["models"]["d"] == [["dev4", "dev5"], ["dev6", "dev7"]]
+    # unsharded placement keeps the flat historical shape
+    p2 = DevicePlacer(["x", "y"])
+    p2.place("flat", 1)
+    assert p2.describe()["models"]["flat"] == ["x"]
+    assert "shards" not in p2.describe()
+
+
+def test_placer_evict_respawn_restores_the_same_slice():
+    p = DevicePlacer([f"dev{i}" for i in range(8)])
+    placed = p.place("m", 2, shards_per_replica=4)
+    dev = p.evict("m", 1)
+    assert dev == placed[1] == ["dev4", "dev5", "dev6", "dev7"]
+    # the WHOLE slice gave its residency back
+    assert p.describe()["load"] == [1, 1, 1, 1, 0, 0, 0, 0]
+    with pytest.raises(ValueError, match="already evicted"):
+        p.evict("m", 1)
+    assert p.respawn("m", 1) == placed[1]      # SAME device set
+    assert p.describe()["load"] == [1] * 8
+    # release with an outstanding eviction stays consistent
+    p.evict("m", 0)
+    p.release("m")
+    assert p.describe()["load"] == [0] * 8
+
+
+# ------------------------------------------------- sharded ModelRunner
+
+
+@pytest.fixture(scope="module")
+def runner_pair():
+    """One unsharded oracle + one 4-shard runner on the first mesh
+    slice, small bucket ladder so module compile cost stays bounded."""
+    if len(jax.devices()) < 8:
+        pytest.skip("needs the 8-device CPU mesh")
+    net = resolve_net_param("lenet", max_batch=4)
+    ref = ModelRunner(net, max_batch=4)
+    shr = ModelRunner(net, max_batch=4, shards=SHARDS,
+                      device=jax.devices()[:SHARDS])
+    return ref, shr
+
+
+@needs_mesh
+def test_sharded_forward_bitwise_vs_single_all_buckets(runner_pair):
+    """THE acceptance bar: the gather-at-use sharded forward is a pure
+    concatenation of the master params, so every bucket's output is
+    bitwise equal to the single-device program — not close, EQUAL."""
+    ref, shr = runner_pair
+    assert shr.shards == SHARDS
+    assert shr.buckets == ref.buckets
+    # the big lenet blobs really live sharded (1/4 per device)
+    assert "ip1/0" in shr.tp_sharded_params()
+    assert shr.params["ip1/0"].sharding.shard_shape((500, 800)) \
+        == (500 // SHARDS, 800)
+    for bucket in ref.buckets:
+        x = _samples(bucket, seed=bucket)
+        np.testing.assert_array_equal(
+            np.asarray(ref.forward_padded(x)),
+            np.asarray(shr.forward_padded(x)),
+            err_msg=f"bucket {bucket} drifted")
+
+
+@needs_mesh
+def test_sharded_zero_post_warmup_compiles(runner_pair):
+    ref, shr = runner_pair
+    warmed = shr.compile_count()
+    assert warmed == len(shr.buckets)
+    for i in range(12):
+        b = shr.buckets[i % len(shr.buckets)]
+        shr.forward_padded(_samples(b, seed=100 + i))
+    assert shr.compile_count() == warmed
+
+
+@needs_mesh
+def test_sharded_replicate_onto_other_slice_bitwise(runner_pair):
+    """replicate() onto the SECOND mesh slice re-shards from the master
+    host params — same math, different devices."""
+    ref, shr = runner_pair
+    clone = shr.replicate(jax.devices()[4:8])
+    assert clone.shards == SHARDS
+    assert [str(d) for d in clone.slice_devices] == \
+        [str(d) for d in jax.devices()[4:8]]
+    x = _samples(4, seed=9)
+    np.testing.assert_array_equal(np.asarray(ref.forward_padded(x)),
+                                  np.asarray(clone.forward_padded(x)))
+
+
+@needs_mesh
+def test_sharded_int8_bitwise_and_packed_gather():
+    """int8 composes with sharding: the PACKED weights shard (so the
+    cross-slice gather moves int8 — 4x smaller than fp32), dequant runs
+    after the gather, and the result is bitwise equal to single-device
+    int8 serving at the same agreement."""
+    net = resolve_net_param("lenet", max_batch=2)
+    ref = ModelRunner(net, max_batch=2, quant="int8")
+    shr = ModelRunner(net, max_batch=2, quant="int8", shards=SHARDS,
+                      device=jax.devices()[:SHARDS])
+    assert shr.quant_agreement == ref.quant_agreement
+    q = shr._exec_params["ip1/0"]["q"]
+    assert q.dtype == np.int8
+    # the int8 blob itself is what lives sharded at rest
+    assert q.sharding.shard_shape(q.shape) == (500 // SHARDS, 800)
+    for bucket in ref.buckets:
+        x = _samples(bucket, seed=20 + bucket)
+        np.testing.assert_array_equal(
+            np.asarray(ref.forward_padded(x)),
+            np.asarray(shr.forward_padded(x)),
+            err_msg=f"int8 bucket {bucket} drifted")
+
+
+@needs_mesh
+def test_sharded_runner_describe_and_slice_validation():
+    net = resolve_net_param("lenet", max_batch=2)
+    with pytest.raises(ValueError, match="device_count"):
+        ModelRunner(net, max_batch=2, shards=SHARDS,
+                    device=jax.devices()[:2])   # slice width mismatch
+    shr = ModelRunner(net, max_batch=2, shards=SHARDS,
+                      device=jax.devices()[:SHARDS])
+    d = shr.describe()
+    assert d["shards"] == SHARDS
+    assert len(d["slice_devices"]) == SHARDS
+    assert "ip1/0" in d["tp_params"]
+    # unsharded runners keep the flat historical shape
+    flat = ModelRunner(net, max_batch=2)
+    assert flat.describe()["shards"] == 1
+    assert "slice_devices" not in flat.describe()
+
+
+# ------------------------------------------------------ server stack
+
+
+@pytest.fixture(scope="module")
+def sharded_server():
+    """2 replicas x 4 shards over the 8-device mesh, single bucket to
+    bound compile time; module-scoped like test_serving's mesh_server."""
+    if len(jax.devices()) < 8:
+        pytest.skip("needs the 8-device CPU mesh")
+    server = InferenceServer(ServerConfig(max_batch=4, max_wait_ms=2.0,
+                                          queue_depth=64))
+    lm = server.load("lenet", replicas=0, shards=SHARDS)
+    yield server, lm
+    server.close(drain=True)
+
+
+@needs_mesh
+def test_server_replicas0_means_one_replica_per_slice(sharded_server):
+    server, lm = sharded_server
+    assert lm.n_replicas == len(jax.devices()) // SHARDS  # = 2 slices
+    assert all(r.shards == SHARDS for r in lm.replicas)
+    slices = [[str(d) for d in r.slice_devices] for r in lm.replicas]
+    assert slices[0] != slices[1]              # distinct slices
+    assert len({d for s in slices for d in s}) == 8   # full mesh, once
+
+
+@needs_mesh
+def test_server_sharded_parity_bitwise_across_replicas(sharded_server):
+    """Every served response is bitwise equal to the unsharded direct
+    forward at its recorded bucket — across BOTH slice replicas."""
+    server, lm = sharded_server
+    oracle = ModelRunner(resolve_net_param("lenet", max_batch=4),
+                         max_batch=4)
+    xs = _samples(24, seed=31)
+    futs = server.submit_many("lenet", xs, wait=True)
+    for i, f in enumerate(futs):
+        r = f.result(timeout=60)
+        np.testing.assert_array_equal(
+            np.asarray(r.probs),
+            oracle.forward_padded(
+                pad_to_bucket(xs[i][None], r.bucket))[0],
+            err_msg=f"request {i}")
+    # both replicas took work
+    reps = server.stats()["models"]["lenet"]["replicas"]
+    assert sum(1 for v in reps.values() if v["dispatches"] > 0) == 2
+
+
+@needs_mesh
+def test_server_stats_expose_shards_and_slice_devices(sharded_server):
+    server, lm = sharded_server
+    m = server.stats()["models"]["lenet"]
+    assert m["engine_shards"] == SHARDS
+    assert len(m["engine_slice_devices"]) == SHARDS
+    # registry devices snapshot is a list of device LISTS
+    assert all(isinstance(d, list) and len(d) == SHARDS
+               for d in m["devices"])
+    placement = server.stats()["placement"]
+    assert placement["shards"] == {"lenet": SHARDS}
+    assert all(isinstance(s, list) for s in placement["models"]["lenet"])
+
+
+@needs_mesh
+def test_sharded_reload_under_traffic_exactly_once():
+    """Generation swaps of SLICED replicas under live traffic: every
+    admitted request resolves exactly once, bitwise under ITS
+    generation's params — the registry swap path never mixes
+    generations across slices."""
+    server = InferenceServer(ServerConfig(max_batch=4, queue_depth=128))
+    xs = _samples(16, seed=43)
+    stop = threading.Event()
+    results, errors = [], []
+    try:
+        lm = server.load("lenet", buckets=[4], replicas=2, shards=SHARDS)
+        runners = {lm.generation: lm.runner}
+
+        def traffic():
+            i = 0
+            while not stop.is_set() and len(results) < 4000:
+                try:
+                    fut = server.submit("lenet", xs[i % len(xs)],
+                                        wait=True, wait_timeout_s=10)
+                except Exception as e:          # pragma: no cover
+                    errors.append(e)
+                    return
+                results.append((i % len(xs), fut))
+                i += 1
+                time.sleep(0.005)
+
+        threads = [threading.Thread(target=traffic, daemon=True)
+                   for _ in range(3)]
+        for t in threads:
+            t.start()
+        for _ in range(2):
+            time.sleep(0.05)
+            server.reload("lenet")              # re-shards identically
+            runners[lm.generation] = lm.runner
+        time.sleep(0.05)
+        stop.set()
+        for t in threads:
+            t.join(timeout=60)
+        server.drain()
+    finally:
+        stop.set()
+        server.close(drain=True)
+    assert not errors
+    assert len(results) > 20
+    gens_seen = set()
+    for sample_i, fut in results:
+        r = fut.result(timeout=60)              # exactly once
+        assert r.generation in runners
+        gens_seen.add(r.generation)
+        np.testing.assert_array_equal(
+            np.asarray(r.probs),
+            np.asarray(runners[r.generation].forward_padded(
+                pad_to_bucket(xs[sample_i][None], r.bucket))[0]),
+            err_msg=f"generation {r.generation} mixed params")
+    assert len(gens_seen) > 1
+
+
+@needs_mesh
+def test_breaker_trip_on_sharded_replica_rebuilds_the_slice(tmp_path):
+    """PR-15 composition: an error storm on sharded replica 0 trips its
+    breaker (drain + requeue, exactly-once), the rebuild lands on the
+    SAME 4-device slice with bitwise math, and half-open probes
+    re-admit it — the event stream carrying the slice as a device
+    LIST."""
+    from sparknet_tpu.serving import ResilienceConfig, ServeFaultPlan
+
+    plan = ServeFaultPlan.from_spec("errstorm:0@0+6", seed=3)
+    rcfg = ResilienceConfig(cooldown_s=0.1, tick_s=0.01,
+                            half_open_probes=2, fault_plan=plan,
+                            event_log=str(tmp_path / "events.jsonl"))
+    server = InferenceServer(ServerConfig(max_batch=4, max_wait_ms=2.0,
+                                          queue_depth=64,
+                                          resilience=rcfg))
+    try:
+        lm = server.load("lenet", buckets=[4], replicas=2, shards=SHARDS)
+        slice0 = [str(d) for d in lm.replicas[0].slice_devices]
+        mgr = server.resilience("lenet")
+        xs = _samples(24, seed=11)
+        futs = []
+        for i in range(24):
+            futs.append(server.submit("lenet", xs[i]))
+            time.sleep(0.004)
+        rs = [f.result(timeout=60) for f in futs]   # exactly-once
+        assert len(rs) == 24
+        assert {r.generation for r in rs} == {0}
+        for i in (0, 11, 23):
+            np.testing.assert_array_equal(
+                np.asarray(rs[i].probs),
+                np.asarray(lm.runner.forward_padded(
+                    pad_to_bucket(xs[i][None], rs[i].bucket))[0]))
+        deadline = time.perf_counter() + 20.0
+        while not mgr.all_closed() and time.perf_counter() < deadline:
+            time.sleep(0.02)
+        snap = mgr.snapshot()
+        assert snap["trips"] >= 1 and snap["respawns"] >= 1
+        assert snap["breakers"] == {"0": "closed", "1": "closed"}
+        # the rebuilt replica sits on the SAME slice, still 4-sharded,
+        # and answers bitwise
+        assert lm.replicas[0].shards == SHARDS
+        assert [str(d) for d in lm.replicas[0].slice_devices] == slice0
+        np.testing.assert_array_equal(
+            np.asarray(lm.replicas[0].forward_padded(
+                pad_to_bucket(xs[0][None], 4))),
+            np.asarray(lm.runner.forward_padded(
+                pad_to_bucket(xs[0][None], 4))))
+        # events stamp the slice as a device list
+        events = [json.loads(line)
+                  for line in open(rcfg.event_log)]
+        opens = [e for e in events if e["kind"] == "replica_open"]
+        spawns = [e for e in events if e["kind"] == "replica_respawn"]
+        assert opens and spawns
+        assert opens[0]["device"] == slice0
+        assert spawns[0]["device"] == slice0
+        assert server.stats()["models"]["lenet"]["failed"] == 0
+    finally:
+        server.close(drain=True)
+
+
+# -------------------------------------------------- program contract
+
+
+@needs_mesh
+def test_sharded_contract_census_matches_committed():
+    """The sharded forward's communication schedule is a committed
+    artifact: the shards=4 CONTRACTS.json entry matches a fresh
+    HLO-censused audit, the key carries the shards suffix, and a
+    perturbed census is DETECTED (the lint --contract exit-1 path)."""
+    from sparknet_tpu.analysis import jaxpr_audit as ja
+
+    rep = ja.audit_serving_forward("lenet", batch=4, shards=SHARDS)
+    key = ja.contract_key(rep)
+    assert key == (f"serving_forward[model=lenet,bucket=1,quant=fp32,"
+                   f"shards={SHARDS}]")
+    contracts = ja.load_contracts(os.path.join(REPO, "CONTRACTS.json"))
+    assert ja.check_contract(rep, contracts) == []
+    # the committed schedule is exactly the two ip1 gathers (weight +
+    # bias), gathered-result volume as the bytes proxy
+    entry = contracts["programs"][key]
+    assert set(entry["collectives"]) == {"all-gather"}
+    assert entry["collectives"]["all-gather"]["count"] == 2
+    assert entry["collectives"]["all-gather"]["bytes"] == \
+        500 * 800 * 4 + 500 * 4
+    assert entry["host_transfers"] == {}
+    # drift detection: a shifted census yields violations
+    drifted = dict(rep)
+    drifted["collectives"] = {"all-gather": {"count": 3,
+                                             "bytes": 999}}
+    assert ja.check_contract(drifted, contracts)
+
+
+@needs_mesh
+def test_audit_serve_sharded_needs_enough_devices():
+    from sparknet_tpu.analysis import jaxpr_audit as ja
+
+    with pytest.raises(RuntimeError, match="device_count"):
+        ja.audit_serving_forward("lenet", batch=4,
+                                 shards=2 * len(jax.devices()))
+
+
+def test_hlo_collective_census_parses_ops_and_bytes():
+    """Pure-text unit pin for the census regex: definitions count,
+    operand references and -done halves do not, bytes come from the
+    result shape token."""
+    from sparknet_tpu.analysis.jaxpr_audit import hlo_collective_census
+
+    hlo = """
+  %all-gather = f32[500,800]{1,0} all-gather(f32[125,800]{1,0} %p5),
+      replica_groups=[1,4], dimensions={0}
+  %all-gather.1 = f32[500]{0} all-gather(f32[125]{0} %p6), dimensions={0}
+  %fusion = f32[8,500]{1,0} fusion(f32[500,800]{1,0} %all-gather)
+  %ar = bf16[128]{0} all-reduce(bf16[128]{0} %x), to_apply=%add
+  %ag-done = f32[16]{0} all-gather-done(f32[16]{0} %ag-start)
+"""
+    census = hlo_collective_census(hlo)
+    assert census == {
+        "all-gather": {"count": 2, "bytes": 500 * 800 * 4 + 500 * 4},
+        "all-reduce": {"count": 1, "bytes": 128 * 2},
+    }
+    assert hlo_collective_census("no collectives here") == {}
