@@ -732,6 +732,61 @@ def bench_serving_resilience():
     return out
 
 
+def bench_serving_autoscale():
+    """Autoscaling drill via `scripts/autoscale_drill.py --smoke` in a
+    subprocess: diurnal / spike / flash-crowd load phases against a
+    live server with the SLO-driven autoscaler armed over a 3-slot
+    pool — the record carries scale-up/scale-down counts, the converged
+    per-phase p99 band, the errstorm doom-loop bar (breaker trips with
+    ZERO scale-ups during the outage), and the exactly-once bar
+    (dropped must be 0 or the leg raises; the smoke itself also asserts
+    the floor, placer-routed scale-ups, and bitwise policy-schedule
+    replay).
+
+    A subprocess for a clean CPU backend and because the smoke's exit
+    code IS the pass/fail signal; re-raises on a non-zero exit or a
+    not-ok line so the guarded leg in _run_legs omits the fields."""
+    import subprocess
+
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+    script = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "scripts", "autoscale_drill.py")
+    proc = subprocess.run(
+        [sys.executable, script, "--smoke"],
+        capture_output=True, text=True, env=env, timeout=900)
+    if proc.returncode != 0:
+        raise RuntimeError(
+            f"autoscale_drill.py exited {proc.returncode}: "
+            f"{proc.stderr.strip()[-500:]}")
+    # autoscale_drill prints ONE JSON line on stdout (chaos_run contract)
+    rec = json.loads(proc.stdout.strip().splitlines()[-1])
+    if not rec.get("ok"):
+        raise RuntimeError(f"autoscale_drill.py reported not-ok: {rec}")
+    if rec.get("dropped"):
+        raise RuntimeError(
+            f"autoscale drill dropped {rec['dropped']} requests (every "
+            f"request must be answered exactly once): {rec}")
+    out = {"serving_autoscale_pool": int(rec["pool"]),
+           "serving_autoscale_ups": int(rec["ups"]),
+           "serving_autoscale_downs": int(rec["downs"]),
+           "serving_autoscale_min_active": int(rec["min_active"]),
+           "serving_autoscale_max_active": int(rec["max_active"]),
+           "serving_autoscale_dropped": int(rec["dropped"]),
+           "serving_autoscale_completed": int(rec["completed"]),
+           "serving_autoscale_tail_p99_ms": max(
+               p["tail_p99_ms"] for p in rec["phases"]),
+           "serving_autoscale_storm_trips": int(
+               rec["storm"]["breaker_trips"]),
+           "serving_autoscale_storm_ups_during_outage": int(
+               rec["storm"]["ups_during_outage"]),
+           "serving_autoscale_replay_bitwise": bool(
+               rec["replay_bitwise"])}
+    log(json.dumps(out))
+    return out
+
+
 def bench_longctx_lm(seq_len: int = 16384, n_layers: int = 4,
                      d_model: int = 512, heads: int = 8,
                      block: int = 1024):
@@ -1056,6 +1111,15 @@ _KNOWN_FIELDS = {
     "serving_resilience_recovery_s",
     "serving_resilience_interactive_p99_ms",
     "serving_resilience_replay_bitwise",
+    # serving autoscale drill (schema v9): shaped load grows/shrinks
+    # the replica set through the placer; errstorm doom-loop bar
+    "serving_autoscale_pool", "serving_autoscale_ups",
+    "serving_autoscale_downs", "serving_autoscale_min_active",
+    "serving_autoscale_max_active", "serving_autoscale_dropped",
+    "serving_autoscale_completed", "serving_autoscale_tail_p99_ms",
+    "serving_autoscale_storm_trips",
+    "serving_autoscale_storm_ups_during_outage",
+    "serving_autoscale_replay_bitwise",
 }
 
 # every leg name main() lands; leg_utc stamps outside this set (renamed
@@ -1066,6 +1130,7 @@ _KNOWN_LEGS = {
     "alexnet_infer", "googlenet_infer", "longctx_lm", "cifar_e2e",
     "imagenet_native", "serving", "serving_int8", "serving_mesh",
     "serving_sharded", "elastic", "trainserve", "serving_resilience",
+    "serving_autoscale",
 }
 
 
@@ -1148,7 +1213,13 @@ def _stale_record(reason: str) -> dict:
     return stale
 
 
-BENCH_SCHEMA_VERSION = 8  # v8: serving_sharded leg (gspmd slice replica
+BENCH_SCHEMA_VERSION = 9  # v9: serving_autoscale leg (autoscaling
+#                           drill — scale-up/down counts through the
+#                           placer, converged tail p99, errstorm
+#                           doom-loop bar (zero ups during the outage),
+#                           dropped==0 bar, bitwise policy replay;
+#                           autoscale_drill.py subprocess);
+#                           v8: serving_sharded leg (gspmd slice replica
 #                           vs single-device A/B — serving_sharded_*
 #                           QPS/latency, ratio, bitwise bar,
 #                           post-warmup-compiles==0 bar);
@@ -1540,6 +1611,23 @@ def _run_legs(land) -> None:
             "serving_resilience_recovery_s",
             "serving_resilience_interactive_p99_ms",
             "serving_resilience_replay_bitwise")})
+    # autoscaling drill (subprocess; CPU path) — the replica set grows
+    # and shrinks through the placer, errstorm suppression, zero-drop
+    # and bitwise-replay bars
+    try:
+        autoscale = bench_serving_autoscale()
+    except Exception as e:
+        log(f"serving_autoscale leg failed, omitting its fields: {e!r}")
+    else:
+        land("serving_autoscale", {k: autoscale[k] for k in (
+            "serving_autoscale_pool", "serving_autoscale_ups",
+            "serving_autoscale_downs", "serving_autoscale_min_active",
+            "serving_autoscale_max_active", "serving_autoscale_dropped",
+            "serving_autoscale_completed",
+            "serving_autoscale_tail_p99_ms",
+            "serving_autoscale_storm_trips",
+            "serving_autoscale_storm_ups_during_outage",
+            "serving_autoscale_replay_bitwise")})
     try:
         imgnet_native = bench_imagenet_native()
     except Exception as e:

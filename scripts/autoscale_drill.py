@@ -1,0 +1,501 @@
+"""Autoscaling drill: shaped load against a live InferenceServer with
+the SLO-driven autoscaler armed, printing ONE JSON line (the bench.py
+`serving_autoscale` leg subprocess protocol — same contract as
+serve_chaos_run.py / chaos_run.py).
+
+Two servers, four load phases:
+
+- **Scaling server** (pool of `--pool` warmed slots, autoscaler floor
+  1, initial 1; every dispatch carries a seeded latency spike so one
+  replica's service capacity is deterministically below peak offered
+  load on CPU): a diurnal swing, a mid-phase spike, and a flash-crowd
+  step run back to back.  Each overload phase must grow the active
+  replica set THROUGH the placer (scale_up events carry the new
+  device), and each quiet tail must shrink it back to the floor
+  (drain -> exactly-once requeue -> evict).
+- **Errstorm server** (the doom-loop case): an error storm on the only
+  active replica trips its breaker under load.  The policy must
+  SUPPRESS every scale-up while a breaker is open (zero scale_up
+  events, >= 1 scale_suppressed), the last-replica guard must respawn
+  the storming slot IN PLACE (replica_open event with in_place=true —
+  capacity never hits zero, submits never hang), and the breaker must
+  recover once the storm expires.
+
+--smoke asserts the acceptance bar and exits non-zero on a miss:
+the replica set grows AND shrinks through the placer; every request is
+answered exactly once with a status (dropped == 0, no re-answers);
+the interactive p99 over the CONVERGED last third of every scaling
+phase stays under the SLO; the active count never violates the
+min_replicas floor (scale_down event stream + min_active both
+checked); the errstorm phase trips a breaker with ZERO scale-ups; and
+the scaling schedule replays bitwise — two independent policy replays
+over independently constructed seeded sensor traces agree on the
+schedule digest, and two same-seed fault-plan constructions agree on
+theirs (determinism over the schedule, serving/resilience.py's
+contract; live event interleavings naturally vary with thread
+timing).
+
+Run:  python scripts/autoscale_drill.py --smoke [--pool 3]
+      [--qps 200] [--seed 7] [--workdir DIR]
+"""
+
+import argparse
+import hashlib
+import json
+import os
+import sys
+import tempfile
+import time
+
+# force the CPU platform BEFORE any backend use; the box's sitecustomize
+# pre-imports jax, so the live-config update is what actually takes
+# effect (tests/conftest.py pattern)
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+# (shape, qps multiplier on --qps, request-count multiplier on
+# --requests): diurnal rides the full sinusoid; spike/flash_crowd run
+# at a base under one-replica capacity and burst past it
+PHASES = (("diurnal", 1.0, 1.0),
+          ("spike", 0.6, 0.8),
+          ("flash_crowd", 0.6, 0.8))
+
+
+def _pct(vals, q):
+    import numpy as np
+
+    if not vals:
+        return 0.0
+    return round(float(np.percentile(np.asarray(vals, np.float64), q)), 3)
+
+
+def _rate_multiplier(shape, progress, factor):
+    """scripts/serve_loadgen.py's deterministic rate profile."""
+    import math
+
+    if shape == "diurnal":
+        return max(0.1, 1.0 + 0.6 * math.sin(2.0 * math.pi * progress))
+    if shape == "spike":
+        return factor if 0.45 <= progress < 0.55 else 1.0
+    if shape == "flash_crowd":
+        return factor if progress >= 0.5 else 1.0
+    return 1.0
+
+
+def _policy_digest(acfg_kwargs, seed, n_ticks, pool):
+    """Combined schedule digest over every drill load shape, from a
+    FRESH config + freshly constructed traces — called twice so the
+    two-run bitwise replay contract is checked end to end."""
+    from sparknet_tpu.serving import (AutoscaleConfig, ScalePolicy,
+                                      synthetic_sensor_trace)
+    from sparknet_tpu.serving.autoscale import LOAD_SHAPES
+
+    cfg = AutoscaleConfig(**acfg_kwargs)
+    h = hashlib.sha256()
+    for shape in LOAD_SHAPES:
+        trace = synthetic_sensor_trace(shape, seed=seed,
+                                       n_ticks=n_ticks,
+                                       slo_ms=cfg.slo_ms)
+        h.update(ScalePolicy.schedule_digest(
+            cfg, trace, initial_active=1, pool=pool).encode())
+        h.update(b"|")
+    return h.hexdigest()
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="autoscale_drill",
+        description="serving autoscaler drill (ONE JSON line on stdout)")
+    ap.add_argument("--smoke", action="store_true",
+                    help="assert the acceptance bar and exit non-zero "
+                         "on a miss")
+    ap.add_argument("--workdir", default=None)
+    ap.add_argument("--model", default="lenet")
+    ap.add_argument("--pool", type=int, default=3,
+                    help="warmed replica slot pool (the autoscaler "
+                         "manages the active subset)")
+    ap.add_argument("--requests", type=int, default=600,
+                    help="requests in the diurnal phase (other phases "
+                         "scale from this)")
+    ap.add_argument("--qps", type=float, default=200.0,
+                    help="diurnal-phase base offered rate")
+    ap.add_argument("--shape_factor", type=float, default=6.0)
+    ap.add_argument("--max_batch", type=int, default=4)
+    ap.add_argument("--queue_depth", type=int, default=48)
+    ap.add_argument("--seed", type=int, default=7)
+    ap.add_argument("--dispatch_ms", type=float, default=25.0,
+                    help="seeded latency spike per dispatch — pins one "
+                         "replica's capacity below peak offered load")
+    ap.add_argument("--slo_ms", type=float, default=2000.0)
+    ap.add_argument("--storm_requests", type=int, default=240)
+    ap.add_argument("--storm_qps", type=float, default=200.0)
+    ap.add_argument("--shrink_timeout_s", type=float, default=30.0)
+    ap.add_argument("--recovery_timeout_s", type=float, default=45.0)
+    ap.add_argument("--replay_ticks", type=int, default=240)
+    a = ap.parse_args(argv)
+
+    import numpy as np
+
+    from sparknet_tpu.serving import (AutoscaleConfig, InferenceServer,
+                                      ResilienceConfig, ServeFaultPlan,
+                                      ServerConfig, ServingError)
+
+    workdir = a.workdir or tempfile.mkdtemp(prefix="sparknet-autoscale-")
+    os.makedirs(workdir, exist_ok=True)
+    event_log = os.path.join(workdir, "scale_events.jsonl")
+
+    def log(msg):
+        print(msg, file=sys.stderr, flush=True)
+
+    # ---- bitwise replay: policy schedule over seeded sensor traces,
+    # computed twice from independent constructions, plus the fault
+    # plan's own digest pair (serve_chaos_run.py's pattern)
+    acfg_kwargs = dict(min_replicas=1, initial_replicas=1,
+                       up_queue_fraction=0.4, down_queue_fraction=0.1,
+                       up_ticks=2, down_ticks=4, cooldown_ticks=4,
+                       slo_ms=a.slo_ms, tick_s=0.05)
+    policy_digest = _policy_digest(acfg_kwargs, a.seed, a.replay_ticks,
+                                   a.pool)
+    policy_replay_ok = policy_digest == _policy_digest(
+        acfg_kwargs, a.seed, a.replay_ticks, a.pool)
+
+    spike_spec = ",".join(f"spike:{i}@0+1000000x{a.dispatch_ms:g}"
+                          for i in range(a.pool))
+    plan = ServeFaultPlan.from_spec(spike_spec, seed=a.seed)
+    plan_digest = plan.schedule_digest(a.pool, 2048)
+    plan_replay_ok = plan_digest == ServeFaultPlan.from_spec(
+        spike_spec, seed=a.seed).schedule_digest(a.pool, 2048)
+
+    # ------------------------------------------------ scaling server
+    t_start = time.perf_counter()
+    cfg = ServerConfig(
+        max_batch=a.max_batch, max_wait_ms=2.0,
+        queue_depth=a.queue_depth,
+        resilience=ResilienceConfig(slo_ms=a.slo_ms, shed_fraction=1.0,
+                                    fault_plan=plan),
+        autoscale=AutoscaleConfig(event_log=event_log, **acfg_kwargs))
+    server = InferenceServer(cfg)
+    lm = server.load(a.model, seed=a.seed, replicas=a.pool)
+    auto = server.autoscaler(a.model)
+    log(f"loaded {a.model}: pool {a.pool}, active "
+        f"{auto.snapshot()['active']}, dispatch spike "
+        f"{a.dispatch_ms:g} ms")
+
+    rng = np.random.RandomState(a.seed)
+    pool_x = rng.rand(64, *lm.runner.sample_shape).astype(np.float32)
+
+    def run_phase(shape, qps, requests):
+        """Offer one shaped open-loop phase; settle every future.
+        Returns the phase record (latencies in rid order, reject
+        counts, per-phase scale deltas)."""
+        before = auto.snapshot()
+        unit = rng.exponential(1.0, size=requests)
+        futs, sync_rejects, dropped = [], {}, 0
+        t0 = time.perf_counter()
+        next_t = t0
+        for i in range(requests):
+            mult = _rate_multiplier(shape, i / requests, a.shape_factor)
+            next_t += unit[i] / (qps * mult)
+            now = time.perf_counter()
+            if next_t > now:
+                time.sleep(next_t - now)
+            try:
+                futs.append((i, server.submit(a.model, pool_x[i % 64],
+                                              priority="interactive")))
+            except ServingError as e:
+                kind = type(e).__name__
+                sync_rejects[kind] = sync_rejects.get(kind, 0) + 1
+        lats = []
+        answered_ids = set()
+        for rid, fut in futs:
+            try:
+                r = fut.result(timeout=120)
+            except ServingError as e:
+                kind = type(e).__name__
+                sync_rejects[kind] = sync_rejects.get(kind, 0) + 1
+                answered_ids.add(rid)
+                continue
+            except Exception:
+                dropped += 1
+                continue
+            if rid in answered_ids:
+                dropped += 1       # re-answered: counted as a failure
+                continue
+            answered_ids.add(rid)
+            lats.append((rid, r.total_ms))
+        # converged tail: the last third of the phase by request id —
+        # by then the autoscaler has had every opportunity to act
+        tail = [ms for rid, ms in lats if rid >= (2 * requests) // 3]
+        # quiet tail: offered load is gone; the set must shrink back
+        # AND the autoscaler must quiesce (a scale-up's rebuild can
+        # outlive the burst that triggered it — wait for the counters
+        # to stop moving, not just for active == floor)
+        t_shrink = time.perf_counter()
+        last_sig, t_stable = None, time.perf_counter()
+        while time.perf_counter() - t_shrink < a.shrink_timeout_s:
+            s = auto.snapshot()
+            sig = (s["ups"], s["downs"], s["errors"], s["active"])
+            if sig != last_sig:
+                last_sig, t_stable = sig, time.perf_counter()
+            elif (s["active"] == auto.cfg.floor
+                  and time.perf_counter() - t_stable > 1.0):
+                break
+            time.sleep(0.05)
+        after = auto.snapshot()
+        # exactly-once accounting: every request either rejected at
+        # submit (sync), answered through its future (result OR a
+        # ServingError), or it is a DROP; a duplicate rid is a
+        # re-answer and also counts as a drop
+        n_sync = requests - len(futs)
+        rec = {
+            "shape": shape, "qps": qps, "requests": requests,
+            "completed": len(lats),
+            "answered": n_sync + len(answered_ids),
+            "rejects": dict(sorted(sync_rejects.items())),
+            "dropped": len(futs) - len(answered_ids),
+            "ups": after["ups"] - before["ups"],
+            "downs": after["downs"] - before["downs"],
+            "max_active": after["max_active"],
+            "active_after": after["active"],
+            "p50_ms": _pct([ms for _, ms in lats], 50),
+            "p99_ms": _pct([ms for _, ms in lats], 99),
+            "tail_p99_ms": _pct(tail, 99),
+        }
+        log(f"phase {shape}: ups {rec['ups']} downs {rec['downs']} "
+            f"tail p99 {rec['tail_p99_ms']} ms "
+            f"active {rec['active_after']}")
+        return rec
+
+    phases = [run_phase(shape, a.qps * qmul,
+                        max(1, int(a.requests * rmul)))
+              for shape, qmul, rmul in PHASES]
+    stats_a = server.stats()["models"][a.model]
+    server.close(drain=True)
+    # snapshots AFTER close: an in-flight scale action finishes (and
+    # logs its event) before the lane stops, so memory and JSONL agree
+    snap = auto.snapshot()
+    scale_events = auto.events_snapshot()
+
+    # ---------------------------------------------- errstorm server
+    # the storm covers every dispatch the phase can reach (including
+    # bounded retries), so the breaker trips and STAYS open under load;
+    # the spike keeps queue pressure real so the policy sees overload
+    storm_spec = (f"errstorm:0@0+60,"
+                  + ",".join(f"spike:{i}@0+1000000x{a.dispatch_ms:g}"
+                             for i in range(a.pool)))
+    storm_plan = ServeFaultPlan.from_spec(storm_spec, seed=a.seed)
+    # a larger up_ticks gives the breaker a deterministic head start:
+    # the storm trips it within ~4 dispatches, well before 6 overload
+    # ticks can accumulate, so every overloaded tick of the outage is
+    # observed WITH an open breaker (the suppression path under test)
+    cfg_b = ServerConfig(
+        max_batch=a.max_batch, max_wait_ms=2.0,
+        queue_depth=a.queue_depth,
+        resilience=ResilienceConfig(slo_ms=a.slo_ms, shed_fraction=1.0,
+                                    cooldown_s=0.2,
+                                    fault_plan=storm_plan),
+        autoscale=AutoscaleConfig(**dict(acfg_kwargs, up_ticks=6)))
+    server_b = InferenceServer(cfg_b)
+    server_b.load(a.model, seed=a.seed, replicas=a.pool)
+    auto_b = server_b.autoscaler(a.model)
+    mgr_b = server_b.resilience(a.model)
+
+    # concurrent outage watcher: the breaker opens and RE-CLOSES while
+    # the settle loop is still resolving backlog futures, so the
+    # recovery moment must be captured live, on the policy's own tick
+    # clock — ups decided at or before outage["tick_closed"] are the
+    # doom-loop violation, ups after it are correct backlog response
+    import threading as _threading
+
+    outage = {"tick_open": None, "tick_closed": None}
+    watch_stop = _threading.Event()
+
+    def _watch_outage():
+        while not watch_stop.is_set():
+            if outage["tick_open"] is None:
+                if mgr_b.open_breakers() > 0:
+                    outage["tick_open"] = auto_b.snapshot()["tick"]
+            elif mgr_b.all_closed():
+                outage["tick_closed"] = auto_b.snapshot()["tick"]
+                return
+            time.sleep(0.02)
+
+    watcher = _threading.Thread(target=_watch_outage, daemon=True)
+    watcher.start()
+
+    unit = rng.exponential(1.0, size=a.storm_requests)
+    futs, storm_rejects, storm_dropped, storm_completed = [], {}, 0, 0
+    t0 = time.perf_counter()
+    next_t = t0
+    for i in range(a.storm_requests):
+        next_t += unit[i] / a.storm_qps
+        now = time.perf_counter()
+        if next_t > now:
+            time.sleep(next_t - now)
+        try:
+            futs.append(server_b.submit(a.model, pool_x[i % 64],
+                                        priority="interactive"))
+        except ServingError as e:
+            kind = type(e).__name__
+            storm_rejects[kind] = storm_rejects.get(kind, 0) + 1
+    for fut in futs:
+        try:
+            fut.result(timeout=120)
+            storm_completed += 1
+        except ServingError as e:
+            kind = type(e).__name__
+            storm_rejects[kind] = storm_rejects.get(kind, 0) + 1
+        except Exception:
+            storm_dropped += 1
+    t_rec = time.perf_counter()
+    while (not mgr_b.all_closed()
+           and time.perf_counter() - t_rec < a.recovery_timeout_s):
+        time.sleep(0.05)
+    storm_recovered = mgr_b.all_closed()
+    watch_stop.set()
+    watcher.join(timeout=5.0)
+    tick_closed = (outage["tick_closed"]
+                   if outage["tick_closed"] is not None
+                   else auto_b.snapshot()["tick"])
+    resil_b = mgr_b.snapshot()
+    in_place_opens = sum(
+        1 for e in mgr_b.events_snapshot()
+        if e["kind"] == "replica_open" and e.get("in_place"))
+    server_b.close(drain=True)
+    storm_snap = auto_b.snapshot()
+    storm_scale_events = auto_b.events_snapshot()
+    storm_ups_during = sum(
+        1 for e in storm_scale_events
+        if e["kind"] == "scale_up" and e["tick"] <= tick_closed)
+
+    # ------------------------------------------------------ summary
+    ev_by_kind = {}
+    for e in scale_events:
+        ev_by_kind[e["kind"]] = ev_by_kind.get(e["kind"], 0) + 1
+    with open(event_log) as f:
+        logged = [json.loads(line) for line in f if line.strip()]
+    floor_violations = [
+        e for e in scale_events + storm_scale_events
+        if e["kind"] == "scale_down" and e["active"] < 1]
+    open_breaker_ups = [
+        e for e in scale_events + storm_scale_events
+        if e["kind"] == "scale_up" and e.get("breakers_open", 0) > 0]
+    up_devices = [e.get("device") for e in scale_events
+                  if e["kind"] == "scale_up"]
+
+    summary = {
+        "ok": True,
+        "model": a.model,
+        "pool": a.pool,
+        "seed": a.seed,
+        "slo_ms": a.slo_ms,
+        "elapsed_s": round(time.perf_counter() - t_start, 3),
+        "phases": phases,
+        "ups": snap["ups"],
+        "downs": snap["downs"],
+        "min_active": snap["min_active"],
+        "max_active": snap["max_active"],
+        "floor": snap["floor"],
+        "blocked_up": snap["blocked_up"],
+        "blocked_down": snap["blocked_down"],
+        "scale_errors": snap["errors"],
+        "dropped": sum(p["dropped"] for p in phases) + storm_dropped,
+        "completed": stats_a["completed"],
+        "scale_events": dict(sorted(ev_by_kind.items())),
+        "scale_events_logged": len(logged),
+        "scale_up_devices": up_devices,
+        "floor_violations": len(floor_violations),
+        "open_breaker_ups": len(open_breaker_ups),
+        "storm": {
+            "requests": a.storm_requests,
+            "completed": storm_completed,
+            "rejects": dict(sorted(storm_rejects.items())),
+            "dropped": storm_dropped,
+            "breaker_trips": resil_b["trips"],
+            "ups_during_outage": storm_ups_during,
+            "ups_total": storm_snap["ups"],
+            "suppressed_ticks": storm_snap["suppressed_ticks"],
+            "suppressed_events": sum(
+                1 for e in storm_scale_events
+                if e["kind"] == "scale_suppressed"),
+            "in_place_opens": in_place_opens,
+            "recovered": storm_recovered,
+        },
+        "replay_bitwise": policy_replay_ok and plan_replay_ok,
+        "policy_digest": policy_digest,
+        "plan_digest": plan_digest,
+        "workdir": workdir,
+    }
+
+    if a.smoke:
+        problems = []
+        if summary["ups"] < 1:
+            problems.append("replica set never grew (ups == 0)")
+        if summary["downs"] < 1:
+            problems.append("replica set never shrank (downs == 0)")
+        if any(d is None for d in up_devices):
+            problems.append("a scale_up event carried no device (must "
+                            "go through the placer)")
+        if summary["dropped"] != 0:
+            problems.append(f"dropped {summary['dropped']} != 0 "
+                            f"(every request answered exactly once)")
+        if summary["min_active"] < summary["floor"]:
+            problems.append(f"min_active {summary['min_active']} fell "
+                            f"below the floor {summary['floor']}")
+        if floor_violations:
+            problems.append(f"{len(floor_violations)} scale_down "
+                            f"events landed below 1 active replica")
+        if summary["scale_errors"] != 0:
+            problems.append(f"autoscaler recorded "
+                            f"{summary['scale_errors']} scale_error(s)")
+        if len(logged) != len(scale_events):
+            problems.append(f"scale event log lines {len(logged)} != "
+                            f"in-memory events {len(scale_events)}")
+        for p in phases:
+            if p["ups"] < 1:
+                problems.append(f"phase {p['shape']} never scaled up")
+            if p["active_after"] > summary["floor"]:
+                problems.append(f"phase {p['shape']} did not shrink "
+                                f"back to the floor")
+            if p["tail_p99_ms"] > a.slo_ms:
+                problems.append(
+                    f"phase {p['shape']} converged p99 "
+                    f"{p['tail_p99_ms']} ms over SLO {a.slo_ms} ms")
+        st = summary["storm"]
+        if st["breaker_trips"] < 1:
+            problems.append("errstorm never tripped a breaker")
+        if st["ups_during_outage"] != 0:
+            problems.append(f"errstorm triggered "
+                            f"{st['ups_during_outage']} scale-ups "
+                            f"before recovery (doom loop: must be 0)")
+        if open_breaker_ups:
+            problems.append(f"{len(open_breaker_ups)} scale_up "
+                            f"event(s) carried breakers_open > 0")
+        if st["suppressed_events"] < 1:
+            problems.append("no scale_suppressed event during the "
+                            "errstorm")
+        if st["in_place_opens"] < 1:
+            problems.append("last-replica breaker open was not "
+                            "in-place (capacity could hit zero)")
+        if not st["recovered"]:
+            problems.append(f"breakers not all closed after "
+                            f"{a.recovery_timeout_s}s")
+        if st["dropped"] != 0:
+            problems.append(f"storm dropped {st['dropped']} != 0")
+        if not summary["replay_bitwise"]:
+            problems.append("scaling/fault schedule did not replay "
+                            "bitwise")
+        if problems:
+            summary["ok"] = False
+            summary["problems"] = problems
+    print(json.dumps(summary), flush=True)
+    return 0 if summary.get("ok") else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -20,6 +20,9 @@
 # SPARKNET_LINT_GATE_NO_SERVECHAOS=1 skips the serving-resilience smoke
 # (scripts/serve_chaos_run.py: seeded error-storm + hard kill under a
 # flash crowd; breakers trip/respawn/re-admit, zero dropped requests).
+# SPARKNET_LINT_GATE_NO_AUTOSCALE=1 skips the autoscale drill
+# (scripts/autoscale_drill.py: shaped load grows/shrinks the replica
+# set through the placer, errstorm suppresses scale-up, zero dropped).
 # SPARKNET_LINT_GATE_NO_SHARDED=1 skips the sharded-serving contract leg
 # (compiles the gspmd slice forward at shards=4 and diffs its HLO
 # collective census against CONTRACTS.json; needs the 8-device mesh).
@@ -68,4 +71,15 @@ if [ "${SPARKNET_LINT_GATE_NO_SERVECHAOS:-0}" != "1" ]; then
     timeout -k 10 420 env JAX_PLATFORMS=cpu \
         XLA_FLAGS=--xla_force_host_platform_device_count=8 \
         python scripts/serve_chaos_run.py --smoke
+fi
+if [ "${SPARKNET_LINT_GATE_NO_AUTOSCALE:-0}" != "1" ]; then
+    # autoscale drill: diurnal/spike/flash-crowd load against the live
+    # server with the SLO-driven autoscaler armed — the replica set
+    # grows AND shrinks through the placer with zero dropped requests,
+    # an errstorm trips breakers with zero scale-ups during the outage,
+    # and the policy schedule replays bitwise (--smoke exits non-zero
+    # on a miss; prints ONE JSON line)
+    timeout -k 10 420 env JAX_PLATFORMS=cpu \
+        XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+        python scripts/autoscale_drill.py --smoke
 fi

@@ -26,6 +26,11 @@ resilience-enabled server (`--resilience`), batch traffic absorbs the
 SLO-aware sheds and the summary reports per-priority percentiles plus
 the shed/deadline-drop counts.
 
+The summary carries a per-1-second-window `timeline` (offered /
+answered / rejected counts + window p99) so shaped runs show WHEN the
+tier caught up with the load, not just whether it did — the autoscale
+drill's convergence check reads it directly.
+
 Prints per-phase progress on stderr and ONE summary JSON line on stdout;
 with `--jsonl out.jsonl` it also appends one record per request (id,
 model, replica, bucket, queue_wait/assembly/device/total ms, or the
@@ -248,6 +253,13 @@ def main() -> None:
     rejects_by_type = {}
     lat_by_pri = {"interactive": [], "batch": []}
     rejects_lock = threading.Lock()
+    # timeline raw stamps (absolute perf_counter seconds; bucketed into
+    # 1 s windows relative to t0 after the run): offered = submit
+    # attempts, answered = (completion stamp, total_ms), rejected = any
+    # disposition that never produced a Response
+    tl_offered = []
+    tl_answered = []
+    tl_rejected = []
 
     def settle(rid, name, fut, t_submit, pri="interactive"):
         """Wait one future; record its disposition."""
@@ -258,11 +270,16 @@ def main() -> None:
                 rejects["n"] += 1
                 kind = type(e).__name__
                 rejects_by_type[kind] = rejects_by_type.get(kind, 0) + 1
+                tl_rejected.append(t_submit)
             record({"id": rid, "model": name, "priority": pri,
                     "error": type(e).__name__, "status": e.status})
             return None
         with rejects_lock:
             lat_by_pri[pri].append(r.total_ms)
+            # completion stamp from submit time + server-side total, so
+            # the answered timeline is independent of settle() ordering
+            # (the open loop settles its futures after the last submit)
+            tl_answered.append((t_submit + r.total_ms / 1e3, r.total_ms))
         record({"id": rid, "model": name, "replica": r.replica,
                 "priority": pri, "bucket": r.bucket,
                 "queue_wait_ms": r.queue_wait_ms,
@@ -279,6 +296,7 @@ def main() -> None:
             rejects["n"] += 1
             kind = type(e).__name__
             rejects_by_type[kind] = rejects_by_type.get(kind, 0) + 1
+            tl_rejected.append(time.perf_counter())
         record({"id": rid, "model": name, "priority": pri,
                 "error": type(e).__name__, "status": e.status})
 
@@ -333,6 +351,8 @@ def main() -> None:
                 now = time.perf_counter()
                 if next_t > now:
                     time.sleep(next_t - now)
+                with rejects_lock:
+                    tl_offered.append(time.perf_counter())
                 try:
                     futs.append((i, name,
                                  server.submit(name,
@@ -356,6 +376,8 @@ def main() -> None:
                         counter["next"] = rid + 1
                     name = names[choices[rid]]
                     ts = time.perf_counter()
+                    with rejects_lock:
+                        tl_offered.append(ts)
                     try:
                         fut = server.submit(name, pools[name][rid % 64],
                                             wait=True,
@@ -428,6 +450,31 @@ def main() -> None:
         out["shape"] = a.shape
         if a.shape in ("spike", "flash_crowd"):
             out["shape_factor"] = a.shape_factor
+    # per-1s-window timeline: offered vs answered QPS and the window's
+    # p99 — the autoscale drill reads convergence (post-scale windows
+    # back under SLO) straight off this instead of re-deriving it from
+    # the per-request JSONL
+    n_win = max(1, int(math.ceil(elapsed)))
+    win_off = [0] * n_win
+    win_rej = [0] * n_win
+    win_ans = [[] for _ in range(n_win)]
+    for t in tl_offered:
+        w = int(t - t0)
+        if 0 <= w < n_win:
+            win_off[w] += 1
+    for t in tl_rejected:
+        w = int(t - t0)
+        if 0 <= w < n_win:
+            win_rej[w] += 1
+    for t, ms in tl_answered:
+        w = min(n_win - 1, max(0, int(t - t0)))
+        win_ans[w].append(ms)
+    out["timeline"] = [
+        {"t": w, "offered": win_off[w], "answered": len(win_ans[w]),
+         "rejected": win_rej[w],
+         "p99_ms": (round(float(np.percentile(win_ans[w], 99)), 4)
+                    if win_ans[w] else None)}
+        for w in range(n_win)]
     if rejects_by_type:
         out["rejected_by_type"] = dict(sorted(rejects_by_type.items()))
     if pri_mix is not None:

@@ -55,6 +55,18 @@ KNOBS: Dict[str, str] = {
                              "controller protects",
     "SPARKNET_SERVE_SHED_FRACTION": "queue fraction beyond which "
                                     "batch-priority requests shed",
+    "SPARKNET_SERVE_SCALE_MIN": "autoscaler replica floor (never "
+                                "below 1)",
+    "SPARKNET_SERVE_SCALE_UP_Q": "queue fraction at or over which a "
+                                 "tick counts as overloaded",
+    "SPARKNET_SERVE_SCALE_DOWN_Q": "queue fraction at or under which "
+                                   "a tick counts as idle",
+    "SPARKNET_SERVE_SCALE_UP_TICKS": "consecutive overloaded ticks "
+                                     "before a scale-up",
+    "SPARKNET_SERVE_SCALE_DOWN_TICKS": "consecutive idle ticks before "
+                                       "a scale-down",
+    "SPARKNET_SERVE_SCALE_COOLDOWN_TICKS": "refractory ticks after "
+                                           "any scaling action",
     # -- ingest
     "SPARKNET_PREFETCH_DEPTH": "rounds staged ahead by the prefetcher",
     "SPARKNET_INGEST_PROCS": "force multi-process ingest",

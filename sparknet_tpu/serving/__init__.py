@@ -17,6 +17,8 @@ CLI: `python -m sparknet_tpu.cli serve --model lenet` (JSONL in/out);
 load generation: `scripts/serve_loadgen.py`.
 """
 
+from .autoscale import (AutoscaleConfig, Autoscaler, ScalePolicy,
+                        SensorSample, synthetic_sensor_trace)
 from .buckets import bucket_sizes, pad_to_bucket, pick_bucket
 from .engine import ModelRunner, resolve_net_param
 from .errors import (DeadlineExceeded, ModelNotLoaded, RequestShed,
@@ -42,4 +44,6 @@ __all__ = [
     "LatencySeries", "ModelStats",
     "ResilienceConfig", "ResilienceManager", "CircuitBreaker",
     "ServeFaultPlan",
+    "AutoscaleConfig", "Autoscaler", "ScalePolicy", "SensorSample",
+    "synthetic_sensor_trace",
 ]

@@ -1,0 +1,648 @@
+"""SLO-driven serving autoscaler: grow and shrink a model's replica
+set through the placement/scheduler/registry levers the circuit
+breakers already exercise.
+
+PR 15 gave the serving tier reflexes (resilience.py: a TRIPPED replica
+is evicted, respawned, probed back in) and PR 17 gave it big-model
+slices (one replica = an N-device gspmd shard).  This module closes
+the control loop the other way: CAPACITY itself becomes a controlled
+variable.  A per-model daemon samples the sensors the tier already
+maintains — lane queue fraction, the interactive total-latency EWMA
+the shed controller's SLO is defined over, open-breaker count — and
+walks the replica set up and down through the SAME primitives the
+breaker uses, so scaling inherits the exactly-once story wholesale:
+
+- **Slot pool, not dynamic arrays.**  `load(name, replicas=POOL)`
+  builds and warms every slot once; the autoscaler manages an
+  active/PARKED partition of the pool.  Parking a slot is a controlled
+  drain-and-evict (scheduler `disable_unless_last` -> atomic
+  `drain_replica` -> exactly-once `requeue(exclude=victim)` ->
+  `DevicePlacer.evict`): admitted requests are rerouted, never dropped
+  or re-answered.  Un-parking respawns the slot onto the currently
+  LEAST-LOADED device — `DevicePlacer.respawn(rebind=True)` — then
+  `ModelRegistry.rebuild_replica(device=...)` builds a fresh warmed
+  runner there (same params, no generation bump) before routing
+  re-opens.  With `shards=N` (PR 17) the unit is a mesh slice; the
+  slot algebra is identical.
+- **Hysteresis, not a thermostat.**  `ScalePolicy` is a pure
+  tick-indexed state machine: overload (queue fraction >= up_q OR
+  EWMA > SLO) must persist `up_ticks` consecutive ticks to scale up,
+  idle (queue fraction <= down_q) must persist `down_ticks` to scale
+  down, and every action opens a `cooldown_ticks` refractory window.
+  No wall clock enters any decision, so `ScalePolicy.replay` over a
+  seeded sensor trace is bitwise-reproducible (`schedule_digest` pins
+  it — the same determinism-over-the-schedule contract as
+  ServeFaultPlan, since live thread interleavings naturally vary).
+- **Composes with the breakers, never competes.**  (1) Scale-up is
+  SUPPRESSED while any breaker is open: an errstorm raises latency,
+  and adding replicas to an error-dominated lane is a doom loop —
+  recovery is the breaker's job (the drill pins trips >= 1 with ZERO
+  scale-ups).  (2) A parked slot is invisible to breaker accounting:
+  the manager's activity gate (`set_activity_gate`) drops outcome
+  records from in-flight stragglers, so a parked slot's breaker stays
+  closed and can never double-evict residency the autoscaler already
+  released.  (3) A non-closed slot is never a scale victim or scale-up
+  candidate, and a lost `placer.evict` race (the breaker got there
+  first) aborts the park — the slot stays the breaker's.
+- **Floors are hard.**  `min_replicas >= 1` always; the scheduler's
+  atomic `disable_unless_last` backstops the n=1 case so no
+  interleaving of breaker and autoscaler can zero a lane's capacity.
+
+Every transition lands as a wall-clock-free JSONL event (`scale_init`
+/ `scale_up` / `scale_down` / `scale_suppressed` / `scale_error`;
+schema in DISTACC.md), and the sensors export as named gauges
+(`serving_queue_fraction`, `serving_interactive_ewma_ms`,
+`serving_active_replicas`) in the model's metrics registry — the
+autoscaler, the shed controller, and a Prometheus scrape all read one
+set of numbers.  Drill: `scripts/autoscale_drill.py` (shaped load:
+diurnal / spike / flash-crowd / errstorm); bench leg:
+`serving_autoscale`.
+
+Locking: `_mu` guards policy state, the parked set, and counters, and
+is NEVER held across a scheduler/placer/registry/stats/resilience
+call or a sleep (ANALYSIS.md R008); the activity gate takes `_mu`
+alone and is called by the manager BEFORE its own `_mu`, so the lock
+graph stays acyclic (R007).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import math
+import threading
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from ..elastic.chaos import u01
+from .errors import ServerClosed
+from .resilience import SLO_ENV, _devstr, _env_float, _env_int
+from .scheduler import SchedulerClosed
+
+__all__ = [
+    "AutoscaleConfig", "Autoscaler", "ScalePolicy", "SensorSample",
+    "synthetic_sensor_trace", "LOAD_SHAPES",
+    "SCALE_MIN_ENV", "SCALE_UP_Q_ENV", "SCALE_DOWN_Q_ENV",
+    "SCALE_UP_TICKS_ENV", "SCALE_DOWN_TICKS_ENV", "SCALE_COOLDOWN_ENV",
+]
+
+SCALE_MIN_ENV = "SPARKNET_SERVE_SCALE_MIN"
+SCALE_UP_Q_ENV = "SPARKNET_SERVE_SCALE_UP_Q"
+SCALE_DOWN_Q_ENV = "SPARKNET_SERVE_SCALE_DOWN_Q"
+SCALE_UP_TICKS_ENV = "SPARKNET_SERVE_SCALE_UP_TICKS"
+SCALE_DOWN_TICKS_ENV = "SPARKNET_SERVE_SCALE_DOWN_TICKS"
+SCALE_COOLDOWN_ENV = "SPARKNET_SERVE_SCALE_COOLDOWN_TICKS"
+
+LOAD_SHAPES = ("diurnal", "spike", "flash_crowd", "errstorm")
+
+
+# ------------------------------------------------------------------ sensors
+@dataclasses.dataclass(frozen=True)
+class SensorSample:
+    """One tick's sensor reading — everything a scaling decision may
+    depend on, and nothing else (no wall clock, no thread state), so a
+    recorded trace replays the policy bitwise."""
+
+    queue_fraction: float               # lane queued / queue_depth
+    interactive_ewma_ms: Optional[float]   # shed controller's SLO EWMA
+    breakers_open: int                  # non-closed breakers right now
+
+
+# ------------------------------------------------------------------- config
+@dataclasses.dataclass
+class AutoscaleConfig:
+    """Knobs of the autoscaling policy.  Every default reads its scale
+    env knob — SPARKNET_SERVE_SCALE_MIN and friends, registered in
+    analysis/knobs.py + the README table (R004) — so deployments tune
+    without code; explicit constructor values win.  Thresholds are in TICKS of the policy
+    clock (`tick_s`), not seconds — the policy itself never sees wall
+    time, which is what makes `ScalePolicy.replay` exact."""
+
+    min_replicas: int = dataclasses.field(
+        default_factory=lambda: _env_int(SCALE_MIN_ENV, 1))
+    initial_replicas: Optional[int] = None   # None -> min_replicas
+    up_queue_fraction: float = dataclasses.field(
+        default_factory=lambda: _env_float(SCALE_UP_Q_ENV, 0.5))
+    down_queue_fraction: float = dataclasses.field(
+        default_factory=lambda: _env_float(SCALE_DOWN_Q_ENV, 0.125))
+    up_ticks: int = dataclasses.field(
+        default_factory=lambda: _env_int(SCALE_UP_TICKS_ENV, 2))
+    down_ticks: int = dataclasses.field(
+        default_factory=lambda: _env_int(SCALE_DOWN_TICKS_ENV, 6))
+    cooldown_ticks: int = dataclasses.field(
+        default_factory=lambda: _env_int(SCALE_COOLDOWN_ENV, 8))
+    slo_ms: float = dataclasses.field(
+        default_factory=lambda: _env_float(SLO_ENV, 500.0))
+    tick_s: float = 0.05        # daemon sampling period
+    event_log: Optional[str] = None   # JSONL path (DISTACC.md schema)
+
+    def __post_init__(self) -> None:
+        if self.min_replicas < 1:
+            raise ValueError(f"min_replicas must be >= 1, "
+                             f"got {self.min_replicas}")
+        if (self.initial_replicas is not None
+                and self.initial_replicas < self.min_replicas):
+            raise ValueError(
+                f"initial_replicas must be >= min_replicas="
+                f"{self.min_replicas}, got {self.initial_replicas}")
+        if not 0.0 < self.up_queue_fraction <= 1.0:
+            raise ValueError(f"up_queue_fraction must be in (0, 1], "
+                             f"got {self.up_queue_fraction}")
+        if not 0.0 <= self.down_queue_fraction < self.up_queue_fraction:
+            raise ValueError(
+                f"down_queue_fraction must be in [0, "
+                f"up_queue_fraction={self.up_queue_fraction}), got "
+                f"{self.down_queue_fraction}")
+        if self.up_ticks < 1:
+            raise ValueError(f"up_ticks must be >= 1, "
+                             f"got {self.up_ticks}")
+        if self.down_ticks < 1:
+            raise ValueError(f"down_ticks must be >= 1, "
+                             f"got {self.down_ticks}")
+        if self.cooldown_ticks < 0:
+            raise ValueError(f"cooldown_ticks must be >= 0, "
+                             f"got {self.cooldown_ticks}")
+        if self.slo_ms <= 0:
+            raise ValueError(f"slo_ms must be > 0, got {self.slo_ms}")
+        if self.tick_s <= 0:
+            raise ValueError(f"tick_s must be > 0, got {self.tick_s}")
+
+    @property
+    def floor(self) -> int:
+        """The hard capacity floor: never below one replica, whatever
+        min_replicas says."""
+        return max(1, self.min_replicas)
+
+
+# ------------------------------------------------------------------- policy
+class ScalePolicy:
+    """Pure hysteresis/cooldown state machine over tick indices.
+
+    `decide()` consumes one SensorSample and returns
+    `(action, suppressed)` with action in {"up", "down", "hold"}.
+    Overload = queue fraction >= up_queue_fraction OR interactive EWMA
+    over the SLO; it must persist `up_ticks` consecutive ticks before
+    an "up" fires.  Idle = queue fraction <= down_queue_fraction,
+    persisting `down_ticks` before a "down".  Any fired action opens a
+    `cooldown_ticks` refractory window during which everything holds
+    (streaks keep accumulating, so a still-overloaded lane fires again
+    the tick the window closes).  Overload while ANY breaker is open
+    is MASKED (suppressed=True, action "hold"): an errstorm's latency
+    spike must trip breakers, never a scale-up doom loop.
+
+    Deliberately free of wall clock, RNG, and thread state: the same
+    sample sequence always yields the same action schedule, which is
+    the drill's bitwise replay contract (`replay` / `schedule_digest`,
+    mirroring ServeFaultPlan's determinism-over-the-schedule)."""
+
+    def __init__(self, cfg: AutoscaleConfig) -> None:
+        self.cfg = cfg
+        self.tick = 0
+        self.up_streak = 0
+        self.down_streak = 0
+        self.cooldown = 0
+
+    def decide(self, sample: SensorSample, *, active: int,
+               pool: int) -> Tuple[str, bool]:
+        cfg = self.cfg
+        self.tick += 1
+        overload = (sample.queue_fraction >= cfg.up_queue_fraction
+                    or (sample.interactive_ewma_ms is not None
+                        and sample.interactive_ewma_ms > cfg.slo_ms))
+        suppressed = False
+        if overload and sample.breakers_open > 0:
+            overload = False
+            suppressed = True
+        idle = (not overload and not suppressed
+                and sample.queue_fraction <= cfg.down_queue_fraction)
+        if overload:
+            self.up_streak += 1
+            self.down_streak = 0
+        elif idle:
+            self.down_streak += 1
+            self.up_streak = 0
+        else:
+            self.up_streak = 0
+            self.down_streak = 0
+        if self.cooldown > 0:
+            self.cooldown -= 1
+            return "hold", suppressed
+        if self.up_streak >= cfg.up_ticks and active < pool:
+            self.up_streak = self.down_streak = 0
+            self.cooldown = cfg.cooldown_ticks
+            return "up", suppressed
+        if self.down_streak >= cfg.down_ticks and active > cfg.floor:
+            self.up_streak = self.down_streak = 0
+            self.cooldown = cfg.cooldown_ticks
+            return "down", suppressed
+        return "hold", suppressed
+
+    # ------------------------------------------------------------- replay
+    @classmethod
+    def replay(cls, cfg: AutoscaleConfig, samples: Sequence[SensorSample],
+               *, initial_active: int,
+               pool: int) -> List[Tuple[int, str, bool, int]]:
+        """Run a fresh policy over `samples` and return the full
+        schedule [(tick, action, suppressed, active_after)].  Pure: two
+        calls with the same inputs agree bitwise on every entry."""
+        pol = cls(cfg)
+        active = int(initial_active)
+        out: List[Tuple[int, str, bool, int]] = []
+        for s in samples:
+            action, suppressed = pol.decide(s, active=active, pool=pool)
+            if action == "up":
+                active += 1
+            elif action == "down":
+                active -= 1
+            out.append((pol.tick, action, suppressed, active))
+        return out
+
+    @classmethod
+    def schedule_digest(cls, cfg: AutoscaleConfig,
+                        samples: Sequence[SensorSample], *,
+                        initial_active: int, pool: int) -> str:
+        """sha256 over the full replayed schedule — the drill computes
+        it twice from independently constructed traces and pins
+        equality (the bitwise two-run replay contract)."""
+        h = hashlib.sha256()
+        for tick, action, suppressed, active in cls.replay(
+                cfg, samples, initial_active=initial_active, pool=pool):
+            h.update(f"{tick}:{action}:{int(suppressed)}:{active}|"
+                     .encode())
+        return h.hexdigest()
+
+
+def synthetic_sensor_trace(shape: str, *, seed: int = 0,
+                           n_ticks: int = 240,
+                           slo_ms: float = 500.0
+                           ) -> List[SensorSample]:
+    """A seeded, shaped sensor trace — pure function of
+    (shape, seed, n_ticks, slo_ms), every draw via the sha256 `u01`
+    elastic/chaos.py uses, so two constructions agree bitwise (the
+    replay-digest half of the drill).  Shapes mirror
+    scripts/serve_loadgen.py's load shapes:
+
+      diurnal      sinusoidal day/night swing (grow at peak, shrink at
+                   trough)
+      spike        quiet -> sudden 20%-of-trace plateau -> quiet
+      flash_crowd  quiet -> permanent step up
+      errstorm     saturated AND breakers open — the doom-loop case;
+                   a correct policy emits zero "up" actions here
+    """
+    if shape not in LOAD_SHAPES:
+        raise ValueError(f"unknown load shape {shape!r}; one of "
+                         f"{LOAD_SHAPES}")
+    if n_ticks < 1:
+        raise ValueError(f"n_ticks must be >= 1, got {n_ticks}")
+    out: List[SensorSample] = []
+    for t in range(int(n_ticks)):
+        p = t / max(1, int(n_ticks) - 1)
+        if shape == "diurnal":
+            m = 1.0 + 0.6 * math.sin(2 * math.pi * p)
+        elif shape == "spike":
+            m = 1.8 if 0.4 <= p < 0.6 else 0.3
+        elif shape == "flash_crowd":
+            m = 0.2 if p < 0.3 else 1.8
+        else:                                  # errstorm
+            m = 1.8
+        jitter = 0.05 * (u01(int(seed), "scale_trace", t) - 0.5)
+        qf = max(0.0, min(1.0, 0.55 * m - 0.15 + jitter))
+        ewma = float(slo_ms) * (0.3 + 0.45 * m)
+        # errstorm: errors dominate from the first dispatch, so the
+        # breaker is open before queue pressure can persist — the whole
+        # trace must yield ZERO "up" actions (the doom-loop pin)
+        breakers = 1 if shape == "errstorm" else 0
+        out.append(SensorSample(queue_fraction=round(qf, 6),
+                                interactive_ewma_ms=round(ewma, 3),
+                                breakers_open=breakers))
+    return out
+
+
+# --------------------------------------------------------------- autoscaler
+class Autoscaler:
+    """Per-lane scaling daemon over a fixed warmed slot pool.
+
+    Wiring (serving/server.py): built after the lane's scheduler and
+    ResilienceManager, with the pool fully placed; the constructor
+    immediately PARKS every slot above `initial_replicas` (disable ->
+    drain -> evict, releasing device residency back to the placer) and
+    registers its `is_active` as the manager's activity gate.  The
+    daemon then samples each `tick_s`: queue fraction from the
+    scheduler, the interactive EWMA + open-breaker count from the
+    manager, feeds `ScalePolicy`, and applies at most one scaling
+    action per tick through the placer/registry/scheduler — always
+    with `_mu` released (R008)."""
+
+    def __init__(self, *, model: str, sched, lm, registry, placer,
+                 queue_depth: int, resil=None,
+                 config: Optional[AutoscaleConfig] = None) -> None:
+        self.cfg = config if config is not None else AutoscaleConfig()
+        self._model = str(model)
+        self._sched = sched
+        self._lm = lm
+        self._registry = registry
+        self._placer = placer
+        self._resil = resil
+        self._queue_depth = int(queue_depth)
+        self._pool = int(lm.n_replicas)
+        if self.cfg.floor > self._pool:
+            raise ValueError(
+                f"min_replicas={self.cfg.min_replicas} exceeds the "
+                f"{self._pool}-slot pool for model {model!r}")
+        initial = (self.cfg.initial_replicas
+                   if self.cfg.initial_replicas is not None
+                   else self.cfg.floor)
+        initial = max(self.cfg.floor, min(int(initial), self._pool))
+        self._mu = threading.Lock()
+        self._ev_mu = threading.Lock()   # serializes event-log appends
+        self._policy = ScalePolicy(self.cfg)
+        self._parked: set = set()
+        self._ups = 0
+        self._downs = 0
+        self._suppressed = 0            # suppressed ticks
+        self._blocked_up = 0
+        self._blocked_down = 0
+        self._errors = 0
+        self._in_suppress_episode = False
+        self._min_active = initial
+        self._max_active = initial
+        self.events: List[dict] = []
+        # park the tail of the pool BEFORE any traffic: the slots were
+        # built and warmed by load() (scale-up is a rebind+rebuild, not
+        # a cold compile), but they start without device residency or
+        # routing.  The gate is registered first so a parked slot is
+        # never breaker-visible, even transiently.
+        if self._resil is not None:
+            self._resil.set_activity_gate(self.is_active)
+        for slot in range(self._pool - 1, initial - 1, -1):
+            with self._mu:
+                self._parked.add(slot)
+            self._sched.set_enabled(slot, False)
+            drained = self._sched.drain_replica(slot)
+            if drained:
+                self._sched.requeue(drained, exclude=slot)
+            if self._placer is not None:
+                try:
+                    self._placer.evict(self._model, slot)
+                except ValueError:
+                    pass        # no recorded placement for this slot
+        self._event("scale_init", active=initial, pool=self._pool,
+                    floor=self.cfg.floor,
+                    parked=sorted(self._parked))
+        self._push_active_gauge()
+        self._stop_ev = threading.Event()
+        self._thread = threading.Thread(
+            target=self._loop, name=f"sparknet-scale-{model}",
+            daemon=True)
+        self._thread.start()
+
+    # ---------------------------------------------------------------- gate
+    def is_active(self, replica: int) -> bool:
+        """True while `replica` is un-parked — the ResilienceManager's
+        activity gate (outcomes from parked slots are dropped so their
+        breakers stay closed).  Takes `_mu` alone; callers never hold
+        their own locks across it (R007)."""
+        with self._mu:
+            return int(replica) not in self._parked
+
+    def active_count(self) -> int:
+        with self._mu:
+            return self._pool - len(self._parked)
+
+    # ---------------------------------------------------------------- loop
+    def _loop(self) -> None:
+        while not self._stop_ev.wait(self.cfg.tick_s):
+            try:
+                self.step()
+            except Exception as e:      # keep the control plane alive
+                with self._mu:
+                    self._errors += 1
+                self._event("scale_error",
+                            error=f"{type(e).__name__}: {e}")
+
+    def step(self) -> None:
+        """One sensing + decision + (at most one) action cycle.  Public
+        so tests and the drill can drive the policy synchronously with
+        the daemon stopped."""
+        sample = self._sense()
+        with self._mu:
+            active = self._pool - len(self._parked)
+            action, suppressed = self._policy.decide(
+                sample, active=active, pool=self._pool)
+            tick = self._policy.tick
+            if suppressed:
+                self._suppressed += 1
+            first_suppress = suppressed and not self._in_suppress_episode
+            self._in_suppress_episode = suppressed
+        if first_suppress:
+            self._event("scale_suppressed", tick=tick,
+                        breakers_open=sample.breakers_open,
+                        queue_fraction=round(sample.queue_fraction, 4))
+        if action == "up":
+            self._scale_up(tick, sample)
+        elif action == "down":
+            self._scale_down(tick, sample)
+
+    def _sense(self) -> SensorSample:
+        qf = self._sched.queued_total() / float(self._queue_depth)
+        ewma = (self._resil.interactive_ewma()
+                if self._resil is not None else None)
+        open_n = (self._resil.open_breakers()
+                  if self._resil is not None else 0)
+        self._lm.stats.observe_sensors(queue_fraction=qf)
+        return SensorSample(queue_fraction=qf,
+                            interactive_ewma_ms=ewma,
+                            breakers_open=open_n)
+
+    def _push_active_gauge(self) -> None:
+        with self._mu:
+            active = self._pool - len(self._parked)
+        self._lm.stats.observe_sensors(active_replicas=active)
+
+    # ------------------------------------------------------------- scale up
+    def _scale_up(self, tick: int, sample: SensorSample) -> None:
+        """Un-park the lowest eligible slot: respawn onto the currently
+        least-loaded device/slice (rebind), rebuild a fresh warmed
+        runner there, and only then re-open routing — the slot's first
+        live dispatch hits warm compiled buckets on its new home."""
+        with self._mu:
+            parked = sorted(self._parked)
+        slot = None
+        for cand in parked:     # a non-closed slot is the breaker's
+            if (self._resil is None
+                    or self._resil.breaker_state(cand) == "closed"):
+                slot = cand
+                break
+        if slot is None:
+            with self._mu:
+                self._blocked_up += 1
+            return
+        device = None
+        if self._placer is not None:
+            try:
+                device = self._placer.respawn(self._model, slot,
+                                              rebind=True)
+            except ValueError:
+                device = None   # slot never had a recorded placement
+        try:
+            self._registry.rebuild_replica(self._model, slot,
+                                           device=device)
+        except Exception as e:
+            # give the residency back; the slot stays parked
+            if device is not None and self._placer is not None:
+                try:
+                    self._placer.evict(self._model, slot)
+                except ValueError:
+                    pass
+            with self._mu:
+                self._errors += 1
+            self._event("scale_error", tick=tick, replica=slot,
+                        error=f"scale-up rebuild failed: "
+                              f"{type(e).__name__}: {e}")
+            return
+        with self._mu:
+            self._parked.discard(slot)
+            self._ups += 1
+            active = self._pool - len(self._parked)
+            self._max_active = max(self._max_active, active)
+        # un-parked BEFORE routing opens: the first dispatch outcome
+        # must already pass the activity gate
+        self._sched.set_enabled(slot, True)
+        # breakers_open rides along as an audit field: decide() masks
+        # overload while any breaker is open, so a scale_up event with
+        # breakers_open > 0 is impossible by construction — the drill
+        # pins exactly that (the doom-loop invariant)
+        self._event("scale_up", tick=tick, replica=slot,
+                    device=_devstr(device), active=active,
+                    queue_fraction=round(sample.queue_fraction, 4),
+                    breakers_open=sample.breakers_open)
+        self._push_active_gauge()
+
+    # ----------------------------------------------------------- scale down
+    def _scale_down(self, tick: int, sample: SensorSample) -> None:
+        """Park the highest eligible slot: atomically close routing
+        (never the last enabled replica), drain its queue, requeue the
+        drained items exactly once onto the survivors, release device
+        residency.  Slot 0 (the registry master) is only ever parked if
+        it is somehow the last candidate above the floor — victim order
+        is highest-index-first precisely to keep it resident."""
+        with self._mu:
+            active = sorted(
+                (s for s in range(self._pool) if s not in self._parked),
+                reverse=True)
+        if len(active) <= self.cfg.floor:
+            with self._mu:
+                self._blocked_down += 1
+            return
+        victim = None
+        for cand in active:     # a non-closed slot is the breaker's
+            if (self._resil is None
+                    or self._resil.breaker_state(cand) == "closed"):
+                victim = cand
+                break
+        if victim is None:
+            with self._mu:
+                self._blocked_down += 1
+            return
+        # capacity floor over ROUTED replicas too: breakers may have
+        # disabled other active slots, and parking below the floor of
+        # live routing capacity would amplify their outage
+        if self._sched.enabled_count() - 1 < self.cfg.floor:
+            with self._mu:
+                self._blocked_down += 1
+            return
+        if not self._sched.disable_unless_last(victim):
+            with self._mu:
+                self._blocked_down += 1
+            return
+        # parked BEFORE the drain: any in-flight straggler outcome on
+        # the victim is already gate-invisible to its breaker
+        with self._mu:
+            self._parked.add(victim)
+        drained = self._sched.drain_replica(victim)
+        if drained:
+            try:
+                self._sched.requeue(drained, exclude=victim)
+            except SchedulerClosed:
+                # shutdown race (the server stops the autoscaler first,
+                # so this is a backstop, not a path): reject loudly —
+                # an admitted request is never silently dropped
+                for r in drained:
+                    fut = getattr(r, "future", None)
+                    if fut is not None:
+                        fut.set_exception(ServerClosed(
+                            "server closed while rebalancing this "
+                            "request off a scaled-down replica"))
+        evicted_device = None
+        if self._placer is not None:
+            try:
+                evicted_device = self._placer.evict(self._model, victim)
+            except ValueError:
+                # the breaker tripped concurrently and evicted first:
+                # the slot is the BREAKER's episode now — un-park so
+                # its respawn/close path re-admits it normally, and
+                # count nothing (no double bookkeeping)
+                with self._mu:
+                    self._parked.discard(victim)
+                    self._errors += 1
+                self._event("scale_error", tick=tick, replica=victim,
+                            error="scale-down lost evict race to "
+                                  "breaker; slot left to resilience")
+                return
+        with self._mu:
+            self._downs += 1
+            active_n = self._pool - len(self._parked)
+            self._min_active = min(self._min_active, active_n)
+        self._event("scale_down", tick=tick, replica=victim,
+                    requeued=len(drained), device=_devstr(evicted_device),
+                    active=active_n,
+                    queue_fraction=round(sample.queue_fraction, 4))
+        self._push_active_gauge()
+
+    # -------------------------------------------------------------- observe
+    def snapshot(self) -> Dict[str, object]:
+        """JSON-ready autoscaler state for server.stats() and the
+        drill's accounting checks."""
+        with self._mu:
+            return {
+                "pool": self._pool,
+                "active": self._pool - len(self._parked),
+                "parked": sorted(self._parked),
+                "floor": self.cfg.floor,
+                "ups": self._ups,
+                "downs": self._downs,
+                "suppressed_ticks": self._suppressed,
+                "blocked_up": self._blocked_up,
+                "blocked_down": self._blocked_down,
+                "errors": self._errors,
+                "min_active": self._min_active,
+                "max_active": self._max_active,
+                "tick": self._policy.tick,
+                "cooldown": self._policy.cooldown,
+            }
+
+    def events_snapshot(self) -> List[dict]:
+        with self._mu:
+            return [dict(e) for e in self.events]
+
+    # ------------------------------------------------------------ lifecycle
+    def stop(self) -> None:
+        self._stop_ev.set()
+        if self._thread is not threading.current_thread():
+            self._thread.join(timeout=30.0)
+
+    # --------------------------------------------------------------- events
+    def _event(self, kind: str, **fields) -> None:
+        """Same wall-clock-free event discipline as resilience.py /
+        deploy/watcher.py: in-memory list + optional JSONL line
+        (DISTACC.md schema table)."""
+        rec = {"kind": kind, "model": self._model}
+        rec.update(fields)
+        with self._mu:
+            self.events.append(rec)
+        path = self.cfg.event_log
+        if path:
+            with self._ev_mu:
+                with open(path, "a") as f:
+                    f.write(json.dumps(rec) + "\n")
+                    f.flush()

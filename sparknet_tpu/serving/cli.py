@@ -72,6 +72,19 @@ def cmd_serve(args) -> int:
         if args.slo_ms is not None:
             rcfg.slo_ms = args.slo_ms
         cfg.resilience = rcfg
+    if args.autoscale:
+        from .autoscale import AutoscaleConfig
+
+        try:
+            acfg = AutoscaleConfig()
+            if args.scale_min is not None:
+                acfg.min_replicas = args.scale_min
+            if args.slo_ms is not None:
+                acfg.slo_ms = args.slo_ms
+            acfg.__post_init__()    # re-validate the overridden fields
+        except ValueError as e:
+            raise SystemExit(f"serve: {e}")
+        cfg.autoscale = acfg
     server = InferenceServer(cfg)
     name = args.name or "default"
     try:
@@ -248,6 +261,15 @@ def register(sub) -> None:
                    help="interactive latency SLO the shed controller "
                         "protects (with --resilience; default "
                         "SPARKNET_SERVE_SLO_MS)")
+    s.add_argument("--autoscale", action="store_true",
+                   help="arm the SLO-driven autoscaler "
+                        "(serving/autoscale.py): --replicas becomes "
+                        "the slot POOL and the active subset grows/"
+                        "shrinks with load (scale knobs in the README "
+                        "table)")
+    s.add_argument("--scale_min", type=int,
+                   help="autoscaler capacity floor (with --autoscale; "
+                        "default SPARKNET_SERVE_SCALE_MIN, normally 1)")
     s.add_argument("--preprocess", action="store_true",
                    help="treat 'data' as an HWC image: resize + center "
                         "crop to the model input (classify.Preprocessor)")

@@ -223,16 +223,29 @@ class DevicePlacer:
                 self._load[i] -= 1
             return self._slot_devices_locked(name, groups[slot])
 
-    def respawn(self, name: str, slot: int):
-        """Re-acquire the original device(s) for an evicted slot (the
-        post-rebuild re-admission path); returns that device — the SAME
-        device set the slot was placed on, list-shaped for sharded
-        slots."""
+    def respawn(self, name: str, slot: int, *, rebind: bool = False):
+        """Re-acquire device(s) for an evicted slot (the post-rebuild
+        re-admission path); returns that device, list-shaped for sharded
+        slots.  Default keeps the sticky binding — the SAME device set
+        the slot was placed on (the breaker-respawn contract).  With
+        `rebind=True` the slot is re-placed onto the currently
+        LEAST-LOADED group of the same slice width (pool-order
+        tie-break, so rebinding is deterministic for a given residency
+        state) — the autoscaler's scale-up path, where the vacated
+        binding may no longer be the emptiest spot on the mesh."""
         with self._lock:
             groups = self._slot_locked(name, slot)
             if slot not in self._evicted.get(name, set()):
                 raise ValueError(f"slot {slot} of model {name!r} is not "
                                  f"evicted")
+            if rebind:
+                s = self._shards.get(name, 1)
+                tiles = [list(range(k * s, (k + 1) * s))
+                         for k in range(len(self._devices) // s)]
+                g = min(range(len(tiles)),
+                        key=lambda k: (sum(self._load[i]
+                                           for i in tiles[k]), k))
+                groups[int(slot)] = list(tiles[g])
             self._evicted[name].discard(int(slot))
             for i in groups[slot]:
                 self._load[i] += 1

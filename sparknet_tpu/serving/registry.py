@@ -184,17 +184,24 @@ class ModelRegistry:
             lm.swap(master, replicas, ModelStats())
         return lm
 
-    def rebuild_replica(self, name: str, idx: int) -> ModelRunner:
-        """Build a FRESH runner for ONE replica slot on its recorded
-        device and swap it into the live set — the circuit-breaker
-        respawn path (serving/resilience.py).  Unlike reload() this
-        changes no parameters: the new runner replicates the CURRENT
-        master's params (bitwise-identical math), so the generation
-        does NOT bump — responses before and after the respawn are the
-        same generation because they ARE the same model.  A batch that
+    def rebuild_replica(self, name: str, idx: int,
+                        device=None) -> ModelRunner:
+        """Build a FRESH runner for ONE replica slot and swap it into
+        the live set — the circuit-breaker respawn path
+        (serving/resilience.py).  Unlike reload() this changes no
+        parameters: the new runner replicates the CURRENT master's
+        params (bitwise-identical math), so the generation does NOT
+        bump — responses before and after the respawn are the same
+        generation because they ARE the same model.  A batch that
         captured the old runner via replica_snapshot completes on it;
         the next snapshot sees the fresh one (same atomicity contract
-        as swap())."""
+        as swap()).
+
+        `device` (a device, or a device LIST for a sharded slot)
+        overrides the slot's recorded placement and re-records it — the
+        autoscaler's scale-up path, where DevicePlacer.respawn(...,
+        rebind=True) may have moved the slot to a new least-loaded
+        device; omitted, the slot rebuilds where it last lived."""
         lm = self.get(name)
         with lm._swap_lock:
             if not 0 <= int(idx) < len(lm.replicas):
@@ -203,9 +210,15 @@ class ModelRegistry:
                     f"slot {idx} does not exist")
             master = lm.replicas[0]
             rep = lm.replicas[idx]
-            device = (lm.devices[idx] if lm.devices is not None
-                      else (rep.slice_devices if rep.shards > 1
-                            else rep.device))
+            if device is None:
+                device = (lm.devices[idx] if lm.devices is not None
+                          else (rep.slice_devices if rep.shards > 1
+                                else rep.device))
+            elif lm.devices is not None:
+                lm.devices[int(idx)] = (list(device)
+                                        if isinstance(device,
+                                                      (list, tuple))
+                                        else device)
         # built OUTSIDE the swap lock: replicate() device_puts params
         # and warmup() compiles — replica_snapshot holds the lock on
         # every dispatch and must never stall behind a rebuild

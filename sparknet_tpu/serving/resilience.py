@@ -455,6 +455,7 @@ class ResilienceManager:
         self._dispatch = [0] * n        # fault-plan index per replica
         self._incarnation = [0] * n     # respawns bump; clears kills
         self._dead = [False] * n        # hard-killed until respawn
+        self._gate = None       # autoscaler activity gate (see setter)
         self._opened_episode_at: Dict[int, float] = {}
         self._recovery_s: Dict[int, float] = {}
         self._interactive_ewma_ms: Optional[float] = None
@@ -496,7 +497,21 @@ class ResilienceManager:
                        if self._plan is not None else 0.0)
         return err, spike_s
 
+    def set_activity_gate(self, gate) -> None:
+        """Register `gate(replica) -> bool` (the autoscaler's
+        `is_active`).  A False slot is administratively PARKED — scaled
+        down, its device residency already released through the placer
+        — so its dispatch outcomes (in-flight stragglers finishing
+        after the drain) are ignored: a parked slot's breaker must stay
+        closed, or the breaker's evict would double-count the
+        autoscaler's and its respawn would re-acquire residency the
+        autoscaler released.  Called BEFORE `_mu` is taken (the gate
+        has its own lock; never nested with ours — R007)."""
+        self._gate = gate
+
     def record_success(self, replica: int) -> None:
+        if self._gate is not None and not self._gate(replica):
+            return
         with self._mu:
             self._breakers[replica].record(True)
 
@@ -506,6 +521,8 @@ class ResilienceManager:
         effects OUTSIDE the lock: disable routing, drain + requeue the
         slot's pending items onto healthy replicas, release the device
         slot."""
+        if self._gate is not None and not self._gate(replica):
+            return
         with self._mu:
             br = self._breakers[replica]
             tripped = br.record(False)
@@ -521,12 +538,22 @@ class ResilienceManager:
             self._open_side_effects(replica)
 
     def _open_side_effects(self, replica: int) -> None:
-        self._sched.set_enabled(replica, False)
-        drained = self._sched.drain_replica(replica)
-        if drained:
-            self._sched.requeue(drained, exclude=replica)
-            with self._mu:
-                self._requeued += len(drained)
+        # The LAST enabled replica of a lane is never drained: zero
+        # enabled replicas would park every admitted item (scheduler
+        # fallback routing) and hang submit(wait=True) until timeout.
+        # The breaker opens anyway, but the slot RESPAWNS IN PLACE —
+        # it keeps routing (degraded: dispatches fail and retry
+        # loudly, bounded by max_retries) while the maintenance loop
+        # walks the usual evict -> rebuild -> half-open-probe cycle;
+        # the close-time re-enable is then a no-op.
+        drained: List = []
+        disabled = self._sched.disable_unless_last(replica)
+        if disabled:
+            drained = self._sched.drain_replica(replica)
+            if drained:
+                self._sched.requeue(drained, exclude=replica)
+                with self._mu:
+                    self._requeued += len(drained)
         device = None
         if self._placer is not None:
             try:
@@ -537,7 +564,8 @@ class ResilienceManager:
         with self._mu:
             trips = self._breakers[replica].trips
         self._event("replica_open", replica=replica, trips=trips,
-                    requeued=len(drained), device=_devstr(device))
+                    requeued=len(drained), device=_devstr(device),
+                    in_place=not disabled)
 
     # ------------------------------------------------------------ shedding
     def should_shed_batch(self, queued_total: int,
@@ -546,6 +574,8 @@ class ResilienceManager:
         NOW (admission raises RequestShed).  Interactive traffic is
         never shed — it only ever sees the plain overload 503 at a
         completely full queue."""
+        self._lm.stats.observe_sensors(
+            queue_fraction=queued_total / float(queue_depth))
         if queued_total >= self.cfg.shed_fraction * queue_depth:
             return (f"queue {queued_total}/{queue_depth} at or over "
                     f"shed fraction {self.cfg.shed_fraction}")
@@ -572,9 +602,12 @@ class ResilienceManager:
             return
         with self._mu:
             e = self._interactive_ewma_ms
-            self._interactive_ewma_ms = (
-                float(total_ms) if e is None
-                else 0.8 * e + 0.2 * float(total_ms))
+            ewma = (float(total_ms) if e is None
+                    else 0.8 * e + 0.2 * float(total_ms))
+            self._interactive_ewma_ms = ewma
+        # the one-set-of-numbers contract: the EWMA the shed controller
+        # acts on IS the gauge the autoscaler and operators read
+        self._lm.stats.observe_sensors(interactive_ewma_ms=ewma)
 
     def count_deadline_drop(self, stage: str, late_ms: float,
                             replica: Optional[int] = None) -> None:
@@ -719,6 +752,29 @@ class ResilienceManager:
     def all_closed(self) -> bool:
         with self._mu:
             return all(b.state == "closed" for b in self._breakers)
+
+    def breaker_state(self, i: int) -> str:
+        """One slot's breaker state ('closed'|'open'|'half_open') —
+        the autoscaler's eligibility query: a non-closed slot is the
+        BREAKER's to evict/respawn, never a scale victim or a scale-up
+        candidate (no double-counting)."""
+        with self._mu:
+            return self._breakers[int(i)].state
+
+    def open_breakers(self) -> int:
+        """Count of non-closed breakers — the autoscaler's errstorm
+        sensor: any open breaker suppresses scale-up (error-dominated
+        load is the breaker's job, not the autoscaler's)."""
+        with self._mu:
+            return sum(1 for b in self._breakers
+                       if b.state != "closed")
+
+    def interactive_ewma(self) -> Optional[float]:
+        """The interactive total-latency EWMA (ms; None before the
+        first completed interactive request) — the shared SLO sensor
+        the autoscaler reads."""
+        with self._mu:
+            return self._interactive_ewma_ms
 
     # ----------------------------------------------------------- lifecycle
     def stop(self) -> None:

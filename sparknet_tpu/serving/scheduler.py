@@ -166,6 +166,23 @@ class ReplicaScheduler:
             self._enabled[i] = bool(enabled)
             self._cv.notify_all()
 
+    def disable_unless_last(self, i: int) -> bool:
+        """Atomically disable replica i for routing UNLESS it is the
+        LAST enabled replica — then leave it routed and return False.
+        The check and the disable are one critical section, so two
+        breakers tripping concurrently on a 2-replica lane can never
+        interleave their way to zero enabled replicas (a zero-capacity
+        lane parks every admitted item and hangs submit(wait=True)
+        until its timeout — the respawn-in-place guard exists so that
+        can never happen)."""
+        with self._cv:
+            if self._enabled[i] and \
+                    sum(1 for e in self._enabled if e) <= 1:
+                return False
+            self._enabled[i] = False
+            self._cv.notify_all()
+            return True
+
     def is_enabled(self, i: int) -> bool:
         with self._cv:
             return self._enabled[i]
@@ -173,6 +190,13 @@ class ReplicaScheduler:
     def enabled_mask(self) -> List[bool]:
         with self._cv:
             return list(self._enabled)
+
+    def enabled_count(self) -> int:
+        """Replicas currently included in routing — the capacity floor
+        the breaker's respawn-in-place guard and the autoscaler's
+        min_replicas floor are both defined over."""
+        with self._cv:
+            return sum(1 for e in self._enabled if e)
 
     def drain_replica(self, i: int) -> List:
         """Atomically remove and return replica i's QUEUED items (the

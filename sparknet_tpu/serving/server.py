@@ -45,6 +45,7 @@ from typing import Dict, List, Optional, Sequence
 import numpy as np
 
 from ..obs.trace import now_s, span
+from .autoscale import AutoscaleConfig, Autoscaler
 from .buckets import pad_to_bucket, pick_bucket
 from .errors import (DeadlineExceeded, RequestShed, ServerClosed,
                      ServerOverloaded, ServingError)
@@ -84,6 +85,10 @@ class ServerConfig:
     # breakers + SLO-aware batch shedding + fault injection.  None (the
     # default) keeps every pre-resilience behavior bit-for-bit.
     resilience: Optional[ResilienceConfig] = None
+    # opt-in SLO-driven autoscaler (serving/autoscale.py): load() then
+    # treats `replicas` as the slot POOL and the autoscaler manages the
+    # active subset.  None keeps the fixed-replica-set behavior.
+    autoscale: Optional[AutoscaleConfig] = None
 
 
 @dataclass
@@ -132,6 +137,7 @@ class _Lane:
     sched: ReplicaScheduler
     stopping: bool = False
     resil: Optional[ResilienceManager] = None
+    auto: Optional[Autoscaler] = None
 
 
 class InferenceServer:
@@ -268,6 +274,15 @@ class InferenceServer:
                 model=name, sched=lane.sched, lm=lm,
                 registry=self.registry, placer=self._placer,
                 config=self.config.resilience)
+        if self.config.autoscale is not None:
+            # built LAST: its constructor parks the pool's tail (the
+            # slots above initial_replicas) through the scheduler and
+            # placer, and registers its activity gate on the manager
+            lane.auto = Autoscaler(
+                model=name, sched=lane.sched, lm=lm,
+                registry=self.registry, placer=self._placer,
+                queue_depth=self.config.queue_depth,
+                resil=lane.resil, config=self.config.autoscale)
         with self._lock:
             old = self._lanes.get(name)
             self._lanes[name] = lane
@@ -317,6 +332,11 @@ class InferenceServer:
 
     def _stop_lane(self, lane: _Lane, *, drain: bool) -> None:
         lane.stopping = True
+        if lane.auto is not None:
+            # autoscaler first: a scale-down mid-shutdown would drain
+            # into a closing scheduler; stopping it joins the daemon so
+            # no scaling action can be in flight below
+            lane.auto.stop()
         if lane.resil is not None:
             # stop the maintenance thread FIRST so no probe/respawn
             # races the scheduler teardown; breakers stay frozen
@@ -472,6 +492,12 @@ class InferenceServer:
         observability handle for breakers/events."""
         return self._lane(model).resil
 
+    def autoscaler(self, model: str) -> Optional[Autoscaler]:
+        """The model's autoscaler (None when the server was built
+        without an AutoscaleConfig) — the drill's and tests'
+        observability handle for scale events/accounting."""
+        return self._lane(model).auto
+
     def _lane(self, model: str) -> _Lane:
         with self._lock:
             lane = self._lanes.get(model)
@@ -616,6 +642,8 @@ class InferenceServer:
             per_model[name]["replicas"] = breakdown
             if lane.resil is not None:
                 per_model[name]["resilience"] = lane.resil.snapshot()
+            if lane.auto is not None:
+                per_model[name]["autoscale"] = lane.auto.snapshot()
         out: Dict[str, object] = {
             "models": per_model,
             "config": {"max_batch": self.config.max_batch,
@@ -624,7 +652,8 @@ class InferenceServer:
                        "min_fill": self.config.min_fill,
                        "default_deadline_ms":
                            self.config.default_deadline_ms,
-                       "resilience": self.config.resilience is not None},
+                       "resilience": self.config.resilience is not None,
+                       "autoscale": self.config.autoscale is not None},
             "accepting": self._accepting}
         if self._placer is not None:
             out["placement"] = self._placer.describe()

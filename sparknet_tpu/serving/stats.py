@@ -81,6 +81,11 @@ class ModelStats:
             # breaker-state gauges (lazy like the replica gauges, so
             # resilience-off servers keep the exact metric set)
             self._breaker_state: Dict[int, object] = {}
+            # shed-controller / autoscaler sensor gauges (lazy —
+            # created on first observe_sensors, so pre-resilience
+            # servers keep the exact metric set and snapshot() stays
+            # byte-pinned)
+            self._sensors: Dict[str, object] = {}
 
     @property
     def registry(self) -> MetricsRegistry:
@@ -166,6 +171,42 @@ class ModelStats:
                                          labels={"replica": str(i)})
                 self._breaker_state[i] = g
         g.set(code)
+
+    SENSOR_GAUGES = ("serving_queue_fraction",
+                     "serving_interactive_ewma_ms",
+                     "serving_active_replicas")
+
+    def observe_sensors(self, queue_fraction=None,
+                        interactive_ewma_ms=None,
+                        active_replicas=None) -> None:
+        """The shed controller's sensors — lane queue fraction and the
+        interactive total-latency EWMA — plus the autoscaler's active
+        replica count, exported as NAMED gauges
+        (`serving_queue_fraction` / `serving_interactive_ewma_ms` /
+        `serving_active_replicas`) in the same private registry, so the
+        autoscaler, the shedder, and an operator scraping the
+        Prometheus text all read the one set of numbers.  Lazy like the
+        replica gauges: snapshot()'s byte-pinned key contract is
+        untouched."""
+        updates = (("serving_queue_fraction", queue_fraction),
+                   ("serving_interactive_ewma_ms", interactive_ewma_ms),
+                   ("serving_active_replicas", active_replicas))
+        for name, v in updates:
+            if v is None:
+                continue
+            with self._lock:
+                g = self._sensors.get(name)
+                if g is None:
+                    g = self._registry.gauge(name)
+                    self._sensors[name] = g
+            g.set(float(v))
+
+    def sensor_values(self) -> Dict[str, float]:
+        """Current sensor-gauge values (only the ones ever observed) —
+        the autoscaler drill's one-set-of-numbers check."""
+        with self._lock:
+            return {name: float(g.value)
+                    for name, g in sorted(self._sensors.items())}
 
     def replica_breakdown(self) -> Dict[str, Dict[str, object]]:
         """replica index (str) -> {queued_now, queued_max, inflight_now,

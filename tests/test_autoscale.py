@@ -1,0 +1,390 @@
+"""SLO-driven autoscaler contract (sparknet_tpu/serving/autoscale.py):
+the ScalePolicy is a pure tick-indexed hysteresis/cooldown machine
+(bitwise-replayable over a seeded sensor trace, zero scale-ups under an
+errstorm — the doom-loop pin), AutoscaleConfig validates loudly and
+reads its SPARKNET_SERVE_SCALE_* env knobs, and the live Autoscaler
+grows/shrinks a warmed slot pool through the placer with exactly-once
+request semantics, a hard min_replicas floor, parked-slot invisibility
+to breaker accounting, and a JSONL event stream mirroring memory.
+
+The reference stack has no serving tier at all (training-side solver
+loop only: reference src/caffe/solver.cpp:178-290 Step), so these
+tests are the contract.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from sparknet_tpu.serving import (AutoscaleConfig, InferenceServer,
+                                  ResilienceConfig, ScalePolicy,
+                                  SensorSample, ServeFaultPlan,
+                                  ServerConfig, pad_to_bucket,
+                                  synthetic_sensor_trace)
+from sparknet_tpu.serving.autoscale import (LOAD_SHAPES,
+                                            SCALE_COOLDOWN_ENV,
+                                            SCALE_DOWN_Q_ENV,
+                                            SCALE_DOWN_TICKS_ENV,
+                                            SCALE_MIN_ENV,
+                                            SCALE_UP_Q_ENV,
+                                            SCALE_UP_TICKS_ENV)
+
+LENET_SHAPE = (1, 28, 28)
+
+SNAPSHOT_KEYS = {"pool", "active", "parked", "floor", "ups", "downs",
+                 "suppressed_ticks", "blocked_up", "blocked_down",
+                 "errors", "min_active", "max_active", "tick",
+                 "cooldown"}
+
+
+def _samples(n, seed=0, shape=LENET_SHAPE):
+    return np.random.RandomState(seed).rand(n, *shape).astype(np.float32)
+
+
+def _s(qf, ewma=None, open_n=0):
+    return SensorSample(queue_fraction=qf, interactive_ewma_ms=ewma,
+                        breakers_open=open_n)
+
+
+def _cfg(**kw):
+    kw.setdefault("min_replicas", 1)
+    kw.setdefault("up_queue_fraction", 0.5)
+    kw.setdefault("down_queue_fraction", 0.1)
+    kw.setdefault("up_ticks", 2)
+    kw.setdefault("down_ticks", 3)
+    kw.setdefault("cooldown_ticks", 3)
+    kw.setdefault("slo_ms", 100.0)
+    return AutoscaleConfig(**kw)
+
+
+# -------------------------------------------------------------- config
+def test_config_validation_contract():
+    for bad in (dict(min_replicas=0), dict(min_replicas=-2),
+                dict(initial_replicas=1, min_replicas=2),
+                dict(up_queue_fraction=0.0),
+                dict(up_queue_fraction=1.5),
+                dict(down_queue_fraction=-0.1),
+                dict(down_queue_fraction=0.5),   # must be < up fraction
+                dict(up_ticks=0), dict(down_ticks=0),
+                dict(cooldown_ticks=-1), dict(slo_ms=0.0),
+                dict(tick_s=0.0)):
+        with pytest.raises(ValueError):
+            _cfg(**bad)
+    assert _cfg(min_replicas=1).floor == 1
+    assert _cfg(min_replicas=3).floor == 3
+
+
+def test_config_env_knobs_and_explicit_override(monkeypatch):
+    """Every policy knob reads its SPARKNET_SERVE_SCALE_* env default
+    (R004 three-way pin: knobs.py + README + here); explicit
+    constructor values win over the environment."""
+    monkeypatch.setenv(SCALE_MIN_ENV, "2")
+    monkeypatch.setenv(SCALE_UP_Q_ENV, "0.7")
+    monkeypatch.setenv(SCALE_DOWN_Q_ENV, "0.2")
+    monkeypatch.setenv(SCALE_UP_TICKS_ENV, "4")
+    monkeypatch.setenv(SCALE_DOWN_TICKS_ENV, "9")
+    monkeypatch.setenv(SCALE_COOLDOWN_ENV, "11")
+    cfg = AutoscaleConfig()
+    assert cfg.min_replicas == 2 and cfg.floor == 2
+    assert cfg.up_queue_fraction == 0.7
+    assert cfg.down_queue_fraction == 0.2
+    assert cfg.up_ticks == 4 and cfg.down_ticks == 9
+    assert cfg.cooldown_ticks == 11
+    explicit = AutoscaleConfig(min_replicas=1, up_queue_fraction=0.5,
+                               down_queue_fraction=0.1, up_ticks=2,
+                               down_ticks=6, cooldown_ticks=8)
+    assert explicit.min_replicas == 1 and explicit.up_ticks == 2
+    for env in (SCALE_MIN_ENV, SCALE_UP_Q_ENV, SCALE_DOWN_Q_ENV,
+                SCALE_UP_TICKS_ENV, SCALE_DOWN_TICKS_ENV,
+                SCALE_COOLDOWN_ENV):
+        monkeypatch.delenv(env)
+    d = AutoscaleConfig()
+    assert (d.min_replicas, d.up_queue_fraction, d.down_queue_fraction,
+            d.up_ticks, d.down_ticks, d.cooldown_ticks) == \
+        (1, 0.5, 0.125, 2, 6, 8)
+
+
+# -------------------------------------------------------------- policy
+def test_policy_up_hysteresis_and_cooldown_refire():
+    """Overload must persist up_ticks consecutive ticks before an "up"
+    fires; the action opens a cooldown window during which everything
+    holds, but streaks keep accumulating so a still-overloaded lane
+    fires again the tick the window closes."""
+    pol = ScalePolicy(_cfg())
+    assert pol.decide(_s(0.9), active=1, pool=4) == ("hold", False)
+    assert pol.decide(_s(0.9), active=1, pool=4) == ("up", False)
+    # cooldown_ticks=3: three overloaded ticks hold...
+    for _ in range(3):
+        assert pol.decide(_s(0.9), active=2, pool=4) == ("hold", False)
+    # ...and the accumulated streak re-fires immediately after
+    assert pol.decide(_s(0.9), active=2, pool=4) == ("up", False)
+    # a single calm tick in the middle resets the streak
+    pol2 = ScalePolicy(_cfg(cooldown_ticks=0))
+    assert pol2.decide(_s(0.9), active=1, pool=4)[0] == "hold"
+    assert pol2.decide(_s(0.3), active=1, pool=4)[0] == "hold"
+    assert pol2.decide(_s(0.9), active=1, pool=4)[0] == "hold"
+    assert pol2.decide(_s(0.9), active=1, pool=4)[0] == "up"
+
+
+def test_policy_ewma_arm_and_pool_bound():
+    """An interactive EWMA over the SLO is overload even with an empty
+    queue; a full pool blocks "up" without consuming the streak."""
+    pol = ScalePolicy(_cfg(cooldown_ticks=0))
+    assert pol.decide(_s(0.0, ewma=150.0), active=1, pool=2)[0] == "hold"
+    assert pol.decide(_s(0.0, ewma=150.0), active=1, pool=2)[0] == "up"
+    # at active == pool the same pressure can never fire
+    for _ in range(6):
+        assert pol.decide(_s(0.0, ewma=150.0), active=2, pool=2)[0] == \
+            "hold"
+    # None EWMA (no interactive traffic yet) is not overload
+    pol3 = ScalePolicy(_cfg())
+    for _ in range(4):
+        assert pol3.decide(_s(0.2, ewma=None), active=1, pool=2)[0] == \
+            "hold"
+
+
+def test_policy_down_hysteresis_and_floor_bound():
+    pol = ScalePolicy(_cfg(cooldown_ticks=0))
+    assert pol.decide(_s(0.05), active=2, pool=4)[0] == "hold"
+    assert pol.decide(_s(0.05), active=2, pool=4)[0] == "hold"
+    assert pol.decide(_s(0.05), active=2, pool=4)[0] == "down"
+    # at the floor, idle pressure can never fire a "down"
+    for _ in range(8):
+        assert pol.decide(_s(0.0), active=1, pool=4)[0] == "hold"
+    # mid-band queue (neither overload nor idle) resets both streaks
+    pol2 = ScalePolicy(_cfg(cooldown_ticks=0))
+    pol2.decide(_s(0.05), active=2, pool=4)
+    pol2.decide(_s(0.05), active=2, pool=4)
+    pol2.decide(_s(0.3), active=2, pool=4)       # mid-band
+    assert pol2.decide(_s(0.05), active=2, pool=4)[0] == "hold"
+
+
+def test_policy_open_breaker_masks_overload():
+    """The doom-loop guard: overload while ANY breaker is open is
+    suppressed — no "up" ever fires, and the suppressed ticks are
+    flagged so the drill can count them.  Recovery starts the up
+    hysteresis from zero."""
+    pol = ScalePolicy(_cfg(cooldown_ticks=0))
+    for _ in range(10):
+        assert pol.decide(_s(0.95, ewma=900.0, open_n=1),
+                          active=1, pool=4) == ("hold", True)
+    assert pol.up_streak == 0
+    # suppressed ticks are not "idle" either: no down streak builds
+    assert pol.down_streak == 0
+    # the breaker closes -> full up_ticks hysteresis applies afresh
+    assert pol.decide(_s(0.95), active=1, pool=4) == ("hold", False)
+    assert pol.decide(_s(0.95), active=1, pool=4) == ("up", False)
+
+
+# -------------------------------------------------------------- replay
+def test_synthetic_trace_determinism_and_validation():
+    a = synthetic_sensor_trace("diurnal", seed=7, n_ticks=120)
+    b = synthetic_sensor_trace("diurnal", seed=7, n_ticks=120)
+    assert a == b and len(a) == 120          # bitwise (frozen dataclass)
+    c = synthetic_sensor_trace("diurnal", seed=8, n_ticks=120)
+    assert a != c
+    assert set(LOAD_SHAPES) == {"diurnal", "spike", "flash_crowd",
+                                "errstorm"}
+    with pytest.raises(ValueError, match="tsunami"):
+        synthetic_sensor_trace("tsunami")
+    with pytest.raises(ValueError, match="n_ticks"):
+        synthetic_sensor_trace("spike", n_ticks=0)
+    # errstorm: breakers open on EVERY tick, by construction
+    storm = synthetic_sensor_trace("errstorm", seed=1, n_ticks=40)
+    assert all(s.breakers_open == 1 for s in storm)
+
+
+def test_replay_and_schedule_digest_bitwise():
+    """The two-run replay contract the drill pins end-to-end: the same
+    (config, trace, initial, pool) always yields the same schedule
+    digest; a different seed or shape diverges.  Replayed active counts
+    respect [floor, pool] at every tick."""
+    cfg = _cfg(slo_ms=500.0)     # the traces' EWMAs are shaped vs 500
+    kw = dict(initial_active=1, pool=3)
+    for shape in LOAD_SHAPES:
+        t1 = synthetic_sensor_trace(shape, seed=11, n_ticks=240)
+        t2 = synthetic_sensor_trace(shape, seed=11, n_ticks=240)
+        assert ScalePolicy.schedule_digest(cfg, t1, **kw) == \
+            ScalePolicy.schedule_digest(cfg, t2, **kw)
+        for tick, action, suppressed, active in ScalePolicy.replay(
+                cfg, t1, **kw):
+            assert cfg.floor <= active <= 3
+    d = synthetic_sensor_trace("diurnal", seed=11, n_ticks=240)
+    assert ScalePolicy.schedule_digest(cfg, d, **kw) != \
+        ScalePolicy.schedule_digest(
+            cfg, synthetic_sensor_trace("diurnal", seed=12,
+                                        n_ticks=240), **kw)
+    assert ScalePolicy.schedule_digest(cfg, d, **kw) != \
+        ScalePolicy.schedule_digest(
+            cfg, synthetic_sensor_trace("spike", seed=11,
+                                        n_ticks=240), **kw)
+    # a diurnal swing actually exercises both directions
+    actions = [a for _, a, _, _ in ScalePolicy.replay(cfg, d, **kw)]
+    assert "up" in actions and "down" in actions
+
+
+def test_errstorm_trace_yields_zero_scale_ups():
+    """The doom-loop pin in schedule space: a saturated, error-dominated
+    trace (breakers open throughout) must produce ZERO "up" actions —
+    error recovery is the breaker's job, not the autoscaler's."""
+    cfg = _cfg(slo_ms=500.0)
+    for seed in (0, 3, 9):
+        storm = synthetic_sensor_trace("errstorm", seed=seed,
+                                       n_ticks=240)
+        sched = ScalePolicy.replay(cfg, storm, initial_active=2, pool=4)
+        assert sum(1 for _, a, _, _ in sched if a == "up") == 0
+        assert sum(1 for _, _, sup, _ in sched if sup) == len(sched)
+        assert all(active == 2 for _, _, _, active in sched)
+
+
+# -------------------------------------------- live server integration
+def _auto_server(tmp_path, pool=3, dispatch_ms=40.0, **akw):
+    """Server with every pool slot latency-spiked (dispatch_ms per
+    batch, via the seeded fault plan) so a submit burst builds real
+    queue pressure, and the autoscaler armed but driven SYNCHRONOUSLY
+    (tests stop the daemon and call step())."""
+    spike = ",".join(f"spike:{i}@0+1000000x{dispatch_ms}"
+                     for i in range(pool))
+    rcfg = ResilienceConfig(slo_ms=60_000.0, shed_fraction=1.0,
+                            tick_s=0.01,
+                            fault_plan=ServeFaultPlan.from_spec(
+                                spike, seed=1),
+                            event_log=str(tmp_path / "resil.jsonl"))
+    akw.setdefault("min_replicas", 1)
+    akw.setdefault("initial_replicas", 1)
+    akw.setdefault("up_queue_fraction", 0.4)
+    akw.setdefault("down_queue_fraction", 0.1)
+    akw.setdefault("up_ticks", 2)
+    akw.setdefault("down_ticks", 3)
+    akw.setdefault("cooldown_ticks", 2)
+    akw.setdefault("slo_ms", 60_000.0)
+    akw.setdefault("event_log", str(tmp_path / "scale.jsonl"))
+    acfg = AutoscaleConfig(**akw)
+    cfg = ServerConfig(max_batch=4, max_wait_ms=2.0, queue_depth=64,
+                       resilience=rcfg, autoscale=acfg)
+    return InferenceServer(cfg)
+
+
+def test_autoscaler_lifecycle_exactly_once(tmp_path):
+    """The tentpole end to end, timing-free (daemon stopped, policy
+    stepped synchronously): load() warms a 3-slot pool, the constructor
+    parks the tail down to initial_replicas=1 releasing placer
+    residency; a queue burst scales up onto a placer-chosen device with
+    exactly-once answers; drained calm scales back down to the hard
+    floor; parked slots are invisible to breaker accounting; events
+    mirror to JSONL; sensors export as named gauges; stats() carries
+    the snapshot."""
+    server = _auto_server(tmp_path)
+    try:
+        lm = server.load("lenet", replicas=3)
+        auto = server.autoscaler("lenet")
+        assert auto is not None
+        auto.stop()                     # drive the policy by hand
+        snap = auto.snapshot()
+        assert set(snap) == SNAPSHOT_KEYS
+        assert snap["pool"] == 3 and snap["floor"] == 1
+        assert snap["active"] == 1 and snap["parked"] == [1, 2]
+        init = [e for e in auto.events_snapshot()
+                if e["kind"] == "scale_init"]
+        assert len(init) == 1 and init[0]["parked"] == [1, 2]
+        # parked slots released their device residency back to the
+        # placer (evicted at the slot grain, like a tripped breaker)
+        placement = server.stats()["placement"]
+        assert placement["evicted"]["lenet"] == [1, 2]
+
+        # parked-slot invisibility: errors on a parked slot never move
+        # its breaker (the activity gate drops them), active slots do
+        mgr = server.resilience("lenet")
+        for _ in range(6):
+            mgr.record_error(2)
+        assert mgr.breaker_state(2) == "closed"
+
+        # ---- overload burst -> scale up ----
+        xs = _samples(48, seed=5)
+        futs = [server.submit("lenet", x, priority="interactive")
+                for x in xs]
+        assert auto._sense().queue_fraction >= 0.4
+        auto.step()                     # tick 1: streak builds
+        auto.step()                     # tick 2: "up" fires (blocking)
+        snap = auto.snapshot()
+        assert snap["ups"] == 1 and snap["active"] == 2
+        assert snap["max_active"] == 2 and snap["parked"] == [2]
+        ups = [e for e in auto.events_snapshot()
+               if e["kind"] == "scale_up"]
+        assert len(ups) == 1 and ups[0]["replica"] == 1
+        assert ups[0]["device"] is not None     # placer-chosen home
+        assert ups[0]["breakers_open"] == 0     # never under an outage
+        # every admitted request answers exactly once, bitwise
+        rs = [f.result(timeout=120) for f in futs]
+        assert len(rs) == 48
+        for i in (0, 20, 47):
+            np.testing.assert_array_equal(
+                np.asarray(rs[i].probs),
+                np.asarray(lm.runner.forward_padded(
+                    pad_to_bucket(xs[i][None], rs[i].bucket))[0]))
+
+        # ---- drained calm -> scale down to the floor ----
+        assert auto._sense().queue_fraction == 0.0
+        for _ in range(5):    # cooldown_ticks=2 + down_ticks=3
+            auto.step()
+        snap = auto.snapshot()
+        assert snap["downs"] == 1 and snap["active"] == 1
+        downs = [e for e in auto.events_snapshot()
+                 if e["kind"] == "scale_down"]
+        assert len(downs) == 1 and downs[0]["replica"] == 1
+        assert downs[0]["requeued"] == 0        # queue was empty
+        # the hard floor: continued idleness never fires another down
+        for _ in range(8):
+            auto.step()
+        snap = auto.snapshot()
+        assert snap["downs"] == 1 and snap["min_active"] == 1
+        assert snap["errors"] == 0
+
+        # ---- books agree everywhere ----
+        logged = [json.loads(line)
+                  for line in open(str(tmp_path / "scale.jsonl"))]
+        assert logged == auto.events_snapshot()
+        m = server.stats()["models"]["lenet"]
+        assert m["autoscale"]["pool"] == 3
+        assert m["autoscale"]["ups"] == 1 and m["autoscale"]["downs"] == 1
+        assert server.stats()["config"]["autoscale"] is True
+        # sensors export as named gauges in the model's registry
+        sv = lm.stats.sensor_values()
+        assert sv["serving_active_replicas"] == 1.0
+        assert "serving_queue_fraction" in sv
+        text = lm.stats.registry.prometheus_text()
+        assert "serving_queue_fraction" in text
+        assert "serving_active_replicas" in text
+        # service still healthy after the full cycle
+        r = server.submit("lenet", xs[0],
+                          priority="interactive").result(30)
+        assert r.argmax == int(np.argmax(np.asarray(r.probs)))
+    finally:
+        server.close(drain=True)
+
+
+def test_autoscale_floor_cannot_exceed_pool():
+    """min_replicas above the warmed slot pool is a LOAD-time error
+    (raised before the daemon starts or any slot is parked), not a
+    policy that can never satisfy its floor."""
+    from sparknet_tpu.serving.autoscale import Autoscaler
+
+    class _LM:
+        n_replicas = 2
+
+    with pytest.raises(ValueError, match="pool"):
+        Autoscaler(model="m", sched=None, lm=_LM(), registry=None,
+                   placer=None, queue_depth=16,
+                   config=AutoscaleConfig(min_replicas=3))
+
+
+def test_server_without_autoscale_has_no_daemon():
+    server = InferenceServer(ServerConfig(max_batch=4))
+    try:
+        server.load("lenet", buckets=[4])
+        assert server.autoscaler("lenet") is None
+        assert server.stats()["config"]["autoscale"] is False
+        assert "autoscale" not in server.stats()["models"]["lenet"]
+    finally:
+        server.close(drain=True)

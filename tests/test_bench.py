@@ -438,7 +438,7 @@ def test_bench_trainserve_leg_contract(monkeypatch):
 
     import bench
 
-    assert bench.BENCH_SCHEMA_VERSION == 8
+    assert bench.BENCH_SCHEMA_VERSION == 9
     canned = {"ok": True, "model": "lenet", "promotions": 2,
               "rejections": 1, "staleness_mean": 0.6, "staleness_max": 1.0,
               "swap_p99_delta_ms": 3.25, "dropped": 0, "completed": 132,
@@ -548,3 +548,69 @@ def test_bench_serving_resilience_leg_contract(monkeypatch):
     _Proc.stdout = _json.dumps(canned) + "\n"
     with pytest.raises(RuntimeError, match="dropped"):
         bench.bench_serving_resilience()
+
+
+def test_bench_serving_autoscale_leg_contract(monkeypatch):
+    """The serving_autoscale leg (schema v9) runs autoscale_drill.py
+    --smoke in a SUBPROCESS and parses one JSON line; pin the field
+    mapping against _KNOWN_FIELDS/_KNOWN_LEGS and every failure mode
+    the guarded leg relies on — non-zero exit, not-ok record, and the
+    exactly-once bar (dropped > 0 must RAISE, never land).  The live
+    path is tests/test_autoscale.py's end-to-end server test."""
+    import json as _json
+    import subprocess
+
+    import bench
+
+    canned = {"ok": True, "model": "lenet", "pool": 3, "ups": 4,
+              "downs": 4, "min_active": 1, "max_active": 3,
+              "dropped": 0, "completed": 1297,
+              "phases": [{"shape": "diurnal", "tail_p99_ms": 87.2},
+                         {"shape": "spike", "tail_p99_ms": 354.7},
+                         {"shape": "flash_crowd", "tail_p99_ms": 401.4}],
+              "storm": {"breaker_trips": 1, "ups_during_outage": 0},
+              "replay_bitwise": True}
+
+    class _Proc:
+        returncode = 0
+        stderr = ""
+        stdout = "progress noise\n" + _json.dumps(canned) + "\n"
+
+    calls = []
+
+    def fake_run(cmd, **kw):
+        calls.append(cmd)
+        return _Proc()
+
+    monkeypatch.setattr(subprocess, "run", fake_run)
+    r = bench.bench_serving_autoscale()
+    assert calls and calls[0][1].endswith("autoscale_drill.py")
+    assert "--smoke" in calls[0]
+    assert r["serving_autoscale_pool"] == 3
+    assert r["serving_autoscale_ups"] == 4
+    assert r["serving_autoscale_downs"] == 4
+    assert r["serving_autoscale_min_active"] == 1
+    assert r["serving_autoscale_max_active"] == 3
+    assert r["serving_autoscale_dropped"] == 0
+    assert r["serving_autoscale_completed"] == 1297
+    assert r["serving_autoscale_tail_p99_ms"] == 401.4  # max over phases
+    assert r["serving_autoscale_storm_trips"] == 1
+    assert r["serving_autoscale_storm_ups_during_outage"] == 0
+    assert r["serving_autoscale_replay_bitwise"] is True
+    assert set(r) <= bench._KNOWN_FIELDS
+    assert "serving_autoscale" in bench._KNOWN_LEGS
+
+    _Proc.returncode = 1
+    _Proc.stderr = "boom"
+    with pytest.raises(RuntimeError, match="exited 1"):
+        bench.bench_serving_autoscale()
+    _Proc.returncode = 0
+    canned["ok"] = False
+    _Proc.stdout = _json.dumps(canned) + "\n"
+    with pytest.raises(RuntimeError, match="not-ok"):
+        bench.bench_serving_autoscale()
+    canned["ok"] = True
+    canned["dropped"] = 3
+    _Proc.stdout = _json.dumps(canned) + "\n"
+    with pytest.raises(RuntimeError, match="dropped"):
+        bench.bench_serving_autoscale()

@@ -806,6 +806,10 @@ def test_lint_gate_script(tmp_path):
     # tests/test_serving_sharded.py's contract-census test)
     assert "--jaxpr serve-sharded" in text
     assert "SPARKNET_LINT_GATE_NO_SHARDED" in text
+    # ... and the autoscale drill (exercised live by the lifecycle tests
+    # in tests/test_autoscale.py)
+    assert "autoscale_drill.py --smoke" in text
+    assert "SPARKNET_LINT_GATE_NO_AUTOSCALE" in text
     clean = _mkpkg(tmp_path, {"ok.py": "x = 1\n"})
     dirty_dir = tmp_path / "dirty"
     dirty_dir.mkdir()
@@ -815,7 +819,8 @@ def test_lint_gate_script(tmp_path):
                SPARKNET_LINT_GATE_NO_CONTRACT="1",
                SPARKNET_LINT_GATE_NO_TRAINSERVE="1",
                SPARKNET_LINT_GATE_NO_SERVECHAOS="1",
-               SPARKNET_LINT_GATE_NO_SHARDED="1")
+               SPARKNET_LINT_GATE_NO_SHARDED="1",
+               SPARKNET_LINT_GATE_NO_AUTOSCALE="1")
     rc_clean = subprocess.run(
         ["bash", gate, clean, "--select", "R001"],
         cwd=REPO, env=env, capture_output=True, text=True)

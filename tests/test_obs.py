@@ -427,8 +427,8 @@ def test_bench_stamp_provenance():
 
     payload = {"metric": "x", "value": 1.0}
     out = bench._stamp(payload)
-    # v8: the serving_sharded A/B leg (bitwise + zero-recompile bars)
-    assert out["schema_version"] == bench.BENCH_SCHEMA_VERSION == 8
+    # v9: the serving_autoscale drill leg (doom-loop + zero-drop bars)
+    assert out["schema_version"] == bench.BENCH_SCHEMA_VERSION == 9
     assert "git_sha" in out and "env" in out
     assert all(k.startswith("SPARKNET_") for k in out["env"])
     assert out["value"] == 1.0

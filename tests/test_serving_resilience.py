@@ -493,6 +493,51 @@ def test_breaker_trips_evicts_respawns_and_readmits(tmp_path):
         server.close(drain=True)
 
 
+def test_breaker_on_last_replica_respawns_in_place(tmp_path):
+    """The last-enabled-replica guard: an error storm on a 1-replica
+    model trips the breaker, but `disable_unless_last` refuses the
+    disable so the slot RESPAWNS IN PLACE — routing capacity never hits
+    zero, submit() never hangs, the `replica_open` event carries
+    `in_place: true` with nothing drained, and the maintenance loop
+    still walks evict -> rebuild -> half-open-probe -> closed."""
+    plan = ServeFaultPlan.from_spec("errstorm:0@0+4", seed=2)
+    # the storm is exactly min_samples errors: the 4th trips the
+    # breaker; max_retries is raised so the rows batched into those
+    # dispatches survive the storm window and answer on the retries
+    server = _resil_server(tmp_path, fault_plan=plan, cooldown_s=0.1,
+                           half_open_probes=1, max_retries=6)
+    try:
+        server.load("lenet")                       # a single replica
+        mgr = server.resilience("lenet")
+        xs = _samples(8, seed=3)
+        futs = [server.submit("lenet", x, priority="interactive")
+                for x in xs]
+        rs = [f.result(timeout=60) for f in futs]  # exactly-once: no hang
+        assert len(rs) == 8
+        deadline = time.perf_counter() + 20.0
+        while not mgr.all_closed() and time.perf_counter() < deadline:
+            time.sleep(0.02)
+        snap = mgr.snapshot()
+        assert snap["trips"] >= 1 and snap["respawns"] >= 1
+        assert snap["breakers"] == {"0": "closed"}
+        opens = [e for e in mgr.events_snapshot()
+                 if e["kind"] == "replica_open"]
+        assert opens and all(e["in_place"] for e in opens)
+        assert all(e["requeued"] == 0 for e in opens)  # nothing drained
+        # capacity never zeroed: no request ever errored out, and fresh
+        # post-recovery traffic answers normally
+        assert server.stats()["models"]["lenet"]["failed"] == 0
+        r = server.submit("lenet", xs[0],
+                          priority="interactive").result(30)
+        assert r.argmax == int(np.argmax(np.asarray(r.probs)))
+        # the JSONL mirror carries the in_place stamp too
+        logged = [json.loads(line) for line in open(mgr.cfg.event_log)]
+        logged_opens = [e for e in logged if e["kind"] == "replica_open"]
+        assert logged_opens == opens
+    finally:
+        server.close(drain=True)
+
+
 def _overload_soak(tmp_path, tag, seed=13):
     """One seeded kill + flash-crowd pass; returns (digest, metrics).
     Latency spikes on every replica make the crowd outrun service

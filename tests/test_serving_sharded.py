@@ -401,6 +401,136 @@ def test_breaker_trip_on_sharded_replica_rebuilds_the_slice(tmp_path):
         server.close(drain=True)
 
 
+@needs_mesh
+def test_sharded_multi_fault_chaos_keeps_slices_and_answers(tmp_path):
+    """Slice-granularity chaos beyond a single storm: a seeded plan
+    mixing an error storm on slice 0, latency spikes on slice 1, and a
+    flaky per-dispatch error draw.  Whatever interleaving the threads
+    pick, the invariants hold: every admitted request answers exactly
+    once bitwise, every trip's evict/respawn moves a whole 4-device
+    slice (never a partial one), rebuilds land on the SAME slice, and
+    every breaker re-closes."""
+    from sparknet_tpu.serving import ResilienceConfig, ServeFaultPlan
+
+    spec = "errstorm:0@0+6,spike:1@0+40x8,flaky:0.05"
+    plan = ServeFaultPlan.from_spec(spec, seed=5)
+    # the plan schedule itself replays bitwise at the slice grain
+    assert plan.schedule_digest(2, 512) == \
+        ServeFaultPlan.from_spec(spec, seed=5).schedule_digest(2, 512)
+    rcfg = ResilienceConfig(cooldown_s=0.1, tick_s=0.01,
+                            half_open_probes=1, max_retries=8,
+                            fault_plan=plan,
+                            event_log=str(tmp_path / "events.jsonl"))
+    server = InferenceServer(ServerConfig(max_batch=4, max_wait_ms=2.0,
+                                          queue_depth=64,
+                                          resilience=rcfg))
+    try:
+        lm = server.load("lenet", buckets=[4], replicas=2, shards=SHARDS)
+        slices = {i: [str(d) for d in lm.replicas[i].slice_devices]
+                  for i in (0, 1)}
+        mgr = server.resilience("lenet")
+        xs = _samples(32, seed=21)
+        futs = []
+        for i in range(32):
+            futs.append(server.submit("lenet", xs[i]))
+            time.sleep(0.004)
+        rs = [f.result(timeout=120) for f in futs]   # exactly-once
+        assert len(rs) == 32
+        for i in (0, 13, 31):
+            np.testing.assert_array_equal(
+                np.asarray(rs[i].probs),
+                np.asarray(lm.runner.forward_padded(
+                    pad_to_bucket(xs[i][None], rs[i].bucket))[0]))
+        deadline = time.perf_counter() + 30.0
+        while not mgr.all_closed() and time.perf_counter() < deadline:
+            time.sleep(0.02)
+        snap = mgr.snapshot()
+        assert snap["trips"] >= 1
+        assert snap["breakers"] == {"0": "closed", "1": "closed"}
+        # each replica still owns its original full-width slice
+        for i in (0, 1):
+            assert lm.replicas[i].shards == SHARDS
+            assert [str(d)
+                    for d in lm.replicas[i].slice_devices] == slices[i]
+        # every open/respawn event moved a whole slice, never a device
+        events = mgr.events_snapshot()
+        for e in events:
+            if e["kind"] in ("replica_open", "replica_respawn") \
+                    and e.get("device") is not None:
+                assert e["device"] == slices[e["replica"]]
+        assert server.stats()["models"]["lenet"]["failed"] == 0
+    finally:
+        server.close(drain=True)
+
+
+@needs_mesh
+def test_autoscaler_scales_sharded_slices(tmp_path):
+    """The autoscaler composes with PR 17's shards=N: the unit it parks
+    and un-parks is a whole 4-device mesh SLICE.  Parking slot 1 at
+    construction releases its slice to the placer; the scale-up
+    respawns onto a least-loaded slice (event device = the 4-device
+    list), rebuilds the sharded runner there, and answers stay bitwise;
+    the scale-down releases the slice again."""
+    from sparknet_tpu.serving import (AutoscaleConfig, ResilienceConfig,
+                                      ServeFaultPlan)
+
+    spike = ",".join(f"spike:{i}@0+1000000x40" for i in range(2))
+    rcfg = ResilienceConfig(slo_ms=60_000.0, shed_fraction=1.0,
+                            tick_s=0.01,
+                            fault_plan=ServeFaultPlan.from_spec(
+                                spike, seed=1),
+                            event_log=str(tmp_path / "resil.jsonl"))
+    acfg = AutoscaleConfig(min_replicas=1, initial_replicas=1,
+                           up_queue_fraction=0.4,
+                           down_queue_fraction=0.1, up_ticks=2,
+                           down_ticks=3, cooldown_ticks=2,
+                           slo_ms=60_000.0,
+                           event_log=str(tmp_path / "scale.jsonl"))
+    server = InferenceServer(ServerConfig(max_batch=4, max_wait_ms=2.0,
+                                          queue_depth=64,
+                                          resilience=rcfg,
+                                          autoscale=acfg))
+    try:
+        lm = server.load("lenet", buckets=[4], replicas=2, shards=SHARDS)
+        auto = server.autoscaler("lenet")
+        auto.stop()                     # drive the policy by hand
+        # slot 1 parked at construction: its whole slice went back to
+        # the placer, at the slice grain
+        pl = server.stats()["placement"]
+        assert pl["evicted"]["lenet"] == [1]
+        assert pl["shards"]["lenet"] == SHARDS
+        xs = _samples(40, seed=9)
+        futs = [server.submit("lenet", x, priority="interactive")
+                for x in xs]
+        auto.step()
+        auto.step()                     # "up" fires, blocking rebuild
+        ups = [e for e in auto.events_snapshot()
+               if e["kind"] == "scale_up"]
+        assert len(ups) == 1 and ups[0]["replica"] == 1
+        dev = ups[0]["device"]
+        assert isinstance(dev, list) and len(dev) == SHARDS
+        assert lm.replicas[1].shards == SHARDS
+        assert [str(d) for d in lm.replicas[1].slice_devices] == dev
+        rs = [f.result(timeout=120) for f in futs]   # exactly-once
+        assert len(rs) == 40
+        for i in (0, 39):
+            np.testing.assert_array_equal(
+                np.asarray(rs[i].probs),
+                np.asarray(lm.runner.forward_padded(
+                    pad_to_bucket(xs[i][None], rs[i].bucket))[0]))
+        for _ in range(5):              # cooldown 2 + down_ticks 3
+            auto.step()
+        downs = [e for e in auto.events_snapshot()
+                 if e["kind"] == "scale_down"]
+        assert len(downs) == 1 and downs[0]["replica"] == 1
+        assert isinstance(downs[0]["device"], list)
+        snap = auto.snapshot()
+        assert snap["active"] == 1 and snap["errors"] == 0
+        assert server.stats()["models"]["lenet"]["failed"] == 0
+    finally:
+        server.close(drain=True)
+
+
 # -------------------------------------------------- program contract
 
 
