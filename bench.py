@@ -787,6 +787,56 @@ def bench_serving_autoscale():
     return out
 
 
+def bench_serving_fleet():
+    """Fleet-vs-in-process serving A/B via `scripts/fleet_bench.py
+    --smoke` in a subprocess: interleaved closed bursts through the
+    OS-process fleet router (serving/fleet.py) and through the plain
+    in-process server at the same replica count — the record carries
+    both arms' median QPS + pooled p50/p99, the speedup ratio (an
+    honest wash or deficit on one contended core: the leg prices the
+    IPC tax, the chaos drill prices the isolation win), and the
+    zero-restart bar (dropped must be 0 or the leg raises; the smoke
+    itself also asserts bitwise A/B parity across the process
+    boundary).
+
+    A subprocess for a clean CPU backend and because the smoke's exit
+    code IS the pass/fail signal; re-raises on a non-zero exit or a
+    not-ok line so the guarded leg in _run_legs omits the fields."""
+    import subprocess
+
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+    script = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "scripts", "fleet_bench.py")
+    proc = subprocess.run(
+        [sys.executable, script, "--smoke"],
+        capture_output=True, text=True, env=env, timeout=900)
+    if proc.returncode != 0:
+        raise RuntimeError(
+            f"fleet_bench.py exited {proc.returncode}: "
+            f"{proc.stderr.strip()[-500:]}")
+    # fleet_bench prints ONE JSON line on stdout (chaos_run contract)
+    rec = json.loads(proc.stdout.strip().splitlines()[-1])
+    if not rec.get("ok"):
+        raise RuntimeError(f"fleet_bench.py reported not-ok: {rec}")
+    if rec.get("dropped"):
+        raise RuntimeError(
+            f"fleet bench dropped {rec['dropped']} requests (every "
+            f"request must be answered exactly once): {rec}")
+    out = {"serving_fleet_workers": int(rec["workers"]),
+           "serving_fleet_qps": rec["fleet_qps"],
+           "serving_fleet_single_qps": rec["single_qps"],
+           "serving_fleet_speedup": rec["speedup"],
+           "serving_fleet_p50_ms": rec["fleet_p50_ms"],
+           "serving_fleet_p99_ms": rec["fleet_p99_ms"],
+           "serving_fleet_dropped": int(rec["dropped"]),
+           "serving_fleet_restarts": int(rec["worker_restarts"]),
+           "serving_fleet_parity_failed": int(rec["parity_failed"])}
+    log(json.dumps(out))
+    return out
+
+
 def bench_longctx_lm(seq_len: int = 16384, n_layers: int = 4,
                      d_model: int = 512, heads: int = 8,
                      block: int = 1024):
@@ -1120,6 +1170,15 @@ _KNOWN_FIELDS = {
     "serving_autoscale_storm_trips",
     "serving_autoscale_storm_ups_during_outage",
     "serving_autoscale_replay_bitwise",
+    # fleet serving A/B (schema v10): OS-process workers behind the
+    # router vs the in-process server at the same replica count —
+    # honest-wash QPS arms, the IPC-tax ratio, and the zero-restart /
+    # bitwise-parity bars from fleet_bench.py --smoke
+    "serving_fleet_workers", "serving_fleet_qps",
+    "serving_fleet_single_qps", "serving_fleet_speedup",
+    "serving_fleet_p50_ms", "serving_fleet_p99_ms",
+    "serving_fleet_dropped", "serving_fleet_restarts",
+    "serving_fleet_parity_failed",
 }
 
 # every leg name main() lands; leg_utc stamps outside this set (renamed
@@ -1130,7 +1189,7 @@ _KNOWN_LEGS = {
     "alexnet_infer", "googlenet_infer", "longctx_lm", "cifar_e2e",
     "imagenet_native", "serving", "serving_int8", "serving_mesh",
     "serving_sharded", "elastic", "trainserve", "serving_resilience",
-    "serving_autoscale",
+    "serving_autoscale", "serving_fleet",
 }
 
 
@@ -1213,7 +1272,13 @@ def _stale_record(reason: str) -> dict:
     return stale
 
 
-BENCH_SCHEMA_VERSION = 9  # v9: serving_autoscale leg (autoscaling
+BENCH_SCHEMA_VERSION = 10  # v10: serving_fleet leg (OS-process fleet
+#                           router vs in-process server, interleaved
+#                           closed bursts — both arms' median QPS +
+#                           p50/p99, speedup ratio, zero-drop /
+#                           zero-restart / bitwise cross-process
+#                           parity bars; fleet_bench.py subprocess);
+#                           v9: serving_autoscale leg (autoscaling
 #                           drill — scale-up/down counts through the
 #                           placer, converged tail p99, errstorm
 #                           doom-loop bar (zero ups during the outage),
@@ -1628,6 +1693,20 @@ def _run_legs(land) -> None:
             "serving_autoscale_storm_trips",
             "serving_autoscale_storm_ups_during_outage",
             "serving_autoscale_replay_bitwise")})
+    # fleet serving A/B (subprocess; CPU path) — OS-process workers vs
+    # in-process replicas, interleaved bursts; zero-drop, zero-restart
+    # and bitwise cross-process parity bars
+    try:
+        fleet = bench_serving_fleet()
+    except Exception as e:
+        log(f"serving_fleet leg failed, omitting its fields: {e!r}")
+    else:
+        land("serving_fleet", {k: fleet[k] for k in (
+            "serving_fleet_workers", "serving_fleet_qps",
+            "serving_fleet_single_qps", "serving_fleet_speedup",
+            "serving_fleet_p50_ms", "serving_fleet_p99_ms",
+            "serving_fleet_dropped", "serving_fleet_restarts",
+            "serving_fleet_parity_failed")})
     try:
         imgnet_native = bench_imagenet_native()
     except Exception as e:

@@ -1,0 +1,215 @@
+"""Fleet-vs-in-process serving A/B: closed-loop bursts through the
+OS-process fleet router (serving/fleet.py) interleaved with identical
+bursts through the plain in-process server at the same replica count,
+printing ONE JSON line (the bench.py `serving_fleet` leg subprocess
+protocol — same contract as serve_chaos_run.py).
+
+Interleaved A/B is this box's measurement discipline (CLAUDE.md: ~8%
+run-to-run variance — confirm deltas with interleaved runs): the arms
+alternate round by round and each arm reports its MEDIAN burst QPS, so
+drift hits both arms equally.  On one contended CPU core the expected
+result is an honest wash or a fleet deficit (every fleet dispatch pays
+a frame round trip and the workers share the core); the leg exists to
+put a NUMBER on that IPC tax and to catch regressions in it — the
+fleet's win is isolation (a worker's death/GIL/compile never blocks
+the router), which the chaos drill measures, not throughput on one
+core.
+
+--smoke asserts the accounting bar: every request in every burst
+completes (dropped == 0), zero worker restarts during the measurement
+(a restart means the fleet was unhealthy, not slow), and a bitwise
+parity spot check between the two arms' responses.
+
+Run:  python scripts/fleet_bench.py --smoke [--workers 2] [--rounds 3]
+      [--requests 48] [--model lenet]
+"""
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import time
+
+# force the CPU platform BEFORE any backend use; the box's sitecustomize
+# pre-imports jax, so the live-config update is what actually takes
+# effect (tests/conftest.py pattern)
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+
+def _pct(vals, q):
+    import numpy as np
+
+    if not vals:
+        return 0.0
+    return round(float(np.percentile(np.asarray(vals, np.float64), q)), 3)
+
+
+def _median(vals):
+    import numpy as np
+
+    return round(float(np.median(np.asarray(vals, np.float64))), 3)
+
+
+def _burst(submit, model, pool, n, lat_out):
+    """One closed-loop burst: submit n requests (blocking admission),
+    resolve every future, return (wall_s, completed, dropped)."""
+    t0 = time.perf_counter()
+    futs = [submit(model, pool[i % len(pool)], wait=True)
+            for i in range(n)]
+    completed = dropped = 0
+    last = None
+    for fut in futs:
+        try:
+            r = fut.result(timeout=180)
+            lat_out.append(r.total_ms)
+            completed += 1
+            last = r
+        except Exception:
+            dropped += 1
+    return time.perf_counter() - t0, completed, dropped, last
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="fleet_bench",
+        description="fleet vs in-process serving A/B "
+                    "(ONE JSON line on stdout)")
+    ap.add_argument("--smoke", action="store_true",
+                    help="assert the accounting bar and exit non-zero "
+                         "on a miss")
+    ap.add_argument("--model", default="lenet")
+    ap.add_argument("--workers", type=int, default=2)
+    ap.add_argument("--rounds", type=int, default=3,
+                    help="interleaved burst pairs per arm")
+    ap.add_argument("--requests", type=int, default=48,
+                    help="requests per closed burst")
+    ap.add_argument("--max_batch", type=int, default=8)
+    ap.add_argument("--seed", type=int, default=7)
+    ap.add_argument("--workdir", default=None)
+    a = ap.parse_args(argv)
+
+    import numpy as np
+
+    from sparknet_tpu.serving import InferenceServer, ServerConfig
+    from sparknet_tpu.serving.fleet import FleetConfig, FleetServer
+
+    workdir = a.workdir or tempfile.mkdtemp(prefix="sparknet-fleetbench-")
+    os.makedirs(workdir, exist_ok=True)
+    t_start = time.perf_counter()
+
+    fleet = FleetServer(FleetConfig(
+        workers=a.workers, max_batch=a.max_batch,
+        queue_depth=4 * a.requests, workdir=workdir))
+    fm = fleet.load(a.model, seed=a.seed)
+    single = InferenceServer(ServerConfig(
+        max_batch=a.max_batch, queue_depth=4 * a.requests))
+    single.load(a.model, seed=a.seed, replicas=a.workers)
+    print(f"A/B armed: {a.model} x {a.workers} worker processes vs "
+          f"{a.workers} in-process replicas, {a.rounds} x "
+          f"{a.requests}-request bursts per arm", file=sys.stderr,
+          flush=True)
+
+    rng = np.random.RandomState(a.seed)
+    pool = rng.rand(64, *fm.sample_shape).astype(np.float32)
+
+    # one untimed warm burst per arm (first dispatches pay queue/thread
+    # ramp; compile warmup already happened at load)
+    _burst(fleet.submit, a.model, pool, a.max_batch, [])
+    _burst(single.submit, a.model, pool, a.max_batch, [])
+
+    fleet_lat, single_lat = [], []
+    fleet_qps, single_qps = [], []
+    completed = {"fleet": 0, "single": 0}
+    dropped = {"fleet": 0, "single": 0}
+    parity_pairs = 0
+    parity_failed = 0
+    for rnd in range(a.rounds):
+        # alternate which arm goes first so neither always runs hot
+        order = (("fleet", fleet), ("single", single))
+        if rnd % 2:
+            order = order[::-1]
+        last = {}
+        for arm, server in order:
+            lat = fleet_lat if arm == "fleet" else single_lat
+            wall, comp, drop, last[arm] = _burst(
+                server.submit, a.model, pool, a.requests, lat)
+            (fleet_qps if arm == "fleet" else single_qps).append(
+                a.requests / wall if wall > 0 else 0.0)
+            completed[arm] += comp
+            dropped[arm] += drop
+        # bitwise parity spot check: the LAST request of each burst is
+        # the same sample; same bucket => same padded program => the
+        # probs must agree bitwise across the process boundary
+        fr, sr = last.get("fleet"), last.get("single")
+        if fr is not None and sr is not None and fr.bucket == sr.bucket:
+            parity_pairs += 1
+            if not np.array_equal(np.asarray(fr.probs),
+                                  np.asarray(sr.probs)):
+                parity_failed += 1
+
+    snap = fleet.fleet_snapshot()
+    fleet.close()
+    single.close(drain=True)
+
+    fq, sq = _median(fleet_qps), _median(single_qps)
+    summary = {
+        "ok": True,
+        "model": a.model,
+        "workers": a.workers,
+        "rounds": a.rounds,
+        "requests_per_burst": a.requests,
+        "seed": a.seed,
+        "elapsed_s": round(time.perf_counter() - t_start, 3),
+        "fleet_qps": fq,
+        "single_qps": sq,
+        "speedup": round(fq / sq, 4) if sq else 0.0,
+        "fleet_p50_ms": _pct(fleet_lat, 50),
+        "fleet_p99_ms": _pct(fleet_lat, 99),
+        "single_p50_ms": _pct(single_lat, 50),
+        "single_p99_ms": _pct(single_lat, 99),
+        "fleet_completed": completed["fleet"],
+        "single_completed": completed["single"],
+        "dropped": dropped["fleet"] + dropped["single"],
+        "worker_restarts": int(snap["restarts"]),
+        "parity_pairs": parity_pairs,
+        "parity_failed": parity_failed,
+        "workdir": workdir,
+    }
+
+    if a.smoke:
+        expect = a.rounds * a.requests
+        problems = []
+        if summary["dropped"] != 0:
+            problems.append(f"dropped {summary['dropped']} != 0")
+        if completed["fleet"] != expect:
+            problems.append(f"fleet completed {completed['fleet']} != "
+                            f"{expect}")
+        if completed["single"] != expect:
+            problems.append(f"single completed {completed['single']} "
+                            f"!= {expect}")
+        if summary["worker_restarts"] != 0:
+            problems.append(f"{summary['worker_restarts']} worker "
+                            f"restarts during a fault-free measurement")
+        if parity_pairs == 0:
+            problems.append("no same-bucket burst pair to parity-check")
+        if parity_failed:
+            problems.append(f"{parity_failed} A/B response pairs "
+                            f"differ bitwise")
+        if fq <= 0 or sq <= 0:
+            problems.append(f"degenerate QPS (fleet {fq}, single {sq})")
+        if problems:
+            summary["ok"] = False
+            summary["problems"] = problems
+    print(json.dumps(summary), flush=True)
+    return 0 if summary.get("ok") else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -26,6 +26,10 @@
 # SPARKNET_LINT_GATE_NO_SHARDED=1 skips the sharded-serving contract leg
 # (compiles the gspmd slice forward at shards=4 and diffs its HLO
 # collective census against CONTRACTS.json; needs the 8-device mesh).
+# SPARKNET_LINT_GATE_NO_FLEET=1 skips the fleet-serving smoke
+# (scripts/serve_chaos_run.py --fleet: OS worker processes behind the
+# router, REAL SIGKILL mid-burst; trip/respawn/re-admit at process
+# grain, zero dropped, bitwise cross-process parity).
 set -euo pipefail
 cd "$(dirname "$0")/.."
 python -m sparknet_tpu.cli lint --format json "$@"
@@ -71,6 +75,19 @@ if [ "${SPARKNET_LINT_GATE_NO_SERVECHAOS:-0}" != "1" ]; then
     timeout -k 10 420 env JAX_PLATFORMS=cpu \
         XLA_FLAGS=--xla_force_host_platform_device_count=8 \
         python scripts/serve_chaos_run.py --smoke
+fi
+if [ "${SPARKNET_LINT_GATE_NO_FLEET:-0}" != "1" ]; then
+    # fleet-serving smoke: the process-granularity arm of the serving
+    # chaos drill — 2 OS worker processes behind the fleet router, an
+    # error storm trips one worker's breaker and a REAL SIGKILL lands
+    # on the other mid-burst; both respawn as fresh processes and earn
+    # re-admission through half-open probes, every request is answered
+    # exactly once, and responses stay bitwise identical to an
+    # in-process reference (--smoke exits non-zero on a miss; prints
+    # ONE JSON line)
+    timeout -k 10 420 env JAX_PLATFORMS=cpu \
+        python scripts/serve_chaos_run.py --smoke --fleet 2 \
+        --requests 64 --qps 200
 fi
 if [ "${SPARKNET_LINT_GATE_NO_AUTOSCALE:-0}" != "1" ]; then
     # autoscale drill: diurnal/spike/flash-crowd load against the live

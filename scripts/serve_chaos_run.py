@@ -29,6 +29,20 @@ schedule, like elastic/chaos.py).
 Run:  python scripts/serve_chaos_run.py --smoke [--requests 240]
       [--qps 300] [--replicas 3] [--spec 'errstorm:0@6+10,kill:1@4']
       [--workdir DIR]
+
+--fleet N runs the drill at PROCESS granularity instead: N OS worker
+processes behind the fleet router (serving/fleet.py), same seeded
+ServeFaultPlan grammar — but `kill` is a REAL SIGKILL delivered to a
+live worker pid mid-burst, `errstorm` trips a process breaker, and
+recovery is a fresh OS process earning re-admission through half-open
+probes.  The smoke bar asserts both faulted workers trip + respawn +
+re-admit, every request is answered exactly once (dropped == 0), the
+generation never bumps, the fault schedule replays bitwise, and
+responses stay BITWISE identical to an in-process reference server
+built from the same (model, seed) — the cross-process parity pin.
+
+Run:  python scripts/serve_chaos_run.py --smoke --fleet 3
+      [--requests 96] [--spec 'errstorm:0@4+8,kill:1@3']
 """
 
 import argparse
@@ -52,6 +66,11 @@ jax.config.update("jax_platforms", "cpu")
 DEFAULT_SPEC = ("errstorm:0@6+10,kill:1@4,"
                 "spike:0@0+4000x8,spike:1@0+4000x8,spike:2@0+4000x8")
 
+# process-granularity default: one error-storm worker, one REAL SIGKILL
+# worker; no spikes (a fleet dispatch already carries a full IPC round
+# trip, and respawns pay a process spawn + compile warmup each)
+DEFAULT_FLEET_SPEC = "errstorm:0@4+8,kill:1@3"
+
 
 def _pct(vals, q):
     import numpy as np
@@ -59,6 +78,205 @@ def _pct(vals, q):
     if not vals:
         return 0.0
     return round(float(np.percentile(np.asarray(vals, np.float64), q)), 3)
+
+
+def _run_fleet(a) -> int:
+    """The --fleet arm: same seeded fault grammar, process granularity.
+    `kill` SIGKILLs a live worker pid mid-burst; recovery is a fresh OS
+    process earning re-admission through half-open probes.  Prints the
+    same ONE-JSON-line contract."""
+    import numpy as np
+
+    from sparknet_tpu.serving import (InferenceServer, ServeFaultPlan,
+                                      ServerConfig, ServingError,
+                                      pad_to_bucket)
+    from sparknet_tpu.serving.fleet import FleetConfig, FleetServer
+
+    workdir = a.workdir or tempfile.mkdtemp(prefix="sparknet-fleetchaos-")
+    os.makedirs(workdir, exist_ok=True)
+    event_log = os.path.join(workdir, "fleet_events.jsonl")
+
+    # bitwise-replay contract: two independent same-seed constructions
+    # of the plan must agree on every (worker, dispatch) decision
+    plan = ServeFaultPlan.from_spec(a.spec, seed=a.seed)
+    plan_replay = ServeFaultPlan.from_spec(a.spec, seed=a.seed)
+    digest = plan.schedule_digest(a.fleet, 2048)
+    replay_bitwise = digest == plan_replay.schedule_digest(a.fleet, 2048)
+
+    fs = FleetServer(FleetConfig(
+        workers=a.fleet, max_batch=a.max_batch, max_wait_ms=2.0,
+        queue_depth=a.queue_depth, cooldown_s=a.cooldown_s,
+        tick_s=0.03, fault_plan=plan, event_log=event_log,
+        workdir=workdir))
+    t_start = time.perf_counter()
+    fm = fs.load(a.model, seed=a.seed)
+    print(f"fleet loaded {a.model}: {a.fleet} worker processes, "
+          f"buckets {fm.buckets}; spec {a.spec!r}", file=sys.stderr,
+          flush=True)
+
+    # in-process reference from the same (model, seed): the
+    # cross-process parity pin compares fleet responses BITWISE against
+    # a direct forward at the recorded bucket
+    ref = InferenceServer(ServerConfig(max_batch=a.max_batch))
+    ref_lm = ref.load(a.model, seed=a.seed, replicas=1)
+
+    rng = np.random.RandomState(a.seed)
+    pool = rng.rand(64, *fm.sample_shape).astype(np.float32)
+    pris = ["interactive" if rng.rand() < a.interactive_frac else "batch"
+            for _ in range(a.requests)]
+    unit = rng.exponential(1.0, size=a.requests)
+
+    futs = []
+    sync_rejects = {}
+    t0 = time.perf_counter()
+    next_t = t0
+    for i in range(a.requests):
+        mult = a.shape_factor if i / a.requests >= 0.5 else 1.0
+        next_t += unit[i] / (a.qps * mult)
+        now = time.perf_counter()
+        if next_t > now:
+            time.sleep(next_t - now)
+        kw = {}
+        if (a.deadline_every and pris[i] == "interactive"
+                and i % a.deadline_every == 0):
+            kw["deadline_ms"] = a.deadline_ms
+        try:
+            futs.append((i, pris[i],
+                         fs.submit(a.model, pool[i % 64],
+                                   priority=pris[i], **kw)))
+        except ServingError as e:
+            kind = type(e).__name__
+            sync_rejects[kind] = sync_rejects.get(kind, 0) + 1
+    offered_s = time.perf_counter() - t0
+
+    lat_by_pri = {"interactive": [], "batch": []}
+    generations = set()
+    async_errs = {}
+    dropped = 0
+    parity_failed = 0
+    parity_checked = 0
+    for rid, pri, fut in futs:
+        try:
+            r = fut.result(timeout=180)
+        except ServingError as e:
+            kind = type(e).__name__
+            async_errs[kind] = async_errs.get(kind, 0) + 1
+            continue
+        except Exception:
+            dropped += 1      # future died without a serving status
+            continue
+        lat_by_pri[pri].append(r.total_ms)
+        generations.add(r.generation)
+        if parity_checked < a.parity_checks:
+            parity_checked += 1
+            probs_ref = ref_lm.runner.forward_padded(pad_to_bucket(
+                pool[rid % 64][None], r.bucket))[0]
+            if not np.array_equal(np.asarray(r.probs),
+                                  np.asarray(probs_ref)):
+                parity_failed += 1
+
+    t_rec = time.perf_counter()
+    while (not fs.all_closed()
+           and time.perf_counter() - t_rec < a.recovery_timeout_s):
+        time.sleep(0.05)
+    recovered = fs.all_closed()
+    stats = fs.stats()
+    events = fs.events_snapshot()
+    snap = fs.fleet_snapshot()
+    fs.close()
+    ref.close()
+
+    m = stats["models"][a.model]
+    ev_by_kind = {}
+    for e in events:
+        ev_by_kind[e["kind"]] = ev_by_kind.get(e["kind"], 0) + 1
+    with open(event_log) as f:
+        logged = [json.loads(line) for line in f if line.strip()]
+
+    answered = (m["completed"] + sum(sync_rejects.values())
+                + sum(async_errs.values()))
+    summary = {
+        "ok": True,
+        "mode": "fleet",
+        "model": a.model,
+        "workers": a.fleet,
+        "spec": a.spec,
+        "seed": a.seed,
+        "requests": a.requests,
+        "offered_qps": a.qps,
+        "shape_factor": a.shape_factor,
+        "offered_s": round(offered_s, 3),
+        "elapsed_s": round(time.perf_counter() - t_start, 3),
+        "completed": m["completed"],
+        "answered": answered,
+        "dropped": dropped + (a.requests - answered),
+        "sync_rejects": dict(sorted(sync_rejects.items())),
+        "async_errors": dict(sorted(async_errs.items())),
+        "breaker_trips": snap["trips"],
+        "respawns": snap["respawns"],
+        "requeued": snap["requeued"],
+        "retried": snap["retried"],
+        "probes_ok": snap["probes_ok"],
+        "probes_failed": snap["probes_failed"],
+        "kills_injected": snap["kills_injected"],
+        "proc_exits": snap["proc_exits"],
+        "hb_miss": snap["hb_miss"],
+        "incarnations": snap["incarnations"],
+        "breakers": snap["breakers"],
+        "recovered": recovered,
+        "interactive_p50_ms": _pct(lat_by_pri["interactive"], 50),
+        "interactive_p99_ms": _pct(lat_by_pri["interactive"], 99),
+        "batch_p99_ms": _pct(lat_by_pri["batch"], 99),
+        "generations": sorted(generations),
+        "parity_checked": parity_checked,
+        "parity_failed": parity_failed,
+        "replay_bitwise": replay_bitwise,
+        "schedule_digest": digest,
+        "events": dict(sorted(ev_by_kind.items())),
+        "events_logged": len(logged),
+        "workdir": workdir,
+    }
+
+    if a.smoke:
+        problems = []
+        if not replay_bitwise:
+            problems.append("fault schedule did not replay bitwise")
+        if summary["breaker_trips"] < 2:
+            problems.append(
+                f"breaker trips {summary['breaker_trips']} < 2 (error "
+                f"storm + SIGKILL must both trip a worker)")
+        if summary["kills_injected"] < 1:
+            problems.append("no SIGKILL was injected (kill token never "
+                            "latched)")
+        if summary["respawns"] < 2:
+            problems.append(f"respawns {summary['respawns']} < 2 "
+                            f"(both faulted workers must come back as "
+                            f"fresh processes)")
+        if not recovered:
+            problems.append(f"breakers not all closed after "
+                            f"{a.recovery_timeout_s}s: "
+                            f"{summary['breakers']}")
+        if summary["dropped"] != 0:
+            problems.append(f"dropped {summary['dropped']} != 0 "
+                            f"(every request must be answered exactly "
+                            f"once)")
+        if summary["generations"] not in ([], [0]):
+            problems.append(f"mixed/bumped generations "
+                            f"{summary['generations']} (respawn must "
+                            f"not change the generation)")
+        if parity_checked == 0:
+            problems.append("no completed response was parity-checked")
+        if parity_failed:
+            problems.append(f"{parity_failed} fleet responses differ "
+                            f"bitwise from the in-process reference")
+        if len(logged) != len(events):
+            problems.append(f"event log lines {len(logged)} != "
+                            f"in-memory events {len(events)}")
+        if problems:
+            summary["ok"] = False
+            summary["problems"] = problems
+    print(json.dumps(summary), flush=True)
+    return 0 if summary.get("ok") else 1
 
 
 def main(argv=None) -> int:
@@ -76,12 +294,18 @@ def main(argv=None) -> int:
                     help="flash-crowd rate multiplier from the halfway "
                          "mark")
     ap.add_argument("--replicas", type=int, default=3)
+    ap.add_argument("--fleet", type=int, default=0, metavar="N",
+                    help="run the drill at process granularity: N OS "
+                         "worker processes behind the fleet router "
+                         "(0 = the in-process resilience drill)")
     ap.add_argument("--max_batch", type=int, default=4)
     ap.add_argument("--queue_depth", type=int, default=96)
     ap.add_argument("--seed", type=int, default=7)
-    ap.add_argument("--spec", default=DEFAULT_SPEC,
+    ap.add_argument("--spec", default=None,
                     help="ServeFaultPlan token spec "
-                         "(serving/resilience.py grammar)")
+                         "(serving/resilience.py grammar; default "
+                         "DEFAULT_SPEC, or DEFAULT_FLEET_SPEC with "
+                         "--fleet)")
     ap.add_argument("--slo_ms", type=float, default=2000.0)
     ap.add_argument("--shed_fraction", type=float, default=0.125)
     ap.add_argument("--cooldown_s", type=float, default=0.2)
@@ -90,9 +314,18 @@ def main(argv=None) -> int:
                     help="every Nth interactive request carries a tight "
                          "deadline (0 disables)")
     ap.add_argument("--deadline_ms", type=float, default=40.0)
-    ap.add_argument("--recovery_timeout_s", type=float, default=45.0)
+    ap.add_argument("--recovery_timeout_s", type=float, default=None,
+                    help="bound on the all-breakers-closed poll "
+                         "(default 45; 150 with --fleet, which pays a "
+                         "process spawn + compile warmup per respawn)")
     ap.add_argument("--parity_checks", type=int, default=12)
     a = ap.parse_args(argv)
+    if a.spec is None:
+        a.spec = DEFAULT_FLEET_SPEC if a.fleet else DEFAULT_SPEC
+    if a.recovery_timeout_s is None:
+        a.recovery_timeout_s = 150.0 if a.fleet else 45.0
+    if a.fleet:
+        return _run_fleet(a)
 
     import numpy as np
 

@@ -67,6 +67,15 @@ KNOBS: Dict[str, str] = {
                                        "a scale-down",
     "SPARKNET_SERVE_SCALE_COOLDOWN_TICKS": "refractory ticks after "
                                            "any scaling action",
+    "SPARKNET_SERVE_FLEET_WORKERS": "default worker-process count for "
+                                    "the fleet serving router",
+    "SPARKNET_SERVE_FLEET_IPC_DEADLINE_S": "per-frame router<->worker "
+                                           "round-trip bound (seconds)",
+    "SPARKNET_SERVE_FLEET_HEARTBEAT_S": "fleet worker heartbeat period "
+                                        "(seconds)",
+    "SPARKNET_SERVE_FLEET_SPAWN_TIMEOUT_S": "bound on worker spawn -> "
+                                            "warmed ready line "
+                                            "(seconds)",
     # -- ingest
     "SPARKNET_PREFETCH_DEPTH": "rounds staged ahead by the prefetcher",
     "SPARKNET_INGEST_PROCS": "force multi-process ingest",

@@ -1,0 +1,353 @@
+"""Shared OS-process plumbing for supervisor-style subsystems.
+
+PR 12's ProcSupervisor (elastic/proc.py) solved the hard subprocess
+problems once — spawn with a pinned-CPU environment, one-ready-line
+handshake with a stderr tail on failure, file-mtime heartbeats with a
+supervisor-side stall watchdog, atomic tmp+fsync+replace publishes, and
+a SIGCONT -> polite stop -> terminate -> kill drain ladder.  The serving
+fleet router (serving/fleet.py) needs exactly the same mechanics, so
+this module factors them out of proc.py instead of growing a second
+copy.
+
+On top of the line-JSON handshake it adds a binary FRAME protocol for
+request/response traffic that carries arrays (the serving payload):
+
+    frame := b"SNF1" | u64-le payload length | payload
+    payload := np.savez archive; "__meta__" holds the JSON header
+               (utf-8 bytes as a uint8 array), every other key is a
+               payload array
+
+A frame is built fully in memory and written with ONE write()+flush(),
+so concurrent writers serialized by a lock can never interleave bytes
+(atomic framing); the reader does exact-count reads and dies with a
+stream-naming ValueError on desync (the R002 parser contract) or
+IpcClosed on EOF — never struct.error.
+
+Everything here is transport: no jax, no model code, importable from a
+worker before its platform is configured.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import os
+import select
+import signal
+import struct
+import subprocess
+import sys
+import threading
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+
+from ..obs.trace import now_s
+
+__all__ = [
+    "REPO_ROOT", "IpcError", "IpcClosed", "worker_env", "spawn_worker",
+    "stderr_tail", "wait_ready_line", "write_frame", "read_frame",
+    "touch", "Heartbeat", "MtimeWatchdog", "atomic_write_npz",
+    "sigcont", "reap",
+]
+
+REPO_ROOT = os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))))
+
+FRAME_MAGIC = b"SNF1"
+_FRAME_HEAD = struct.Struct("<4sQ")
+MAX_FRAME_BYTES = 1 << 31   # desync tripwire, not a real payload bound
+
+
+class IpcError(Exception):
+    """Transport-level failure talking to a worker process."""
+
+
+class IpcClosed(IpcError):
+    """The peer hung up (EOF / broken pipe) — distinct from a malformed
+    stream, which is a ValueError like every other parser in the tree."""
+
+
+# ------------------------------------------------------------------ spawn
+def worker_env(extra: Optional[Dict[str, str]] = None) -> Dict[str, str]:
+    """Child environment: CPU-pinned jax (the box's sitecustomize
+    pre-imports jax, so the env var must be set before the child starts)
+    plus the repo root on PYTHONPATH so `-m sparknet_tpu...` resolves
+    from any cwd."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = REPO_ROOT + os.pathsep + env.get("PYTHONPATH", "")
+    if extra:
+        env.update(extra)
+    return env
+
+
+def spawn_worker(module: str, cfg_path: str, *, stderr_f,
+                 env: Optional[Dict[str, str]] = None,
+                 text: bool = True) -> subprocess.Popen:
+    """Launch `python -m <module> --config <cfg_path>` as a supervised
+    worker.  start_new_session detaches it from the terminal's process
+    group: a ctrl-C reaches ONLY the supervisor, which then drains
+    instead of every child dying mid-work.  text=False selects binary
+    std streams for frame traffic (serving fleet); the ready line works
+    either way.  The guaranteed kill path for these processes is
+    reap() below."""
+    return subprocess.Popen(
+        [sys.executable, "-m", module, "--config", cfg_path],
+        stdin=subprocess.PIPE, stdout=subprocess.PIPE, stderr=stderr_f,
+        text=text, bufsize=(1 if text else -1),
+        start_new_session=True, env=env or worker_env())
+
+
+def stderr_tail(path: str, n: int = 2000) -> str:
+    """Last `n` bytes of a worker's stderr file — the diagnostic payload
+    for spawn/ready failures."""
+    try:
+        with open(path, "rb") as f:
+            f.seek(max(0, os.path.getsize(path) - n))
+            return f.read().decode("utf-8", "replace")
+    except OSError:
+        return ""
+
+
+def wait_ready_line(proc: subprocess.Popen, *, timeout_s: float,
+                    what: str = "worker",
+                    stderr_path: Optional[str] = None) -> dict:
+    """Block (bounded) until the child prints its one JSON ready line on
+    stdout; returns the parsed message.  Works for text and binary
+    stdout (the ready line is the first line either way).  Raises
+    RuntimeError with the stderr tail when the child dies or stays
+    silent past timeout_s."""
+    t0 = now_s()
+    while True:
+        remaining = timeout_s - (now_s() - t0)
+        if remaining <= 0:
+            break
+        r, _, _ = select.select([proc.stdout], [], [],
+                                min(remaining, 0.5))
+        if not r:
+            if proc.poll() is not None:
+                break
+            continue
+        line = proc.stdout.readline()
+        if not line:
+            break
+        if isinstance(line, bytes):
+            line = line.decode("utf-8", "replace")
+        try:
+            msg = json.loads(line)
+        except ValueError:
+            continue
+        if msg.get("ready"):
+            return msg
+    tail = stderr_tail(stderr_path) if stderr_path else ""
+    raise RuntimeError(
+        f"{what} (pid {proc.pid}) never reported ready within "
+        f"{timeout_s:.0f}s (rc={proc.poll()}); stderr tail:\n{tail}")
+
+
+# ----------------------------------------------------------------- frames
+def write_frame(stream, meta: Dict[str, Any],
+                arrays: Optional[Dict[str, np.ndarray]] = None, *,
+                lock: Optional[threading.Lock] = None) -> None:
+    """Serialize one frame and publish it with a single write()+flush().
+    `lock` (when given) serializes concurrent writers onto one pipe —
+    combined with the one-write publish, frames can never interleave."""
+    payload_arrays: Dict[str, np.ndarray] = dict(arrays or {})
+    payload_arrays["__meta__"] = np.frombuffer(
+        json.dumps(meta).encode("utf-8"), dtype=np.uint8)
+    buf = io.BytesIO()
+    np.savez(buf, **payload_arrays)
+    payload = buf.getvalue()
+    frame = _FRAME_HEAD.pack(FRAME_MAGIC, len(payload)) + payload
+    try:
+        if lock is not None:
+            with lock:
+                stream.write(frame)
+                stream.flush()
+        else:
+            stream.write(frame)
+            stream.flush()
+    except (BrokenPipeError, ValueError, OSError) as e:
+        raise IpcClosed(f"peer pipe closed while writing frame: {e}")
+
+
+def _read_exact(stream, n: int, what: str, *, got_any: bool) -> bytes:
+    chunks = []
+    have = 0
+    while have < n:
+        try:
+            b = stream.read(n - have)
+        except (OSError, ValueError) as e:
+            raise IpcClosed(f"{what}: pipe error mid-frame: {e}")
+        if not b:
+            if have == 0 and not got_any:
+                raise IpcClosed(f"{what}: EOF")
+            raise IpcClosed(
+                f"{what}: EOF after {have}/{n} frame bytes (torn frame)")
+        chunks.append(b)
+        have += len(b)
+    return b"".join(chunks)
+
+
+def read_frame(stream, *, what: str = "peer"
+               ) -> Optional[Tuple[Dict[str, Any], Dict[str, np.ndarray]]]:
+    """Read one frame; returns (meta, arrays), or None on a clean EOF at
+    a frame boundary (the peer exited).  A desynchronized or malformed
+    stream dies with a ValueError naming `what` (never struct.error /
+    zipfile noise); a mid-frame hangup raises IpcClosed."""
+    try:
+        head = _read_exact(stream, _FRAME_HEAD.size, what, got_any=False)
+    except IpcClosed as e:
+        if str(e).endswith("EOF"):
+            return None
+        raise
+    try:
+        magic, length = _FRAME_HEAD.unpack(head)
+    except struct.error as e:        # unreachable with exact reads
+        raise ValueError(f"{what}: unreadable frame header: {e}")
+    if magic != FRAME_MAGIC:
+        raise ValueError(
+            f"{what}: bad IPC frame magic {magic!r} (expected "
+            f"{FRAME_MAGIC!r}; stream desynchronized)")
+    if length > MAX_FRAME_BYTES:
+        raise ValueError(
+            f"{what}: implausible frame length {length} "
+            f"(> {MAX_FRAME_BYTES}; stream desynchronized)")
+    payload = _read_exact(stream, length, what, got_any=True)
+    try:
+        with np.load(io.BytesIO(payload), allow_pickle=False) as data:
+            if "__meta__" not in data.files:
+                raise KeyError("__meta__")
+            meta = json.loads(bytes(data["__meta__"].tobytes())
+                              .decode("utf-8"))
+            arrays = {k: np.array(data[k]) for k in data.files
+                      if k != "__meta__"}
+    except Exception as e:   # zipfile / pickle-refusal / json / key errors
+        raise ValueError(f"{what}: malformed frame payload "
+                         f"({type(e).__name__}: {e})")
+    if not isinstance(meta, dict):
+        raise ValueError(f"{what}: frame meta is {type(meta).__name__}, "
+                         f"expected an object")
+    return meta, arrays
+
+
+# -------------------------------------------------------------- heartbeat
+def touch(path: str) -> None:
+    with open(path, "a"):
+        pass
+    os.utime(path, None)
+
+
+class Heartbeat:
+    """Worker-side file-mtime heartbeat on a daemon thread
+    (proc_worker's `_beat` pattern): touches `path` every `period_s`,
+    which stalls exactly while the process is SIGSTOP'd or dead — the
+    signal the supervisor's MtimeWatchdog measures."""
+
+    def __init__(self, path: str, period_s: float) -> None:
+        self.path = path
+        self.period_s = float(period_s)
+        touch(path)
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True, name="sparknet-heartbeat")
+        self._thread.start()
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.period_s):
+            try:
+                touch(self.path)
+            except OSError:
+                return
+
+    def stop(self, join_timeout_s: float = 2.0) -> None:
+        """Bounded: the loop wakes on the event within one period; the
+        timeout only caps a touch stuck on a dead filesystem."""
+        self._stop.set()
+        self._thread.join(timeout=join_timeout_s)
+
+
+class MtimeWatchdog:
+    """Supervisor-side heartbeat-stall detector (ProcSupervisor's
+    `_hb_tick` logic, keyed): tracks each key's last observed mtime
+    signature and accumulates supervisor-clock stall time while it
+    doesn't move.  tick() returns True exactly once per stall episode,
+    when the accumulated stall first crosses `miss_after_s`."""
+
+    def __init__(self, miss_after_s: float) -> None:
+        self.miss_after_s = float(miss_after_s)
+        self._sig: Dict[Any, Any] = {}
+        self._stall: Dict[Any, float] = {}
+        self._fired: Dict[Any, bool] = {}
+
+    def reset(self, key) -> None:
+        """Forget a key's state (fresh spawn / fresh dispatch)."""
+        self._sig.pop(key, None)
+        self._stall.pop(key, None)
+        self._fired.pop(key, None)
+
+    def stalled_s(self, key) -> float:
+        return self._stall.get(key, 0.0)
+
+    def tick(self, key, path: str, dt: float) -> bool:
+        try:
+            sig = (os.stat(path).st_mtime_ns,)
+        except OSError:
+            sig = None
+        if sig != self._sig.get(key, ()):
+            self._sig[key] = sig
+            self._stall[key] = 0.0
+            self._fired[key] = False
+            return False
+        self._stall[key] = self._stall.get(key, 0.0) + dt
+        if (self._stall[key] > self.miss_after_s
+                and not self._fired.get(key)):
+            self._fired[key] = True
+            return True
+        return False
+
+
+# --------------------------------------------------------------- publish
+def atomic_write_npz(path: str, arrays: Dict[str, np.ndarray]) -> None:
+    """tmp + fsync + os.replace publish: the file's appearance implies
+    completeness, so a poller can never observe a torn archive
+    (proc_worker's `_write_report` discipline)."""
+    tmp = os.path.join(os.path.dirname(os.path.abspath(path)),
+                       f".tmp.{os.getpid()}.{os.path.basename(path)}")
+    with open(tmp, "wb") as f:
+        np.savez(f, **arrays)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+
+
+# ------------------------------------------------------------------ reap
+def sigcont(pid: int) -> None:
+    """Wake a possibly-SIGSTOP'd child so it can process a stop command
+    (a stopped process cannot drain)."""
+    try:
+        os.kill(pid, signal.SIGCONT)
+    except (ProcessLookupError, OSError):
+        pass
+
+
+def reap(proc: subprocess.Popen, *, wait_s: float = 5.0) -> None:
+    """Bounded terminate-then-kill ladder for a child that already got
+    its polite stop command: wait, terminate, kill — every Popen this
+    module spawns funnels through here, so no supervisor leaks
+    children."""
+    if proc.poll() is not None:
+        return
+    try:
+        proc.wait(timeout=wait_s)
+    except subprocess.TimeoutExpired:
+        proc.terminate()
+        try:
+            proc.wait(timeout=2)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            try:
+                proc.wait(timeout=wait_s)
+            except subprocess.TimeoutExpired:
+                pass

@@ -45,11 +45,9 @@ from __future__ import annotations
 import dataclasses
 import json
 import os
-import select
 import shutil
 import signal
 import subprocess
-import sys
 import tempfile
 import time  # sleep only; timestamps flow through obs.trace.now_s
 from typing import Any, Dict, List, Optional, Set
@@ -60,11 +58,9 @@ from ..obs.metrics import MetricsRegistry
 from ..obs.trace import now_s
 from ..utils import orbax_ckpt
 from ..utils.signals import SignalHandler, SolverAction
+from . import ipc
 from .chaos import FaultPlan
 from .runtime import QuorumError
-
-_REPO_ROOT = os.path.dirname(os.path.dirname(
-    os.path.dirname(os.path.abspath(__file__))))
 
 
 def masked_host_average(params_by_slot: Dict[int, Dict[str, np.ndarray]]
@@ -94,9 +90,6 @@ class _Worker:
     hb_path: str
     stderr_path: str
     stderr_f: Any
-    hb_sig: Any = None          # last observed (mtime_ns,) stat signature
-    hb_stall_s: float = 0.0     # supervisor-side elapsed since it moved
-    hb_missed_round: bool = False
 
 
 class ProcSupervisor:
@@ -146,6 +139,7 @@ class ProcSupervisor:
                 "SPARKNET_ELASTIC_PROC_HEARTBEAT_S", "0.25") or 0.25)
         self.heartbeat_s = float(heartbeat_s)
         self.hb_miss_after_s = max(4.0 * self.heartbeat_s, 1.0)
+        self._watchdog = ipc.MtimeWatchdog(self.hb_miss_after_s)
         self.chaos = chaos
         self.snapshot_dir = snapshot_dir
         self.snapshot_every = int(snapshot_every)
@@ -240,32 +234,20 @@ class ProcSupervisor:
 
     def _drain(self) -> None:
         """Stop every live worker: SIGCONT (a SIGSTOP'd straggler cannot
-        process a stop command), polite stop, then terminate/kill — the
-        guaranteed kill path for every Popen this module creates."""
+        process a stop command), polite stop, then ipc.reap's
+        terminate/kill ladder — the guaranteed kill path for every
+        worker this module spawns."""
         for w in self.workers.values():
             if w.proc.poll() is not None:
                 continue
-            try:
-                os.kill(w.proc.pid, signal.SIGCONT)
-            except (ProcessLookupError, OSError):
-                pass
+            ipc.sigcont(w.proc.pid)
             try:
                 w.proc.stdin.write(json.dumps({"cmd": "stop"}) + "\n")
                 w.proc.stdin.flush()
             except (BrokenPipeError, ValueError, OSError):
                 pass
         for w in self.workers.values():
-            if w.proc.poll() is not None:
-                continue
-            try:
-                w.proc.wait(timeout=5)
-            except subprocess.TimeoutExpired:
-                w.proc.terminate()
-                try:
-                    w.proc.wait(timeout=2)
-                except subprocess.TimeoutExpired:
-                    w.proc.kill()
-                    w.proc.wait(timeout=5)
+            ipc.reap(w.proc)
 
     # ------------------------------------------------------------- spawning
     def _worker_cfg(self, slot: int, restore_root: Optional[str]) -> dict:
@@ -286,19 +268,11 @@ class ProcSupervisor:
             json.dump(cfg, f)
         stderr_path = os.path.join(self.workdir, f"worker_{slot}.stderr")
         stderr_f = open(stderr_path, "ab")
-        env = dict(os.environ)
-        env["JAX_PLATFORMS"] = "cpu"
-        env["PYTHONPATH"] = _REPO_ROOT + os.pathsep + env.get(
-            "PYTHONPATH", "")
-        # start_new_session detaches workers from the terminal's process
-        # group: a ctrl-C reaches ONLY the supervisor, which then does
+        # ipc.spawn_worker: CPU-pinned env + start_new_session, so a
+        # ctrl-C reaches ONLY the supervisor, which then does
         # snapshot-then-drain instead of every child dying mid-round
-        proc = subprocess.Popen(
-            [sys.executable, "-m", "sparknet_tpu.elastic.proc_worker",
-             "--config", cfg_path],
-            stdin=subprocess.PIPE, stdout=subprocess.PIPE,
-            stderr=stderr_f, text=True, bufsize=1,
-            start_new_session=True, env=env)
+        proc = ipc.spawn_worker("sparknet_tpu.elastic.proc_worker",
+                                cfg_path, stderr_f=stderr_f)
         w = _Worker(slot=slot, proc=proc, cfg_path=cfg_path,
                     hb_path=cfg["heartbeat_path"],
                     stderr_path=stderr_path, stderr_f=stderr_f)
@@ -307,39 +281,11 @@ class ProcSupervisor:
                     restore_root=restore_root)
         return w
 
-    def _stderr_tail(self, w: _Worker, n: int = 2000) -> str:
-        try:
-            with open(w.stderr_path, "rb") as f:
-                f.seek(max(0, os.path.getsize(w.stderr_path) - n))
-                return f.read().decode("utf-8", "replace")
-        except OSError:
-            return ""
-
     def _wait_ready(self, w: _Worker) -> dict:
-        t0 = now_s()
-        while True:
-            remaining = self.spawn_timeout_s - (now_s() - t0)
-            if remaining <= 0:
-                break
-            r, _, _ = select.select([w.proc.stdout], [], [],
-                                    min(remaining, 0.5))
-            if not r:
-                if w.proc.poll() is not None:
-                    break
-                continue
-            line = w.proc.stdout.readline()
-            if not line:
-                break
-            try:
-                msg = json.loads(line)
-            except ValueError:
-                continue
-            if msg.get("ready"):
-                return msg
-        raise RuntimeError(
-            f"worker {w.slot} (pid {w.proc.pid}) never reported ready "
-            f"within {self.spawn_timeout_s:.0f}s (rc={w.proc.poll()}); "
-            f"stderr tail:\n{self._stderr_tail(w)}")
+        return ipc.wait_ready_line(w.proc,
+                                   timeout_s=self.spawn_timeout_s,
+                                   what=f"worker {w.slot}",
+                                   stderr_path=w.stderr_path)
 
     # ------------------------------------------------------------ telemetry
     def _event(self, **fields) -> None:
@@ -354,20 +300,9 @@ class ProcSupervisor:
             w = self.workers.get(slot)
             if w is None or not w.hb_path:
                 continue
-            try:
-                sig = (os.stat(w.hb_path).st_mtime_ns,)
-            except OSError:
-                sig = None
-            if sig != w.hb_sig:
-                w.hb_sig = sig
-                w.hb_stall_s = 0.0
-            else:
-                w.hb_stall_s += dt
-                if (w.hb_stall_s > self.hb_miss_after_s
-                        and not w.hb_missed_round):
-                    w.hb_missed_round = True
-                    self.c_hb_miss.inc()
-                    hb_missed.add(slot)
+            if self._watchdog.tick(slot, w.hb_path, dt):
+                self.c_hb_miss.inc()
+                hb_missed.add(slot)
 
     # ------------------------------------------------------------ membership
     def schedule_join(self, slot: int, round_idx: int) -> None:
@@ -405,10 +340,7 @@ class ProcSupervisor:
     def _kill_slot(self, slot: int, reason: str, round_idx: int) -> None:
         w = self.workers[slot]
         if w.proc.poll() is None:
-            try:
-                os.kill(w.proc.pid, signal.SIGCONT)
-            except (ProcessLookupError, OSError):
-                pass
+            ipc.sigcont(w.proc.pid)
             w.proc.kill()
             try:
                 w.proc.wait(timeout=10)
@@ -429,10 +361,7 @@ class ProcSupervisor:
                   for k, v in self.params_avg.items()}
         arrays["__iter__"] = np.int64(self.iter_done)
         path = os.path.join(self.workdir, f"bcast_{round_idx:06d}.npz")
-        tmp = path + f".tmp{os.getpid()}.npz"
-        with open(tmp, "wb") as f:
-            np.savez(f, **arrays)
-        os.replace(tmp, path)
+        ipc.atomic_write_npz(path, arrays)
         return path
 
     @staticmethod
@@ -502,10 +431,7 @@ class ProcSupervisor:
                 except (ProcessLookupError, OSError):
                     pass
         for slot in dispatched:
-            w = self.workers[slot]
-            w.hb_sig = None
-            w.hb_stall_s = 0.0
-            w.hb_missed_round = False
+            self._watchdog.reset(slot)
         pending = [s for s in dispatched
                    if s in self.active and s not in stragglers]
         reports: Dict[int, dict] = {}

@@ -38,7 +38,6 @@ import argparse
 import json
 import os
 import sys
-import threading
 import time  # sleep only; timestamps flow through obs.trace.now_s
 
 
@@ -49,16 +48,6 @@ def _force_cpu() -> None:
     import jax
 
     jax.config.update("jax_platforms", "cpu")
-
-
-def _beat(path: str, period_s: float, stop: threading.Event) -> None:
-    while not stop.wait(period_s):
-        try:
-            with open(path, "a"):
-                pass
-            os.utime(path, None)
-        except OSError:
-            return
 
 
 def _build_toy(cfg: dict):
@@ -163,20 +152,17 @@ def _load_bcast(solver, path: str) -> None:
 
 def _write_report(path: str, round_idx: int, solver, loss: float) -> None:
     """Atomic report publish: the supervisor polls for `path`, so its
-    appearance must imply completeness (tmp+fsync+os.replace)."""
+    appearance must imply completeness (ipc.atomic_write_npz's
+    tmp+fsync+os.replace)."""
     import numpy as np
+
+    from .ipc import atomic_write_npz
 
     arrays = {f"param:{k}": np.asarray(v) for k, v in solver.params.items()}
     arrays["__loss__"] = np.float64(loss)
     arrays["__iter__"] = np.int64(solver.iter)
     arrays["__round__"] = np.int64(round_idx)
-    tmp = os.path.join(os.path.dirname(os.path.abspath(path)),
-                       f".tmp.{os.getpid()}.{os.path.basename(path)}")
-    with open(tmp, "wb") as f:
-        np.savez(f, **arrays)
-        f.flush()
-        os.fsync(f.fileno())
-    os.replace(tmp, path)
+    atomic_write_npz(path, arrays)
 
 
 def main(argv=None) -> int:
@@ -188,17 +174,12 @@ def main(argv=None) -> int:
         cfg = json.load(f)
     _force_cpu()
 
-    stop_beat = threading.Event()
-    beat_thread = None
+    from .ipc import Heartbeat
+
+    beat = None
     hb = cfg.get("heartbeat_path")
     if hb:
-        with open(hb, "a"):
-            pass
-        beat_thread = threading.Thread(
-            target=_beat,
-            args=(hb, float(cfg.get("heartbeat_s", 0.25)), stop_beat),
-            daemon=True, name="proc-worker-heartbeat")
-        beat_thread.start()
+        beat = Heartbeat(hb, float(cfg.get("heartbeat_s", 0.25)))
 
     builder = cfg.get("builder", "toy")
     if builder == "toy":
@@ -251,12 +232,8 @@ def main(argv=None) -> int:
             time.sleep(sleep_s)  # test knob: widen the mid-round window
         loss = solver.step(int(cmd.get("tau", cfg.get("tau", 1))))
         _write_report(cmd["report"], int(cmd["round"]), solver, loss)
-    stop_beat.set()
-    if beat_thread is not None:
-        # bounded: the beat loop wakes on the event within one period,
-        # so this returns promptly; the timeout only caps a touch stuck
-        # on a dead filesystem
-        beat_thread.join(timeout=2.0)
+    if beat is not None:
+        beat.stop()
     return 0
 
 

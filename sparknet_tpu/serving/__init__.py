@@ -23,6 +23,7 @@ from .buckets import bucket_sizes, pad_to_bucket, pick_bucket
 from .engine import ModelRunner, resolve_net_param
 from .errors import (DeadlineExceeded, ModelNotLoaded, RequestShed,
                      ServerClosed, ServerOverloaded, ServingError)
+from .fleet import FleetConfig, FleetModel, FleetServer
 from .placement import (DevicePlacer, resolve_replica_count,
                         resolve_shard_count, serving_mesh)
 from .registry import LoadedModel, ModelRegistry
@@ -46,4 +47,5 @@ __all__ = [
     "ServeFaultPlan",
     "AutoscaleConfig", "Autoscaler", "ScalePolicy", "SensorSample",
     "synthetic_sensor_trace",
+    "FleetServer", "FleetConfig", "FleetModel",
 ]

@@ -55,9 +55,61 @@ def _error_line(rid, exc) -> dict:
             "detail": str(exc)}
 
 
+def _build_fleet(args):
+    """--fleet N: the OS-process router (serving/fleet.py) in place of
+    the in-process server.  The fleet carries its own process-grained
+    resilience and autoscale planes, so the in-process flags that would
+    double-arm them are rejected rather than silently ignored."""
+    from .fleet import FleetConfig, FleetServer
+
+    if args.resilience or args.autoscale:
+        raise SystemExit(
+            "serve: --fleet workers have their own process-grained "
+            "breaker/autoscale plane; drop --resilience/--autoscale "
+            "(scale the fleet with --fleet N)")
+    if args.replicas is not None:
+        raise SystemExit(
+            "serve: --fleet replaces --replicas (each worker process "
+            "IS a full replica; use --shards for mesh slices per "
+            "worker)")
+    try:
+        fcfg = FleetConfig(workers=args.fleet,
+                           max_batch=args.max_batch,
+                           max_wait_ms=args.max_wait_ms,
+                           queue_depth=args.queue_depth,
+                           default_deadline_ms=args.deadline_ms)
+        if args.min_fill is not None:
+            fcfg.min_fill = args.min_fill
+            fcfg.__post_init__()    # re-validate the overridden field
+    except ValueError as e:
+        raise SystemExit(f"serve: {e}")
+    return FleetServer(fcfg)
+
+
 def cmd_serve(args) -> int:
     from ..utils.signals import SignalHandler, SolverAction
     from .server import InferenceServer, ServerConfig
+
+    if getattr(args, "fleet", None):
+        server = _build_fleet(args)
+        name = args.name or "default"
+        try:
+            fm = server.load(name, args.model, weights=args.weights,
+                             buckets=_parse_buckets(args.buckets),
+                             seed=args.seed, quant=args.quant,
+                             quant_min_agreement=(
+                                 args.quant_min_agreement
+                                 if args.quant != "fp32" else None),
+                             shards=args.shards)
+        except (ValueError, RuntimeError) as e:
+            raise SystemExit(f"serve: {e}")
+        quant_note = "" if fm.quant == "fp32" else f", quant {fm.quant}"
+        shard_note = "" if fm.shards <= 1 else f" x {fm.shards} shards"
+        print(f"serving {args.model!r} as {name!r}: input "
+              f"{fm.sample_shape}, buckets {fm.buckets}, "
+              f"{fm.n_replicas} worker process(es){shard_note}"
+              f"{quant_note}", file=sys.stderr, flush=True)
+        return _serve_loop(args, server, name, fm.sample_shape)
 
     cfg = ServerConfig(max_batch=args.max_batch,
                        max_wait_ms=args.max_wait_ms,
@@ -111,12 +163,19 @@ def cmd_serve(args) -> int:
           f"{lm.n_replicas} replica(s){shard_note}, "
           f"{lm.runner.compile_count()} programs warmed{quant_note}",
           file=sys.stderr, flush=True)
+    return _serve_loop(args, server, name, lm.runner.sample_shape)
+
+
+def _serve_loop(args, server, name: str, sample_shape) -> int:
+    """The JSONL request/response pump, shared by the in-process and
+    --fleet paths (both speak submit/close/stats)."""
+    from ..utils.signals import SignalHandler, SolverAction
 
     pre = None
     if args.preprocess:
         from ..classify import Preprocessor
 
-        crop = lm.runner.sample_shape[1:]
+        crop = sample_shape[1:]
         image_dims = ([int(d) for d in args.image_dims.split(",")]
                       if args.image_dims else crop)
         pre = Preprocessor(image_dims, crop)
@@ -228,6 +287,12 @@ def register(sub) -> None:
     s.add_argument("--max_batch", type=int, default=8)
     s.add_argument("--max_wait_ms", type=float, default=5.0)
     s.add_argument("--queue_depth", type=int, default=64)
+    s.add_argument("--fleet", type=int, metavar="N",
+                   help="serve through N OS worker processes behind "
+                        "one router (serving/fleet.py) instead of "
+                        "in-process replicas; each worker runs a full "
+                        "inference stack (replaces --replicas; "
+                        "process-grained breakers built in)")
     s.add_argument("--replicas", type=int,
                    help="model replicas spread across the device mesh "
                         "(0 = one per device; default "

@@ -1,0 +1,1267 @@
+"""Fleet serving router: N OS inference-worker processes behind one
+InferenceServer-shaped front end.
+
+Why a fleet: every in-process replica shares one GIL, so PR 8 measured
+8 replicas at only 1.20x single-replica QPS — the parallelism the
+replica scheduler exposes is real on a TPU mesh but fake on host
+threads.  SparkNet's own architecture is full-model replicas in
+separate executor processes behind one driver (reference:
+SparkNetArchitecture.scala — arXiv:1511.06051 §2), and this module is
+that shape for serving: each worker process (fleet_worker.py) runs a
+COMPLETE InferenceServer on its own device slice (or mesh slice via
+shards=N), and the router speaks the existing serving interface —
+`ReplicaScheduler` routes, `ModelStats` counts, `CircuitBreaker`s guard
+— where "replica" now means "worker process".
+
+Transport is elastic/ipc.py (the PR 12 proc substrate): spawn with a
+CPU-pinned env + start_new_session, one-ready-line handshake with a
+stderr tail on failure, then length-prefixed binary frames both ways
+(atomic framing: one write per frame, writers serialized per pipe).  A
+reader thread per worker routes reply frames to waiting dispatches by
+`seq`; every wait is bounded (R006 discipline — IPC deadline, spawn
+timeout, reap ladder).
+
+Process-grained resilience, mirroring serving/resilience.py exactly:
+
+- a dead (SIGKILL, crash), wedged (SIGSTOP — caught by the file-mtime
+  heartbeat watchdog), or erroring worker trips its breaker: the slot
+  is disabled (never the last enabled one), its queued items drain and
+  requeue onto healthy workers (exactly-once: requeue bypasses
+  queue_depth), in-flight dispatches fail fast when the reader sees
+  EOF, and bounded per-request retries redispatch elsewhere;
+- the maintenance thread respawns a FRESH process after the cooldown,
+  waits for its warmed ready line, then earns re-admission through
+  half-open probes (real end-to-end requests through the new process,
+  drawing from the same fault schedule as live traffic);
+- the optional autoscaler (ScalePolicy — the tick-indexed policy the
+  in-process lane uses) parks/unparks whole worker processes;
+- reload() hot-swaps generations fleet-wide with a dispatch barrier:
+  the gate closes, in-flight batches finish, every live worker reloads,
+  the fleet generation bumps, the gate reopens — so no response can
+  ever carry a mixed generation and the generation sequence any client
+  observes is monotone.
+
+Faults for drills come from the SAME seeded ServeFaultPlan grammar as
+PR 15 (errstorm/spike/kill), but `kill` here is a REAL SIGKILL to a
+live worker pid.
+
+Events are JSONL (DISTACC.md schema): worker_spawn / worker_ready /
+worker_open / worker_respawn / worker_probe / worker_kill_injected /
+fleet_reload / scale_up / scale_down / scale_suppressed / fleet_error.
+
+Knobs (analysis/knobs.py + README table, R004):
+SPARKNET_SERVE_FLEET_WORKERS (default worker count, 2),
+SPARKNET_SERVE_FLEET_IPC_DEADLINE_S (per-frame round-trip bound, 30),
+SPARKNET_SERVE_FLEET_HEARTBEAT_S (worker heartbeat period, 0.25),
+SPARKNET_SERVE_FLEET_SPAWN_TIMEOUT_S (spawn->ready bound, 120); the
+breaker window/error-threshold/cooldown/probe knobs are shared with
+the in-process plane (serving/resilience.py declares them).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import json
+import os
+import queue
+import shutil
+import signal
+import tempfile
+import threading
+import time  # sleep only; timestamps flow through obs.trace.now_s
+from concurrent.futures import Future
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..elastic import ipc
+from ..obs.trace import now_s, span
+from .autoscale import AutoscaleConfig, ScalePolicy, SensorSample
+from .errors import (DeadlineExceeded, ServerClosed, ServerOverloaded,
+                     ServingError)
+from .resilience import (BREAKER_COOLDOWN_ENV, BREAKER_ERRS_ENV,
+                         BREAKER_WINDOW_ENV, PRIORITIES, PROBES_ENV,
+                         CircuitBreaker, ServeFaultPlan, _env_float,
+                         _env_int)
+from .scheduler import ReplicaScheduler, SchedulerClosed, SchedulerFull
+from .server import Response, _Request
+from .stats import ModelStats
+
+__all__ = ["FleetConfig", "FleetServer", "FleetModel",
+           "FLEET_WORKERS_ENV", "FLEET_IPC_DEADLINE_ENV",
+           "FLEET_HEARTBEAT_ENV", "FLEET_SPAWN_TIMEOUT_ENV"]
+
+FLEET_WORKERS_ENV = "SPARKNET_SERVE_FLEET_WORKERS"
+FLEET_IPC_DEADLINE_ENV = "SPARKNET_SERVE_FLEET_IPC_DEADLINE_S"
+FLEET_HEARTBEAT_ENV = "SPARKNET_SERVE_FLEET_HEARTBEAT_S"
+FLEET_SPAWN_TIMEOUT_ENV = "SPARKNET_SERVE_FLEET_SPAWN_TIMEOUT_S"
+
+_WORKER_MODULE = "sparknet_tpu.serving.fleet_worker"
+
+
+# ------------------------------------------------------------------- config
+@dataclasses.dataclass
+class FleetConfig:
+    """Router knobs.  Batching fields mirror ServerConfig (the router's
+    scheduler batches exactly like a lane's); fleet fields default from
+    their env knobs so deployments tune without code."""
+
+    workers: int = dataclasses.field(
+        default_factory=lambda: _env_int(FLEET_WORKERS_ENV, 2))
+    max_batch: int = 8
+    max_wait_ms: float = 0.0
+    queue_depth: int = 64
+    min_fill: int = 1
+    default_deadline_ms: Optional[float] = None
+    ipc_deadline_s: float = dataclasses.field(
+        default_factory=lambda: _env_float(FLEET_IPC_DEADLINE_ENV, 30.0))
+    heartbeat_s: float = dataclasses.field(
+        default_factory=lambda: _env_float(FLEET_HEARTBEAT_ENV, 0.25))
+    spawn_timeout_s: float = dataclasses.field(
+        default_factory=lambda: _env_float(FLEET_SPAWN_TIMEOUT_ENV,
+                                           120.0))
+    # breaker knobs are shared with the in-process resilience plane
+    breaker_window: int = dataclasses.field(
+        default_factory=lambda: _env_int(BREAKER_WINDOW_ENV, 16))
+    breaker_error_threshold: float = dataclasses.field(
+        default_factory=lambda: _env_float(BREAKER_ERRS_ENV, 0.5))
+    breaker_min_samples: int = 4
+    cooldown_s: float = dataclasses.field(
+        default_factory=lambda: _env_float(BREAKER_COOLDOWN_ENV, 0.25))
+    half_open_probes: int = dataclasses.field(
+        default_factory=lambda: _env_int(PROBES_ENV, 3))
+    max_retries: int = 2
+    tick_s: float = 0.05            # maintenance thread period
+    result_timeout_s: float = 120.0   # worker-side future bound
+    autoscale: Optional[AutoscaleConfig] = None
+    fault_plan: Optional[ServeFaultPlan] = None
+    event_log: Optional[str] = None   # JSONL path (DISTACC.md schema)
+    workdir: Optional[str] = None     # default: mkdtemp, removed on close
+    force_cpu: bool = True
+
+    def __post_init__(self) -> None:
+        if self.workers < 1:
+            raise ValueError(f"workers must be >= 1, got {self.workers}")
+        if self.max_batch < 1:
+            raise ValueError("max_batch must be >= 1")
+        if self.queue_depth < 1:
+            raise ValueError("queue_depth must be >= 1")
+        if not 1 <= self.min_fill <= self.max_batch:
+            raise ValueError(
+                f"min_fill must be in [1, max_batch={self.max_batch}], "
+                f"got {self.min_fill}")
+        if self.ipc_deadline_s <= 0:
+            raise ValueError(f"ipc_deadline_s must be > 0, "
+                             f"got {self.ipc_deadline_s}")
+        if self.heartbeat_s <= 0:
+            raise ValueError(f"heartbeat_s must be > 0, "
+                             f"got {self.heartbeat_s}")
+        if self.spawn_timeout_s <= 0:
+            raise ValueError(f"spawn_timeout_s must be > 0, "
+                             f"got {self.spawn_timeout_s}")
+        if self.max_retries < 0:
+            raise ValueError(f"max_retries must be >= 0, "
+                             f"got {self.max_retries}")
+        if self.tick_s <= 0:
+            raise ValueError(f"tick_s must be > 0, got {self.tick_s}")
+
+    @property
+    def hb_miss_after_s(self) -> float:
+        """Stall threshold: 4 missed beats, floored at 1 s so a slow
+        filesystem can't fake a wedge (proc.py's constant)."""
+        return max(4.0 * self.heartbeat_s, 1.0)
+
+
+@dataclasses.dataclass
+class FleetModel:
+    """Client-side description of the fleet's one model — what load()
+    returns in place of a LoadedModel (the params live in the worker
+    processes; this is the routing-relevant surface)."""
+
+    name: str
+    sample_shape: Tuple[int, ...]
+    buckets: Tuple[int, ...]
+    n_outputs: int
+    quant: str
+    shards: int
+    _fleet: "FleetServer" = dataclasses.field(repr=False, default=None)
+
+    @property
+    def generation(self) -> int:
+        return self._fleet.generation
+
+    @property
+    def n_replicas(self) -> int:
+        return self._fleet.cfg.workers
+
+
+class _Slot:
+    """One worker slot: the process, its pipes, and the seq->queue
+    reply routing its reader thread feeds.  Mutable fields are guarded
+    by the router's `_mu` (state/proc/pid/incarnation/dispatch) or by
+    `pending_mu` (the reply map)."""
+
+    def __init__(self, idx: int) -> None:
+        self.idx = idx
+        self.state = "down"     # down|live|tripped|probing|parked
+        self.proc = None
+        self.pid: Optional[int] = None
+        self.cfg_path = ""
+        self.hb_path = ""
+        self.stderr_path = ""
+        self.stderr_f = None
+        self.ready: Dict[str, Any] = {}
+        self.incarnation = -1       # first spawn makes it 0
+        self.dispatch = 0           # fault-plan index
+        self.kill_fired = False     # plan kill latched (incarnation 0)
+        self.write_lock = threading.Lock()
+        self.pending_mu = threading.Lock()
+        self.pending: Dict[int, "queue.Queue"] = {}
+        self.reader: Optional[threading.Thread] = None
+
+
+class FleetServer:
+    """One-model serving front end over N worker processes.  Speaks the
+    InferenceServer surface: load / submit / submit_many / reload /
+    drain / close / stats, plus the control-plane observability hooks
+    the chaos drill uses (all_closed, events_snapshot, fleet_snapshot,
+    kill_worker)."""
+
+    def __init__(self, config: Optional[FleetConfig] = None) -> None:
+        self.cfg = config or FleetConfig()
+        self._mu = threading.Lock()
+        self._ev_mu = threading.Lock()     # serializes JSONL appends
+        self._seq_mu = threading.Lock()
+        self._seq = 0
+        # serializes reload/respawn/scale.  A busy-flag lease (its own
+        # condition, not a held mutex) because the critical sections
+        # block for seconds — spawn waits, reap ladders, probe RPCs —
+        # and holding a Lock across blocking work is the R008
+        # anti-pattern this repo lints against.
+        self._swap_cv = threading.Condition()
+        self._swap_busy = False
+        self._flight_cv = threading.Condition()
+        self._inflight = 0
+        self._swapping = False
+        self._accepting = True
+        self._closing = False
+        self._closed = False
+        self._started = False
+        self._model: Optional[FleetModel] = None
+        self._model_cfg: Dict[str, Any] = {}
+        self._generation = 0
+        self._sched: Optional[ReplicaScheduler] = None
+        self._stats = ModelStats()
+        self._slots: List[_Slot] = []
+        self._breakers: List[CircuitBreaker] = []
+        self._watchdog = ipc.MtimeWatchdog(self.cfg.hb_miss_after_s)
+        self._policy: Optional[ScalePolicy] = (
+            ScalePolicy(self.cfg.autoscale)
+            if self.cfg.autoscale is not None else None)
+        self._interactive_ewma_ms: Optional[float] = None
+        self.events: List[dict] = []
+        self._c: Dict[str, int] = {
+            k: 0 for k in ("trips", "respawns", "requeued", "retried",
+                           "probes_ok", "probes_failed", "hb_miss",
+                           "proc_exits", "kills_injected", "restarts",
+                           "scale_ups", "scale_downs")}
+        self._own_workdir = self.cfg.workdir is None
+        self.workdir = self.cfg.workdir
+        self._stop_evt = threading.Event()
+        self._maint: Optional[threading.Thread] = None
+
+    # ------------------------------------------------------------ lifecycle
+    def load(self, name: str, spec: Optional[str] = None, *,
+             weights: Optional[str] = None,
+             buckets: Optional[Sequence[int]] = None,
+             seed: int = 0, quant: Optional[str] = None,
+             quant_min_agreement: Optional[float] = None,
+             shards: Optional[int] = None) -> FleetModel:
+        """Spawn the worker fleet (concurrent compiles, sequential
+        ready-waits), verify every worker agrees on the model surface,
+        and start routing.  One fleet serves ONE model — the worker
+        processes each hold a full copy, so a second model belongs in a
+        second fleet.  A worker that fails to load (bad spec, failed
+        quant calibration floor) surfaces as a RuntimeError carrying
+        its stderr tail."""
+        if self._model is not None:
+            raise ValueError(
+                f"fleet already serves {self._model.name!r}; one fleet "
+                f"serves one model (start another FleetServer)")
+        if self._closing or self._closed:
+            raise ServerClosed("fleet is shutting down")
+        self._started = True
+        with self._mu:    # pre-thread writes, but lint-uniform anyway
+            if self.workdir is None:
+                self.workdir = tempfile.mkdtemp(prefix="sparknet_fleet_")
+            workdir = self.workdir
+        os.makedirs(workdir, exist_ok=True)
+        model_cfg = {
+            "model": str(name), "spec": spec, "weights": weights,
+            "buckets": list(buckets) if buckets is not None else None,
+            "seed": int(seed), "quant": quant or "fp32",
+            "quant_min_agreement": quant_min_agreement,
+            "shards": shards, "max_batch": self.cfg.max_batch,
+            "max_wait_ms": 0.0, "queue_depth": self.cfg.queue_depth,
+            "heartbeat_s": self.cfg.heartbeat_s,
+            "result_timeout_s": self.cfg.result_timeout_s,
+            "force_cpu": self.cfg.force_cpu}
+        slots = [_Slot(i) for i in range(self.cfg.workers)]
+        breakers = [
+            CircuitBreaker(window=self.cfg.breaker_window,
+                           error_threshold=self.cfg.breaker_error_threshold,
+                           min_samples=self.cfg.breaker_min_samples,
+                           cooldown_s=self.cfg.cooldown_s,
+                           half_open_probes=self.cfg.half_open_probes)
+            for _ in range(self.cfg.workers)]
+        with self._mu:
+            self._model_cfg = model_cfg
+            self._slots = slots
+            self._breakers = breakers
+        try:
+            for slot in self._slots:      # concurrent compile fan-out
+                self._spawn(slot)
+            for slot in self._slots:
+                self._finish_spawn(slot)
+        except Exception:
+            for slot in self._slots:
+                self._kill_slot_proc(slot)
+            raise
+        r0 = self._slots[0].ready
+        for slot in self._slots[1:]:
+            for key in ("sample_shape", "buckets", "n_outputs", "quant",
+                        "generation"):
+                if slot.ready.get(key) != r0.get(key):
+                    raise RuntimeError(
+                        f"fleet worker {slot.idx} disagrees on {key}: "
+                        f"{slot.ready.get(key)!r} != {r0.get(key)!r}")
+        fm = FleetModel(
+            name=str(name),
+            sample_shape=tuple(int(d) for d in r0["sample_shape"]),
+            buckets=tuple(int(b) for b in r0["buckets"]),
+            n_outputs=int(r0["n_outputs"]),
+            quant=str(r0.get("quant", "fp32")),
+            shards=int(r0.get("shards", 1) or 1),
+            _fleet=self)
+        sched = ReplicaScheduler(
+            self.cfg.workers, max_batch=self.cfg.max_batch,
+            queue_depth=self.cfg.queue_depth,
+            min_fill=self.cfg.min_fill,
+            max_wait_ms=self.cfg.max_wait_ms,
+            run=self._run_batch,
+            name=f"fleet-{name}")
+        with self._mu:
+            self._model = fm
+            self._sched = sched
+        self._stats.observe_sensors(active_replicas=self.cfg.workers)
+        self._maint = threading.Thread(
+            target=self._loop, name=f"sparknet-fleet-{name}",
+            daemon=True)
+        self._maint.start()
+        return self._model
+
+    def __enter__(self) -> "FleetServer":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close(drain=True)
+
+    @property
+    def generation(self) -> int:
+        with self._mu:
+            return self._generation
+
+    def drain(self) -> None:
+        """Block until every admitted request has been delivered."""
+        if self._sched is not None:
+            self._sched.drain()
+
+    def close(self, *, drain: bool = True) -> None:
+        """Stop accepting; deliver (drain=True) or reject everything
+        still queued; stop the maintenance thread, then the scheduler,
+        then the workers (in that order — draining needs live workers,
+        and no respawn may race the teardown).  Idempotent."""
+        with self._mu:
+            self._accepting = False
+            if self._closed:
+                return
+            self._closed = True
+            self._closing = True
+        with self._flight_cv:       # unblock any swap-gated dispatch
+            self._flight_cv.notify_all()
+        self._stop_evt.set()
+        if self._maint is not None and \
+                self._maint is not threading.current_thread():
+            self._maint.join(timeout=30.0)
+        if self._sched is not None:
+            for req in self._sched.stop(drain=drain):
+                self._stats.bump("rejected_closed")
+                req.future.set_exception(
+                    ServerClosed("fleet closed before this request ran"))
+        for slot in self._slots:
+            self._stop_worker(slot)
+        if self._own_workdir and self.workdir:
+            shutil.rmtree(self.workdir, ignore_errors=True)
+
+    # ------------------------------------------------------------ admission
+    def submit(self, model: str, sample, *,
+               deadline_ms: Optional[float] = None,
+               wait: bool = False,
+               wait_timeout_s: Optional[float] = None,
+               priority: str = "interactive") -> Future:
+        """InferenceServer.submit, verbatim semantics: shape-checked
+        admission, 503 on overload (or bounded backpressure with
+        wait=True), immediate 504 for an unmeetable deadline; the
+        future resolves to the same Response type, with `replica`
+        carrying the worker index."""
+        if priority not in PRIORITIES:
+            raise ValueError(f"priority must be one of {PRIORITIES}, "
+                             f"got {priority!r}")
+        fm = self._require_model(model)
+        x = np.asarray(sample, dtype=np.float32)
+        if x.shape == (int(np.prod(fm.sample_shape)),):
+            x = x.reshape(fm.sample_shape)
+        if tuple(x.shape) != fm.sample_shape:
+            raise ValueError(
+                f"sample shape {tuple(x.shape)} != model input "
+                f"{fm.sample_shape} for {model!r}")
+        if not self._accepting or self._closing:
+            raise ServerClosed("fleet is shutting down")
+        if deadline_ms is None:
+            deadline_ms = self.cfg.default_deadline_ms
+        if deadline_ms is not None and float(deadline_ms) <= 0.0:
+            self._stats.bump("submitted")
+            self._stats.bump("rejected_deadline")
+            raise DeadlineExceeded(
+                f"deadline {float(deadline_ms):g} ms is already "
+                f"unmeetable at submit")
+        t0 = now_s()
+        req = _Request(
+            sample=x, future=Future(), t_submit=t0,
+            deadline=None if deadline_ms is None
+            else t0 + float(deadline_ms) / 1e3,
+            priority=priority)
+        self._stats.bump("submitted")
+        try:
+            with span("fleet.submit", model=model) as sp:
+                idx = self._sched.submit(req, wait=wait,
+                                         timeout_s=wait_timeout_s)
+                queued, inflight = self._sched.depth(idx)
+                self._stats.observe_replica(idx, queued, inflight)
+                sp.set(worker=idx, queued=self._sched.queued_total())
+        except SchedulerFull:
+            self._stats.bump("rejected_overload")
+            raise ServerOverloaded(
+                f"{model!r} fleet queue at depth {self.cfg.queue_depth}"
+            ) from None
+        except SchedulerClosed:
+            raise ServerClosed("fleet is shutting down") from None
+        return req.future
+
+    def submit_many(self, model: str, samples, **kw) -> List[Future]:
+        """Burst admission; per-sample rejections surface on the
+        corresponding future (server.submit_many semantics)."""
+        futs: List[Future] = []
+        for s in samples:
+            try:
+                futs.append(self.submit(model, s, **kw))
+            except ServingError as e:
+                f: Future = Future()
+                f.set_exception(e)
+                futs.append(f)
+        return futs
+
+    def _require_model(self, name: str) -> FleetModel:
+        fm = self._model
+        if fm is None or fm.name != name:
+            from .errors import ModelNotLoaded
+
+            loaded = [] if fm is None else [fm.name]
+            raise ModelNotLoaded(
+                f"model {name!r} is not loaded in this fleet "
+                f"(loaded: {loaded})")
+        return fm
+
+    @contextlib.contextmanager
+    def _swap_lease(self):
+        """Exclusive claim on the worker set for reload / respawn /
+        scale.  The claim itself is condition-guarded (the wait releases
+        `_swap_cv`); the leaseholder then blocks — spawn waits, reap
+        ladders, probe RPCs — while holding NO mutex, so dispatch and
+        observability never stall behind a multi-second swap."""
+        with self._swap_cv:
+            while self._swap_busy:
+                self._swap_cv.wait(0.5)
+            self._swap_busy = True
+        try:
+            yield
+        finally:
+            with self._swap_cv:
+                self._swap_busy = False
+                self._swap_cv.notify_all()
+
+    # --------------------------------------------------------------- reload
+    def reload(self, name: str) -> FleetModel:
+        """Fleet-wide generation hot-swap with ZERO mixed-generation
+        responses: close the dispatch gate, wait out in-flight batches
+        (every response they carry is old-generation), reload every
+        live worker, bump the fleet generation, reopen the gate.  The
+        barrier makes the swap atomic from any client's point of view —
+        the generation sequence across responses is monotone with one
+        step.  A worker that fails its reload trips and respawns at the
+        NEW generation (generation_base in its config)."""
+        fm = self._require_model(name)
+        with self._swap_lease():
+            with self._flight_cv:
+                self._swapping = True
+                deadline = now_s() + max(self.cfg.ipc_deadline_s,
+                                         self.cfg.result_timeout_s)
+                while self._inflight > 0 and not self._closing:
+                    remaining = deadline - now_s()
+                    if remaining <= 0:
+                        self._swapping = False
+                        self._flight_cv.notify_all()
+                        raise ServingError(
+                            f"reload barrier timed out with "
+                            f"{self._inflight} batches in flight")
+                    self._flight_cv.wait(min(remaining, 0.5))
+            try:
+                live = [s for s in self._slots if s.state == "live"]
+                new_gens = []
+                for slot in live:
+                    try:
+                        meta, _ = self._call(
+                            slot, {"cmd": "reload"},
+                            timeout_s=self.cfg.ipc_deadline_s
+                            + self.cfg.result_timeout_s)
+                        if not meta.get("ok"):
+                            raise ServingError(
+                                f"worker {slot.idx} reload failed: "
+                                f"{meta.get('detail', meta)}")
+                        new_gens.append(int(meta["generation"]))
+                    except Exception as e:
+                        self._force_trip(slot.idx,
+                                         f"reload: {type(e).__name__}")
+                if not new_gens:
+                    raise ServingError(
+                        "reload failed on every live worker")
+                gen = max(new_gens)
+                with self._mu:
+                    self._generation = gen
+                self._event("fleet_reload", generation=gen,
+                            workers=[s.idx for s in live],
+                            reloaded=len(new_gens))
+            finally:
+                with self._flight_cv:
+                    self._swapping = False
+                    self._flight_cv.notify_all()
+        return fm
+
+    # ------------------------------------------------------------- batching
+    def _run_batch(self, i: int, batch: List[_Request]) -> None:
+        """Scheduler run callback — the server lane's _run_batch with
+        the forward replaced by a framed round trip to worker i.  Never
+        raises; every future resolves here."""
+        now = now_s()
+        live: List[_Request] = []
+        for r in batch:
+            r.t_pop = now
+            if r.deadline is not None and now > r.deadline:
+                self._stats.bump("rejected_deadline")
+                r.future.set_exception(DeadlineExceeded(
+                    f"deadline passed "
+                    f"{round((now - r.deadline) * 1e3, 2)}"
+                    f" ms before batch launch"))
+            else:
+                live.append(r)
+        if not live:
+            return
+        # reload barrier: no dispatch may START while a generation swap
+        # is in progress (in-flight count is what the swap waits out)
+        with self._flight_cv:
+            while self._swapping and not self._closing:
+                self._flight_cv.wait(0.5)
+            self._inflight += 1
+        try:
+            self._dispatch(i, live)
+        finally:
+            with self._flight_cv:
+                self._inflight -= 1
+                self._flight_cv.notify_all()
+
+    def _dispatch(self, i: int, live: List[_Request]) -> None:
+        slot = self._slots[i]
+        plan = self.cfg.fault_plan
+        kill_now = False
+        inject_err = False
+        spike_s = 0.0
+        with self._mu:
+            d = slot.dispatch
+            slot.dispatch = d + 1
+            state = slot.state
+            pid = slot.pid
+            if plan is not None:
+                if (slot.incarnation == 0 and not slot.kill_fired
+                        and plan.kill_at(i) is not None
+                        and d >= plan.kill_at(i)):
+                    slot.kill_fired = True
+                    kill_now = True
+                inject_err = plan.error_at(i, d)
+                spike_s = plan.spike_ms(i, d) / 1e3
+        queued, inflight = self._sched.depth(i)
+        self._stats.observe_replica(i, queued, inflight, dispatched=1)
+        err: Optional[Exception] = None
+        meta: Dict[str, Any] = {}
+        arrays: Dict[str, np.ndarray] = {}
+        t_launch = now_s()
+        try:
+            if kill_now and pid is not None:
+                # the drill's process-granularity fault: a REAL SIGKILL
+                # to a live worker mid-burst; detection must flow
+                # through the same machinery as a genuine crash
+                with self._mu:
+                    self._c["kills_injected"] += 1
+                self._event("worker_kill_injected", worker=i,
+                            dispatch=d, pid=pid)
+                try:
+                    os.kill(pid, signal.SIGKILL)
+                except (ProcessLookupError, OSError):
+                    pass
+            if spike_s > 0:
+                time.sleep(spike_s)   # slow SUCCESS unless also erroring
+            if inject_err:
+                raise ServingError(
+                    f"injected fault on worker {i} (ServeFaultPlan)")
+            if state != "live":
+                raise ipc.IpcError(f"worker {i} is {state}")
+            with span("fleet.device", worker=i, live=len(live)):
+                x = np.stack([r.sample for r in live]).astype(np.float32)
+                meta, arrays = self._call(
+                    slot,
+                    {"cmd": "infer", "count": len(live),
+                     "priorities": [r.priority for r in live]},
+                    {"x": x},
+                    timeout_s=self.cfg.ipc_deadline_s + spike_s)
+            if not meta.get("ok"):
+                raise ServingError(
+                    f"worker {i} infer failed: "
+                    f"{meta.get('detail', meta)}")
+        except Exception as e:
+            err = e
+        if err is not None:
+            self._record_error(i, reason=type(err).__name__)
+            if not self._closing:
+                retry = [r for r in live
+                         if r.retries < self.cfg.max_retries]
+                for r in retry:
+                    r.retries += 1
+                if retry:
+                    try:
+                        self._sched.requeue(retry, exclude=i)
+                        with self._mu:
+                            self._c["retried"] += len(retry)
+                        kept = {id(r) for r in retry}
+                        live = [r for r in live if id(r) not in kept]
+                    except SchedulerClosed:
+                        pass        # fall through: fail them below
+            self._stats.bump("failed", len(live))
+            for r in live:
+                r.future.set_exception(ServingError(
+                    f"fleet worker {i} failed: {err}"))
+            return
+        self._record_success(i)
+        t_done = now_s()
+        probs = arrays.get("probs")
+        statuses = meta.get("statuses") or [None] * len(live)
+        gens = meta.get("generations") or [0] * len(live)
+        buckets = meta.get("buckets") or [0] * len(live)
+        lives = meta.get("batch_live") or [0] * len(live)
+        dms = meta.get("device_ms") or [0.0] * len(live)
+        ok_rows = [j for j, st in enumerate(statuses) if st is None]
+        if ok_rows:
+            self._stats.observe_batch(len(ok_rows), max(
+                buckets[j] for j in ok_rows))
+        for j, r in enumerate(live):
+            st = statuses[j] if j < len(statuses) else None
+            if st is not None:
+                self._stats.bump("failed")
+                r.future.set_exception(ServingError(
+                    f"fleet worker {i} rejected request: "
+                    f"{st.get('error')}: {st.get('detail')}"))
+                continue
+            total_ms = (t_done - r.t_submit) * 1e3
+            queue_wait_ms = (r.t_pop - r.t_submit) * 1e3
+            assembly_ms = (t_launch - r.t_pop) * 1e3
+            device_ms = float(dms[j]) if j < len(dms) else 0.0
+            self._stats.observe_request(queue_wait_ms, assembly_ms,
+                                        device_ms, total_ms)
+            self._observe_total(r.priority, total_ms)
+            r.future.set_result(Response(
+                probs=np.asarray(probs[j]),
+                model=self._model.name,
+                generation=int(gens[j]),
+                bucket=int(buckets[j]),
+                batch_live=int(lives[j]),
+                queue_wait_ms=round(queue_wait_ms, 4),
+                assembly_ms=round(assembly_ms, 4),
+                device_ms=round(device_ms, 4),
+                total_ms=round(total_ms, 4),
+                replica=i,
+                priority=r.priority))
+
+    def _observe_total(self, priority: str, total_ms: float) -> None:
+        if priority != "interactive":
+            return
+        with self._mu:
+            e = self._interactive_ewma_ms
+            ewma = (float(total_ms) if e is None
+                    else 0.8 * e + 0.2 * float(total_ms))
+            self._interactive_ewma_ms = ewma
+        self._stats.observe_sensors(interactive_ewma_ms=ewma)
+
+    # ------------------------------------------------------------ transport
+    def _next_seq(self) -> int:
+        with self._seq_mu:
+            self._seq += 1
+            return self._seq
+
+    def _call(self, slot: _Slot, meta: Dict[str, Any],
+              arrays: Optional[Dict[str, np.ndarray]] = None, *,
+              timeout_s: float) -> Tuple[Dict[str, Any],
+                                         Dict[str, np.ndarray]]:
+        """One framed round trip: register the reply slot, write the
+        frame (writers serialized per pipe), wait (bounded) for the
+        reader thread to route the reply.  A dead pipe or a timeout
+        raises IpcError; the caller owns the breaker consequences."""
+        proc = slot.proc
+        if proc is None or proc.stdin is None:
+            raise ipc.IpcClosed(f"worker {slot.idx} has no process")
+        seq = self._next_seq()
+        rq: "queue.Queue" = queue.Queue()
+        with slot.pending_mu:
+            slot.pending[seq] = rq
+        try:
+            ipc.write_frame(proc.stdin, dict(meta, seq=seq), arrays,
+                            lock=slot.write_lock)
+            try:
+                reply = rq.get(timeout=timeout_s)
+            except queue.Empty:
+                raise ipc.IpcError(
+                    f"worker {slot.idx} gave no reply within "
+                    f"{timeout_s:.1f}s (seq {seq})")
+            if isinstance(reply, Exception):
+                raise reply
+            return reply
+        finally:
+            with slot.pending_mu:
+                slot.pending.pop(seq, None)
+
+    def _reader(self, slot: _Slot, proc) -> None:
+        """Per-worker reader thread: routes reply frames by seq.  On
+        EOF/desync every waiting call fails immediately — a SIGKILL'd
+        worker unblocks its dispatches in one pipe-close, not after the
+        IPC deadline."""
+        tag = f"fleet worker {slot.idx} stdout"
+        while True:
+            try:
+                frame = ipc.read_frame(proc.stdout, what=tag)
+            except (ipc.IpcError, ValueError, OSError) as e:
+                self._fail_pending(slot, ipc.IpcClosed(f"{tag}: {e}"))
+                return
+            if frame is None:
+                self._fail_pending(slot,
+                                   ipc.IpcClosed(f"{tag}: worker exited"))
+                return
+            meta, arrays = frame
+            with slot.pending_mu:
+                rq = slot.pending.pop(meta.get("seq"), None)
+            if rq is not None:
+                rq.put((meta, arrays))
+
+    def _fail_pending(self, slot: _Slot, exc: Exception) -> None:
+        with slot.pending_mu:
+            waiting = list(slot.pending.values())
+            slot.pending.clear()
+        for rq in waiting:
+            rq.put(exc)
+
+    # ----------------------------------------------------------- resilience
+    def _record_success(self, i: int) -> None:
+        with self._mu:
+            self._breakers[i].record(True)
+
+    def _record_error(self, i: int, *, reason: str) -> None:
+        """One failed dispatch; trips on the rolling-window threshold,
+        or immediately when the worker process is gone (a dead process
+        fails every dispatch — no point burning min_samples more)."""
+        slot = self._slots[i]
+        with self._mu:
+            if slot.state != "live":
+                return              # already tripped/parked/respawning
+            br = self._breakers[i]
+            tripped = br.record(False)
+            proc = slot.proc
+            dead = proc is None or proc.poll() is not None
+            if not tripped and dead and br.state == "closed":
+                br.trip(now_s())
+                tripped = True
+        if tripped:
+            self._trip_side_effects(i, reason)
+
+    def _force_trip(self, i: int, reason: str) -> None:
+        """Unconditional trip (heartbeat wedge, clean process exit,
+        failed reload): the evidence is process-level, not a dispatch
+        outcome, so the window doesn't apply."""
+        with self._mu:
+            if self._slots[i].state != "live":
+                return
+            br = self._breakers[i]
+            if br.state == "closed":
+                br.trip(now_s())
+        self._trip_side_effects(i, reason)
+
+    def _trip_side_effects(self, i: int, reason: str) -> None:
+        """The open-breaker ritual, at process grain (mirrors
+        ResilienceManager._open_side_effects): disable routing (never
+        the last enabled slot), drain + requeue queued items
+        exactly-once, make sure the process is really dead (a wedged
+        one is killed so its reader EOFs and in-flight calls fail
+        fast), and record the event."""
+        slot = self._slots[i]
+        with self._mu:
+            self._c["trips"] += 1
+            slot.state = "tripped"
+            trips = self._breakers[i].trips
+        disabled = self._sched.disable_unless_last(i)
+        drained: List[_Request] = []
+        if disabled:
+            drained = self._sched.drain_replica(i)
+            if drained:
+                try:
+                    self._sched.requeue(drained, exclude=i)
+                    with self._mu:
+                        self._c["requeued"] += len(drained)
+                except SchedulerClosed:
+                    for r in drained:
+                        self._stats.bump("rejected_closed")
+                        r.future.set_exception(ServerClosed(
+                            "fleet closed before this request ran"))
+        self._kill_slot_proc(slot)
+        self._stats.observe_breaker(i, "open")
+        self._event("worker_open", worker=i, trips=trips,
+                    requeued=len(drained), reason=reason,
+                    in_place=not disabled, pid=slot.pid)
+
+    def _kill_slot_proc(self, slot: _Slot) -> None:
+        """Make the slot's process dead for sure: SIGCONT first (a
+        SIGSTOP'd worker can't die politely), then SIGKILL.  The reaper
+        wait happens at respawn/close (ipc.reap)."""
+        proc = slot.proc
+        if proc is not None and proc.poll() is None:
+            ipc.sigcont(proc.pid)
+            try:
+                proc.kill()
+            except OSError:
+                pass
+
+    # ---------------------------------------------------------- maintenance
+    def _loop(self) -> None:
+        prev = now_s()
+        while not self._stop_evt.wait(self.cfg.tick_s):
+            now = now_s()
+            dt, prev = now - prev, now
+            try:
+                self._tick(dt)
+            except Exception as e:     # keep the control plane alive
+                self._event("fleet_error",
+                            error=f"{type(e).__name__}: {e}")
+
+    def _tick(self, dt: float) -> None:
+        # 1) detection: clean exits and heartbeat wedges on live slots
+        for slot in self._slots:
+            with self._mu:
+                state, proc = slot.state, slot.proc
+            if state != "live" or proc is None:
+                continue
+            if proc.poll() is not None:
+                with self._mu:
+                    self._c["proc_exits"] += 1
+                self._force_trip(slot.idx,
+                                 f"proc_exit rc={proc.poll()}")
+                continue
+            if self._watchdog.tick(slot.idx, slot.hb_path, dt):
+                with self._mu:
+                    self._c["hb_miss"] += 1
+                self._force_trip(slot.idx, "heartbeat")
+        # 2) recovery: cooled breakers respawn + probe for re-admission
+        now = now_s()
+        for slot in self._slots:
+            with self._mu:
+                br = self._breakers[slot.idx]
+                actionable = (slot.state == "tripped"
+                              and br.cooled_down(now))
+                respawned = br.respawned
+            if not actionable:
+                continue
+            with self._swap_lease():  # never race a reload's worker set
+                if not respawned:
+                    if not self._respawn(slot):
+                        continue    # retry next tick
+                self._probe_cycle(slot)
+        # 3) autoscale
+        if self._policy is not None and not self._closing:
+            self._autoscale_tick()
+
+    def _respawn(self, slot: _Slot) -> bool:
+        """Fresh process for a tripped slot, warmed before re-admission
+        is even attempted (the ready line follows load+warmup).  Spawned
+        with generation_base = the CURRENT fleet generation, so a worker
+        that died across a reload() comes back serving the new one."""
+        if slot.proc is not None:
+            ipc.reap(slot.proc, wait_s=2.0)
+        try:
+            self._spawn(slot)
+            self._finish_spawn(slot, probing=True)
+        except Exception as e:
+            self._kill_slot_proc(slot)
+            self._event("fleet_error", worker=slot.idx,
+                        error=f"respawn failed: {type(e).__name__}: {e}")
+            return False
+        with self._mu:
+            self._breakers[slot.idx].respawned = True
+            self._c["respawns"] += 1
+            self._c["restarts"] += 1
+            incarnation = slot.incarnation
+        self._event("worker_respawn", worker=slot.idx,
+                    incarnation=incarnation, pid=slot.pid)
+        return True
+
+    def _probe_cycle(self, slot: _Slot) -> None:
+        """Half-open probing: real end-to-end requests through the new
+        process.  Probes draw from the SAME fault schedule as live
+        traffic (dispatch index advances), so a worker inside an
+        un-expired error storm keeps failing probes and re-opens —
+        re-admission is earned, not granted."""
+        i = slot.idx
+        with self._mu:
+            self._breakers[i].begin_probing()
+            slot.state = "probing"
+        self._stats.observe_breaker(i, "half_open")
+        plan = self.cfg.fault_plan
+        closed = False
+        for _ in range(self.cfg.half_open_probes):
+            with self._mu:
+                d = slot.dispatch
+                slot.dispatch = d + 1
+                inject = (plan.error_at(i, d)
+                          if plan is not None else False)
+                spike_s = (plan.spike_ms(i, d) / 1e3
+                           if plan is not None else 0.0)
+            ok = not inject
+            if ok:
+                try:
+                    if spike_s > 0:
+                        time.sleep(spike_s)
+                    meta, _ = self._call(
+                        slot, {"cmd": "probe"},
+                        timeout_s=self.cfg.ipc_deadline_s)
+                    ok = bool(meta.get("ok"))
+                except Exception:
+                    ok = False
+            with self._mu:
+                br = self._breakers[i]
+                if ok:
+                    self._c["probes_ok"] += 1
+                    closed = br.probe_ok()
+                else:
+                    self._c["probes_failed"] += 1
+                    br.probe_fail(now_s())
+                    slot.state = "tripped"
+                state, streak = br.state, br.probe_successes
+            self._event("worker_probe", worker=i, ok=ok,
+                        state_after=state, streak=streak)
+            if not ok:
+                self._stats.observe_breaker(i, "open")
+                return
+        if closed:
+            with self._mu:
+                slot.state = "live"
+            self._watchdog.reset(i)
+            self._sched.set_enabled(i, True)
+            self._stats.observe_breaker(i, "closed")
+
+    # ------------------------------------------------------------ autoscale
+    def _autoscale_tick(self) -> None:
+        with self._mu:
+            open_breakers = sum(1 for b in self._breakers
+                                if b.state != "closed")
+            ewma = self._interactive_ewma_ms
+            parked = sum(1 for s in self._slots if s.state == "parked")
+        pool = self.cfg.workers
+        active = pool - parked
+        qf = (self._sched.queued_total() / float(self.cfg.queue_depth)
+              if self.cfg.queue_depth else 0.0)
+        sample = SensorSample(queue_fraction=qf,
+                              interactive_ewma_ms=ewma,
+                              breakers_open=open_breakers)
+        self._stats.observe_sensors(queue_fraction=qf,
+                                    active_replicas=active)
+        action, suppressed = self._policy.decide(sample, active=active,
+                                                 pool=pool)
+        if suppressed and action != "hold":
+            self._event("scale_suppressed", action=action,
+                        queue_fraction=round(qf, 4),
+                        breakers_open=open_breakers)
+            return
+        if action == "up":
+            self._scale_up(qf)
+        elif action == "down":
+            self._scale_down(qf)
+
+    def _scale_up(self, qf: float) -> None:
+        with self._mu:
+            victim = next((s for s in self._slots
+                           if s.state == "parked"), None)
+        if victim is None:
+            return
+        with self._swap_lease():
+            try:
+                self._spawn(victim)
+                self._finish_spawn(victim, probing=True)
+            except Exception as e:
+                self._kill_slot_proc(victim)
+                self._event("fleet_error", worker=victim.idx,
+                            error=f"scale-up spawn failed: "
+                                  f"{type(e).__name__}: {e}")
+                return
+            with self._mu:
+                victim.state = "live"
+                self._c["scale_ups"] += 1
+                self._c["restarts"] += 1
+            self._watchdog.reset(victim.idx)
+            self._sched.set_enabled(victim.idx, True)
+        self._event("scale_up", worker=victim.idx, pid=victim.pid,
+                    queue_fraction=round(qf, 4))
+
+    def _scale_down(self, qf: float) -> None:
+        """Park the highest healthy slot: disable routing (never the
+        last), drain + requeue its queue, stop its process gracefully.
+        The slot stays allocated — scale-up respawns into it."""
+        with self._mu:
+            victim = next(
+                (s for s in reversed(self._slots)
+                 if s.state == "live"
+                 and self._breakers[s.idx].state == "closed"), None)
+        if victim is None:
+            return
+        with self._swap_lease():
+            if not self._sched.disable_unless_last(victim.idx):
+                return
+            drained = self._sched.drain_replica(victim.idx)
+            if drained:
+                try:
+                    self._sched.requeue(drained, exclude=victim.idx)
+                    with self._mu:
+                        self._c["requeued"] += len(drained)
+                except SchedulerClosed:
+                    for r in drained:
+                        self._stats.bump("rejected_closed")
+                        r.future.set_exception(ServerClosed(
+                            "fleet closed before this request ran"))
+            with self._mu:
+                victim.state = "parked"
+                self._c["scale_downs"] += 1
+            self._stop_worker(victim)
+        self._event("scale_down", worker=victim.idx,
+                    requeued=len(drained), queue_fraction=round(qf, 4))
+
+    # -------------------------------------------------------------- spawning
+    def _spawn(self, slot: _Slot) -> None:
+        """Write the slot's config (generation_base = current fleet
+        generation) and launch the worker with binary pipes.  The ready
+        wait is separate (_finish_spawn) so load() can fan spawns out
+        and overlap the workers' compile time."""
+        with self._mu:
+            gen_base = self._generation
+        cfg = dict(self._model_cfg)
+        cfg["worker"] = slot.idx
+        cfg["generation_base"] = gen_base
+        cfg["heartbeat_path"] = os.path.join(self.workdir,
+                                             f"hb_f{slot.idx}")
+        slot.cfg_path = os.path.join(self.workdir,
+                                     f"fleet_worker_{slot.idx}.json")
+        with open(slot.cfg_path, "w") as f:
+            json.dump(cfg, f)
+        slot.hb_path = cfg["heartbeat_path"]
+        slot.stderr_path = os.path.join(
+            self.workdir, f"fleet_worker_{slot.idx}.stderr")
+        if slot.stderr_f is not None:
+            try:
+                slot.stderr_f.close()
+            except OSError:
+                pass
+        slot.stderr_f = open(slot.stderr_path, "ab")
+        proc = ipc.spawn_worker(_WORKER_MODULE, slot.cfg_path,
+                                stderr_f=slot.stderr_f, text=False)
+        with self._mu:
+            slot.proc = proc
+            slot.pid = proc.pid
+            slot.incarnation += 1
+            slot.state = "spawning"
+        self._event("worker_spawn", worker=slot.idx, pid=proc.pid,
+                    incarnation=slot.incarnation,
+                    generation_base=gen_base)
+
+    def _finish_spawn(self, slot: _Slot, *, probing: bool = False
+                      ) -> None:
+        """Bounded ready-wait, then start the reader thread.  The slot
+        comes up 'live' at load time (the scheduler routes to it
+        immediately) or stays out of routing when re-admission must be
+        earned (probing=True: respawn / scale-up paths flip it after
+        their probe cycle)."""
+        ready = ipc.wait_ready_line(
+            slot.proc, timeout_s=self.cfg.spawn_timeout_s,
+            what=f"fleet worker {slot.idx}",
+            stderr_path=slot.stderr_path)
+        slot.ready = ready
+        self._watchdog.reset(slot.idx)
+        reader = threading.Thread(
+            target=self._reader, args=(slot, slot.proc),
+            name=f"sparknet-fleet-reader-{slot.idx}", daemon=True)
+        slot.reader = reader
+        reader.start()
+        with self._mu:
+            slot.state = "probing" if probing else "live"
+        self._event("worker_ready", worker=slot.idx, pid=slot.pid,
+                    incarnation=slot.incarnation,
+                    compiles=ready.get("compiles"),
+                    generation=ready.get("generation"))
+
+    def _stop_worker(self, slot: _Slot) -> None:
+        """Graceful stop: SIGCONT, polite stop frame, reap ladder, close
+        pipes.  Safe on dead/parked slots."""
+        proc = slot.proc
+        if proc is None:
+            return
+        if proc.poll() is None:
+            ipc.sigcont(proc.pid)
+            try:
+                ipc.write_frame(proc.stdin,
+                                {"cmd": "stop", "seq": self._next_seq()},
+                                lock=slot.write_lock)
+            except ipc.IpcError:
+                pass
+        ipc.reap(proc)
+        for stream in (proc.stdin, proc.stdout):
+            try:
+                if stream:
+                    stream.close()
+            except OSError:
+                pass
+        if slot.stderr_f is not None:
+            try:
+                slot.stderr_f.close()
+            except OSError:
+                pass
+
+    # --------------------------------------------------------------- observe
+    def kill_worker(self, i: int, sig: int = signal.SIGKILL) -> None:
+        """Deliver a REAL signal to worker i (tests/chaos tooling).  The
+        router marks nothing — detection must flow through the same
+        poll/heartbeat/EOF machinery a genuine fault exercises."""
+        pid = self._slots[i].pid
+        if pid is None:
+            raise ValueError(f"worker {i} has no process")
+        os.kill(pid, sig)
+
+    def worker_pid(self, i: int) -> Optional[int]:
+        return self._slots[i].pid
+
+    def all_closed(self) -> bool:
+        with self._mu:
+            return all(b.state == "closed" for b in self._breakers)
+
+    def events_snapshot(self) -> List[dict]:
+        with self._mu:
+            return [dict(e) for e in self.events]
+
+    def fleet_snapshot(self) -> Dict[str, object]:
+        """JSON-ready control-plane state (the drill's accounting)."""
+        with self._mu:
+            return {
+                "workers": self.cfg.workers,
+                "live": sum(1 for s in self._slots
+                            if s.state == "live"),
+                "states": {str(s.idx): s.state for s in self._slots},
+                "breakers": {str(i): self._breakers[i].state
+                             for i in range(len(self._breakers))},
+                "open_now": sum(1 for b in self._breakers
+                                if b.state != "closed"),
+                "incarnations": [s.incarnation for s in self._slots],
+                "generation": self._generation,
+                "interactive_ewma_ms": (
+                    None if self._interactive_ewma_ms is None
+                    else round(self._interactive_ewma_ms, 3)),
+                "fault_plan": self.cfg.fault_plan is not None,
+                **dict(self._c),
+            }
+
+    def stats(self) -> Dict[str, object]:
+        """server.stats()-shaped snapshot: the model entry carries the
+        standard ModelStats counters/latency summaries plus the fleet
+        control plane under "fleet"."""
+        fm = self._model
+        per_model: Dict[str, Any] = {}
+        if fm is not None:
+            m = self._stats.snapshot()
+            m["generation"] = self.generation
+            m["engine_compiles"] = sum(
+                int(s.ready.get("compiles") or 0) for s in self._slots)
+            m["queued_now"] = (self._sched.queued_total()
+                               if self._sched is not None else 0)
+            breakdown = self._stats.replica_breakdown()
+            if self._sched is not None:
+                for i, (queued, inflight) in \
+                        enumerate(self._sched.depths()):
+                    entry = breakdown.setdefault(
+                        str(i), {"queued_max": 0, "inflight_max": 0,
+                                 "dispatches": 0})
+                    entry["queued_now"] = queued
+                    entry["inflight_now"] = inflight
+                    entry["state"] = self._slots[i].state
+                    entry["pid"] = self._slots[i].pid
+            m["workers"] = breakdown
+            m["fleet"] = self.fleet_snapshot()
+            per_model[fm.name] = m
+        return {
+            "models": per_model,
+            "config": {"workers": self.cfg.workers,
+                       "max_batch": self.cfg.max_batch,
+                       "max_wait_ms": self.cfg.max_wait_ms,
+                       "queue_depth": self.cfg.queue_depth,
+                       "min_fill": self.cfg.min_fill,
+                       "default_deadline_ms":
+                           self.cfg.default_deadline_ms,
+                       "ipc_deadline_s": self.cfg.ipc_deadline_s,
+                       "heartbeat_s": self.cfg.heartbeat_s,
+                       "autoscale": self.cfg.autoscale is not None,
+                       "fault_plan": self.cfg.fault_plan is not None},
+            "accepting": self._accepting}
+
+    # ---------------------------------------------------------------- events
+    def _event(self, kind: str, **fields) -> None:
+        """resilience.py's event discipline: wall-clock-free payload
+        appended in memory and (optionally) as one JSONL line —
+        DISTACC.md documents the schema per kind."""
+        rec = {"kind": kind,
+               "model": self._model.name if self._model else None}
+        rec.update(fields)
+        with self._mu:
+            self.events.append(rec)
+        path = self.cfg.event_log
+        if path:
+            with self._ev_mu:
+                with open(path, "a") as f:
+                    f.write(json.dumps(rec) + "\n")
+                    f.flush()

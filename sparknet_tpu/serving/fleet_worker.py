@@ -1,0 +1,233 @@
+"""Worker entrypoint for the fleet serving router (serving/fleet.py).
+
+One OS process = one full inference stack: the worker owns a complete
+`InferenceServer` (engine + registry + scheduler + stats) serving ONE
+model on its own device slice — the SparkNet worker shape (full model
+replica per executor process) applied to serving instead of training,
+and the process-granularity answer to the GIL bound PR 8 measured on
+in-process replicas.
+
+Protocol (router -> stdin / stdout -> router):
+
+  ready     one text JSON line after load+warmup:
+            {"ready": true, "worker": N, "pid": ..., "model": ...,
+             "generation": g, "sample_shape": [...], "buckets": [...],
+             "n_outputs": k, "compiles": c, "quant": ..., "shards": s}
+  frames    after the ready line BOTH pipes switch to elastic/ipc.py
+            binary frames (magic+length+npz).  Commands:
+              {"cmd": "infer", "seq": s, "count": k,
+               "priorities": [...]}            + array "x" (k, *shape)
+              {"cmd": "reload", "seq": s}
+              {"cmd": "probe", "seq": s}
+              {"cmd": "stats", "seq": s}
+              {"cmd": "stop", "seq": s}
+            Every command gets exactly one reply frame echoing "seq".
+            An infer reply carries per-request parallel lists
+            (statuses/generations/buckets/batch_live/device_ms) plus
+            the "probs" array — failed rows hold a status dict and a
+            zero row, so one poisoned request never fails its batch.
+
+The worker NEVER writes to stdout outside the ready line + reply frames
+(the router's reader thread owns the pipe).  Heartbeats are file-mtime
+touches every `heartbeat_s` from a daemon thread (ipc.Heartbeat); they
+stall exactly while the process is SIGSTOP'd or dead, which is what the
+router's watchdog measures.  stdin EOF means the router is gone: drain
+and exit.  `generation_base` in the config makes a respawned worker
+report the fleet-wide generation (base + local reload count), so a
+process that missed earlier reload() cycles still stamps responses
+consistently.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+
+def _force_cpu() -> None:
+    # the box's sitecustomize pre-imports jax, so the live-config update
+    # is what actually takes effect (tests/conftest.py pattern)
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+
+def _status_of(exc) -> dict:
+    from .errors import ServingError
+
+    if isinstance(exc, ServingError):
+        return {"error": type(exc).__name__, "status": exc.status,
+                "detail": str(exc)}
+    return {"error": type(exc).__name__, "status": 500,
+            "detail": str(exc)}
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="fleet_worker")
+    ap.add_argument("--config", required=True,
+                    help="worker config JSON written by the router")
+    a = ap.parse_args(argv)
+    with open(a.config) as f:
+        cfg = json.load(f)
+    if cfg.get("force_cpu", True):
+        _force_cpu()
+
+    import numpy as np
+
+    from ..elastic import ipc
+    from .server import InferenceServer, ServerConfig
+
+    slot = int(cfg["worker"])
+    name = str(cfg["model"])
+    gen_base = int(cfg.get("generation_base", 0))
+    result_timeout_s = float(cfg.get("result_timeout_s", 120.0))
+
+    beat = None
+    if cfg.get("heartbeat_path"):
+        beat = ipc.Heartbeat(cfg["heartbeat_path"],
+                             float(cfg.get("heartbeat_s", 0.25)))
+
+    max_batch = int(cfg.get("max_batch", 8))
+    scfg = ServerConfig(
+        max_batch=max_batch,
+        max_wait_ms=float(cfg.get("max_wait_ms", 0.0)),
+        # the inner queue must absorb a full router batch without
+        # blocking the command loop's submit fan-out
+        queue_depth=max(int(cfg.get("queue_depth", 64)), 2 * max_batch),
+        default_deadline_ms=None,
+        min_fill=1)
+    server = InferenceServer(scfg)
+    lm = server.load(
+        name, cfg.get("spec"),
+        weights=cfg.get("weights"),
+        buckets=cfg.get("buckets"),
+        seed=int(cfg.get("seed", 0)),
+        quant=cfg.get("quant", "fp32"),
+        quant_min_agreement=cfg.get("quant_min_agreement"),
+        replicas=1,
+        shards=cfg.get("shards"))
+    n_out = int(lm.runner.n_outputs)
+    sample_shape = tuple(lm.runner.sample_shape)
+
+    out = sys.stdout.buffer
+    out.write((json.dumps(
+        {"ready": True, "worker": slot, "pid": os.getpid(),
+         "model": name, "generation": gen_base + int(lm.generation),
+         "sample_shape": list(sample_shape),
+         "buckets": list(lm.runner.buckets),
+         "n_outputs": n_out,
+         "compiles": int(lm.runner.compile_count()),
+         "quant": lm.runner.quant,
+         "shards": int(lm.runner.shards)}) + "\n").encode("utf-8"))
+    out.flush()
+
+    stdin = sys.stdin.buffer
+    tag = f"fleet_worker[{slot}] stdin"
+
+    def reply(meta, arrays=None):
+        ipc.write_frame(out, meta, arrays)
+
+    try:
+        while True:
+            try:
+                frame = ipc.read_frame(stdin, what=tag)
+            except ipc.IpcClosed:
+                break
+            if frame is None:       # router gone: drain and exit
+                break
+            meta, arrays = frame
+            cmd = meta.get("cmd")
+            seq = meta.get("seq")
+            if cmd == "stop":
+                reply({"cmd": "stopped", "seq": seq, "ok": True})
+                break
+            if cmd == "infer":
+                x = arrays["x"]
+                k = int(meta.get("count", x.shape[0]))
+                pris = meta.get("priorities") or ["interactive"] * k
+                futs = []
+                for j in range(k):
+                    try:
+                        futs.append(server.submit(
+                            name, np.asarray(x[j]), wait=True,
+                            priority=pris[j]))
+                    except Exception as e:
+                        futs.append(e)
+                statuses, gens, buckets, lives, dms = [], [], [], [], []
+                probs = np.zeros((k, n_out), dtype=np.float32)
+                for j, fut in enumerate(futs):
+                    r = None
+                    if isinstance(fut, Exception):
+                        statuses.append(_status_of(fut))
+                    else:
+                        try:
+                            r = fut.result(timeout=result_timeout_s)
+                        except Exception as e:
+                            statuses.append(_status_of(e))
+                    if r is None:
+                        gens.append(-1)
+                        buckets.append(0)
+                        lives.append(0)
+                        dms.append(0.0)
+                        continue
+                    statuses.append(None)
+                    probs[j] = np.asarray(r.probs, dtype=np.float32)
+                    gens.append(gen_base + int(r.generation))
+                    buckets.append(int(r.bucket))
+                    lives.append(int(r.batch_live))
+                    dms.append(float(r.device_ms))
+                reply({"cmd": "result", "seq": seq, "ok": True,
+                       "count": k, "statuses": statuses,
+                       "generations": gens, "buckets": buckets,
+                       "batch_live": lives, "device_ms": dms},
+                      {"probs": probs})
+            elif cmd == "reload":
+                try:
+                    new_lm = server.reload(name)
+                    reply({"cmd": "reloaded", "seq": seq, "ok": True,
+                           "generation":
+                               gen_base + int(new_lm.generation),
+                           "compiles":
+                               int(new_lm.runner.compile_count())})
+                except Exception as e:
+                    reply({"cmd": "reloaded", "seq": seq, "ok": False,
+                           **_status_of(e)})
+            elif cmd == "probe":
+                # end-to-end health probe: a real request through the
+                # full inner stack, not just a device ping
+                try:
+                    fut = server.submit(
+                        name, np.zeros(sample_shape, dtype=np.float32),
+                        wait=True)
+                    fut.result(timeout=result_timeout_s)
+                    reply({"cmd": "probed", "seq": seq, "ok": True})
+                except Exception as e:
+                    reply({"cmd": "probed", "seq": seq, "ok": False,
+                           **_status_of(e)})
+            elif cmd == "stats":
+                try:
+                    payload = json.loads(
+                        json.dumps(server.stats(), default=str))
+                    reply({"cmd": "stats", "seq": seq, "ok": True,
+                           "stats": payload})
+                except Exception as e:
+                    reply({"cmd": "stats", "seq": seq, "ok": False,
+                           **_status_of(e)})
+            else:
+                reply({"cmd": "error", "seq": seq, "ok": False,
+                       "error": "UnknownCommand", "status": 400,
+                       "detail": f"unknown fleet command {cmd!r}"})
+    except ipc.IpcClosed:
+        pass                        # router hung up mid-reply: just exit
+    finally:
+        server.close(drain=True)
+        if beat is not None:
+            beat.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -438,7 +438,7 @@ def test_bench_trainserve_leg_contract(monkeypatch):
 
     import bench
 
-    assert bench.BENCH_SCHEMA_VERSION == 9
+    assert bench.BENCH_SCHEMA_VERSION == 10
     canned = {"ok": True, "model": "lenet", "promotions": 2,
               "rejections": 1, "staleness_mean": 0.6, "staleness_max": 1.0,
               "swap_p99_delta_ms": 3.25, "dropped": 0, "completed": 132,
@@ -614,3 +614,67 @@ def test_bench_serving_autoscale_leg_contract(monkeypatch):
     _Proc.stdout = _json.dumps(canned) + "\n"
     with pytest.raises(RuntimeError, match="dropped"):
         bench.bench_serving_autoscale()
+
+
+def test_bench_serving_fleet_leg_contract(monkeypatch):
+    """The serving_fleet leg (schema v10) runs fleet_bench.py --smoke
+    in a SUBPROCESS and parses one JSON line; pin the field mapping
+    against _KNOWN_FIELDS/_KNOWN_LEGS and every failure mode the
+    guarded leg relies on — non-zero exit, not-ok record, and the
+    exactly-once bar (dropped > 0 must RAISE, never land).  The live
+    path is tests/test_serving_fleet.py."""
+    import json as _json
+    import subprocess
+
+    import bench
+
+    canned = {"ok": True, "model": "lenet", "workers": 2, "rounds": 3,
+              "requests_per_burst": 48, "fleet_qps": 1179.3,
+              "single_qps": 2063.2, "speedup": 0.5716,
+              "fleet_p50_ms": 26.1, "fleet_p99_ms": 40.4,
+              "single_p50_ms": 13.9, "single_p99_ms": 21.7,
+              "fleet_completed": 144, "single_completed": 144,
+              "dropped": 0, "worker_restarts": 0, "parity_pairs": 3,
+              "parity_failed": 0}
+
+    class _Proc:
+        returncode = 0
+        stderr = ""
+        stdout = "progress noise\n" + _json.dumps(canned) + "\n"
+
+    calls = []
+
+    def fake_run(cmd, **kw):
+        calls.append(cmd)
+        return _Proc()
+
+    monkeypatch.setattr(subprocess, "run", fake_run)
+    r = bench.bench_serving_fleet()
+    assert calls and calls[0][1].endswith("fleet_bench.py")
+    assert "--smoke" in calls[0]
+    assert r["serving_fleet_workers"] == 2
+    assert r["serving_fleet_qps"] == 1179.3
+    assert r["serving_fleet_single_qps"] == 2063.2
+    assert r["serving_fleet_speedup"] == 0.5716
+    assert r["serving_fleet_p50_ms"] == 26.1
+    assert r["serving_fleet_p99_ms"] == 40.4
+    assert r["serving_fleet_dropped"] == 0
+    assert r["serving_fleet_restarts"] == 0
+    assert r["serving_fleet_parity_failed"] == 0
+    assert set(r) <= bench._KNOWN_FIELDS
+    assert "serving_fleet" in bench._KNOWN_LEGS
+
+    _Proc.returncode = 1
+    _Proc.stderr = "boom"
+    with pytest.raises(RuntimeError, match="exited 1"):
+        bench.bench_serving_fleet()
+    _Proc.returncode = 0
+    canned["ok"] = False
+    _Proc.stdout = _json.dumps(canned) + "\n"
+    with pytest.raises(RuntimeError, match="not-ok"):
+        bench.bench_serving_fleet()
+    canned["ok"] = True
+    canned["dropped"] = 3
+    _Proc.stdout = _json.dumps(canned) + "\n"
+    with pytest.raises(RuntimeError, match="dropped"):
+        bench.bench_serving_fleet()

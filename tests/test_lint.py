@@ -810,6 +810,10 @@ def test_lint_gate_script(tmp_path):
     # in tests/test_autoscale.py)
     assert "autoscale_drill.py --smoke" in text
     assert "SPARKNET_LINT_GATE_NO_AUTOSCALE" in text
+    # ... and the fleet-serving smoke (exercised live by the
+    # chaos-marked tests in tests/test_serving_fleet.py)
+    assert "serve_chaos_run.py --smoke --fleet" in text
+    assert "SPARKNET_LINT_GATE_NO_FLEET" in text
     clean = _mkpkg(tmp_path, {"ok.py": "x = 1\n"})
     dirty_dir = tmp_path / "dirty"
     dirty_dir.mkdir()
@@ -820,7 +824,8 @@ def test_lint_gate_script(tmp_path):
                SPARKNET_LINT_GATE_NO_TRAINSERVE="1",
                SPARKNET_LINT_GATE_NO_SERVECHAOS="1",
                SPARKNET_LINT_GATE_NO_SHARDED="1",
-               SPARKNET_LINT_GATE_NO_AUTOSCALE="1")
+               SPARKNET_LINT_GATE_NO_AUTOSCALE="1",
+               SPARKNET_LINT_GATE_NO_FLEET="1")
     rc_clean = subprocess.run(
         ["bash", gate, clean, "--select", "R001"],
         cwd=REPO, env=env, capture_output=True, text=True)

@@ -427,8 +427,8 @@ def test_bench_stamp_provenance():
 
     payload = {"metric": "x", "value": 1.0}
     out = bench._stamp(payload)
-    # v9: the serving_autoscale drill leg (doom-loop + zero-drop bars)
-    assert out["schema_version"] == bench.BENCH_SCHEMA_VERSION == 9
+    # v10: the serving_fleet A/B leg (process workers vs in-process)
+    assert out["schema_version"] == bench.BENCH_SCHEMA_VERSION == 10
     assert "git_sha" in out and "env" in out
     assert all(k.startswith("SPARKNET_") for k in out["env"])
     assert out["value"] == 1.0

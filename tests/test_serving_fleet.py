@@ -1,0 +1,353 @@
+"""Fleet serving contract (sparknet_tpu/serving/fleet.py): one router
+in front of REAL OS worker processes must be indistinguishable from the
+in-process server where it counts — responses bitwise equal to a direct
+forward (fp32 AND int8, across process boundaries), every admitted
+request answered exactly once through worker death (plan-driven SIGKILL
+→ drain/requeue → fresh-process respawn → half-open re-admission), a
+SIGSTOP'd worker caught by the heartbeat watchdog, and `reload()`
+swapping generations fleet-wide with zero mixed-generation responses.
+
+Plus the shared transport's own contract (elastic/ipc.py): bitwise
+frame round-trips, clean-EOF vs torn-frame vs desync taxonomy
+(None / IpcClosed / stream-naming ValueError — rule R002 applies to
+the wire), and single-fire watchdog semantics.
+
+The heavy tests spawn real subprocesses (jax import + warmup per
+worker); they keep worker counts and bursts minimal.
+"""
+
+import io
+import json
+import os
+import signal
+import struct
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from sparknet_tpu.elastic import ipc
+from sparknet_tpu.serving import (InferenceServer, ServeFaultPlan,
+                                  ServerConfig, pad_to_bucket)
+from sparknet_tpu.serving.fleet import FleetConfig, FleetServer
+
+LENET_SHAPE = (1, 28, 28)
+
+
+def _can_spawn() -> bool:
+    try:
+        p = subprocess.run([sys.executable, "-c", "print(7*6)"],
+                           capture_output=True, text=True, timeout=60)
+        return p.returncode == 0 and "42" in p.stdout
+    except (OSError, subprocess.SubprocessError):
+        return False
+
+
+_SPAWN_OK = _can_spawn()
+
+pytestmark = pytest.mark.chaos
+
+needs_spawn = pytest.mark.skipif(
+    not _SPAWN_OK, reason="sandbox forbids subprocess spawn")
+
+
+def _samples(n, seed=0):
+    return np.random.RandomState(seed).rand(
+        n, *LENET_SHAPE).astype(np.float32)
+
+
+def _wait_for(pred, timeout_s, what):
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < timeout_s:
+        if pred():
+            return
+        time.sleep(0.05)
+    raise AssertionError(f"timed out after {timeout_s}s waiting for "
+                         f"{what}")
+
+
+def _fleet_cfg(tmp_path, **kw):
+    base = dict(workers=2, max_batch=4, max_wait_ms=1.0,
+                queue_depth=64, cooldown_s=0.3, tick_s=0.03,
+                heartbeat_s=0.1, spawn_timeout_s=180.0,
+                workdir=str(tmp_path / "fleet"),
+                event_log=str(tmp_path / "fleet_events.jsonl"))
+    base.update(kw)
+    return FleetConfig(**base)
+
+
+# ----------------------------------------------------------- ipc frames
+def test_frame_roundtrip_bitwise():
+    # exotic payloads survive the wire bit-for-bit: nan, -0.0, denormal,
+    # int64 extremes, empty arrays, and non-ASCII meta
+    arrays = {
+        "f32": np.array([np.nan, -0.0, np.finfo(np.float32).tiny,
+                         1.0 / 3.0], dtype=np.float32),
+        "i64": np.array([np.iinfo(np.int64).min,
+                         np.iinfo(np.int64).max], dtype=np.int64),
+        "empty": np.zeros((0, 3), dtype=np.float32),
+    }
+    meta = {"cmd": "infer", "seq": 7, "note": "probé"}
+    buf = io.BytesIO()
+    ipc.write_frame(buf, meta, arrays, lock=threading.Lock())
+    ipc.write_frame(buf, {"cmd": "stop", "seq": 8})   # second frame
+    buf.seek(0)
+    got_meta, got = ipc.read_frame(buf, what="test")
+    assert got_meta == meta
+    assert set(got) == set(arrays)
+    for k in arrays:
+        assert got[k].dtype == arrays[k].dtype
+        assert got[k].tobytes() == arrays[k].tobytes()   # bitwise
+    meta2, arrays2 = ipc.read_frame(buf, what="test")
+    assert meta2 == {"cmd": "stop", "seq": 8} and arrays2 == {}
+    assert ipc.read_frame(buf, what="test") is None      # clean EOF
+
+
+def test_frame_roundtrip_over_real_pipe():
+    rfd, wfd = os.pipe()
+    w = os.fdopen(wfd, "wb")
+    r = os.fdopen(rfd, "rb")
+    try:
+        x = _samples(2, seed=3)
+        ipc.write_frame(w, {"seq": 1}, {"x": x})
+        w.close()
+        meta, arrays = ipc.read_frame(r, what="pipe")
+        assert meta == {"seq": 1}
+        assert arrays["x"].tobytes() == x.tobytes()
+        assert ipc.read_frame(r, what="pipe") is None
+    finally:
+        for f in (w, r):
+            try:
+                f.close()
+            except OSError:
+                pass
+
+
+def test_frame_error_taxonomy():
+    # bad magic: stream-naming ValueError, never struct/zipfile noise
+    bad = b"XXXX" + struct.pack("<Q", 4) + b"zzzz"
+    with pytest.raises(ValueError, match="mystream.*magic"):
+        ipc.read_frame(io.BytesIO(bad), what="mystream")
+    # implausible length: desync tripwire
+    huge = ipc.FRAME_MAGIC + struct.pack("<Q", ipc.MAX_FRAME_BYTES + 1)
+    with pytest.raises(ValueError, match="implausible frame length"):
+        ipc.read_frame(io.BytesIO(huge), what="mystream")
+    # torn frame (EOF mid-payload): IpcClosed, not ValueError — the
+    # peer died mid-write, the stream itself was well-formed
+    buf = io.BytesIO()
+    ipc.write_frame(buf, {"seq": 1}, {"x": _samples(1)})
+    torn = buf.getvalue()[:-10]
+    with pytest.raises(ipc.IpcClosed, match="torn frame"):
+        ipc.read_frame(io.BytesIO(torn), what="mystream")
+    # torn header too
+    with pytest.raises(ipc.IpcClosed):
+        ipc.read_frame(io.BytesIO(torn[:6]), what="mystream")
+    # well-framed garbage payload: ValueError naming the stream
+    junk = ipc.FRAME_MAGIC + struct.pack("<Q", 4) + b"junk"
+    with pytest.raises(ValueError, match="mystream.*malformed"):
+        ipc.read_frame(io.BytesIO(junk), what="mystream")
+    # valid npz payload but no __meta__ key
+    nbuf = io.BytesIO()
+    np.savez(nbuf, x=np.zeros(1))
+    payload = nbuf.getvalue()
+    framed = ipc.FRAME_MAGIC + struct.pack("<Q", len(payload)) + payload
+    with pytest.raises(ValueError, match="mystream"):
+        ipc.read_frame(io.BytesIO(framed), what="mystream")
+
+
+def test_mtime_watchdog_fires_once_per_stall_episode(tmp_path):
+    hb = str(tmp_path / "hb")
+    ipc.touch(hb)
+    wd = ipc.MtimeWatchdog(miss_after_s=1.0)
+    assert wd.tick("w", hb, 0.5) is False      # first sight: baseline
+    assert wd.tick("w", hb, 0.6) is False      # 0.6s stalled
+    assert wd.tick("w", hb, 0.6) is True       # crosses 1.0s: FIRES
+    assert wd.tick("w", hb, 5.0) is False      # same episode: silent
+    assert wd.stalled_s("w") > 1.0
+    time.sleep(0.01)
+    ipc.touch(hb)                              # heartbeat resumes
+    assert wd.tick("w", hb, 0.5) is False      # episode ends
+    assert wd.stalled_s("w") == 0.0
+    assert wd.tick("w", hb, 1.1) is True       # new episode re-arms
+    wd.reset("w")
+    assert wd.tick("w", hb, 9.9) is False      # reset = fresh baseline
+
+
+# ------------------------------------------------- cross-process parity
+@needs_spawn
+def test_fleet_parity_and_generation_swap(tmp_path):
+    """fp32, 2 workers: every fleet response is bitwise equal to an
+    in-process direct forward at the recorded bucket, and reload()
+    under live traffic never emits a mixed or stale generation."""
+    fs = FleetServer(_fleet_cfg(tmp_path))
+    try:
+        fm = fs.load("lenet", seed=0, buckets=[1, 4])
+        ref = InferenceServer(ServerConfig(max_batch=4))
+        ref_lm = ref.load("lenet", seed=0, replicas=1, buckets=[1, 4])
+        pool = _samples(8, seed=11)
+
+        futs = [fs.submit("lenet", pool[i % 8],
+                          priority=("batch" if i % 3 == 0
+                                    else "interactive"))
+                for i in range(12)]
+        for i, fut in enumerate(futs):
+            r = fut.result(timeout=120)
+            assert r.generation == 0
+            assert 0 <= r.replica < 2
+            probs_ref = ref_lm.runner.forward_padded(
+                pad_to_bucket(pool[i % 8][None], r.bucket))[0]
+            np.testing.assert_array_equal(r.probs, probs_ref)
+
+        # generation swap under live traffic: a submitter thread keeps
+        # the queue non-empty across the barrier
+        stop = threading.Event()
+        during = []
+
+        def pump():
+            while not stop.is_set():
+                try:
+                    during.append(
+                        fs.submit("lenet", pool[0]).result(timeout=120))
+                except Exception:
+                    return
+
+        t = threading.Thread(target=pump, daemon=True)
+        t.start()
+        try:
+            fm2 = fs.reload("lenet")
+        finally:
+            stop.set()
+            t.join(timeout=120)
+        assert fm2.generation == 1 and fs.generation == 1
+
+        # responses spanning the swap carry exactly one generation each,
+        # from {0, 1} — and seed-replicated params mean BOTH generations
+        # must still match the reference bitwise (a torn swap would not)
+        assert during
+        gens = [r.generation for r in during]
+        assert set(gens) <= {0, 1}
+        probs_ref = ref_lm.runner.forward_padded(
+            pad_to_bucket(pool[0][None], during[-1].bucket))[0]
+        for r in during:
+            np.testing.assert_array_equal(r.probs, probs_ref)
+
+        # everything submitted AFTER the swap returned is generation 1
+        r = fs.submit("lenet", pool[1]).result(timeout=120)
+        assert r.generation == 1
+        kinds = [e["kind"] for e in fs.events_snapshot()]
+        assert "fleet_reload" in kinds
+        ref.close()
+    finally:
+        fs.close()
+    assert fs.stats()["accepting"] is False
+
+
+@needs_spawn
+def test_fleet_parity_int8_single_worker(tmp_path):
+    """Quantized serving crosses the process boundary bitwise too: the
+    worker's int8 pack (per-channel scales computed in-process from the
+    same seed) must agree with a local int8 reference."""
+    fs = FleetServer(_fleet_cfg(tmp_path, workers=1, max_batch=2))
+    try:
+        fm = fs.load("lenet", seed=0, buckets=[1, 2], quant="int8",
+                     quant_min_agreement=0.0)
+        assert fm.quant == "int8"
+        ref = InferenceServer(ServerConfig(max_batch=2))
+        ref_lm = ref.load("lenet", seed=0, replicas=1, buckets=[1, 2],
+                          quant="int8", quant_min_agreement=0.0)
+        pool = _samples(4, seed=5)
+        for i, fut in enumerate(fs.submit_many("lenet", pool)):
+            r = fut.result(timeout=120)
+            probs_ref = ref_lm.runner.forward_padded(
+                pad_to_bucket(pool[i][None], r.bucket))[0]
+            np.testing.assert_array_equal(r.probs, probs_ref)
+        ref.close()
+    finally:
+        fs.close()
+
+
+# ------------------------------------------------ process-grained faults
+@needs_spawn
+def test_fleet_kill_requeue_exactly_once(tmp_path):
+    """A plan-driven REAL SIGKILL mid-burst: every admitted request
+    still resolves exactly once (retried onto the survivor), the dead
+    worker respawns as a FRESH process and earns re-admission through
+    probes, and post-heal traffic flows through the new incarnation."""
+    plan = ServeFaultPlan.from_spec("kill:1@2", seed=3)
+    fs = FleetServer(_fleet_cfg(tmp_path, max_batch=2,
+                                fault_plan=plan))
+    try:
+        fs.load("lenet", seed=0, buckets=[1, 2])
+        pid0 = fs.worker_pid(1)
+        pool = _samples(8, seed=2)
+        futs = [fs.submit("lenet", pool[i % 8]) for i in range(16)]
+        results = [f.result(timeout=120) for f in futs]
+        assert len(results) == 16                 # dropped == 0
+        for r in results:
+            assert r.probs.shape == (10,)
+
+        snap = fs.fleet_snapshot()
+        assert snap["kills_injected"] >= 1
+        assert snap["trips"] >= 1
+        assert snap["requeued"] + snap["retried"] >= 1
+
+        _wait_for(fs.all_closed, 90.0,
+                  "respawn + half-open re-admission")
+        snap = fs.fleet_snapshot()
+        assert snap["respawns"] >= 1
+        assert snap["incarnations"][1] >= 1       # fresh process
+        assert fs.worker_pid(1) != pid0
+        kinds = [e["kind"] for e in fs.events_snapshot()]
+        for k in ("worker_kill_injected", "worker_open",
+                  "worker_respawn", "worker_probe"):
+            assert k in kinds, f"missing {k} in {kinds}"
+
+        # post-heal: traffic reaches BOTH workers again, bitwise same
+        seen = set()
+        for i, f in enumerate([fs.submit("lenet", pool[i % 8])
+                               for i in range(8)]):
+            seen.add(f.result(timeout=120).replica)
+        assert seen == {0, 1}
+
+        # event log on disk mirrors the in-memory stream
+        with open(fs.cfg.event_log) as f:
+            logged = [json.loads(line) for line in f if line.strip()]
+        assert len(logged) == len(fs.events_snapshot())
+    finally:
+        fs.close()
+
+
+@needs_spawn
+def test_fleet_sigstop_trips_heartbeat_watchdog(tmp_path):
+    """An UNPLANNED wedge (SIGSTOP — no exit, no pipe close) must be
+    caught by the file-mtime watchdog, tripped like a death, and healed
+    by a fresh process."""
+    fs = FleetServer(_fleet_cfg(tmp_path))
+    try:
+        fs.load("lenet", seed=0, buckets=[1, 4])
+        fs.kill_worker(1, signal.SIGSTOP)
+
+        def tripped():
+            return any(e["kind"] == "worker_open"
+                       and e["worker"] == 1
+                       and e["reason"] == "heartbeat"
+                       for e in fs.events_snapshot())
+
+        # hb_miss_after_s = max(4 * 0.1, 1.0) = 1.0s of mtime silence
+        _wait_for(tripped, 30.0, "heartbeat-reason worker_open event")
+        assert fs.fleet_snapshot()["hb_miss"] >= 1
+
+        # traffic keeps flowing on the survivor while 1 is down
+        pool = _samples(4, seed=9)
+        for f in [fs.submit("lenet", pool[i]) for i in range(4)]:
+            assert f.result(timeout=120).replica == 0
+
+        _wait_for(fs.all_closed, 90.0, "wedged worker healed")
+        assert fs.fleet_snapshot()["states"]["1"] == "live"
+        assert fs.submit("lenet", pool[0]).result(timeout=120) \
+                 .probs.shape == (10,)
+    finally:
+        fs.close()
