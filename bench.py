@@ -837,6 +837,67 @@ def bench_serving_fleet():
     return out
 
 
+def bench_serving_compound():
+    """Compound-serving drill via `scripts/serve_chaos_run.py --smoke
+    --compound` in a subprocess: a mixed seeded burst of windowed-
+    detection compounds, featurization compounds, and plain classify
+    rows against three lanes of one faulted server
+    (serving/compound.py) — the record carries the zero-partial /
+    exactly-once bars, whole-request batch sheds (interactive sheds
+    must be 0), interactive p99, and the interleaved served-vs-offline
+    A/B medians with the bitwise parity bar (dropped or a partial
+    response raises so the guarded leg omits the fields; the smoke
+    itself also asserts event-stream reconciliation and bitwise
+    fault-schedule replay).
+
+    A subprocess for a clean CPU backend and because the smoke's exit
+    code IS the pass/fail signal; re-raises on a non-zero exit or a
+    not-ok line so the guarded leg in _run_legs omits the fields."""
+    import subprocess
+
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+    script = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "scripts", "serve_chaos_run.py")
+    proc = subprocess.run(
+        [sys.executable, script, "--smoke", "--compound",
+         "--requests", "120", "--qps", "200"],
+        capture_output=True, text=True, env=env, timeout=900)
+    if proc.returncode != 0:
+        raise RuntimeError(
+            f"serve_chaos_run.py --compound exited {proc.returncode}: "
+            f"{proc.stderr.strip()[-500:]}")
+    # serve_chaos_run prints ONE JSON line on stdout (chaos_run contract)
+    rec = json.loads(proc.stdout.strip().splitlines()[-1])
+    if not rec.get("ok"):
+        raise RuntimeError(
+            f"serve_chaos_run.py --compound reported not-ok: {rec}")
+    if rec.get("dropped") or rec.get("partial_responses"):
+        raise RuntimeError(
+            f"compound drill dropped {rec.get('dropped')} / answered "
+            f"{rec.get('partial_responses')} partial compounds (every "
+            f"logical request must be answered exactly once, whole or "
+            f"not at all): {rec}")
+    out = {"serving_compound_requests": int(rec["requests"]),
+           "serving_compound_completed": int(rec["completed_compound"]),
+           "serving_compound_dropped": int(rec["dropped"]),
+           "serving_compound_partials": int(rec["partial_responses"]),
+           "serving_compound_sheds": int(rec["sheds"]),
+           "serving_compound_sheds_interactive": int(
+               rec["sheds_interactive"]),
+           "serving_compound_breaker_trips": int(rec["breaker_trips"]),
+           "serving_compound_interactive_p99_ms": rec[
+               "interactive_p99_ms"],
+           "serving_compound_ab_served_ms": rec["ab_served_ms"],
+           "serving_compound_ab_offline_ms": rec["ab_offline_ms"],
+           "serving_compound_parity_failed": int(rec["parity_failed"]),
+           "serving_compound_replay_bitwise": bool(
+               rec["replay_bitwise"])}
+    log(json.dumps(out))
+    return out
+
+
 def bench_longctx_lm(seq_len: int = 16384, n_layers: int = 4,
                      d_model: int = 512, heads: int = 8,
                      block: int = 1024):
@@ -1179,6 +1240,18 @@ _KNOWN_FIELDS = {
     "serving_fleet_p50_ms", "serving_fleet_p99_ms",
     "serving_fleet_dropped", "serving_fleet_restarts",
     "serving_fleet_parity_failed",
+    # compound serving (schema v11): windowed detection + featurization
+    # as served workloads — zero-partial / exactly-once / whole-request
+    # shed bars and the interleaved served-vs-offline A/B medians with
+    # bitwise parity, from serve_chaos_run.py --smoke --compound
+    "serving_compound_requests", "serving_compound_completed",
+    "serving_compound_dropped", "serving_compound_partials",
+    "serving_compound_sheds", "serving_compound_sheds_interactive",
+    "serving_compound_breaker_trips",
+    "serving_compound_interactive_p99_ms",
+    "serving_compound_ab_served_ms", "serving_compound_ab_offline_ms",
+    "serving_compound_parity_failed",
+    "serving_compound_replay_bitwise",
 }
 
 # every leg name main() lands; leg_utc stamps outside this set (renamed
@@ -1189,7 +1262,7 @@ _KNOWN_LEGS = {
     "alexnet_infer", "googlenet_infer", "longctx_lm", "cifar_e2e",
     "imagenet_native", "serving", "serving_int8", "serving_mesh",
     "serving_sharded", "elastic", "trainserve", "serving_resilience",
-    "serving_autoscale", "serving_fleet",
+    "serving_autoscale", "serving_fleet", "serving_compound",
 }
 
 
@@ -1272,7 +1345,15 @@ def _stale_record(reason: str) -> dict:
     return stale
 
 
-BENCH_SCHEMA_VERSION = 10  # v10: serving_fleet leg (OS-process fleet
+BENCH_SCHEMA_VERSION = 11  # v11: serving_compound leg (compound
+#                           serving drill — mixed windowed-detection /
+#                           featurization / classify burst under
+#                           seeded faults; zero-partial, exactly-once
+#                           and whole-request-shed bars, interleaved
+#                           served-vs-offline A/B medians with bitwise
+#                           parity; serve_chaos_run.py --compound
+#                           subprocess);
+#                           v10: serving_fleet leg (OS-process fleet
 #                           router vs in-process server, interleaved
 #                           closed bursts — both arms' median QPS +
 #                           p50/p99, speedup ratio, zero-drop /
@@ -1707,6 +1788,26 @@ def _run_legs(land) -> None:
             "serving_fleet_p50_ms", "serving_fleet_p99_ms",
             "serving_fleet_dropped", "serving_fleet_restarts",
             "serving_fleet_parity_failed")})
+    # compound serving drill (subprocess; CPU path) — mixed windowed
+    # detection + featurization + classify burst under seeded faults;
+    # zero-partial, exactly-once, whole-request-shed and bitwise
+    # served-vs-offline parity bars
+    try:
+        comp = bench_serving_compound()
+    except Exception as e:
+        log(f"serving_compound leg failed, omitting its fields: {e!r}")
+    else:
+        land("serving_compound", {k: comp[k] for k in (
+            "serving_compound_requests", "serving_compound_completed",
+            "serving_compound_dropped", "serving_compound_partials",
+            "serving_compound_sheds",
+            "serving_compound_sheds_interactive",
+            "serving_compound_breaker_trips",
+            "serving_compound_interactive_p99_ms",
+            "serving_compound_ab_served_ms",
+            "serving_compound_ab_offline_ms",
+            "serving_compound_parity_failed",
+            "serving_compound_replay_bitwise")})
     try:
         imgnet_native = bench_imagenet_native()
     except Exception as e:

@@ -30,6 +30,10 @@
 # (scripts/serve_chaos_run.py --fleet: OS worker processes behind the
 # router, REAL SIGKILL mid-burst; trip/respawn/re-admit at process
 # grain, zero dropped, bitwise cross-process parity).
+# SPARKNET_LINT_GATE_NO_COMPOUND=1 skips the compound-serving smoke
+# (scripts/serve_chaos_run.py --compound: detect/featurize/classify
+# lanes under the chaos plan; zero partial responses, whole-request
+# sheds only, bitwise served-vs-offline A/B parity).
 set -euo pipefail
 cd "$(dirname "$0")/.."
 python -m sparknet_tpu.cli lint --format json "$@"
@@ -88,6 +92,19 @@ if [ "${SPARKNET_LINT_GATE_NO_FLEET:-0}" != "1" ]; then
     timeout -k 10 420 env JAX_PLATFORMS=cpu \
         python scripts/serve_chaos_run.py --smoke --fleet 2 \
         --requests 64 --qps 200
+fi
+if [ "${SPARKNET_LINT_GATE_NO_COMPOUND:-0}" != "1" ]; then
+    # compound-serving smoke: windowed detection + featurization as
+    # served workloads — three lanes (detect/featurize/classify) share
+    # the chaos plan under a flash crowd; asserts zero partial
+    # responses, whole-request batch-only sheds with exact three-way
+    # accounting (client == control plane == event stream), exactly-
+    # once at fragment grain, and bitwise served-vs-offline A/B parity
+    # via recorded-bucket replay (--smoke exits non-zero on a miss;
+    # prints ONE JSON line)
+    timeout -k 10 420 env JAX_PLATFORMS=cpu \
+        XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+        python scripts/serve_chaos_run.py --smoke --compound
 fi
 if [ "${SPARKNET_LINT_GATE_NO_AUTOSCALE:-0}" != "1" ]; then
     # autoscale drill: diurnal/spike/flash-crowd load against the live

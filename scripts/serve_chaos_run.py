@@ -43,6 +43,26 @@ built from the same (model, seed) — the cross-process parity pin.
 
 Run:  python scripts/serve_chaos_run.py --smoke --fleet 3
       [--requests 96] [--spec 'errstorm:0@4+8,kill:1@3']
+
+--compound runs the COMPOUND drill instead (the bench.py
+`serving_compound` leg): a mixed seeded burst of windowed-detection
+compounds, featurization compounds, and plain classify rows against
+three lanes of one server (model_type detect / featurize / classify,
+serving/compound.py), with a seeded fault plan armed on every lane.
+The smoke bar asserts the compound contract end to end: ZERO partial
+or mixed-generation responses (every delivered compound carries
+exactly its submitted fragment count from one generation), batch
+compounds shed WHOLE-request while interactive traffic sheds zero and
+its p99 holds the SLO, every logical request is answered exactly once
+(dropped == 0), the compound event stream reconciles exactly
+(submits == assembled + aborts; shed events match client-observed
+sheds; the JSONL sink matches memory line for line), the fault
+schedule replays bitwise, and an interleaved A/B pass pins served
+detect scores BITWISE against the offline warp + forward path while
+timing both sides (ab_served_ms / ab_offline_ms medians).
+
+Run:  python scripts/serve_chaos_run.py --smoke --compound
+      [--requests 120] [--qps 200] [--spec 'errstorm:0@2+6,...']
 """
 
 import argparse
@@ -70,6 +90,12 @@ DEFAULT_SPEC = ("errstorm:0@6+10,kill:1@4,"
 # worker; no spikes (a fleet dispatch already carries a full IPC round
 # trip, and respawns pay a process spawn + compile warmup each)
 DEFAULT_FLEET_SPEC = "errstorm:0@4+8,kill:1@3"
+
+# compound default: an early error storm on replica 0 (tripping its
+# breaker exercises drain-and-requeue at FRAGMENT grain) and a short
+# latency spike on replica 1 so the flash crowd builds real queue
+# pressure and batch compounds shed whole-request
+DEFAULT_COMPOUND_SPEC = "errstorm:0@2+6,spike:1@0+3x400"
 
 
 def _pct(vals, q):
@@ -279,6 +305,341 @@ def _run_fleet(a) -> int:
     return 0 if summary.get("ok") else 1
 
 
+def _run_compound(a) -> int:
+    """The --compound arm: mixed detect/featurize/classify burst with
+    seeded faults on every lane, asserting the all-or-nothing compound
+    contract plus an interleaved served-vs-offline A/B parity + timing
+    pass.  Prints the same ONE-JSON-line contract."""
+    import numpy as np
+
+    from sparknet_tpu.serving import (InferenceServer, RequestShed,
+                                      ResilienceConfig, ServeFaultPlan,
+                                      ServerConfig, ServingError,
+                                      nms_detections, pad_to_bucket,
+                                      pick_bucket, warp_windows)
+    from sparknet_tpu.serving.compound import COMPOUND_LOG_ENV
+
+    workdir = a.workdir or tempfile.mkdtemp(prefix="sparknet-compchaos-")
+    os.makedirs(workdir, exist_ok=True)
+    event_log = os.path.join(workdir, "serve_events.jsonl")
+    compound_log = os.path.join(workdir, "compound_events.jsonl")
+    # the JSONL sink knob is read at server construction
+    # (CompoundEventLog); the drill doubles as its integration test
+    os.environ[COMPOUND_LOG_ENV] = compound_log
+
+    plan = ServeFaultPlan.from_spec(a.spec, seed=a.seed)
+    plan_replay = ServeFaultPlan.from_spec(a.spec, seed=a.seed)
+    digest = plan.schedule_digest(a.replicas, 2048)
+    replay_bitwise = digest == plan_replay.schedule_digest(a.replicas,
+                                                           2048)
+
+    rcfg = ResilienceConfig(
+        cooldown_s=a.cooldown_s, slo_ms=a.slo_ms,
+        shed_fraction=a.shed_fraction, fault_plan=plan,
+        event_log=event_log)
+    cfg = ServerConfig(max_batch=a.max_batch, max_wait_ms=2.0,
+                       queue_depth=a.queue_depth, resilience=rcfg)
+    server = InferenceServer(cfg)
+    t_start = time.perf_counter()
+    det = server.load("det", a.model, seed=a.seed, replicas=a.replicas,
+                      model_type="detect")
+    server.load("feat", a.model, seed=a.seed, replicas=a.replicas,
+                model_type="featurize", capture_blob=a.feat_blob)
+    server.load("cls", a.model, seed=a.seed, replicas=a.replicas)
+    cs = det.runner.sample_shape[-1]
+    print(f"compound lanes up on {a.model}: det/feat/cls x "
+          f"{a.replicas} replicas, crop {cs}, feat blob "
+          f"{a.feat_blob!r}; spec {a.spec!r}", file=sys.stderr,
+          flush=True)
+
+    rng = np.random.RandomState(a.seed)
+    c = det.runner.sample_shape[0]
+    ih = iw = 2 * cs            # detect images larger than the crop
+    imgs = rng.rand(16, c, ih, iw).astype(np.float32)
+    rows = rng.rand(16, *det.runner.sample_shape).astype(np.float32)
+
+    def draw_windows(n):
+        out = []
+        for _ in range(n):
+            x1 = int(rng.randint(0, iw - 6))
+            y1 = int(rng.randint(0, ih - 6))
+            out.append([x1, y1,
+                        x1 + int(rng.randint(3, min(12, iw - x1))),
+                        y1 + int(rng.randint(3, min(12, ih - y1)))])
+        return out
+
+    # pre-drawn seeded traffic: kind, priority, fan-out width
+    kinds, plans_w = [], []
+    for i in range(a.requests):
+        u = rng.rand()
+        if u < 0.4:
+            nw = int(rng.randint(2, 6))
+            kinds.append(("det", nw))
+            plans_w.append(draw_windows(nw))
+        elif u < 0.7:
+            kinds.append(("feat", int(rng.randint(1, 5))))
+            plans_w.append(None)
+        else:
+            kinds.append(("cls", 1))
+            plans_w.append(None)
+    pris = ["interactive" if rng.rand() < a.interactive_frac else "batch"
+            for _ in range(a.requests)]
+    unit = rng.exponential(1.0, size=a.requests)
+
+    futs = []                 # (rid, kind, priority, n_expected, fut)
+    sync_rejects = {}
+    shed_client = 0           # all RequestShed observations
+    shed_compound_client = 0  # ... of which were compound submissions
+    t0 = time.perf_counter()
+    next_t = t0
+    for i in range(a.requests):
+        mult = a.shape_factor if i / a.requests >= 0.5 else 1.0
+        next_t += unit[i] / (a.qps * mult)
+        now = time.perf_counter()
+        if next_t > now:
+            time.sleep(next_t - now)
+        kind, n = kinds[i]
+        kw = {}
+        if (a.deadline_every and pris[i] == "interactive"
+                and i % a.deadline_every == 0):
+            kw["deadline_ms"] = a.deadline_ms
+        try:
+            if kind == "det":
+                fut = server.submit_compound(
+                    "det", imgs[i % 16], plans_w[i],
+                    priority=pris[i], **kw)
+            elif kind == "feat":
+                fut = server.submit_compound(
+                    "feat", rows[(i + np.arange(n)) % 16],
+                    priority=pris[i], **kw)
+            else:
+                fut = server.submit("cls", rows[i % 16],
+                                    priority=pris[i], **kw)
+            futs.append((i, kind, pris[i], n, fut))
+        except ServingError as e:
+            name = type(e).__name__
+            sync_rejects[name] = sync_rejects.get(name, 0) + 1
+            if isinstance(e, RequestShed):
+                shed_client += 1
+                if kind != "cls":
+                    shed_compound_client += 1
+    offered_s = time.perf_counter() - t0
+
+    lat_by_pri = {"interactive": [], "batch": []}
+    generations = set()
+    async_errs = {}
+    dropped = 0
+    partials = 0              # delivered compounds missing fragments
+    completed_compound = 0
+    completed_cls = 0
+    for rid, kind, pri, n, fut in futs:
+        try:
+            r = fut.result(timeout=120)
+        except ServingError as e:
+            name = type(e).__name__
+            async_errs[name] = async_errs.get(name, 0) + 1
+            continue
+        except Exception:
+            dropped += 1      # future died without a serving status
+            continue
+        lat_by_pri[pri].append(r.total_ms)
+        generations.add(r.generation)
+        if kind == "cls":
+            completed_cls += 1
+        else:
+            completed_compound += 1
+            # the zero-partial bar: a DELIVERED compound carries
+            # exactly its submitted fragment count, no more, no less
+            if r.fragments != n or len(r.scores) != n:
+                partials += 1
+
+    # recovery: every lane's breakers must close again
+    t_rec = time.perf_counter()
+    mgrs = [server.resilience(m) for m in ("det", "feat", "cls")]
+    while (not all(m.all_closed() for m in mgrs)
+           and time.perf_counter() - t_rec < a.recovery_timeout_s):
+        time.sleep(0.05)
+    recovered = all(m.all_closed() for m in mgrs)
+
+    # ---- interleaved A/B: served compound vs offline warp+forward.
+    # Same seeded windows, bitwise-distinct images per pair (the
+    # measurement discipline: chained timings carry real data
+    # dependencies).  Parity relies on the row-independence the
+    # resilience drill's replay pin already established: a row's score
+    # does not depend on its co-batched rows, so the offline forward at
+    # the covering bucket must reproduce every served row bitwise.
+    ab_served, ab_offline = [], []
+    parity_checked = parity_failed = 0
+    runner = det.runner
+    for j in range(a.ab_pairs):
+        wins = draw_windows(4)
+        img = rng.rand(c, ih, iw).astype(np.float32)
+        t1 = time.perf_counter()
+        r = server.submit_compound("det", img, wins).result(timeout=120)
+        served = float(np.sum(r.scores))    # value consumed before stop
+        ab_served.append((time.perf_counter() - t1) * 1e3)
+        t1 = time.perf_counter()
+        warped = warp_windows(img, [tuple(w) for w in wins],
+                              crop_size=cs)
+        b = pick_bucket(len(warped), runner.buckets)
+        off = runner.forward_padded(
+            pad_to_bucket(warped, b))[:len(warped)]
+        nms_detections(wins, off)
+        offline = float(np.sum(off))
+        ab_offline.append((time.perf_counter() - t1) * 1e3)
+        parity_checked += 1
+        got = np.asarray(r.scores)
+        if np.array_equal(got, off):
+            continue
+        # fragments that rode a replica alone batched at a SMALLER
+        # bucket than the covering one, and bucket-1 vs bucket-4 are
+        # different XLA programs (~1e-7 fp32 drift on this backend);
+        # the bitwise contract is same-bucket replay, so re-run each
+        # unmatched row at the buckets the compound actually rode
+        for i in range(len(wins)):
+            if np.array_equal(got[i], off[i]):
+                continue
+            if not any(np.array_equal(
+                    got[i], runner.forward_padded(
+                        pad_to_bucket(warped[i][None], rb))[0])
+                    for rb in r.buckets):
+                parity_failed += 1
+                break
+
+    stats = server.stats()
+    cevents = server.compound_events()
+    server.close(drain=True)
+    os.environ.pop(COMPOUND_LOG_ENV, None)
+
+    cev = {}
+    for e in cevents:
+        cev[e["kind"]] = cev.get(e["kind"], 0) + 1
+    with open(compound_log) as f:
+        logged = [json.loads(line) for line in f if line.strip()]
+
+    models = stats["models"]
+    sheds_ctl = sum(models[m]["resilience"]["sheds"]
+                    for m in ("det", "feat", "cls"))
+    sheds_interactive = sum(
+        models[m]["resilience"]["sheds_by_priority"].get(
+            "interactive", 0) for m in ("det", "feat", "cls"))
+    deadline_drops = sum(models[m]["resilience"]["deadline_drops"]
+                         for m in ("det", "feat", "cls"))
+    trips = sum(models[m]["resilience"]["trips"]
+                for m in ("det", "feat", "cls"))
+    requeued = sum(models[m]["resilience"]["requeued"]
+                   for m in ("det", "feat", "cls"))
+    answered = (completed_compound + completed_cls
+                + sum(sync_rejects.values()) + sum(async_errs.values()))
+    summary = {
+        "ok": True,
+        "mode": "compound",
+        "model": a.model,
+        "replicas": a.replicas,
+        "spec": a.spec,
+        "seed": a.seed,
+        "requests": a.requests,
+        "offered_qps": a.qps,
+        "shape_factor": a.shape_factor,
+        "offered_s": round(offered_s, 3),
+        "elapsed_s": round(time.perf_counter() - t_start, 3),
+        "completed_compound": completed_compound,
+        "completed_classify": completed_cls,
+        "answered": answered,
+        "dropped": dropped + (a.requests - answered),
+        "partial_responses": partials,
+        "sync_rejects": dict(sorted(sync_rejects.items())),
+        "async_errors": dict(sorted(async_errs.items())),
+        "sheds": sheds_ctl,
+        "sheds_interactive": sheds_interactive,
+        "sheds_client": shed_client,
+        "sheds_compound_client": shed_compound_client,
+        "deadline_drops": deadline_drops,
+        "breaker_trips": trips,
+        "requeued": requeued,
+        "recovered": recovered,
+        "interactive_p50_ms": _pct(lat_by_pri["interactive"], 50),
+        "interactive_p99_ms": _pct(lat_by_pri["interactive"], 99),
+        "batch_p99_ms": _pct(lat_by_pri["batch"], 99),
+        "slo_ms": a.slo_ms,
+        "generations": sorted(generations),
+        "ab_pairs": a.ab_pairs,
+        "ab_served_ms": _pct(ab_served, 50),
+        "ab_offline_ms": _pct(ab_offline, 50),
+        "parity_checked": parity_checked,
+        "parity_failed": parity_failed,
+        "replay_bitwise": replay_bitwise,
+        "schedule_digest": digest,
+        "compound_events": dict(sorted(cev.items())),
+        "compound_events_logged": len(logged),
+        "workdir": workdir,
+    }
+
+    if a.smoke:
+        problems = []
+        if not replay_bitwise:
+            problems.append("fault schedule did not replay bitwise")
+        if partials:
+            problems.append(f"{partials} delivered compounds were "
+                            f"PARTIAL (fragment count mismatch)")
+        if summary["generations"] not in ([], [0]):
+            problems.append(f"mixed/bumped generations "
+                            f"{summary['generations']}")
+        if summary["dropped"] != 0:
+            problems.append(f"dropped {summary['dropped']} != 0 "
+                            f"(every logical request must be answered "
+                            f"exactly once)")
+        if sheds_ctl < 1:
+            problems.append("no sheds under flash crowd")
+        if sheds_interactive != 0:
+            problems.append(f"interactive sheds {sheds_interactive} "
+                            f"!= 0 (batch must absorb 100% of sheds)")
+        if shed_client != sheds_ctl:
+            problems.append(f"shed accounting mismatch: client "
+                            f"{shed_client} != control plane "
+                            f"{sheds_ctl}")
+        if cev.get("compound_shed", 0) != shed_compound_client:
+            problems.append(
+                f"compound_shed events "
+                f"{cev.get('compound_shed', 0)} != client-observed "
+                f"compound sheds {shed_compound_client}")
+        if cev.get("compound_submit", 0) != (
+                cev.get("compound_assembled", 0)
+                + cev.get("compound_abort", 0)):
+            problems.append(
+                f"compound event stream does not reconcile: "
+                f"{cev.get('compound_submit', 0)} submits != "
+                f"{cev.get('compound_assembled', 0)} assembled + "
+                f"{cev.get('compound_abort', 0)} aborts")
+        if cev.get("compound_assembled", 0) != \
+                completed_compound + a.ab_pairs:
+            problems.append(
+                f"assembled events {cev.get('compound_assembled', 0)} "
+                f"!= delivered compounds "
+                f"{completed_compound + a.ab_pairs}")
+        if len(logged) != len(cevents):
+            problems.append(f"compound JSONL lines {len(logged)} != "
+                            f"in-memory events {len(cevents)}")
+        if not recovered:
+            problems.append(f"breakers not all closed after "
+                            f"{a.recovery_timeout_s}s")
+        if summary["interactive_p99_ms"] > a.slo_ms:
+            problems.append(
+                f"interactive p99 {summary['interactive_p99_ms']} ms "
+                f"over SLO {a.slo_ms} ms")
+        if parity_checked == 0:
+            problems.append("no A/B pair was parity-checked")
+        if parity_failed:
+            problems.append(f"{parity_failed} served compounds differ "
+                            f"bitwise from the offline warp+forward "
+                            f"path")
+        if problems:
+            summary["ok"] = False
+            summary["problems"] = problems
+    print(json.dumps(summary), flush=True)
+    return 0 if summary.get("ok") else 1
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="serve_chaos_run",
@@ -298,6 +659,15 @@ def main(argv=None) -> int:
                     help="run the drill at process granularity: N OS "
                          "worker processes behind the fleet router "
                          "(0 = the in-process resilience drill)")
+    ap.add_argument("--compound", action="store_true",
+                    help="run the compound-serving drill instead: a "
+                         "mixed detect/featurize/classify burst "
+                         "against three lanes (serving/compound.py)")
+    ap.add_argument("--feat_blob", default="ip1",
+                    help="capture_blob for the featurize lane")
+    ap.add_argument("--ab_pairs", type=int, default=6,
+                    help="interleaved served-vs-offline A/B pairs "
+                         "after recovery (--compound)")
     ap.add_argument("--max_batch", type=int, default=4)
     ap.add_argument("--queue_depth", type=int, default=96)
     ap.add_argument("--seed", type=int, default=7)
@@ -321,11 +691,17 @@ def main(argv=None) -> int:
     ap.add_argument("--parity_checks", type=int, default=12)
     a = ap.parse_args(argv)
     if a.spec is None:
-        a.spec = DEFAULT_FLEET_SPEC if a.fleet else DEFAULT_SPEC
+        a.spec = (DEFAULT_FLEET_SPEC if a.fleet
+                  else DEFAULT_COMPOUND_SPEC if a.compound
+                  else DEFAULT_SPEC)
     if a.recovery_timeout_s is None:
         a.recovery_timeout_s = 150.0 if a.fleet else 45.0
+    if a.fleet and a.compound:
+        ap.error("--compound runs in-process; drop --fleet")
     if a.fleet:
         return _run_fleet(a)
+    if a.compound:
+        return _run_compound(a)
 
     import numpy as np
 

@@ -42,11 +42,23 @@ request/response stream as TrafficLogger shards
 serving generation, re-ingestable as a training feed
 (`deploy.traffic.traffic_feed` — the train-while-serve reverse edge).
 
+COMPOUND traffic (`--windows-dist LO:HI` with `--model_type detect`
+or `--model_type featurize --capture_blob BLOB`): every request is one
+submit_compound() — a seeded image plus a seeded proposal-window set
+whose width is drawn uniformly from [LO, HI] (detect), or that many
+raw rows answered with the captured intermediate blob (featurize).
+The summary then adds a `compound` section (logical requests vs device
+fragments, realized fan-out mean, per-request detection counts for
+detect) on top of the usual percentiles — note `completed`/`p50` come
+from lane stats, which count FRAGMENTS for compound lanes.
+
 Examples:
     python scripts/serve_loadgen.py --model lenet --mode open --qps 200
     python scripts/serve_loadgen.py --models lenet=3,cifar10_quick=1 \
         --mode closed --concurrency 16 --replicas 0 --requests 2000 \
         --jsonl serve_study.jsonl
+    python scripts/serve_loadgen.py --model lenet --model_type detect \
+        --windows-dist 2:8 --mode open --qps 50 --requests 200
 """
 
 import argparse
@@ -106,6 +118,24 @@ def _parse_priority_mix(spec):
         raise SystemExit("--priority-mix parsed to an empty mix")
     total = sum(out.values())
     return {k: v / total for k, v in out.items()}
+
+
+def _parse_windows_dist(spec):
+    """'2:8' -> (2, 8): per-request compound fan-out width drawn
+    uniformly from [lo, hi].  None -> no compound traffic."""
+    if not spec:
+        return None
+    lo, sep, hi = spec.partition(":")
+    if not sep:
+        raise SystemExit(f"--windows-dist {spec!r} needs LO:HI")
+    try:
+        lo_i, hi_i = int(lo), int(hi)
+    except ValueError:
+        raise SystemExit(f"--windows-dist bounds {spec!r} are not ints")
+    if lo_i < 1 or hi_i < lo_i:
+        raise SystemExit(f"--windows-dist needs 1 <= LO <= HI, "
+                         f"got {spec!r}")
+    return (lo_i, hi_i)
 
 
 def _parse_models(spec: str):
@@ -183,6 +213,20 @@ def main() -> None:
                    help="batch rows a replica waits for before dispatch "
                         "(default SPARKNET_SERVE_MIN_FILL, normally 1 = "
                         "continuous batching)")
+    p.add_argument("--model_type", default="classify",
+                   choices=("classify", "detect", "featurize"),
+                   help="lane type for the loaded model; detect and "
+                        "featurize serve COMPOUND requests "
+                        "(--windows-dist)")
+    p.add_argument("--capture_blob", default=None,
+                   help="intermediate blob answered by a featurize "
+                        "lane (required with --model_type featurize)")
+    p.add_argument("--windows-dist", dest="windows_dist", default=None,
+                   metavar="LO:HI",
+                   help="compound fan-out width per request, uniform "
+                        "on [LO, HI]: proposal windows for detect, "
+                        "raw rows for featurize (requires a "
+                        "non-classify --model_type)")
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--jsonl", default=None,
                    help="append one record per request to this file")
@@ -202,10 +246,21 @@ def main() -> None:
         raise SystemExit(f"--shape_factor must be > 0, "
                          f"got {a.shape_factor}")
     pri_mix = _parse_priority_mix(a.priority_mix)
+    windows_dist = _parse_windows_dist(a.windows_dist)
     mix = _parse_models(a.models) if a.models else [(a.model or "lenet",
                                                      1.0)]
     if a.weights and len(mix) > 1:
         raise SystemExit("--weights applies to a single --model only")
+    if windows_dist and a.model_type == "classify":
+        raise SystemExit("--windows-dist needs --model_type detect or "
+                         "featurize (classify lanes serve plain rows)")
+    if a.model_type != "classify" and not windows_dist:
+        raise SystemExit(f"--model_type {a.model_type} serves compound "
+                         f"traffic; pass --windows-dist LO:HI")
+    if a.model_type != "classify" and len(mix) > 1:
+        raise SystemExit("compound traffic drives a single --model")
+    if a.model_type == "featurize" and not a.capture_blob:
+        raise SystemExit("--model_type featurize needs --capture_blob")
 
     from sparknet_tpu.utils.compile_cache import (apply_platform_env,
                                                   maybe_enable_compile_cache)
@@ -251,6 +306,9 @@ def main() -> None:
                                 model=a.model if not a.models else None)
     rejects = {"n": 0}
     rejects_by_type = {}
+    # compound accounting: logical requests vs the device fragments
+    # they fanned out to (lane stats count fragments)
+    comp_done = {"requests": 0, "fragments": 0, "detections": 0}
     lat_by_pri = {"interactive": [], "batch": []}
     rejects_lock = threading.Lock()
     # timeline raw stamps (absolute perf_counter seconds; bucketed into
@@ -274,19 +332,39 @@ def main() -> None:
             record({"id": rid, "model": name, "priority": pri,
                     "error": type(e).__name__, "status": e.status})
             return None
+        compound = hasattr(r, "fragments")
         with rejects_lock:
             lat_by_pri[pri].append(r.total_ms)
             # completion stamp from submit time + server-side total, so
             # the answered timeline is independent of settle() ordering
             # (the open loop settles its futures after the last submit)
             tl_answered.append((t_submit + r.total_ms / 1e3, r.total_ms))
-        record({"id": rid, "model": name, "replica": r.replica,
-                "priority": pri, "bucket": r.bucket,
-                "queue_wait_ms": r.queue_wait_ms,
-                "assembly_ms": r.assembly_ms,
-                "device_ms": r.device_ms, "total_ms": r.total_ms,
-                "client_ms": round((time.perf_counter() - t_submit) * 1e3,
-                                   4)})
+            if compound:
+                comp_done["requests"] += 1
+                comp_done["fragments"] += r.fragments
+                if r.detections is not None:
+                    comp_done["detections"] += len(r.detections)
+        if compound:
+            # a CompoundResponse has no single replica/bucket — the
+            # fragments rode their own; record the fan-in view
+            record({"id": rid, "model": name, "priority": pri,
+                    "mode": r.mode, "fragments": r.fragments,
+                    "buckets": r.buckets,
+                    "queue_wait_ms": r.queue_wait_ms,
+                    "total_ms": r.total_ms,
+                    "detections": (len(r.detections)
+                                   if r.detections is not None
+                                   else None),
+                    "client_ms": round(
+                        (time.perf_counter() - t_submit) * 1e3, 4)})
+        else:
+            record({"id": rid, "model": name, "replica": r.replica,
+                    "priority": pri, "bucket": r.bucket,
+                    "queue_wait_ms": r.queue_wait_ms,
+                    "assembly_ms": r.assembly_ms,
+                    "device_ms": r.device_ms, "total_ms": r.total_ms,
+                    "client_ms": round(
+                        (time.perf_counter() - t_submit) * 1e3, 4)})
         return r
 
     def reject_now(rid, name, pri, e):
@@ -303,11 +381,15 @@ def main() -> None:
     try:
         pools = {}
         rng = np.random.RandomState(a.seed)
+        runners = {}
         for name, _w in mix:
             lm = server.load(name,
                              weights=a.weights if len(mix) == 1 else None,
                              seed=a.seed, replicas=a.replicas,
-                             shards=a.shards)
+                             shards=a.shards,
+                             model_type=a.model_type,
+                             capture_blob=a.capture_blob)
+            runners[name] = lm.runner
             shape = lm.runner.sample_shape
             pools[name] = rng.rand(64, *shape).astype(np.float32)
             if traffic is not None:
@@ -336,6 +418,43 @@ def main() -> None:
         else:
             pris = ["interactive"] * a.requests
 
+        # compound traffic: pre-draw fan-out widths (and, for detect,
+        # seeded oversize images plus in-bounds proposal windows) so
+        # open and closed loops offer identical compounds per seed
+        comp_widths = comp_imgs = comp_windows = None
+        if windows_dist:
+            lo, hi = windows_dist
+            comp_widths = rng.randint(lo, hi + 1, size=a.requests)
+            if a.model_type == "detect":
+                c, ph, pw = runners[names[0]].sample_shape
+                ih, iw = 2 * ph, 2 * pw
+                comp_imgs = rng.rand(16, c, ih, iw).astype(np.float32)
+                comp_windows = []
+                for nw in comp_widths:
+                    wins = []
+                    for _ in range(int(nw)):
+                        x1 = int(rng.randint(0, iw - 4))
+                        y1 = int(rng.randint(0, ih - 4))
+                        wins.append([x1, y1,
+                                     x1 + int(rng.randint(2, iw - x1)),
+                                     y1 + int(rng.randint(2, ih - y1))])
+                    comp_windows.append(wins)
+
+        def do_submit(rid, name, wait=False):
+            """One logical request: a plain row, or a compound (one
+            image + proposal windows / a raw row block)."""
+            if not windows_dist:
+                return server.submit(name, pools[name][rid % 64],
+                                     wait=wait, priority=pris[rid])
+            if a.model_type == "detect":
+                return server.submit_compound(
+                    name, comp_imgs[rid % 16], comp_windows[rid],
+                    wait=wait, priority=pris[rid])
+            rows = pools[name][(rid + np.arange(int(comp_widths[rid])))
+                               % 64]
+            return server.submit_compound(name, rows, wait=wait,
+                                          priority=pris[rid])
+
         t0 = time.perf_counter()
         if a.mode == "open":
             # scale[i] * standard-exponential is numpy's exponential()
@@ -354,10 +473,7 @@ def main() -> None:
                 with rejects_lock:
                     tl_offered.append(time.perf_counter())
                 try:
-                    futs.append((i, name,
-                                 server.submit(name,
-                                               pools[name][i % 64],
-                                               priority=pris[i]),
+                    futs.append((i, name, do_submit(i, name),
                                  time.perf_counter()))
                 except ServingError as e:
                     reject_now(i, name, pris[i], e)
@@ -379,9 +495,7 @@ def main() -> None:
                     with rejects_lock:
                         tl_offered.append(ts)
                     try:
-                        fut = server.submit(name, pools[name][rid % 64],
-                                            wait=True,
-                                            priority=pris[rid])
+                        fut = do_submit(rid, name, wait=True)
                     except ServingError as e:
                         reject_now(rid, name, pris[rid], e)
                         continue
@@ -477,6 +591,22 @@ def main() -> None:
         for w in range(n_win)]
     if rejects_by_type:
         out["rejected_by_type"] = dict(sorted(rejects_by_type.items()))
+    if windows_dist:
+        # logical-request view of the compound run — the lane stats
+        # above (completed / p50 / bucket_counts) count FRAGMENTS,
+        # since that is what crossed the scheduler
+        out["compound"] = {
+            "model_type": a.model_type,
+            "windows_dist": [int(windows_dist[0]), int(windows_dist[1])],
+            "requests_completed": comp_done["requests"],
+            "fragments_completed": comp_done["fragments"],
+            "fanout_mean": round(
+                comp_done["fragments"] / max(1, comp_done["requests"]),
+                3)}
+        if a.model_type == "detect":
+            out["compound"]["detections"] = comp_done["detections"]
+        if a.capture_blob:
+            out["compound"]["capture_blob"] = a.capture_blob
     if pri_mix is not None:
         def _pcts(vals):
             if not vals:

@@ -76,6 +76,10 @@ KNOBS: Dict[str, str] = {
     "SPARKNET_SERVE_FLEET_SPAWN_TIMEOUT_S": "bound on worker spawn -> "
                                             "warmed ready line "
                                             "(seconds)",
+    "SPARKNET_SERVE_MAX_WINDOWS": "per-request cap on compound "
+                                  "proposal windows / rows",
+    "SPARKNET_SERVE_COMPOUND_LOG": "JSONL sink for compound lifecycle "
+                                   "events",
     # -- ingest
     "SPARKNET_PREFETCH_DEPTH": "rounds staged ahead by the prefetcher",
     "SPARKNET_INGEST_PROCS": "force multi-process ingest",

@@ -2,6 +2,15 @@
 blob (reference: src/main/scala/apps/FeaturizerApp.scala:88-103 — forwards
 minibatches through the net and reads blob `ip1` via getData).
 
+Since the compound-serving PR the app rides the serving engine's
+`capture_blob` execution path (serving/engine.py ModelRunner), so offline
+featurization and a served `--model_type featurize` lane share ONE jitted
+forward — same bucket machinery, same blob readback, bitwise-identical
+features.  The historical tail-drop bug (the pre-rebase loop computed
+``n = (len(data) // batch_size) * batch_size`` and silently discarded the
+remainder rows) is fixed here: the final short batch is zero-padded to
+the bucket and the output sliced back to the true row count.
+
     python -m sparknet_tpu.apps.featurizer_app --model NET.prototxt
         [--weights W.npz] --data D.npz --blob ip1 --out features.npz
 """
@@ -13,7 +22,6 @@ from typing import Dict, List, Optional
 
 import numpy as np
 
-from ..core.net import Net
 from ..proto import caffe_pb
 
 
@@ -23,37 +31,40 @@ def featurize(net_prototxt: str, data: np.ndarray, blob: str = "ip1", *,
               extra_shapes: Optional[Dict] = None) -> np.ndarray:
     """Forward batches, collect `blob` activations
     (reference: FeaturizerApp.scala:88-103; blob readback = the bridge's
-    getData path, Net.scala:174-192)."""
-    import jax
-    import jax.numpy as jnp
+    getData path, Net.scala:174-192).
+
+    Every row of `data` produces a feature row — a trailing partial
+    batch is padded to `batch_size` for the bucketed forward and the
+    padding rows sliced off the result.  `labels` is accepted for
+    call-site compatibility but does not influence intermediate
+    activations (the engine zero-fills declared aux blobs, exactly as
+    the classify path does); capture a label-independent blob.
+    """
+    from ..serving.engine import ModelRunner
 
     net_param = caffe_pb.load_net_prototxt(net_prototxt)
     net_param = caffe_pb.replace_data_layers(
         net_param, batch_size, batch_size, *data.shape[1:])
-    net = Net(net_param, "TEST", data_shapes=extra_shapes)
-    params = net.init_params(0)
-    if weights_path:
-        z = np.load(weights_path)
-        params = {k: jnp.asarray(z[k]) for k in z.files}
-    if blob not in net.blob_shapes:
-        raise ValueError(f"blob {blob!r} not in net; have "
-                         f"{sorted(net.blob_shapes)}")
-
-    @jax.jit
-    def fwd(p, x, y):
-        blobs, _ = net.apply(p, {"data": x, "label": y}, train=False)
-        return blobs[blob]
-
+    runner = ModelRunner(net_param, weights=weights_path,
+                         buckets=[batch_size], max_batch=batch_size,
+                         capture_blob=blob, data_shapes=extra_shapes)
+    data = np.asarray(data, dtype=np.float32)
     out: List[np.ndarray] = []
-    n = (len(data) // batch_size) * batch_size
-    if labels is None:
-        labels = np.zeros(len(data), dtype=np.int32)
-    for i in range(0, n, batch_size):
-        out.append(np.asarray(fwd(params,
-                                  jnp.asarray(data[i:i + batch_size],
-                                              dtype=jnp.float32),
-                                  jnp.asarray(labels[i:i + batch_size]))))
-    return np.concatenate(out) if out else np.zeros((0,))
+    for i in range(0, len(data), batch_size):
+        chunk = data[i:i + batch_size]
+        n_real = len(chunk)
+        if n_real < batch_size:
+            pad = np.zeros((batch_size - n_real,) + chunk.shape[1:],
+                           np.float32)
+            chunk = np.concatenate([chunk, pad])
+        out.append(runner.forward_padded(chunk)[:n_real])
+    flat = (np.concatenate(out) if out
+            else np.zeros((0, runner.n_outputs), np.float32))
+    # the engine flattens captured activations to (batch, -1) so the
+    # serving response contract holds; restore the blob's true per-row
+    # shape for offline callers (conv captures stay (N, C, H, W))
+    feat_shape = tuple(runner.net.blob_shapes[blob][1:])
+    return flat.reshape((len(data),) + feat_shape)
 
 
 def main() -> None:

@@ -129,6 +129,14 @@ def expand_window(x1: int, y1: int, x2: int, y2: int, img_h: int, img_w: int,
     target_w = target_h = crop_size
     pad_w = pad_h = 0
     if context_pad > 0 or use_square:
+        if 2 * context_pad >= crop_size:
+            # the reference divides by (crop_size - 2*context_pad)
+            # unchecked; a pad eating the whole crop is a config error —
+            # die loudly instead of ZeroDivisionError / negative scale
+            raise ValueError(
+                f"context_pad={context_pad} must be < crop_size/2 "
+                f"(crop_size={crop_size}): the context scale divides by "
+                f"crop_size - 2*context_pad")
         context_scale = crop_size / float(crop_size - 2 * context_pad)
         half_height = (y2 - y1 + 1) / 2.0
         half_width = (x2 - x1 + 1) / 2.0

@@ -14,9 +14,18 @@ from __future__ import annotations
 from .alexnet import _alexnet_family
 
 
-def rcnn_ilsvrc13(batch: int = 10, n_classes: int = 200, crop: int = 227):
+def rcnn_ilsvrc13(batch: int = 10, n_classes: int = 200, crop: int = 227,
+                  deploy: bool = True):
     """R-CNN-ilsvrc13 deploy form: input (batch, 3, 227, 227) —
-    deploy.prototxt's 10-window default — ending at fc-rcnn."""
+    deploy.prototxt's 10-window default — ending at fc-rcnn.
+
+    `deploy` exists so the serving loader (`resolve_net_param`, which
+    passes deploy=True to every zoo builder) can serve this model by
+    name; the family is deploy-only, so deploy=False is refused."""
+    if not deploy:
+        raise ValueError(
+            "rcnn_ilsvrc13 is deploy-only: the reference ships no "
+            "train_val for this model")
     return _alexnet_family("R-CNN-ilsvrc13", batch, n_classes, crop,
                            norm_after_pool=True, deploy=True,
                            classifier="fc-rcnn", deploy_softmax=False)

@@ -20,6 +20,8 @@ load generation: `scripts/serve_loadgen.py`.
 from .autoscale import (AutoscaleConfig, Autoscaler, ScalePolicy,
                         SensorSample, synthetic_sensor_trace)
 from .buckets import bucket_sizes, pad_to_bucket, pick_bucket
+from .compound import (MODEL_TYPES, CompoundResponse, nms,
+                       nms_detections, parse_windows, warp_windows)
 from .engine import ModelRunner, resolve_net_param
 from .errors import (DeadlineExceeded, ModelNotLoaded, RequestShed,
                      ServerClosed, ServerOverloaded, ServingError)
@@ -48,4 +50,6 @@ __all__ = [
     "AutoscaleConfig", "Autoscaler", "ScalePolicy", "SensorSample",
     "synthetic_sensor_trace",
     "FleetServer", "FleetConfig", "FleetModel",
+    "MODEL_TYPES", "CompoundResponse", "parse_windows", "warp_windows",
+    "nms", "nms_detections",
 ]

@@ -14,6 +14,23 @@ Response lines: {"id": 7, "argmax": 3, "probs": [...], "bucket": 4,
                  "total_ms": 1.9}            # input order preserved
 Rejections:     {"id": 7, "error": "DeadlineExceeded", "status": 504}
 
+Compound lanes (`--model_type detect|featurize`, serving/compound.py)
+additionally accept per-line proposal windows — one image fanning out
+to N scored rows with all-or-nothing assembly:
+
+    {"id": 9, "data": [[...]], "windows": [[x1, y1, x2, y2], ...]}
+    -> {"id": 9, "mode": "detect", "n_windows": 3, "detections":
+        [{"window": [...], "class": 7, "score": 1.3}, ...],
+        "buckets": [2], "total_ms": 4.0}
+
+and featurize lanes (require --capture_blob) answer with the
+intermediate activations; without "windows" the "data" field is the
+raw (N, C, H, W) row batch itself:
+
+    {"id": 3, "data": [[[...]]]}
+    -> {"id": 3, "mode": "featurize", "rows": 4, "feature_dim": 500,
+        "features": [[...], ...], "buckets": [4], "total_ms": 2.2}
+
 SIGINT triggers a graceful drain via utils/signals.py (the solver's
 signal contract, reapplied to serving): stop admitting, deliver every
 admitted request, exit 0.
@@ -91,6 +108,11 @@ def cmd_serve(args) -> int:
     from .server import InferenceServer, ServerConfig
 
     if getattr(args, "fleet", None):
+        if args.model_type != "classify":
+            raise SystemExit(
+                "serve: --fleet workers speak plain classify only; "
+                "compound lanes (--model_type detect|featurize) run "
+                "in-process")
         server = _build_fleet(args)
         name = args.name or "default"
         try:
@@ -146,7 +168,9 @@ def cmd_serve(args) -> int:
                          quant_min_agreement=(args.quant_min_agreement
                                               if args.quant != "fp32"
                                               else None),
-                         replicas=args.replicas, shards=args.shards)
+                         replicas=args.replicas, shards=args.shards,
+                         model_type=args.model_type,
+                         capture_blob=args.capture_blob)
     except ValueError as e:
         # a failed quant calibration floor (or bad spec) is a load
         # error, not a crash
@@ -158,6 +182,10 @@ def cmd_serve(args) -> int:
     shard_note = ""
     if lm.runner.shards > 1:
         shard_note = f" x {lm.runner.shards} shards"
+    if args.model_type != "classify":
+        cap = (f" capturing {lm.runner.capture_blob!r}"
+               if lm.runner.capture_blob else "")
+        shard_note += f", {args.model_type} lane{cap}"
     print(f"serving {args.model!r} as {name!r}: input "
           f"{lm.runner.sample_shape}, buckets {lm.runner.buckets}, "
           f"{lm.n_replicas} replica(s){shard_note}, "
@@ -194,11 +222,28 @@ def _serve_loop(args, server, name: str, sample_shape) -> int:
             elif item.done() or block:
                 try:
                     r = item.result()
-                    line = {"id": rid, "argmax": r.argmax,
-                            "probs": np.asarray(r.probs, np.float64)
-                            .tolist(),
-                            "bucket": r.bucket,
-                            "total_ms": r.total_ms}
+                    if hasattr(r, "fragments"):     # CompoundResponse
+                        line = {"id": rid, "mode": r.mode,
+                                "buckets": r.buckets,
+                                "total_ms": r.total_ms}
+                        if r.mode == "detect":
+                            line["n_windows"] = r.fragments
+                            line["detections"] = [
+                                {"window": list(d["window"]),
+                                 "class": d["class"],
+                                 "score": d["score"]}
+                                for d in (r.detections or [])]
+                        else:
+                            feats = np.asarray(r.features, np.float64)
+                            line["rows"] = r.fragments
+                            line["feature_dim"] = int(feats.shape[1])
+                            line["features"] = feats.tolist()
+                    else:
+                        line = {"id": rid, "argmax": r.argmax,
+                                "probs": np.asarray(r.probs, np.float64)
+                                .tolist(),
+                                "bucket": r.bucket,
+                                "total_ms": r.total_ms}
                 except Exception as e:
                     line = _error_line(rid, e)
             else:
@@ -227,11 +272,23 @@ def _serve_loop(args, server, name: str, sample_shape) -> int:
                 kw = {}
                 if "deadline_ms" in obj:
                     kw["deadline_ms"] = float(obj["deadline_ms"])
-                fut = server.submit(
-                    name, data,
-                    wait=(args.overload == "wait"),
-                    priority=obj.get("priority", "interactive"),
-                    **kw)
+                model_type = getattr(args, "model_type", "classify")
+                if model_type != "classify":
+                    # compound lane: "windows" fans one image out to N
+                    # scored rows (detect/featurize); without windows
+                    # the data IS the raw row batch (featurize)
+                    fut = server.submit_compound(
+                        name, data, obj.get("windows"),
+                        wait=(args.overload == "wait"),
+                        priority=obj.get("priority", "interactive"),
+                        context_pad=getattr(args, "context_pad", 0),
+                        **kw)
+                else:
+                    fut = server.submit(
+                        name, data,
+                        wait=(args.overload == "wait"),
+                        priority=obj.get("priority", "interactive"),
+                        **kw)
                 pending.append((rid, fut))
             except Exception as e:
                 # a malformed or rejected REQUEST gets an error response
@@ -335,6 +392,22 @@ def register(sub) -> None:
     s.add_argument("--scale_min", type=int,
                    help="autoscaler capacity floor (with --autoscale; "
                         "default SPARKNET_SERVE_SCALE_MIN, normally 1)")
+    s.add_argument("--model_type", default="classify",
+                   choices=["classify", "detect", "featurize"],
+                   help="lane semantics (serving/compound.py): classify "
+                        "= plain rows; detect = per-line proposal "
+                        "windows warped + scored through the deploy "
+                        "net's raw head with host-side NMS; featurize "
+                        "= rows answered with --capture_blob "
+                        "activations")
+    s.add_argument("--capture_blob",
+                   help="intermediate blob to read back as the answer "
+                        "(required with --model_type featurize; the "
+                        "engine's capture_blob exec variant)")
+    s.add_argument("--context_pad", type=int, default=0,
+                   help="context padding pixels around each window "
+                        "before the warp (R-CNN geometry; with "
+                        "--model_type detect)")
     s.add_argument("--preprocess", action="store_true",
                    help="treat 'data' as an HWC image: resize + center "
                         "crop to the model input (classify.Preprocessor)")

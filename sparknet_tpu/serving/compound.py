@@ -1,0 +1,435 @@
+"""Compound-request serving: windowed detection and featurization as
+first-class served workloads.
+
+A classify request is one sample -> one score row.  A COMPOUND request
+is one logical unit that expands to N device rows: an image plus N
+R-CNN proposal windows (model_type=detect — each window is context-
+padded, warped, and scored through the deploy net's raw classifier
+head, reference heritage: caffe/python/caffe/detector.py windowed
+detection over window_data_layer.cpp geometry), or N raw samples whose
+INTERMEDIATE activations are the answer (model_type=featurize — the
+engine's capture_blob exec variant, the served replacement for
+apps/featurizer_app.py's ad-hoc jit).
+
+The fan-out rides the existing lane machinery untouched: every
+fragment is an ordinary scheduler item, so it routes least-loaded,
+batches into warmed buckets, sheds, retries, and breaker-trips like
+any other row.  What this module adds is the COMPOUND semantics on
+top:
+
+- window ingress validation (the file-format parser contract applied
+  to a network surface: malformed windows die with a request-naming
+  ValueError, never an IndexError),
+- the warp/preprocess path shared verbatim with the offline
+  WindowDataFeed (data/window_data.py expand_window + _warp, mirror
+  off — mirroring is a training augmentation), which is what makes
+  served detection bitwise-equal to the offline batch path,
+- host-side greedy NMS over the per-class scores (SVM margins for
+  rcnn_ilsvrc13 — the deploy net has no softmax),
+- the all-or-nothing fan-in assembler: per-image results reassemble in
+  window order from a SINGLE generation, and the first fragment
+  rejection (503/504) aborts the whole compound — queued sibling
+  fragments are discarded before a worker pops them (no wasted device
+  work), and the client never sees a partial or mixed-generation
+  response.
+
+Knobs: SPARKNET_SERVE_MAX_WINDOWS caps the fan-out width one request
+may demand; SPARKNET_SERVE_COMPOUND_LOG appends one JSONL event per
+compound lifecycle edge (schema: DISTACC.md "Compound serving
+events").
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+from concurrent.futures import Future
+from dataclasses import dataclass
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..data.window_data import _warp, expand_window
+from ..obs.trace import now_s
+
+__all__ = ["MODEL_TYPES", "validate_model_type", "resolve_max_windows",
+           "parse_windows", "warp_windows", "nms", "nms_detections",
+           "CompoundResponse", "CompoundEventLog",
+           "MAX_WINDOWS_ENV", "COMPOUND_LOG_ENV"]
+
+MODEL_TYPES = ("classify", "detect", "featurize")
+
+MAX_WINDOWS_ENV = "SPARKNET_SERVE_MAX_WINDOWS"
+COMPOUND_LOG_ENV = "SPARKNET_SERVE_COMPOUND_LOG"
+
+
+def validate_model_type(model_type: str) -> str:
+    if model_type not in MODEL_TYPES:
+        raise ValueError(f"model_type must be one of {MODEL_TYPES}, "
+                         f"got {model_type!r}")
+    return model_type
+
+
+def resolve_max_windows() -> int:
+    """SPARKNET_SERVE_MAX_WINDOWS: the fan-out width one compound
+    request may demand (default 256).  An unbounded request would let a
+    single client monopolize every bucket on the lane — this is the
+    compound analogue of queue_depth."""
+    raw = os.environ.get(MAX_WINDOWS_ENV, "256")
+    try:
+        v = int(raw)
+    except ValueError:
+        raise ValueError(f"{MAX_WINDOWS_ENV}={raw!r} is not an int")
+    if v < 1:
+        raise ValueError(f"{MAX_WINDOWS_ENV} must be >= 1, got {v}")
+    return v
+
+
+# ------------------------------------------------------------- ingress
+def parse_windows(raw, *, source: str = "compound request"
+                  ) -> List[Tuple[int, int, int, int]]:
+    """Validate proposal windows arriving over the serving surface into
+    [(x1, y1, x2, y2)] int tuples.  Same contract as every file-format
+    parser in this repo (CLAUDE.md): malformed input dies with a
+    ValueError naming `source`, never an IndexError/TypeError — a
+    network ingress is just a parser whose file is a request."""
+    if raw is None:
+        raise ValueError(f"{source}: windows must be a non-empty list "
+                         f"of [x1, y1, x2, y2], got null")
+    try:
+        entries = list(raw)
+    except TypeError:
+        raise ValueError(f"{source}: windows must be a list of "
+                         f"[x1, y1, x2, y2], got {type(raw).__name__}")
+    if not entries:
+        raise ValueError(f"{source}: windows list is empty")
+    cap = resolve_max_windows()
+    if len(entries) > cap:
+        raise ValueError(
+            f"{source}: {len(entries)} windows exceeds the "
+            f"{MAX_WINDOWS_ENV}={cap} per-request cap")
+    out: List[Tuple[int, int, int, int]] = []
+    for k, entry in enumerate(entries):
+        try:
+            vals = list(entry)
+        except TypeError:
+            raise ValueError(
+                f"{source}: window {k} must be [x1, y1, x2, y2], got "
+                f"{type(entry).__name__}")
+        if len(vals) != 4:
+            raise ValueError(
+                f"{source}: window {k} has {len(vals)} coordinates, "
+                f"expected 4 (x1, y1, x2, y2)")
+        coords = []
+        for v in vals:
+            try:
+                coords.append(int(v))
+            except (TypeError, ValueError):
+                raise ValueError(
+                    f"{source}: window {k} coordinate {v!r} is not an "
+                    f"integer")
+        x1, y1, x2, y2 = coords
+        if x2 < x1 or y2 < y1:
+            raise ValueError(
+                f"{source}: window {k} is inverted "
+                f"(x1={x1}, y1={y1}, x2={x2}, y2={y2})")
+        out.append((x1, y1, x2, y2))
+    return out
+
+
+# ---------------------------------------------------------- preprocess
+def warp_windows(image_chw: np.ndarray,
+                 windows: Sequence[Tuple[int, int, int, int]], *,
+                 crop_size: int, context_pad: int = 0,
+                 use_square: bool = False,
+                 mean_values: Sequence[float] = (),
+                 scale: float = 1.0,
+                 source: str = "compound request") -> np.ndarray:
+    """Crop + context-pad + warp every window of one (C, H, W) image to
+    a (N, C, crop_size, crop_size) float32 batch — the offline
+    WindowDataFeed._one pipeline (data/window_data.py) with mirroring
+    off, op for op, so a served window's tensor is BITWISE the tensor
+    the offline batch path builds for the same window (the parity pin
+    in tests/test_serving_compound.py depends on this function staying
+    in lockstep with _one)."""
+    img = np.asarray(image_chw)
+    if img.ndim != 3:
+        raise ValueError(
+            f"{source}: image must be (C, H, W), got shape "
+            f"{tuple(img.shape)}")
+    c, img_h, img_w = img.shape
+    cs = int(crop_size)
+    mv = list(mean_values)
+    if len(mv) == 1 and c > 1:
+        mv = mv * c
+    if mv and len(mv) != c:
+        raise ValueError(
+            f"{source}: specify 1 mean_value or {c} (one per channel), "
+            f"got {len(mv)}")
+    mean = np.asarray(mv, dtype=np.float32) if mv else None
+    out = np.zeros((len(windows), c, cs, cs), dtype=np.float32)
+    for k, (wx1, wy1, wx2, wy2) in enumerate(windows):
+        if context_pad <= 0 and not use_square:
+            # the context-pad path clips to the image itself; the plain
+            # path crops raw coordinates, so they must be in-bounds
+            if not (0 <= wx1 and wx2 < img_w and 0 <= wy1
+                    and wy2 < img_h):
+                raise ValueError(
+                    f"{source}: window {k} "
+                    f"({wx1}, {wy1}, {wx2}, {wy2}) falls outside the "
+                    f"{img_h}x{img_w} image")
+        x1, y1, x2, y2, tw, th, pad_w, pad_h = expand_window(
+            wx1, wy1, wx2, wy2, img_h, img_w, cs, int(context_pad),
+            bool(use_square), False)
+        roi = img[:, y1:y2 + 1, x1:x2 + 1]
+        warped = _warp(roi, th, tw)
+        region = warped
+        if mean is not None:
+            region = region - mean[:, None, None]
+        out[k, :, pad_h:pad_h + th, pad_w:pad_w + tw] = \
+            region * float(scale)
+    return out
+
+
+# ----------------------------------------------------------------- nms
+def nms(boxes: np.ndarray, scores: np.ndarray,
+        iou_threshold: float = 0.3) -> List[int]:
+    """Greedy non-maximum suppression over inclusive-coordinate boxes
+    (x1, y1, x2, y2); returns kept indices in descending-score order.
+    Host-side numpy on the (small) per-image window set — the device
+    answers raw per-window margins, suppression is assembly work."""
+    b = np.asarray(boxes, dtype=np.float64)
+    s = np.asarray(scores, dtype=np.float64)
+    areas = (b[:, 2] - b[:, 0] + 1) * (b[:, 3] - b[:, 1] + 1)
+    order = np.argsort(-s, kind="stable")
+    keep: List[int] = []
+    while order.size:
+        i = int(order[0])
+        keep.append(i)
+        rest = order[1:]
+        ix1 = np.maximum(b[i, 0], b[rest, 0])
+        iy1 = np.maximum(b[i, 1], b[rest, 1])
+        ix2 = np.minimum(b[i, 2], b[rest, 2])
+        iy2 = np.minimum(b[i, 3], b[rest, 3])
+        iw = np.maximum(0.0, ix2 - ix1 + 1)
+        ih = np.maximum(0.0, iy2 - iy1 + 1)
+        inter = iw * ih
+        iou = inter / (areas[i] + areas[rest] - inter)
+        order = rest[iou <= iou_threshold]
+    return keep
+
+
+def nms_detections(windows: Sequence[Tuple[int, int, int, int]],
+                   scores: np.ndarray, *, iou_threshold: float = 0.3,
+                   score_min: float = 0.0) -> List[Dict[str, object]]:
+    """Per-class greedy NMS over the (n_windows, n_classes) score
+    matrix -> [{"window", "class", "score"}] sorted by descending
+    score.  For rcnn_ilsvrc13 the scores are raw SVM margins (the
+    deploy net ends at fc-rcnn, no softmax), so score_min=0.0 keeps
+    exactly the positive-margin detections."""
+    sc = np.asarray(scores)
+    boxes = np.asarray(windows, dtype=np.float64)
+    out: List[Dict[str, object]] = []
+    for cls in range(sc.shape[1]):
+        col = sc[:, cls]
+        idx = np.nonzero(col > float(score_min))[0]
+        if not idx.size:
+            continue
+        for k in nms(boxes[idx], col[idx], iou_threshold):
+            w = idx[k]
+            out.append({"window": tuple(int(v) for v in boxes[w]),
+                        "class": int(cls),
+                        "score": float(col[w])})
+    out.sort(key=lambda d: -d["score"])
+    return out
+
+
+# ------------------------------------------------------------- fan-in
+@dataclass
+class CompoundResponse:
+    """What a compound future resolves to: the per-window results of
+    ONE image, reassembled in submission order from fragments that all
+    carry the SAME generation (a reload landing mid-compound fails the
+    compound rather than mixing params in one answer).
+
+    `scores` is (n_windows, n_outputs): raw classifier margins for
+    detect (plus the host-side `detections` NMS digest), the flattened
+    capture_blob activations for featurize (alias `features`)."""
+
+    model: str
+    mode: str                      # "detect" | "featurize"
+    scores: np.ndarray
+    generation: int
+    fragments: int
+    buckets: List[int]             # distinct buckets the fragments rode
+    queue_wait_ms: float           # max over fragments
+    total_ms: float                # submit -> last fragment + assembly
+    priority: str = "interactive"
+    windows: Optional[List[Tuple[int, int, int, int]]] = None
+    detections: Optional[List[Dict[str, object]]] = None
+
+    @property
+    def features(self) -> np.ndarray:
+        return self.scores
+
+    @property
+    def argmaxes(self) -> np.ndarray:
+        return np.argmax(self.scores, axis=1)
+
+
+class CompoundAssembler:
+    """Fan-in state for one compound request: collects fragment
+    responses by index, resolves the compound future exactly once —
+    with a full CompoundResponse when every fragment delivered from one
+    generation, or with the FIRST fragment's rejection, after asking
+    the server to discard the queued siblings (`cancel` callback; in-
+    flight siblings complete and are ignored, their math is already
+    launched).  Runs on batcher threads via future done-callbacks; the
+    lock covers bookkeeping only — assembly, NMS, and the cancel sweep
+    all run outside it."""
+
+    def __init__(self, *, model: str, mode: str, n: int,
+                 priority: str, t_submit: float,
+                 windows: Optional[List[Tuple[int, int, int, int]]],
+                 nms_iou: float, score_min: float,
+                 cancel: Callable[["CompoundAssembler", Exception], int],
+                 event: Callable[..., None]) -> None:
+        self.future: Future = Future()
+        self.model = model
+        self.mode = mode
+        self.n = int(n)
+        self.priority = priority
+        self.windows = windows
+        self._t_submit = float(t_submit)
+        self._nms_iou = float(nms_iou)
+        self._score_min = float(score_min)
+        self._cancel = cancel
+        self._event = event
+        self._mu = threading.Lock()
+        self._results: List[Optional[object]] = [None] * self.n
+        self._remaining = self.n
+        self._sealed = False
+
+    def _seal(self) -> bool:
+        """Exactly-once gate on resolving the compound future: the
+        first sealer (a fragment rejection, an external abort from the
+        fan-out loop, or the final-fragment assembly) owns it; everyone
+        else backs off.  Late sibling callbacks after a seal are the
+        in-flight fragments completing — ignored by design."""
+        with self._mu:
+            if self._sealed:
+                return False
+            self._sealed = True
+            return True
+
+    def fragment_done(self, index: int, fut: Future) -> None:
+        """Done-callback for fragment `index`'s future."""
+        exc = fut.exception()
+        if exc is not None:
+            self.abort(exc)
+            return
+        result = fut.result()   # resolved: we run from add_done_callback
+        with self._mu:
+            if self._sealed:
+                return          # compound already aborted; late sibling
+            self._results[index] = result
+            self._remaining -= 1
+            if self._remaining:
+                return
+        self._assemble()
+
+    def abort(self, exc: Exception) -> bool:
+        """Fail the compound with `exc` (first caller wins): discard
+        the queued sibling fragments, log, resolve the compound future
+        with the rejection.  Returns whether this call was the one that
+        sealed."""
+        if not self._seal():
+            return False
+        self._fail(exc)
+        return True
+
+    def _fail(self, exc: Exception) -> None:
+        discarded = self._cancel(self, exc)
+        self._event("compound_abort", model=self.model, mode=self.mode,
+                    fragments=self.n, discarded=discarded,
+                    priority=self.priority,
+                    error=type(exc).__name__)
+        self.future.set_exception(exc)
+
+    def _assemble(self) -> None:
+        if not self._seal():
+            return
+        gens = {r.generation for r in self._results}
+        if len(gens) != 1:
+            # a reload swapped params mid-compound: the fragments are
+            # individually correct but belong to DIFFERENT models — a
+            # mixed answer is exactly the partial response the
+            # all-or-nothing contract forbids
+            from .errors import ServingError
+
+            self._fail(ServingError(
+                f"compound to {self.model!r} spans generations "
+                f"{sorted(gens)}; all-or-nothing assembly refuses to "
+                f"mix them"))
+            return
+        scores = np.stack([r.probs for r in self._results])
+        buckets = sorted({r.bucket for r in self._results})
+        queue_wait = max(r.queue_wait_ms for r in self._results)
+        total_ms = (now_s() - self._t_submit) * 1e3
+        detections = None
+        if self.mode == "detect" and self.windows is not None:
+            detections = nms_detections(
+                self.windows, scores, iou_threshold=self._nms_iou,
+                score_min=self._score_min)
+        resp = CompoundResponse(
+            model=self.model, mode=self.mode, scores=scores,
+            generation=gens.pop(), fragments=self.n, buckets=buckets,
+            queue_wait_ms=round(queue_wait, 4),
+            total_ms=round(total_ms, 4), priority=self.priority,
+            windows=self.windows, detections=detections)
+        self._event("compound_assembled", model=self.model,
+                    mode=self.mode, fragments=self.n, buckets=buckets,
+                    priority=self.priority,
+                    detections=(len(detections)
+                                if detections is not None else None),
+                    total_ms=round(total_ms, 4))
+        self.future.set_result(resp)
+
+
+# -------------------------------------------------------------- events
+class CompoundEventLog:
+    """Compound lifecycle events: an in-memory list (tests/drill
+    observability) plus an optional JSONL sink
+    (SPARKNET_SERVE_COMPOUND_LOG).  Events are wall-clock-free — kinds
+    and counts only, durations in ms — matching the resilience event
+    discipline (DISTACC.md)."""
+
+    def __init__(self, path: Optional[str] = None) -> None:
+        self.path = (path if path is not None
+                     else os.environ.get(COMPOUND_LOG_ENV) or None)
+        self.events: List[dict] = []
+        self._mu = threading.Lock()
+
+    def __call__(self, kind: str, **fields) -> None:
+        ev = {"kind": kind}
+        ev.update(fields)
+        with self._mu:
+            self.events.append(ev)
+            if self.path:
+                try:
+                    with open(self.path, "a") as f:
+                        f.write(json.dumps(ev) + "\n")
+                except OSError:
+                    self.path = None    # never let a dead disk serve 500s
+
+    def snapshot(self) -> List[dict]:
+        with self._mu:
+            return [dict(e) for e in self.events]
+
+    def counts(self) -> Dict[str, int]:
+        with self._mu:
+            out: Dict[str, int] = {}
+            for e in self.events:
+                out[e["kind"]] = out.get(e["kind"], 0) + 1
+            return out

@@ -71,7 +71,9 @@ class ModelRunner:
                  quant_calib_batches: int = 2,
                  quant_min_agreement: Optional[float] = None,
                  shards: int = 1,
-                 tp_min_elems: int = 1 << 16) -> None:
+                 tp_min_elems: int = 1 << 16,
+                 capture_blob: Optional[str] = None,
+                 data_shapes: Optional[Dict] = None) -> None:
         import jax
 
         from ..core.net import Net
@@ -88,7 +90,10 @@ class ModelRunner:
             raise ValueError(
                 f"shards must be >= 1, got {self.shards}")
         self.tp_min_elems = int(tp_min_elems)
-        self.net = Net(net_param, "TEST")
+        # data_shapes: explicit shapes for data blobs the builder cannot
+        # infer (no crop_size, no readable store) — the offline
+        # featurizer app's `extra_shapes` passthrough
+        self.net = Net(net_param, "TEST", data_shapes=data_shapes)
         self.params = self.net.init_params(seed)
         if weights:
             self.params = load_pretrained(self.net, self.params, weights)
@@ -109,8 +114,31 @@ class ModelRunner:
         self.input_blob = self.net.input_blobs[0]
         self.sample_shape: Tuple[int, ...] = tuple(
             self.net.blob_shapes[self.input_blob][1:])
-        self.output_blob = probability_blob(self.net)
-        self.n_outputs = int(self.net.blob_shapes[self.output_blob][-1])
+        self.capture_blob = capture_blob
+        if capture_blob is None:
+            self.output_blob = probability_blob(self.net)
+            self.n_outputs = int(
+                self.net.blob_shapes[self.output_blob][-1])
+        else:
+            # featurization mode: read back an INTERMEDIATE blob through
+            # the same jit/bucket/quant machinery the score path uses
+            # (the served replacement for featurizer_app's ad-hoc jit).
+            # The captured activation is flattened to (batch, -1) so the
+            # server's (bucket, n_outputs) response contract holds for
+            # conv feature maps too.
+            shape = self.net.blob_shapes.get(capture_blob)
+            if shape is None:
+                raise ValueError(
+                    f"capture_blob {capture_blob!r} is not a blob of "
+                    f"this net; available: "
+                    f"{sorted(self.net.blob_shapes)}")
+            if len(shape) < 2:
+                raise ValueError(
+                    f"capture_blob {capture_blob!r} has shape "
+                    f"{tuple(shape)} with no per-row feature axis; "
+                    f"capture needs a (batch, ...) activation")
+            self.output_blob = capture_blob
+            self.n_outputs = int(np.prod(shape[1:]))
         self._build_exec()
         if self.quant != "fp32":
             self.calibrate_quant(quant_calib_batches,
@@ -202,6 +230,7 @@ class ModelRunner:
         net = self.net
         aux_blobs = list(net.input_blobs[1:])
         input_blob, output_blob = self.input_blob, self.output_blob
+        flatten_out = self.capture_blob is not None
 
         if self.shards > 1:
             # bitwise contract of sharded serving: params live SHARDED
@@ -238,7 +267,10 @@ class ModelRunner:
                         net.blob_shapes[b],
                         jnp.int32 if len(net.blob_shapes[b]) == 1
                         else jnp.float32)
-                return net.forward(params, feed)[output_blob]
+                y = net.forward(params, feed)[output_blob]
+                if flatten_out:
+                    y = y.reshape((y.shape[0], -1))
+                return y
 
         if self.shards > 1:
             # params carry their NamedShardings in, the (small) score
@@ -457,7 +489,8 @@ class ModelRunner:
                "quant": self.quant,
                "quant_agreement": self.quant_agreement,
                "param_bytes": self.param_bytes,
-               "shards": self.shards}
+               "shards": self.shards,
+               "capture_blob": self.capture_blob}
         if self.shards > 1:
             out["slice_devices"] = [str(d) for d in self.slice_devices]
             out["tp_params"] = sorted(self.tp_sharded_params())

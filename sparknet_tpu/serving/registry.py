@@ -107,7 +107,8 @@ class ModelRegistry:
              devices: Optional[Sequence] = None,
              warmup: bool = True, quant: Optional[str] = None,
              quant_min_agreement: Optional[float] = None,
-             shards: int = 1) -> LoadedModel:
+             shards: int = 1,
+             capture_blob: Optional[str] = None) -> LoadedModel:
         """Build, (optionally) warm, and register a model under `name`.
         `spec` defaults to `name` (zoo entry or prototxt path).
         `devices` (a list) builds one replica per entry — the master on
@@ -141,7 +142,8 @@ class ModelRegistry:
         kwargs = {"buckets": buckets, "max_batch": max_batch,
                   "seed": seed, "quant": quant,
                   "quant_min_agreement": quant_min_agreement,
-                  "shards": int(shards)}
+                  "shards": int(shards),
+                  "capture_blob": capture_blob}
         dev0 = list(devices)[0] if devices is not None else device
         master = ModelRunner(
             resolve_net_param(spec, max_batch=max_batch),

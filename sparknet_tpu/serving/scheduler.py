@@ -208,6 +208,26 @@ class ReplicaScheduler:
             self._cv.notify_all()
             return items
 
+    def discard(self, pred: Callable[[object], bool]) -> List:
+        """Atomically remove and return every QUEUED item matching
+        `pred`, across all replicas (the compound-request abort lever:
+        when one fragment of an all-or-nothing compound 503s/504s, its
+        sibling fragments still waiting in queues are pure waste — pull
+        them before a worker pops them).  In-flight items are untouched,
+        same as drain_replica: their math is already launched and the
+        run callback owns their futures."""
+        with self._cv:
+            removed: List = []
+            for dq in self._pending:
+                kept = [it for it in dq if not pred(it)]
+                if len(kept) != len(dq):
+                    removed.extend(it for it in dq if pred(it))
+                    dq.clear()
+                    dq.extend(kept)
+            if removed:
+                self._cv.notify_all()    # queue space freed
+            return removed
+
     def requeue(self, items: Sequence, *,
                 exclude: Optional[int] = None) -> None:
         """Re-admit ALREADY-ADMITTED items (drained from a tripped

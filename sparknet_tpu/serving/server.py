@@ -47,6 +47,8 @@ import numpy as np
 from ..obs.trace import now_s, span
 from .autoscale import AutoscaleConfig, Autoscaler
 from .buckets import pad_to_bucket, pick_bucket
+from .compound import (CompoundAssembler, CompoundEventLog,
+                       parse_windows, validate_model_type, warp_windows)
 from .errors import (DeadlineExceeded, RequestShed, ServerClosed,
                      ServerOverloaded, ServingError)
 from .placement import (DevicePlacer, resolve_replica_count,
@@ -127,6 +129,11 @@ class _Request:
     t_pop: float = 0.0
     priority: str = "interactive"
     retries: int = 0            # redispatches after failed batches
+    # compound fan-out bookkeeping: the owning CompoundAssembler (None
+    # for plain requests) and this fragment's window index within it —
+    # the discard predicate and the fan-in both key on these
+    compound: Optional[object] = None
+    frag: int = 0
 
 
 @dataclass
@@ -138,6 +145,10 @@ class _Lane:
     stopping: bool = False
     resil: Optional[ResilienceManager] = None
     auto: Optional[Autoscaler] = None
+    # how this lane answers: "classify" (plain rows), "detect" (compound
+    # windows -> raw classifier margins + NMS), "featurize" (compound
+    # rows -> capture_blob activations)
+    model_type: str = "classify"
 
 
 class InferenceServer:
@@ -179,6 +190,9 @@ class InferenceServer:
         # delays only subsequent batches, never a client's result
         self._response_hooks: Dict[str, List] = {}
         self._hook_warned: set = set()
+        # compound lifecycle events (in-memory + the optional
+        # SPARKNET_SERVE_COMPOUND_LOG JSONL sink)
+        self._compound_log = CompoundEventLog()
 
     def _get_placer(self) -> DevicePlacer:
         """Lazy so the default single-replica path never touches
@@ -206,7 +220,9 @@ class InferenceServer:
              quant: Optional[str] = None,
              quant_min_agreement: Optional[float] = None,
              replicas: Optional[int] = None,
-             shards: Optional[int] = None) -> LoadedModel:
+             shards: Optional[int] = None,
+             model_type: str = "classify",
+             capture_blob: Optional[str] = None) -> LoadedModel:
         """Load + warm a model and start its scheduler.  `replicas`
         (default SPARKNET_SERVE_REPLICAS, normally 1; 0 = one per
         device) places that many replicas least-loaded-first across the
@@ -217,9 +233,27 @@ class InferenceServer:
         path — placement always goes through the placer then (replicas=0
         means one replica per slice, saturating the pool), and `device`
         pinning is rejected.  The bucket ladder defaults to powers of
-        two up to config.max_batch."""
+        two up to config.max_batch.
+
+        `model_type` selects the lane's answer shape: "classify" (the
+        default — plain submit() rows), "detect" (submit_compound()
+        windows scored through the deploy net's raw classifier head),
+        or "featurize" (submit_compound() rows answered with the
+        `capture_blob` intermediate activation, flattened — requires
+        capture_blob; the engine then reads that blob back through the
+        same jit/bucket/quant machinery the score path uses)."""
         if not self._accepting:
             raise ServerClosed("server is shutting down")
+        validate_model_type(model_type)
+        if model_type == "featurize" and not capture_blob:
+            raise ValueError(
+                "model_type='featurize' needs capture_blob= (the "
+                "intermediate blob whose activations are the answer)")
+        if capture_blob and model_type != "featurize":
+            raise ValueError(
+                f"capture_blob= only applies to model_type='featurize', "
+                f"not {model_type!r} (detect serves the deploy net's "
+                f"own output head)")
         n_rep = resolve_replica_count(replicas, None)
         n_shards = resolve_shard_count(shards)
         devices = None
@@ -252,7 +286,7 @@ class InferenceServer:
                 max_batch=self.config.max_batch, seed=seed,
                 device=device, devices=devices, warmup=warmup,
                 quant=quant, quant_min_agreement=quant_min_agreement,
-                shards=n_shards)
+                shards=n_shards, capture_blob=capture_blob)
         except Exception:
             if devices is not None:
                 self._get_placer().release(name)
@@ -261,7 +295,8 @@ class InferenceServer:
             raise ValueError(
                 f"max_batch {self.config.max_batch} exceeds the largest "
                 f"bucket {max(lm.runner.buckets)}")
-        lane = _Lane(model=lm, sched=None)  # run callback needs the lane
+        # run callback needs the lane, so sched attaches after
+        lane = _Lane(model=lm, sched=None, model_type=model_type)
         lane.sched = ReplicaScheduler(
             lm.n_replicas, max_batch=self.config.max_batch,
             queue_depth=self.config.queue_depth,
@@ -448,6 +483,197 @@ class InferenceServer:
                 futs.append(f)
         return futs
 
+    # ------------------------------------------------------------- compound
+    def submit_compound(self, model: str, image, windows=None, *,
+                        deadline_ms: Optional[float] = None,
+                        wait: bool = False,
+                        wait_timeout_s: Optional[float] = None,
+                        priority: str = "interactive",
+                        context_pad: int = 0,
+                        crop_mode: str = "warp",
+                        mean_values: Sequence[float] = (),
+                        scale: float = 1.0,
+                        nms_iou: float = 0.3,
+                        score_min: float = 0.0) -> Future:
+        """Admit ONE logical request that expands to N device rows;
+        returns a Future resolving to a CompoundResponse
+        (serving/compound.py) or raising the rejection.
+
+        With `windows` (a list of [x1, y1, x2, y2] proposals), `image`
+        is one (C, H, W) array: every window is context-padded, warped
+        to the model's crop via the offline WindowDataFeed geometry,
+        and scored — detect lanes additionally get a host-side NMS
+        digest over the raw per-class margins.  Without windows,
+        `image` is the raw row batch itself ((N, *sample_shape) or a
+        single sample) — the featurize ingress.
+
+        Compound semantics on the installed control planes:
+        - the deadline stamps EVERY fragment (one absolute instant);
+          dead-on-arrival answers 504 before any fan-out,
+        - a batch-priority compound sheds WHOLE-REQUEST at admission
+          (one should_shed_batch verdict for all N fragments — never
+          a partial shed; interactive never sheds),
+        - assembly is all-or-nothing: the first fragment 503/504
+          aborts the compound, discards its queued siblings (no wasted
+          device work), and the client sees ONE rejection — never a
+          partial or mixed-generation response,
+        - delivered fragments fire the response hooks as usual, so
+          served detections flow into the TrafficLogger stream."""
+        if priority not in PRIORITIES:
+            raise ValueError(f"priority must be one of {PRIORITIES}, "
+                             f"got {priority!r}")
+        lane = self._lane(model)
+        if lane.model_type == "classify":
+            raise ValueError(
+                f"model {model!r} was loaded model_type='classify'; "
+                f"compound submission needs a detect or featurize lane "
+                f"(load(..., model_type=...))")
+        lm = lane.model
+        runner = lm.runner
+        source = f"compound request to {model!r}"
+        wins = None
+        if windows is not None:
+            wins = parse_windows(windows, source=source)
+            c, h, w = runner.sample_shape
+            if h != w:
+                raise ValueError(
+                    f"{source}: window warping needs a square model "
+                    f"input, got {runner.sample_shape}")
+            samples = warp_windows(
+                image, wins, crop_size=h, context_pad=context_pad,
+                use_square=(crop_mode == "square"),
+                mean_values=mean_values, scale=scale, source=source)
+        else:
+            samples = np.asarray(image, dtype=np.float32)
+            if samples.shape == tuple(runner.sample_shape):
+                samples = samples[None]
+            if samples.ndim != 1 + len(runner.sample_shape) or \
+                    tuple(samples.shape[1:]) != runner.sample_shape:
+                raise ValueError(
+                    f"{source}: rows must be (n, "
+                    f"{', '.join(map(str, runner.sample_shape))}), got "
+                    f"{tuple(samples.shape)}")
+            if not len(samples):
+                raise ValueError(f"{source}: zero rows")
+        n = len(samples)
+        if not self._accepting or lane.stopping:
+            raise ServerClosed("server is shutting down")
+        if deadline_ms is None:
+            deadline_ms = self.config.default_deadline_ms
+        if deadline_ms is not None and float(deadline_ms) <= 0.0:
+            lm.stats.bump("submitted", n)
+            lm.stats.bump("rejected_deadline", n)
+            if lane.resil is not None:
+                lane.resil.count_deadline_drop(
+                    "submit", -float(deadline_ms))
+            raise DeadlineExceeded(
+                f"deadline {float(deadline_ms):g} ms is already "
+                f"unmeetable at submit")
+        if lane.resil is not None and priority == "batch":
+            # ONE shed verdict for the whole compound, taken before any
+            # fragment admits: batch compounds shed whole-request,
+            # never partially
+            queued = lane.sched.queued_total()
+            reason = lane.resil.should_shed_batch(
+                queued, self.config.queue_depth)
+            if reason is not None:
+                lm.stats.bump("submitted", n)
+                lm.stats.bump("rejected_shed", n)
+                lane.resil.count_shed(priority, queued, reason)
+                self._compound_log(
+                    "compound_shed", model=model,
+                    mode=lane.model_type, fragments=n,
+                    priority=priority, reason=reason)
+                raise RequestShed(
+                    f"batch compound to {model!r} shed whole-request: "
+                    f"{reason}")
+        t0 = now_s()
+        deadline = (None if deadline_ms is None
+                    else t0 + float(deadline_ms) / 1e3)
+        asm = CompoundAssembler(
+            model=model, mode=lane.model_type, n=n, priority=priority,
+            t_submit=t0, windows=wins, nms_iou=nms_iou,
+            score_min=score_min,
+            cancel=lambda a, exc: self._cancel_fragments(lane, a, exc),
+            event=self._compound_log)
+        frags = []
+        for i in range(n):
+            req = _Request(sample=np.ascontiguousarray(samples[i]),
+                           future=Future(), t_submit=t0,
+                           deadline=deadline, priority=priority,
+                           compound=asm, frag=i)
+            req.future.add_done_callback(
+                lambda fut, i=i: asm.fragment_done(i, fut))
+            frags.append(req)
+        lm.stats.bump("submitted", n)
+        self._compound_log("compound_submit", model=model,
+                           mode=lane.model_type, fragments=n,
+                           priority=priority,
+                           windows=(len(wins) if wins is not None
+                                    else None))
+        with span("serve.submit_compound", model=model,
+                  fragments=n) as sp:
+            for i, req in enumerate(frags):
+                if asm.future.done():
+                    # a fast fragment already failed and aborted the
+                    # compound mid-fan-out; the rest never admit
+                    for r in frags[i:]:
+                        r.future.set_exception(ServingError(
+                            f"fragment {r.frag} never admitted: "
+                            f"compound to {model!r} aborted"))
+                    break
+                try:
+                    lane.sched.submit(req, wait=wait,
+                                      timeout_s=wait_timeout_s)
+                except (SchedulerFull, SchedulerClosed) as e:
+                    if isinstance(e, SchedulerFull):
+                        lm.stats.bump("rejected_overload")
+                        exc: ServingError = ServerOverloaded(
+                            f"{model!r} queue at depth "
+                            f"{self.config.queue_depth} with fragment "
+                            f"{i}/{n} of a compound in flight")
+                    else:
+                        exc = ServerClosed("server is shutting down")
+                    # all-or-nothing: fail the compound, sweep the
+                    # already-queued siblings, resolve the unsubmitted
+                    # fragments so no future leaks unresolved
+                    asm.abort(exc)
+                    for r in frags[i:]:
+                        if not r.future.done():
+                            r.future.set_exception(ServingError(
+                                f"fragment {r.frag} never admitted: "
+                                f"compound to {model!r} aborted"))
+                    raise exc from None
+            sp.set(queued=lane.sched.queued_total())
+        if asm.future.done() and asm.future.exception() is not None:
+            # late stragglers: a fragment submitted before the abort
+            # sweep ran may still sit queued — sweep once more
+            self._cancel_fragments(lane, asm, asm.future.exception())
+        return asm.future
+
+    def _cancel_fragments(self, lane: _Lane, asm, exc) -> int:
+        """Discard `asm`'s fragments still QUEUED on the lane (the
+        CompoundAssembler's cancel callback).  In-flight fragments
+        complete and are ignored by the sealed assembler — their math
+        is already launched; the queued ones are the saved device
+        work.  Discarded fragments resolve with a cancellation (their
+        done-callbacks re-enter the sealed assembler and back off), so
+        no future is ever left pending."""
+        removed = lane.sched.discard(
+            lambda it: getattr(it, "compound", None) is asm)
+        if removed:
+            lane.model.stats.bump("rejected_compound", len(removed))
+            for r in removed:
+                r.future.set_exception(ServingError(
+                    f"fragment {r.frag} cancelled: compound to "
+                    f"{asm.model!r} aborted ({type(exc).__name__})"))
+        return len(removed)
+
+    def compound_events(self) -> List[dict]:
+        """Snapshot of the compound lifecycle event stream (submit /
+        assembled / abort / shed) — the drill's and tests' handle."""
+        return self._compound_log.snapshot()
+
     # ---------------------------------------------------------------- hooks
     def add_response_hook(self, model: str, hook) -> None:
         """Register `hook(sample, response)` to observe every DELIVERED
@@ -632,6 +858,7 @@ class InferenceServer:
             if name not in per_model:
                 continue
             per_model[name]["queued_now"] = lane.sched.queued_total()
+            per_model[name]["model_type"] = lane.model_type
             breakdown = lane.model.stats.replica_breakdown()
             for i, (queued, inflight) in enumerate(lane.sched.depths()):
                 entry = breakdown.setdefault(

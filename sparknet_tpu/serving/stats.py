@@ -47,7 +47,10 @@ class ModelStats:
 
     SERIES = ("queue_wait", "assembly", "device", "total")
     REJECTS = ("rejected_overload", "rejected_deadline",
-               "rejected_closed", "rejected_shed")
+               "rejected_closed", "rejected_shed",
+               # fragments of an aborted compound discarded before
+               # dispatch (all-or-nothing cancellation, serving/compound.py)
+               "rejected_compound")
     BREAKER_STATES = {"closed": 0, "open": 1, "half_open": 2}
 
     def __init__(self, window: int = 65536) -> None:

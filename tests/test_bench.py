@@ -438,7 +438,7 @@ def test_bench_trainserve_leg_contract(monkeypatch):
 
     import bench
 
-    assert bench.BENCH_SCHEMA_VERSION == 10
+    assert bench.BENCH_SCHEMA_VERSION == 11
     canned = {"ok": True, "model": "lenet", "promotions": 2,
               "rejections": 1, "staleness_mean": 0.6, "staleness_max": 1.0,
               "swap_p99_delta_ms": 3.25, "dropped": 0, "completed": 132,
@@ -678,3 +678,77 @@ def test_bench_serving_fleet_leg_contract(monkeypatch):
     _Proc.stdout = _json.dumps(canned) + "\n"
     with pytest.raises(RuntimeError, match="dropped"):
         bench.bench_serving_fleet()
+
+
+def test_bench_serving_compound_leg_contract(monkeypatch):
+    """The serving_compound leg (schema v11) runs serve_chaos_run.py
+    --smoke --compound in a SUBPROCESS and parses one JSON line; pin
+    the field mapping against _KNOWN_FIELDS/_KNOWN_LEGS and every
+    failure mode the guarded leg relies on — non-zero exit, not-ok
+    record, the exactly-once bar (dropped > 0 must RAISE) and the
+    zero-partial bar (a partial compound must RAISE, never land).  The
+    live path is tests/test_serving_compound.py."""
+    import json as _json
+    import subprocess
+
+    import bench
+
+    canned = {"ok": True, "mode": "compound", "model": "lenet",
+              "requests": 120, "completed_compound": 74,
+              "completed_classify": 35, "dropped": 0,
+              "partial_responses": 0, "sheds": 9,
+              "sheds_interactive": 0, "breaker_trips": 3,
+              "interactive_p99_ms": 1102.6, "ab_pairs": 6,
+              "ab_served_ms": 7.58, "ab_offline_ms": 4.41,
+              "parity_checked": 6, "parity_failed": 0,
+              "replay_bitwise": True, "generations": [0]}
+
+    class _Proc:
+        returncode = 0
+        stderr = ""
+        stdout = "progress noise\n" + _json.dumps(canned) + "\n"
+
+    calls = []
+
+    def fake_run(cmd, **kw):
+        calls.append(cmd)
+        return _Proc()
+
+    monkeypatch.setattr(subprocess, "run", fake_run)
+    r = bench.bench_serving_compound()
+    assert calls and calls[0][1].endswith("serve_chaos_run.py")
+    assert "--smoke" in calls[0] and "--compound" in calls[0]
+    assert r["serving_compound_requests"] == 120
+    assert r["serving_compound_completed"] == 74
+    assert r["serving_compound_dropped"] == 0
+    assert r["serving_compound_partials"] == 0
+    assert r["serving_compound_sheds"] == 9
+    assert r["serving_compound_sheds_interactive"] == 0
+    assert r["serving_compound_breaker_trips"] == 3
+    assert r["serving_compound_interactive_p99_ms"] == 1102.6
+    assert r["serving_compound_ab_served_ms"] == 7.58
+    assert r["serving_compound_ab_offline_ms"] == 4.41
+    assert r["serving_compound_parity_failed"] == 0
+    assert r["serving_compound_replay_bitwise"] is True
+    assert set(r) <= bench._KNOWN_FIELDS
+    assert "serving_compound" in bench._KNOWN_LEGS
+
+    _Proc.returncode = 1
+    _Proc.stderr = "boom"
+    with pytest.raises(RuntimeError, match="exited 1"):
+        bench.bench_serving_compound()
+    _Proc.returncode = 0
+    canned["ok"] = False
+    _Proc.stdout = _json.dumps(canned) + "\n"
+    with pytest.raises(RuntimeError, match="not-ok"):
+        bench.bench_serving_compound()
+    canned["ok"] = True
+    canned["dropped"] = 3
+    _Proc.stdout = _json.dumps(canned) + "\n"
+    with pytest.raises(RuntimeError, match="dropped"):
+        bench.bench_serving_compound()
+    canned["dropped"] = 0
+    canned["partial_responses"] = 1
+    _Proc.stdout = _json.dumps(canned) + "\n"
+    with pytest.raises(RuntimeError, match="partial"):
+        bench.bench_serving_compound()

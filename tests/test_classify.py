@@ -286,3 +286,54 @@ def test_classify_cli_fuse_flag(tmp_path):
                str(out), "--center_only", "--fuse_1x1"])
     assert rc == 0
     assert np.load(out).shape == (1, 5)
+
+
+def test_detect_cli_windows_listfile(tmp_path, deploy_file, capsys):
+    """The detect verb (tools.cmd_detect) end to end: a window listfile
+    produces one output row PER INPUT LINE (filenames + windows +
+    predictions aligned), whole-image mode covers each input, and a
+    malformed listfile line fails loudly with rc 1."""
+    from PIL import Image
+
+    from sparknet_tpu.cli import main
+
+    rng = np.random.RandomState(3)
+    imgs = []
+    for i in range(2):
+        p = tmp_path / f"im{i}.png"
+        Image.fromarray((rng.rand(30, 30, 3) * 255)
+                        .astype(np.uint8)).save(p)
+        imgs.append(str(p))
+    listfile = tmp_path / "wins.txt"
+    # interleaved filenames + a CSV-style line: order must be kept
+    listfile.write_text(f"{imgs[0]} 0 0 20 20\n"
+                        f"{imgs[1]},5,5,25,25\n"
+                        f"{imgs[0]} 5 5 28 28\n")
+    out = tmp_path / "dets.npz"
+    rc = main(["detect", "--model", deploy_file, "--windows",
+               str(listfile), "--output", str(out),
+               "--context_pad", "2"])
+    assert rc == 0
+    z = np.load(out)
+    assert list(z["filenames"]) == [imgs[0], imgs[1], imgs[0]]
+    assert z["windows"].shape == (3, 4)
+    np.testing.assert_array_equal(z["windows"][1], [5, 5, 25, 25])
+    assert z["predictions"].shape == (3, 5)
+    assert not np.isnan(z["predictions"]).any()
+    np.testing.assert_allclose(z["predictions"].sum(axis=1), 1.0,
+                               rtol=1e-4)   # softmax head
+    # whole-image mode: no listfile, one full-frame window per input
+    out2 = tmp_path / "dets2.npz"
+    rc = main(["detect", imgs[0], imgs[1], "--model", deploy_file,
+               "--output", str(out2)])
+    assert rc == 0
+    z2 = np.load(out2)
+    assert z2["predictions"].shape == (2, 5)
+    np.testing.assert_array_equal(z2["windows"][0], [0, 0, 30, 30])
+    # malformed listfile line: loud rc 1, names the file
+    bad = tmp_path / "bad.txt"
+    bad.write_text(f"{imgs[0]} 1 2\n")
+    rc = main(["detect", "--model", deploy_file, "--windows", str(bad),
+               "--output", str(tmp_path / "x.npz")])
+    assert rc == 1
+    assert str(bad) in capsys.readouterr().err

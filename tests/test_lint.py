@@ -814,6 +814,10 @@ def test_lint_gate_script(tmp_path):
     # chaos-marked tests in tests/test_serving_fleet.py)
     assert "serve_chaos_run.py --smoke --fleet" in text
     assert "SPARKNET_LINT_GATE_NO_FLEET" in text
+    # ... and the compound-serving smoke (exercised live by
+    # tests/test_serving_compound.py's in-process suite)
+    assert "serve_chaos_run.py --smoke --compound" in text
+    assert "SPARKNET_LINT_GATE_NO_COMPOUND" in text
     clean = _mkpkg(tmp_path, {"ok.py": "x = 1\n"})
     dirty_dir = tmp_path / "dirty"
     dirty_dir.mkdir()
@@ -825,7 +829,8 @@ def test_lint_gate_script(tmp_path):
                SPARKNET_LINT_GATE_NO_SERVECHAOS="1",
                SPARKNET_LINT_GATE_NO_SHARDED="1",
                SPARKNET_LINT_GATE_NO_AUTOSCALE="1",
-               SPARKNET_LINT_GATE_NO_FLEET="1")
+               SPARKNET_LINT_GATE_NO_FLEET="1",
+               SPARKNET_LINT_GATE_NO_COMPOUND="1")
     rc_clean = subprocess.run(
         ["bash", gate, clean, "--select", "R001"],
         cwd=REPO, env=env, capture_output=True, text=True)

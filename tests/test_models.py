@@ -213,3 +213,18 @@ def test_rcnn_zoo_model_drives_the_detector(tmp_path):
         assert np.isfinite(d["prediction"]).all()
     # margins, not probabilities: no softmax normalization happened
     assert not np.allclose(dets[0]["prediction"].sum(), 1.0)
+
+
+def test_rcnn_is_servable_by_zoo_name():
+    """The serving loader passes deploy=True to every zoo builder, so
+    rcnn_ilsvrc13 must accept the kwarg (it is the detect lane's model:
+    CONTRACTS.json pins serving_forward[model=rcnn_ilsvrc13,...]).  The
+    family is deploy-only — deploy=False is refused loudly."""
+    from sparknet_tpu.serving.engine import resolve_net_param
+
+    npm = resolve_net_param("rcnn_ilsvrc13", max_batch=1)
+    shapes = Net(npm, "TEST").blob_shapes
+    assert shapes["fc-rcnn"] == (1, 200)
+    assert "prob" not in shapes  # raw margins: no deploy softmax
+    with pytest.raises(ValueError, match="deploy-only"):
+        get_model("rcnn_ilsvrc13", batch=1, deploy=False)

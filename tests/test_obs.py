@@ -251,7 +251,7 @@ def test_model_stats_snapshot_byte_for_byte_zero_state():
     pinned = ('{"submitted": 0, "completed": 0, "failed": 0, '
               '"batches": 0, "rejected_overload": 0, '
               '"rejected_deadline": 0, "rejected_closed": 0, '
-              '"rejected_shed": 0, '
+              '"rejected_shed": 0, "rejected_compound": 0, '
               '"batch_occupancy_mean": 0.0, "bucket_counts": {}, '
               f'"queue_wait_ms": {zero_ms}, "assembly_ms": {zero_ms}, '
               f'"device_ms": {zero_ms}, "total_ms": {zero_ms}}}')
@@ -427,8 +427,8 @@ def test_bench_stamp_provenance():
 
     payload = {"metric": "x", "value": 1.0}
     out = bench._stamp(payload)
-    # v10: the serving_fleet A/B leg (process workers vs in-process)
-    assert out["schema_version"] == bench.BENCH_SCHEMA_VERSION == 10
+    # v11: the serving_compound leg (windowed detect/featurize lanes)
+    assert out["schema_version"] == bench.BENCH_SCHEMA_VERSION == 11
     assert "git_sha" in out and "env" in out
     assert all(k.startswith("SPARKNET_") for k in out["env"])
     assert out["value"] == 1.0

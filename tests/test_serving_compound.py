@@ -1,0 +1,600 @@
+"""Compound serving (sparknet_tpu/serving/compound.py +
+InferenceServer.submit_compound): one logical request = one image + N
+proposal windows (detect) or N raw rows (featurize), fanned into the
+bucketed scheduler as fragments and reassembled all-or-nothing.
+
+The contracts pinned here:
+- window ingress is a PARSER: malformed windows die with a ValueError
+  naming the source, never IndexError/TypeError (CLAUDE.md),
+- warp_windows is BITWISE the offline WindowDataFeed._one pipeline
+  (data/window_data.py) with mirroring off — a served window's tensor
+  is the tensor the training batch path would build,
+- served compound scores are BITWISE a direct forward at the recorded
+  bucket (same-bucket replay; cross-bucket XLA programs drift ~1e-7,
+  so parity replays per-row across the response's recorded buckets),
+- control planes compose at the COMPOUND grain: whole-request batch
+  sheds, dead-on-arrival 504 before fan-out, all-or-nothing abort that
+  discards queued siblings, exactly-once under transient batch faults,
+- the capture_blob engine path flattens intermediate activations into
+  the (bucket, n_outputs) response contract.
+
+The reference stack has window warping only as an offline training
+feed (caffe window_data_layer.cpp) and detection only as a batch
+script (caffe python/caffe/detector.py); serving them is new surface,
+so these tests are the contract.
+"""
+
+import json
+import os
+import threading
+
+import numpy as np
+import pytest
+
+from sparknet_tpu.serving import (CompoundResponse, DeadlineExceeded,
+                                  InferenceServer, RequestShed,
+                                  ResilienceConfig, ServerConfig,
+                                  ServerOverloaded, nms, nms_detections,
+                                  pad_to_bucket, parse_windows,
+                                  warp_windows)
+from sparknet_tpu.serving.compound import (COMPOUND_LOG_ENV,
+                                           MAX_WINDOWS_ENV,
+                                           resolve_max_windows,
+                                           validate_model_type)
+from sparknet_tpu.serving.engine import ModelRunner, resolve_net_param
+from sparknet_tpu.serving.scheduler import ReplicaScheduler
+from sparknet_tpu.data.window_data import WindowDataFeed
+
+LENET_SHAPE = (1, 28, 28)
+
+
+def _rows(n, seed=0, shape=LENET_SHAPE):
+    return np.random.RandomState(seed).rand(n, *shape).astype(np.float32)
+
+
+def _image(seed=0, c=1, h=56, w=56):
+    return np.random.RandomState(seed).rand(c, h, w).astype(np.float32)
+
+
+def _replay_rows(runner, samples, buckets):
+    """The offline parity oracle: forward each row alone, padded to
+    each RECORDED bucket — a row matches iff it is bitwise equal at one
+    of the buckets its sibling fragments actually rode (same-bucket
+    replay is exact; different-bucket XLA programs drift ~6e-8)."""
+    outs = []
+    for i in range(len(samples)):
+        outs.append([runner.forward_padded(
+            pad_to_bucket(samples[i:i + 1], b))[0] for b in buckets])
+    return outs
+
+
+def _assert_parity(scores, replays):
+    for i, row in enumerate(np.asarray(scores)):
+        assert any(np.array_equal(row, r) for r in replays[i]), \
+            f"row {i} matches no recorded-bucket replay"
+
+
+# ----------------------------------------------------- ingress parsing
+def test_validate_model_type():
+    for mt in ("classify", "detect", "featurize"):
+        assert validate_model_type(mt) == mt
+    with pytest.raises(ValueError, match="model_type"):
+        validate_model_type("segment")
+
+
+def test_resolve_max_windows_env(monkeypatch):
+    monkeypatch.delenv(MAX_WINDOWS_ENV, raising=False)
+    assert resolve_max_windows() == 256
+    monkeypatch.setenv(MAX_WINDOWS_ENV, "7")
+    assert resolve_max_windows() == 7
+    monkeypatch.setenv(MAX_WINDOWS_ENV, "nope")
+    with pytest.raises(ValueError, match=MAX_WINDOWS_ENV):
+        resolve_max_windows()
+    monkeypatch.setenv(MAX_WINDOWS_ENV, "0")
+    with pytest.raises(ValueError, match=MAX_WINDOWS_ENV):
+        resolve_max_windows()
+
+
+def test_parse_windows_happy_path_coerces_to_int_tuples():
+    out = parse_windows([[0, 1, 2, 3], (4.0, 5.0, 6.0, 7.0),
+                         np.array([1, 1, 1, 1])])
+    assert out == [(0, 1, 2, 3), (4, 5, 6, 7), (1, 1, 1, 1)]
+    assert all(isinstance(v, int) for win in out for v in win)
+
+
+def test_parse_windows_valueerror_contract(monkeypatch):
+    """Network ingress is a parser: every malformed shape dies with a
+    ValueError naming the source — never IndexError/TypeError (the
+    repo-wide parser contract, CLAUDE.md)."""
+    src = "ingress-test"
+    cases = [
+        (None, "null"),
+        (42, "got int"),
+        ([], "empty"),
+        ([[0, 1, 2]], "3 coordinates"),
+        ([[0, 1, 2, 3, 4]], "5 coordinates"),
+        ([7], "window 0 must be"),
+        ([[0, 1, "x", 3]], "not an integer"),
+        ([[5, 1, 2, 3]], "inverted"),
+        ([[0, 5, 2, 3]], "inverted"),
+    ]
+    for raw, frag in cases:
+        with pytest.raises(ValueError, match=src) as ei:
+            parse_windows(raw, source=src)
+        assert frag in str(ei.value), (raw, str(ei.value))
+    monkeypatch.setenv(MAX_WINDOWS_ENV, "2")
+    with pytest.raises(ValueError, match="per-request cap"):
+        parse_windows([[0, 0, 1, 1]] * 3, source=src)
+
+
+# ---------------------------------------- warp parity with the offline feed
+class _FeedStub:
+    """A WindowDataFeed minus the dataset plumbing: just the attributes
+    _one() reads, so the parity pin calls the REAL offline method."""
+
+    def __init__(self, img, *, crop_size, context_pad=0,
+                 use_square=False, mean_values=None, scale=1.0):
+        self._img = img
+        self.crop_size = crop_size
+        self.context_pad = context_pad
+        self.use_square = use_square
+        self.mean_image = None
+        self.mean_values = (None if mean_values is None
+                            else np.asarray(mean_values, np.float32))
+        self.scale = scale
+
+    def _image(self, idx):
+        return self._img
+
+
+@pytest.mark.parametrize("kw", [
+    dict(),                                   # plain in-bounds crop
+    dict(context_pad=4),                      # context expansion + clip
+    dict(context_pad=4, use_square=True),     # square mode
+    dict(mean_values=[9.5], scale=0.25),      # mean + scale arithmetic
+])
+def test_warp_windows_matches_offline_window_feed_bitwise(kw):
+    img = (np.random.RandomState(11).rand(3, 40, 50) * 255) \
+        .astype(np.float32)
+    wins = [(3, 4, 20, 30), (0, 0, 49, 39), (10, 10, 10, 10),
+            (44, 2, 49, 8)]                  # incl. 1-px and border boxes
+    feed_kw = dict(kw)
+    if "mean_values" in feed_kw:
+        feed_kw["mean_values"] = feed_kw["mean_values"] * 3
+    got = warp_windows(img, wins, crop_size=12, **kw)
+    assert got.shape == (4, 3, 12, 12) and got.dtype == np.float32
+    for k, (x1, y1, x2, y2) in enumerate(wins):
+        want = WindowDataFeed._one(
+            _FeedStub(img, crop_size=12, **feed_kw),
+            [0.0, 1.0, 1.0, float(x1), float(y1), float(x2), float(y2)],
+            False)
+        np.testing.assert_array_equal(got[k], want,
+                                      err_msg=f"window {k} kw={kw}")
+
+
+def test_warp_windows_errors():
+    img = _image(c=3, h=20, w=20)
+    with pytest.raises(ValueError, match=r"\(C, H, W\)"):
+        warp_windows(img[0], [(0, 0, 5, 5)], crop_size=8)
+    # the plain (no-context) path crops raw coords: out-of-bounds dies
+    with pytest.raises(ValueError, match="outside"):
+        warp_windows(img, [(0, 0, 25, 5)], crop_size=8)
+    # ... but the context-pad path clips to the image instead
+    out = warp_windows(img, [(0, 0, 25, 5)], crop_size=8, context_pad=2)
+    assert out.shape == (1, 3, 8, 8)
+    with pytest.raises(ValueError, match="mean_value"):
+        warp_windows(img, [(0, 0, 5, 5)], crop_size=8,
+                     mean_values=[1.0, 2.0])
+
+
+# ----------------------------------------------------------------- nms
+def test_nms_greedy_suppression_and_detections_digest():
+    boxes = np.array([[0, 0, 10, 10], [1, 1, 11, 11], [50, 50, 60, 60]])
+    keep = nms(boxes, np.array([0.9, 0.8, 0.7]), iou_threshold=0.3)
+    assert keep == [0, 2]            # near-duplicate suppressed
+    assert nms(boxes, np.array([0.1, 0.9, 0.5]),
+               iou_threshold=0.99) == [1, 2, 0]   # high thr keeps all
+    scores = np.array([[0.9, -1.0], [0.8, 0.2], [-0.5, 0.6]])
+    dets = nms_detections([tuple(b) for b in boxes], scores,
+                          iou_threshold=0.3, score_min=0.0)
+    # per-class: class 0 keeps box0 (box1 suppressed), class 1 keeps
+    # box1 and box2; sorted by descending score
+    assert [(d["class"], d["window"][0]) for d in dets] == \
+        [(0, 0), (1, 50), (1, 1)]
+    assert dets[0]["score"] == pytest.approx(0.9)
+    assert nms_detections([tuple(b) for b in boxes], scores,
+                          score_min=0.85) == \
+        [{"window": (0, 0, 10, 10), "class": 0, "score": 0.9}]
+
+
+# ------------------------------------------------- engine capture_blob
+def test_capture_blob_flattens_into_the_response_contract():
+    runner = ModelRunner(resolve_net_param("lenet", max_batch=4),
+                         buckets=[1, 4], max_batch=4,
+                         capture_blob="ip1")
+    shape = runner.net.blob_shapes["ip1"]
+    assert runner.output_blob == "ip1"
+    assert runner.n_outputs == int(np.prod(shape[1:]))
+    y = runner.forward_padded(_rows(4, seed=5))
+    assert y.shape == (4, runner.n_outputs)
+    d = runner.describe()
+    assert d["capture_blob"] == "ip1"
+    assert d["output_blob"] == "ip1"
+    assert d["n_outputs"] == runner.n_outputs
+    # a conv capture flattens (C, H, W) per row — offline callers
+    # reshape back via blob_shapes (featurizer_app does)
+    conv = ModelRunner(resolve_net_param("lenet", max_batch=2),
+                       buckets=[2], max_batch=2,
+                       capture_blob="conv1")
+    cshape = conv.net.blob_shapes["conv1"]
+    assert conv.forward_padded(_rows(2)).shape == \
+        (2, int(np.prod(cshape[1:])))
+
+
+def test_capture_blob_validation(tmp_path):
+    net = resolve_net_param("lenet", max_batch=2)
+    with pytest.raises(ValueError, match="not a blob"):
+        ModelRunner(net, buckets=[2], max_batch=2,
+                    capture_blob="ghost_blob")
+    # a 1-d blob (label) cannot satisfy the (batch, features) response
+    # contract — the train-style tiny net has one
+    from sparknet_tpu.proto import caffe_pb
+
+    proto = tmp_path / "tiny.prototxt"
+    proto.write_text(_TINY_PROTOTXT)
+    tiny = caffe_pb.replace_data_layers(
+        caffe_pb.load_net_prototxt(str(proto)), 2, 2, 1, 16, 16)
+    with pytest.raises(ValueError, match="has shape"):
+        ModelRunner(tiny, buckets=[2], max_batch=2,
+                    capture_blob="label")
+
+
+# ------------------------------------------------------ the served lanes
+@pytest.fixture(scope="module")
+def compound_server():
+    server = InferenceServer(ServerConfig(max_batch=8, max_wait_ms=3.0,
+                                          queue_depth=64))
+    server.load("det", "lenet", model_type="detect")
+    server.load("feat", "lenet", model_type="featurize",
+                capture_blob="ip1")
+    server.load("cls", "lenet")
+    yield server
+    server.close(drain=True)
+
+
+def test_detect_compound_scores_bitwise_and_nms_digest(compound_server):
+    server = compound_server
+    runner = server._lane("det").model.runner
+    img = _image(seed=1)
+    wins = [(0, 0, 27, 27), (10, 12, 40, 44), (30, 5, 55, 50),
+            (2, 2, 2, 2), (20, 20, 47, 47)]
+    r = server.submit_compound("det", img, wins).result(30)
+    assert isinstance(r, CompoundResponse)
+    assert r.mode == "detect" and r.fragments == len(wins)
+    assert r.windows == [tuple(w) for w in wins]
+    assert r.scores.shape == (len(wins), runner.n_outputs)
+    assert set(r.buckets) <= set(runner.buckets)
+    # served == offline: warp through the same geometry, replay at the
+    # recorded buckets, bitwise per row
+    warped = warp_windows(img, r.windows, crop_size=28)
+    _assert_parity(r.scores, _replay_rows(runner, warped, r.buckets))
+    # the NMS digest is a pure function of (windows, scores): the
+    # host-side assembly recomputes identically
+    assert r.detections == nms_detections(r.windows, r.scores,
+                                          iou_threshold=0.3,
+                                          score_min=0.0)
+    assert r.argmaxes.shape == (len(wins),)
+
+
+def test_featurize_compound_rows_bitwise(compound_server):
+    server = compound_server
+    runner = server._lane("feat").model.runner
+    rows = _rows(5, seed=2)
+    r = server.submit_compound("feat", rows).result(30)
+    assert r.mode == "featurize" and r.fragments == 5
+    assert r.windows is None and r.detections is None
+    assert r.features.shape == (5, runner.n_outputs)
+    assert r.features is r.scores                 # alias, not a copy
+    _assert_parity(r.features, _replay_rows(runner, rows, r.buckets))
+    # a single bare sample promotes to a 1-row compound
+    one = server.submit_compound("feat", rows[0]).result(30)
+    assert one.fragments == 1 and one.features.shape[0] == 1
+
+
+def test_mixed_burst_no_partials_single_generation(compound_server):
+    """A burst of interleaved detect/featurize compounds + plain
+    classify rows: every compound comes back COMPLETE (all fragments,
+    one generation) and the classify lane is untouched — the
+    zero-partials acceptance bar, in-process."""
+    server = compound_server
+    img = _image(seed=3)
+    futs = []
+    for i in range(12):
+        if i % 3 == 0:
+            nw = 2 + i % 4
+            wins = [(j, j, j + 20, j + 20) for j in range(nw)]
+            futs.append(("det", nw,
+                         server.submit_compound("det", img, wins)))
+        elif i % 3 == 1:
+            n = 1 + i % 5
+            futs.append(("feat", n,
+                         server.submit_compound("feat",
+                                                _rows(n, seed=i))))
+        else:
+            futs.append(("cls", 1,
+                         server.submit("cls", _rows(1, seed=i)[0])))
+    for name, n, f in futs:
+        r = f.result(30)
+        if name == "cls":
+            assert abs(float(np.sum(r.probs)) - 1.0) < 1e-5
+        else:
+            assert r.fragments == n and len(r.scores) == n
+            assert isinstance(r.generation, int)
+    ev = server.compound_events()
+    kinds = [e["kind"] for e in ev]
+    assert kinds.count("compound_submit") == \
+        kinds.count("compound_assembled") + kinds.count("compound_abort")
+    for e in ev:
+        if e["kind"] == "compound_assembled":
+            assert e["fragments"] >= 1 and e["total_ms"] >= 0.0
+
+
+def test_compound_rejects_malformed_ingress(compound_server):
+    server = compound_server
+    img = _image(seed=4)
+    with pytest.raises(ValueError, match="classify"):
+        server.submit_compound("cls", img, [(0, 0, 5, 5)])
+    with pytest.raises(ValueError, match="'det'.*inverted"):
+        server.submit_compound("det", img, [(9, 0, 3, 5)])
+    with pytest.raises(ValueError, match="outside"):
+        server.submit_compound("det", img, [(0, 0, 99, 99)])
+    with pytest.raises(ValueError, match="rows must be"):
+        server.submit_compound("feat", np.zeros((2, 3, 3), np.float32))
+    with pytest.raises(ValueError, match="zero rows"):
+        server.submit_compound("feat",
+                               np.zeros((0,) + LENET_SHAPE, np.float32))
+    with pytest.raises(ValueError, match="priority"):
+        server.submit_compound("feat", _rows(1), priority="bulk")
+
+
+def test_stats_count_fragments_not_logical_requests(compound_server):
+    """The lane's ModelStats meter the scheduler's view: a compound
+    bumps submitted/completed once PER FRAGMENT (that is what crossed
+    the queue) — the logical-request ledger lives in compound_events."""
+    server = compound_server
+    before = server.stats()["models"]["feat"]
+    r = server.submit_compound("feat", _rows(3, seed=9)).result(30)
+    assert r.fragments == 3
+    after = server.stats()["models"]["feat"]
+    assert after["submitted"] - before["submitted"] == 3
+    assert after["completed"] - before["completed"] == 3
+
+
+# --------------------------------------------- control-plane composition
+def test_batch_compound_sheds_whole_request():
+    """shed_fraction=0.0 sheds every batch request: a batch COMPOUND
+    sheds as ONE verdict for all N fragments (never a partial shed),
+    the books record N fragment rejects + one compound_shed event, and
+    interactive compounds pass untouched."""
+    rcfg = ResilienceConfig(shed_fraction=0.0, tick_s=0.01,
+                            cooldown_s=0.1)
+    server = InferenceServer(ServerConfig(max_batch=8, max_wait_ms=2.0,
+                                          queue_depth=32,
+                                          resilience=rcfg))
+    try:
+        server.load("feat", "lenet", model_type="featurize",
+                    capture_blob="ip1")
+        rows = _rows(4, seed=6)
+        with pytest.raises(RequestShed, match="whole-request"):
+            server.submit_compound("feat", rows, priority="batch")
+        m = server.stats()["models"]["feat"]
+        assert m["rejected_shed"] == 4          # all 4 fragments, at once
+        assert m["completed"] == 0              # none slipped through
+        sheds = [e for e in server.compound_events()
+                 if e["kind"] == "compound_shed"]
+        assert len(sheds) == 1 and sheds[0]["fragments"] == 4
+        assert sheds[0]["priority"] == "batch"
+        r = server.submit_compound("feat", rows,
+                                   priority="interactive").result(30)
+        assert r.fragments == 4 and r.priority == "interactive"
+    finally:
+        server.close(drain=True)
+
+
+def test_dead_on_arrival_deadline_rejects_before_fanout():
+    rcfg = ResilienceConfig(tick_s=0.01, cooldown_s=0.1)
+    server = InferenceServer(ServerConfig(max_batch=8, max_wait_ms=2.0,
+                                          queue_depth=32,
+                                          resilience=rcfg))
+    try:
+        server.load("feat", "lenet", model_type="featurize",
+                    capture_blob="ip1")
+        with pytest.raises(DeadlineExceeded):
+            server.submit_compound("feat", _rows(3), deadline_ms=0.0)
+        m = server.stats()["models"]["feat"]
+        assert m["rejected_deadline"] == 3 and m["completed"] == 0
+        assert m["resilience"]["deadline_drops"] == 1  # one verdict
+    finally:
+        server.close(drain=True)
+
+
+def test_all_or_nothing_abort_discards_queued_siblings():
+    """With the batcher gated in flight and the queue nearly full, a
+    compound whose later fragment hits SchedulerFull aborts WHOLE: the
+    client sees ONE ServerOverloaded, the already-queued sibling is
+    discarded (rejected_compound — saved device work), and unrelated
+    queued work still completes bitwise."""
+    server = InferenceServer(ServerConfig(max_batch=2, max_wait_ms=1.0,
+                                          queue_depth=3))
+    try:
+        lm = server.load("feat", "lenet", model_type="featurize",
+                         capture_blob="ip1")
+        entered, release = threading.Event(), threading.Event()
+        orig = lm.runner.forward_padded
+
+        def gated(x):
+            entered.set()
+            assert release.wait(30), "gate never released"
+            return orig(x)
+
+        lm.runner.forward_padded = gated
+        try:
+            pin = server.submit_compound("feat", _rows(1, seed=1))
+            assert entered.wait(30)             # batcher inside forward
+            bystander = server.submit_compound("feat", _rows(2, seed=2))
+            # queue now holds 2 of 3: fragment 0 admits (queue full),
+            # fragment 1 rejects -> whole-compound abort
+            with pytest.raises(ServerOverloaded, match="fragment 1/3"):
+                server.submit_compound("feat", _rows(3, seed=3))
+        finally:
+            release.set()
+            lm.runner.forward_padded = orig
+        aborts = [e for e in server.compound_events()
+                  if e["kind"] == "compound_abort"]
+        assert len(aborts) == 1
+        assert aborts[0]["fragments"] == 3
+        assert aborts[0]["discarded"] == 1      # the queued sibling
+        assert aborts[0]["error"] == "ServerOverloaded"
+        assert server.stats()["models"]["feat"]["rejected_compound"] == 1
+        # the pinned and bystander compounds are untouched and complete
+        assert pin.result(30).fragments == 1
+        r = bystander.result(30)
+        assert r.fragments == 2
+        _assert_parity(r.features,
+                       _replay_rows(lm.runner, _rows(2, seed=2),
+                                    r.buckets))
+    finally:
+        server.close(drain=True)
+
+
+def test_exactly_once_under_transient_batch_fault():
+    """A batch that throws mid-compound redispatches its fragments
+    (resilience retry path): the compound still assembles COMPLETE,
+    every row bitwise at a recorded bucket, no duplicate or dropped
+    fragment — exactly-once at the fragment grain."""
+    rcfg = ResilienceConfig(tick_s=0.01, cooldown_s=0.1,
+                            breaker_window=64, max_retries=2)
+    server = InferenceServer(ServerConfig(max_batch=4, max_wait_ms=2.0,
+                                          queue_depth=32,
+                                          resilience=rcfg))
+    try:
+        lm = server.load("feat", "lenet", model_type="featurize",
+                         capture_blob="ip1")
+        orig = lm.runner.forward_padded
+        fails = {"n": 0}
+
+        def flaky(x):
+            if fails["n"] == 0:
+                fails["n"] += 1
+                raise RuntimeError("injected transient device fault")
+            return orig(x)
+
+        lm.runner.forward_padded = flaky
+        try:
+            rows = _rows(3, seed=7)
+            r = server.submit_compound("feat", rows).result(30)
+        finally:
+            lm.runner.forward_padded = orig
+        assert fails["n"] == 1                  # the fault really fired
+        assert r.fragments == 3 and len(r.features) == 3
+        _assert_parity(r.features, _replay_rows(lm.runner, rows,
+                                                r.buckets))
+        m = server.stats()["models"]["feat"]
+        assert m["completed"] == 3              # once each, no dupes
+        assert m["resilience"]["retried"] >= 1  # the requeue really ran
+    finally:
+        server.close(drain=True)
+
+
+def test_compound_event_log_jsonl_sink(tmp_path, monkeypatch):
+    """COMPOUND_LOG_ENV mirrors the in-memory event stream to JSONL —
+    line for line (the drill reconciles the two)."""
+    path = tmp_path / "compound_events.jsonl"
+    monkeypatch.setenv(COMPOUND_LOG_ENV, str(path))
+    server = InferenceServer(ServerConfig(max_batch=8, max_wait_ms=2.0,
+                                          queue_depth=32))
+    try:
+        server.load("feat", "lenet", model_type="featurize",
+                    capture_blob="ip1")
+        server.submit_compound("feat", _rows(2, seed=8)).result(30)
+    finally:
+        server.close(drain=True)
+    mem = server.compound_events()
+    assert [e["kind"] for e in mem] == ["compound_submit",
+                                       "compound_assembled"]
+    logged = [json.loads(line) for line in path.read_text().splitlines()]
+    assert logged == mem
+
+
+# ----------------------------------------------------- scheduler.discard
+def test_scheduler_discard_removes_queued_matches_only():
+    """discard(pred) pulls QUEUED matches across every replica and
+    returns them; non-matching items stay queued (the compound-abort
+    lever the server's _cancel_fragments stands on)."""
+
+    class Item:
+        def __init__(self, tag):
+            self.tag = tag
+
+    # min_fill=4 + a long coalesce window parks submissions in the
+    # queues (each replica holds < min_fill), so discard races nothing
+    sched = ReplicaScheduler(2, max_batch=4, queue_depth=16,
+                             run=lambda i, batch: None,
+                             min_fill=4, max_wait_ms=10_000.0, name="t")
+    try:
+        items = [Item("a"), Item("b"), Item("a"), Item("c")]
+        for it in items:
+            sched.submit(it)
+        assert sched.queued_total() == 4
+        removed = sched.discard(lambda it: it.tag == "a")
+        assert sorted(it.tag for it in removed) == ["a", "a"]
+        assert sched.queued_total() == 2
+        assert sched.discard(lambda it: it.tag == "zzz") == []
+    finally:
+        sched.stop(drain=False)
+
+
+# ------------------------------------- featurizer app tail regression
+_TINY_PROTOTXT = """
+name: "tiny"
+layer {
+  name: "data"  type: "Data"  top: "data"  top: "label"
+  data_param { batch_size: 4 }
+}
+layer {
+  name: "conv1"  type: "Convolution"  bottom: "data"  top: "conv1"
+  convolution_param { num_output: 6  kernel_size: 3  stride: 2
+    weight_filler { type: "xavier" } }
+}
+layer {
+  name: "ip1"  type: "InnerProduct"  bottom: "conv1"  top: "ip1"
+  inner_product_param { num_output: 10
+    weight_filler { type: "xavier" } }
+}
+layer {
+  name: "loss"  type: "SoftmaxWithLoss"  bottom: "ip1"  bottom: "label"
+  top: "loss"
+}
+"""
+
+
+def test_featurizer_keeps_tail_rows_and_blob_shapes(tmp_path):
+    """The historical FeaturizerApp bug dropped `len(data) %
+    batch_size` tail rows silently; the engine-rebased featurize()
+    pads the final chunk and slices back — 7 rows through batch_size=4
+    must equal the same 7 rows in one batch, bitwise, and a conv
+    capture must come back UNflattened."""
+    from sparknet_tpu.apps.featurizer_app import featurize
+
+    proto = tmp_path / "tiny.prototxt"
+    proto.write_text(_TINY_PROTOTXT)
+    data = np.random.RandomState(0).rand(7, 1, 16, 16) \
+        .astype(np.float32)
+    feats = featurize(str(proto), data, blob="ip1", batch_size=4)
+    assert feats.shape == (7, 10)               # ALL 7 rows, not 4
+    whole = featurize(str(proto), data, blob="ip1", batch_size=7)
+    np.testing.assert_array_equal(feats, whole)
+    conv = featurize(str(proto), data, blob="conv1", batch_size=4)
+    assert conv.ndim == 4 and conv.shape[0] == 7  # conv shape restored
+    assert featurize(str(proto), data[:0], blob="ip1",
+                     batch_size=4).shape == (0, 10)

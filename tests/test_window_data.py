@@ -101,6 +101,67 @@ def test_expand_window_square_mode():
     assert y2 - y1 == x2 - x1
 
 
+def test_expand_window_one_pixel_window():
+    """A degenerate 1-px proposal survives every mode: the geometry
+    never collapses the warp target to zero or escapes the canvas."""
+    assert expand_window(5, 5, 5, 5, 20, 20, 8, 0, False, False) == \
+        (5, 5, 5, 5, 8, 8, 0, 0)
+    for cp, sq in ((2, False), (0, True), (3, True)):
+        x1, y1, x2, y2, tw, th, pw, ph = expand_window(
+            5, 5, 5, 5, 20, 20, 8, cp, sq, False)
+        assert 0 <= x1 <= x2 < 20 and 0 <= y1 <= y2 < 20
+        assert tw >= 1 and th >= 1
+        assert pw + tw <= 8 and ph + th <= 8
+    # 1-px window in the image corner: clipping + padding still sane
+    x1, y1, x2, y2, tw, th, pw, ph = expand_window(
+        0, 0, 0, 0, 20, 20, 8, 2, False, False)
+    assert (x1, y1) == (0, 0) and pw + tw <= 8 and ph + th <= 8
+
+
+def test_expand_window_full_image_window_heavy_clip():
+    """A window already covering the image: context expansion clips on
+    ALL four sides and the canvas offsets stay inside the crop."""
+    x1, y1, x2, y2, tw, th, pw, ph = expand_window(
+        0, 0, 31, 31, 32, 32, 24, 8, False, False)
+    assert (x1, y1, x2, y2) == (0, 0, 31, 31)   # clipped to the image
+    assert pw > 0 and ph > 0                     # clip became padding
+    assert pw + tw <= 24 and ph + th <= 24
+
+
+def test_expand_window_rounding_is_c_round_not_bankers():
+    """The geometry uses C round() (half AWAY from zero); Python's
+    banker's round would land the expanded ROI one pixel off on exact
+    .5 midpoints (window_data_layer.cpp static_cast<int>(round(...)))."""
+    from sparknet_tpu.data.window_data import _c_round
+
+    assert _c_round(0.5) == 1 and round(0.5) == 0     # the divergence
+    assert _c_round(1.5) == 2 and _c_round(2.5) == 3
+    assert _c_round(-0.5) == -1 and _c_round(-2.5) == -3
+    # crop 16, pad 4: context_scale = 2.0; a half-extent of 2.5 hits
+    # exact .5 midpoints -> half-away-from-zero widens BOTH sides
+    x1, y1, x2, y2, tw, th, pw, ph = expand_window(
+        10, 10, 14, 14, 64, 64, 16, 4, False, False)
+    # center 12.5, half 2.5 * 2 = 5 -> c_round(7.5)=8, c_round(17.5)=18
+    assert (x1, x2) == (8, 18) and (y1, y2) == (8, 18)
+    assert (tw, th, pw, ph) == (16, 16, 0, 0)
+
+
+def test_expand_window_context_pad_too_large_rejected():
+    """2*context_pad >= crop_size divides by zero (or flips the scale
+    negative) in the reference formula — here it dies loudly as a
+    config ValueError, per the repo-wide parser contract."""
+    with pytest.raises(ValueError, match="context_pad"):
+        expand_window(0, 0, 5, 5, 20, 20, 8, 4, False, False)
+    with pytest.raises(ValueError, match="context_pad"):
+        expand_window(0, 0, 5, 5, 20, 20, 8, 5, False, False)
+    # square mode takes the same guard (it shares the scale formula)
+    with pytest.raises(ValueError, match="context_pad"):
+        expand_window(0, 0, 5, 5, 20, 20, 8, 4, True, False)
+    # boundary: the largest legal pad still works
+    out = expand_window(4, 4, 9, 9, 40, 40, 9, 4, False, False)
+    assert out[4] >= 1 and out[5] >= 1
+
+
 def test_batch_composition_and_shapes(tmp_path):
     paths = _make_images(tmp_path)
     wf = _window_file(tmp_path, paths)
